@@ -1,0 +1,28 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the MXNet 1.5 API.
+
+Brand-new implementation (NOT a port): the compute path is JAX/XLA/Pallas,
+parallelism is jax.sharding Mesh + collectives over ICI/DCN, and eager /
+hybridized execution maps onto XLA tracing + jit instead of an async CUDA
+dependency engine.
+
+API surface mirrors the reference (nswamy/incubator-mxnet):
+  python/mxnet/__init__.py — top-level namespaces nd, sym, gluon, module,
+  autograd, optimizer, kvstore, io, metric, initializer, ...
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor

@@ -1,0 +1,42 @@
+"""Base helpers and exceptions.
+
+Reference: python/mxnet/base.py (ctypes ABI plumbing, MXNetError, registry
+helpers). Here there is no C ABI to cross for the frontend — the native core
+is JAX/XLA — so this module keeps only the user-visible pieces: the exception
+type, name mangling, and the op-registration glue used to synthesize the
+`nd.*` / `sym.*` namespaces (reference: python/mxnet/base.py:580-647).
+"""
+
+import re
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (reference: python/mxnet/base.py:75)."""
+
+
+class NotSupportedForTPU(MXNetError):
+    """Raised for reference features that cannot map to TPU/XLA semantics."""
+
+
+def check_call(ret):  # kept for API compatibility with reference base.py
+    if ret != 0:
+        raise MXNetError("non-zero return")
+
+
+_CAMEL_RE1 = re.compile("(.)([A-Z][a-z]+)")
+_CAMEL_RE2 = re.compile("([a-z0-9])([A-Z])")
+
+
+def camel_to_snake(name):
+    s = _CAMEL_RE1.sub(r"\1_\2", name)
+    return _CAMEL_RE2.sub(r"\1_\2", s).lower()
+
+
+def classproperty(func):
+    class _Prop:
+        def __get__(self, obj, owner):
+            return func(owner)
+    return _Prop()

@@ -1,0 +1,132 @@
+"""Device contexts.
+
+Reference: python/mxnet/context.py — Context(device_type, device_id) with
+`with ctx:` scoping and a thread-default. TPU-native mapping: a Context wraps
+a concrete `jax.Device`. `gpu(i)` is accepted for source compatibility and
+resolves to the i-th accelerator (TPU) when one exists.
+"""
+
+import threading
+
+import jax
+
+_thread_local = threading.local()
+
+
+class Context:
+    """Device context, usable as a `with` scope (python/mxnet/context.py:28)."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type, self.device_id = device_type.device_type, device_type.device_id
+        else:
+            self.device_type = device_type
+            self.device_id = device_id
+        self._old_ctx = None
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def device_typeid(self):
+        return self.devstr2type[self.device_type]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    # -- jax mapping ------------------------------------------------------
+    @property
+    def jax_device(self):
+        """The concrete jax.Device this context denotes."""
+        if self.device_type == "cpu" or self.device_type == "cpu_pinned" \
+                or self.device_type == "cpu_shared":
+            devs = _devices_by_platform("cpu")
+        else:
+            devs = _accelerators()
+            if not devs:  # no accelerator present: transparently run on host
+                devs = _devices_by_platform("cpu")
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):
+        """Release pooled memory (reference Context.empty_cache). XLA manages
+        HBM arenas itself; provided as a no-op hook."""
+
+    # -- scoping ----------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(_thread_local, "ctx_stack"):
+            _thread_local.ctx_stack = []
+        _thread_local.ctx_stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        _thread_local.ctx_stack.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        stack = getattr(_thread_local, "ctx_stack", None)
+        if stack:
+            return stack[-1]
+        return _default_context()
+
+
+def _devices_by_platform(platform):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+def _accelerators():
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs
+
+
+def _default_context():
+    if _accelerators():
+        return Context("tpu", 0)
+    return Context("cpu", 0)
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Source-compat alias: reference scripts say `mx.gpu(0)`; on this stack
+    it denotes the i-th accelerator (TPU) chip."""
+    return Context("gpu", device_id)
+
+
+def num_gpus():
+    return len(_accelerators())
+
+
+def num_tpus():
+    return len(_accelerators())
+
+
+def current_context():
+    return Context.default_ctx()

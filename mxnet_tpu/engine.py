@@ -1,0 +1,114 @@
+"""Execution engine shim.
+
+Reference: src/engine/ — ThreadedEnginePerDevice schedules every kernel as an
+async op with read/write NDArray-var dependencies to hide CUDA launch latency
+(include/mxnet/engine.h:117-318, src/engine/threaded_engine.cc:288).
+
+TPU-native stance: XLA's runtime already executes dispatched computations
+asynchronously and in dependency order (jax.Array futures), so a user-space
+dependency scheduler for device kernels would only add latency. What remains
+engine-shaped on this stack:
+  * `wait_to_read` / `WaitForVar`  -> jax.Array.block_until_ready()
+  * `WaitForAll`                   -> sync over live arrays
+  * host-side async work (IO prefetch, checkpoint writes) -> a small thread
+    pool with FIFO ordering per key, mirroring FnProperty queues
+    (include/mxnet/engine.h:95-112).
+
+`set_bulk_size` / NaiveEngine toggles are kept as API no-ops: op bulking is
+what XLA fusion + jit tracing do natively.
+"""
+
+import os
+import queue
+import threading
+
+import jax
+
+_BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+
+
+class _Worker(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.q = queue.Queue()
+        self.start()
+
+    def run(self):
+        while True:
+            fn, done = self.q.get()
+            try:
+                fn()
+            finally:
+                done.set()
+
+
+class Engine:
+    """Host-side async executor with per-key FIFO ordering."""
+
+    def __init__(self):
+        self._workers = {}
+        self._pending = []
+        self._lock = threading.Lock()
+
+    def push(self, fn, key="default"):
+        """Run `fn` asynchronously; ops with the same key run in FIFO order
+        (mirrors per-var queues in src/engine/threaded_engine.h:104-229)."""
+        with self._lock:
+            w = self._workers.get(key)
+            if w is None:
+                w = self._workers[key] = _Worker()
+            done = threading.Event()
+            self._pending.append(done)
+            w.q.put((fn, done))
+        return done
+
+    def wait_for_all(self):
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for ev in pending:
+            ev.wait()
+
+
+_ENGINE = Engine()
+
+
+def get():
+    return _ENGINE
+
+
+def push(fn, key="default"):
+    return _ENGINE.push(fn, key)
+
+
+def wait_for_var(arr):
+    """Engine::WaitForVar — block until `arr` is materialized."""
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+
+
+def wait_for_all():
+    """MXNDArrayWaitAll: drain host-side queues and device work."""
+    _ENGINE.wait_for_all()
+    try:
+        jax.effects_barrier()
+    except Exception:  # pragma: no cover - older jax
+        pass
+
+
+def set_bulk_size(size):
+    """Kept for API parity (engine op bulking == XLA fusion here)."""
+    global _BULK_SIZE
+    prev = _BULK_SIZE
+    _BULK_SIZE = size
+    return prev
+
+
+def bulk(size):
+    """Context manager parity with mx.engine bulking (no-op under XLA)."""
+    class _Bulk:
+        def __enter__(self):
+            self._prev = set_bulk_size(size)
+
+        def __exit__(self, *a):
+            set_bulk_size(self._prev)
+    return _Bulk()

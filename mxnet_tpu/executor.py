@@ -1,0 +1,305 @@
+"""Executor — bound symbolic graphs, compiled to single XLA computations.
+
+Reference: src/executor/graph_executor.cc (SimpleBind:1913, Bind:1995,
+Forward:78, Backward:91) — there, the graph is executed node-by-node
+through the dependency engine with a hand-built memory plan
+(src/nnvm/plan_memory.cc) and manual op bulking (InitOpSegs:1288).
+
+TPU-native design: binding lowers the WHOLE graph (and its backward) to
+one jit-compiled XLA computation. XLA subsumes the reference passes:
+memory planning (buffer assignment), inplace/addto detection (buffer
+aliasing), op bulking (fusion), and the gradient pass (jax.vjp). The
+train-mode path compiles forward+backward together so TPU sees a single
+fused program per (shapes, dtypes) signature.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import ops
+from .base import MXNetError
+from .symbol import OP_AUX
+
+_META_ATTRS = ("__input_names__", "__shape__", "__dtype__", "__lr_mult__",
+               "__wd_mult__", "__init__", "__aux__", "__ctx_group__",
+               "__storage_type__")
+
+
+def _clean_attrs(attrs):
+    return {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+
+def node_eval_fn(node, for_inference=False):
+    """Pure fn(*input_arrays) for one graph node (used by eval_shape)."""
+    op = ops.get(node.op)
+    attrs = _clean_attrs(node.attrs)
+    sig = ops.op_signature(node.op)
+    if "is_train" in sig.parameters:
+        attrs.setdefault("is_train", False)
+    if op.stateful_rng and "rng_key" in sig.parameters:
+        attrs.setdefault("rng_key", jax.random.PRNGKey(0))
+
+    import inspect
+    has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
+                      for p in sig.parameters.values())
+    in_names = node.attrs.get("__input_names__")
+
+    def fn(*arrays):
+        if has_varargs:
+            return op.fn(*arrays, **attrs)
+        call = dict(attrs)
+        if in_names:
+            call.update({n: a for n, a in zip(in_names, arrays)})
+        else:
+            pnames = [p for p in sig.parameters if p not in attrs]
+            call.update({n: a for n, a in zip(pnames, arrays)})
+        return op.fn(**call)
+
+    return fn
+
+
+def build_graph_fn(symbol, is_train):
+    """Compile plan: returns fn(arg_dict, aux_dict, rng_key) ->
+    (outputs_list, new_aux_dict)."""
+    all_nodes = symbol._nodes
+    nodes = symbol._active_nodes()
+    out_refs = [(all_nodes[ni], oi) for ni, oi in symbol._outputs]
+
+    def graph_fn(arg_arrays, aux_arrays, rng_key):
+        vals = {}
+        aux_updates = {}
+        key = rng_key
+        for node in nodes:
+            if node.is_var():
+                name = node.name
+                if name in arg_arrays:
+                    vals[(id(node), 0)] = arg_arrays[name]
+                elif name in aux_arrays:
+                    vals[(id(node), 0)] = aux_arrays[name]
+                else:
+                    raise MXNetError("unbound variable %s" % name)
+                continue
+            op = ops.get(node.op)
+            attrs = _clean_attrs(node.attrs)
+            sig = ops.op_signature(node.op)
+            if "is_train" in sig.parameters:
+                attrs["is_train"] = is_train
+            if op.stateful_rng and "rng_key" in sig.parameters:
+                key, sub = jax.random.split(key)
+                attrs["rng_key"] = sub
+            ins = []
+            for s, oi in node.inputs:
+                src = s._nodes[s._outputs[0][0]]
+                ins.append(vals[(id(src), oi)])
+            import inspect
+            has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
+                              for p in sig.parameters.values())
+            in_names = node.attrs.get("__input_names__")
+            if has_varargs:
+                out = op.fn(*ins, **attrs)
+            else:
+                call = dict(attrs)
+                if in_names:
+                    call.update({n: a for n, a in zip(in_names, ins)})
+                else:
+                    pnames = [p for p in sig.parameters if p not in attrs]
+                    call.update({n: a for n, a in zip(pnames, ins)})
+                out = op.fn(**call)
+
+            if node.op == "BatchNorm":
+                # fold running-stat update (reference mutates aux in-place,
+                # src/operator/nn/batch_norm.cc; we return new values)
+                y, mean, var = out
+                vals[(id(node), 0)] = y
+                if is_train and not node.attrs.get("use_global_stats", False):
+                    mom = float(node.attrs.get("momentum", 0.9))
+                    names = node.attrs.get("__input_names__", ())
+                    for pname, stat in (("moving_mean", mean), ("moving_var", var)):
+                        try:
+                            idx = list(names).index(pname)
+                        except ValueError:
+                            continue
+                        s, _ = node.inputs[idx]
+                        aux_name = s._nodes[s._outputs[0][0]].name
+                        old = aux_arrays[aux_name]
+                        aux_updates[aux_name] = mom * old + (1 - mom) * stat
+                continue
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for k, o in enumerate(outs):
+                vals[(id(node), k)] = o
+
+        outputs = []
+        for node, oi in out_refs:
+            outputs.append(vals[(id(node), oi)])
+        return outputs, aux_updates
+
+    return graph_fn
+
+
+class Executor:
+    """Bound executor (python/mxnet/executor.py wrapper semantics)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None):
+        from . import ndarray as nd
+        self._symbol = symbol
+        self._ctx = ctx
+        self._group2ctx = group2ctx or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.arg_dict = {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+                         for k, v in args.items()}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict = args_grad or {}
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.aux_dict = aux_states or {}
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+
+        self._diff_args = [n for n in arg_names
+                           if self._grad_req.get(n, "null") != "null"
+                           and n in self.grad_dict]
+
+        self.outputs = []
+        self._cached_grads = None
+        self._saved_inputs = None
+
+        fwd_infer = build_graph_fn(symbol, is_train=False)
+        fwd_train = build_graph_fn(symbol, is_train=True)
+        diff_names = tuple(self._diff_args)
+
+        @jax.jit
+        def infer_fn(arg_arrays, aux_arrays, key):
+            outs, _ = fwd_infer(arg_arrays, aux_arrays, key)
+            return outs
+
+        @jax.jit
+        def train_fn(diff_arrays, rest_arrays, aux_arrays, key, head_grads):
+            def f(diff):
+                full = dict(rest_arrays)
+                full.update(dict(zip(diff_names, diff)))
+                outs, aux_up = fwd_train(full, aux_arrays, key)
+                return outs, aux_up
+            outs, vjp, aux_up = jax.vjp(f, list(diff_arrays), has_aux=True)
+            heads = [h if h is not None else jnp.ones_like(o)
+                     for h, o in zip(head_grads, outs)]
+            (grads,) = vjp(type(outs)(heads) if isinstance(outs, (tuple, list))
+                           else heads[0])
+            return outs, aux_up, grads
+
+        self._infer_fn = infer_fn
+        self._train_fn = train_fn
+
+    # ------------------------------------------------------------ run ---
+    def forward(self, is_train=False, **kwargs):
+        """is_train=True compiles+runs forward AND backward (with default
+        ones head-grads) as one fused XLA program — optimal for the standard
+        Module train step (forward → backward() with no custom heads). Use
+        is_train=False for pure inference: it runs the cheap forward-only
+        program. backward(out_grads=...) with custom heads re-runs the fused
+        program with those heads (costs one extra forward)."""
+        from . import ndarray as nd
+        from . import random as rnd
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, nd.NDArray) \
+                    else jnp.asarray(v)
+        arg_arrays = {k: v._data for k, v in self.arg_dict.items()}
+        aux_arrays = {k: v._data for k, v in self.aux_dict.items()}
+        key = rnd.next_key()
+        if is_train:
+            self._saved_inputs = (arg_arrays, aux_arrays, key)
+            outs, aux_up, grads = self._run_train(arg_arrays, aux_arrays, key,
+                                                  [None] * len(self._symbol._outputs))
+            self._cached_grads = grads
+            for name, val in aux_up.items():
+                self.aux_dict[name]._data = val
+        else:
+            self._saved_inputs = None
+            self._cached_grads = None
+            outs = self._infer_fn(arg_arrays, aux_arrays, key)
+        self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        return self.outputs
+
+    def _run_train(self, arg_arrays, aux_arrays, key, head_grads):
+        diff = [arg_arrays[n] for n in self._diff_args]
+        rest = {k: v for k, v in arg_arrays.items()}
+        outs, aux_up, grads = self._train_fn(diff, rest, aux_arrays, key,
+                                             head_grads)
+        return outs, aux_up, grads
+
+    def backward(self, out_grads=None):
+        from . import ndarray as nd
+        if self._saved_inputs is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is not None:
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            heads = [g._data if isinstance(g, nd.NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+            arg_arrays, aux_arrays, key = self._saved_inputs
+            _, _, grads = self._run_train(arg_arrays, aux_arrays, key, heads)
+        else:
+            grads = self._cached_grads
+        for name, g in zip(self._diff_args, grads):
+            req = self._grad_req.get(name, "write")
+            tgt = self.grad_dict[name]
+            if req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    # ------------------------------------------------------- utilities --
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %s" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = v._data
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %s" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """graph_executor.cc:876 Reshape — with jit, reshape is free: new
+        shapes trigger a cached recompile keyed on the new signature."""
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        for name, shp in zip(self._symbol.list_arguments(), arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) != tuple(shp):
+                self.arg_dict[name] = nd.zeros(shp, ctx=self._ctx, dtype=cur.dtype)
+                if name in self.grad_dict and self.grad_dict[name] is not None:
+                    self.grad_dict[name] = nd.zeros(shp, ctx=self._ctx,
+                                                    dtype=cur.dtype)
+        return self

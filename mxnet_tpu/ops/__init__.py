@@ -1,0 +1,109 @@
+"""Operator registry — the TPU-native analogue of the NNVM op registry.
+
+Reference: 345 `NNVM_REGISTER_OP` registrations under src/operator/ with
+attribute functions FInferShape/FInferType/FCompute/FGradient consumed by the
+imperative and symbolic runtimes (include/mxnet/op_attr_types.h, dispatch in
+src/imperative/imperative_utils.h:394-560).
+
+TPU-native design: an op's "kernel" is a pure JAX function over jax.Array
+inputs. That single artifact subsumes most of the reference's attribute
+machinery:
+  * FCompute            -> the function itself (XLA-compiled on dispatch)
+  * FInferShape/Type    -> jax.eval_shape on the function (free, exact)
+  * FGradient           -> jax.vjp on the function (free, exact)
+  * FInplaceOption      -> XLA buffer aliasing / donation
+  * dispatch modes      -> XLA backend selection; no sparse/MKLDNN forks
+
+What the registry still owns: the op *name* surface (so `nd.*`, `sym.*` and
+Symbol JSON stay MXNet-compatible), parameter parsing/validation, and
+flags (non-differentiable outputs, rng statefulness, mutable inputs).
+"""
+
+import functools
+import inspect
+
+from ..base import MXNetError
+
+_REGISTRY = {}
+_ALIAS = {}
+
+
+class Op:
+    """A registered operator.
+
+    `fn(*arrays, **attrs)` must be a pure JAX-traceable function: arrays are
+    jax.Array (or pytrees of them for multi-output ops), attrs are static
+    python values. Multi-output ops return a tuple/list.
+    """
+
+    def __init__(self, name, fn, differentiable=True, stateful_rng=False,
+                 num_outputs=1, mutate_inputs=()):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.stateful_rng = stateful_rng
+        self.num_outputs = num_outputs
+        self.mutate_inputs = tuple(mutate_inputs)
+        self._sig = None
+
+    def make_fn(self, attrs):
+        """Close the op over static attrs -> pure fn(*arrays)."""
+        fn = self.fn
+        if not attrs:
+            return fn
+        return functools.partial(fn, **attrs)
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def register(name=None, aliases=(), differentiable=True, stateful_rng=False,
+             num_outputs=1, mutate_inputs=()):
+    """Decorator: register a pure jax function as an operator."""
+    def deco(fn):
+        opname = name or fn.__name__
+        op = Op(opname, fn, differentiable=differentiable,
+                stateful_rng=stateful_rng, num_outputs=num_outputs,
+                mutate_inputs=mutate_inputs)
+        _REGISTRY[opname] = op
+        for a in aliases:
+            _ALIAS[a] = opname
+        return fn
+    return deco
+
+
+def get(name):
+    op = _REGISTRY.get(name)
+    if op is None:
+        real = _ALIAS.get(name)
+        if real is not None:
+            op = _REGISTRY[real]
+    if op is None:
+        raise MXNetError("Operator %s is not registered" % name)
+    return op
+
+
+def exists(name):
+    return name in _REGISTRY or name in _ALIAS
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+def op_signature(name):
+    op = get(name)
+    if op._sig is None:
+        op._sig = inspect.signature(op.fn)
+    return op._sig
+
+
+# Import op definition modules so the registry is populated at import time
+# (mirrors static NNVM_REGISTER_OP initializers linking into libmxnet.so).
+from . import elemwise  # noqa: E402,F401
+from . import reduce_ops  # noqa: E402,F401
+from . import matrix  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import random_ops  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import contrib_ops  # noqa: E402,F401

@@ -1,0 +1,300 @@
+"""Contrib operators.
+
+Reference: src/operator/contrib/ — the subset with TPU-sensible semantics:
+transformer helpers (transformer.cc interleaved-matmul attention), ROIAlign,
+bounding-box ops, fft/ifft, boolean_mask (dense variant), index ops,
+adaptive pooling, bilinear resize, quadratic (tutorial op),
+gradient_multiplier, hawkes_ll, allclose/all_finite.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+@register(name="_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """src/operator/contrib/quadratic_op.cc (the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register(name="_contrib_gradientmultiplier")
+def gradient_multiplier(data, scalar=1.0):
+    """src/operator/contrib/gradient_multiplier_op.cc — identity fwd,
+    scaled bwd."""
+    return data * scalar - lax.stop_gradient(data * (scalar - 1.0))
+
+
+@register(name="_contrib_fft")
+def fft(data, compute_size=128):
+    """src/operator/contrib/fft.cc — output packs (re, im) interleaved on
+    the last axis, matching the reference layout."""
+    f = jnp.fft.fft(data.astype("float32"), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register(name="_contrib_ifft")
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    x = data.astype("float32").reshape(data.shape[:-1] + (n, 2))
+    c = x[..., 0] + 1j * x[..., 1]
+    return jnp.fft.ifft(c, axis=-1).real.astype(data.dtype) * n
+
+
+@register(name="_contrib_index_copy")
+def index_copy(old, idx, new):
+    return old.at[idx.astype("int32")].set(new)
+
+
+@register(name="_contrib_index_array", differentiable=False)
+def index_array(data, axes=None):
+    shape = data.shape
+    axes = tuple(axes) if axes is not None else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    out = jnp.stack([grids[a] for a in axes], axis=-1)
+    return out.astype("int64")
+
+
+@register(name="all_finite", differentiable=False)
+def all_finite(*arrays, init_output=True):
+    """src/operator/contrib/all_finite.cc — scalar 1.0 if every element of
+    every input is finite (AMP loss-scaler support)."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype("float32"))))
+    return ok.astype("float32").reshape(1)
+
+
+@register(name="multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    return all_finite(*arrays)
+
+
+@register(name="_contrib_allclose", differentiable=False)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    return jnp.asarray(
+        jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        dtype="float32").reshape(1)
+
+
+@register(name="_contrib_arange_like", differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        return (start + step * jnp.arange(n, dtype=data.dtype)).reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+# ----------------------------------------------------------- transformer --
+@register(name="_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """src/operator/contrib/transformer.cc — input (seq, batch, 3*embed)
+    with q/k/v head-interleaved; returns (batch*heads, seq, seq) scores."""
+    s, b, e3 = queries_keys_values.shape
+    e = e3 // 3
+    hd = e // heads
+    x = queries_keys_values.reshape(s, b, heads, 3, hd)
+    q = x[:, :, :, 0]  # (s, b, h, hd)
+    k = x[:, :, :, 1]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(b * heads, s, hd)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(b * heads, s, hd)
+    return jnp.matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+
+
+@register(name="_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    s, b, e3 = queries_keys_values.shape
+    e = e3 // 3
+    hd = e // heads
+    x = queries_keys_values.reshape(s, b, heads, 3, hd)
+    v = jnp.transpose(x[:, :, :, 2], (1, 2, 0, 3)).reshape(b * heads, s, hd)
+    out = jnp.matmul(attention, v)  # (b*h, s, hd)
+    out = out.reshape(b, heads, s, hd)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, e)
+
+
+@register(name="_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ------------------------------------------------------------- roi align --
+@register(name="_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """src/operator/contrib/roi_align.cc — bilinear-sampled average pool."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    sr = 2 if sample_ratio <= 0 else sample_ratio
+    off = 0.5 if aligned else 0.0
+
+    def one(roi):
+        bidx = roi[0].astype("int32")
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bw, bh = rw / pw, rh / ph
+        img = data[bidx]  # (c, h, w)
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        sy = jnp.arange(sr)
+        sx = jnp.arange(sr)
+        yy = y1 + (py[:, None] + (sy[None, :] + 0.5) / sr) * bh  # (ph, sr)
+        xx = x1 + (px[:, None] + (sx[None, :] + 0.5) / sr) * bw  # (pw, sr)
+        yg = yy.reshape(-1)  # ph*sr
+        xg = xx.reshape(-1)  # pw*sr
+
+        y0 = jnp.clip(jnp.floor(yg), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xg), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype("int32")
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype("int32")
+        y0i = y0.astype("int32"); x0i = x0.astype("int32")
+        wy1 = jnp.clip(yg, 0, h - 1) - y0; wy0 = 1 - wy1
+        wx1 = jnp.clip(xg, 0, w - 1) - x0; wx0 = 1 - wx1
+        g = (img[:, y0i][:, :, x0i] * (wy0[:, None] * wx0[None, :])
+             + img[:, y0i][:, :, x1i] * (wy0[:, None] * wx1[None, :])
+             + img[:, y1i][:, :, x0i] * (wy1[:, None] * wx0[None, :])
+             + img[:, y1i][:, :, x1i] * (wy1[:, None] * wx1[None, :]))
+        g = g.reshape(c, ph, sr, pw, sr)
+        return jnp.mean(g, axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------- bounding box --
+@register(name="_contrib_box_iou")
+def box_iou(lhs, rhs, format="corner"):
+    """src/operator/contrib/bounding_box.cc box_iou."""
+    def to_corner(b):
+        if format == "center":
+            x, y, w_, h_ = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([x - w_ / 2, y - h_ / 2, x + w_ / 2, y + h_ / 2], -1)
+        return b
+    a = to_corner(lhs)[..., None, :]
+    b = to_corner(rhs)[None, ...]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register(name="_contrib_box_nms", aliases=("_contrib_box_non_maximum_suppression",),
+          differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Greedy NMS with a fixed iteration bound (static shapes for XLA)."""
+    boxes = data[..., coord_start:coord_start + 4]
+    scores = data[..., score_index]
+    n = data.shape[-2]
+
+    def nms_one(boxes_i, scores_i, data_i):
+        order = jnp.argsort(-scores_i)
+        boxes_s = boxes_i[order]
+        scores_s = scores_i[order]
+        valid = scores_s > valid_thresh
+
+        tl = jnp.maximum(boxes_s[:, None, :2], boxes_s[None, :, :2])
+        br = jnp.minimum(boxes_s[:, None, 2:], boxes_s[None, :, 2:])
+        wh = jnp.maximum(br - tl, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (boxes_s[:, 2] - boxes_s[:, 0]) * (boxes_s[:, 3] - boxes_s[:, 1])
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & keep[i] & (jnp.arange(n) > i)
+            return jnp.where(sup, False, keep)
+        keep = lax.fori_loop(0, n, body, valid)
+        out = data_i[order]
+        return jnp.where(keep[:, None], out, -1.0)
+
+    flat = data.reshape(-1, n, data.shape[-1])
+    out = jax.vmap(nms_one)(flat[..., coord_start:coord_start + 4],
+                            flat[..., score_index], flat)
+    return out.reshape(data.shape)
+
+
+# ------------------------------------------------------ adaptive pooling --
+@register(name="_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, output_size=()):
+    """src/operator/contrib/adaptive_avg_pooling.cc."""
+    if not output_size:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = (output_size[0], output_size[0]) if len(output_size) == 1 else output_size
+    n, c, h, w = data.shape
+    # integral-image formulation keeps everything static-shape
+    ys = (jnp.arange(oh + 1) * h) // oh
+    xs = (jnp.arange(ow + 1) * w) // ow
+    integ = jnp.cumsum(jnp.cumsum(data, axis=2), axis=3)
+    integ = jnp.pad(integ, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    s = (integ[:, :, ys[1:], :][:, :, :, xs[1:]]
+         - integ[:, :, ys[:-1], :][:, :, :, xs[1:]]
+         - integ[:, :, ys[1:], :][:, :, :, xs[:-1]]
+         + integ[:, :, ys[:-1], :][:, :, :, xs[:-1]])
+    counts = ((ys[1:] - ys[:-1])[:, None] * (xs[1:] - xs[:-1])[None, :]).astype(data.dtype)
+    return s / counts
+
+
+@register(name="_contrib_BilinearResize2D")
+def bilinear_resize(data, height=1, width=1, scale_height=None, scale_width=None,
+                    mode="size", align_corners=True):
+    """src/operator/contrib/bilinear_resize.cc."""
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (n, c, int(height), int(width)),
+                            method="linear")
+
+
+@register(name="_contrib_hawkesll", num_outputs=2)
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """src/operator/contrib/hawkes_ll.cc — simplified log-likelihood of a
+    marked self-exciting process."""
+    # lda: (N,K) background; alpha,beta: (K,); lags,marks: (N,T)
+    N, T = lags.shape
+    K = lda.shape[1]
+
+    def one(lda_i, state_i, lags_i, marks_i, vl_i, mt_i):
+        def step(carry, t):
+            ll, rem = carry
+            m = marks_i[t].astype("int32")
+            dt = lags_i[t]
+            decay = jnp.exp(-beta * dt)
+            rem = rem * decay
+            lam = lda_i[m] + alpha[m] * beta[m] * rem[m]
+            valid = (t < vl_i).astype(lam.dtype)
+            ll = ll + valid * jnp.log(jnp.maximum(lam, 1e-20))
+            rem = rem.at[m].add(valid)
+            return (ll, rem), None
+        (ll, rem), _ = lax.scan(step, (jnp.asarray(0.0, lda.dtype), state_i),
+                                jnp.arange(T))
+        compens = jnp.sum(lda_i * mt_i) + jnp.sum(alpha * (1 - jnp.exp(-beta * mt_i)) * rem * 0)
+        return ll - compens, rem
+
+    ll, states = jax.vmap(one)(lda, state, lags, marks, valid_length,
+                               jnp.broadcast_to(max_time, (N,)))
+    return ll, states
+
+
+@register(name="_contrib_count_sketch")
+def count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
+    """src/operator/contrib/count_sketch.cc."""
+    idx = h.astype("int32").reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
+    return out.at[..., idx].add(data * sign)

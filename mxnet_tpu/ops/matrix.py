@@ -1,0 +1,485 @@
+"""Matrix / shape-manipulation / indexing operators.
+
+Reference: src/operator/tensor/matrix_op.cc (+ matrix_op-inl.h), dot-inl.h,
+indexing_op.cc, init_op.cc, ordering_op.cc, histogram.cc. All static-shape
+transforms — exactly what XLA wants; `dot`/`batch_dot` land on the MXU via
+lax.dot_general.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+# ------------------------------------------------------------------ dot --
+@register(name="dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """src/operator/tensor/dot-inl.h — 2D (and nD-flattened) matmul."""
+    a = lhs.T if transpose_a and lhs.ndim == 2 else (jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs)
+    b = rhs.T if transpose_b and rhs.ndim == 2 else (jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot contracts last axis of a with first axis of b for nD
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register(name="batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------- shape --
+@register(name="Reshape", aliases=("reshape",))
+def reshape(data, shape=(), reverse=False):
+    """src/operator/tensor/matrix_op.cc Reshape with MXNet's special codes:
+    0 copy dim, -1 infer, -2 copy rest, -3 merge two, -4 split."""
+    if not shape:
+        return data
+    src = list(data.shape[::-1]) if reverse else list(data.shape)
+    spec = list(shape[::-1]) if reverse else list(shape)
+    out = []
+    i = 0
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = spec[j + 1], spec[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return data.reshape(tuple(out))
+
+
+@register(name="reshape_like")
+def reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+@register(name="Flatten", aliases=("flatten",))
+def flatten(data):
+    return data.reshape(data.shape[0], -1)
+
+
+@register(name="transpose")
+def transpose(data, axes=None):
+    if axes is None or axes == ():
+        return jnp.transpose(data)
+    return jnp.transpose(data, axes)
+
+
+@register(name="expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register(name="squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register(name="swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register(name="depth_to_space")
+def depth_to_space(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register(name="space_to_depth")
+def space_to_depth(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------- slice --
+@register(name="slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    idx = []
+    for i in range(data.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] is not None and step[i] != 0 else None
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register(name="slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    ax = axis % data.ndim
+    idx = [slice(None)] * data.ndim
+    idx[ax] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register(name="slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a % data.ndim] = slice(0, shape_like.shape[a % shape_like.ndim])
+    return data[tuple(idx)]
+
+
+@register(name="SliceChannel", aliases=("split",), num_outputs="n")
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """src/operator/slice_channel.cc."""
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register(name="Concat", aliases=("concat",))
+def concat(*data, dim=1):
+    """src/operator/nn/concat.cc."""
+    return jnp.concatenate(data, axis=dim)
+
+
+@register(name="stack")
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register(name="tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register(name="repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register(name="reverse", aliases=("flip",))
+def reverse(data, axis=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axes)
+
+
+@register(name="Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """src/operator/pad.cc — pad_width is MXNet's flat (before,after) pairs."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    while len(pw) < data.ndim:
+        pw.append((0, 0))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+# ------------------------------------------------------------- indexing --
+@register(name="take")
+def take(a, indices, axis=0, mode="clip"):
+    """src/operator/tensor/indexing_op.cc take."""
+    idx = indices.astype("int32")
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register(name="batch_take")
+def batch_take(a, indices):
+    idx = jnp.clip(indices.astype("int32"), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register(name="Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """src/operator/tensor/indexing_op.cc Embedding — gather rows. On TPU a
+    gather from HBM; sparse_grad collapses to dense (no sparse memory ops)."""
+    idx = jnp.clip(data.astype("int32"), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register(name="one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype("int32"), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register(name="gather_nd")
+def gather_nd(data, indices):
+    idx = indices.astype("int32")
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register(name="scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = indices.astype("int32")
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register(name="_scatter_set_nd")
+def scatter_set_nd(lhs, indices, rhs, shape=()):
+    idx = indices.astype("int32")
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register(name="where")
+def where(condition, x, y):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register(name="boolean_mask_dense")
+def boolean_mask_dense(data, mask):
+    """contrib boolean_mask (src/operator/contrib/boolean_mask.cc) has a
+    data-dependent output shape — impossible under XLA static shapes. The
+    dense variant zeroes masked-out rows and keeps shape; callers needing
+    compaction use nd.contrib.boolean_mask which falls back to host."""
+    m = (mask != 0).astype(data.dtype)
+    return data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+
+
+# ------------------------------------------------------------- ordering --
+@register(name="sort")
+def sort(data, axis=-1, is_ascend=True):
+    r = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r
+
+
+@register(name="argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    r = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(jnp.dtype(dtype))
+
+
+@register(name="topk", differentiable=False, num_outputs="n")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """src/operator/tensor/ordering_op.cc."""
+    ax = axis % data.ndim if axis is not None else data.ndim - 1
+    d = jnp.moveaxis(data, ax, -1)
+    if is_ascend:
+        vals, idxs = lax.top_k(-d, k)
+        vals = -vals
+    else:
+        vals, idxs = lax.top_k(d, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return idxs
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(jnp.moveaxis(idxs, ax, -1).astype("int32"),
+                            data.shape[ax], dtype=data.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, ax)
+    raise ValueError(ret_typ)
+
+
+@register(name="shuffle", differentiable=False, stateful_rng=True)
+def shuffle(data, rng_key=None):
+    return jax.random.permutation(rng_key, data, axis=0)
+
+
+# ----------------------------------------------------------------- init --
+@register(name="_zeros", differentiable=False)
+def zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register(name="_ones", differentiable=False)
+def ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register(name="_full", differentiable=False)
+def full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype))
+
+
+@register(name="_arange", differentiable=False)
+def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    r = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        r = jnp.repeat(r, repeat)
+    return r
+
+
+@register(name="_linspace", differentiable=False)
+def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register(name="_eye", differentiable=False)
+def eye(N=1, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype))
+
+
+@register(name="zeros_like", differentiable=False)
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register(name="ones_like", differentiable=False)
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register(name="shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype="int64")
+
+
+@register(name="size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype="int64")
+
+
+@register(name="histogram", differentiable=False, num_outputs=2)
+def histogram(data, bins=10, range=None):
+    cnt, edges = jnp.histogram(data, bins=bins, range=range)
+    return cnt.astype("float32"), edges
+
+
+@register(name="diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register(name="UpSampling")
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat"):
+    """src/operator/nn/upsampling.cc (nearest only; bilinear uses the
+    deconv path in the reference — here jax.image.resize)."""
+    x = data[0]
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+@register(name="GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """src/operator/grid_generator.cc — affine only."""
+    h, w = target_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones_ = jnp.ones_like(gx)
+    grid = jnp.stack([gx.ravel(), gy.ravel(), ones_.ravel()], axis=0)
+    theta = data.reshape(-1, 2, 3)
+    out = jnp.einsum("nij,jk->nik", theta, grid)
+    return out.reshape(-1, 2, h, w)
+
+
+@register(name="BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """src/operator/bilinear_sampler.cc — sample NCHW `data` at `grid`
+    locations in [-1,1]."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx); x1 = x0 + 1
+    y0 = jnp.floor(gy); y1 = y0 + 1
+    wx1 = gx - x0; wx0 = 1.0 - wx1
+    wy1 = gy - y0; wy0 = 1.0 - wy1
+
+    def sample(yy, xx):
+        valid = (xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1)
+        xc = jnp.clip(xx, 0, w - 1).astype("int32")
+        yc = jnp.clip(yy, 0, h - 1).astype("int32")
+        flat = data.reshape(n, c, h * w)
+        lin = (yc * w + xc).reshape(n, -1)
+        g = jnp.take_along_axis(flat, lin[:, None, :], axis=2)
+        g = g.reshape(n, c, *xx.shape[1:])
+        return g * valid[:, None].astype(data.dtype)
+
+    out = (sample(y0, x0) * (wy0 * wx0)[:, None]
+           + sample(y0, x1) * (wy0 * wx1)[:, None]
+           + sample(y1, x0) * (wy1 * wx0)[:, None]
+           + sample(y1, x1) * (wy1 * wx1)[:, None])
+    return out
+
+
+# ------------------------------------------------------------ sequence --
+@register(name="SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """src/operator/sequence_mask.cc — data is (seq, batch, ...) for axis=0."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis = axis
+    slen = data.shape[seq_axis]
+    pos = jnp.arange(slen)
+    shape = [1] * data.ndim
+    shape[seq_axis] = slen
+    pos = pos.reshape(shape)
+    batch_axis = 1 - seq_axis
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    lens = sequence_length.reshape(lshape)
+    mask = pos < lens
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register(name="SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    lens = jnp.clip(sequence_length.astype("int32") - 1, 0, data.shape[axis] - 1)
+    d = jnp.moveaxis(data, axis, 0)
+    return jnp.take_along_axis(
+        d, lens.reshape((1, -1) + (1,) * (d.ndim - 2)), axis=0)[0]
+
+
+@register(name="SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    slen = data.shape[axis]
+    pos = jnp.arange(slen)[:, None]
+    lens = sequence_length.astype("int32")[None, :]
+    rev_idx = jnp.where(pos < lens, lens - 1 - pos, pos)
+    d = jnp.moveaxis(data, axis, 0)
+    out = jnp.take_along_axis(d, rev_idx.reshape(rev_idx.shape + (1,) * (d.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)

@@ -1,0 +1,670 @@
+"""Neural-network operators.
+
+Reference: src/operator/nn/ (Convolution, Pooling, FullyConnected, BatchNorm,
+LayerNorm, GroupNorm, LRN, Activation, Dropout, softmax family, CTCLoss,
+Upsampling), src/operator/rnn.cc (fused RNN), src/operator/leaky_relu.cc,
+src/operator/softmax_output.cc, src/operator/instance_norm.cc.
+
+TPU-native mapping: convs/matmuls are lax.conv_general_dilated/dot_general on
+the MXU (bf16-friendly); pooling is lax.reduce_window; the fused RNN is a
+lax.scan over time steps (XLA pipelines the per-step matmuls); there are no
+cuDNN/MKLDNN forks — one implementation, every backend.
+"""
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _tuplize(v, n):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+# ---------------------------------------------------------- convolution --
+def _conv_dnums(nd):
+    # MXNet default layouts: NCW / NCHW / NCDHW, weights OIHW-style
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_dimension_numbers((0,) * (nd + 2), (0,) * (nd + 2),
+                                      (lhs, rhs, lhs))
+
+
+@register(name="Convolution")
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=1, num_group=1, no_bias=False,
+                layout=None, workspace=1024, cudnn_tune=None, cudnn_off=False):
+    """src/operator/nn/convolution.cc — N-D convolution, NC[DHW] layout.
+
+    `workspace`/`cudnn_*` are accepted for source compat and ignored (XLA
+    picks MXU tilings; there is no algo autotune registry to manage —
+    reference kept one in src/operator/nn/cudnn/cudnn_algoreg-inl.h).
+    """
+    nd = data.ndim - 2
+    stride = _tuplize(stride, nd)
+    dilate = _tuplize(dilate, nd)
+    pad = _tuplize(pad if pad != () else 0, nd)
+    dn = _conv_dnums(nd)
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.promote_types(data.dtype, jnp.float32)
+        if data.dtype == jnp.bfloat16 else None)
+    out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register(name="Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=1, num_group=1,
+                  no_bias=True, layout=None, workspace=1024, cudnn_tune=None,
+                  cudnn_off=False):
+    """src/operator/nn/deconvolution.cc — transposed conv (gradient of conv
+    w.r.t. its input, lowered via lax.conv_transpose semantics)."""
+    nd = data.ndim - 2
+    stride = _tuplize(stride, nd)
+    dilate = _tuplize(dilate, nd)
+    pad = _tuplize(pad if pad != () else 0, nd)
+    adj = _tuplize(adj if adj != () else 0, nd)
+    dn = _conv_dnums(nd)
+    kshape = weight.shape[2:]
+    # transposed conv = lhs-dilated conv with flipped kernel, swapped I/O
+    pads = []
+    for i in range(nd):
+        k = (kshape[i] - 1) * dilate[i] + 1
+        lo = k - 1 - pad[i]
+        hi = k - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    if num_group > 1:
+        ws = weight.shape
+        w = weight.reshape(num_group, ws[0] // num_group, ws[1], *kshape)
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape(ws[1] * num_group, ws[0] // num_group, *kshape)
+    else:
+        w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# -------------------------------------------------------------- pooling --
+@register(name="Pooling")
+def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, pooling_convention="valid", cudnn_off=False,
+            count_include_pad=True, layout=None, p_value=2):
+    """src/operator/nn/pooling.cc — max/avg/sum/lp, valid/full conventions."""
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif pool_type == "sum":
+            out = jnp.sum(data, axis=axes, keepdims=True)
+        elif pool_type == "lp":
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(data), p_value), axis=axes,
+                                    keepdims=True), 1.0 / p_value)
+        else:
+            out = jnp.mean(data, axis=axes, keepdims=True)
+        return out
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride, nd)
+    pad = _tuplize(pad if pad != () else 0, nd)
+
+    pads = []
+    for i in range(nd):
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            # ceil convention (pooling-inl.h): pad extra on the high side
+            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem
+        pads.append((lo, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value),
+                              jnp.asarray(0, data.dtype), lax.add,
+                              window, strides, padding)
+        return jnp.power(s, 1.0 / p_value)
+    s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                          window, strides, padding)
+    if pool_type == "sum":
+        return s
+    # avg
+    if count_include_pad:
+        denom = float(_np.prod(kernel))
+        return s / jnp.asarray(denom, data.dtype)
+    ones_ = jnp.ones_like(data)
+    cnt = lax.reduce_window(ones_, jnp.asarray(0, data.dtype), lax.add,
+                            window, strides, padding)
+    return s / cnt
+
+
+# ------------------------------------------------------------- fully-connected --
+@register(name="FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=1, no_bias=False,
+                    flatten=True):
+    """src/operator/nn/fully_connected.cc — y = x W^T + b on the MXU."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    y = jnp.matmul(x, weight.T)
+    if not no_bias and bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------- norms --
+@register(name="BatchNorm", num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, is_train=False):
+    """src/operator/nn/batch_norm.cc.
+
+    Functional formulation: returns (out, batch_mean, batch_var); the caller
+    (gluon.nn.BatchNorm / executor aux-state machinery) folds the running
+    stats update `moving = momentum*moving + (1-m)*batch` — the reference op
+    mutates its aux states in-place instead (batch_norm.cc:~400).
+    """
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    xhat = (data - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    out = xhat * g.reshape(shape) + beta.reshape(shape)
+    return out, mean, var
+
+
+@register(name="LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """src/operator/nn/layer_norm.cc."""
+    ax = axis % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    xhat = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    return xhat * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register(name="GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False):
+    """src/operator/nn/group_norm.cc — NC... input, groups over C."""
+    n, c = data.shape[:2]
+    rest = data.shape[2:]
+    x = data.reshape(n, num_groups, c // num_groups, *rest)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    xhat = ((x - mean) * lax.rsqrt(var + eps)).reshape(data.shape)
+    shape = (1, c) + (1,) * len(rest)
+    return xhat * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register(name="InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    """src/operator/instance_norm.cc."""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    xhat = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return xhat * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register(name="LRN")
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    """src/operator/nn/lrn.cc — cross-channel local response norm."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    s = lax.reduce_window(padded, jnp.asarray(0, data.dtype), lax.add,
+                          window, (1,) * data.ndim, "valid")
+    return data / jnp.power(knorm + alpha / nsize * s, beta)
+
+
+# ----------------------------------------------------------- activation --
+@register(name="Activation")
+def activation(data, act_type="relu"):
+    """src/operator/nn/activation.cc."""
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return lax.logistic(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jnp.logaddexp(data, 0.0)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+@register(name="LeakyReLU", stateful_rng=True)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, rng_key=None,
+               is_train=False):
+    """src/operator/leaky_relu.cc — leaky/prelu/elu/selu/gelu/rrelu."""
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim:
+            shape = [1] * data.ndim
+            if g.size > 1 and data.ndim > 1:
+                shape[1] = g.size
+            g = g.reshape(shape)
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data >= 0, data, a * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if is_train and rng_key is not None:
+            r = jax.random.uniform(rng_key, data.shape, dtype=data.dtype,
+                                   minval=lower_bound, maxval=upper_bound)
+        else:
+            r = jnp.asarray((lower_bound + upper_bound) / 2.0, data.dtype)
+        return jnp.where(data >= 0, data, r * data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+# -------------------------------------------------------------- softmax --
+@register(name="softmax")
+def softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+            dtype=None):
+    """src/operator/nn/softmax.cc."""
+    x = data / temperature if temperature not in (None, 1.0, 0.0) else data
+    if use_length and length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis % x.ndim] = x.shape[axis]
+        mask = pos.reshape(shape) < length.reshape([-1] + [1] * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(jnp.dtype(dtype))
+    return out
+
+
+@register(name="log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature not in (None, 1.0, 0.0) else data
+    out = jax.nn.log_softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(jnp.dtype(dtype))
+    return out
+
+
+@register(name="softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    return softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register(name="SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, preserve_shape, normalization, smooth_alpha):
+    axis = 1 if (multi_output and data.ndim > 2) else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _softmax_output(data, label, grad_scale, ignore_label, multi_output,
+                    use_ignore, preserve_shape, normalization, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                               multi_output, use_ignore, preserve_shape,
+                               normalization, smooth_alpha)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, multi_output, use_ignore,
+            preserve_shape, normalization, smooth_alpha):
+    out = _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                              multi_output, use_ignore, preserve_shape,
+                              normalization, smooth_alpha)
+    return out, (out, label)
+
+
+def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+            preserve_shape, normalization, smooth_alpha, res, g):
+    out, label = res
+    axis = 1 if (multi_output and out.ndim > 2) else -1
+    nclass = out.shape[axis]
+    lbl = label.astype("int32")
+    oh = jax.nn.one_hot(lbl, nclass, axis=axis, dtype=out.dtype)
+    if smooth_alpha:
+        oh = oh * (1.0 - smooth_alpha - smooth_alpha / (nclass - 1)) \
+            + smooth_alpha / (nclass - 1)
+    grad = out - oh
+    if use_ignore:
+        keep = (lbl != int(ignore_label)).astype(out.dtype)
+        keep = jnp.expand_dims(keep, axis % out.ndim)
+        grad = grad * keep
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum((lbl != int(ignore_label)).astype(out.dtype)), 1.0)
+        grad = grad / valid
+    grad = grad * scale
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_so_fwd, _so_bwd)
+
+
+@register(name="SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """src/operator/softmax_output.cc — softmax fwd; bwd is (p - onehot)
+    (the classic fused softmax+CE gradient), via jax.custom_vjp."""
+    lbl = label if jnp.issubdtype(label.dtype, jnp.floating) else label.astype("float32")
+    return _softmax_output(data, lbl, grad_scale, ignore_label, multi_output,
+                           use_ignore, preserve_shape, normalization, smooth_alpha)
+
+
+@register(name="softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """src/operator/loss_binary_op.cc — summed CE over the batch."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype("int32").reshape(-1)
+    picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# -------------------------------------------------------------- dropout --
+@register(name="Dropout", stateful_rng=True)
+def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False,
+            rng_key=None, is_train=False):
+    """src/operator/nn/dropout.cc — inverted dropout; counter-based
+    (threefry) RNG instead of per-resource Philox states (divergence noted
+    in SURVEY §7 hard parts (f))."""
+    if (not is_train and mode != "always") or p <= 0.0 or rng_key is None:
+        return data
+    shape = list(data.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng_key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ------------------------------------------------------------------ rnn --
+def _lstm_cell(x, h, c, wx, wh, bx, bh):
+    gates = x @ wx.T + h @ wh.T + bx + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = lax.logistic(i); f = lax.logistic(f)
+    g = jnp.tanh(g); o = lax.logistic(o)
+    c2 = f * c + i * g
+    return o * jnp.tanh(c2), c2
+
+
+def _gru_cell(x, h, wx, wh, bx, bh):
+    xr, xz, xn = jnp.split(x @ wx.T + bx, 3, axis=-1)
+    hr, hz, hn = jnp.split(h @ wh.T + bh, 3, axis=-1)
+    r = lax.logistic(xr + hr)
+    z = lax.logistic(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_cell(x, h, wx, wh, bx, bh, act):
+    return act(x @ wx.T + h @ wh.T + bx + bh)
+
+
+def _gates(mode):
+    return {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
+
+
+def _unpack_rnn_params(params, mode, num_layers, input_size, state_size, bidirectional):
+    """Unpack MXNet's flat RNN parameter vector (rnn-inl.h layout: all
+    weights layer-major then all biases)."""
+    ng = _gates(mode)
+    d = 2 if bidirectional else 1
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * d
+        for _dir in range(d):
+            wx = lax.dynamic_slice(params, (off,), (ng * state_size * isz,)) \
+                .reshape(ng * state_size, isz)
+            off += ng * state_size * isz
+            wh = lax.dynamic_slice(params, (off,), (ng * state_size * state_size,)) \
+                .reshape(ng * state_size, state_size)
+            off += ng * state_size * state_size
+            ws.append((wx, wh))
+    for layer in range(num_layers):
+        for _dir in range(d):
+            bx = lax.dynamic_slice(params, (off,), (ng * state_size,)); off += ng * state_size
+            bh = lax.dynamic_slice(params, (off,), (ng * state_size,)); off += ng * state_size
+            bs.append((bx, bh))
+    return ws, bs
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
+    ng = _gates(mode)
+    d = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * d
+        total += d * ng * state_size * (isz + state_size + 2)
+    return total
+
+
+@register(name="RNN", num_outputs="n", stateful_rng=True)
+def rnn(data, parameters, state, state_cell=None, state_size=1, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False,
+        use_sequence_length=False, sequence_length=None, rng_key=None,
+        is_train=False):
+    """src/operator/rnn.cc — fused multi-layer (bi)RNN/LSTM/GRU.
+
+    data: (seq_len, batch, input); scanned with lax.scan so XLA pipelines
+    the per-step MXU matmuls (the reference reaches cuDNN's fused kernels
+    on GPU; lax.scan + fusion is the TPU analogue).
+    """
+    seq_len, batch, input_size = data.shape
+    d = 2 if bidirectional else 1
+    ws, bs = _unpack_rnn_params(parameters, mode, num_layers, input_size,
+                                state_size, bidirectional)
+
+    h0 = state  # (num_layers*d, batch, state_size)
+    c0 = state_cell if mode == "lstm" else None
+    x = data
+    h_last, c_last = [], []
+    key = rng_key
+    for layer in range(num_layers):
+        outs = []
+        for dr in range(d):
+            li = layer * d + dr
+            wx, wh = ws[li]
+            bx, bh = bs[li]
+            xs = jnp.flip(x, axis=0) if dr == 1 else x
+            h_init = h0[li]
+            if mode == "lstm":
+                c_init = c0[li]
+
+                def step(carry, xt, wx=wx, wh=wh, bx=bx, bh=bh):
+                    h, c = carry
+                    h2, c2 = _lstm_cell(xt, h, c, wx, wh, bx, bh)
+                    return (h2, c2), h2
+                (hT, cT), ys = lax.scan(step, (h_init, c_init), xs)
+                c_last.append(cT)
+            elif mode == "gru":
+                def step(h, xt, wx=wx, wh=wh, bx=bx, bh=bh):
+                    h2 = _gru_cell(xt, h, wx, wh, bx, bh)
+                    return h2, h2
+                hT, ys = lax.scan(step, h_init, xs)
+            else:
+                act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+                def step(h, xt, wx=wx, wh=wh, bx=bx, bh=bh, act=act):
+                    h2 = _rnn_cell(xt, h, wx, wh, bx, bh, act)
+                    return h2, h2
+                hT, ys = lax.scan(step, h_init, xs)
+            h_last.append(hT)
+            if dr == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+        x = jnp.concatenate(outs, axis=-1) if d == 2 else outs[0]
+        if p > 0.0 and is_train and layer < num_layers - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape).astype(x.dtype)
+            x = x * mask / (1.0 - p)
+    hN = jnp.stack(h_last, axis=0)
+    if mode == "lstm":
+        cN = jnp.stack(c_last, axis=0)
+        return x, hN, cN
+    return x, hN
+
+
+# ------------------------------------------------------------- ctc loss --
+@register(name="CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """src/operator/nn/ctc_loss.cc — forward algorithm in log space via
+    lax.scan (reference uses 3rdparty/ctc_include warp-ctc)."""
+    # data: (seq, batch, alphabet); label: (batch, label_len)
+    seq_len, batch, alphabet = data.shape
+    logp = jax.nn.log_softmax(data.astype("float32"), axis=-1)
+    blank = 0 if blank_label == "first" else alphabet - 1
+    lab = label.astype("int32")
+    if blank_label == "first":
+        lab = lab - 0  # labels already 1-based w/ blank=0 in MXNet convention? keep as-is
+    L = lab.shape[1]
+    # extended label: blank l1 blank l2 ... blank
+    ext_len = 2 * L + 1
+    ext = jnp.full((batch, ext_len), blank, dtype="int32")
+    ext = ext.at[:, 1::2].set(lab)
+    lab_lens = (label_lengths.astype("int32") if use_label_lengths and label_lengths is not None
+                else jnp.sum((lab != blank) & (lab >= 0), axis=1).astype("int32"))
+    dat_lens = (data_lengths.astype("int32") if use_data_lengths and data_lengths is not None
+                else jnp.full((batch,), seq_len, dtype="int32"))
+    ninf = jnp.asarray(-1e30, "float32")
+
+    emit = jnp.take_along_axis(
+        jnp.transpose(logp, (1, 0, 2)), ext[:, None, :], axis=2)  # (batch, seq, ext)
+    emit = jnp.transpose(emit, (1, 0, 2))  # (seq, batch, ext)
+
+    same = jnp.concatenate(
+        [jnp.zeros((batch, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)  # can't skip if same label
+
+    alpha0 = jnp.full((batch, ext_len), ninf)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_lens > 0, emit[0, :, 1], ninf))
+
+    def logsumexp3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        m_safe = jnp.where(m == ninf, 0.0, m)
+        return jnp.where(
+            m == ninf, ninf,
+            m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c - m_safe)))
+
+    def step(alpha, t_emit_t):
+        t, emit_t = t_emit_t
+        shift1 = jnp.concatenate([jnp.full((batch, 1), ninf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((batch, 2), ninf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same, ninf, shift2)
+        new = logsumexp3(alpha, shift1, shift2) + emit_t
+        new = jnp.where(t < dat_lens[:, None], new, alpha)
+        return new, None
+
+    ts = jnp.arange(1, seq_len)
+    alphaT, _ = lax.scan(step, alpha0, (ts, emit[1:]))
+    end1 = 2 * lab_lens
+    end2 = 2 * lab_lens - 1
+    aT1 = jnp.take_along_axis(alphaT, end1[:, None], axis=1)[:, 0]
+    aT2 = jnp.take_along_axis(alphaT, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(aT1, aT2)
+    m_safe = jnp.where(m == ninf, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(aT1 - m_safe) + jnp.exp(aT2 - m_safe))
+    return (-ll).astype(data.dtype)
+
+
+# ---------------------------------------------------- spatial transformer --
+@register(name="SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """src/operator/spatial_transformer.cc = GridGenerator + BilinearSampler."""
+    from .matrix import grid_generator, bilinear_sampler
+    grid = grid_generator(loc, transform_type="affine", target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register(name="ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """src/operator/roi_pooling.cc — max pool over ROI grid cells."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bidx = roi[0].astype("int32")
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = data[bidx]
+        ys = jnp.arange(h).reshape(1, 1, h, 1)
+        xs = jnp.arange(w).reshape(1, 1, 1, w)
+        py = jnp.arange(ph).reshape(ph, 1, 1, 1)
+        px = jnp.arange(pw).reshape(1, pw, 1, 1)
+        y_lo = jnp.floor(y1 + py * bh); y_hi = jnp.ceil(y1 + (py + 1) * bh)
+        x_lo = jnp.floor(x1 + px * bw); x_hi = jnp.ceil(x1 + (px + 1) * bw)
+        mask = ((ys >= y_lo) & (ys < y_hi) & (xs >= x_lo) & (xs < x_hi))
+        masked = jnp.where(mask[None], img[:, None, None], -jnp.inf)
+        pooled = jnp.max(masked, axis=(3, 4))
+        pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        return pooled  # (c, ph, pw)
+
+    return jax.vmap(one_roi)(rois)

@@ -1,0 +1,166 @@
+"""Random sampling operators.
+
+Reference: src/operator/random/ (sample_op.cc samplers, multisample_op.cc
+distribution-parameter sampling, pdf ops). TPU-native: counter-based
+threefry keys from jax.random instead of per-resource Philox generator
+state — functional keys are what make RNG reproducible under jit/pjit
+(SURVEY §7 hard part (f) documents the divergence).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register(name="_random_uniform", aliases=("uniform", "random_uniform"),
+          differentiable=False, stateful_rng=True)
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", rng_key=None):
+    return jax.random.uniform(rng_key, _shape(shape), dtype=jnp.dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register(name="_random_normal", aliases=("normal", "random_normal"),
+          differentiable=False, stateful_rng=True)
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", rng_key=None):
+    return loc + scale * jax.random.normal(rng_key, _shape(shape), dtype=jnp.dtype(dtype))
+
+
+@register(name="_random_gamma", aliases=("gamma_sample", "random_gamma"),
+          differentiable=False, stateful_rng=True)
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", rng_key=None):
+    return beta * jax.random.gamma(rng_key, alpha, _shape(shape), dtype=jnp.dtype(dtype))
+
+
+@register(name="_random_exponential", aliases=("random_exponential", "exponential"),
+          differentiable=False, stateful_rng=True)
+def random_exponential(lam=1.0, shape=(), dtype="float32", rng_key=None):
+    return jax.random.exponential(rng_key, _shape(shape), dtype=jnp.dtype(dtype)) / lam
+
+
+@register(name="_random_poisson", aliases=("random_poisson", "poisson"),
+          differentiable=False, stateful_rng=True)
+def random_poisson(lam=1.0, shape=(), dtype="float32", rng_key=None):
+    return jax.random.poisson(rng_key, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register(name="_random_negative_binomial", aliases=("random_negative_binomial",),
+          differentiable=False, stateful_rng=True)
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", rng_key=None):
+    k1, k2 = jax.random.split(rng_key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register(name="_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",),
+          differentiable=False, stateful_rng=True)
+def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", rng_key=None):
+    k1, k2 = jax.random.split(rng_key)
+    g = jax.random.gamma(k1, 1.0 / alpha, _shape(shape)) * (alpha * mu)
+    return jax.random.poisson(k2, g, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register(name="_random_randint", aliases=("randint",), differentiable=False,
+          stateful_rng=True)
+def random_randint(low=0, high=1, shape=(), dtype="int32", rng_key=None):
+    return jax.random.randint(rng_key, _shape(shape), low, high, dtype=jnp.dtype(dtype))
+
+
+@register(name="_sample_multinomial", aliases=("sample_multinomial", "multinomial"),
+          differentiable=False, stateful_rng=True)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", rng_key=None):
+    n = 1
+    for s in _shape(shape):
+        n *= s
+    n = max(n, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out = jax.random.categorical(rng_key, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    if data.ndim == 1:
+        out = out.reshape(_shape(shape) or ())
+    else:
+        out = jnp.moveaxis(out, 0, -1).reshape(data.shape[:-1] + _shape(shape))
+    return out.astype(jnp.dtype(dtype))
+
+
+@register(name="_sample_unique_zipfian", differentiable=False, stateful_rng=True)
+def sample_unique_zipfian(range_max=1, shape=(), rng_key=None):
+    u = jax.random.uniform(rng_key, _shape(shape))
+    out = jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0
+    return out.astype("int64")
+
+
+# Distribution-parameter tensor sampling (src/operator/random/multisample_op.cc)
+@register(name="sample_uniform", differentiable=False, stateful_rng=True)
+def sample_uniform(low, high, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    u = jax.random.uniform(rng_key, low.shape + s, dtype=jnp.dtype(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + \
+        (high - low).reshape(low.shape + (1,) * len(s)) * u
+
+
+@register(name="sample_normal", differentiable=False, stateful_rng=True)
+def sample_normal(mu, sigma, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    z = jax.random.normal(rng_key, mu.shape + s, dtype=jnp.dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        sigma.reshape(sigma.shape + (1,) * len(s)) * z
+
+
+@register(name="sample_gamma", differentiable=False, stateful_rng=True)
+def sample_gamma(alpha, beta, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(rng_key, a, a.shape[:len(alpha.shape)] + s,
+                         dtype=jnp.dtype(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register(name="sample_exponential", differentiable=False, stateful_rng=True)
+def sample_exponential(lam, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    e = jax.random.exponential(rng_key, lam.shape + s, dtype=jnp.dtype(dtype))
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register(name="sample_poisson", differentiable=False, stateful_rng=True)
+def sample_poisson(lam, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    p = jax.random.poisson(rng_key, lam.reshape(lam.shape + (1,) * len(s)),
+                           lam.shape + s)
+    return p.astype(jnp.dtype(dtype))
+
+
+# --------------------------------------------------------------- pdf ops --
+@register(name="_backward_guard_pdf", differentiable=False)
+def _noop(x):
+    return x
+
+
+@register(name="pdf_uniform")
+def pdf_uniform(sample, low, high, is_log=False):
+    p = 1.0 / (high - low)
+    inside = (sample >= low[..., None]) & (sample <= high[..., None]) \
+        if sample.ndim > low.ndim else (sample >= low) & (sample <= high)
+    pb = p[..., None] if sample.ndim > low.ndim else p
+    out = jnp.where(inside, pb, 0.0)
+    return jnp.log(out) if is_log else out
+
+
+@register(name="pdf_normal")
+def pdf_normal(sample, mu, sigma, is_log=False):
+    if sample.ndim > mu.ndim:
+        mu = mu[..., None]
+        sigma = sigma[..., None]
+    logp = -0.5 * jnp.square((sample - mu) / sigma) - jnp.log(
+        sigma * jnp.sqrt(2 * jnp.pi))
+    return logp if is_log else jnp.exp(logp)

@@ -1,0 +1,156 @@
+"""Reduction / broadcast-family operators.
+
+Reference: src/operator/tensor/broadcast_reduce_op.h (sum/mean/prod/max/min/
+norm/argmax/... with axis/keepdims/exclude semantics shared across the
+family). XLA reduces map straight onto these; `exclude=True` inverts the
+axis set (reference semantics in broadcast_reduce_op.h ReduceAxesCompute).
+"""
+
+import jax.numpy as jnp
+
+from . import register
+
+
+def _norm_axes(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce(fn):
+    def impl(data, axis=None, keepdims=False, exclude=False):
+        axes = _norm_axes(axis, data.ndim, exclude)
+        return fn(data, axis=axes, keepdims=keepdims)
+    return impl
+
+
+for _n, _f in [
+    ("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+    ("max", jnp.max), ("min", jnp.min),
+    ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+]:
+    def _mk(n=_n, f=_f):
+        aliases = ("sum_axis",) if n == "sum" else ()
+        @register(name=n, aliases=aliases)
+        def _op(data, axis=None, keepdims=False, exclude=False):
+            return _reduce(f)(data, axis, keepdims, exclude)
+    _mk()
+
+
+@register(name="norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axes = _norm_axes(axis, data.ndim) if axis is not None else None
+    if ord == 1:
+        r = jnp.sum(jnp.abs(data), axis=axes, keepdims=keepdims)
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=keepdims))
+    return r
+
+
+@register(name="argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    if axis is None:
+        res = jnp.argmax(data.reshape(-1))
+        return res.astype("float32")
+    r = jnp.argmax(data, axis=axis)
+    if keepdims:
+        r = jnp.expand_dims(r, axis)
+    return r.astype("float32")
+
+
+@register(name="argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    if axis is None:
+        return jnp.argmin(data.reshape(-1)).astype("float32")
+    r = jnp.argmin(data, axis=axis)
+    if keepdims:
+        r = jnp.expand_dims(r, axis)
+    return r.astype("float32")
+
+
+@register(name="argmax_channel", differentiable=False)
+def argmax_channel(data):
+    """src/operator/tensor/broadcast_reduce_op_index.cc — argmax over axis 1
+    on a 2D input (used by Accuracy metric path)."""
+    return jnp.argmax(data, axis=-1).astype("float32")
+
+
+@register(name="pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """src/operator/tensor/broadcast_reduce_op_index.cc pick."""
+    idx = index.astype("int32")
+    ax = axis % data.ndim
+    if mode == "wrap":
+        idx = jnp.mod(idx, data.shape[ax])
+    else:
+        idx = jnp.clip(idx, 0, data.shape[ax] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, ax), axis=ax)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=ax)
+    return picked
+
+
+@register(name="broadcast_to")
+def broadcast_to(data, shape=()):
+    # MXNet semantics: 0 in target shape means "keep source dim"
+    tgt = tuple(s if s != 0 else data.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register(name="broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    tgt = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la % lhs.ndim] = rhs.shape[ra % rhs.ndim]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register(name="broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register(name="L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError("unknown mode %s" % mode)
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / n
+
+
+@register(name="moments", num_outputs=2)
+def moments(data, axes=None, keepdims=False):
+    """src/operator/nn/moments.cc."""
+    ax = _norm_axes(axes, data.ndim) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    var = jnp.var(data, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register(name="khatri_rao")
+def khatri_rao(*args):
+    """src/operator/contrib/krprod.cc — column-wise Khatri-Rao product."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out

@@ -1,0 +1,26 @@
+"""Test config: run the whole suite on a virtual 8-device CPU mesh so
+multi-chip sharding paths are exercised without TPU hardware (SURVEY §7 /
+driver contract). Platform must be forced before the jax backend
+initializes; the environment's axon plugin overrides JAX_PLATFORMS env, so
+use jax.config directly."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      (os.environ.get("XLA_FLAGS", "") +
+                       " --xla_force_host_platform_device_count=8").strip())
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

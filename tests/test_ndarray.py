@@ -1,0 +1,296 @@
+"""NDArray numeric tests vs NumPy.
+
+Modeled on the reference test strategy (SURVEY §4):
+tests/python/unittest/test_ndarray.py — op numerics diffed against NumPy.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.asnumpy().sum() == 0
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert_close(c.asnumpy(), np.full((2, 2), 7.5))
+    d = nd.arange(0, 10, 2)
+    assert_close(d.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+    e = nd.array([[1, 2], [3, 4]])
+    assert e.shape == (2, 2)
+
+
+def test_arithmetic():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32) + 0.5
+    a, b = nd.array(x), nd.array(y)
+    assert_close((a + b).asnumpy(), x + y)
+    assert_close((a - b).asnumpy(), x - y)
+    assert_close((a * b).asnumpy(), x * y)
+    assert_close((a / b).asnumpy(), x / y)
+    assert_close((a ** 2).asnumpy(), x ** 2)
+    assert_close((a + 1.5).asnumpy(), x + 1.5)
+    assert_close((2.0 - a).asnumpy(), 2.0 - x)
+    assert_close((1.0 / b).asnumpy(), 1.0 / y)
+    assert_close((-a).asnumpy(), -x)
+    assert_close(abs(nd.array(-x)).asnumpy(), np.abs(-x))
+
+
+def test_comparisons():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    a = nd.array(x)
+    assert_close((a > 2).asnumpy(), (x > 2).astype(np.float32))
+    assert_close((a <= 2).asnumpy(), (x <= 2).astype(np.float32))
+    assert_close((a == 2).asnumpy(), (x == 2).astype(np.float32))
+
+
+def test_unary_math():
+    x = np.random.rand(5).astype(np.float32) + 0.1
+    a = nd.array(x)
+    assert_close(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert_close(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert_close(nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert_close(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_close(nd.tanh(a).asnumpy(), np.tanh(x), rtol=1e-5)
+    assert_close(nd.relu(nd.array(x - 0.5)).asnumpy(), np.maximum(x - 0.5, 0))
+
+
+def test_reduce():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_close(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    assert_close(a.sum(axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5)
+    assert_close(a.mean(axis=(0, 2)).asnumpy(), x.mean(axis=(0, 2)), rtol=1e-5)
+    assert_close(a.max(axis=2).asnumpy(), x.max(axis=2))
+    assert_close(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                 x.sum(axis=1, keepdims=True), rtol=1e-5)
+    assert_close(nd.sum(a, axis=0, exclude=True).asnumpy(),
+                 x.sum(axis=(1, 2)), rtol=1e-5)
+    assert_close(a.norm().asnumpy(), np.linalg.norm(x.ravel()), rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    assert_close(nd.dot(nd.array(x), nd.array(y)).asnumpy(), x @ y, rtol=1e-4)
+    assert_close(nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+                 x @ y, rtol=1e-4)
+    bx = np.random.rand(2, 3, 4).astype(np.float32)
+    by = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_close(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                 bx @ by, rtol=1e-4)
+
+
+def test_shape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(x)
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)  # MXNet special code 0
+    assert a.transpose().shape == (4, 3, 2)
+    assert nd.transpose(a, axes=(1, 0, 2)).shape == (3, 2, 4)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert nd.flatten(a).shape == (2, 12)
+    assert nd.concat(a, a, dim=2).shape == (2, 3, 8)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3, 4)
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    assert nd.tile(a, reps=(2, 1, 1)).shape == (4, 3, 4)
+    assert_close(nd.reverse(a, axis=0).asnumpy(), x[::-1])
+
+
+def test_slicing():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = nd.array(x)
+    assert_close(a[1].asnumpy(), x[1])
+    assert_close(a[1:3].asnumpy(), x[1:3])
+    assert_close(a[:, 2:4].asnumpy(), x[:, 2:4])
+    assert_close(nd.slice_axis(a, axis=1, begin=1, end=4).asnumpy(), x[:, 1:4])
+    b = nd.array(x.copy())
+    b[0] = 0.0
+    assert b.asnumpy()[0].sum() == 0
+    b[1:3] = 1.0
+    assert_close(b.asnumpy()[1:3], np.ones((2, 6)))
+
+
+def test_indexing_ops():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    assert_close(nd.take(nd.array(w), nd.array(idx)).asnumpy(), w[[1, 3, 5]])
+    emb = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_close(emb.asnumpy(), w[[1, 3, 5]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=4)
+    assert_close(oh.asnumpy(), np.eye(4, dtype=np.float32)[[0, 2]])
+
+
+def test_ordering():
+    x = np.random.rand(3, 7).astype(np.float32)
+    a = nd.array(x)
+    assert_close(nd.sort(a, axis=1).asnumpy(), np.sort(x, axis=1))
+    assert_close(nd.argmax(a, axis=1).asnumpy(), x.argmax(axis=1).astype(np.float32))
+    tk = nd.topk(a, axis=1, k=3, ret_typ="value")
+    assert_close(tk.asnumpy(), -np.sort(-x, axis=1)[:, :3])
+
+
+def test_pick_and_where():
+    x = np.random.rand(4, 5).astype(np.float32)
+    idx = np.array([0, 1, 2, 3], np.float32)
+    p = nd.pick(nd.array(x), nd.array(idx), axis=1)
+    assert_close(p.asnumpy(), x[np.arange(4), idx.astype(int)])
+    cond = np.array([1, 0, 1], np.float32)
+    w = nd.where(nd.array(cond), nd.array([1.0, 2, 3]), nd.array([4.0, 5, 6]))
+    assert_close(w.asnumpy(), [1, 5, 3])
+
+
+def test_broadcast():
+    a = nd.array(np.ones((1, 3), np.float32))
+    assert nd.broadcast_to(a, shape=(4, 3)).shape == (4, 3)
+    b = nd.array(np.ones((2, 1), np.float32))
+    assert nd.broadcast_axis(b, axis=1, size=5).shape == (2, 5)
+
+
+def test_cast_astype():
+    a = nd.array([1.5, 2.5])
+    assert a.astype("int32").dtype == np.int32
+    assert nd.Cast(a, dtype="int32").dtype == np.int32
+
+
+def test_context():
+    a = nd.zeros((2,), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.shape == (2,)
+    with mx.Context("cpu", 0):
+        c = nd.ones((2,))
+        assert c.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.nd")
+    d = {"w": nd.array(np.random.rand(3, 3)), "b": nd.array(np.random.rand(3))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_close(loaded["w"].asnumpy(), d["w"].asnumpy())
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(f, lst)
+    l2 = nd.load(f)
+    assert isinstance(l2, list) and len(l2) == 2
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    n1 = nd.random.normal(0, 1, shape=(50,)).asnumpy()
+    mx.random.seed(42)
+    u2 = nd.random.uniform(0, 1, shape=(100,))
+    assert_close(u.asnumpy(), u2.asnumpy())  # seeded reproducibility
+    r = nd.random.randint(0, 10, shape=(20,))
+    assert r.dtype == np.int32
+    m = nd.random.multinomial(nd.array([0.0, 0.0, 1.0]), shape=(8,))
+    assert (m.asnumpy() == 2).all()
+
+
+def test_nn_ops_numeric():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.zeros((4,)),
+                         kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    # check one output element against a manual computation
+    manual = (x[0, :, 0:3, 0:3] * w[1]).sum()
+    assert_close(out.asnumpy()[0, 1, 0, 0], manual, rtol=1e-4)
+
+    p = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert p.shape == (2, 3, 4, 4)
+    assert_close(p.asnumpy()[0, 0, 0, 0], x[0, 0, :2, :2].max())
+
+    fc_w = np.random.rand(5, 3 * 8 * 8).astype(np.float32)
+    fc = nd.FullyConnected(nd.array(x), nd.array(fc_w), nd.zeros((5,)),
+                           num_hidden=5)
+    assert_close(fc.asnumpy(), x.reshape(2, -1) @ fc_w.T, rtol=1e-4)
+
+    s = nd.softmax(nd.array(np.random.rand(3, 4).astype(np.float32)))
+    assert_close(s.asnumpy().sum(axis=1), np.ones(3), rtol=1e-5)
+
+
+def test_batchnorm_inference():
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), fix_gamma=False,
+                       eps=1e-5)
+    expect = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+    assert_close(out.asnumpy(), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.rand(4, 10).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.ones((10,)), nd.zeros((10,)), axis=-1)
+    expect = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_close(out.asnumpy(), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_ops():
+    x = np.random.rand(5, 3, 2).astype(np.float32)
+    lens = np.array([2, 5, 3], np.float32)
+    m = nd.SequenceMask(nd.array(x), nd.array(lens), use_sequence_length=True,
+                        value=-1.0)
+    out = m.asnumpy()
+    assert (out[2, 0] == -1).all() and (out[3, 2] == -1).all()
+    assert_close(out[1, 0], x[1, 0])
+    last = nd.SequenceLast(nd.array(x), nd.array(lens), use_sequence_length=True)
+    assert_close(last.asnumpy()[0], x[1, 0])
+
+
+def test_elemwise_shape_check():
+    a = nd.ones((2, 3))
+    b = nd.ones((3, 2))
+    with pytest.raises(Exception):
+        nd.elemwise_add(a, b)
+
+
+def test_clip_and_linalg():
+    x = np.random.rand(3, 3).astype(np.float32) + np.eye(3, dtype=np.float32) * 3
+    a = nd.array(x)
+    assert_close(nd.clip(a, a_min=0.2, a_max=0.8).asnumpy(), np.clip(x, 0.2, 0.8))
+    sym_x = x @ x.T
+    inv = nd.linalg.inverse(nd.array(sym_x))
+    assert_close(inv.asnumpy() @ sym_x, np.eye(3), atol=1e-3)
+    chol = nd.linalg.potrf(nd.array(sym_x))
+    assert_close(chol.asnumpy() @ chol.asnumpy().T, sym_x, rtol=1e-3, atol=1e-3)
+
+
+def test_keyword_tensor_order():
+    # regression: tensors passed by keyword bind by parameter name, not
+    # call-site order
+    x = np.random.rand(2, 6).astype(np.float32)
+    w = np.random.rand(4, 6).astype(np.float32)
+    out1 = nd.FullyConnected(data=nd.array(x), weight=nd.array(w),
+                             no_bias=True, num_hidden=4)
+    out2 = nd.FullyConnected(weight=nd.array(w), data=nd.array(x),
+                             no_bias=True, num_hidden=4)
+    assert_close(out1.asnumpy(), x @ w.T, rtol=1e-4)
+    assert_close(out2.asnumpy(), out1.asnumpy())
+
+
+def test_csr_matrix_tuple():
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([0, 2, 1])
+    indptr = np.array([0, 2, 3])
+    m = nd.sparse.csr_matrix((data, indices, indptr), shape=(2, 3))
+    expect = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+    assert_close(m.asnumpy(), expect)
+    assert m.stype == "csr"

@@ -1,0 +1,160 @@
+"""Symbol/executor tests (reference: tests/python/unittest/test_symbol.py,
+test_executor.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, label=sym.var("softmax_label"),
+                             name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(16, 10), softmax_label=(16,))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(16, 3)]
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    conv = sym.Convolution(data=data, kernel=(3, 3), num_filter=6, name="conv")
+    bn = sym.BatchNorm(data=conv, name="bn")
+    pool = sym.Pooling(data=bn, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (6, 3, 3, 3)
+    assert d["bn_gamma"] == (6,)
+    assert out_shapes == [(2, 6, 3, 3)]
+    assert pool.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert aux_shapes == [(6,), (6,)]
+
+
+def test_symbol_arithmetic():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(mx.cpu(), {"a": nd.array([4.0]), "b": nd.array([2.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [(4 + 2) * 2 - 2.0])
+
+
+def test_grouped_symbol():
+    a = sym.var("a")
+    s1 = sym.sqrt(a)
+    s2 = sym.square(a)
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(mx.cpu(), {"a": nd.array([4.0])})
+    o = ex.forward()
+    np.testing.assert_allclose(o[0].asnumpy(), [2.0])
+    np.testing.assert_allclose(o[1].asnumpy(), [16.0])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    ex = net2.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    assert ex.forward()[0].shape == (4, 3)
+
+
+def test_executor_train_backward():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.rand(4, 10)
+    ex.arg_dict["fc1_weight"][:] = rng.rand(8, 10) * 0.1
+    ex.arg_dict["fc2_weight"][:] = rng.rand(3, 8) * 0.1
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 0])
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(4), rtol=1e-5)
+    ex.backward()
+    assert float(np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum()) > 0
+    # gradient of softmax output wrt fc2_bias = sum over batch of (p - onehot)
+    p = out.asnumpy()
+    onehot = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               (p - onehot).sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_executor_batchnorm_aux_update():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data=data, name="bn", momentum=0.5, fix_gamma=False)
+    out = sym.make_loss(sym.sum(bn))
+    ex = out.simple_bind(mx.cpu(), data=(8, 4))
+    x = np.random.rand(8, 4).astype(np.float32) * 3 + 1
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = np.ones(4)
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expect = 0.5 * before + 0.5 * x.mean(axis=0)
+    np.testing.assert_allclose(after, expect, rtol=1e-4)
+    # inference does not touch aux
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), after)
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+    feat = internals["fc1_output"]
+    ex = feat.simple_bind(mx.cpu(), data=(2, 10))
+    assert ex.forward()[0].shape == (2, 8)
+
+
+def test_simple_bind_shared_shapes():
+    # rebinding with a different batch size triggers jit recompile, not error
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex.forward()
+    ex.reshape(data=(8, 10), softmax_label=(8,))
+    out = ex.forward()
+    assert out[0].shape == (8, 3)
+
+
+def test_split_output_index_json_roundtrip():
+    # regression: consumers of output k of a multi-output node must still
+    # read output k after JSON save/load (executor input wiring uses the
+    # stored output index)
+    data = sym.var("data")
+    parts = sym.split(data, num_outputs=3, axis=1)
+    out = parts[2] * 10.0 + parts[0]
+    x = np.arange(6, dtype=np.float32).reshape(1, 6)
+    ex = out.bind(mx.cpu(), {"data": nd.array(x)})
+    expect = x[:, 4:6] * 10 + x[:, 0:2]
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), expect)
+    out2 = sym.load_json(out.tojson())
+    ex2 = out2.bind(mx.cpu(), {"data": nd.array(x)})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), expect)
+
+
+def test_symbol_positional_attrs():
+    # regression: positional non-Symbol args bind to attr params
+    data = sym.var("data")
+    e = sym.expand_dims(data, 1)
+    _, out_shapes, _ = e.infer_shape(data=(2, 3))
+    assert out_shapes == [(2, 1, 3)]
