@@ -26,3 +26,17 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from . import executor
+from . import lr_scheduler
+from . import optimizer
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import recordio
+from . import io
+from . import kvstore
+from . import callback
+from . import model
+from . import parallel
+from . import module
+from . import monitor
+from .monitor import Monitor

@@ -1,0 +1,362 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py:57-715 (Initializer base with
+registry + InitDesc attr-routing, Xavier/MSRAPrelu/Bilinear/LSTMBias/
+FusedRNN and friends).
+
+TPU note: initialization happens host-side in numpy then lands on device
+in one transfer — there is no per-element device loop to hide, and doing
+it in numpy keeps jit caches clean of init-only computations.
+"""
+
+import json
+import math
+import re
+
+import numpy as np
+
+from . import ndarray as nd
+from . import random as _random
+
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _INIT_REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (initializer.py:34)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer(object):
+    """Base init with name-pattern dispatch (initializer.py:57-188)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            create(*json.loads(init))._init_weight(desc, arr)
+        else:
+            # routing by name suffix (initializer.py:125-160)
+            if desc.endswith("weight"):
+                self._init_weight(desc, arr)
+            elif desc.endswith("bias"):
+                self._init_bias(desc, arr)
+            elif desc.endswith("gamma"):
+                self._init_gamma(desc, arr)
+            elif desc.endswith("beta"):
+                self._init_beta(desc, arr)
+            elif desc.endswith("min"):
+                self._init_zero(desc, arr)
+            elif desc.endswith("max"):
+                self._init_one(desc, arr)
+            elif desc.endswith("weight_quantize"):
+                self._init_quantized_weight(desc, arr)
+            else:
+                self._init_default(desc, arr)
+
+    def _set(self, arr, value):
+        arr[:] = nd.array(np.asarray(value, dtype=np.float32)
+                          .astype(np.dtype("float32")))._data.astype(arr.dtype) \
+            if not np.isscalar(value) else value
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_quantized_weight(self, _, arr):
+        arr[:] = nd.array(np.random.randint(-127, 127, size=arr.shape),
+                          dtype="int8")._data
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s." % name)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (initializer.py:441)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape) \
+            .astype(np.float32)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (initializer.py:467)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape).astype(np.float32)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (initializer.py:493)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * res).reshape(arr.shape).astype(np.float32)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (initializer.py:540): factor_type in/out/avg,
+    rnd_type uniform/gaussian."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.
+        if len(shape) < 2:
+            raise ValueError("Xavier initializer cannot be applied to vector "
+                             "{0}. It requires at least 2D.".format(name))
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, arr.shape).astype(np.float32)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0, scale, arr.shape).astype(np.float32)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init adjusted for PReLU slope (initializer.py:611)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2. / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (initializer.py:634)."""
+
+    def _init_weight(self, _, arr):
+        weight = np.zeros(np.prod(arr.shape), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.)
+        c = (2 * f - 1 - f % 2) / (2. * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (initializer.py:660): bias layout
+    [input, forget, cell, output] each of hidden size."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+
+class Mixed(object):
+    """Pattern-routed initializer list (initializer.py:225)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern. "
+                         'Consider adding a ".*" pattern at the end.' % name)
+
+
+@register
+class Load(object):
+    """Init from a dict of saved params (initializer.py:257)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = nd.load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                self.param[name[4:]] = arr
+            else:
+                self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise ValueError("Parameter %s cannot be initialized from "
+                                 "loading. Shape mismatch, target %s vs loaded "
+                                 "%s" % (name, str(arr.shape),
+                                         self.param[name].shape))
+            arr[:] = self.param[name]._data
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot Initialize parameter %s. Not found in "
+                                 "loaded param and no default Initializer is "
+                                 "provided." % name)
+            self.default_init(name, arr)
+
+
+# FusedRNN initializer needs the rnn cell param layout; provided in
+# rnn.rnn_cell once cells exist. Placeholder registered name for parity.
+@register
+class FusedRNN(Initializer):
+    """Init for fused RNN packed params (initializer.py:344). The packed
+    vector is de-concatenated into per-gate weights, each initialized with
+    `init`, biases with forget_bias where applicable."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        # The packed vector layout (weights then biases, ops/nn.py RNN op)
+        # carries no per-chunk shape metadata here; weights get uniform
+        # init, biases (the trailing 2*dirs*layers*gates*h entries) get 0
+        # with the forget-gate quarter at forget_bias for LSTM.
+        ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[self._mode]
+        h = self._num_hidden
+        dirs = 2 if self._bidirectional else 1
+        total = int(np.prod(arr.shape))
+        nbias = 2 * dirs * self._num_layers * ngates * h
+        flat = np.random.uniform(-0.07, 0.07, (total,)).astype("float32")
+        bias = np.zeros((nbias,), dtype="float32")
+        if self._mode == "lstm":
+            per = ngates * h
+            for b in range(nbias // per):
+                bias[b * per + h:b * per + 2 * h] = self._forget_bias
+        flat[total - nbias:] = bias
+        arr[:] = flat.reshape(arr.shape)

@@ -1,0 +1,639 @@
+"""Data iterators.
+
+Reference: python/mxnet/io/io.py:180-790 (DataIter/DataBatch/DataDesc,
+NDArrayIter, ResizeIter, PrefetchingIter) and src/io/ (CSVIter,
+ImageRecordIter, MNISTIter registered C++ iterators surfaced as MXDataIter).
+
+TPU-native design: iterators yield host-side numpy batches and convert to
+device NDArrays at the boundary; batching is static-shape (pad +
+discard/roll-over policies) so downstream jit never sees a ragged batch —
+the TPU analogue of the reference's fixed-batch DataBatchLoader
+(src/io/iter_batchloader.h). Prefetching uses a background thread like
+dmlc::ThreadedIter (src/io/iter_prefetcher.h).
+"""
+
+import csv as _csv
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import recordio
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape+dtype+layout of one input (io.py:70)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch(object):
+    """One mini-batch (io.py:139)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (io.py:180)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        pass
+
+    def getdata(self):
+        pass
+
+    def getlabel(self):
+        pass
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        pass
+
+
+def _init_data(data, allow_empty, default_name):
+    """io.py:493 — normalize to list of (name, numpy) pairs."""
+    assert (data is not None) or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {default_name + "_%d" % i: d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    out = {}
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            out[k] = v.asnumpy()
+        else:
+            out[k] = np.asarray(v)
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with shuffle + pad/discard/roll_over
+    last-batch handling (io.py:560)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.batch_size = batch_size
+        self.cursor = -self.batch_size
+        self.num_data = self.idx.shape[0]
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.label]
+
+    def hard_reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+
+    def reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        # roll_over carries the cached tail into the next epoch (io.py:640)
+        if self.last_batch_handle == "roll_over" and self._cache_data is not None:
+            self.cursor = -len(self._cache_data[0]) - self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor <= self.num_data - self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self.getdata()
+        label = self.getlabel()
+        if self.cursor < 0:  # cached tail consumed
+            self._cache_data = None
+            self._cache_label = None
+        if data[0].shape[0] != self.batch_size:
+            if self.last_batch_handle == "roll_over":
+                # cache the tail for the next epoch (reference io.py next())
+                self._cache_data = [d.asnumpy() for d in data]
+                self._cache_label = [l.asnumpy() for l in label]
+                raise StopIteration
+            # 'pad': wrap around with samples from the epoch start
+            data = self._pad_batch(data, self.data)
+            label = self._pad_batch(label, self.label)
+        return DataBatch(data=data, label=label, pad=self.getpad(), index=None)
+
+    def _pad_batch(self, arrays, source):
+        out = []
+        for x, (_, v) in zip(arrays, source):
+            pad = self.batch_size - x.shape[0]
+            head = x.asnumpy()
+            filler = v[self.idx[:pad]]
+            out.append(nd.array(np.concatenate([head, filler])))
+        return out
+
+    def _getdata(self, data_source, cache):
+        if self.cursor < 0:
+            # roll_over start-of-epoch: cached tail + head of this epoch
+            taken = self.cursor + self.batch_size
+            out = []
+            for c, (_, v) in zip(cache, data_source):
+                out.append(nd.array(np.concatenate([c, v[self.idx[:taken]]])))
+            return out
+        end = min(self.cursor + self.batch_size, self.num_data)
+        s = slice(self.cursor, end)
+        return [nd.array(v[self.idx[s]]) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data, self._cache_data)
+
+    def getlabel(self):
+        return self._getdata(self.label, self._cache_label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def _shuffle_data(self):
+        np.random.shuffle(self.idx)
+
+
+class ResizeIter(DataIter):
+    """Resize epoch length of an inner iterator (io.py:351)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher over one or more iterators (io.py:410)
+    — the Python analogue of dmlc::ThreadedIter in iter_prefetcher.h."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Different pad size between iterators"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad,
+            self.next_batch[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(NDArrayIter):
+    """CSV reader (src/io/iter_csv.cc registered as CSVIter). Loads the
+    file host-side then batches like NDArrayIter (static shapes)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard",
+                         data_name=kwargs.get("data_name", "data"),
+                         label_name=kwargs.get("label_name", "label"))
+
+
+class LibSVMIter(NDArrayIter):
+    """LibSVM sparse-format reader (src/io/iter_libsvm.cc). Parses into a
+    dense array (TPU sparse divergence, SURVEY §7(a)); label supports
+    multi-target files."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, **kwargs):
+        data, labels = self._parse(data_libsvm, int(np.prod(data_shape)))
+        if label_libsvm is not None:
+            _, labels2 = self._parse_labels_only(label_libsvm)
+            labels = labels2
+        super().__init__(data, labels, batch_size=batch_size,
+                         last_batch_handle="discard",
+                         label_name=kwargs.get("label_name", "softmax_label"))
+
+    @staticmethod
+    def _parse(path, dim):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(dim, dtype=np.float32)
+                for t in parts[1:]:
+                    k, v = t.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        return np.stack(rows), np.asarray(labels, dtype=np.float32)
+
+    @staticmethod
+    def _parse_labels_only(path):
+        labels = []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if parts:
+                    labels.append([float(x) for x in parts])
+        return None, np.asarray(labels, dtype=np.float32).squeeze()
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format reader (src/io/iter_mnist.cc). Reads the
+    idx3-ubyte/idx1-ubyte (optionally .gz) files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        img = self._read_idx(image)
+        lbl = self._read_idx(label)
+        img = img.astype(np.float32) / 255.0
+        if flat:
+            img = img.reshape(img.shape[0], -1)
+        else:
+            img = img.reshape(img.shape[0], 1, img.shape[1], img.shape[2])
+        super().__init__(img, lbl.astype(np.float32), batch_size=batch_size,
+                         shuffle=shuffle, last_batch_handle="discard")
+
+    @staticmethod
+    def _read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+class ImageRecordIter(DataIter):
+    """Threaded image-record pipeline (src/io/iter_image_recordio_2.cc).
+
+    Reads RecordIO image records, decodes + augments (resize, crop,
+    mirror, mean subtraction) in worker threads, emits fixed-shape NCHW
+    batches. The C++ decode path is optional (mxnet_tpu.io uses PIL/npy
+    payloads host-side); shapes are static for jit."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 rand_crop=False, rand_mirror=False, resize=-1,
+                 label_width=1, preprocess_threads=4, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.record = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r") \
+            if path_imgidx else recordio.MXRecordIO(path_imgrec, "r")
+        self.data_shape = tuple(data_shape)
+        self.shuffle = shuffle
+        self.mean = np.array([mean_r, mean_g, mean_b],
+                             dtype=np.float32).reshape(3, 1, 1)
+        self.std = np.array([std_r, std_g, std_b],
+                            dtype=np.float32).reshape(3, 1, 1)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self._records = self._load_all()
+        self._order = np.arange(len(self._records))
+        self.cursor = 0
+        self.reset()
+
+    def _load_all(self):
+        out = []
+        while True:
+            rec = self.record.read()
+            if rec is None:
+                break
+            header, payload = recordio.unpack(rec)
+            out.append((header, payload))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shp)]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self.cursor = 0
+
+    def _decode_one(self, header, payload):
+        img = recordio._imdecode(payload)
+        img = np.asarray(img, dtype=np.float32)
+        if img.ndim == 2:
+            img = np.stack([img] * 3, axis=-1)
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            img = _resize_hwc(img, self.resize)
+        # crop to target h,w (center or random)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = _resize_hwc(img, max(h, w))
+            ih, iw = img.shape[:2]
+        if self.rand_crop:
+            y = np.random.randint(0, ih - h + 1)
+            x = np.random.randint(0, iw - w + 1)
+        else:
+            y, x = (ih - h) // 2, (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1)[:c]
+        chw = (chw - self.mean[:c]) / self.std[:c]
+        label = header.label if np.ndim(header.label) else \
+            np.float32(header.label)
+        return chw, label
+
+    def next(self):
+        n = len(self._records)
+        if self.cursor >= n:
+            raise StopIteration
+        idxs = [self._order[(self.cursor + i) % n]
+                for i in range(self.batch_size)]
+        pad = max(0, self.cursor + self.batch_size - n)
+        self.cursor += self.batch_size
+        datas, labels = [], []
+        for i in idxs:
+            header, payload = self._records[i]
+            d, l = self._decode_one(header, payload)
+            datas.append(d)
+            labels.append(l)
+        data = nd.array(np.stack(datas))
+        label = nd.array(np.asarray(labels, dtype=np.float32))
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _resize_hwc(img, short):
+    """Bilinear resize shortest side to `short` (host-side numpy)."""
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = short, int(w * short / h)
+    else:
+        nh, nw = int(h * short / w), short
+    ys = np.clip((np.arange(nh) + 0.5) * h / nh - 0.5, 0, h - 1)
+    xs = np.clip((np.arange(nw) + 0.5) * w / nw - 0.5, 0, w - 1)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    out = (img[y0][:, x0] * (1 - wy) * (1 - wx) +
+           img[y1][:, x0] * wy * (1 - wx) +
+           img[y0][:, x1] * (1 - wy) * wx +
+           img[y1][:, x1] * wy * wx)
+    return out.astype(np.float32)
+
+
+class MXDataIter(DataIter):
+    """Compatibility shim name for C++-registered iterators (io.py:790).
+    In this framework native iterators are the Python classes above."""
+    pass

@@ -1,0 +1,690 @@
+"""Evaluation metrics.
+
+Reference: python/mxnet/metric.py:68-1666 — EvalMetric hierarchy with
+registry, CompositeEvalMetric, and ~20 concrete metrics.
+
+TPU note: metric state (sum_metric/num_inst) is host-side python floats;
+predictions are pulled to host once per update. Heavy per-batch math
+(argmax/topk) runs on device via jnp before the single transfer.
+"""
+
+import math
+
+import numpy as _np
+import jax.numpy as jnp
+
+from . import ndarray
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "Caffe", "CustomMetric", "np", "create", "register"]
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass, *aliases):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    for a in aliases:
+        _METRIC_REGISTRY[a.lower()] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    """mx.metric.create (metric.py:46)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    if isinstance(metric, str):
+        if metric.lower() not in _METRIC_REGISTRY:
+            raise ValueError("Metric must be either callable or in registry: %s"
+                             % metric)
+        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+    raise TypeError("metric should be callable, str, EvalMetric or list")
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    """metric.py:36 helper."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels {} does not match shape of "
+                         "predictions {}".format(label_shape, pred_shape))
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric(object):
+    """Base metric (metric.py:68)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._has_global_stats = kwargs.pop("has_global_stats", False)
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({
+            "metric": self.__class__.__name__,
+            "name": self.name,
+            "output_names": self.output_names,
+            "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self._has_global_stats:
+            if self.global_num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.global_sum_metric / self.global_num_inst)
+        return self.get()
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        if self._has_global_stats:
+            name, value = self.get_global()
+            if not isinstance(name, list):
+                name = [name]
+            if not isinstance(value, list):
+                value = [value]
+            return list(zip(name, value))
+        return self.get_name_value()
+
+    def _inc(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manages multiple metrics (metric.py:315)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(i) for i in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}".format(
+                index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        if self.label_names is not None:
+            labels = {name: label for name, label in labels.items()
+                      if name in self.label_names}
+        if self.output_names is not None:
+            preds = {name: pred for name, pred in preds.items()
+                     if name in self.output_names}
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, _np.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_global(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get_global()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, _np.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        return config
+
+
+@register
+class Accuracy(EvalMetric):
+    """Classification accuracy (metric.py:393)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, axis=axis, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pred_np = _as_np(pred_label)
+            if pred_np.ndim > _as_np(label).ndim:
+                pred_np = _np.argmax(pred_np, axis=self.axis)
+            pred_np = pred_np.astype("int32")
+            label_np = _as_np(label).astype("int32")
+            label_np, pred_np = check_label_shapes(label_np, pred_np)
+            correct = (pred_np.flat == label_np.flat).sum()
+            self._inc(float(correct), len(pred_np.flat))
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (metric.py:480)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, top_k=top_k, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
+            pred_np = _np.argsort(_as_np(pred_label).astype("float32"), axis=-1)
+            label_np = _as_np(label).astype("int32")
+            num_samples = pred_np.shape[0]
+            num_dims = len(pred_np.shape)
+            if num_dims == 1:
+                self._inc(float((pred_np.flat == label_np.flat).sum()),
+                          num_samples)
+            elif num_dims == 2:
+                num_classes = pred_np.shape[1]
+                top_k = min(num_classes, self.top_k)
+                correct = 0.0
+                for j in range(top_k):
+                    correct += (pred_np[:, num_classes - 1 - j].flat ==
+                                label_np.flat).sum()
+                self._inc(float(correct), num_samples)
+
+
+class _BinaryClassificationMetrics(object):
+    """Running TP/FP/TN/FN used by F1 and MCC (metric.py:573)."""
+
+    def __init__(self):
+        self.reset_stats()
+
+    def update_binary_stats(self, label, pred):
+        pred_np = _as_np(pred)
+        label_np = _as_np(label).astype("int32")
+        pred_label = _np.argmax(pred_np, axis=1) if pred_np.ndim > 1 else \
+            (pred_np > 0.5).astype("int32")
+        check_label_shapes(label_np, pred_label)
+        if len(_np.unique(label_np)) > 2:
+            raise ValueError("%s currently only supports binary classification."
+                             % self.__class__.__name__)
+        pred_true = (pred_label == 1)
+        pred_false = 1 - pred_true
+        label_true = (label_np.flat == 1)
+        label_false = 1 - label_true
+        true_pos = (pred_true.flat * label_true).sum()
+        false_pos = (pred_true.flat * label_false).sum()
+        false_neg = (pred_false.flat * label_true).sum()
+        true_neg = (pred_false.flat * label_false).sum()
+        self.true_positives += true_pos
+        self.false_positives += false_pos
+        self.false_negatives += false_neg
+        self.true_negatives += true_neg
+
+    @property
+    def precision(self):
+        if self.true_positives + self.false_positives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_positives)
+        return 0.
+
+    @property
+    def recall(self):
+        if self.true_positives + self.false_negatives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_negatives)
+        return 0.
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (
+                self.precision + self.recall)
+        return 0.
+
+    @property
+    def matthewscc(self):
+        if not self.total_examples:
+            return 0.
+        true_pos = float(self.true_positives)
+        false_pos = float(self.false_positives)
+        false_neg = float(self.false_negatives)
+        true_neg = float(self.true_negatives)
+        terms = [(true_pos + false_pos), (true_pos + false_neg),
+                 (true_neg + false_pos), (true_neg + false_neg)]
+        denom = 1.
+        for t in filter(lambda t: t != 0., terms):
+            denom *= t
+        return ((true_pos * true_neg) - (false_pos * false_neg)) / \
+            math.sqrt(denom)
+
+    @property
+    def total_examples(self):
+        return (self.false_negatives + self.false_positives +
+                self.true_negatives + self.true_positives)
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (metric.py:683)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(label, pred)
+        if self.average == "macro":
+            self.sum_metric += self.metrics.fscore
+            self.global_sum_metric += self.metrics.fscore
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self.metrics.reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.global_sum_metric = self.sum_metric
+            self.num_inst = self.metrics.total_examples
+            self.global_num_inst = self.num_inst
+
+    def reset(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self.global_sum_metric = 0.
+        self.global_num_inst = 0.
+        self.metrics.reset_stats()
+
+    reset_local = reset
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (metric.py:776)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        self._average = average
+        self._metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._metrics.update_binary_stats(label, pred)
+        if self._average == "macro":
+            self.sum_metric += self._metrics.matthewscc
+            self.global_sum_metric += self._metrics.matthewscc
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self._metrics.reset_stats()
+        else:
+            self.sum_metric = self._metrics.matthewscc * self._metrics.total_examples
+            self.global_sum_metric = self.sum_metric
+            self.num_inst = self._metrics.total_examples
+            self.global_num_inst = self.num_inst
+
+    def reset(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self.global_sum_metric = 0.
+        self.global_num_inst = 0.
+        self._metrics.reset_stats()
+
+    reset_local = reset
+
+
+@register
+class Perplexity(EvalMetric):
+    """Perplexity (metric.py:880)."""
+
+    def __init__(self, ignore_label, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, ignore_label=ignore_label, axis=axis,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.
+        num = 0
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label).astype("int32").reshape(-1)
+            pred_np = _as_np(pred).astype("float64")
+            pred_np = pred_np.reshape(-1, pred_np.shape[-1])
+            probs = pred_np[_np.arange(label_np.shape[0]), label_np]
+            if self.ignore_label is not None:
+                ignore = (label_np == self.ignore_label).astype(pred_np.dtype)
+                num -= int(ignore.sum())
+                probs = probs * (1 - ignore) + ignore
+            loss -= _np.sum(_np.log(_np.maximum(1e-10, probs)))
+            num += label_np.shape[0]
+        self._inc(loss, num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (metric.py:971)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            self._inc(float(_np.abs(label_np - pred_np).mean()), 1)
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (metric.py:1021)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            self._inc(float(((label_np - pred_np) ** 2.0).mean()), 1)
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (metric.py:1071)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            self._inc(float(_np.sqrt(((label_np - pred_np) ** 2.0).mean())), 1)
+
+
+@register
+class CrossEntropy(EvalMetric):
+    """Cross-entropy of predicted prob at the label (metric.py:1122)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            label_np = label_np.ravel()
+            assert label_np.shape[0] == pred_np.shape[0]
+            prob = pred_np[_np.arange(label_np.shape[0]), _np.int64(label_np)]
+            cross_entropy = (-_np.log(prob + self.eps)).sum()
+            self._inc(float(cross_entropy), label_np.shape[0])
+
+
+@register
+class NegativeLogLikelihood(EvalMetric):
+    """NLL (metric.py:1180)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            label_np = label_np.ravel()
+            num_examples = pred_np.shape[0]
+            assert label_np.shape[0] == num_examples, \
+                (label_np.shape[0], num_examples)
+            prob = pred_np[_np.arange(num_examples, dtype=_np.int64),
+                           _np.int64(label_np)]
+            nll = (-_np.log(prob + self.eps)).sum()
+            self._inc(float(nll), num_examples)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (metric.py:1238)."""
+
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            check_label_shapes(label, pred, False, True)
+            label_np = _as_np(label).ravel().astype(_np.float64)
+            pred_np = _as_np(pred).ravel().astype(_np.float64)
+            self._inc(float(_np.corrcoef(pred_np, label_np)[0, 1]), 1)
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of a loss output (metric.py:1296)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            loss = float(_as_np(pred).sum())
+            self._inc(loss, int(_np.prod(pred.shape)))
+
+
+@register
+class Torch(Loss):
+    """Legacy alias (metric.py:1330)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Legacy alias (metric.py:1338)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wraps a feval function (metric.py:1346)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            label_np = _as_np(label)
+            pred_np = _as_np(pred)
+            reval = self._feval(label_np, pred_np)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self._inc(sum_metric, num_inst)
+            else:
+                self._inc(reval, 1)
+
+    def get_config(self):
+        raise NotImplementedError("CustomMetric cannot be serialized")
+
+
+# `acc`, `ce`, `nll_loss` aliases (metric registry names in the reference)
+register(Accuracy, "acc")
+register(CrossEntropy, "ce")
+register(NegativeLogLikelihood, "nll_loss")
+register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """mx.metric.np — make a CustomMetric from a numpy feval (metric.py:1422)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,12 @@
+"""Module API — symbolic training loops.
+
+Reference: python/mxnet/module/ (BaseModule, Module, BucketingModule,
+SequentialModule).
+"""
+
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule"]

@@ -1,0 +1,95 @@
+"""Monitor — per-step output/param inspection.
+
+Reference: python/mxnet/monitor.py (Monitor installs a callback on
+executor outputs; C++ hook graph_executor.cc:185 SetMonitorCallback).
+
+TPU note: under whole-graph jit there is no per-op callback point; the
+monitor inspects bound arrays (args/aux/outputs) at step boundaries,
+which covers the reference's main use (norm/NaN watching) without
+de-fusing the compiled program.
+"""
+
+import logging
+import re
+from math import sqrt
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """monitor.py:34."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """returns |x|/size(x), async execution."""
+                return nd.norm(x) / sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, array):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(array)))
+
+    def install(self, exe):
+        """Hook an executor (monitor.py:87)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this step (monitor.py:96)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Collect stats from bound arrays (monitor.py:106)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                self.stat_helper(name, array)
+            for name, array in exe.aux_dict.items():
+                self.stat_helper(name, array)
+            for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
+                self.stat_helper(name, array)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """monitor.py:139."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))

@@ -668,3 +668,57 @@ def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
         return pooled  # (c, ph, pw)
 
     return jax.vmap(one_roi)(rois)
+
+
+# ------------------------------------------------- regression outputs ---
+# src/operator/regression_output.cc — identity-ish forward, fixed bwd
+# (pred - label) * grad_scale. Implemented with custom_vjp like
+# SoftmaxOutput so Module loss heads train identically to the reference.
+
+def _make_regression_output(fwd, bwd_from):
+    @_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _core(data, label, grad_scale):
+        return fwd(data)
+
+    def _fvjp(data, label, grad_scale):
+        return fwd(data), (data, label)
+
+    def _bvjp(grad_scale, res, g):
+        data, label = res
+        # reference scales by grad_scale / num_output (outputs per sample,
+        # regression_output-inl.h:201-207)
+        num_output = data.size // data.shape[0] if data.ndim else 1
+        grad = bwd_from(data, label) * (grad_scale / num_output)
+        return grad, jnp.zeros_like(label)
+
+    _core.defvjp(_fvjp, _bvjp)
+    return _core
+
+
+_linreg_core = _make_regression_output(
+    lambda d: d,
+    lambda d, l: d - l.reshape(d.shape))
+_maereg_core = _make_regression_output(
+    lambda d: d,
+    lambda d, l: jnp.sign(d - l.reshape(d.shape)))
+_logreg_core = _make_regression_output(
+    jax.nn.sigmoid,
+    lambda d, l: jax.nn.sigmoid(d) - l.reshape(d.shape))
+
+
+@register(name="LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    """src/operator/regression_output.cc:xx — identity fwd, (pred-label) bwd."""
+    return _linreg_core(data, label, grad_scale)
+
+
+@register(name="MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    """src/operator/regression_output.cc — identity fwd, sign(pred-label) bwd."""
+    return _maereg_core(data, label, grad_scale)
+
+
+@register(name="LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """src/operator/regression_output.cc — sigmoid fwd, (sigmoid-label) bwd."""
+    return _logreg_core(data, label, grad_scale)

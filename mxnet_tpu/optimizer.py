@@ -1,0 +1,693 @@
+"""Optimizers.
+
+Reference: python/mxnet/optimizer/optimizer.py:48-1672 (Optimizer base with
+registry + 17 optimizers) and the fused C++ update kernels in
+src/operator/optimizer_op.cc:47-893.
+
+TPU-native design: each update rule is a pure jnp function jit-compiled by
+XLA (the analogue of the fused `sgd_mom_update`/`adam_update` kernels —
+XLA fuses the elementwise chain into one HBM pass). Hyper-parameters that
+change per step (lr, wd, rescale) are passed as traced scalars so a
+changing schedule never recompiles. States live as jax.Arrays inside
+NDArrays, matching `create_state`/`update` semantics that kvstore's
+server-side Updater also consumes.
+"""
+
+import math
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .base import MXNetError
+
+__all__ = ["Optimizer", "SGD", "NAG", "Signum", "FTML", "DCASGD", "LBSGD",
+           "SGLD", "Adam", "AdaGrad", "AdaDelta", "RMSProp", "Ftrl",
+           "Adamax", "Nadam", "Test", "Updater", "get_updater", "create",
+           "register"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    """Optimizer.register decorator (optimizer.py:93)."""
+    name = klass.__name__.lower()
+    _OPT_REGISTRY[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    """mx.optimizer.create (optimizer.py:139)."""
+    if name.lower() not in _OPT_REGISTRY:
+        raise ValueError("Cannot find optimizer %s" % name)
+    return _OPT_REGISTRY[name.lower()](**kwargs)
+
+
+def _flt(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+class Optimizer(object):
+    """Base optimizer (optimizer.py:48): lr/wd multipliers resolved per
+    param index, gradient rescale + clip, update-count tracking for
+    schedulers and bias correction."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = 0
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict)
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
+            if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    create_optimizer = staticmethod(create)
+    opt_registry = _OPT_REGISTRY
+
+    @staticmethod
+    def register(klass):
+        return register(klass)
+
+    # ------------------------------------------------------------ state --
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for bf16 weights (optimizer.py:278)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == jnp.bfloat16:
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == jnp.bfloat16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype("float32")
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight._data = weight_master_copy._data.astype(jnp.bfloat16)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -------------------------------------------------------- lr/wd mult --
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # biases/betas get no decay; weights and BN gammas do
+            # (reference optimizer.py:378)
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def _preprocess_grad(self, grad):
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+# --------------------------------------------------------------- rules ---
+# Pure jitted update kernels (analogues of src/operator/optimizer_op.cc).
+
+@jax.jit
+def _sgd_update(w, g, lr, wd):
+    return w - lr * (g + wd * w)
+
+
+@jax.jit
+def _sgd_mom_update(w, g, mom, lr, wd, momentum):
+    mom = momentum * mom - lr * (g + wd * w)
+    return w + mom, mom
+
+
+@jax.jit
+def _nag_mom_update(w, g, mom, lr, wd, momentum):
+    g = g + wd * w
+    mom = momentum * mom + g
+    return w - lr * (momentum * mom + g), mom
+
+
+@jax.jit
+def _adam_update(w, g, m, v, lr, wd, beta1, beta2, eps):
+    g = g + wd * w
+    m = beta1 * m + (1.0 - beta1) * g
+    v = beta2 * v + (1.0 - beta2) * g * g
+    return w - lr * m / (jnp.sqrt(v) + eps), m, v
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (optimizer.py:479;
+    kernels optimizer_op.cc sgd_update/sgd_mom_update). lazy_update applies
+    only to row_sparse — dense-backed here, so it is a no-op flag."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if state is not None:
+            weight._data, state._data = _sgd_mom_update(
+                weight._data, g, state._data, _flt(lr), _flt(wd),
+                _flt(self.momentum))
+        else:
+            weight._data = _sgd_update(weight._data, g, _flt(lr), _flt(wd))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        super().update_multi_precision(index, weight, grad, state)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (optimizer.py:1137)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if state is not None:
+            weight._data, state._data = _nag_mom_update(
+                weight._data, g, state._data, _flt(lr), _flt(wd),
+                _flt(self.momentum))
+        else:
+            weight._data = _sgd_update(weight._data, g, _flt(lr), _flt(wd))
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (optimizer.py:699): takes sign of (momentum) grad."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if state is not None:
+            mom = self.momentum * state._data - (1 - self.momentum) * (g + wd * weight._data)
+            weight._data = (1 - lr * self.wd_lh) * weight._data + lr * jnp.sign(mom)
+            state._data = mom
+        else:
+            weight._data = (1 - lr * (self.wd_lh + wd)) * weight._data \
+                - lr * jnp.sign(g)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (optimizer.py:636)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+             nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+             nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return z  # (prev_d, prev_v, prev_z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad) + wd * weight._data
+        prev_d, prev_v, prev_z = state
+        v = self.beta2 * prev_v._data + (1 - self.beta2) * g * g
+        d = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d - self.beta1 * prev_d._data
+        z = self.beta1 * prev_z._data + (1 - self.beta1) * g \
+            - sigma * weight._data
+        weight._data = -z / d
+        prev_d._data, prev_v._data, prev_z._data = d, v, z
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (optimizer.py:769)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        mon, previous_weight = state
+        comp = g + wd * weight._data + self.lamda * g * g * \
+            (weight._data - previous_weight._data)
+        if mon is not None:
+            mon._data = self.momentum * mon._data - lr * comp
+            delta = mon._data
+        else:
+            delta = -lr * comp
+        previous_weight._data = weight._data
+        weight._data = weight._data + delta
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate + warmup
+    (optimizer.py:860). Simplified: warmup strategies collapse to 'linear'
+    scaling of lr; adaptive ratio = ||w||/||g|| as in the reference."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.adaptive = warmup_strategy.startswith("lars")
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if self.adaptive:
+            wnorm = jnp.linalg.norm(weight._data)
+            gnorm = jnp.linalg.norm(g)
+            ratio = jnp.where(gnorm > 0, wnorm / (gnorm + wd * wnorm + 1e-9), 1.0)
+            lr = lr * jnp.clip(ratio, 0.0, 10.0)
+        if state is not None:
+            weight._data, state._data = _sgd_mom_update(
+                weight._data, g, state._data, _flt(lr), _flt(wd),
+                _flt(self.momentum))
+        else:
+            weight._data = _sgd_update(weight._data, g, _flt(lr), _flt(wd))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (optimizer.py:1599)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype="float32")
+        weight._data = weight._data - lr / 2 * (g + wd * weight._data) \
+            + noise._data.astype(weight.dtype)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (optimizer.py:1181; kernel optimizer_op.cc adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        g = self._preprocess_grad(grad)
+        mean, var = state
+        weight._data, mean._data, var._data = _adam_update(
+            weight._data, g, mean._data, var._data, _flt(lr), _flt(wd),
+            _flt(self.beta1), _flt(self.beta2), _flt(self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (optimizer.py:1369; sparse adagrad in optimizer_op.cc:893)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        state._data = state._data + g * g
+        weight._data = weight._data - lr * g / (
+            jnp.sqrt(state._data) + self.float_stable_eps)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (optimizer.py:1467)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1. - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1. - self.rho) * delta * delta
+        weight._data = weight._data - delta
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, non-centered (Hinton) and centered (Graves) variants
+    (optimizer.py:1270)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if self.centered:
+            n, gmean, delta = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            gmean._data = (1 - self.gamma1) * g + self.gamma1 * gmean._data
+            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - gmean._data * gmean._data + self.epsilon)
+            weight._data = weight._data + delta._data
+        else:
+            (n,) = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            weight._data = weight._data - lr * g / jnp.sqrt(n._data + self.epsilon)
+        if self.clip_weights:
+            weight._data = jnp.clip(weight._data, -self.clip_weights,
+                                    self.clip_weights)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (optimizer.py:1518)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),  # z
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + g * g) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * weight._data
+        n._data = n._data + g * g
+        weight._data = jnp.where(
+            jnp.abs(z._data) <= self.lamda1,
+            jnp.zeros_like(weight._data),
+            -(z._data - jnp.sign(z._data) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n._data)) / lr + wd))
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (optimizer.py:1613)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        m_t, u_t = state
+        m_t._data = self.beta1 * m_t._data + (1. - self.beta1) * g
+        u_t._data = jnp.maximum(self.beta2 * u_t._data, jnp.abs(g))
+        weight._data = weight._data - lr * m_t._data / (u_t._data + 1e-12)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (optimizer.py:1660)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad) + wd * weight._data
+        momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t * self.schedule_decay)))
+        momentum_t_1 = self.beta1 * (1. - 0.5 *
+                                     (pow(0.96, (t + 1) * self.schedule_decay)))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = self.beta1 * m_t._data + (1. - self.beta1) * g
+        v_t._data = self.beta2 * v_t._data + (1. - self.beta2) * g * g
+        grad_prime = g / (1. - self.m_schedule)
+        m_t_prime = m_t._data / (1. - m_schedule_next)
+        v_t_prime = v_t._data / (1. - pow(self.beta2, t))
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._data = weight._data - lr * m_t_bar / (
+            jnp.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer that stores the weight delta (optimizer.py:437)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data + grad._data * self.rescale_grad
+        state._data = weight._data
+
+
+# alias used in examples (ccSGD was deprecated alias of SGD in 1.x)
+_OPT_REGISTRY["ccsgd"] = SGD
+
+
+class Updater(object):
+    """Applies an optimizer to (index, grad, weight) triples — the object
+    the reference ships to kvstore servers (optimizer.py get_updater /
+    kvstore_dist_server.h ApplyUpdates)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices, grads, weights = [index], [grad], [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer):
+    """mx.optimizer.get_updater (optimizer.py end)."""
+    return Updater(optimizer)

@@ -1,0 +1,118 @@
+"""Parallelism primitives — the TPU-native communication substrate.
+
+Replaces the reference's three comm stacks (src/kvstore/comm.h CPU/P2P
+tree reduce, kvstore_nccl.h NCCL, kvstore_dist.h ps-lite) with one layer:
+jax.sharding Mesh + XLA collectives (psum/all_gather/reduce_scatter/
+ppermute) over ICI within a slice and DCN across slices.
+
+Axis convention (used across the framework):
+  'dp' — data parallel          'tp' — tensor (model) parallel
+  'pp' — pipeline parallel      'sp' — sequence/context parallel
+  'ep' — expert parallel
+
+The reference has only DP (kvstore) + manual-placement model parallelism
+(group2ctx, graph_executor.cc:997). TP/PP/SP/EP here are capability
+extensions enabled by GSPMD (SURVEY §2.3 'NOT PRESENT' row).
+"""
+
+from contextlib import contextmanager
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "current_mesh",
+           "use_mesh", "set_mesh", "shard", "replicate", "all_reduce",
+           "all_gather", "reduce_scatter", "ring_permute", "device_count"]
+
+_CURRENT_MESH = None
+
+
+def device_count():
+    return jax.device_count()
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from an axis-name -> size dict.
+
+    make_mesh({'dp': 4, 'tp': 2}) lays 8 devices out as a 4x2 grid.
+    Sizes of -1 are inferred (at most one). Defaults to pure DP over all
+    devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    assert int(np.prod(sizes)) == n, \
+        "mesh axes %s don't cover %d devices" % (dict(zip(names, sizes)), n)
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def current_mesh():
+    """The active mesh (creates a default all-DP mesh on first use)."""
+    global _CURRENT_MESH
+    if _CURRENT_MESH is None:
+        _CURRENT_MESH = make_mesh()
+    return _CURRENT_MESH
+
+
+def set_mesh(mesh):
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+@contextmanager
+def use_mesh(mesh):
+    global _CURRENT_MESH
+    prev = _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _CURRENT_MESH = prev
+
+
+def shard(x, spec, mesh=None):
+    """Place an array (jax.Array / NDArray data) with a PartitionSpec."""
+    mesh = mesh or current_mesh()
+    data = x._data if hasattr(x, "_data") else x
+    return jax.device_put(data, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh=None):
+    return shard(x, P(), mesh)
+
+
+# ---------------------------------------------------------------------
+# Collectives — inside shard_map/pjit these lower to ICI/DCN collectives.
+# Outside a mapped context they operate on sharded global arrays via jnp
+# (XLA inserts the communication).
+# ---------------------------------------------------------------------
+
+def all_reduce(x, axis_name="dp"):
+    """psum over a mesh axis (usable inside shard_map)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name="dp", axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ring_permute(x, axis_name, shift=1):
+    """ppermute by `shift` around the ring — building block for ring
+    attention / pipeline transfers."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm=perm)

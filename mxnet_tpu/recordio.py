@@ -1,0 +1,258 @@
+"""RecordIO — binary record container + indexed variant + image records.
+
+Reference: python/mxnet/recordio.py:37,216,344 (MXRecordIO,
+MXIndexedRecordIO, IRHeader/pack/unpack) over dmlc-core's C++ recordio
+writer; src/io/image_recordio.h:110 (IRHeader layout).
+
+TPU-native: pure-Python implementation of the same on-disk format
+(kMagic-delimited, length+content, 4-byte aligned) so record files are
+interchangeable with reference tooling. The hot decode path for training
+runs through the C++ pipeline in src/ (see mxnet_tpu.io pipeline); this
+module is the format layer.
+"""
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(rec):
+    return (rec >> 29) & 7, rec & ((1 << 29) - 1)
+
+
+class MXRecordIO(object):
+    """Sequential record reader/writer (recordio.py:37).
+
+    Format per record: uint32 magic | uint32 lrec (3-bit cflag, 29-bit
+    len) | payload | pad to 4-byte boundary. cflag 0 = whole record;
+    1/2/3 = begin/middle/end of a split record (records > 2^29 bytes).
+    """
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fio = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fio = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fio = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_mx_rec = type(self).__name__ == "MXRecordIO"
+        if not is_mx_rec:
+            raise RuntimeError("Only MXRecordIO is picklable.")
+        d = dict(self.__dict__)
+        d["fio"] = None
+        d["pid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # fork safety (recordio.py:107): child must reopen its own handle
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in a forked process")
+
+    def close(self):
+        if self.fio is not None and not self.fio.closed:
+            self.fio.close()
+        self.fio = None
+        self.pid = None
+
+    @property
+    def is_open(self):
+        return self.fio is not None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        self.fio.write(struct.pack("<II", _kMagic,
+                                   _encode_lrec(0, len(buf))))
+        self.fio.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fio.write(b"\x00" * pad)
+
+    def tell(self):
+        return self.fio.tell()
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        parts = []
+        while True:
+            head = self.fio.read(8)
+            if len(head) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                raise RuntimeError("Invalid record magic in %s" % self.uri)
+            cflag, length = _decode_lrec(lrec)
+            data = self.fio.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.fio.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):  # whole record or end-of-split
+                return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records keyed by an .idx file (recordio.py:216)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.fio is None:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def __getstate__(self):
+        raise RuntimeError("MXIndexedRecordIO is not picklable.")
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.fio.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+# image record header (src/io/image_recordio.h:110 / recordio.py:344)
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a label header + byte payload into one record (recordio.py:355)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into header + payload (recordio.py:388)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (header, image ndarray) — decodes jpg/png payloads
+    (recordio.py:415). Uses PIL if available, else raw numpy pass-through
+    for .npy-packed payloads."""
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (recordio.py:451)."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imencode(img, quality, img_fmt):
+    try:
+        from PIL import Image
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(np.asarray(img).astype(np.uint8)).save(
+            buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+            quality=quality)
+        return buf.getvalue()
+    except ImportError:
+        # fallback: raw .npy serialization (not interchange-compatible)
+        import io as _io
+        buf = _io.BytesIO()
+        np.save(buf, np.asarray(img))
+        return buf.getvalue()
+
+
+def _imdecode(s, iscolor=-1):
+    if s[:6] == b"\x93NUMPY":
+        import io as _io
+        return np.load(_io.BytesIO(s))
+    try:
+        from PIL import Image
+        import io as _io
+        img = np.asarray(Image.open(_io.BytesIO(s)))
+        return img
+    except ImportError:
+        raise RuntimeError("No image decoder available (PIL missing and "
+                           "payload is not npy)")

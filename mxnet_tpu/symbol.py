@@ -59,6 +59,19 @@ OP_INPUTS = {
 # by gradient (reference: MutableInput lists; BatchNorm moving stats).
 OP_AUX = {"BatchNorm": ("moving_mean", "moving_var")}
 
+# Loss heads whose missing `label` input is auto-created as `{name}_label`
+# (the reference's ListArguments auto-var rule that makes `softmax_label`
+# appear in list_arguments()). Value = label-shape rule from data shape.
+LABEL_SHAPE_RULES = {
+    "SoftmaxOutput": lambda ds, at: ds[:1] if not at.get("multi_output")
+    else (ds[0],) + tuple(ds[2:]),
+    "Softmax": lambda ds, at: ds[:1],
+    "softmax_cross_entropy": lambda ds, at: ds[:1],
+    "LinearRegressionOutput": lambda ds, at: ds,
+    "MAERegressionOutput": lambda ds, at: ds,
+    "LogisticRegressionOutput": lambda ds, at: ds,
+}
+
 # Params auto-created as trainable variables when omitted at composition
 # time, and their deferred-shape rule given the first input's shape.
 _NORM_PARAM = lambda data_shape, attrs, axis=1: (data_shape[attrs.get("axis", axis) % len(data_shape)],)
@@ -598,6 +611,12 @@ def _make_sym_func(op_name):
                 v = var("%s_%s" % (nm, pname), attr=vattrs)
                 input_syms.append(v)
                 input_names.append(pname)
+        # auto-create the label variable for loss heads ({name}_label)
+        if not has_varargs and op_name in LABEL_SHAPE_RULES \
+                and "label" not in set(n for n in input_names if n):
+            v = var("%s_label" % nm)
+            input_syms.append(v)
+            input_names.append("label")
         # order inputs by declared order when names are known
         if input_names and all(n is not None for n in input_names) and not has_varargs:
             order = {n: i for i, n in enumerate(declared_inputs)}
@@ -702,6 +721,8 @@ def _infer_graph(nodes, known_shapes, known_dtypes, partial=False):
             if srcres is None and src.is_var():
                 # try deferred param shape rule
                 rule = PARAM_SHAPE_RULES.get(node.op, {}).get(pname)
+                if rule is None and pname == "label":
+                    rule = LABEL_SHAPE_RULES.get(node.op)
                 if rule is not None and data_spec is not None:
                     shp = rule(data_spec.shape, node.attrs)
                     dt = data_spec.dtype

@@ -1,0 +1,126 @@
+"""KVStore tests — mirrors tests/python/unittest/test_kvstore.py and the
+nightly dist_sync_kvstore.py exact-sum checks (SURVEY §4: multi-process
+collective tests runnable on one host → here, multi-device mesh on the
+virtual 8-device CPU backend)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import parallel
+
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(name="local"):
+    kv = kvs.create(name)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+@pytest.mark.parametrize("name", ["local", "device", "dist_tpu_sync"])
+def test_single_kv_pair(name):
+    kv = init_kv(name)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    out = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=out)
+    for o in out:
+        np.testing.assert_allclose(o.asnumpy(), np.full(SHAPE, 4.0))
+
+
+def test_aggregator():
+    """Multi-device push aggregates by sum (test_kvstore.py
+    test_aggregator): push a list of 'device' values for one key."""
+    kv = init_kv()
+    num_devs = 4
+    devs_vals = [mx.nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, devs_vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, num_devs))
+
+
+def test_updater_runs_on_store():
+    """update_on_kvstore: optimizer applied inside the store
+    (dist_sync_kvstore.py check_diff semantics)."""
+    kv = init_kv()
+    opt = mx.optimizer.create("test", rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 4.0))
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 8.0))
+
+
+def test_row_sparse_pull():
+    kv = init_kv()
+    kv.push(3, mx.nd.array(np.arange(16).reshape(4, 4).astype(np.float32)))
+    out = mx.nd.zeros(SHAPE)
+    row_ids = mx.nd.array([1, 3])
+    kv.row_sparse_pull(3, out=out, row_ids=row_ids)
+    expect = np.zeros(SHAPE, dtype=np.float32)
+    src = np.arange(16).reshape(4, 4)
+    expect[1] = src[1]
+    expect[3] = src[3]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_dist_async_rejected():
+    with pytest.raises(ValueError):
+        kvs.create("dist_async")
+
+
+def test_mesh_collectives_exact_sum():
+    """shard_map psum over the 8-device CPU mesh — the all-reduce that
+    backs dist_tpu_sync (exact-sum check as in dist_sync_kvstore.py:28)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    import jax.numpy as jnp
+
+    mesh = parallel.make_mesh({"dp": 8})
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+
+    def f(xs):
+        return parallel.all_reduce(xs, "dp")
+
+    g = shard_map(f, mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None))
+    out = np.asarray(jax.jit(g)(x))
+    expect = x.reshape(8, 1, 4).sum(axis=0)
+    for d in range(8):
+        np.testing.assert_allclose(out[d:d + 1], expect, rtol=1e-6)
+
+
+def test_kvstore_type_and_rank():
+    kv = kvs.create("dist_tpu_sync")
+    assert kv.type == "dist_tpu_sync"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.barrier()
+
+
+def test_optimizer_states_save_load(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    kv.push(3, mx.nd.ones(SHAPE))
+    p = str(tmp_path / "states")
+    kv.save_optimizer_states(p)
+    kv.load_optimizer_states(p)

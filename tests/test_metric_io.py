@@ -1,0 +1,209 @@
+"""Metric + IO + RecordIO tests (mirrors tests/python/unittest/test_metric.py
+and test_io.py strategies)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as mx_metric
+from mxnet_tpu import io as mx_io
+from mxnet_tpu import recordio
+
+
+# ------------------------------------------------------------- metric ---
+def test_accuracy():
+    m = mx_metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = mx_metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # both labels in top-2
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    for name, expect in [("mse", 0.25), ("mae", 0.5), ("rmse", 0.5)]:
+        m = mx_metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6, name
+
+
+def test_perplexity():
+    m = mx_metric.create("Perplexity", ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-4
+
+
+def test_f1_and_mcc():
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.1, 0.9], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 1, 1])
+    f1 = mx_metric.create("f1")
+    f1.update([label], [pred])
+    assert 0 < f1.get()[1] <= 1.0
+    mcc = mx_metric.create("mcc")
+    mcc.update([label], [pred])
+    assert -1.0 <= mcc.get()[1] <= 1.0
+
+
+def test_composite():
+    m = mx_metric.create(["acc", "mse"])
+    assert isinstance(m, mx_metric.CompositeEvalMetric)
+    names, _ = m.get()
+    assert "accuracy" in names and "mse" in names
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.sum(label))
+    m = mx_metric.np(feval)
+    m.update([mx.nd.array([1, 2])], [mx.nd.array([0, 0])])
+    assert abs(m.get()[1] - 3.0) < 1e-6
+
+
+# ---------------------------------------------------------------- io ----
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = mx_io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4  # ceil(10/3)
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    # reset and iterate again
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    it = mx_io.NDArrayIter(data, None, batch_size=3,
+                           last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(10).reshape(10, 1).astype(np.float32)
+    it = mx_io.NDArrayIter(data, None, batch_size=5, shuffle=True)
+    seen = []
+    for b in it:
+        seen.extend(b.data[0].asnumpy().ravel().tolist())
+    assert sorted(seen) == list(range(10))
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), dtype=np.float32)
+    base = mx_io.NDArrayIter(data, None, batch_size=2)
+    it = mx_io.ResizeIter(base, size=3)
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    base = mx_io.NDArrayIter(data, None, batch_size=2)
+    it = mx_io.PrefetchingIter(base)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (2, 2)
+        n += 1
+    assert n == 5
+
+
+def test_csv_iter(tmp_path):
+    p = tmp_path / "d.csv"
+    np.savetxt(p, np.arange(12).reshape(4, 3), delimiter=",")
+    it = mx_io.CSVIter(data_csv=str(p), data_shape=(3,), batch_size=2)
+    b = next(it)
+    assert b.data[0].shape == (2, 3)
+
+
+# ----------------------------------------------------------- recordio ---
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"record-%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == b"record-%d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    r.close()
+
+
+def test_pack_unpack_labels():
+    header = recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1.0, 2.0])
+    assert payload == b"payload"
+    assert h2.id == 7
+
+
+def test_image_record_iter(tmp_path):
+    # npy-payload fallback path (no PIL dependency needed)
+    path = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (10, 10, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img, img_fmt=".npy"))
+    w.close()
+    it = mx_io.ImageRecordIter(path_imgrec=path, path_imgidx=idx,
+                               data_shape=(3, 8, 8), batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 8, 8)
+    assert b.label[0].shape == (4,)
+
+
+def test_ndarray_iter_roll_over():
+    """roll_over withholds the tail and prepends it to the next epoch
+    (reference io.py semantics)."""
+    data = np.arange(10).reshape(10, 1).astype(np.float32)
+    it = mx_io.NDArrayIter(data, None, batch_size=4,
+                           last_batch_handle="roll_over")
+    ep1 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert ep1 == [[0, 1, 2, 3], [4, 5, 6, 7]]  # tail [8,9] cached
+    it.reset()
+    ep2 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert ep2[0] == [8, 9, 0, 1]  # cached tail + new head
+    assert all(len(b) == 4 for b in ep2)
+
+
+def test_ndarray_iter_pad_wraps_from_start():
+    data = np.arange(10).reshape(10, 1).astype(np.float32)
+    it = mx_io.NDArrayIter(data, None, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert batches[-1].pad == 2
+    assert batches[-1].data[0].asnumpy().ravel().tolist() == [8, 9, 0, 1]

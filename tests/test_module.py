@@ -1,0 +1,149 @@
+"""Module API tests — mirrors tests/python/train/test_mlp.py (small
+end-to-end fit asserting accuracy threshold) and unittest/test_module.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mx_io
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _two_blob_data(n=400, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    half = n // 2
+    x = np.concatenate([rng.randn(half, dim) + 1.5,
+                        rng.randn(half, dim) - 1.5]).astype(np.float32)
+    y = np.concatenate([np.zeros(half), np.ones(half)]).astype(np.float32)
+    order = rng.permutation(n)
+    return x[order], y[order]
+
+
+def _mlp_symbol(num_hidden=16, num_classes=2):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=num_hidden)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_fit_converges():
+    x, y = _two_blob_data()
+    train = mx_io.NDArrayIter(x[:320], y[:320], batch_size=32, shuffle=True)
+    val = mx_io.NDArrayIter(x[320:], y[320:], batch_size=32)
+    mod = Module(_mlp_symbol(), data_names=["data"],
+                 label_names=["softmax_label"])
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "rescale_grad": 1.0 / 32}, num_epoch=5)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_forward_shapes():
+    mod = Module(_mlp_symbol(), data_names=["data"],
+                 label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx_io.DataBatch(data=[mx.nd.zeros((8, 10))],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(8),
+                               rtol=1e-5)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _two_blob_data(n=64)
+    train = mx_io.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+
+    mod2 = Module.load(prefix, 1)
+    mod2.bind(data_shapes=[("data", (16, 10))],
+              label_shapes=[("softmax_label", (16,))], for_training=False)
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-5)
+    batch = mx_io.DataBatch(data=[mx.nd.array(x[:16])],
+                            label=[mx.nd.array(y[:16])])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-4)
+
+
+def test_module_update_on_kvstore():
+    x, y = _two_blob_data(n=64)
+    train = mx_io.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol())
+    kv = mx.kvstore.create("device")
+    mod.fit(train, num_epoch=2, kvstore=kv,
+            optimizer_params={"learning_rate": 0.5, "rescale_grad": 1.0 / 32})
+    score = mod.score(mx_io.NDArrayIter(x, y, batch_size=16), "acc")
+    assert score[0][1] > 0.8, score
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    x, y = _two_blob_data(n=64)
+    train = mx_io.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    p = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(p)
+    mod.load_optimizer_states(p)
+
+
+def test_bucketing_module():
+    # variable-length sequences via buckets (BucketingModule semantics)
+    def sym_gen(seq_len):
+        # params must be bucket-invariant: reduce over the variable axis
+        data = mx.sym.Variable("data")
+        pooled = mx.sym.sum(data, axis=1, keepdims=True)
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params=(("learning_rate", 0.1),))
+
+    for key, dim in [(8, 8), (4, 4), (8, 8)]:
+        batch = mx_io.DataBatch(
+            data=[mx.nd.zeros((4, dim))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[("data", (4, dim))],
+            provide_label=[("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_sequential_module():
+    from mxnet_tpu.module import SequentialModule
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                 num_hidden=8)
+    net2 = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("fc1_output"), name="fc2", num_hidden=2),
+        name="softmax")
+    mod = SequentialModule()
+    mod.add(Module(net1, label_names=[])) \
+       .add(Module(net2, data_names=["fc1_output"]),
+            take_labels=True, auto_wiring=True)
+    x, y = _two_blob_data(n=64)
+    train = mx_io.NDArrayIter(x, y, batch_size=16)
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.5, "rescale_grad": 1.0 / 32})
+    score = mod.score(mx_io.NDArrayIter(x, y, batch_size=16), "acc")
+    assert score[0][1] > 0.8, score
