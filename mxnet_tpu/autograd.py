@@ -204,6 +204,7 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
             leaf._grad._data = leaf._grad._data + g.astype(leaf._grad.dtype)
         elif leaf._grad_req == "write":
             leaf._grad._data = g.astype(leaf._grad.dtype)
+        leaf._fresh_grad = True  # consumed by Trainer stale-grad detection
 
     if not retain_graph:
         tape.clear()

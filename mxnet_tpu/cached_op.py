@@ -1,0 +1,124 @@
+"""CachedOp — compiled trace for Gluon hybridize.
+
+Reference: src/imperative/cached_op.{cc,h} (CachedOp::Forward:904,
+DynamicForward:815, StaticForward:742, Backward:1128) — there, the traced
+graph is replayed through the dependency engine with optional
+static_alloc/static_shape memory planning.
+
+TPU-native design: the traced Symbol is lowered to ONE jit-compiled XLA
+computation per (is_train, shapes, dtypes, diff-set) signature via
+executor.build_graph_fn. XLA subsumes static_alloc/static_shape (buffer
+assignment), op bulking (fusion) and the backward-graph pass (jax.vjp).
+Autograd integration records a single tape node whose pullback is the
+compiled transpose of the whole computation — the reference's
+CachedOp::Backward analogue.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from . import random as _random
+from .base import MXNetError
+from .executor import build_graph_fn
+
+
+class CachedOp:
+    """Compiled callable over a Symbol.
+
+    ``__call__(*inputs)`` takes NDArrays ordered as ``sym.list_inputs()``
+    (arguments and auxiliary states in declaration order), mirroring
+    MXInvokeCachedOp (src/c_api/c_api_ndarray.cc:192). Auxiliary states
+    (e.g. BatchNorm running stats) are updated in place on the passed
+    NDArrays after each call.
+    """
+
+    def __init__(self, sym, flags=()):
+        self._sym = sym
+        self._flags = dict(flags) if flags else {}
+        self._arg_names = sym.list_arguments()
+        self._aux_names = sym.list_auxiliary_states()
+        self._input_names = sym.list_inputs()
+        self._num_outputs = len(sym.list_outputs())
+        self._fns = {}  # (is_train, diff_names) -> jitted fn
+
+    @property
+    def symbol(self):
+        return self._sym
+
+    # ------------------------------------------------------------------
+    def _get_fn(self, is_train, diff_names):
+        key = (is_train, diff_names)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        graph_fn = build_graph_fn(self._sym, is_train=is_train)
+
+        if diff_names:
+            def pure(diff_list, rest, aux, rng_key):
+                full = dict(rest)
+                full.update(zip(diff_names, diff_list))
+                outs, aux_up = graph_fn(full, aux, rng_key)
+                return tuple(outs), aux_up
+            fn = jax.jit(pure)
+        else:
+            def pure(args, aux, rng_key):
+                outs, aux_up = graph_fn(args, aux, rng_key)
+                return tuple(outs), aux_up
+            fn = jax.jit(pure)
+        self._fns[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def __call__(self, *inputs):
+        from . import ndarray as nd
+
+        if len(inputs) != len(self._input_names):
+            raise MXNetError(
+                "CachedOp expects %d inputs (%s), got %d"
+                % (len(self._input_names), self._input_names, len(inputs)))
+        by_name = dict(zip(self._input_names, inputs))
+        args = {n: by_name[n]._data for n in self._arg_names}
+        aux = {n: by_name[n]._data for n in self._aux_names}
+        rng_key = _random.next_key()
+        is_train = autograd.is_training()
+        recording = autograd.is_recording()
+
+        diff_names = tuple(
+            n for n in self._arg_names
+            if recording and by_name[n]._requires_tape())
+
+        ctx = inputs[0]._ctx if inputs else None
+
+        if diff_names:
+            fn = self._get_fn(is_train, diff_names)
+            diff_list = [args[n] for n in diff_names]
+            outs, vjp_fn, aux_up = jax.vjp(
+                lambda d: fn(d, args, aux, rng_key), diff_list, has_aux=True)
+
+            diff_nds = [by_name[n] for n in diff_names]
+
+            def tape_vjp(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                (grads,) = vjp_fn(cts_t)
+                return grads
+
+            node = autograd.TapeNode(
+                tape_vjp, diff_nds, len(outs),
+                [tuple(o.shape) for o in outs], [o.dtype for o in outs],
+                op_name="CachedOp")
+            autograd._record_node(node)
+            results = []
+            for k, o in enumerate(outs):
+                r = nd.NDArray(o, ctx)
+                r._ag_node = (node, k)
+                results.append(r)
+        else:
+            fn = self._get_fn(is_train, ())
+            outs, aux_up = fn(args, aux, rng_key)
+            results = [nd.NDArray(o, ctx) for o in outs]
+
+        for name, val in aux_up.items():
+            by_name[name]._data = val
+
+        return results

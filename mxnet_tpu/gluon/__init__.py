@@ -1,0 +1,24 @@
+"""Gluon — the imperative / hybrid frontend.
+
+Reference: python/mxnet/gluon/ (Block/HybridBlock in block.py, Parameter in
+parameter.py, Trainer, losses, nn/rnn layers, data pipeline, model_zoo).
+"""
+
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+
+from . import trainer
+from .trainer import Trainer
+
+from . import utils
+from .utils import split_data, split_and_load, clip_global_norm
+
+from . import nn
+from . import loss
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib

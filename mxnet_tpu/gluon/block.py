@@ -1,0 +1,624 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: python/mxnet/gluon/block.py (Block:131, HybridBlock:705,
+SymbolBlock:992; hybridize -> _build_cache:786 -> CachedOp:823).
+
+TPU-native design: ``hybridize()`` traces ``hybrid_forward`` with Symbol
+proxies (exactly like the reference) and wraps the traced graph in a
+CachedOp whose execution is ONE jit-compiled XLA computation
+(mxnet_tpu/cached_op.py) — the natural TPU realization of the reference's
+static_alloc/static_shape fast path, with XLA doing memory planning and
+fusion instead of MXPlanMemory/bulking.
+"""
+
+import copy
+import re
+import threading
+
+from .. import autograd
+from .. import ndarray as nd
+from .. import symbol as _symbol
+from ..base import MXNetError
+from ..cached_op import CachedOp
+from ..context import current_context
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_naming = threading.local()
+
+
+def _global_counter():
+    if not hasattr(_naming, "counter"):
+        _naming.counter = {}
+    return _naming.counter
+
+
+class _BlockScope(object):
+    """Name-manager scope for nested Blocks (gluon/block.py:35)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Creates prefix and params for new `Block`."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                counter = _global_counter()
+                count = counter.get(hint, 0)
+                counter[hint] = count + 1
+                prefix = "%s%d_" % (hint, count)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, fmt_name):
+    """Flatten nested list/tuple structure of NDArrays/Symbols; returns
+    (flat_list, format_tree) (gluon/block.py:53)."""
+    if isinstance(args, (nd.NDArray, _symbol.Symbol)):
+        return [args], int(0)
+    if args is None:
+        return [None], int(-1)
+    if not isinstance(args, (list, tuple)):
+        raise ValueError(
+            "When hybridized, the input of HybridBlock {} must be (nested) "
+            "list of Symbol or NDArray, but got {} of type {}"
+            .format(fmt_name, str(args), str(type(args))))
+    flat, fmts = [], []
+    for i in args:
+        arg, fmt = _flatten(i, fmt_name)
+        flat += arg
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == -1:
+            return None, args[1:]
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block(object):
+    """Base class for all neural network layers and models
+    (python/mxnet/gluon/block.py:131).
+
+    Childs and Parameters set as attributes are registered automatically;
+    ``collect_params()`` returns the full ParameterDict of the subtree.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=key, block=re.sub("\n", "\n  ", repr(block)))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise TypeError(
+                    "Changing attribute type for {name} from {type1} to "
+                    "{type2} is not allowed.".format(
+                        name=name, type1=type(existing), type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed. " \
+                "If you want to share parameters between blocks, please set " \
+                "'params' at Block construction instead." % name
+            self._reg_params[name] = value
+        super(Block, self).__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # ---------------------------------------------------------- naming --
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """Returns a name space object managing a child Block and parameter
+        names."""
+        return self._scope
+
+    @property
+    def params(self):
+        """Returns this Block's parameter dictionary (does not include its
+        children's parameters)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """Returns a ParameterDict containing this Block's and all of its
+        children's Parameters, optionally filtered by regex ``select``."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # ---------------------------------------------------------- children --
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def apply(self, fn):
+        """Applies ``fn`` recursively to every child block as well as self."""
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    # -------------------------------------------------------------- io --
+    def save_parameters(self, filename, deduplicate=False):
+        """Saves parameters to file using structural naming
+        (gluon/block.py:319)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._reduce() if hasattr(val, "_reduce")
+                    else val.data() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """Loads parameters from file (gluon/block.py:361). Accepts both
+        structural-name files (save_parameters) and full-name files
+        (collect_params().save)."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in k for k in loaded.keys()):
+            # contains full parameter names — legacy collect_params().save
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s'" % (name, filename)
+        for name in loaded:
+            if name not in params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "this block" % (name, filename)
+                continue
+            params[name]._load_init(loaded[name], ctx, cast_dtype=cast_dtype,
+                                    dtype_source=dtype_source)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # ------------------------------------------------------------- init --
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Initializes Parameters of this Block and its children."""
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """Activates or deactivates HybridBlock children recursively."""
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    # ------------------------------------------------------------- call --
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        """Overridden by users: imperative computation over NDArray."""
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a table of layer outputs and params for given inputs."""
+        summary = []
+        hooks = []
+
+        def _register(block):
+            def hook(blk, ins, outs):
+                n_params = sum(
+                    int(p.data().size) for p in blk.params.values()
+                    if p._data is not None)
+                first = outs[0] if isinstance(outs, (list, tuple)) else outs
+                summary.append((blk.name, type(blk).__name__,
+                                getattr(first, "shape", None), n_params))
+            hooks.append(block.register_forward_hook(hook))
+
+        self.apply(_register)
+        try:
+            self(*inputs)
+            lines = ["%-30s %-20s %-20s %10s" %
+                     ("Layer (name)", "Type", "Output Shape", "Params")]
+            lines.append("-" * 84)
+            total = 0
+            for name, tname, shape, n in summary:
+                total += n
+                lines.append("%-30s %-20s %-20s %10d"
+                             % (name, tname, str(shape), n))
+            lines.append("-" * 84)
+            lines.append("Total params: %d" % total)
+            print("\n".join(lines))
+        finally:
+            def _clean(blk):
+                blk._forward_hooks = [h for h in blk._forward_hooks
+                                      if h not in hooks]
+            self.apply(_clean)
+
+
+class HybridBlock(Block):
+    """A Block that supports hybridization: forwarding with NDArray or
+    Symbol, and compilation of the traced graph via CachedOp
+    (python/mxnet/gluon/block.py:705)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridBlock, self).__init__(prefix=prefix, params=params)
+        self._cached_graph = ()
+        self._cached_op = None
+        self._cached_op_args = []
+        self._active = False
+        self._flags = []
+
+    def __setattr__(self, name, value):
+        super(HybridBlock, self).__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s. If you are using Sequential, please try "
+                "HybridSequential instead." % (str(block), str(type(block))))
+        super(HybridBlock, self).register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = list(kwargs.items())
+        self._clear_cached_op()
+        super(HybridBlock, self).hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super(HybridBlock, self).cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_op = None
+        self._cached_op_args = []
+
+    # ------------------------------------------------------------ trace --
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args, "input")
+            real = [a for a in flat_args if a is not None]
+            if len(real) == 1:
+                syms = [_symbol.var("data")]
+            else:
+                syms = [_symbol.var("data%d" % i) for i in range(len(real))]
+            it = iter(syms)
+            grouped = [next(it) if a is not None else None for a in flat_args]
+            grouped_args, _ = _regroup(grouped, self._in_format)
+            if not isinstance(grouped_args, (list, tuple)):
+                grouped_args = [grouped_args]
+            params = {name: p.var() for name, p in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(_symbol, *grouped_args, **params)
+            flat_out, self._out_format = _flatten(out, "output")
+            if len(flat_out) > 1:
+                self._cached_graph = (syms, _symbol.Group(flat_out))
+            else:
+                self._cached_graph = (syms, flat_out[0])
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        """Infers shape of Parameters from inputs."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            inputs, out = self._get_graph(*args)
+            flat_args, _ = _flatten(args, "input")
+            real = [a for a in flat_args if a is not None]
+            kwargs = {i.name: a.shape for i, a in zip(inputs, real)}
+            arg_shapes, _, aux_shapes = out.infer_shape_partial(**kwargs)
+            sdict = dict(zip(out.list_arguments(), arg_shapes))
+            sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
+            for name, param in self.collect_params().items():
+                shp = sdict.get(name)
+                if shp is not None:
+                    param.shape = shp
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred: %s" % e)
+
+    # ------------------------------------------------------------ cache --
+    def _build_cache(self, *args):
+        inputs, out = self._get_graph(*args)
+        input_names = out.list_inputs()
+        params = {p.name: p for p in self.collect_params().values()}
+        param_names = set(params.keys())
+        expected_names = set(input_names)
+        for name in expected_names:
+            assert name in param_names or name in [i.name for i in inputs], \
+                "Unknown input to HybridBlock: %s" % name
+
+        data_names = {i.name: idx for idx, i in enumerate(inputs)}
+        self._cached_op_args = []
+        for name in input_names:
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                self._cached_op_args.append((False, params[name]))
+        self._cached_op = CachedOp(out, self._flags)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._build_cache(*args)
+        flat_args, fmt = _flatten(args, "input")
+        real = [a for a in flat_args if a is not None]
+        cargs = []
+        for is_data, data in self._cached_op_args:
+            if is_data:
+                cargs.append(real[data])
+            else:
+                cargs.append(data.data())
+        out = self._cached_op(*cargs)
+        if len(out) == 1 and self._out_format == 0:
+            return out[0]
+        ret, _ = _regroup(list(out), self._out_format)
+        return ret
+
+    # ---------------------------------------------------------- forward --
+    def forward(self, x, *args):
+        """Defines the forward computation; dispatches to
+        ``hybrid_forward`` with F=ndarray or F=symbol."""
+        if isinstance(x, nd.NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for p in self.collect_params().values():
+                        p._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {name: p.data()
+                          for name, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for p in self.collect_params().values():
+                    p._finish_deferred_init()
+                params = {name: p.data()
+                          for name, p in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **params)
+
+        assert isinstance(x, _symbol.Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {name: p.var() for name, p in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(_symbol, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Overridden by users: computation over ``F`` (mx.nd or mx.sym)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ export --
+    def export(self, path, epoch=0):
+        """Exports traced symbol + params for deployment
+        (gluon/block.py:907): path-symbol.json and path-NNNN.params."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save("%s-symbol.json" % path)
+
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                arg_dict["arg:%s" % name] = param.data()
+            elif name in aux_names:
+                arg_dict["aux:%s" % name] = param.data()
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+        return sym
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a Block from a Symbol (gluon/block.py:992) — the importer
+    for exported models."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = _symbol.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_symbol.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx, cast_dtype=True,
+                                      dtype_source="saved",
+                                      allow_missing=False, ignore_extra=False)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super(SymbolBlock, self).__init__(prefix=None, params=None)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = _symbol.Group(outputs)
+        if isinstance(inputs, _symbol.Symbol):
+            inputs = [inputs]
+
+        syms, self._in_format = _flatten(inputs, "input")
+        out = outputs
+        input_names = set(s.name for s in syms)
+
+        for name in out.list_arguments():
+            if name not in input_names:
+                p = self._params.get(name, allow_deferred_init=True)
+                self._reg_params[name] = p
+        for name in out.list_auxiliary_states():
+            if name not in input_names:
+                p = self._params.get(name, grad_req="null",
+                                     allow_deferred_init=True)
+                self._reg_params[name] = p
+
+        self._cached_graph = syms, out
+        self._build_cache_from_graph()
+
+    def _build_cache_from_graph(self):
+        inputs, out = self._cached_graph
+        input_names = out.list_inputs()
+        params = {p.name: p for p in self._params.values()}
+        data_names = {i.name: idx for idx, i in enumerate(inputs)}
+        self._cached_op_args = []
+        for name in input_names:
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                self._cached_op_args.append((False, params[name]))
+        self._cached_op = CachedOp(out, self._flags)
+        self._out_format = _flatten(
+            [out] if len(out.list_outputs()) == 1 else
+            [out[i] for i in range(len(out.list_outputs()))], "output")[1]
+        if len(out.list_outputs()) == 1:
+            self._out_format = 0
+
+    def forward(self, x, *args):
+        if isinstance(x, nd.NDArray):
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for p in self._params.values():
+                    p._finish_deferred_init()
+                return self._call_cached_op(x, *args)
+        assert isinstance(x, _symbol.Symbol), \
+            "SymbolBlock requires Symbol or NDArray input"
+        return self._cached_graph[1]
+
+    def _call_cached_op(self, *args):
+        flat_args, _ = _flatten(args, "input")
+        real = [a for a in flat_args if a is not None]
+        cargs = []
+        for is_data, data in self._cached_op_args:
+            if is_data:
+                cargs.append(real[data])
+            else:
+                cargs.append(data.data())
+        out = self._cached_op(*cargs)
+        if len(out) == 1:
+            return out[0]
+        return list(out)
+
+    def _clear_cached_op(self):
+        tmp = getattr(self, "_cached_graph", ())
+        super(SymbolBlock, self)._clear_cached_op()
+        self._cached_graph = tmp
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,444 @@
+"""Gluon basic layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py:34-758 (Sequential,
+HybridSequential, Dense, Dropout, Embedding, BatchNorm, InstanceNorm,
+LayerNorm, GroupNorm, Flatten, Lambda, HybridLambda).
+
+TPU notes: BatchNorm's running-stat update is expressed functionally — the
+op returns batch stats and the layer (eager) or the graph executor
+(hybridized, executor.build_graph_fn BatchNorm clause) folds the momentum
+update, instead of the reference's in-kernel aux mutation
+(src/operator/nn/batch_norm.cc).
+"""
+
+from ... import autograd
+from ... import initializer as init
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+           "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
+           "Lambda", "HybridLambda"]
+
+
+class Sequential(Block):
+    """Stacks Blocks sequentially (gluon/nn/basic_layers.py:34)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(Sequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(key=key, block=repr(block).replace("\n", "\n  "))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._children.values()):
+            import warnings
+            warnings.warn(
+                "All children of this Sequential layer '%s' are HybridBlocks. "
+                "Consider using HybridSequential for the best performance."
+                % self.prefix, stacklevel=2)
+        super(Sequential, self).hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Stacks HybridBlocks sequentially (gluon/nn/basic_layers.py:117)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridSequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(key=key, block=repr(block).replace("\n", "\n  "))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Densely-connected layer: out = act(dot(x, W^T) + b)
+    (gluon/nn/basic_layers.py:167). The matmul maps straight onto the MXU."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super(Dense, self).__init__(**kwargs)
+        self._flatten = flatten
+        with self.name_scope():
+            self._units = units
+            self._in_units = in_units
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        act = F.FullyConnected(x, weight, bias, no_bias=bias is None,
+                               num_hidden=self._units, flatten=self._flatten,
+                               name="fwd")
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def __repr__(self):
+        s = "{name}({layout}, {act})"
+        shape = self.weight.shape
+        return s.format(name=self.__class__.__name__,
+                        act=self.act if self.act else "linear",
+                        layout="{0} -> {1}".format(
+                            shape[1] if shape[1] else None, shape[0]))
+
+
+class Dropout(HybridBlock):
+    """Dropout regularization (gluon/nn/basic_layers.py:237). Uses the
+    counter-based threefry RNG — inside a CachedOp trace the key is a real
+    computation input, so compiled dropout stays fresh per step."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super(Dropout, self).__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        if self._rate > 0:
+            return F.Dropout(x, p=self._rate, axes=self._axes, name="fwd",
+                             cudnn_off=False)
+        return F._copy(x)
+
+    def __repr__(self):
+        s = "{name}(p = {_rate}, axes={_axes})"
+        return s.format(name=self.__class__.__name__, **self.__dict__)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (gluon/nn/basic_layers.py:291)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super(BatchNorm, self).__init__(**kwargs)
+        self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats}
+        if in_channels != 0:
+            self.in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True, differentiable=center)
+            self.running_mean = self.params.get(
+                "running_mean", grad_req="null", shape=(in_channels,),
+                init=running_mean_initializer, allow_deferred_init=True,
+                differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", grad_req="null", shape=(in_channels,),
+                init=running_variance_initializer, allow_deferred_init=True,
+                differentiable=False)
+
+    def cast(self, dtype):
+        if dtype in ("float16", "bfloat16"):
+            dtype = "float32"  # stats stay fp32 (reference: BN runs fp32)
+        super(BatchNorm, self).cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        if F is nd and autograd.is_training() \
+                and not self._kwargs["use_global_stats"]:
+            out, mean, var = F.BatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                output_mean_var=True, name="fwd", **self._kwargs)
+            with autograd.pause():
+                mom = self._kwargs["momentum"]
+                running_mean._data = (mom * running_mean._data +
+                                      (1 - mom) * mean._data)
+                running_var._data = (mom * running_var._data +
+                                     (1 - mom) * var._data)
+            return out
+        return F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                           name="fwd", **self._kwargs)
+
+    def __repr__(self):
+        s = "{name}({content}"
+        in_channels = self.gamma.shape[0]
+        s += ", in_channels={0}".format(in_channels if in_channels else None)
+        s += ")"
+        return s.format(name=self.__class__.__name__,
+                        content=", ".join(
+                            "=".join([k, v.__repr__()])
+                            for k, v in self._kwargs.items()))
+
+
+class Embedding(HybridBlock):
+    """Turns non-negative integers into dense vectors
+    (gluon/nn/basic_layers.py:397). On TPU this is a one-hot matmul /
+    gather chosen by XLA."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super(Embedding, self).__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": sparse_grad}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, name="fwd", **self._kwargs)
+
+    def __repr__(self):
+        s = "{block_name}({input_dim} -> {output_dim}, {dtype})"
+        return s.format(block_name=self.__class__.__name__, **self._kwargs)
+
+
+class Flatten(HybridBlock):
+    """Flattens the input to (batch, -1) (gluon/nn/basic_layers.py:459)."""
+
+    def __init__(self, **kwargs):
+        super(Flatten, self).__init__(**kwargs)
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+    def __repr__(self):
+        return self.__class__.__name__
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (gluon/nn/basic_layers.py:480)."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super(InstanceNorm, self).__init__(**kwargs)
+        self._kwargs = {"eps": epsilon}
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        if self._axis == 1:
+            return F.InstanceNorm(x, gamma, beta, name="fwd", eps=self._epsilon)
+        x = x.swapaxes(1, self._axis)
+        return F.InstanceNorm(x, gamma, beta, name="fwd",
+                              eps=self._epsilon).swapaxes(1, self._axis)
+
+    def __repr__(self):
+        s = "{name}({content}"
+        in_channels = self.gamma.shape[0]
+        s += ", in_channels={0}".format(in_channels)
+        s += ")"
+        return s.format(name=self.__class__.__name__,
+                        content=", ".join(
+                            "=".join([k, v.__repr__()])
+                            for k, v in self._kwargs.items()))
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization over the last (or given) axis
+    (gluon/nn/basic_layers.py:563)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super(LayerNorm, self).__init__(prefix=prefix, params=params)
+        self._kwargs = {"eps": epsilon, "axis": axis}
+        self._axis = axis
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True)
+
+    def hybrid_forward(self, F, data, gamma, beta):
+        return F.LayerNorm(data, gamma=gamma, beta=beta, axis=self._axis,
+                           eps=self._epsilon)
+
+    def __repr__(self):
+        s = "{name}({content}"
+        in_channels = self.gamma.shape[0]
+        s += ", in_channels={0}".format(in_channels)
+        s += ")"
+        return s.format(name=self.__class__.__name__,
+                        content=", ".join(
+                            "=".join([k, v.__repr__()])
+                            for k, v in self._kwargs.items()))
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization (gluon/nn/basic_layers.py:657)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super(GroupNorm, self).__init__(prefix=prefix, params=params)
+        self._kwargs = {"eps": epsilon, "num_groups": num_groups}
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True)
+
+    def hybrid_forward(self, F, data, gamma, beta):
+        return F.GroupNorm(data, gamma=gamma, beta=beta,
+                           num_groups=self._num_groups, eps=self._epsilon)
+
+    def __repr__(self):
+        s = "{name}({content})"
+        return s.format(name=self.__class__.__name__,
+                        content=", ".join(
+                            "=".join([k, v.__repr__()])
+                            for k, v in self._kwargs.items()))
+
+
+class Lambda(Block):
+    """Wraps a function as a Block (gluon/nn/basic_layers.py:727)."""
+
+    def __init__(self, function, prefix=None):
+        super(Lambda, self).__init__(prefix=prefix)
+        if isinstance(function, str):
+            assert hasattr(nd, function), \
+                "Function name %s is not found in ndarray." % function
+            self._func_impl = getattr(nd, function)
+            self._func_name = function
+        elif callable(function):
+            self._func_impl = function
+            self._func_name = function.__name__
+        else:
+            raise ValueError(
+                "Unrecognized function in lambda: {} of type {}"
+                .format(function, type(function)))
+
+    def forward(self, *args):
+        return self._func_impl(*args)
+
+    def __repr__(self):
+        return "{name}({function})".format(name=self.__class__.__name__,
+                                           function=self._func_name)
+
+
+class HybridLambda(HybridBlock):
+    """Wraps a function as a HybridBlock (gluon/nn/basic_layers.py:758)."""
+
+    def __init__(self, function, prefix=None):
+        super(HybridLambda, self).__init__(prefix=prefix)
+        from ... import symbol as sym
+        if isinstance(function, str):
+            assert hasattr(nd, function) or hasattr(sym, function), \
+                "Function name %s is not found in symbol/ndarray." % function
+            func_dict = {sym: getattr(sym, function, None),
+                         nd: getattr(nd, function, None)}
+            self._func = func_dict
+            self._func_name = function
+        elif callable(function):
+            self._func = function
+            self._func_name = function.__name__
+        else:
+            raise ValueError(
+                "Unrecognized function in lambda: {} of type {}"
+                .format(function, type(function)))
+
+    def hybrid_forward(self, F, x, *args):
+        if isinstance(self._func, dict):
+            return self._func[F](x, *args)
+        return self._func(F, x, *args)
+
+    def __repr__(self):
+        return "{name}({function})".format(name=self.__class__.__name__,
+                                           function=self._func_name)
+
+
+from .activations import Activation  # noqa: E402  (Dense uses it)

@@ -1,0 +1,498 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (Parameter, Constant,
+ParameterDict; 1029 LoC). TPU-native notes: a Parameter holds ONE global
+NDArray — multi-device placement is expressed by a jax.sharding
+PartitionSpec on that array (set via ``Parameter.shard_spec``), not by
+per-context copies, so ``list_data`` returns a single element. Deferred
+initialization (shape inferred at first forward) is preserved.
+"""
+
+import re
+import threading
+
+import numpy as np
+
+from .. import autograd
+from .. import initializer
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import current_context, Context
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (nd.NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization
+    (python/mxnet/gluon/parameter.py:36)."""
+    pass
+
+
+class Parameter(object):
+    """A Container holding parameters (weights) of Blocks
+    (python/mxnet/gluon/parameter.py:42).
+
+    Parameters
+    ----------
+    name : str
+    grad_req : {'write', 'add', 'null'}
+    shape : tuple, elements may be 0/-1 (unknown, inferred at first forward)
+    dtype : numpy dtype or str
+    lr_mult / wd_mult : float
+    init : Initializer
+    allow_deferred_init : bool
+    differentiable : bool
+    stype / grad_stype : {'default', 'row_sparse', 'csr'}
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        self._shape = tuple(shape) if shape is not None else None
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self.grad_req = grad_req
+        # sharding annotation for multi-device (TPU extension): a
+        # jax.sharding.PartitionSpec applied when a mesh is active
+        self.shard_spec = None
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    # ------------------------------------------------------- properties --
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("grad_req must be write/add/null, got %s" % req)
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, -1) or s1 == s2
+            for s1, s2 in zip(self._shape, new_shape)) \
+            and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise AssertionError(
+                "Expected shape %s is incompatible with given shape %s for "
+                "Parameter %s" % (str(new_shape), str(self._shape), self.name))
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
+    # ---------------------------------------------------------- helpers --
+    def _shape_known(self):
+        return (self._shape is not None and len(self._shape) > 0 and
+                all(s > 0 for s in self._shape))
+
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters."
+                % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with Block.collect_params() "
+            "instead of Block.params" % self.name)
+
+    def _init_impl(self, data):
+        self._data = data if isinstance(data, nd.NDArray) else nd.array(data)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype)
+        self._data.attach_grad(self._grad_req)
+        self._data._grad = self._grad
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if not self._shape_known():
+            raise MXNetError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self._shape)))
+        with autograd.pause():
+            if data is None:
+                import json as _json
+                data = nd.zeros(self._shape, dtype=self._dtype)
+                if init is None:
+                    init_str = ""
+                elif isinstance(init, str):
+                    init_str = _json.dumps([init, {}])
+                else:
+                    init_str = init.dumps()
+                initializer.create(default_init)(
+                    initializer.InitDesc(self.name, {"__init__": init_str}),
+                    data)
+            self._init_impl(data)
+
+    # -------------------------------------------------------------- API --
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter and gradient arrays
+        (python/mxnet/gluon/parameter.py:337)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            import warnings
+            warnings.warn("Parameter '%s' is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize." % self.name)
+            return
+        self._data = self._grad = None
+        if init is None:
+            init = self.init
+        if not self._shape_known():
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s. Please specify in_units, in_channels, etc for "
+                "`Block`s." % (self.name, str(self._shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source="current"):
+        """Initialize from loaded data (used by load_parameters)."""
+        if cast_dtype and dtype_source == "current" and self._dtype is not None:
+            data = data.astype(self._dtype)
+        else:
+            self._dtype = data.dtype
+        if self._shape is not None and self._shape_known():
+            if tuple(self.shape) != tuple(data.shape):
+                raise AssertionError(
+                    "Failed loading Parameter '%s' from saved params: shape "
+                    "incompatible expected %s vs saved %s"
+                    % (self.name, str(self.shape), str(data.shape)))
+        else:
+            self._shape = tuple(data.shape)
+        self._deferred_init = ()
+        self._init_impl(data)
+
+    def set_data(self, data):
+        """Sets this parameter's value on all contexts."""
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init:
+                init, ctx, default_init, _ = self._deferred_init
+                self._deferred_init = (init, ctx, default_init, data)
+                return
+            raise AssertionError(
+                "Parameter '%s' has not been initialized" % self.name)
+        self._data._data = data._data if isinstance(data, nd.NDArray) \
+            else np.asarray(data)
+
+    def data(self, ctx=None):
+        """Returns a copy of this parameter on one context — here the single
+        global (possibly sharded) array."""
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        self._check_initialized()
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad._data = nd.zeros(self._grad.shape,
+                                    dtype=self._grad.dtype)._data
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return [self._deferred_init[1] or current_context()]
+        self._check_initialized()
+        return [self._data.context]
+
+    def reset_ctx(self, ctx):
+        pass  # single global array; placement is via shard_spec
+
+    def var(self):
+        """Returns the symbol representing this parameter."""
+        from .. import symbol
+        if self._var is None:
+            # only bake the shape into the variable once fully known —
+            # partial shapes (zeros) would defeat deferred shape inference
+            shape = self.shape if self._shape_known() else None
+            self._var = symbol.var(self.name, shape=shape,
+                                   dtype=self._dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult,
+                                   init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self._dtype = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = self._data.astype(self._dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(self._dtype)
+                self._data.attach_grad(self._grad_req)
+                self._data._grad = self._grad
+
+
+class Constant(Parameter):
+    """A constant parameter for holding non-differentiable values
+    (python/mxnet/gluon/parameter.py:653)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                arr[:] = value._data
+        init_name = "Constant_{}_{}".format(name, id(self))
+        initializer._INIT_REGISTRY[init_name.lower()] = Init
+        super(Constant, self).__init__(
+            name, grad_req="null", shape=value.shape, dtype=value.dtype,
+            init=init_name)
+
+
+class ParameterDict(object):
+    """A dictionary managing a set of parameters
+    (python/mxnet/gluon/parameter.py:703)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(
+            name=name,
+            content="\n".join("  " + repr(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieves or creates a ``Parameter`` named ``self.prefix+name``.
+        Matches the reference's attribute-compatibility rule
+        (gluon/parameter.py ParameterDict.get): existing attributes must be
+        compatible with the requested ones, partial shapes unify."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            private = {"differentiable": "_differentiable",
+                       "allow_deferred_init": "_allow_deferred_init"}
+            for k, v in kwargs.items():
+                if v is None:
+                    continue
+                attr = private.get(k, k)
+                existing = getattr(param, attr, None)
+                if k in private:
+                    # construction-time flags: must simply agree
+                    if existing != v:
+                        raise AssertionError(
+                            "Cannot retrieve Parameter '%s' because desired "
+                            "attribute does not match with stored for "
+                            "attribute '%s': desired '%s' vs stored '%s'."
+                            % (name, k, str(v), str(existing)))
+                    continue
+                if existing is None:
+                    setattr(param, k, v)
+                    continue
+                if k == "shape" and len(v) == len(existing):
+                    # unify: 0/-1 dims are wildcards on either side
+                    if all(sv in (0, -1) or ev in (0, -1) or sv == ev
+                           for sv, ev in zip(v, existing)):
+                        param._shape = tuple(
+                            ev if sv in (0, -1) else sv
+                            for sv, ev in zip(v, existing))
+                        continue
+                elif k == "init" or existing == v:
+                    continue
+                raise AssertionError(
+                    "Cannot retrieve Parameter '%s' because desired "
+                    "attribute does not match with stored for attribute "
+                    "'%s': desired '%s' vs stored '%s'."
+                    % (name, k, str(v), str(existing)))
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    "No constant named '{}'. Please specify value if you want "
+                    "to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            if not isinstance(param, Constant):
+                raise TypeError("Parameter '{}' already exists but is not a "
+                                "constant.".format(name))
+        return param
+
+    def update(self, other):
+        """Copies all Parameters in ``other`` to self."""
+        for k, v in other.items():
+            if k in self._params:
+                if self._params[k] is not v:
+                    raise ValueError(
+                        "Cannot update self with other because they have "
+                        "different Parameters with the same name '%s'" % k)
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with it"
+                    % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not " \
+                    "start with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" \
+                    % (name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
+                                  dtype_source=dtype_source)

@@ -1,0 +1,177 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py:27 (step:305,
+_allreduce_grads:356, _update:399). Applies an Optimizer to a set of
+Parameters; gradient aggregation across data-parallel devices goes through
+the KVStore layer, which on this build is XLA collectives over the active
+device mesh (the reference's engine-priority comm/compute overlap is
+subsumed by XLA's async scheduling of collectives).
+"""
+
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from ..base import MXNetError
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer(object):
+    """Applies an Optimizer on a set of Parameters.
+
+    Parameters
+    ----------
+    params : ParameterDict or list of Parameter
+    optimizer : str or Optimizer
+    optimizer_params : dict
+    kvstore : str or KVStore, default 'device'
+    compression_params : dict, optional (gradient compression config)
+    update_on_kvstore : bool, optional
+    """
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._trainer = self
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states = {}
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if isinstance(self._kvstore_type, kvs.KVStore):
+            kv = self._kvstore_type
+        elif self._kvstore_type is None:
+            kv = None
+        else:
+            kv = kvs.create(self._kvstore_type)
+        self._kvstore = kv
+        if self._update_on_kvstore is None:
+            self._update_on_kvstore = False
+        if kv is not None:
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    kv.init(i, param.data())
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------- step --
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Makes one parameter update step: rescale grads by 1/batch_size,
+        allreduce across data-parallel replicas, apply optimizer
+        (gluon/trainer.py:305)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        "Parameter %s has not been initialized" % param.name)
+                continue
+            if not getattr(param._data, "_fresh_grad", False):
+                # grad array still holds a previous iteration's value
+                # (reference: trainer.py _update fresh-grad check)
+                if ignore_stale_grad:
+                    continue
+                raise UserWarning(
+                    "Gradient of Parameter `%s` on context %s has not been "
+                    "updated by backward since last `step`. This could mean "
+                    "a bug in your model that made it only use a subset of "
+                    "the Parameters (Blocks) for this iteration. If you are "
+                    "intentionally only using a subset, call step with "
+                    "ignore_stale_grad=True to suppress this warning"
+                    % (param.name, str(param.list_ctx()[0])))
+            if self._update_on_kvstore and self._kvstore is not None:
+                self._kvstore.pull(i, param.data(), priority=-i)
+            else:
+                self._updaters[0](i, param.grad(), param.data())
+            param._data._fresh_grad = False
+
+    # ------------------------------------------------------------ states --
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)

@@ -69,7 +69,11 @@ class Initializer(object):
             desc.global_init = self
         init = desc.attrs.get("__init__", "")
         if init:
-            create(*json.loads(init))._init_weight(desc, arr)
+            try:
+                nm, kw = json.loads(init)
+            except (json.JSONDecodeError, ValueError):
+                nm, kw = init, {}  # plain registry name, e.g. "zeros"
+            create(nm, **kw)._init_weight(desc, arr)
         else:
             # routing by name suffix (initializer.py:125-160)
             if desc.endswith("weight"):
@@ -360,3 +364,10 @@ class FusedRNN(Initializer):
                 bias[b * per + h:b * per + 2 * h] = self._forget_bias
         flat[total - nbias:] = bias
         arr[:] = flat.reshape(arr.shape)
+
+
+# Name aliases matching the reference registry (python/mxnet/initializer.py
+# registers Zero under 'zeros', One under 'ones', MSRAPrelu under 'msra').
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+_INIT_REGISTRY["msra"] = MSRAPrelu

@@ -193,3 +193,12 @@ def amp_multicast(*data, num_outputs=1):
 @register(name="gamma_sampled_like_guard", differentiable=False)
 def _guard(data):  # internal helper op used by tests for registry behavior
     return data
+
+
+@register(name="add_n", aliases=("ElementWiseSum",))
+def add_n(*args):
+    """src/operator/tensor/elemwise_sum.cc — sum of N arrays in one pass."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

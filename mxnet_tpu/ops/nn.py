@@ -6,9 +6,11 @@ Upsampling), src/operator/rnn.cc (fused RNN), src/operator/leaky_relu.cc,
 src/operator/softmax_output.cc, src/operator/instance_norm.cc.
 
 TPU-native mapping: convs/matmuls are lax.conv_general_dilated/dot_general on
-the MXU (bf16-friendly); pooling is lax.reduce_window; the fused RNN is a
-lax.scan over time steps (XLA pipelines the per-step matmuls); there are no
-cuDNN/MKLDNN forks — one implementation, every backend.
+the MXU (bf16-friendly); pooling is a strided-slice window reduction (XLA's
+own reduce_window decomposition, chosen because it linearizes under
+vjp-of-jit); the fused RNN is a lax.scan over time steps (XLA pipelines the
+per-step matmuls); there are no cuDNN/MKLDNN forks — one implementation,
+every backend.
 """
 
 import numpy as _np
@@ -108,6 +110,38 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 
 
 # -------------------------------------------------------------- pooling --
+def _window_reduce(data, kernel, stride, pads, combine, init_val, use_np=False):
+    """Reduce over sliding windows via one strided slice per kernel offset.
+
+    `data` is NC<spatial> (or bare <spatial> with use_np=True for static
+    count computation). `pads` is [(lo, hi)] per spatial dim."""
+    import itertools
+    xp = _np if use_np else jnp
+    nsp = len(kernel)
+    nbatch = data.ndim - nsp
+    pad_cfg = [(0, 0)] * nbatch + list(pads)
+    if use_np:
+        padded = _np.pad(data, pad_cfg, constant_values=init_val)
+    else:
+        padded = jnp.pad(data, pad_cfg, constant_values=init_val)
+    out_len = [(padded.shape[nbatch + d] - kernel[d]) // stride[d] + 1
+               for d in range(nsp)]
+    acc = None
+    for off in itertools.product(*[range(k) for k in kernel]):
+        starts = [0] * nbatch + list(off)
+        limits = list(padded.shape[:nbatch]) + \
+            [off[d] + (out_len[d] - 1) * stride[d] + 1 for d in range(nsp)]
+        strides = [1] * nbatch + list(stride)
+        if use_np:
+            sl = tuple(slice(s, l, st)
+                       for s, l, st in zip(starts, limits, strides))
+            piece = padded[sl]
+        else:
+            piece = lax.slice(padded, starts, limits, strides)
+        acc = piece if acc is None else combine(acc, piece)
+    return acc
+
+
 @register(name="Pooling")
 def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", cudnn_off=False,
@@ -140,31 +174,30 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             if rem != 0:
                 hi += stride[i] - rem
         pads.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple(pads)
 
+    # Window reduce as a max/add over kernel-offset strided slices. This is
+    # the decomposition XLA itself applies, it fuses cleanly, and — unlike
+    # lax.reduce_window — it linearizes, so jax.vjp over a jitted CachedOp
+    # graph works (reduce_window has no linearization rule as of jax 0.9).
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, padding)
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return _window_reduce(data, kernel, stride, pads, jnp.maximum, init)
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value),
-                              jnp.asarray(0, data.dtype), lax.add,
-                              window, strides, padding)
+        s = _window_reduce(jnp.power(jnp.abs(data), p_value), kernel, stride,
+                           pads, jnp.add, 0)
         return jnp.power(s, 1.0 / p_value)
-    s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
-                          window, strides, padding)
+    s = _window_reduce(data, kernel, stride, pads, jnp.add, 0)
     if pool_type == "sum":
         return s
     # avg
     if count_include_pad:
         denom = float(_np.prod(kernel))
         return s / jnp.asarray(denom, data.dtype)
-    ones_ = jnp.ones_like(data)
-    cnt = lax.reduce_window(ones_, jnp.asarray(0, data.dtype), lax.add,
-                            window, strides, padding)
-    return s / cnt
+    # denominators depend only on static shapes — computed host-side
+    cnt = _window_reduce(_np.ones(data.shape[2:], dtype=_np.float32),
+                         kernel, stride, pads, _np.add, 0, use_np=True)
+    return s / jnp.asarray(cnt, data.dtype)
 
 
 # ------------------------------------------------------------- fully-connected --
@@ -249,9 +282,11 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     sq = jnp.square(data)
     half = nsize // 2
     padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
-    window = (1, nsize) + (1,) * (data.ndim - 2)
-    s = lax.reduce_window(padded, jnp.asarray(0, data.dtype), lax.add,
-                          window, (1,) * data.ndim, "valid")
+    c = data.shape[1]
+    s = None
+    for off in range(nsize):  # channel-window sum as shifted slices
+        piece = lax.slice_in_dim(padded, off, off + c, axis=1)
+        s = piece if s is None else s + piece
     return data / jnp.power(knorm + alpha / nsize * s, beta)
 
 

@@ -115,6 +115,13 @@ class Optimizer(object):
             self.update(index, weight, grad, state)
 
     # -------------------------------------------------------- lr/wd mult --
+    @property
+    def learning_rate(self):
+        """Current base lr (optimizer.py learning_rate property)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been "

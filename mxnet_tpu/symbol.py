@@ -624,6 +624,15 @@ def _make_sym_func(op_name):
                             key=lambda t: order.get(t[0], 99))
             input_syms = [s for _, s in zipped]
             input_names = [n for n, _ in zipped]
+        # mark explicitly-passed variables bound to aux inputs (e.g. gluon
+        # passing running_mean into BatchNorm's moving_mean slot) as aux
+        aux_inputs = set(OP_AUX.get(op_name, ()))
+        if aux_inputs and not has_varargs:
+            for n, s in zip(input_names, input_syms):
+                if n in aux_inputs:
+                    node = s._nodes[s._outputs[0][0]]
+                    if node.is_var():
+                        node.attrs["__aux__"] = True
         attrs["__input_names__"] = tuple(n or "arg%d" % i
                                          for i, n in enumerate(input_names))
         return _compose(op_name, input_syms, attrs, nm)

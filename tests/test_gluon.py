@@ -1,0 +1,400 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer/loss tests.
+
+Modeled on the reference suite tests/python/unittest/test_gluon.py (2821
+LoC): parameter lifecycle, deferred init, hybridize consistency, trainer
+steps, losses vs hand-computed numpy references.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict_get_and_share():
+    shared = gluon.ParameterDict("net_")
+    d1 = gluon.ParameterDict("net_", shared=shared)
+    shared.get("w", shape=(3,))
+    p = d1.get("w")
+    assert p is shared["net_w"]
+
+
+def test_constant_parameter():
+    const = np.arange(6.0).reshape(2, 3)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.c = self.params.get_constant("const", const)
+
+        def hybrid_forward(self, F, x, c):
+            return x + c
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.zeros((2, 3))
+    out = net(x)
+    assert np.allclose(out.asnumpy(), const)
+    assert net.c.grad_req == "null"
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    assert net.weight.shape == (8, 0)
+    x = mx.nd.ones((4, 5))
+    y = net(x)
+    assert net.weight.shape == (8, 5)
+    assert y.shape == (4, 8)
+
+
+def test_dense_forward_numpy_parity():
+    net = nn.Dense(3, use_bias=True, in_units=4)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 4))
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    expected = x.asnumpy() @ w.T + b
+    assert np.allclose(net(x).asnumpy(), expected, atol=1e-5)
+
+
+def test_sequential_and_slicing():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[0], nn.Dense)
+    net.initialize()
+    y = net(mx.nd.ones((1, 5)))
+    assert y.shape == (1, 2)
+
+
+def test_hybrid_consistency_mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(3, 7))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, atol=1e-5)
+
+
+def test_hybrid_grad_consistency_cnn():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, 3, padding=1),
+                    nn.BatchNorm(),
+                    nn.Activation("relu"),
+                    nn.MaxPool2D(2),
+                    nn.Flatten(),
+                    nn.Dense(3))
+        return net
+
+    net = build()
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g_eager = net[0].weight.grad().asnumpy().copy()
+
+    net.hybridize()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g_hybrid = net[0].weight.grad().asnumpy()
+    assert np.allclose(g_eager, g_hybrid, atol=1e-4)
+
+
+def test_batchnorm_running_stats_update():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(4, 3, 5, 5) * 3 + 1)
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # inference uses running stats: output differs from training output
+    y_train_mean = None
+    with autograd.record():
+        y_train_mean = net(x).asnumpy()
+    y_infer = net(x).asnumpy()
+    assert not np.allclose(y_train_mean, y_infer)
+
+
+def test_conv_transpose_shapes():
+    net = nn.Conv2DTranspose(8, 3, strides=2, padding=1, output_padding=1,
+                             in_channels=4)
+    net.initialize()
+    y = net(mx.nd.ones((2, 4, 7, 7)))
+    assert y.shape == (2, 8, 14, 14)
+
+
+def test_pool_layers():
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+    # avg pool numeric check
+    y = nn.AvgPool2D(2)(x).asnumpy()
+    ref = x.asnumpy().reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    assert np.allclose(y, ref, atol=1e-6)
+
+
+def test_maxpool_grad_through_hybrid():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.MaxPool2D(2), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(1, 1, 4, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    # gradient flows only to window maxima
+    gx = x.grad.asnumpy()
+    assert (gx != 0).sum() > 0
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]]), dtype="int32")
+    out = net(idx)
+    assert out.shape == (2, 2, 4)
+    w = net.weight.data().asnumpy()
+    assert np.allclose(out.asnumpy()[0, 0], w[1], atol=1e-6)
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = mx.nd.array(np.random.randn(2, 6, 4))
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    y = ln(x).asnumpy()
+    assert np.allclose(y.mean(axis=-1), 0, atol=1e-4)
+    gn = nn.GroupNorm(num_groups=3, in_channels=6)
+    gn.initialize()
+    assert gn(x).shape == x.shape
+    inorm = nn.InstanceNorm(in_channels=6)
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+
+
+def test_activations_layers():
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.5, 2.0]))
+    assert np.allclose(nn.Activation("relu")(x).asnumpy(),
+                       np.maximum(x.asnumpy(), 0))
+    lrelu = nn.LeakyReLU(0.1)
+    y = lrelu(x).asnumpy()
+    assert np.allclose(y, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                   0.1 * x.asnumpy()), atol=1e-6)
+    for blk in [nn.ELU(), nn.SELU(), nn.Swish(), nn.GELU(),
+                nn.PReLU()]:
+        blk.initialize()
+        assert blk(x).shape == x.shape
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array([[1.0, 2.0]])
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    assert not np.allclose(w_before, w_after)
+    assert trainer.learning_rate == pytest.approx(0.1)
+    trainer.set_learning_rate(0.01)
+    assert trainer.learning_rate == pytest.approx(0.01)
+
+
+def test_trainer_convergence_linear_regression():
+    np.random.seed(0)
+    true_w = np.array([[2.0, -3.4]])
+    true_b = 4.2
+    X = np.random.randn(200, 2).astype(np.float32)
+    Y = X @ true_w.T + true_b
+
+    net = nn.Dense(1, in_units=2)
+    net.initialize(init=mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    l2 = gluon.loss.L2Loss()
+    for epoch in range(60):
+        with autograd.record():
+            loss = l2(net(mx.nd.array(X)), mx.nd.array(Y))
+        loss.backward()
+        trainer.step(X.shape[0])
+    assert np.allclose(net.weight.data().asnumpy(), true_w, atol=0.1)
+    assert abs(float(net.bias.data().asnumpy()[0]) - true_b) < 0.1
+
+
+def test_losses_numeric():
+    pred = mx.nd.array(np.array([[1.0, 2.0], [0.5, -0.5]]))
+    label = mx.nd.array(np.array([[0.5, 1.0], [1.0, 0.0]]))
+
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    ref = 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1)
+    assert np.allclose(l2, ref, atol=1e-6)
+
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    ref = np.abs(pred.asnumpy() - label.asnumpy()).mean(axis=1)
+    assert np.allclose(l1, ref, atol=1e-6)
+
+    huber = gluon.loss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    d = np.abs(pred.asnumpy() - label.asnumpy())
+    ref = np.where(d > 1, d - 0.5, 0.5 * d * d).mean(axis=1)
+    assert np.allclose(huber, ref, atol=1e-6)
+
+
+def test_softmax_ce_loss():
+    pred = mx.nd.array(np.random.randn(4, 5))
+    label = mx.nd.array(np.array([0, 1, 2, 3]))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    logp = p - p.max(axis=1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(axis=1, keepdims=True))
+    ref = -logp[np.arange(4), label.asnumpy().astype(int)]
+    assert np.allclose(loss, ref, atol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    pred = mx.nd.array(np.random.randn(3, 4))
+    label = mx.nd.array((np.random.rand(3, 4) > 0.5).astype(np.float32))
+    loss = gluon.loss.SigmoidBCELoss()(pred, label).asnumpy()
+    x, z = pred.asnumpy(), label.asnumpy()
+    ref = (np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))).mean(axis=1)
+    assert np.allclose(loss, ref, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    fname = str(tmp_path / "p.params")
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(fname)
+    x = mx.nd.array(np.random.randn(2, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy(), atol=1e-6)
+
+
+def test_export_symbolblock_import(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(5, activation="relu", in_units=4), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(2, 4))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+    sb = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                   path + "-0000.params")
+    assert np.allclose(sb(x).asnumpy(), ref, atol=1e-5)
+
+
+def test_name_scope_prefixes():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        d = nn.Dense(2)
+    assert d.prefix.startswith("model_")
+    p_names = list(net.collect_params().keys()) + \
+        list(d.collect_params().keys())
+    assert all(n.startswith("model_") for n in p_names)
+
+
+def test_block_grad_req_setattr():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.collect_params().setattr("grad_req", "null")
+    with autograd.record():
+        loss = net(mx.nd.ones((1, 2))).sum()
+    loss.backward()
+    assert net.weight.grad_req == "null"
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda(lambda x: x * 2)
+    out = lam(mx.nd.ones((2, 2)))
+    assert np.allclose(out.asnumpy(), 2.0)
+    hlam = nn.HybridLambda(lambda F, x: F.relu(x))
+    out = hlam(mx.nd.array(np.array([-1.0, 1.0])))
+    assert np.allclose(out.asnumpy(), [0.0, 1.0])
+
+
+def test_hybrid_multi_output():
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x), F.sigmoid(x)
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3))
+    a, b = net(x)
+    net.hybridize()
+    a2, b2 = net(x)
+    assert np.allclose(a.asnumpy(), a2.asnumpy(), atol=1e-6)
+    assert np.allclose(b.asnumpy(), b2.asnumpy(), atol=1e-6)
+
+
+def test_dropout_hybrid_randomness():
+    net = nn.Dropout(0.5)
+    net.hybridize()
+    x = mx.nd.ones((100,))
+    with autograd.record():
+        y1 = net(x).asnumpy()
+        y2 = net(x).asnumpy()
+    # training-mode dropout: masks differ between calls even when compiled
+    assert not np.allclose(y1, y2)
+    # inference: identity
+    y3 = net(x).asnumpy()
+    assert np.allclose(y3, 1.0)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.array(np.ones((2, 2)) * 3),
+              mx.nd.array(np.ones((3,)) * 4)]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert new_norm < 1.01
+    assert total > 1.0
+
+
+def test_split_and_load():
+    data = mx.nd.array(np.arange(12).reshape(6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    loaded = gluon.utils.split_and_load(data, [mx.cpu()])
+    assert len(loaded) == 1
