@@ -1,1 +1,4 @@
-"""placeholder — populated in this round."""
+"""Gluon model zoo (reference: python/mxnet/gluon/model_zoo/__init__.py)."""
+
+from . import vision
+from .vision import get_model

@@ -19,7 +19,7 @@ from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
            "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
-           "Lambda", "HybridLambda"]
+           "Lambda", "HybridLambda", "Concurrent", "HybridConcurrent", "Identity"]
 
 
 class Sequential(Block):
@@ -442,3 +442,46 @@ class HybridLambda(HybridBlock):
 
 
 from .activations import Activation  # noqa: E402  (Dense uses it)
+
+
+class Concurrent(Sequential):
+    """Runs children on the same input and concatenates their outputs
+    along `axis` (reference gluon/contrib/nn/basic_layers.py Concurrent;
+    promoted into gluon.nn as in later MXNet)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(Concurrent, self).__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._children.values()):
+            import warnings
+            warnings.warn(
+                "All children of this Concurrent layer '%s' are HybridBlocks. "
+                "Consider using HybridConcurrent for the best performance."
+                % self.prefix, stacklevel=2)
+        Block.hybridize(self, active, **kwargs)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (one XLA fusion per parallel branch set)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(HybridConcurrent, self).__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping — useful as a no-op branch in Concurrent."""
+
+    def hybrid_forward(self, F, x):
+        return x

@@ -1,0 +1,90 @@
+"""Model zoo construction/forward tests (reference:
+tests/python/unittest/test_gluon_model_zoo.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        get_model("no_such_model")
+
+
+def test_resnet_thumbnail_all_variants():
+    # thumbnail=True uses the CIFAR stem so 32x32 inputs work everywhere
+    x = mx.nd.random.uniform(shape=(2, 3, 32, 32))
+    for version in (1, 2):
+        net = vision.get_resnet(version, 18, classes=10, thumbnail=True)
+        net.initialize()
+        assert net(x).shape == (2, 10)
+
+
+def test_resnet50_bottleneck_forward():
+    net = vision.resnet50_v1(classes=7)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+    assert net(x).shape == (1, 7)
+
+
+def test_resnet_hybridized_matches_eager():
+    net = vision.get_resnet(1, 18, classes=10, thumbnail=True)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 32, 32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_mobilenet_forward():
+    for ctor in (vision.mobilenet0_25, vision.mobilenet_v2_0_25):
+        net = ctor(classes=5)
+        net.initialize()
+        x = mx.nd.random.uniform(shape=(1, 3, 64, 64))
+        assert net(x).shape == (1, 5)
+
+
+def test_squeezenet_forward():
+    net = vision.squeezenet1_1(classes=6)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+    assert net(x).shape == (1, 6)
+
+
+def test_vgg_and_alexnet_forward():
+    net = vision.get_model("alexnet", classes=4)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+    assert net(x).shape == (1, 4)
+
+
+def test_densenet_forward():
+    net = vision.densenet121(classes=3)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+    assert net(x).shape == (1, 3)
+
+
+def test_model_zoo_train_step_decreases_loss():
+    """A few SGD steps on random data should reduce loss (sanity that
+    gradients flow through residual blocks + BN)."""
+    from mxnet_tpu import gluon, autograd
+    net = vision.get_resnet(1, 18, classes=4, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.random.uniform(shape=(8, 3, 32, 32))
+    y = mx.nd.array(np.random.randint(0, 4, (8,)))
+    losses = []
+    for _ in range(4):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0]
