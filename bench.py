@@ -1,0 +1,101 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s).
+
+Baseline row (BASELINE.md): ResNet-50 training, fp32, bs=128 on 1x V100
+= 363.69 img/s (reference docs/faq/perf.md:241). Here the single TPU
+chip runs the TPU-idiomatic equivalent: bf16 compute with fp32 master
+weights (AMP), whole train step as ONE donated-buffer XLA computation.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69
+BATCH = 128
+
+
+def build_train_step(batch, image_size=224, classes=1000, lr=0.1):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.utils import functionalize_block
+
+    net = vision.resnet50_v1(classes=classes)
+    net.initialize(mx.init.Xavier())
+    x0 = mx.nd.zeros((batch, 3, image_size, image_size))
+    graph_fn, data_names, args, aux = functionalize_block(
+        net, x0, is_train=True)
+    key = jax.random.PRNGKey(0)
+
+    def loss_of(args_f32, aux, x, y):
+        # AMP: bf16 compute, fp32 master weights / loss
+        args_bf16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), args_f32)
+        inputs = dict(args_bf16)
+        inputs[data_names[0]] = x.astype(jnp.bfloat16)
+        aux_bf16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), aux)
+        outs, aux_up = graph_fn(inputs, aux_bf16, key)
+        logits = outs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+        aux_up = jax.tree.map(lambda a: a.astype(jnp.float32), aux_up)
+        return nll.mean(), aux_up
+
+    def step(args, mom, aux, x, y):
+        (loss, aux_up), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(args, aux, x, y)
+        mom = jax.tree.map(
+            lambda m, g: 0.9 * m + g.astype(jnp.float32), mom, grads)
+        args = jax.tree.map(lambda p, m: p - lr * m, args, mom)
+        return args, mom, aux_up, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    mom = jax.tree.map(lambda p: np.zeros(p.shape, np.float32), args)
+    return jitted, args, mom, aux
+
+
+def main():
+    import jax
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    batch = BATCH if on_accel else 8
+    size = 224 if on_accel else 64
+    steps = 20 if on_accel else 2
+
+    import jax.numpy as jnp
+    step, args, mom, aux = build_train_step(batch, size)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 3, size, size).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+
+    # compile + warmup; float() fetches force a real barrier (the axon
+    # tunnel's block_until_ready can return before remote completion)
+    args, mom, aux, loss = step(args, mom, aux, x, y)
+    float(loss)
+    args, mom, aux, loss = step(args, mom, aux, x, y)
+    float(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        args, mom, aux, loss = step(args, mom, aux, x, y)
+    loss = float(loss)
+    dt = time.time() - t0
+
+    img_s = batch * steps / dt
+    result = {
+        "metric": "resnet50_train_img_per_sec_bs%d_%s" % (batch, backend),
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }
+    print(json.dumps(result))
+    if not np.isfinite(loss):
+        print("WARNING: non-finite loss", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Model families implemented TPU-first.
+
+`transformer` is the SPMD flagship for multi-chip execution (dp/tp/sp/ep
+sharded training step over a jax.sharding.Mesh); the classic CNN families
+live in `mxnet_tpu.gluon.model_zoo.vision` behind the MXNet Gluon API.
+"""
+
+from . import transformer

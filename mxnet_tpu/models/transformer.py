@@ -1,0 +1,208 @@
+"""SPMD transformer language model — the multi-chip flagship.
+
+Built TPU-first rather than ported: a pure-functional decoder LM whose
+parameters and activations carry jax.sharding PartitionSpecs over the
+framework mesh axes (parallel/__init__.py):
+
+  dp — batch;  tp — heads / FFN hidden (Megatron-style);  sp — sequence
+  (ring attention, parallel/ring.py);  ep — MoE experts;  pp — pipeline
+  stages (stage-major layer stacking + collective-permute microbatch
+  schedule in parallel/pipeline.py).
+
+The reference framework has no transformer model family beyond attention
+helper ops (src/operator/contrib/transformer.cc interleaved matmul) —
+this module is the capability extension SURVEY §2.3/§5 calls for, and is
+what `__graft_entry__.dryrun_multichip` compiles over an N-device mesh.
+
+Everything here is plain JAX (jit-traceable, static shapes); bf16
+matmuls with fp32 accumulation target the MXU.
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.ring import ring_attention_sharded
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
+           "make_train_step", "param_specs"]
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
+    max_len: int = 128
+    dtype: object = jnp.float32
+    # mesh axis names (set to None to disable an axis)
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+    sp_axis: str = "sp"
+    ep_axis: str = "ep"
+    use_ring_attention: bool = True
+
+
+def _norm_shape(cfg):
+    return (cfg.d_model,)
+
+
+def param_specs(cfg):
+    """PartitionSpec per parameter — Megatron-style TP, experts on ep."""
+    tp, ep = cfg.tp_axis, cfg.ep_axis
+    layer = {
+        "ln1": P(None), "ln2": P(None),
+        "wq": P(None, tp, None), "wk": P(None, tp, None),
+        "wv": P(None, tp, None), "wo": P(tp, None, None),
+    }
+    if cfg.n_experts:
+        layer.update({
+            "gate": P(None, None),
+            "w1": P(ep, None, tp), "w2": P(ep, tp, None),
+        })
+    else:
+        layer.update({"w1": P(None, tp), "w2": P(tp, None)})
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "ln_f": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def init_params(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    dt = cfg.dtype
+    hd = cfg.d_model // cfg.n_heads
+
+    def dense(*shape):
+        scale = 1.0 / np.sqrt(shape[0] if len(shape) == 2 else cfg.d_model)
+        return jnp.asarray(rng.randn(*shape) * scale, dt)
+
+    def layer():
+        p = {
+            "ln1": jnp.ones(_norm_shape(cfg), dt),
+            "ln2": jnp.ones(_norm_shape(cfg), dt),
+            "wq": dense(cfg.d_model, cfg.n_heads, hd),
+            "wk": dense(cfg.d_model, cfg.n_heads, hd),
+            "wv": dense(cfg.d_model, cfg.n_heads, hd),
+            "wo": dense(cfg.n_heads, hd, cfg.d_model),
+        }
+        if cfg.n_experts:
+            p["gate"] = dense(cfg.d_model, cfg.n_experts)
+            p["w1"] = jnp.asarray(
+                rng.randn(cfg.n_experts, cfg.d_model, cfg.d_ff) /
+                np.sqrt(cfg.d_model), dt)
+            p["w2"] = jnp.asarray(
+                rng.randn(cfg.n_experts, cfg.d_ff, cfg.d_model) /
+                np.sqrt(cfg.d_ff), dt)
+        else:
+            p["w1"] = dense(cfg.d_model, cfg.d_ff)
+            p["w2"] = dense(cfg.d_ff, cfg.d_model)
+        return p
+
+    return {
+        "embed": jnp.asarray(rng.randn(cfg.vocab_size, cfg.d_model) * 0.02,
+                             dt),
+        "pos": jnp.asarray(rng.randn(cfg.max_len, cfg.d_model) * 0.02, dt),
+        "ln_f": jnp.ones(_norm_shape(cfg), dt),
+        "layers": [layer() for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params, cfg, mesh):
+    """device_put every param with its PartitionSpec."""
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def _rms_norm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
+
+
+def _attention(x, p, cfg, mesh):
+    q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
+    k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
+    v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
+    if mesh is not None and cfg.use_ring_attention and cfg.sp_axis:
+        o = ring_attention_sharded(q, k, v, mesh, axis_name=cfg.sp_axis,
+                                   causal=True)
+    else:
+        T = x.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
+        s = s / np.sqrt(q.shape[-1])
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v.astype(a.dtype))
+        o = o.astype(x.dtype)
+    return jnp.einsum("bthk,hkd->btd", o, p["wo"])
+
+
+def _ffn(x, p, cfg):
+    if cfg.n_experts:
+        # dense top-all dispatch: every token weighted over every expert.
+        # XLA shards the E dim over ep (and d_ff over tp) so each device
+        # computes only its experts' slices; the combine is a psum over ep.
+        gates = jax.nn.softmax(
+            jnp.einsum("btd,de->bte", x, p["gate"]), axis=-1)
+        h = jax.nn.gelu(jnp.einsum("btd,edf->betf", x, p["w1"]))
+        y = jnp.einsum("betf,efd->betd", h, p["w2"])
+        return jnp.einsum("betd,bte->btd", y, gates)
+    h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, p["w1"]))
+    return jnp.einsum("btf,fd->btd", h, p["w2"])
+
+
+def forward(params, tokens, cfg, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    x = params["embed"][tokens] + params["pos"][: tokens.shape[1]]
+    act = P(cfg.dp_axis, cfg.sp_axis, None)
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act))
+    for p in params["layers"]:
+        x = x + _attention(_rms_norm(x, p["ln1"]), p, cfg, mesh)
+        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act))
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("btd,vd->btv", x, params["embed"])
+
+
+def loss_fn(params, tokens, cfg, mesh=None):
+    """Next-token cross entropy (mean over B, T-1)."""
+    # keep the full (sp-divisible) sequence through the model; shift the
+    # logits instead of the inputs
+    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg, mesh=None, lr=1e-2):
+    """Jitted full training step: (params, opt_state, tokens) ->
+    (params, opt_state, loss). SGD with momentum, all-reduce of grads is
+    implicit in GSPMD (grads inherit param shardings)."""
+
+    def step(params, momentum, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+        params = jax.tree.map(lambda p, m: p - lr * m.astype(p.dtype),
+                              params, momentum)
+        return params, momentum, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_momentum(params):
+    return jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)

@@ -58,14 +58,15 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     dilate = _tuplize(dilate, nd)
     pad = _tuplize(pad if pad != () else 0, nd)
     dn = _conv_dnums(nd)
+    # bf16 convs: no preferred_element_type — the MXU already accumulates
+    # bf16 products in fp32, and forcing an fp32 output dtype breaks the
+    # conv transpose rule (fp32 cotangent meets bf16 operand in the
+    # weight-gradient conv)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.promote_types(data.dtype, jnp.float32)
-        if data.dtype == jnp.bfloat16 else None)
-    out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

@@ -1,0 +1,26 @@
+"""Framework-level utilities."""
+
+__all__ = ["functionalize_block"]
+
+
+def functionalize_block(net, example, is_train=False):
+    """Trace an initialized HybridBlock into a pure graph function.
+
+    Returns (graph_fn, data_names, args, aux) where
+    graph_fn(arg_dict, aux_dict, rng_key) -> (outputs, new_aux),
+    data_names are the traced input variable names, and args/aux are the
+    network's parameter arrays (raw jax) split per the symbol's
+    list_arguments / list_auxiliary_states. Used by __graft_entry__ and
+    bench.py; mirrors what CachedOp does internally for hybridize."""
+    from .executor import build_graph_fn
+
+    net(example)  # materialize deferred-shape params
+    data_syms, out_sym = net._get_graph(example)
+    graph_fn = build_graph_fn(out_sym, is_train=is_train)
+    arg_names = set(out_sym.list_arguments())
+    aux_names = set(out_sym.list_auxiliary_states())
+    all_params = {p.var().name: p.data()._data
+                  for p in net.collect_params().values()}
+    args = {k: v for k, v in all_params.items() if k in arg_names}
+    aux = {k: v for k, v in all_params.items() if k in aux_names}
+    return graph_fn, [s.name for s in data_syms], args, aux

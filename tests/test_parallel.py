@@ -1,0 +1,102 @@
+"""Parallelism tests on the virtual 8-device CPU mesh: ring attention
+(sequence parallel), SPMD transformer train step (dp/tp/sp/ep), and the
+driver contract in __graft_entry__.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.ring import ring_attention_sharded
+from mxnet_tpu.models import transformer as T
+
+
+def _ref_attention(q, k, v, causal):
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        Tq = q.shape[1]
+        mask = np.tril(np.ones((Tq, Tq), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    B, Tq, H, D = 2, 32, 4, 16
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, Tq, H, D).astype("float32"))
+               for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("sp", "dp"))
+    out = ring_attention_sharded(q, k, v, mesh, axis_name="sp",
+                                 causal=causal)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grads_flow():
+    B, Tq, H, D = 1, 16, 2, 8
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(B, Tq, H, D).astype("float32"))
+               for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("sp",))
+    f = jax.jit(lambda q, k, v: ring_attention_sharded(
+        q, k, v, mesh, axis_name="sp", causal=True).sum())
+    gq, gk = jax.grad(f, argnums=(0, 1))(q, k, v)
+    assert float(jnp.abs(gq).sum()) > 0
+    assert float(jnp.abs(gk).sum()) > 0
+
+
+def test_transformer_train_step_dp_tp_sp_ep_loss_drops():
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2, "ep": 1})
+    cfg = T.TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, n_experts=2, max_len=16)
+    params = T.shard_params(T.init_params(cfg, seed=0), cfg, mesh)
+    mom = T.init_momentum(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (8, 16)), jnp.int32)
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    step = T.make_train_step(cfg, mesh, lr=0.1)
+    losses = []
+    for _ in range(5):
+        params, mom, loss = step(params, mom, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_sharded_matches_single_device():
+    """The dp/tp/sp/ep-sharded forward must equal the unsharded one."""
+    cfg = T.TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                              n_layers=1, d_ff=64, n_experts=2, max_len=16)
+    params = T.init_params(cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (4, 16)), jnp.int32)
+    ref = T.forward(params, tokens, cfg, mesh=None)
+
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2, "ep": 1})
+    sharded = T.shard_params(params, cfg, mesh)
+    out = T.forward(sharded,
+                    jax.device_put(tokens,
+                                   NamedSharding(mesh, P("dp", None))),
+                    cfg, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)
+
+
+def test_graft_entry_forward_jits():
+    import __graft_entry__ as ge
+    fn, ex = ge.entry()
+    out = jax.jit(fn)(*ex)
+    assert out.shape == (8, 1000)
+    assert np.isfinite(np.asarray(out)).all()
