@@ -32,6 +32,7 @@ from . import initializer
 from . import initializer as init
 from . import metric
 from . import recordio
+from . import image
 from . import io
 from . import kvstore
 from . import callback

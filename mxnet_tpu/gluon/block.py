@@ -455,6 +455,12 @@ class HybridBlock(Block):
             self._build_cache(*args)
         flat_args, fmt = _flatten(args, "input")
         real = [a for a in flat_args if a is not None]
+        # arg structure changed since the trace (e.g. an RNN layer called
+        # with and without explicit begin_state) -> retrace
+        n_traced = sum(1 for is_data, _ in self._cached_op_args if is_data)
+        if n_traced != len(real):
+            self._clear_cached_op()
+            self._build_cache(*args)
         cargs = []
         for is_data, data in self._cached_op_args:
             if is_data:

@@ -1,1 +1,6 @@
-"""placeholder — populated in this round."""
+"""Gluon data API (reference: python/mxnet/gluon/data/__init__.py)."""
+
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision

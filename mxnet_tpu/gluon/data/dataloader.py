@@ -1,0 +1,101 @@
+"""Gluon DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py:77-285 — there, worker
+processes decode/augment and ship batches through POSIX-shm pickled
+NDArrays. TPU-native divergence: JAX runtimes are not fork-safe, so
+`num_workers>0` uses a THREAD pool (decode/augment is numpy-side and
+releases the GIL in practice); batches land on device asynchronously via
+the normal dispatch queue. The shared-memory IPC layer is unnecessary —
+device transfer is the only copy.
+"""
+
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch NDArray (recursively for tuples)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """Loads data from a Dataset and returns mini-batches.
+
+    Parameters mirror the reference loader: dataset, batch_size, shuffle,
+    sampler, last_batch, batch_sampler, batchify_fn, num_workers,
+    pin_memory (accepted, no-op on TPU), prefetch.
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn if batchify_fn is not None \
+            else default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._make_batch(batch)
+            return
+
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._num_workers + self._prefetch):
+                    futures.append(pool.submit(self._make_batch, next(it)))
+            except StopIteration:
+                it = None
+            while futures:
+                batch = futures.pop(0).result()
+                if it is not None:
+                    try:
+                        futures.append(pool.submit(self._make_batch,
+                                                   next(it)))
+                    except StopIteration:
+                        it = None
+                yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)

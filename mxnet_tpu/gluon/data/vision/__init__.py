@@ -1,0 +1,5 @@
+"""Gluon vision data API (reference:
+python/mxnet/gluon/data/vision/__init__.py)."""
+
+from .datasets import *
+from . import transforms

@@ -1,0 +1,183 @@
+"""Gluon vision transforms.
+
+Reference: python/mxnet/gluon/data/vision/transforms.py. Host-side
+transforms (decode/resize/crop) run in numpy/cv2; pure-math transforms
+(ToTensor/Normalize/flip) are Blocks over nd ops so they can also fuse
+into a jit graph.
+"""
+
+import random as pyrandom
+
+import numpy as np
+
+from .... import ndarray as nd
+from .... import image as _image
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting", "RandomGray"]
+
+
+class Compose(Sequential):
+    """Sequentially composes transforms."""
+
+    def __init__(self, transforms):
+        super(Compose, self).__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super(Cast, self).__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]. Hybridized (Symbol) use
+    assumes a single HWC image; batched NHWC input needs eager mode
+    (Symbols carry no rank at compose time)."""
+
+    def hybrid_forward(self, F, x):
+        if getattr(x, "ndim", 3) == 4:
+            out = F.transpose(x, axes=(0, 3, 1, 2))
+        else:
+            out = F.transpose(x, axes=(2, 0, 1))
+        return F.cast(out, dtype="float32") / 255.0
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std on CHW float input."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super(Normalize, self).__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        return (x - F.array(self._mean)) / F.array(self._std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super(Resize, self).__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        if isinstance(self._size, int):
+            if self._keep:
+                return _image.resize_short(x, self._size, self._interp)
+            return _image.imresize(x, self._size, self._size, self._interp)
+        return _image.imresize(x, self._size[0], self._size[1],
+                               self._interp)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super(CenterCrop, self).__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        return _image.center_crop(x, self._size, self._interp)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super(RandomResizedCrop, self).__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        return _image.random_size_crop(x, self._size, self._scale,
+                                       self._ratio, self._interp)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if pyrandom.random() < 0.5:
+            arr = x.asnumpy()[:, ::-1]
+            return nd.array(arr.copy(), dtype=arr.dtype.name)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if pyrandom.random() < 0.5:
+            arr = x.asnumpy()[::-1]
+            return nd.array(arr.copy(), dtype=arr.dtype.name)
+        return x
+
+
+class _JitterBlock(Block):
+    aug_cls = None
+
+    def __init__(self, amount):
+        super(_JitterBlock, self).__init__()
+        self._aug = self.aug_cls(amount)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomBrightness(_JitterBlock):
+    aug_cls = _image.BrightnessJitterAug
+
+
+class RandomContrast(_JitterBlock):
+    aug_cls = _image.ContrastJitterAug
+
+
+class RandomSaturation(_JitterBlock):
+    aug_cls = _image.SaturationJitterAug
+
+
+class RandomHue(_JitterBlock):
+    aug_cls = _image.HueJitterAug
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super(RandomColorJitter, self).__init__()
+        self._aug = _image.ColorJitterAug(brightness, contrast, saturation)
+        self._hue = _image.HueJitterAug(hue) if hue else None
+
+    def forward(self, x):
+        x = self._aug(x)
+        if self._hue:
+            x = self._hue(x)
+        return x
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super(RandomLighting, self).__init__()
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        self._aug = _image.LightingAug(alpha, eigval, eigvec)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super(RandomGray, self).__init__()
+        self._aug = _image.RandomGrayAug(p)
+
+    def forward(self, x):
+        return self._aug(x)

@@ -1,1 +1,4 @@
-"""placeholder — populated in this round."""
+"""Gluon recurrent API (reference: python/mxnet/gluon/rnn/__init__.py)."""
+
+from .rnn_cell import *
+from .rnn_layer import *

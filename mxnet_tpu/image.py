@@ -1,0 +1,543 @@
+"""mx.image — image IO, processing and augmentation pipeline.
+
+Reference: python/mxnet/image/image.py (2504 LoC Python-side pipeline)
+and the C++ augmenters (src/io/image_aug_default.cc:565). TPU-native
+design: decode/augment stay on the host CPU in numpy/cv2 (the chip has
+no JPEG engine), producing batched NDArrays that transfer to device
+once per batch; device-side normalize/flip also exist as jax ops for
+in-graph use (ops applied under jit fuse into the input pipeline).
+"""
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover
+    cv2 = None
+
+from . import ndarray as nd
+from .base import MXNetError
+from .io import DataIter, DataBatch, DataDesc
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "scale_down", "copyMakeBorder",
+           "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+           "ForceResizeAug", "CastAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "RandomGrayAug", "HorizontalFlipAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "CreateAugmenter",
+           "ImageIter"]
+
+
+def _require_cv2():
+    if cv2 is None:
+        raise MXNetError("cv2 (OpenCV) is required for image decode ops")
+
+
+def _as_np(img):
+    return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC uint8 NDArray
+    (reference imdecode: python/mxnet/image/image.py:imdecode)."""
+    _require_cv2()
+    if isinstance(buf, (bytes, bytearray)):
+        buf = np.frombuffer(buf, dtype=np.uint8)
+    img = cv2.imdecode(buf, int(flag))
+    if img is None:
+        raise MXNetError("Decoding failed. Invalid image buffer.")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    _require_cv2()
+    img = cv2.resize(_as_np(src), (w, h), interpolation=int(interp))
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=img.dtype.name)
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = _as_np(src).shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, values=0):
+    _require_cv2()
+    img = cv2.copyMakeBorder(_as_np(src), top, bot, left, right,
+                             border_type, value=values)
+    return nd.array(img, dtype=img.dtype.name)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _as_np(src)[y0:y0 + h, x0:x0 + w]
+    out = nd.array(arr, dtype=arr.dtype.name)
+    if size is not None and (w, h) != size:
+        out = imresize(out, *size, interp=interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = _as_np(src).shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = _as_np(src).shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = _as_np(src).shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") if isinstance(src, nd.NDArray) \
+        else nd.array(src, dtype="float32")
+    out = src - nd.array(np.asarray(mean, np.float32))
+    if std is not None:
+        out = out / nd.array(np.asarray(std, np.float32))
+    return out
+
+
+# ----------------------------------------------------------- augmenters --
+class Augmenter(object):
+    """Image augmenter base (image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super(SequentialAug, self).__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super(RandomOrderAug, self).__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ForceResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, *self.size, interp=self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(RandomCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super(RandomSizedCropAug, self).__init__(size=size, area=area,
+                                                 ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(CenterCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super(HorizontalFlipAug, self).__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = _as_np(src)[:, ::-1]
+            return nd.array(arr.copy(), dtype=arr.dtype.name)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super(CastAug, self).__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ) if isinstance(src, nd.NDArray) \
+            else nd.array(_as_np(src), dtype=self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super(BrightnessJitterAug, self).__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super(ContrastJitterAug, self).__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = _as_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum() * (3.0 / arr.size)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super(SaturationJitterAug, self).__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = _as_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super(HueJitterAug, self).__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]])
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]])
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        arr = _as_np(src).astype(np.float32)
+        return nd.array(np.dot(arr, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super(ColorJitterAug, self).__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super(LightingAug, self).__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src + nd.array(rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super(ColorNormalizeAug, self).__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super(RandomGrayAug, self).__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd.array(np.dot(_as_np(src).astype(np.float32),
+                                   self._mat))
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list factory (image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec files or path-imglist with augmenters
+    (reference python/mxnet/image/image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super(ImageIter, self).__init__()
+        from . import recordio
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                     "r")
+            self.seq = list(self.imgrec.keys)
+        else:
+            if path_imglist:
+                imglist = {}
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        line = line.strip().split("\t")
+                        label = np.array(line[1:-1], dtype=np.float32)
+                        imglist[int(line[0])] = (label, line[-1])
+            else:
+                imglist = {i: (np.array(item[0], dtype=np.float32)
+                               if not np.isscalar(item[0])
+                               else np.array([item[0]], dtype=np.float32),
+                               item[1])
+                           for i, item in enumerate(imglist)}
+            self.imglist = imglist
+            self.seq = list(imglist.keys())
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if num_parts > 1:
+            self.seq = self.seq[part_index::num_parts]
+        self.auglist = aug_list if aug_list is not None \
+            else CreateAugmenter(data_shape, **kwargs)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + data_shape, "float32")]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name,
+                                           (batch_size, label_width),
+                                           "float32")]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,),
+                                           "float32")]
+        self.last_batch_handle = last_batch_handle
+        self._cache = []
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            pyrandom.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        from . import recordio
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            s = self.imgrec.read_idx(idx)
+            header, img = recordio.unpack(s)
+            return header.label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root or "", fname), "rb") as f:
+            return label, f.read()
+
+    def _decoded_sample(self):
+        """Next (CHW float array, label row), from the rollover cache
+        first."""
+        if self._cache:
+            return self._cache.pop(0)
+        label, s = self.next_sample()
+        img = imdecode(s)
+        for aug in self.auglist:
+            img = aug(img)
+        return _as_np(img).transpose(2, 0, 1), label
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width),
+                               np.float32)
+        rows = []
+        try:
+            while len(rows) < self.batch_size:
+                rows.append(self._decoded_sample())
+        except StopIteration:
+            if not rows:
+                raise
+            if self.last_batch_handle == "discard":
+                raise
+            if self.last_batch_handle == "roll_over":
+                self._cache = rows  # ragged remainder joins next epoch
+                raise StopIteration
+        for i, (arr, label) in enumerate(rows):
+            batch_data[i] = arr
+            batch_label[i] = label
+        label_out = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return DataBatch(data=[nd.array(batch_data)],
+                         label=[nd.array(label_out)],
+                         pad=self.batch_size - len(rows))

@@ -532,7 +532,8 @@ def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False
 
 
 @register(name="RNN", num_outputs="n", stateful_rng=True)
-def rnn(data, parameters, state, state_cell=None, state_size=1, num_layers=1,
+def rnn(data, parameters, state=None, state_cell=None, state_size=1,
+        num_layers=1,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
         projection_size=None, lstm_state_clip_min=None,
         lstm_state_clip_max=None, lstm_state_clip_nan=False,
@@ -549,8 +550,14 @@ def rnn(data, parameters, state, state_cell=None, state_size=1, num_layers=1,
     ws, bs = _unpack_rnn_params(parameters, mode, num_layers, input_size,
                                 state_size, bidirectional)
 
+    # omitted initial states default to zeros (lets hybridized graphs
+    # avoid baking a batch-size constant for begin_state)
+    if state is None:
+        state = jnp.zeros((num_layers * d, batch, state_size), data.dtype)
     h0 = state  # (num_layers*d, batch, state_size)
     c0 = state_cell if mode == "lstm" else None
+    if mode == "lstm" and c0 is None:
+        c0 = jnp.zeros_like(h0)
     x = data
     h_last, c_last = [], []
     key = rng_key

@@ -645,7 +645,9 @@ def _make_sym_func(op_name):
 def _param_unused(op_name, pname, attrs):
     if pname == "bias" and attrs.get("no_bias"):
         return True
-    if pname == "state_cell" and attrs.get("mode", "lstm") != "lstm":
+    if pname in ("state", "state_cell"):
+        # the RNN op synthesizes zero initial states when omitted; don't
+        # auto-create bindable begin-state variables
         return True
     if pname in ("sequence_length", "data_lengths", "label_lengths") \
             and not attrs.get("use_sequence_length"):

@@ -1,0 +1,169 @@
+"""Gluon data pipeline (reference:
+tests/python/unittest/test_gluon_data.py)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_array_dataset_and_loader():
+    X = np.random.rand(50, 3, 8, 8).astype("float32")
+    Y = np.random.randint(0, 10, (50,))
+    ds = gdata.ArrayDataset(mx.nd.array(X), Y)
+    assert len(ds) == 50
+    dl = gdata.DataLoader(ds, batch_size=16, shuffle=True,
+                          last_batch="discard")
+    batches = list(dl)
+    assert len(batches) == 3
+    for xb, yb in batches:
+        assert xb.shape == (16, 3, 8, 8)
+        assert yb.shape == (16,)
+
+
+def test_dataloader_last_batch_modes():
+    ds = gdata.ArrayDataset(np.arange(10))
+    assert len(list(gdata.DataLoader(ds, 4, last_batch="keep"))) == 3
+    assert len(list(gdata.DataLoader(ds, 4, last_batch="discard"))) == 2
+    loader = gdata.DataLoader(ds, 4, last_batch="rollover")
+    assert len(list(loader)) == 2
+    assert len(list(loader)) == 3  # rolled-over remainder joins
+
+
+def test_threaded_dataloader_matches_serial():
+    X = np.arange(40, dtype="float32").reshape(20, 2)
+    ds = gdata.ArrayDataset(X)
+    serial = [b.asnumpy() for b in gdata.DataLoader(ds, 5)]
+    threaded = [b.asnumpy() for b in gdata.DataLoader(ds, 5,
+                                                      num_workers=3)]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_transform_and_take_filter():
+    ds = gdata.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: 2 * x)
+    assert doubled[4] == 8
+    assert len(ds.take(3)) == 3
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert len(evens) == 5
+
+
+def test_samplers():
+    s = gdata.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    r = list(gdata.RandomSampler(5))
+    assert sorted(r) == [0, 1, 2, 3, 4]
+    b = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "keep")
+    assert [len(x) for x in b] == [2, 2, 1]
+
+
+def test_mnist_dataset(tmp_path):
+    root = str(tmp_path)
+    n, rows, cols = 20, 28, 28
+    imgs = np.random.randint(0, 255, (n, rows, cols), dtype=np.uint8)
+    labs = np.random.randint(0, 10, (n,), dtype=np.uint8)
+    with open(os.path.join(root, "train-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols))
+        f.write(imgs.tobytes())
+    with open(os.path.join(root, "train-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labs.tobytes())
+    mn = gdata.vision.MNIST(root=root, train=True)
+    assert len(mn) == n
+    img, lab = mn[3]
+    assert img.shape == (28, 28, 1)
+    assert int(lab) == labs[3]
+    dl = gdata.DataLoader(mn.transform_first(transforms.ToTensor()), 5)
+    xb, yb = next(iter(dl))
+    assert xb.shape == (5, 1, 28, 28)
+
+
+def test_image_record_dataset(tmp_path):
+    import cv2
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = np.random.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        packed = recordio.pack_img(header, img, quality=95, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+    ds = gdata.vision.ImageRecordDataset(rec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (16, 16, 3)
+    assert float(label) == 2.0
+
+
+def test_transforms_pipeline():
+    img = mx.nd.array(np.random.randint(0, 255, (32, 32, 3)),
+                      dtype="uint8")
+    tr = transforms.Compose([
+        transforms.Resize(24),
+        transforms.CenterCrop(16),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5, 0.5, 0.5], [0.2, 0.2, 0.2]),
+    ])
+    out = tr(img)
+    assert out.shape == (3, 16, 16)
+    flip = transforms.RandomFlipLeftRight()
+    assert flip(img).shape == img.shape
+
+
+def test_image_api_roundtrip():
+    import cv2
+    arr = np.random.randint(0, 255, (32, 40, 3), dtype=np.uint8)
+    ok, buf = cv2.imencode(".png", arr)
+    img = mx.image.imdecode(buf.tobytes())
+    assert img.shape == (32, 40, 3)
+    np.testing.assert_array_equal(img.asnumpy()[..., ::-1],
+                                  cv2.imdecode(buf, 1))
+    small = mx.image.resize_short(img, 24)
+    assert min(small.shape[:2]) == 24
+    crop, rect = mx.image.center_crop(small, (16, 16))
+    assert crop.shape[:2] == (16, 16)
+    aug = mx.image.CreateAugmenter((3, 16, 16), rand_mirror=True,
+                                   mean=True, std=True)
+    out = img
+    for a in aug:
+        out = a(out)
+    assert out.shape == (16, 16, 3)
+
+
+def test_image_iter_last_batch_handle(tmp_path):
+    import cv2
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        img = np.random.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+        packed = recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                   img, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+
+    def count(mode):
+        it = mx.image.ImageIter(2, (3, 8, 8), path_imgrec=rec,
+                                last_batch_handle=mode)
+        n = pads = 0
+        for batch in it:
+            n += 1
+            pads += batch.pad
+        return n, pads
+
+    assert count("pad") == (3, 1)
+    assert count("discard") == (2, 0)
+    it = mx.image.ImageIter(2, (3, 8, 8), path_imgrec=rec,
+                            last_batch_handle="roll_over")
+    assert sum(1 for _ in it) == 2
+    it.reset()
+    assert sum(1 for _ in it) == 3  # remainder rolled into this epoch

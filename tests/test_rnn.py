@@ -1,0 +1,147 @@
+"""Gluon RNN cells + fused layers (reference:
+tests/python/unittest/test_gluon_rnn.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cell_step_and_unroll():
+    cell = rnn.RNNCell(8, input_size=4)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    out, st = cell(x, cell.begin_state(batch_size=2))
+    assert out.shape == (2, 8) and len(st) == 1
+    seq = mx.nd.random.uniform(shape=(2, 5, 4))
+    outs, st = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+
+
+def test_lstm_cell_state_shapes():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 4))
+    out, st = cell(x, cell.begin_state(batch_size=3))
+    assert out.shape == (3, 8)
+    assert [s.shape for s in st] == [(3, 8), (3, 8)]
+
+
+def test_sequential_and_bidirectional():
+    seq = mx.nd.random.uniform(shape=(2, 5, 4))
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.GRUCell(8, input_size=4))
+    stack.add(rnn.RNNCell(6, input_size=8))
+    stack.initialize()
+    outs, st = stack.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 6)
+
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=4),
+                               rnn.LSTMCell(4, input_size=4))
+    bi.initialize()
+    outs, st = bi.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+
+
+def test_modifier_cells():
+    seq = mx.nd.random.uniform(shape=(2, 5, 8))
+    res = rnn.ResidualCell(rnn.GRUCell(8, input_size=8))
+    res.initialize()
+    outs, st = res.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+    drop = rnn.DropoutCell(0.5)
+    out, st = drop(seq, [])
+    assert out.shape == seq.shape
+
+
+@pytest.mark.parametrize("cls,kw", [(rnn.LSTM, {}), (rnn.GRU, {}),
+                                    (rnn.RNN, {"activation": "tanh"})])
+def test_fused_layer_shapes_and_grads(cls, kw):
+    seq = mx.nd.random.uniform(shape=(2, 5, 4))
+    layer = cls(16, num_layers=2, layout="NTC", bidirectional=True,
+                input_size=4, **kw)
+    layer.initialize()
+    with autograd.record():
+        y = layer(seq)
+        loss = y.sum()
+    loss.backward()
+    assert y.shape == (2, 5, 32)
+    assert float(mx.nd.abs(layer.l0_i2h_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_fused_lstm_matches_cell_unroll():
+    seq = mx.nd.random.uniform(shape=(2, 5, 4))
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    fused = rnn.LSTM(8, layout="NTC", input_size=4)
+    fused.initialize()
+    fused.l0_i2h_weight.set_data(cell.i2h_weight.data())
+    fused.l0_h2h_weight.set_data(cell.h2h_weight.data())
+    fused.l0_i2h_bias.set_data(cell.i2h_bias.data())
+    fused.l0_h2h_bias.set_data(cell.h2h_bias.data())
+    co, _ = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+    fo = fused(seq)
+    np.testing.assert_allclose(co.asnumpy(), fo.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_layer_hybridize_and_explicit_state():
+    seq = mx.nd.random.uniform(shape=(2, 5, 4))
+    layer = rnn.LSTM(8, layout="NTC", input_size=4)
+    layer.initialize()
+    eager = layer(seq).asnumpy()
+    layer.hybridize()
+    hybrid = layer(seq).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    out, states = layer(seq, layer.begin_state(batch_size=2))
+    assert out.shape == (2, 5, 8)
+    assert [s.shape for s in states] == [(1, 2, 8), (1, 2, 8)]
+
+
+def test_rnn_layer_trains():
+    """Char-level next-step prediction loss should drop."""
+    np.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Embedding(16, 8))
+    net.add(rnn.LSTM(16, layout="NTC", input_size=8))
+    net.add(gluon.nn.Dense(16, flatten=False))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens = mx.nd.array(np.random.randint(0, 16, (4, 9)))
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            loss = lf(net(x), y)
+        loss.backward()
+        tr.step(4)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_bidirectional_valid_length():
+    """Reverse cell must not see padding before real tokens: outputs for
+    a shorter sample must be independent of its padding content."""
+    np.random.seed(2)
+    base = np.random.rand(2, 4, 3).astype("float32")
+    pad_a = base.copy()
+    pad_b = base.copy()
+    pad_b[0, 2:] = 99.0  # sample 0 valid_length=2; alter only its padding
+    vlen = mx.nd.array([2, 4])
+
+    def run(arr):
+        bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3,
+                                                prefix="l_"),
+                                   rnn.LSTMCell(4, input_size=3,
+                                                prefix="r_"),)
+        bi.initialize(mx.init.One())
+        outs, st = bi.unroll(4, mx.nd.array(arr), layout="NTC",
+                             merge_outputs=True, valid_length=vlen)
+        return outs.asnumpy()
+
+    oa, ob = run(pad_a), run(pad_b)
+    np.testing.assert_allclose(oa[0, :2], ob[0, :2], rtol=1e-5, atol=1e-6)
