@@ -41,3 +41,20 @@ from . import parallel
 from . import module
 from . import monitor
 from .monitor import Monitor
+from . import profiler
+from . import runtime
+from . import test_utils
+from . import visualization
+from . import operator
+from . import registry
+from . import rtc
+from . import library
+from . import libinfo
+from . import util
+from . import name
+from .name import NameManager, Prefix
+from . import attribute
+from .attribute import AttrScope
+from . import contrib
+from . import utils
+from . import models

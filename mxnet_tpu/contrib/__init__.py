@@ -1,0 +1,3 @@
+"""Contrib namespace (reference: python/mxnet/contrib/__init__.py)."""
+
+from . import amp

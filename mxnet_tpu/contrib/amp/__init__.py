@@ -1,0 +1,5 @@
+"""AMP (reference: python/mxnet/contrib/amp/__init__.py)."""
+
+from .amp import *
+from .loss_scaler import LossScaler
+from . import lists

@@ -1,0 +1,104 @@
+"""AMP — automatic mixed precision (reference:
+python/mxnet/contrib/amp/amp.py:78-288).
+
+TPU policy: bfloat16. The reference monkey-patches every op wrapper to
+insert amp_cast pairs; on TPU the policy is simpler and more robust —
+cast the model's MXU-bound parameters/compute to bf16, keep the
+fp32-list layers (norms, softmax heads) in fp32, and let XLA fuse the
+casts away. The MXU accumulates bf16 matmuls in fp32 natively, which is
+the whole reason the reference needed its 'widest dtype' machinery for
+fp16 but bf16 does not."""
+
+from contextlib import contextmanager
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from .lists import symbol as amp_lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "convert_symbol"]
+
+_amp_initialized = False
+_target_dtype = "bfloat16"
+_loss_scaler = None
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP globally (tracked state consumed by init_trainer /
+    scale_loss; models are converted with convert_hybrid_block)."""
+    global _amp_initialized, _target_dtype, _loss_scaler
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    _amp_initialized = True
+    _target_dtype = target_dtype
+    _loss_scaler = LossScaler() if target_dtype == "float16" else None
+
+
+def init_trainer(trainer):
+    """Attach the dynamic loss scaler to a Gluon Trainer (no-op for
+    bf16, where scaling is unnecessary)."""
+    if not _amp_initialized:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = _loss_scaler
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss (fp16 only; bf16 passes through)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    scale = 1.0 / scaler.loss_scale
+    for param in trainer._params:
+        if param.grad_req != "null":
+            grad = param.grad()
+            grad[:] = grad * scale
+
+
+def _fp32_param(name):
+    lname = name.lower()
+    return any(k in lname for k in
+               ("batchnorm", "layernorm", "groupnorm", "instancenorm",
+                "gamma", "beta", "mean", "var"))
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a Gluon block for mixed precision: MXU-bound params ->
+    target dtype, norm-family params stay fp32 (amp_lists.FP32_FUNCS)."""
+    block.cast(target_dtype)
+    for name, param in block.collect_params().items():
+        if _fp32_param(name):
+            param.cast("float32")
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  excluded_sym_names=None):
+    """Cast a symbolic model's parameters (the graph computes in the
+    dtype of its inputs; XLA folds the casts)."""
+    excluded = set(excluded_sym_names or [])
+    new_args = {}
+    for k, v in arg_params.items():
+        new_args[k] = v if (_fp32_param(k) or k in excluded) \
+            else v.astype(target_dtype)
+    new_aux = {k: v.astype("float32") for k, v in aux_params.items()}
+    return sym, new_args, new_aux
+
+
+def convert_symbol(sym, target_dtype="bfloat16", **kwargs):
+    """The graph itself is dtype-polymorphic under XLA tracing; returns
+    the symbol unchanged (casting happens at the parameter/input level)."""
+    return sym

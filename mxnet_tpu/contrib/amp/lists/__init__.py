@@ -1,0 +1,3 @@
+"""AMP op lists."""
+
+from . import symbol

@@ -1,0 +1,38 @@
+"""AMP op lists (reference: python/mxnet/contrib/amp/lists/symbol.py).
+
+On TPU the low-precision dtype is bfloat16: matmul/conv-heavy ops run
+bf16 on the MXU (fp32 accumulation is hardware-native), numerically
+sensitive reductions stay fp32. bf16's fp32-equal exponent range makes
+the reference's 'widest dtype' conditional list mostly unnecessary —
+those ops are safe in bf16 and listed here accordingly."""
+
+# ops that benefit from bf16 (MXU-bound)
+FP16_FUNCS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN",
+    "dot", "batch_dot",
+]
+
+# numerically sensitive: keep fp32
+FP32_FUNCS = [
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "L2Normalization",
+    "LRN", "SoftmaxOutput", "softmax", "log_softmax", "Softmax",
+    "SoftmaxActivation", "exp", "log", "log2", "log10", "log1p", "expm1",
+    "norm", "mean", "sum", "CTCLoss", "MakeLoss", "smooth_l1", "sqrt",
+    "rsqrt", "square", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput",
+]
+
+# elementwise/shape ops safe in either dtype (follow their inputs)
+FP16_FP32_FUNCS = [
+    "Activation", "LeakyReLU", "relu", "sigmoid", "tanh", "Pooling",
+    "Concat", "concat", "slice", "Reshape", "reshape", "transpose",
+    "Flatten", "Dropout", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "add_n", "stack", "clip", "Pad", "pad", "UpSampling", "Embedding",
+]
+
+# reference keeps a 'widest type' list for ops where fp16 overflows;
+# bf16 shares fp32's exponent so these are safe — kept for API parity
+WIDEST_TYPE_CASTS = []
+
+CONDITIONAL_FP32_FUNCS = []

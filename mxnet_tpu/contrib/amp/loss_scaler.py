@@ -1,0 +1,40 @@
+"""Dynamic loss scaling (reference:
+python/mxnet/contrib/amp/loss_scaler.py).
+
+bf16 shares fp32's exponent range, so on TPU loss scaling is a no-op in
+the default bf16 policy; the scaler remains functional for users who
+cast to float16 explicitly."""
+
+import numpy as np
+
+from ... import ndarray as nd
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler(object):
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite."""
+        for param in params:
+            if param.grad_req != "null":
+                grad = param.grad()
+                if not bool(nd.isfinite(grad).min().asnumpy()):
+                    return True
+        return False
+
+    def update_scale(self, skip):
+        if skip:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

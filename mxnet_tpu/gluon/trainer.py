@@ -111,6 +111,16 @@ class Trainer(object):
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer): check
+        # overflow, fold 1/scale into the update, skip the step when any
+        # grad is non-finite
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            skip = scaler.has_overflow(self._params)
+            scaler.update_scale(skip)
+            if skip:
+                return
+            self._optimizer.rescale_grad /= scaler.loss_scale
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):

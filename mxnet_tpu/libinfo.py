@@ -1,0 +1,25 @@
+"""Library info (reference: python/mxnet/libinfo.py find_lib_path /
+__version__). There is no libmxnet.so — the 'library' is the python
+package + the native pipeline extension when built."""
+
+import os
+
+__version__ = "0.1.0"
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+
+def find_lib_path():
+    """Paths of native extensions shipped with the package (the
+    RecordIO/image C++ pipeline), empty if none built."""
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    libs = []
+    native = os.path.join(curr, "native")
+    if os.path.isdir(native):
+        libs += [os.path.join(native, f) for f in os.listdir(native)
+                 if f.endswith(".so")]
+    return libs
+
+
+def find_include_path():
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    return os.path.join(curr, "native", "include")

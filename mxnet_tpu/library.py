@@ -1,0 +1,39 @@
+"""Dynamic operator libraries (reference: python/mxnet/library.py +
+include/mxnet/lib_api.h — load .so files registering extra ops).
+
+TPU-native equivalent: an "op library" is a python module that calls
+mxnet_tpu.ops.register at import. load() imports such a module from a
+file path; compiled CUDA .so op libraries are meaningless here."""
+
+import importlib.util
+import os
+
+from .base import MXNetError
+
+__all__ = ["load"]
+
+
+def load(path, verbose=True):
+    """Load an op-library python file (registers its ops on import)."""
+    if not os.path.exists(path):
+        raise MXNetError("library %s does not exist" % path)
+    if path.endswith(".so") or path.endswith(".dylib"):
+        raise MXNetError(
+            "compiled CUDA op libraries are not loadable in the TPU "
+            "build; ship the op as a python module that registers jax "
+            "kernels via mxnet_tpu.ops.register")
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    before = set(_registered_ops())
+    spec.loader.exec_module(module)
+    if verbose:
+        added = set(_registered_ops()) - before
+        print("loaded library %s (registered ops: %s)"
+              % (path, sorted(added) if added else "none"))
+    return module
+
+
+def _registered_ops():
+    from . import ops
+    return ops.list_ops() if hasattr(ops, "list_ops") else []

@@ -1,0 +1,150 @@
+"""Custom Python operators (reference: python/mxnet/operator.py
+CustomOp:428 / CustomOpProp:474 / register:694; C++ host
+src/operator/custom/custom.cc runs the callbacks on a dedicated
+thread).
+
+TPU-native scope: custom ops execute EAGERLY on the host between XLA
+computations (the autograd tape records their backward like any other
+op). Inside hybridized/jit graphs they are not supported — a Python
+callback inside a compiled TPU program would stall the device (the
+reference has the same wart: custom ops break graph fusion and
+cross-device async). Use nd.Custom / mx.operator for the eager path."""
+
+from .base import MXNetError
+from . import autograd
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "Custom"]
+
+_REGISTRY = {}
+
+
+class CustomOp(object):
+    """Base class for custom eager operators."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign src to dst honouring the grad req."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %s" % req)
+
+
+class CustomOpProp(object):
+    """Describes a custom op: arguments, outputs, shapes, types."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under a name."""
+
+    def do_register(prop_cls):
+        assert issubclass(prop_cls, CustomOpProp), \
+            "can only register subclass of CustomOpProp"
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+class _CustomFunction(autograd.Function):
+    def __init__(self, op, prop, num_outputs):
+        super(_CustomFunction, self).__init__()
+        self._op = op
+        self._prop = prop
+        self._num_outputs = num_outputs
+        self._in_data = None
+        self._out_data = None
+
+    def __call__(self, *inputs):
+        # capture training state BEFORE Function.__call__ wraps forward in
+        # autograd.pause() (which would make is_recording() always False)
+        self._is_train = autograd.is_recording()
+        return super(_CustomFunction, self).__call__(*inputs)
+
+    def forward(self, *inputs):
+        from . import ndarray as nd
+        out_shapes = self._prop.infer_shape(
+            [i.shape for i in inputs])[1]
+        in_types = [i.dtype for i in inputs]
+        out_types = self._prop.infer_type(in_types)[1]
+        outputs = [nd.zeros(s, dtype=t)
+                   for s, t in zip(out_shapes, out_types)]
+        self._op.forward(is_train=self._is_train,
+                         req=["write"] * len(outputs),
+                         in_data=list(inputs), out_data=outputs, aux=[])
+        self._in_data = list(inputs)
+        self._out_data = outputs
+        return outputs if len(outputs) > 1 else outputs[0]
+
+    def backward(self, *out_grads):
+        from . import ndarray as nd
+        in_grads = [nd.zeros(i.shape, dtype=i.dtype)
+                    for i in self._in_data]
+        self._op.backward(req=["write"] * len(in_grads),
+                          out_grad=list(out_grads),
+                          in_data=self._in_data,
+                          out_data=self._out_data,
+                          in_grad=in_grads, aux=[])
+        return in_grads if len(in_grads) > 1 else in_grads[0]
+
+
+def Custom(*inputs, **kwargs):
+    """nd.Custom(*data, op_type='my_op', **op_kwargs) — eager custom op
+    invocation (reference MXImperativeInvoke on the 'Custom' op)."""
+    op_type = kwargs.pop("op_type", None)
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    if op_type not in _REGISTRY:
+        raise MXNetError(
+            "custom op type %s is not registered; registered: %s"
+            % (op_type, sorted(_REGISTRY)))
+    prop = _REGISTRY[op_type](**kwargs)
+    op = prop.create_operator(None, [i.shape for i in inputs],
+                              [i.dtype for i in inputs])
+    fn = _CustomFunction(op, prop, len(prop.list_outputs()))
+    return fn(*inputs)

@@ -122,6 +122,9 @@ _UNARY = [
     ("relu", lambda x: jnp.maximum(x, 0)),
     ("softsign", lambda x: x / (1 + jnp.abs(x))),
     ("logical_not", lambda x: (x == 0).astype(x.dtype)),
+    ("isfinite", lambda x: jnp.isfinite(x).astype(jnp.float32)),
+    ("isnan", lambda x: jnp.isnan(x).astype(jnp.float32)),
+    ("isinf", lambda x: jnp.isinf(x).astype(jnp.float32)),
 ]
 
 

@@ -237,7 +237,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     shape[ax] = data.shape[ax]
     xhat = (data - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
     out = xhat * g.reshape(shape) + beta.reshape(shape)
-    return out, mean, var
+    # mixed precision: fp32 gamma/beta with bf16 data must not upcast
+    # the activation stream (AMP keeps norm params fp32)
+    return out.astype(data.dtype), mean, var
 
 
 @register(name="LayerNorm")

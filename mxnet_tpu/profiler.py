@@ -1,0 +1,207 @@
+"""mx.profiler — profiling API over jax.profiler/XPlane.
+
+Reference: python/mxnet/profiler.py:33-474 (set_config/set_state/dump +
+Domain/Task/Frame/Event/Counter/Marker) backed by the native
+chrome://tracing profiler (src/profiler/profiler.h:251, DumpProfile:299).
+
+TPU-native design: device-side op timing comes from XLA's profiler
+(jax.profiler.start_trace -> TensorBoard/XPlane, the TPU analogue of the
+reference's chrome tracing); the user-facing Domain/Task/Event/Counter
+objects emit jax.profiler.TraceAnnotation spans on the host timeline and
+also record into a python-side ring so `dumps()` works without a trace
+viewer."""
+
+import threading
+import time
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dumps", "pause", "resume",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": True, "profile_api": True,
+           "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_records = []
+_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference profiler.set_config). The
+    `filename` stem names the trace directory for the XLA trace dump."""
+    for k, v in kwargs.items():
+        _config[k] = v
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts a jax profiler trace; 'stop' ends it and writes the
+    XPlane trace next to `filename`."""
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    if state == "run" and not _state["running"]:
+        trace_dir = str(_config["filename"]) + ".tracedir"
+        _state["dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+        _state["running"] = True
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    if not _state["running"]:
+        set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    """Stop any running trace so the files hit disk."""
+    if _state["running"] and finished:
+        set_state("stop")
+
+
+def dumps(reset=False):
+    """Text dump of python-side recorded events (reference returns the
+    aggregate stats table)."""
+    with _lock:
+        lines = ["Profile Statistics:",
+                 "%-32s %-16s %-12s" % ("Name", "Kind", "Duration/Value")]
+        for name, kind, value in _records:
+            lines.append("%-32s %-16s %-12s" % (name, kind, value))
+        if reset:
+            del _records[:]
+    return "\n".join(lines)
+
+
+def _record(name, kind, value):
+    with _lock:
+        _records.append((name, kind, value))
+
+
+class Domain(object):
+    """Grouping namespace for profiler objects."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __str__(self):
+        return self.name
+
+
+class _Span(object):
+    """start()/stop() span; emits a TraceAnnotation on the host
+    timeline."""
+
+    kind = "span"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        self._t0 = time.time()
+        self._ann = jax.profiler.TraceAnnotation(
+            "%s::%s" % (self.domain, self.name))
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            _record(self.name, self.kind, "%.6fs" % (time.time() - self._t0))
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    kind = "task"
+
+
+class Frame(_Span):
+    kind = "frame"
+
+
+class Event(_Span):
+    kind = "event"
+
+    def __init__(self, name):
+        super(Event, self).__init__("event", name)
+
+
+class Counter(object):
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _record(self.name, "counter", str(value))
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+    def __str__(self):
+        return self.name
+
+
+class Marker(object):
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record(self.name, "marker", scope)

@@ -1,0 +1,67 @@
+"""Runtime kernel compilation (reference: python/mxnet/rtc.py CudaModule
+over NVRTC, src/common/rtc.cc).
+
+TPU-native equivalent: runtime-compiled kernels are Pallas kernels, not
+CUDA C. `PallasModule` fills the CudaModule role: wrap a python kernel
+function and get a launchable Kernel. The CUDA-source API is kept for
+source compatibility but raises — there is no NVRTC on TPU."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel", "PallasModule"]
+
+
+class CudaModule(object):
+    """Source-compat stub: CUDA runtime compilation is unavailable on
+    TPU. Use PallasModule with a Pallas kernel function instead."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CudaModule (NVRTC) is not available in the TPU build; write "
+            "the kernel as a Pallas function and wrap it in "
+            "mxnet_tpu.rtc.PallasModule instead")
+
+
+class CudaKernel(object):
+    def __init__(self, *args, **kwargs):
+        raise MXNetError("CudaKernel is not available in the TPU build")
+
+
+class PallasModule(object):
+    """Wraps Pallas kernel functions for launch, mirroring
+    CudaModule.get_kernel.
+
+    kernels: dict name -> (kernel_fn, out_shape_fn) where kernel_fn is a
+    Pallas kernel body and out_shape_fn(*inputs) returns the
+    jax.ShapeDtypeStruct (or list) of outputs.
+    """
+
+    def __init__(self, **kernels):
+        self._kernels = kernels
+
+    def get_kernel(self, name, signature=None):
+        if name not in self._kernels:
+            raise MXNetError("kernel %s not found; have %s"
+                             % (name, sorted(self._kernels)))
+        kernel_fn, out_shape_fn = self._kernels[name]
+
+        class _Kernel(object):
+            def launch(self, args, ctx=None, grid_dims=None,
+                       block_dims=None, shared_mem=0):
+                # block_dims/shared_mem are CUDA launch-config concepts;
+                # Pallas expresses blocking via BlockSpecs in kernel_fn
+                if block_dims is not None or shared_mem:
+                    raise MXNetError(
+                        "block_dims/shared_mem are not applicable to "
+                        "Pallas kernels; express blocking with BlockSpec")
+                datas = [a._data if hasattr(a, "_data") else a
+                         for a in args]
+                kw = {"grid": grid_dims} if grid_dims is not None else {}
+                call = pl.pallas_call(kernel_fn,
+                                      out_shape=out_shape_fn(*datas), **kw)
+                return call(*datas)
+
+        return _Kernel()

@@ -1,0 +1,77 @@
+"""Runtime feature detection (reference: python/mxnet/runtime.py and
+src/libinfo.cc:39-90 — compile-time USE_* flags surfaced at runtime).
+
+TPU-native equivalents: features reflect what this build actually
+provides (XLA/TPU/Pallas/mesh collectives) plus the reference flag names
+that map onto them; CUDA-era flags report disabled."""
+
+import collections
+import jax
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    try:
+        platform = jax.default_backend()
+        has_tpu = platform == "tpu" or any(
+            d.platform == "tpu" for d in jax.devices())
+    except Exception:  # pragma: no cover - backend init failure
+        has_tpu = False
+    try:
+        import cv2  # noqa: F401
+        has_cv = True
+    except ImportError:
+        has_cv = False
+    feats = {
+        # TPU-native capabilities
+        "TPU": has_tpu,
+        "XLA": True,
+        "PALLAS": True,
+        "MESH_COLLECTIVES": True,
+        "BF16": True,
+        # reference flag names (src/libinfo.cc) mapped to this build
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False, "OPENMP": False, "BLAS_OPEN": False,
+        "CPU_SSE": False, "CPU_AVX": False,
+        "OPENCV": has_cv,
+        "DIST_KVSTORE": True,   # dist_tpu_sync over XLA collectives
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "PROFILER": True,
+    }
+    return feats
+
+
+class Features(dict):
+    """dict of name -> Feature with `is_enabled` (reference
+    runtime.Features)."""
+
+    instance = None
+
+    def __new__(cls):
+        if cls.instance is None:
+            cls.instance = super(Features, cls).__new__(cls)
+            dict.__init__(cls.instance,
+                          [(n, Feature(n, e))
+                           for n, e in _detect().items()])
+        return cls.instance
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(
+            "%s%s" % ("✔ " if f.enabled else "✖ ", f.name)
+            for f in self.values())
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown, known features "
+                               "are: %s" % (feature_name, list(self)))
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """List of Feature tuples (reference mx.runtime.feature_list)."""
+    return list(Features().values())

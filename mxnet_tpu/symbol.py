@@ -142,10 +142,9 @@ _name_counter = {}
 
 
 def _auto_name(op_name):
+    from .name import NameManager
     base = op_name.lower().lstrip("_")
-    idx = _name_counter.get(base, 0)
-    _name_counter[base] = idx + 1
-    return "%s%d" % (base, idx)
+    return NameManager.current().get(None, base)
 
 
 class Symbol:
@@ -635,6 +634,11 @@ def _make_sym_func(op_name):
                         node.attrs["__aux__"] = True
         attrs["__input_names__"] = tuple(n or "arg%d" % i
                                          for i, n in enumerate(input_names))
+        from .attribute import AttrScope
+        scoped = AttrScope.current().get(attr)
+        if scoped:
+            attrs.update(("__%s__" % k if not k.startswith("__") else k, v)
+                         for k, v in scoped.items())
         return _compose(op_name, input_syms, attrs, nm)
 
     func.__name__ = op_name

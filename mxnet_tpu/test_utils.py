@@ -1,0 +1,263 @@
+"""mx.test_utils — testing helpers.
+
+Reference: python/mxnet/test_utils.py (assert_almost_equal,
+check_numeric_gradient, check_symbolic_forward/backward,
+check_consistency, default_context, rand_ndarray, ...). The
+cross-backend `check_consistency` here compares the CPU interpreter
+against the compiled TPU path (SURVEY §4 takeaway (2)) when a TPU is
+attached, else eager-vs-hybridized."""
+
+import numbers
+
+import numpy as np
+
+from . import context
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "random_arrays",
+           "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency",
+           "numeric_grad", "simple_forward", "assert_exception"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None \
+        else context.current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _as_numpy(a):
+    return a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(_as_numpy(a), _as_numpy(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _as_numpy(a), _as_numpy(b)
+    if not almost_equal(a, b, rtol, atol, equal_nan):
+        index = np.unravel_index(
+            np.argmax(np.abs(a - b) - atol - rtol * np.abs(b)), a.shape)
+        rel = np.abs(a - b) / (np.abs(b) + atol)
+        raise AssertionError(
+            "Items are not equal (rtol=%g, atol=%g): max rel err %g at "
+            "%s: %s=%r %s=%r" % (rtol, atol, np.nanmax(rel), str(index),
+                                 names[0], a[index], names[1], b[index]))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution="uniform"):
+    if stype != "default":
+        from .ndarray import sparse
+        return sparse.rand_sparse_ndarray(shape, stype, density=density,
+                                          dtype=dtype)[0] \
+            if hasattr(sparse, "rand_sparse_ndarray") else \
+            nd.array(np.random.uniform(size=shape), dtype=dtype)
+    if distribution == "normal":
+        return nd.array(np.random.normal(size=shape), dtype=dtype)
+    return nd.array(np.random.uniform(size=shape), dtype=dtype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.array(np.random.randn(), dtype=np.float32) if len(s) == 0
+              else np.random.randn(*s).astype(np.float32) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def simple_forward(sym_, ctx=None, is_train=False, **inputs):
+    """Bind a symbol with input arrays and run one forward."""
+    shapes = {k: v.shape for k, v in inputs.items()}
+    ex = sym_.simple_bind(ctx or default_context(), **shapes)
+    for k, v in inputs.items():
+        ex.arg_dict[k][:] = v
+    ex.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in ex.outputs]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=np.float32):
+    """Finite-difference gradients of the executor's scalar-summed
+    output wrt `location` (reference test_utils.numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=dtype)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        old_value = v.copy()
+        for i in range(int(np.prod(v.shape)) if v.shape else 1):
+            if v.shape:
+                idx = np.unravel_index(i, v.shape)
+            else:
+                idx = ()
+            v_p = old_value.copy()
+            v_p[idx] += eps / 2
+            executor.arg_dict[k][:] = v_p
+            executor.forward(is_train=use_forward_train)
+            f_p = sum(float(o.asnumpy().sum()) for o in executor.outputs)
+            v_m = old_value.copy()
+            v_m[idx] -= eps / 2
+            executor.arg_dict[k][:] = v_m
+            executor.forward(is_train=use_forward_train)
+            f_m = sum(float(o.asnumpy().sum()) for o in executor.outputs)
+            approx_grads[k][idx] = (f_p - f_m) / eps
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym_, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True,
+                           ctx=None, grad_stype_dict=None, dtype=np.float64):
+    """Finite-difference check of the symbol's gradients (reference
+    check_numeric_gradient — SURVEY §4 load-bearing pattern (1))."""
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        arg_names = sym_.list_arguments()
+        location = dict(zip(arg_names, location))
+    location = {k: np.asarray(v, dtype=np.float32)
+                for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = [k for k in sym_.list_arguments() if k in location]
+
+    ex = sym_.simple_bind(ctx, grad_req={
+        k: "write" if k in grad_nodes else "null"
+        for k in sym_.list_arguments()},
+        **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = v
+    ex.forward(is_train=use_forward_train)
+    ex.backward([nd.ones(o.shape) for o in ex.outputs])
+    analytic = {k: ex.grad_dict[k].asnumpy() for k in grad_nodes
+                if ex.grad_dict.get(k) is not None}
+    numeric = numeric_grad(ex, {k: location[k] for k in grad_nodes},
+                           eps=numeric_eps,
+                           use_forward_train=use_forward_train)
+    for k in grad_nodes:
+        assert_almost_equal(analytic[k], numeric[k], rtol=rtol,
+                            atol=atol if atol is not None else 1e-4,
+                            names=("analytic_%s" % k, "numeric_%s" % k))
+
+
+def check_symbolic_forward(sym_, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None,
+                           equal_nan=False, dtype=np.float32):
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym_.list_arguments(), location))
+    ex = sym_.simple_bind(ctx, **{k: np.asarray(v).shape
+                                  for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = np.asarray(v, dtype=dtype)
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = v
+    ex.forward(is_train=False)
+    for out, exp in zip(ex.outputs, expected):
+        assert_almost_equal(out.asnumpy(), exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            equal_nan=equal_nan)
+    return ex.outputs
+
+
+def check_symbolic_backward(sym_, location, out_grads, expected,
+                            rtol=1e-5, atol=None, aux_states=None,
+                            grad_req="write", ctx=None, equal_nan=False,
+                            dtype=np.float32):
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym_.list_arguments(), location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym_.list_arguments(), expected))
+    ex = sym_.simple_bind(ctx, **{k: np.asarray(v).shape
+                                  for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = np.asarray(v, dtype=dtype)
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = v
+    ex.forward(is_train=True)
+    ex.backward([g if isinstance(g, nd.NDArray) else nd.array(g)
+                 for g in out_grads])
+    for k, exp in expected.items():
+        if ex.grad_dict.get(k) is None:
+            continue
+        assert_almost_equal(ex.grad_dict[k].asnumpy(), exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            equal_nan=equal_nan)
+    return ex.grad_dict
+
+
+def check_consistency(sym_, ctx_list=None, scale=1.0, rtol=1e-3, atol=1e-4,
+                      arg_params=None):
+    """Run the symbol on multiple contexts (or eager CPU vs jit TPU when
+    ctx_list omitted) and compare outputs — the reference's CPU-vs-GPU
+    harness (test_utils.check_consistency)."""
+    if ctx_list is None:
+        ctxs = [context.cpu()]
+        if context.num_tpus():
+            ctxs.append(context.tpu())
+        ctx_list = [{"ctx": c} for c in ctxs]
+    shapes = None
+    outputs = []
+    for spec in ctx_list:
+        ctx = spec["ctx"] if isinstance(spec, dict) else spec
+        shape_kwargs = {k: v for k, v in (spec.items()
+                                          if isinstance(spec, dict) else [])
+                        if k != "ctx" and isinstance(v, tuple)}
+        if shapes is None:
+            shapes = shape_kwargs
+        ex = sym_.simple_bind(ctx, **shapes)
+        if arg_params is None:
+            np.random.seed(0)
+            arg_params = {k: np.random.normal(
+                size=ex.arg_dict[k].shape) * scale
+                for k in ex.arg_dict}
+        for k, v in arg_params.items():
+            ex.arg_dict[k][:] = v
+        ex.forward(is_train=False)
+        outputs.append([o.asnumpy() for o in ex.outputs])
+    for other in outputs[1:]:
+        for a, b in zip(outputs[0], other):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol)
+    return outputs
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("Did not raise %s" % exception_type.__name__)

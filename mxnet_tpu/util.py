@@ -1,0 +1,59 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+
+import functools
+import inspect
+import threading
+
+__all__ = ["makedirs", "use_np_shape", "is_np_shape", "set_np_shape",
+           "np_shape", "wraps_safely"]
+
+_np_shape_flag = threading.local()
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)
+
+
+def set_np_shape(active):
+    """Enable/disable NumPy shape semantics (zero-dim/zero-size arrays).
+    The TPU build always supports them natively; the flag is kept for
+    source compatibility and gates mx.np array creation defaults."""
+    prev = getattr(_np_shape_flag, "value", False)
+    _np_shape_flag.value = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return getattr(_np_shape_flag, "value", False)
+
+
+class np_shape(object):
+    """Context manager / decorator form of set_np_shape."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with np_shape(self._active):
+                return func(*args, **kwargs)
+        return wrapper
+
+
+use_np_shape = np_shape
+
+
+def wraps_safely(obj, attr_list=functools.WRAPPER_ASSIGNMENTS):
+    """functools.wraps tolerant of missing attributes."""
+    safe = [a for a in attr_list if hasattr(obj, a)]
+    return functools.wraps(obj, assigned=safe)

@@ -1,0 +1,104 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary and plot_network over the Symbol graph)."""
+
+from .symbol import Symbol
+from .base import MXNetError
+
+
+def _src_name(input_sym):
+    """Name of the node feeding an input slot (inputs hold
+    (Symbol, out_index) pairs)."""
+    ni, _ = input_sym._outputs[0]
+    return input_sym._nodes[ni].name
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary table of a Symbol graph."""
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    shape_dict = {}
+    if shape is not None:
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+        shape_dict = dict(zip(symbol.list_arguments(), arg_shapes))
+
+    total_params = 0
+    nodes = symbol._active_nodes()
+    for node in nodes:
+        name = node.name
+        if node.is_var():
+            op = "Variable"
+            out_shape = shape_dict.get(name, "")
+            params = 0
+            if name in shape_dict and name != "data" \
+                    and not name.endswith("label"):
+                params = 1
+                for d in shape_dict[name]:
+                    params *= d
+            prev = ""
+        else:
+            op = node.op
+            out_shape = ""
+            params = 0
+            prev = ",".join(_src_name(inp) for inp, _ in node.inputs[:3])
+        total_params += params
+        print_row(["%s (%s)" % (name, op), str(out_shape), params, prev],
+                  positions)
+        print("_" * line_length)
+    print("Total params: %d" % total_params)
+    print("=" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the Symbol (requires the python
+    graphviz package; raises a clear error when absent)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError(
+            "plot_network requires the graphviz python package")
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title, format=save_format)
+    hidden = set()
+    for node in symbol._active_nodes():
+        name = node.name
+        if node.is_var():
+            if hide_weights and name != "data" \
+                    and not name.endswith("label"):
+                hidden.add(name)
+                continue
+            dot.node(name, label=name, shape="oval")
+        else:
+            dot.node(name, label="%s\n%s" % (name, node.op), shape="box",
+                     **node_attrs)
+    for node in symbol._active_nodes():
+        if node.is_var():
+            continue
+        for inp, _ in node.inputs:
+            src_name = _src_name(inp)
+            if src_name not in hidden:
+                dot.edge(src_name, node.name)
+    return dot

@@ -1,0 +1,153 @@
+"""Aux subsystems: profiler, runtime features, test_utils, custom ops,
+AMP, name/attr scoping, visualization (SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+import mxnet_tpu.operator as mxop
+from mxnet_tpu.contrib import amp
+from mxnet_tpu import test_utils as tu
+
+
+def test_custom_op_forward_backward():
+    class Sigmoid(mxop.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], mx.nd.sigmoid(in_data[0]))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mxop.register("test_sigmoid")
+    class SigmoidProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid()
+
+    x = mx.nd.array(np.random.randn(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = mxop.Custom(x, op_type="test_sigmoid")
+        y.sum().backward()
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, atol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref * (1 - ref),
+                               atol=1e-5)
+
+
+def test_custom_op_unknown_type():
+    with pytest.raises(mx.MXNetError):
+        mxop.Custom(mx.nd.zeros((2,)), op_type="never_registered")
+
+
+def test_amp_convert_hybrid_block():
+    amp.init()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm(), gluon.nn.Dense(2))
+    net.initialize()
+    net(mx.nd.zeros((2, 4)))
+    amp.convert_hybrid_block(net)
+    params = net.collect_params()
+    assert str(params["dense0_weight"].data().dtype) == "bfloat16"
+    assert str(params["batchnorm0_gamma"].data().dtype) == "float32"
+    y = net(mx.nd.zeros((2, 4), dtype="bfloat16"))
+    assert str(y.dtype) == "bfloat16"
+
+
+def test_amp_loss_scaler():
+    from mxnet_tpu.contrib.amp import LossScaler
+    s = LossScaler(init_scale=1024.0)
+    s.update_scale(skip=True)
+    assert s.loss_scale == 512.0
+    for _ in range(s._scale_window):
+        s.update_scale(skip=False)
+    assert s.loss_scale == 1024.0
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert any(f.name == "TPU" for f in mx.runtime.feature_list())
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NO_SUCH_FEATURE")
+
+
+def test_profiler_objects():
+    mx.profiler.set_config(filename="/tmp/mxtpu_prof.json")
+    d = mx.profiler.Domain("unit")
+    with d.new_task("tsk"):
+        pass
+    c = d.new_counter("ctr", 5)
+    c += 3
+    m = d.new_marker("mk")
+    m.mark()
+    out = mx.profiler.dumps(reset=True)
+    assert "tsk" in out and "ctr" in out and "mk" in out
+
+
+def test_name_and_attr_scope():
+    with mx.name.Prefix("pre_"):
+        assert mx.name.NameManager.current().get(None, "conv") == \
+            "pre_conv0"
+    with mx.AttrScope(ctx_group="dev1", lr_mult="2"):
+        assert mx.AttrScope.current().get({"x": "y"})["ctx_group"] == "dev1"
+    # scope restored
+    assert mx.AttrScope.current().get(None) == {}
+
+
+def test_test_utils_numeric_gradient():
+    data = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(data, num_hidden=3, no_bias=True, name="fc")
+    w = np.random.rand(3, 4).astype("float32")
+    xv = np.random.rand(2, 4).astype("float32")
+    tu.check_numeric_gradient(s, {"data": xv, "fc_weight": w})
+    tu.check_symbolic_forward(s, {"data": xv, "fc_weight": w},
+                              [xv.dot(w.T)], rtol=1e-4)
+    tu.check_symbolic_backward(
+        s, {"data": xv, "fc_weight": w}, [np.ones((2, 3), np.float32)],
+        {"data": np.ones((2, 3), np.float32).dot(w)}, rtol=1e-4)
+
+
+def test_test_utils_assert_helpers():
+    tu.assert_almost_equal(np.ones(3), np.ones(3))
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(np.ones(3), np.zeros(3))
+    assert tu.same(np.arange(3), np.arange(3))
+    assert tu.rand_ndarray((2, 3)).shape == (2, 3)
+    assert len(tu.rand_shape_nd(3, dim=4)) == 3
+
+
+def test_visualization_summary():
+    data = mx.sym.Variable("data")
+    s = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=3,
+                                                name="fc"),
+                          act_type="relu")
+    total = mx.visualization.print_summary(s, shape={"data": (2, 4)})
+    assert total == 3 * 4 + 3
+
+
+def test_registry_module():
+    from mxnet_tpu import registry
+
+    class Base(object):
+        pass
+
+    reg = registry.get_register_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @reg
+    class Foo(Base):
+        pass
+
+    assert isinstance(create("foo"), Foo)
+    with pytest.raises(mx.MXNetError):
+        create("bar")
+
+
+def test_rtc_and_library_stubs():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k(){}")
+    with pytest.raises(mx.MXNetError):
+        mx.library.load("/nonexistent/lib.so")
