@@ -37,6 +37,8 @@ from . import io
 from . import kvstore
 from . import callback
 from . import model
+from . import sparse
+ndarray.sparse = sparse  # compressed-storage sparse module (nd.sparse)
 from . import parallel
 from . import module
 from . import monitor
@@ -58,3 +60,5 @@ from .attribute import AttrScope
 from . import contrib
 from . import utils
 from . import models
+from . import numpy as np
+from . import numpy_extension as npx

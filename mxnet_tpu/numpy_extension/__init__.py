@@ -1,0 +1,173 @@
+"""mx.npx — NumPy-extension operators (reference:
+python/mxnet/numpy_extension/ — neural-net ops with numpy-semantics
+arrays, set_np/reset_np switches)."""
+
+import jax
+import jax.numpy as jnp
+
+from .. import util as _util
+from .. import ndarray as _classic
+from ..numpy import ndarray as np_ndarray, _wrap, _unwrap
+
+__all__ = ["set_np", "reset_np", "is_np_array", "use_np", "relu",
+           "sigmoid", "softmax", "log_softmax", "topk", "pick",
+           "one_hot", "gamma", "erf", "erfinv", "batch_dot",
+           "reshape_like", "batch_flatten", "save", "load", "seed",
+           "waitall"]
+
+_np_array_active = [False]
+
+
+def set_np(shape=True, array=True):
+    """Enable NumPy semantics globally (reference npx.set_np)."""
+    _util.set_np_shape(shape)
+    _np_array_active[0] = array
+
+
+def reset_np():
+    _util.set_np_shape(False)
+    _np_array_active[0] = False
+
+
+def is_np_array():
+    return _np_array_active[0]
+
+
+class use_np(object):
+    """Decorator/context enabling np semantics inside. Supports
+    @use_np, @use_np(), and `with use_np():` forms."""
+
+    def __init__(self, func=None):
+        self._func = func
+
+    def _snapshot(self):
+        return (_np_array_active[0], _util.is_np_shape())
+
+    def _restore(self, snap):
+        _np_array_active[0] = snap[0]
+        _util.set_np_shape(snap[1])
+
+    def __call__(self, *args, **kwargs):
+        if self._func is None:
+            # @use_np() form: the single argument is the function
+            if len(args) == 1 and callable(args[0]) and not kwargs:
+                return use_np(args[0])
+            raise TypeError("use_np() expects a callable to decorate")
+        snap = self._snapshot()
+        set_np()
+        try:
+            return self._func(*args, **kwargs)
+        finally:
+            self._restore(snap)
+
+    def __enter__(self):
+        self._prev = self._snapshot()
+        set_np()
+        return self
+
+    def __exit__(self, *exc):
+        self._restore(self._prev)
+
+
+def relu(x):
+    return _wrap(jnp.maximum(_unwrap(x), 0))
+
+
+def sigmoid(x):
+    return _wrap(jax.nn.sigmoid(_unwrap(x)))
+
+
+def softmax(x, axis=-1):
+    return _wrap(jax.nn.softmax(_unwrap(x), axis=axis))
+
+
+def log_softmax(x, axis=-1):
+    return _wrap(jax.nn.log_softmax(_unwrap(x), axis=axis))
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    d = _unwrap(data)
+    d_move = jnp.moveaxis(d, axis, -1)
+    if is_ascend:
+        d_move = -d_move
+    vals, idx = jax.lax.top_k(d_move, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "indices":
+        return _wrap(idx)
+    if ret_typ == "value":
+        return _wrap(vals)
+    # 'both' = [values, indices] (ordering_op-inl.h:62-63)
+    return _wrap(vals), _wrap(idx)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    d, i = _unwrap(data), _unwrap(index).astype(jnp.int32)
+    out = jnp.take_along_axis(d, jnp.expand_dims(i, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return _wrap(out)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype=None):
+    out = jax.nn.one_hot(_unwrap(data).astype(jnp.int32), depth,
+                         dtype=dtype or jnp.float32)
+    return _wrap(out * (on_value - off_value) + off_value)
+
+
+def gamma(x):
+    return _wrap(jnp.exp(jax.scipy.special.gammaln(_unwrap(x))))
+
+
+def erf(x):
+    return _wrap(jax.scipy.special.erf(_unwrap(x)))
+
+
+def erfinv(x):
+    return _wrap(jax.scipy.special.erfinv(_unwrap(x)))
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    a, b = _unwrap(a), _unwrap(b)
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return _wrap(jnp.matmul(a, b))
+
+
+def reshape_like(lhs, rhs):
+    return _wrap(jnp.reshape(_unwrap(lhs), _unwrap(rhs).shape))
+
+
+def batch_flatten(x):
+    d = _unwrap(x)
+    return _wrap(d.reshape(d.shape[0], -1))
+
+
+def save(file, arr):
+    from .. import ndarray as nd
+    nd.save(file, {k: _classic.NDArray(_unwrap(v))
+                   for k, v in arr.items()}
+            if isinstance(arr, dict) else
+            [_classic.NDArray(_unwrap(a)) for a in arr])
+
+
+def load(file):
+    from .. import ndarray as nd
+    out = nd.load(file)
+    if isinstance(out, dict):
+        return {k: _wrap(v._data) for k, v in out.items()}
+    return [_wrap(v._data) for v in out]
+
+
+def seed(s):
+    from .. import random as _rand
+    _rand.seed(s)
+
+
+def waitall():
+    from .. import ndarray as nd
+    nd.waitall()

@@ -198,6 +198,18 @@ class Optimizer(object):
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
+    def _sparse_rows(self, grad):
+        """(row_indices, row_grads) when grad is row_sparse, else None —
+        enables lazy updates touching only referenced rows (reference
+        sparse sgd/adagrad kernels, optimizer_op.cc:47-893)."""
+        from .sparse import RowSparseNDArray
+        if not isinstance(grad, RowSparseNDArray):
+            return None
+        g = grad._sp_data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return grad._sp_indices, g
+
     def __getstate__(self):
         ret = self.__dict__.copy()
         return ret
@@ -254,6 +266,18 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        sparse = self._sparse_rows(grad) if self.lazy_update else None
+        if sparse is not None:
+            rows, g = sparse
+            w = weight._data
+            if state is not None:
+                m = state._data[rows] * self.momentum - lr * (
+                    g + wd * w[rows])
+                state._data = state._data.at[rows].set(m)
+                weight._data = w.at[rows].add(m)
+            else:
+                weight._data = w.at[rows].add(-lr * (g + wd * w[rows]))
+            return
         g = self._preprocess_grad(grad)
         if state is not None:
             weight._data, state._data = _sgd_mom_update(
@@ -481,6 +505,17 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        sparse = self._sparse_rows(grad)
+        if sparse is not None:
+            # sparse adagrad (optimizer_op.cc:893): history/update only on
+            # referenced rows
+            rows, g = sparse
+            g = g + wd * weight._data[rows]
+            hist = state._data[rows] + g * g
+            state._data = state._data.at[rows].set(hist)
+            weight._data = weight._data.at[rows].add(
+                -lr * g / (jnp.sqrt(hist) + self.float_stable_eps))
+            return
         g = self._preprocess_grad(grad) + wd * weight._data
         state._data = state._data + g * g
         weight._data = weight._data - lr * g / (

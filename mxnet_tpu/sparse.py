@@ -1,0 +1,290 @@
+"""Sparse NDArrays — row_sparse and CSR storage.
+
+Reference: include/mxnet/ndarray.h:61-66 (storage types),
+src/operator/tensor/ (cast_storage, sparse dot in dot-inl.h,
+sparse_retain), python/mxnet/ndarray/sparse.py.
+
+TPU-native design (SURVEY §7 hard part (a)): the TPU has no sparse
+memory ops, so sparse arrays keep their compressed parts
+(data/indices/indptr) as dense jax arrays and compute lowers to
+gather/scatter/segment-sum — which XLA maps well — rather than
+pointer-chasing kernels. Dense materialization is lazy and cached.
+row_sparse exists for its real use-case: touching only the rows a batch
+referenced (embedding grads, lazy optimizer updates, kvstore
+row_sparse_pull)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, array as _nd_array, zeros as _nd_zeros
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "BaseSparseNDArray", "retain",
+           "cast_storage", "dot", "add", "zeros",
+           "rand_sparse_ndarray"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior: lazy dense materialization through ._data."""
+
+    __slots__ = ("_dense_cache",)
+
+    def __init__(self, shape, ctx=None, stype="default"):
+        self._dense_cache = None
+        self._shape = shape
+        super(BaseSparseNDArray, self).__init__(None, ctx, stype=stype)
+
+    # NDArray stores the payload in _data; for sparse arrays that slot
+    # is a lazily-built dense view of the compressed parts.
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense_jax()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+
+    @property
+    def shape(self):
+        return tuple(self._shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def _to_dense_jax(self):
+        raise NotImplementedError
+
+    def todense(self):
+        return NDArray(self._data, self._ctx)
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self.shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ndarray.h kCSRStorage)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr", "_shape",
+                 "_row_ids")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, jnp.int32)
+        self._sp_indptr = jnp.asarray(indptr, jnp.int32)
+        # static per-nnz row ids let dot lower to one segment_sum
+        counts = np.diff(np.asarray(indptr))
+        self._row_ids = jnp.asarray(
+            np.repeat(np.arange(shape[0]), counts), jnp.int32)
+        super(CSRNDArray, self).__init__(shape, ctx, stype="csr")
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices, self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._sp_indptr, self._ctx)
+
+    def _to_dense_jax(self):
+        dense = jnp.zeros(self.shape, self._sp_data.dtype)
+        return dense.at[self._row_ids, self._sp_indices].set(self._sp_data)
+
+    def __getitem__(self, i):
+        return self.todense()[i]
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Subset of rows + their indices (ndarray.h kRowSparseStorage)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_shape")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, jnp.int32)
+        super(RowSparseNDArray, self).__init__(shape, ctx,
+                                               stype="row_sparse")
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices, self._ctx)
+
+    def _to_dense_jax(self):
+        dense = jnp.zeros(self.shape, self._sp_data.dtype)
+        if self._sp_indices.size == 0:
+            return dense
+        return dense.at[self._sp_indices].set(self._sp_data)
+
+    def __getitem__(self, i):
+        return self.todense()[i]
+
+
+# ------------------------------------------------------------ factories --
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """csr_matrix((data, indices, indptr), shape=...), an (M, N) shape
+    tuple (empty matrix), or a dense array/NDArray (reference sparse.py
+    csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 and \
+            all(isinstance(i, int) for i in arg1):
+        return zeros("csr", arg1, ctx, dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        assert shape is not None, "shape is required"
+        data = np.asarray(data, dtype=dtype or np.float32)
+        return CSRNDArray(data, indices, indptr, shape, ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(
+        arg1, dtype=dtype or np.float32)
+    assert dense.ndim == 2, "csr_matrix requires 2D input"
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(np.asarray(data, dense.dtype), indices, indptr,
+                      dense.shape, ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """row_sparse_array((data, indices), shape=...), a shape tuple
+    (empty array), or a dense array."""
+    if isinstance(arg1, tuple) and all(isinstance(i, int) for i in arg1):
+        return zeros("row_sparse", arg1, ctx, dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2 and \
+            not np.isscalar(arg1[0]):
+        data, indices = arg1
+        assert shape is not None, "shape is required"
+        data = np.asarray(data, dtype=dtype or np.float32)
+        return RowSparseNDArray(data, indices, shape, ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(
+        arg1, dtype=dtype or np.float32)
+    nz_rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                axis=1))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows, dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = dtype or np.float32
+    if stype == "csr":
+        return CSRNDArray(np.zeros((0,), dtype), np.zeros((0,), np.int32),
+                          np.zeros((shape[0] + 1,), np.int32), shape, ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:]), dtype),
+                                np.zeros((0,), np.int64), shape, ctx)
+    if stype == "default":
+        return _nd_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def rand_sparse_ndarray(shape, stype, density=0.1, dtype=None):
+    """Random sparse array + its dense equivalent (test helper used by
+    mx.test_utils.rand_ndarray)."""
+    dense = np.zeros(shape, dtype=dtype or np.float32)
+    mask = np.random.rand(*shape) < density
+    dense[mask] = np.random.randn(int(mask.sum()))
+    if stype == "csr":
+        arr = csr_matrix(dense, ctx=None, dtype=dtype)
+    else:
+        arr = row_sparse_array(dense, ctx=None, dtype=dtype)
+    return arr, dense
+
+
+# ----------------------------------------------------------------- ops --
+def cast_storage(arr, stype):
+    """src/operator/tensor/cast_storage.cc."""
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "csr":
+        return csr_matrix(arr.asnumpy())
+    if stype == "row_sparse":
+        return row_sparse_array(arr.asnumpy())
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def retain(arr, indices):
+    """sparse_retain: keep only the given rows of a RowSparseNDArray."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    keep = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                      else indices, np.int64)
+    have = np.asarray(arr._sp_indices)
+    pos = {r: i for i, r in enumerate(have.tolist())}
+    sel = [r for r in keep.tolist() if r in pos]
+    rows = np.asarray([pos[r] for r in sel], np.int64) if sel else np.zeros((0,), np.int64)
+    data = jnp.asarray(np.asarray(arr._sp_data)[rows]) if len(rows) else \
+        jnp.zeros((0,) + arr.shape[1:], arr._sp_data.dtype)
+    return RowSparseNDArray(data, np.asarray(sel, np.int64), arr.shape,
+                            arr._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (dot-inl.h): csr x dense and csr.T x dense lower
+    to segment-sum / scatter-add on the TPU."""
+    from . import ndarray as nd
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
+                                                      BaseSparseNDArray):
+        dense = rhs._data
+        if transpose_a:
+            # out[c] += data[k] * dense[row_ids[k]] scattered to indices
+            contrib = lhs._sp_data[:, None] * dense[lhs._row_ids]
+            out = jnp.zeros((lhs.shape[1], dense.shape[1]), contrib.dtype)
+            out = out.at[lhs._sp_indices].add(contrib)
+            return NDArray(out, lhs._ctx)
+        gathered = lhs._sp_data[:, None] * dense[lhs._sp_indices]
+        out = jax.ops.segment_sum(gathered, lhs._row_ids,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out, lhs._ctx)
+    if isinstance(lhs, BaseSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    return nd.dot(lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """Sparse-aware add; rsp+rsp stays row_sparse, anything else falls
+    back to dense."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        assert lhs.shape == rhs.shape
+        l_idx = np.asarray(lhs._sp_indices)
+        r_idx = np.asarray(rhs._sp_indices)
+        idx = np.union1d(l_idx, r_idx)
+        dense = np.zeros((len(idx),) + lhs.shape[1:],
+                         np.asarray(lhs._sp_data).dtype
+                         if lhs._sp_data.size else np.float32)
+        # vectorized scatter-add of both operands' rows
+        np.add.at(dense, np.searchsorted(idx, l_idx),
+                  np.asarray(lhs._sp_data))
+        np.add.at(dense, np.searchsorted(idx, r_idx),
+                  np.asarray(rhs._sp_data))
+        return RowSparseNDArray(dense, idx, lhs.shape, lhs._ctx)
+    from . import ndarray as nd
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return nd.add(l, r)

@@ -1,0 +1,111 @@
+"""mx.np / mx.npx API tests (reference: tests/python/unittest/
+test_numpy_op.py / test_numpy_ndarray.py)."""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_creation_and_methods():
+    a = np.array([[1., 2.], [3., 4.]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    assert a.T.shape == (2, 2)
+    assert a.reshape(4).shape == (4,)
+    assert a.transpose(1, 0).shape == (2, 2)
+    assert float(a.sum().item()) == 10.0
+    assert float(a.mean().item()) == 2.5
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert np.arange(5).shape == (5,)
+    assert np.eye(3).shape == (3, 3)
+
+
+def test_numpy_math_matches_onp():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(3, 4).astype("float32")
+    a, b = np.array(x), np.array(y)
+    onp.testing.assert_allclose(np.add(a, b).asnumpy(), x + y, rtol=1e-6)
+    onp.testing.assert_allclose(np.exp(a).asnumpy(), onp.exp(x),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.dot(a, b.T).asnumpy(), x.dot(y.T),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.tensordot(a, b, axes=([1], [1])).asnumpy(),
+        onp.tensordot(x, y, axes=([1], [1])), rtol=1e-5)
+    onp.testing.assert_allclose(np.cumsum(a, axis=1).asnumpy(),
+                                onp.cumsum(x, axis=1), rtol=1e-5)
+    onp.testing.assert_allclose(np.std(a).asnumpy(), x.std(), rtol=1e-4)
+
+
+def test_numpy_manipulation():
+    a = np.arange(12).reshape(3, 4)
+    assert np.concatenate([a, a], axis=0).shape == (6, 4)
+    assert np.stack([a, a]).shape == (2, 3, 4)
+    assert np.split(a, 2, axis=1)[0].shape == (3, 2)
+    assert np.flip(a, axis=0).asnumpy()[0, 0] == 8
+    assert np.broadcast_to(np.array([1., 2.]), (3, 2)).shape == (3, 2)
+    assert np.where(np.array([True, False]), np.array([1, 2]),
+                    np.array([3, 4])).tolist() == [1, 4]
+
+
+def test_numpy_linalg_and_random():
+    a = np.array([[2., 0.], [0., 3.]])
+    onp.testing.assert_allclose(np.linalg.det(a).item(), 6.0, rtol=1e-5)
+    inv = np.linalg.inv(a)
+    onp.testing.assert_allclose(inv.asnumpy(),
+                                onp.linalg.inv(a.asnumpy()), rtol=1e-5)
+    np.random.seed(42)
+    r1 = np.random.normal(size=(6,)).asnumpy()
+    np.random.seed(42)
+    r2 = np.random.normal(size=(6,)).asnumpy()
+    onp.testing.assert_array_equal(r1, r2)
+    assert np.random.randint(0, 10, size=(5,)).shape == (5,)
+    assert np.random.rand(2, 3).shape == (2, 3)
+
+
+def test_npx_ops():
+    a = np.array([[1., -2.], [3., 4.]])
+    onp.testing.assert_array_equal(npx.relu(a).asnumpy(),
+                                   [[1., 0.], [3., 4.]])
+    s = npx.softmax(a, axis=-1)
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), [1., 1.], rtol=1e-6)
+    k = npx.topk(np.array([3., 1., 2.]), k=2)
+    onp.testing.assert_array_equal(k.asnumpy(), [0, 2])
+    p = npx.pick(a, np.array([1, 0]))
+    onp.testing.assert_array_equal(p.asnumpy(), [-2., 3.])
+    oh = npx.one_hot(np.array([1, 0]), 3)
+    onp.testing.assert_array_equal(oh.asnumpy(),
+                                   [[0, 1, 0], [1, 0, 0]])
+    bd = npx.batch_dot(np.ones((2, 3, 4)), np.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+    assert npx.batch_flatten(np.ones((2, 3, 4))).shape == (2, 12)
+
+
+def test_npx_set_np():
+    npx.set_np()
+    assert npx.is_np_array()
+    assert mx.util.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    npx.save(f, {"a": np.ones((2, 2)), "b": np.zeros(3)})
+    out = npx.load(f)
+    assert set(out) == {"a", "b"}
+    onp.testing.assert_array_equal(out["a"].asnumpy(), onp.ones((2, 2)))
+    assert isinstance(out["a"], np.ndarray)
+
+
+def test_np_interop_with_classic_nd():
+    a = np.ones((2, 2))
+    classic = a.as_nd_ndarray()
+    assert isinstance(classic, mx.nd.NDArray)
+    back = np.array(classic)
+    assert isinstance(back, np.ndarray)

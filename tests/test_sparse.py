@@ -1,0 +1,122 @@
+"""Sparse NDArray + sparse optimizer tests (reference:
+tests/python/unittest/test_sparse_ndarray.py / test_sparse_operator.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sparse
+
+
+def _rand_dense():
+    d = np.zeros((5, 6), np.float32)
+    d[0, 1] = 2.0
+    d[2, 3] = -1.5
+    d[4, 5] = 4.0
+    d[2, 0] = 0.5
+    return d
+
+
+def test_csr_roundtrip_and_attrs():
+    d = _rand_dense()
+    csr = sparse.csr_matrix(d)
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), d)
+    assert csr.data.shape == (4,)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(),
+                                  [0, 1, 1, 3, 3, 4])
+    # explicit (data, indices, indptr) constructor
+    csr2 = sparse.csr_matrix((csr.data.asnumpy(), csr.indices.asnumpy(),
+                              csr.indptr.asnumpy()), shape=(5, 6))
+    np.testing.assert_array_equal(csr2.asnumpy(), d)
+
+
+def test_row_sparse_roundtrip():
+    d = _rand_dense()
+    rsp = sparse.row_sparse_array(d)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.asnumpy(), d)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [0, 2, 4])
+    assert rsp.data.shape == (3, 6)
+
+
+def test_csr_dot_dense():
+    d = _rand_dense()
+    csr = sparse.csr_matrix(d)
+    rhs = np.random.RandomState(0).rand(6, 3).astype(np.float32)
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), d.dot(rhs), rtol=1e-5)
+    lhs_t = np.random.RandomState(1).rand(5, 3).astype(np.float32)
+    out_t = sparse.dot(csr, mx.nd.array(lhs_t), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), d.T.dot(lhs_t), rtol=1e-5)
+
+
+def test_retain_and_cast_storage():
+    d = _rand_dense()
+    rsp = sparse.row_sparse_array(d)
+    kept = sparse.retain(rsp, mx.nd.array([0, 4]))
+    exp = d.copy()
+    exp[2] = 0
+    np.testing.assert_array_equal(kept.asnumpy(), exp)
+    assert sparse.cast_storage(rsp, "default").stype == "default"
+    assert sparse.cast_storage(rsp, "csr").stype == "csr"
+    np.testing.assert_array_equal(
+        sparse.cast_storage(rsp, "csr").asnumpy(), d)
+
+
+def test_sparse_add():
+    d = _rand_dense()
+    rsp = sparse.row_sparse_array(d)
+    out = sparse.add(rsp, rsp)
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.asnumpy(), 2 * d)
+    dense_out = sparse.add(rsp, mx.nd.array(np.ones_like(d)))
+    np.testing.assert_array_equal(dense_out.asnumpy(), d + 1)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (3, 4))
+    assert z.asnumpy().sum() == 0
+    z2 = sparse.zeros("row_sparse", (3, 4))
+    assert z2.stype == "row_sparse" and z2.shape == (3, 4)
+
+
+def test_sgd_lazy_update_touches_only_rows():
+    opt = mx.optimizer.SGD(learning_rate=1.0, momentum=0.9,
+                           lazy_update=True)
+    w = mx.nd.array(np.ones((4, 3), np.float32))
+    state = opt.create_state(0, w)
+    grad = sparse.row_sparse_array(
+        (np.full((2, 3), 0.5, np.float32), [1, 3]), shape=(4, 3))
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    np.testing.assert_array_equal(out[0], np.ones(3))
+    np.testing.assert_array_equal(out[2], np.ones(3))
+    assert (out[1] < 1).all() and (out[3] < 1).all()
+    # momentum state only on touched rows
+    st = state.asnumpy()
+    assert (st[0] == 0).all() and (st[1] != 0).all()
+
+
+def test_adagrad_sparse_update_matches_dense_on_rows():
+    lr = 0.5
+    opt_s = mx.optimizer.AdaGrad(learning_rate=lr)
+    opt_d = mx.optimizer.AdaGrad(learning_rate=lr)
+    w_s = mx.nd.array(np.ones((4, 3), np.float32))
+    w_d = mx.nd.array(np.ones((4, 3), np.float32))
+    st_s = opt_s.create_state(0, w_s)
+    st_d = opt_d.create_state(0, w_d)
+    g_dense = np.zeros((4, 3), np.float32)
+    g_dense[1] = 0.7
+    grad_sparse = sparse.row_sparse_array(g_dense)
+    opt_s.update(0, w_s, grad_sparse, st_s)
+    opt_d.update(0, w_d, mx.nd.array(g_dense), st_d)
+    np.testing.assert_allclose(w_s.asnumpy()[1], w_d.asnumpy()[1],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(w_s.asnumpy()[0], np.ones(3))
+
+
+def test_rand_sparse_ndarray_via_test_utils():
+    arr = mx.test_utils.rand_ndarray((8, 5), stype="csr", density=0.3)
+    assert arr.stype == "csr"
+    assert arr.shape == (8, 5)
