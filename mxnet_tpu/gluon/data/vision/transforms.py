@@ -41,28 +41,24 @@ class Cast(HybridBlock):
 
 
 class ToTensor(HybridBlock):
-    """HWC uint8 [0,255] -> CHW float32 [0,1]. Hybridized (Symbol) use
-    assumes a single HWC image; batched NHWC input needs eager mode
-    (Symbols carry no rank at compose time)."""
+    """HWC uint8 [0,255] -> CHW float32 [0,1] via the _image_to_tensor op
+    (handles NHWC batches too; rank is resolved at trace time)."""
 
     def hybrid_forward(self, F, x):
-        if getattr(x, "ndim", 3) == 4:
-            out = F.transpose(x, axes=(0, 3, 1, 2))
-        else:
-            out = F.transpose(x, axes=(2, 0, 1))
-        return F.cast(out, dtype="float32") / 255.0
+        return F.image.to_tensor(x)
 
 
 class Normalize(HybridBlock):
-    """(x - mean) / std on CHW float input."""
+    """(x - mean) / std on CHW (or NCHW) float input via _image_normalize
+    — an op available in both nd and sym namespaces, so hybridize works."""
 
     def __init__(self, mean=0.0, std=1.0):
         super(Normalize, self).__init__()
-        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean = tuple(np.atleast_1d(np.asarray(mean, np.float32)))
+        self._std = tuple(np.atleast_1d(np.asarray(std, np.float32)))
 
     def hybrid_forward(self, F, x):
-        return (x - F.array(self._mean)) / F.array(self._std)
+        return F.image.normalize(x, mean=self._mean, std=self._std)
 
 
 class Resize(Block):

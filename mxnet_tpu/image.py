@@ -433,9 +433,10 @@ class ImageIter(DataIter):
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
-                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
-                 imglist=None, data_name="data", label_name="softmax_label",
-                 last_batch_handle="pad", **kwargs):
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad",
+                 **kwargs):
         super(ImageIter, self).__init__()
         from . import recordio
         assert path_imgrec or path_imglist or isinstance(imglist, list)
@@ -443,7 +444,8 @@ class ImageIter(DataIter):
         self.imglist = None
         self.seq = None
         if path_imgrec:
-            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            idx_path = path_imgidx or \
+                os.path.splitext(path_imgrec)[0] + ".idx"
             self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
                                                      "r")
             self.seq = list(self.imgrec.keys)
@@ -533,6 +535,23 @@ class ImageIter(DataIter):
             if self.last_batch_handle == "roll_over":
                 self._cache = rows  # ragged remainder joins next epoch
                 raise StopIteration
+            # 'pad': fill with real samples wrapped from the epoch start
+            # (reference ImageIter semantics) — pad stays set so aware
+            # consumers can discard them
+            pad = self.batch_size - len(rows)
+            self.cur = 0
+            while len(rows) < self.batch_size:
+                if self.cur >= len(self.seq):
+                    self.cur = 0  # dataset smaller than the pad: keep cycling
+                rows.append(self._decoded_sample())
+            self.cur = len(self.seq)  # next() must still end the epoch
+            for i, (arr, label) in enumerate(rows):
+                batch_data[i] = arr
+                batch_label[i] = label
+            label_out = batch_label[:, 0] if self.label_width == 1 \
+                else batch_label
+            return DataBatch(data=[nd.array(batch_data)],
+                             label=[nd.array(label_out)], pad=pad)
         for i, (arr, label) in enumerate(rows):
             batch_data[i] = arr
             batch_label[i] = label

@@ -191,9 +191,17 @@ _FUNCS = [
 ]
 
 _g = globals()
-for _n in _FUNCS:
-    if hasattr(jnp, _n):
-        _g[_n] = _make(_n, getattr(jnp, _n))
+import warnings as _warnings
+with _warnings.catch_warnings():
+    # probing jnp attributes must not surface deprecation warnings at
+    # import time (e.g. jnp.fix in jax 0.9)
+    _warnings.simplefilter("ignore", DeprecationWarning)
+    for _n in _FUNCS:
+        if hasattr(jnp, _n):
+            _g[_n] = _make(_n, getattr(jnp, _n))
+# jnp.fix is deprecated (removed in jax 0.10); keep np.fix alive via
+# trunc, which is the same round-toward-zero operation
+fix = _make("fix", jnp.trunc)
 
 # dtype aliases
 float16 = _onp.float16

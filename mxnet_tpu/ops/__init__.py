@@ -107,3 +107,4 @@ from . import nn  # noqa: E402,F401
 from . import random_ops  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import contrib_ops  # noqa: E402,F401
+from . import image_ops  # noqa: E402,F401

@@ -1,0 +1,193 @@
+"""Image operators — nd.image.* / sym.image.*.
+
+Reference: src/operator/image/image_random.cc (registers _image_to_tensor,
+_image_normalize, flips, brightness/contrast/saturation/hue jitter,
+lighting), resize.cc (_image_resize), crop.cc (_image_crop). These back the
+Gluon vision transforms so that the transforms stay hybridizable: every op
+exists in both the ndarray and symbol namespaces.
+
+TPU-native notes: all ops are pure jnp functions (batch-friendly, fused by
+XLA); random augmentations take an explicit threefry key (`rng_key`) like
+every other sampler here instead of a per-resource Philox state.
+Layout follows the reference: to_tensor consumes HWC (or NHWC) uint8-like
+input and produces CHW float32; normalize consumes CHW/NCHW.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _is_batched(x, rank):
+    return x.ndim == rank + 1
+
+
+@register(name="_image_to_tensor", aliases=("image_to_tensor",))
+def image_to_tensor(x):
+    """HWC (or NHWC) [0,255] -> CHW (NCHW) float32 [0,1]."""
+    perm = (0, 3, 1, 2) if _is_batched(x, 3) else (2, 0, 1)
+    return jnp.transpose(x, perm).astype(jnp.float32) / 255.0
+
+
+@register(name="_image_normalize", aliases=("image_normalize",))
+def image_normalize(x, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW or NCHW float input."""
+    mean = jnp.reshape(jnp.asarray(mean, x.dtype), (-1, 1, 1))
+    std = jnp.reshape(jnp.asarray(std, x.dtype), (-1, 1, 1))
+    return (x - mean) / std
+
+
+@register(name="_image_flip_left_right", aliases=("image_flip_left_right",))
+def image_flip_left_right(x):
+    """Flip HWC (or NHWC) image along width."""
+    return jnp.flip(x, axis=-2)
+
+
+@register(name="_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def image_flip_top_bottom(x):
+    return jnp.flip(x, axis=-3)
+
+
+@register(name="_image_random_flip_left_right",
+          aliases=("image_random_flip_left_right",), stateful_rng=True)
+def image_random_flip_left_right(x, rng_key=None):
+    flip = jax.random.bernoulli(rng_key)
+    return jnp.where(flip, jnp.flip(x, axis=-2), x)
+
+
+@register(name="_image_random_flip_top_bottom",
+          aliases=("image_random_flip_top_bottom",), stateful_rng=True)
+def image_random_flip_top_bottom(x, rng_key=None):
+    flip = jax.random.bernoulli(rng_key)
+    return jnp.where(flip, jnp.flip(x, axis=-3), x)
+
+
+@register(name="_image_resize", aliases=("image_resize",))
+def image_resize(x, size=None, keep_ratio=False, interp=1):
+    """Resize HWC (or NHWC) to `size` (int or (w, h)); bilinear when
+    interp=1, nearest when interp=0. keep_ratio scales the short side to
+    `size` (static-shape variant of the reference's resize_short)."""
+    h, w = (x.shape[-3], x.shape[-2])
+    if isinstance(size, int):
+        if keep_ratio:
+            if h < w:
+                new_h, new_w = size, max(1, int(round(w * size / h)))
+            else:
+                new_h, new_w = max(1, int(round(h * size / w))), size
+        else:
+            new_h = new_w = size
+    else:
+        new_w, new_h = size  # reference order: (w, h)
+    method = "nearest" if interp == 0 else "bilinear"
+    if _is_batched(x, 3):
+        shape = (x.shape[0], new_h, new_w, x.shape[3])
+    else:
+        shape = (new_h, new_w, x.shape[2])
+    out = jax.image.resize(x.astype(jnp.float32), shape, method=method)
+    return out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.integer) \
+        else out
+
+
+@register(name="_image_crop", aliases=("image_crop",))
+def image_crop(x, x0=0, y0=0, width=0, height=0):
+    """Static crop of HWC (or NHWC): rows [y0, y0+height), cols
+    [x0, x0+width)."""
+    if _is_batched(x, 3):
+        return x[:, y0:y0 + height, x0:x0 + width, :]
+    return x[y0:y0 + height, x0:x0 + width, :]
+
+
+def _blend(a, b, alpha):
+    return a * alpha + b * (1.0 - alpha)
+
+
+def _grayscale(x):
+    # ITU-R BT.601 luma weights over the channel axis of HWC/NHWC
+    w = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@register(name="_image_random_brightness",
+          aliases=("image_random_brightness",), stateful_rng=True)
+def image_random_brightness(x, max_brightness=0.0, rng_key=None):
+    alpha = 1.0 + jax.random.uniform(
+        rng_key, minval=-max_brightness, maxval=max_brightness)
+    return x * alpha
+
+
+@register(name="_image_random_contrast",
+          aliases=("image_random_contrast",), stateful_rng=True)
+def image_random_contrast(x, max_contrast=0.0, rng_key=None):
+    alpha = 1.0 + jax.random.uniform(
+        rng_key, minval=-max_contrast, maxval=max_contrast)
+    gray_mean = jnp.mean(_grayscale(x))
+    return _blend(x, gray_mean, alpha)
+
+
+@register(name="_image_random_saturation",
+          aliases=("image_random_saturation",), stateful_rng=True)
+def image_random_saturation(x, max_saturation=0.0, rng_key=None):
+    alpha = 1.0 + jax.random.uniform(
+        rng_key, minval=-max_saturation, maxval=max_saturation)
+    return _blend(x, _grayscale(x), alpha)
+
+
+@register(name="_image_random_hue", aliases=("image_random_hue",),
+          stateful_rng=True)
+def image_random_hue(x, max_hue=0.0, rng_key=None):
+    """Hue rotation via the YIQ approximation the reference uses
+    (image_random-inl.h RandomHue)."""
+    alpha = jax.random.uniform(rng_key, minval=-max_hue, maxval=max_hue)
+    u, w = jnp.cos(alpha * jnp.pi), jnp.sin(alpha * jnp.pi)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], x.dtype)
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], x.dtype)
+    rot = jnp.asarray([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], x.dtype)
+    m = t_rgb @ rot @ t_yiq
+    return jnp.einsum("...c,dc->...d", x, m)
+
+
+@register(name="_image_random_color_jitter",
+          aliases=("image_random_color_jitter",), stateful_rng=True)
+def image_random_color_jitter(x, brightness=0.0, contrast=0.0,
+                              saturation=0.0, hue=0.0, rng_key=None):
+    kb, kc, ks, kh = jax.random.split(rng_key, 4)
+    if brightness:
+        x = image_random_brightness(x, brightness, rng_key=kb)
+    if contrast:
+        x = image_random_contrast(x, contrast, rng_key=kc)
+    if saturation:
+        x = image_random_saturation(x, saturation, rng_key=ks)
+    if hue:
+        x = image_random_hue(x, hue, rng_key=kh)
+    return x
+
+
+# PCA lighting noise over ImageNet eigen-basis (AlexNet augmentation;
+# reference image_random-inl.h AdjustLighting / RandomLighting).
+_EIGVAL = (55.46, 4.794, 1.148)
+_EIGVEC = ((-0.5675, 0.7192, 0.4009),
+           (-0.5808, -0.0045, -0.8140),
+           (-0.5836, -0.6948, 0.4203))
+
+
+@register(name="_image_adjust_lighting", aliases=("image_adjust_lighting",))
+def image_adjust_lighting(x, alpha=(0.0, 0.0, 0.0)):
+    vec = jnp.asarray(_EIGVEC, x.dtype)
+    val = jnp.asarray(_EIGVAL, x.dtype) * jnp.asarray(alpha, x.dtype)
+    return x + vec @ val
+
+
+@register(name="_image_random_lighting",
+          aliases=("image_random_lighting",), stateful_rng=True)
+def image_random_lighting(x, alpha_std=0.05, rng_key=None):
+    alpha = jax.random.normal(rng_key, (3,), x.dtype) * alpha_std
+    vec = jnp.asarray(_EIGVEC, x.dtype)
+    val = jnp.asarray(_EIGVAL, x.dtype) * alpha
+    return x + vec @ val

