@@ -1,0 +1,135 @@
+"""Control-flow operators: _foreach, _while_loop, _cond.
+
+Reference: src/operator/control_flow.cc (_foreach :1089, _while_loop
+:1150, _cond :1211) — stateful subgraph ops executed by the legacy engine
+node-by-node per iteration.
+
+TPU-native design: the subgraph (a Symbol) is a static attr of the node;
+lowering turns it into a pure jax function (executor.build_graph_fn) and
+wraps it in the native XLA structured-control-flow primitive:
+
+  _foreach     -> lax.scan        (differentiable, one compiled body)
+  _while_loop  -> lax.scan over max_iterations steps with an active mask
+                  (lax.while_loop is not reverse-mode differentiable and
+                  dynamic trip counts defeat XLA static shapes; the
+                  masked scan is differentiable and TPU-friendly, at the
+                  cost of always running max_iterations steps — the
+                  reference also fixes the output's leading dim to
+                  max_iterations for the same shape-inference reason)
+  _cond        -> lax.cond        (single branch executed, differentiable)
+
+Subgraph free variables (closure captures) are explicit trailing inputs
+of the node, so gradients flow to them like any other input.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _graph_fn(subgraph, is_train):
+    from ..executor import build_graph_fn
+    return build_graph_fn(subgraph, is_train)
+
+
+def _key(rng_key):
+    return rng_key if rng_key is not None else jax.random.PRNGKey(0)
+
+
+@register(name="_foreach", num_outputs="n", stateful_rng=True)
+def _foreach(*arrays, subgraph=None, sub_in_names=(), num_data=1,
+             num_out_data=0, num_states=0, is_train=False, rng_key=None):
+    """Scan `subgraph` over axis 0 of the data inputs.
+
+    Inputs: num_data data arrays, num_states carry states, then free
+    (closure) arrays. Subgraph outputs: num_out_data per-step outputs
+    followed by num_states new states. Returns stacked outputs + final
+    states."""
+    data = arrays[:num_data]
+    states = tuple(arrays[num_data:num_data + num_states])
+    free = arrays[num_data + num_states:]
+    names = list(sub_in_names)
+    data_names = names[:num_data]
+    state_names = names[num_data:num_data + num_states]
+    free_names = names[num_data + num_states:]
+    gfn = _graph_fn(subgraph, is_train)
+    key = _key(rng_key)
+
+    def step(carry, xs_and_key):
+        xs, k = xs_and_key
+        args = dict(zip(data_names, xs))
+        args.update(zip(state_names, carry))
+        args.update(zip(free_names, free))
+        outs, _ = gfn(args, {}, k)
+        return tuple(outs[num_out_data:]), tuple(outs[:num_out_data])
+
+    n_steps = data[0].shape[0]
+    keys = jax.random.split(key, n_steps)
+    final_states, stacked = jax.lax.scan(step, states, (tuple(data), keys))
+    return tuple(stacked) + tuple(final_states)
+
+
+@register(name="_while_loop", num_outputs="n", stateful_rng=True)
+def _while_loop(*arrays, cond_graph=None, func_graph=None, sub_in_names=(),
+                num_out_data=0, num_vars=0, max_iterations=None,
+                is_train=False, rng_key=None):
+    """Masked-scan while loop: runs max_iterations steps; once the cond
+    subgraph reports false, loop vars freeze and step outputs stop being
+    written (rows beyond the trip count stay zero — the reference leaves
+    them undefined)."""
+    assert max_iterations is not None and max_iterations > 0, \
+        "while_loop requires a positive max_iterations"
+    loop_vars = tuple(arrays[:num_vars])
+    free = arrays[num_vars:]
+    names = list(sub_in_names)
+    var_names = names[:num_vars]
+    free_names = names[num_vars:]
+    cfn = _graph_fn(cond_graph, is_train)
+    ffn = _graph_fn(func_graph, is_train)
+    key = _key(rng_key)
+
+    def step(carry, k):
+        vars_, active = carry
+        args = dict(zip(var_names, vars_))
+        args.update(zip(free_names, free))
+        (pred,), _ = cfn(args, {}, k)
+        active = jnp.logical_and(active,
+                                 jnp.reshape(pred, ()).astype(bool))
+        outs, _ = ffn(args, {}, k)
+        step_outs = outs[:num_out_data]
+        new_vars = outs[num_out_data:]
+        sel_vars = tuple(jnp.where(active, nv, ov)
+                         for nv, ov in zip(new_vars, vars_))
+        emitted = tuple(jnp.where(active, so, jnp.zeros_like(so))
+                        for so in step_outs)
+        return (sel_vars, active), emitted
+
+    keys = jax.random.split(key, int(max_iterations))
+    (final_vars, _), stacked = jax.lax.scan(
+        step, (loop_vars, jnp.asarray(True)), keys)
+    return tuple(stacked) + tuple(final_vars)
+
+
+@register(name="_cond", num_outputs="n", stateful_rng=True)
+def _cond(*arrays, then_graph=None, else_graph=None, sub_in_names=(),
+          num_outputs_branch=0, is_train=False, rng_key=None):
+    """lax.cond over the two branch subgraphs. Input 0 is the scalar
+    predicate; the rest are the union of both branches' free inputs."""
+    pred = jnp.reshape(arrays[0], ()).astype(bool)
+    free = arrays[1:]
+    names = list(sub_in_names)
+    tfn = _graph_fn(then_graph, is_train)
+    efn = _graph_fn(else_graph, is_train)
+    key = _key(rng_key)
+    args = dict(zip(names, free))
+
+    def then_branch(_):
+        outs, _aux = tfn(args, {}, key)
+        return tuple(outs)
+
+    def else_branch(_):
+        outs, _aux = efn(args, {}, key)
+        return tuple(outs)
+
+    return jax.lax.cond(pred, then_branch, else_branch, None)

@@ -287,6 +287,35 @@ class Symbol:
     def __neg__(self):
         return _unary_sym("negative", self)
 
+    # rich comparisons compose broadcast/scalar compare ops (reference
+    # symbol.py __gt__ etc.); note __eq__/__ne__ build symbols, so Symbol
+    # is identity-hashed like the reference
+    def __eq__(self, other):
+        return _binary_sym("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _binary_sym("broadcast_not_equal", "_not_equal_scalar",
+                           self, other)
+
+    def __gt__(self, other):
+        return _binary_sym("broadcast_greater", "_greater_scalar",
+                           self, other)
+
+    def __ge__(self, other):
+        return _binary_sym("broadcast_greater_equal",
+                           "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _binary_sym("broadcast_lesser", "_lesser_scalar",
+                           self, other)
+
+    def __le__(self, other):
+        return _binary_sym("broadcast_lesser_equal",
+                           "_lesser_equal_scalar", self, other)
+
+    def __hash__(self):
+        return id(self)
+
     def __repr__(self):
         name = self.name
         return "<Symbol %s>" % (name if name else "Grouped")
@@ -381,17 +410,30 @@ class Symbol:
 
     # ------------------------------------------------------------ io ----
     def tojson(self):
-        """symbol.py:1331 — reference-layout JSON node list."""
+        """symbol.py:1331 — reference-layout JSON node list. Subgraph-
+        valued attrs (control-flow ops) serialize into the node's
+        "subgraphs" list, as the reference format does."""
         node_index = {id(n): i for i, n in enumerate(self._nodes)}
         nodes = []
         for n in self._nodes:
-            nodes.append({
+            attrs = {}
+            subgraphs = []
+            for k, v in n.attrs.items():
+                if isinstance(v, Symbol):
+                    attrs[k] = "__subgraph__:%d" % len(subgraphs)
+                    subgraphs.append(json.loads(v.tojson()))
+                else:
+                    attrs[k] = str(v)
+            entry = {
                 "op": n.op,
                 "name": n.name,
-                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "attrs": attrs,
                 "inputs": [[node_index[id(s._nodes[s._outputs[0][0]])], oi, 0]
                            for s, oi in n.inputs],
-            })
+            }
+            if subgraphs:
+                entry["subgraphs"] = subgraphs
+            nodes.append(entry)
         heads = [[ni, oi, 0] for ni, oi in self._outputs]
         arg_nodes = [i for i, n in enumerate(self._nodes) if n.is_var()]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
@@ -410,6 +452,10 @@ class Symbol:
 def _node_num_outputs(node):
     if node.is_var():
         return 1
+    if "__num_outputs__" in node.attrs:
+        # per-node arity (control-flow subgraph ops: outputs depend on
+        # the traced body, not the op class)
+        return int(node.attrs["__num_outputs__"])
     op = ops.get(node.op)
     if node.op == "BatchNorm":
         return 1  # mean/var are internal plumbing, not user outputs
@@ -486,7 +532,13 @@ def load_json(json_str):
     syms = []
     for nd_ in data["nodes"]:
         inputs = [(syms[i], oi) for i, oi, _ in nd_["inputs"]]
-        attrs = {k: _parse_attr(v) for k, v in nd_.get("attrs", {}).items()}
+        attrs = {}
+        for k, v in nd_.get("attrs", {}).items():
+            if isinstance(v, str) and v.startswith("__subgraph__:"):
+                sg = nd_["subgraphs"][int(v.split(":", 1)[1])]
+                attrs[k] = load_json(json.dumps(sg))
+            else:
+                attrs[k] = _parse_attr(v)
         node = _Node(nd_["op"], nd_["name"], attrs, inputs)
         nodes.append(node)
         syms.append(Symbol(nodes[:], [(len(nodes) - 1, 0)]))
@@ -683,6 +735,9 @@ def ones(shape, dtype="float32", **kwargs):
 
 class _SymContribNamespace:
     def __getattr__(self, item):
+        if item in ("foreach", "while_loop", "cond"):
+            from . import control_flow
+            return getattr(control_flow, "sym_" + item)
         full = "_contrib_" + item
         if ops.exists(full):
             return _g.get(full) or _make_sym_func(full)

@@ -1,0 +1,200 @@
+"""Control-flow op tests — semantics mirror
+tests/python/unittest/test_contrib_control_flow.py for the reference ops
+src/operator/control_flow.cc (_foreach/_while_loop/_cond), in both eager
+(python loop on the tape) and symbolic (lax.scan/cond lowering) modes,
+including gradients through the scan."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, autograd
+
+
+def test_foreach_eager_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, s):
+        new_s = s + x
+        return new_s, new_s
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1])
+
+
+def test_foreach_eager_grad_flows_to_closure():
+    """Gradients reach both the scanned data and closure-captured
+    weights (the RNN use case)."""
+    data = nd.array(np.ones((3, 2), np.float32))
+    w = nd.array(np.full((2,), 2.0, np.float32))
+    data.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        def body(x, s):
+            new_s = s + x * w   # closure capture of w
+            return new_s, new_s
+        outs, final = nd.contrib.foreach(body, data, nd.zeros((2,)))
+        loss = outs.sum()
+    loss.backward()
+    # d(loss)/dw: each step contributes (n_steps - i) copies
+    np.testing.assert_allclose(w.grad.asnumpy(), [6.0, 6.0])
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               2.0 * np.array([[3, 3], [2, 2], [1, 1]]))
+
+
+def test_foreach_symbolic_matches_eager():
+    data_np = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+    def body(x, s):
+        new_s = s + x * 2.0
+        return new_s, new_s
+
+    # eager
+    outs_e, final_e = nd.contrib.foreach(body, nd.array(data_np),
+                                         nd.zeros((3,)))
+    # symbolic
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    outs_s, final_s = sym.contrib.foreach(body, data, init)
+    ex = sym.Group([outs_s, final_s]).simple_bind(
+        mx.cpu(), data=(4, 3), init=(3,))
+    res = ex.forward(data=nd.array(data_np), init=nd.zeros((3,)))
+    np.testing.assert_allclose(res[0].asnumpy(), outs_e.asnumpy())
+    np.testing.assert_allclose(res[1].asnumpy(), final_e.asnumpy())
+
+
+def test_foreach_symbolic_closure_grad():
+    """Symbolic foreach: closure-captured weight variable becomes a node
+    input; grads flow through the lax.scan lowering."""
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    init = sym.Variable("init")
+
+    def body(x, s):
+        new_s = s + x * w
+        return new_s, new_s
+
+    outs, _final = sym.contrib.foreach(body, data, init)
+    loss = sym.sum(outs)
+    ex = loss.simple_bind(mx.cpu(), data=(3, 2), w=(2,), init=(2,))
+    ex.arg_dict["data"][:] = nd.ones((3, 2))
+    ex.arg_dict["w"][:] = nd.array([2.0, 2.0])
+    ex.arg_dict["init"][:] = nd.zeros((2,))
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), [6.0, 6.0])
+    np.testing.assert_allclose(
+        ex.grad_dict["data"].asnumpy(),
+        2.0 * np.array([[3, 3], [2, 2], [1, 1]], np.float32))
+
+
+def test_while_loop_eager_reference_example():
+    """The documented reference example (ndarray/contrib.py:296-318)."""
+    cond = lambda i, s: i <= 5
+    func = lambda i, s: (i + s, [i + 1, s + i])
+    i0 = nd.array([0.0])
+    s0 = nd.array([1.0])
+    outputs, states = nd.contrib.while_loop(cond, func, [i0, s0],
+                                            max_iterations=10)
+    np.testing.assert_allclose(
+        outputs.asnumpy()[:6].ravel(), [1, 2, 4, 7, 11, 16])
+    assert outputs.shape == (10, 1)
+    np.testing.assert_allclose(states[0].asnumpy(), [6.0])
+    np.testing.assert_allclose(states[1].asnumpy(), [16.0])
+
+
+def test_while_loop_symbolic_matches_eager():
+    i = sym.Variable("i")
+    s = sym.Variable("s")
+    outs, states = sym.contrib.while_loop(
+        lambda i, s: i <= 5.0,
+        lambda i, s: (i + s, [i + 1.0, s + i]),
+        [i, s], max_iterations=10)
+    ex = sym.Group([outs] + list(states)).simple_bind(
+        mx.cpu(), i=(1,), s=(1,))
+    res = ex.forward(i=nd.array([0.0]), s=nd.array([1.0]))
+    np.testing.assert_allclose(res[0].asnumpy()[:6].ravel(),
+                               [1, 2, 4, 7, 11, 16])
+    # masked tail stays zero (reference: undefined; ours: deterministic)
+    np.testing.assert_allclose(res[0].asnumpy()[6:].ravel(), np.zeros(4))
+    np.testing.assert_allclose(res[1].asnumpy(), [6.0])
+    np.testing.assert_allclose(res[2].asnumpy(), [16.0])
+
+
+def test_while_loop_never_true_raises():
+    with pytest.raises(ValueError):
+        nd.contrib.while_loop(lambda x: x < 0, lambda x: (x, x),
+                              nd.array([1.0]), max_iterations=4)
+
+
+def test_cond_eager():
+    x = nd.array([3.0])
+    y = nd.array([5.0])
+    out = nd.contrib.cond(x < y, lambda: x * 2, lambda: y * 2)
+    np.testing.assert_allclose(out.asnumpy(), [6.0])
+    out = nd.contrib.cond(x > y, lambda: x * 2, lambda: y * 2)
+    np.testing.assert_allclose(out.asnumpy(), [10.0])
+
+
+def test_cond_symbolic_single_branch_taken():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    out = sym.contrib.cond(x < y, lambda: x * 2.0, lambda: y * 3.0)
+    ex = out.simple_bind(mx.cpu(), x=(1,), y=(1,))
+    res = ex.forward(x=nd.array([3.0]), y=nd.array([5.0]))
+    np.testing.assert_allclose(res[0].asnumpy(), [6.0])
+    res = ex.forward(x=nd.array([7.0]), y=nd.array([5.0]))
+    np.testing.assert_allclose(res[0].asnumpy(), [15.0])
+
+
+def test_cond_symbolic_grad():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    # pred must be scalar (reference contract: "a scalar MXNet NDArray")
+    out = sym.sum(sym.contrib.cond(sym.sum(x) < sym.sum(y),
+                                   lambda: x * 2.0, lambda: y * 3.0))
+    ex = out.simple_bind(mx.cpu(), x=(2,), y=(2,))
+    ex.arg_dict["x"][:] = nd.array([1.0, 1.0])
+    ex.arg_dict["y"][:] = nd.array([5.0, 5.0])
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [2.0, 2.0])
+    np.testing.assert_allclose(ex.grad_dict["y"].asnumpy(), [0.0, 0.0])
+
+
+def test_foreach_json_roundtrip():
+    """Control-flow nodes survive Symbol JSON save/load (subgraphs
+    field, reference format)."""
+    data = sym.Variable("data")
+    init = sym.Variable("init")
+    outs, final = sym.contrib.foreach(
+        lambda x, s: (s + x, s + x), data, init)
+    g = sym.Group([outs, final])
+    js = g.tojson()
+    g2 = sym.load_json(js)
+    ex = g2.simple_bind(mx.cpu(), data=(4, 3), init=(3,))
+    res = ex.forward(data=nd.array(np.ones((4, 3), np.float32)),
+                     init=nd.zeros((3,)))
+    np.testing.assert_allclose(res[1].asnumpy(), [4.0, 4.0, 4.0])
+
+
+def test_foreach_rnn_style_hybrid():
+    """foreach drives an RNN-cell-style body with weights — the
+    motivating use case (control_flow.cc _foreach)."""
+    rng = np.random.RandomState(0)
+    T_, B, H = 5, 2, 4
+    data = nd.array(rng.randn(T_, B, H).astype(np.float32))
+    w = nd.array(rng.randn(H, H).astype(np.float32) * 0.1)
+    w.attach_grad()
+    with autograd.record():
+        def body(x, h):
+            new_h = nd.tanh(nd.dot(x + h, w))
+            return new_h, new_h
+        outs, final = nd.contrib.foreach(body, data, nd.zeros((B, H)))
+        loss = outs.sum()
+    loss.backward()
+    assert outs.shape == (T_, B, H)
+    assert float(np.abs(w.grad.asnumpy()).sum()) > 0
