@@ -58,12 +58,24 @@ def node_eval_fn(node, for_inference=False):
     return fn
 
 
-def build_graph_fn(symbol, is_train):
+def build_graph_fn(symbol, is_train, node_device=None):
     """Compile plan: returns fn(arg_dict, aux_dict, rng_key) ->
-    (outputs_list, new_aux_dict)."""
+    (outputs_list, new_aux_dict).
+
+    node_device: optional callable node -> jax.Device | None. When it
+    returns a device, the node's outputs are constrained there with
+    device_put — the model-parallel group2ctx placement pass
+    (graph_executor.cc:997 AssignContext + cross_device_copy insertion:
+    XLA/jax materializes the transfers at group boundaries)."""
     all_nodes = symbol._nodes
     nodes = symbol._active_nodes()
     out_refs = [(all_nodes[ni], oi) for ni, oi in symbol._outputs]
+
+    def _place(node, arr):
+        if node_device is None:
+            return arr
+        dev = node_device(node)
+        return arr if dev is None else jax.device_put(arr, dev)
 
     def graph_fn(arg_arrays, aux_arrays, rng_key):
         vals = {}
@@ -73,9 +85,9 @@ def build_graph_fn(symbol, is_train):
             if node.is_var():
                 name = node.name
                 if name in arg_arrays:
-                    vals[(id(node), 0)] = arg_arrays[name]
+                    vals[(id(node), 0)] = _place(node, arg_arrays[name])
                 elif name in aux_arrays:
-                    vals[(id(node), 0)] = aux_arrays[name]
+                    vals[(id(node), 0)] = _place(node, aux_arrays[name])
                 else:
                     raise MXNetError("unbound variable %s" % name)
                 continue
@@ -90,7 +102,7 @@ def build_graph_fn(symbol, is_train):
             ins = []
             for s, oi in node.inputs:
                 src = s._nodes[s._outputs[0][0]]
-                ins.append(vals[(id(src), oi)])
+                ins.append(_place(node, vals[(id(src), oi)]))
             import inspect
             has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
                               for p in sig.parameters.values())
@@ -126,7 +138,7 @@ def build_graph_fn(symbol, is_train):
                 continue
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             for k, o in enumerate(outs):
-                vals[(id(node), k)] = o
+                vals[(id(node), k)] = _place(node, o)
 
         outputs = []
         for node, oi in out_refs:
@@ -174,16 +186,40 @@ class Executor:
         self._cached_grads = None
         self._saved_inputs = None
 
-        fwd_infer = build_graph_fn(symbol, is_train=False)
-        fwd_train = build_graph_fn(symbol, is_train=True)
+        node_device = None
+        if self._group2ctx:
+            # model parallelism (graph_executor.cc:997): nodes carrying a
+            # __ctx_group__ attr are pinned to group2ctx[group]'s device;
+            # ungrouped nodes follow the default ctx. Arg/aux arrays move
+            # to their owning node's device at bind time.
+            dev_by_group = {g: c.jax_device
+                            for g, c in self._group2ctx.items()}
+            default_dev = ctx.jax_device if ctx is not None else None
+
+            def node_device(node):
+                group = node.attrs.get("__ctx_group__")
+                return dev_by_group.get(group, default_dev)
+
+            for node in symbol._active_nodes():
+                if not node.is_var():
+                    continue
+                tgt = self.arg_dict.get(node.name)
+                if tgt is None:
+                    tgt = self.aux_dict.get(node.name)
+                if tgt is not None:
+                    tgt._data = jax.device_put(tgt._data,
+                                               node_device(node))
+            self._node_device = node_device
+        fwd_infer = build_graph_fn(symbol, is_train=False,
+                                   node_device=node_device)
+        fwd_train = build_graph_fn(symbol, is_train=True,
+                                   node_device=node_device)
         diff_names = tuple(self._diff_args)
 
-        @jax.jit
         def infer_fn(arg_arrays, aux_arrays, key):
             outs, _ = fwd_infer(arg_arrays, aux_arrays, key)
             return outs
 
-        @jax.jit
         def train_fn(diff_arrays, rest_arrays, aux_arrays, key, head_grads):
             def f(diff):
                 full = dict(rest_arrays)
@@ -197,6 +233,12 @@ class Executor:
                            else heads[0])
             return outs, aux_up, grads
 
+        if node_device is None:
+            # single-placement graphs compile to ONE XLA computation;
+            # placed (group2ctx) graphs run op-by-op so each segment can
+            # live on its own device with transfers at group boundaries
+            infer_fn = jax.jit(infer_fn)
+            train_fn = jax.jit(train_fn)
         self._infer_fn = infer_fn
         self._train_fn = train_fn
 
@@ -214,6 +256,10 @@ class Executor:
             if k in self.arg_dict:
                 self.arg_dict[k]._data = v._data if isinstance(v, nd.NDArray) \
                     else jnp.asarray(v)
+            else:
+                raise MXNetError(
+                    "forward got unknown argument %r (bound arguments: %s)"
+                    % (k, sorted(self.arg_dict)))
         arg_arrays = {k: v._data for k, v in self.arg_dict.items()}
         aux_arrays = {k: v._data for k, v in self.aux_dict.items()}
         key = rnd.next_key()

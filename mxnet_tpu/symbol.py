@@ -122,6 +122,8 @@ PARAM_SHAPE_RULES = {
     "InstanceNorm": {"gamma": _NORM_PARAM, "beta": _NORM_PARAM},
     "RNN": {"parameters": _rnn_params},
     "LeakyReLU": {"gamma": lambda ds, at: (ds[1] if len(ds) > 1 else 1,)},
+    "Embedding": {"weight": lambda ds, at: (at.get("input_dim", 1),
+                                            at.get("output_dim", 1))},
 }
 
 
@@ -498,7 +500,11 @@ def _merge_nodes(syms):
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """sym.var / sym.Variable (symbol.py:2516)."""
-    attrs = dict(attr or {})
+    from .attribute import AttrScope
+    scoped = AttrScope.current().get(None)
+    attrs = {("__%s__" % k if not k.startswith("__") else k): v
+             for k, v in scoped.items()} if scoped else {}
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
