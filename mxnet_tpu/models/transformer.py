@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.ring import ring_attention_sharded
+from ..parallel.ring import ring_attention, ring_attention_sharded
+from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs"]
@@ -46,6 +47,8 @@ class TransformerConfig:
     tp_axis: str = "tp"
     sp_axis: str = "sp"
     ep_axis: str = "ep"
+    pp_axis: str = None         # set to 'pp' to pipeline the layer stack
+    num_microbatches: int = 0   # 0 = one per pipeline stage
     use_ring_attention: bool = True
 
 
@@ -129,11 +132,14 @@ def _rms_norm(x, g):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
 
 
-def _attention(x, p, cfg, mesh):
+def _attention(x, p, cfg, mesh, manual_sp=False):
     q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
     k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
     v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
-    if mesh is not None and cfg.use_ring_attention and cfg.sp_axis:
+    if manual_sp:
+        # already inside a shard_map manual over sp (pipeline stage body)
+        o = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+    elif mesh is not None and cfg.use_ring_attention and cfg.sp_axis:
         o = ring_attention_sharded(q, k, v, mesh, axis_name=cfg.sp_axis,
                                    causal=True)
     else:
@@ -163,17 +169,44 @@ def _ffn(x, p, cfg):
     return jnp.einsum("btf,fd->btd", h, p["w2"])
 
 
+def _pp_size(cfg, mesh):
+    if mesh is None or not cfg.pp_axis:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(cfg.pp_axis, 1)
+
+
 def forward(params, tokens, cfg, mesh=None):
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
     x = params["embed"][tokens] + params["pos"][: tokens.shape[1]]
     act = P(cfg.dp_axis, cfg.sp_axis, None)
     if mesh is not None:
         x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act))
-    for p in params["layers"]:
-        x = x + _attention(_rms_norm(x, p["ln1"]), p, cfg, mesh)
-        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
-        if mesh is not None:
-            x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act))
+    n_stages = _pp_size(cfg, mesh)
+    if n_stages > 1:
+        # pipeline the homogeneous layer stack over pp: stage-major
+        # stacked weights, ppermute microbatch schedule; ring attention
+        # runs manually over sp inside each stage, tp/ep stay auto
+        ring = bool(cfg.use_ring_attention and cfg.sp_axis)
+
+        def layer_fn(p, xm):
+            xm = xm + _attention(_rms_norm(xm, p["ln1"]), p, cfg, mesh,
+                                 manual_sp=ring)
+            return xm + _ffn(_rms_norm(xm, p["ln2"]), p, cfg)
+
+        stacked = stack_stage_params(params["layers"], n_stages)
+        x = spmd_pipeline(
+            layer_fn, stacked, x, mesh, axis_name=cfg.pp_axis,
+            num_microbatches=cfg.num_microbatches or None,
+            extra_manual_axes=(cfg.sp_axis,) if ring else (),
+            microbatch_spec=P(None, None, cfg.sp_axis, None) if ring
+            else P())
+    else:
+        for p in params["layers"]:
+            x = x + _attention(_rms_norm(x, p["ln1"]), p, cfg, mesh)
+            x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+            if mesh is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, act))
     x = _rms_norm(x, params["ln_f"])
     return jnp.einsum("btd,vd->btv", x, params["embed"])
 

@@ -100,3 +100,76 @@ def test_graft_entry_forward_jits():
     out = jax.jit(fn)(*ex)
     assert out.shape == (8, 1000)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_spmd_pipeline_matches_sequential():
+    """parallel/pipeline.py: pp=2 pipeline over a 4-layer MLP stack
+    equals sequential layer application, forward and backward."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.pipeline import (stack_stage_params,
+                                             spmd_pipeline)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    rng = np.random.RandomState(0)
+    L, D, B = 4, 8, 8
+    layers = [{"w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+               "b": jnp.asarray(rng.randn(D).astype(np.float32) * 0.1)}
+              for _ in range(L)]
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+
+    def layer_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    stacked = stack_stage_params(layers, 2)
+    y = jax.jit(lambda s, x_: spmd_pipeline(layer_fn, s, x_, mesh))(
+        stacked, x)
+    ref = x
+    for p in layers:
+        ref = jnp.tanh(ref @ p["w"] + p["b"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    def loss(s, x_):
+        return jnp.sum(spmd_pipeline(layer_fn, s, x_, mesh) ** 2)
+
+    def loss_ref(ls, x_):
+        h = x_
+        for p in ls:
+            h = jnp.tanh(h @ p["w"] + p["b"])
+        return jnp.sum(h ** 2)
+
+    g = jax.jit(jax.grad(loss))(stacked, x)
+    gref = jax.grad(loss_ref)(layers, x)
+    # stage 0 layer 0 == layers[0]; stage 1 layer 1 == layers[3]
+    np.testing.assert_allclose(np.asarray(g["w"][0, 0]),
+                               np.asarray(gref[0]["w"]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["w"][1, 1]),
+                               np.asarray(gref[3]["w"]), atol=1e-5)
+
+
+def test_transformer_pp_matches_unsharded():
+    """Full transformer train-step parity: pp=2 (+sp ring attention +tp)
+    loss equals the single-device unsharded loss (VERDICT r1 item 6)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.models import transformer as T
+    mesh = make_mesh({"pp": 2, "sp": 2, "tp": 2, "dp": 1, "ep": 1})
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=4, d_ff=64, max_len=32,
+                              pp_axis="pp", use_ring_attention=True)
+    cfg_ref = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                  n_layers=4, d_ff=64, max_len=32,
+                                  use_ring_attention=False)
+    params = T.init_params(cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 32)), jnp.int32)
+    loss_ref = float(T.loss_fn(params, tokens, cfg_ref, mesh=None))
+    sharded = T.shard_params(params, cfg, mesh)
+    tok = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    loss_pp = float(jax.jit(
+        lambda p, t: T.loss_fn(p, t, cfg, mesh))(sharded, tok))
+    assert abs(loss_ref - loss_pp) < 1e-4, (loss_ref, loss_pp)
+    # and the full train step executes with finite loss
+    step = T.make_train_step(cfg, mesh, lr=1e-2)
+    _, _, l = step(sharded, T.init_momentum(sharded), tok)
+    assert np.isfinite(float(l))
