@@ -1,1 +1,7 @@
-"""placeholder — populated in this round."""
+"""gluon.contrib — estimator fit loop, contrib layers, conv RNN cells,
+samplers (reference: python/mxnet/gluon/contrib/)."""
+
+from . import estimator
+from . import nn
+from . import rnn
+from . import data

@@ -1,0 +1,4 @@
+"""Contrib data utilities (reference: gluon/contrib/data/)."""
+from .sampler import IntervalSampler
+
+__all__ = ["IntervalSampler"]

@@ -1,0 +1,30 @@
+"""Contrib samplers (reference:
+python/mxnet/gluon/contrib/data/sampler.py)."""
+
+from ...data import sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(sampler.Sampler):
+    """Samples [0, s, 2s, ...], then [1, s+1, 2s+1, ...], etc. —
+    interval-strided coverage of [0, length)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError(
+                "interval %d must not exceed length %d"
+                % (interval, length))
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for start in range(self._interval if self._rollover else 1):
+            for i in range(start, self._length, self._interval):
+                yield i
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

@@ -1,0 +1,176 @@
+"""Gluon Estimator — a batteries-included fit loop over Trainer.
+
+Reference: python/mxnet/gluon/contrib/estimator/estimator.py:40. The
+event-handler contract (train/epoch/batch begin/end hooks, handler
+priority ordering, default Stopping/Metric/Logging handlers) matches the
+reference; the loop body is the TPU-native train step: one hybridized
+forward + loss + backward per batch, Trainer.step, device-side metric
+updates."""
+
+import numpy as np
+
+from .... import metric as metric_mod
+from ....context import current_context
+from ... import loss as gloss
+from ...trainer import Trainer
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, ValidationHandler,
+                            LoggingHandler)
+
+__all__ = ["Estimator"]
+
+
+class _LossMetric(metric_mod.EvalMetric):
+    """Running mean of the loss values (reference uses metric.Loss)."""
+
+    _is_loss_metric = True
+
+    def __init__(self, name="loss"):
+        super(_LossMetric, self).__init__(name)
+
+    def update(self, _labels, losses):
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        for l in losses:
+            arr = l.asnumpy() if hasattr(l, "asnumpy") else np.asarray(l)
+            self.sum_metric += float(arr.sum())
+            self.num_inst += arr.size
+
+
+class Estimator(object):
+    """Train/evaluate a Gluon net with event handlers."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        if not isinstance(loss, gloss.Loss):
+            raise ValueError("loss must be a gluon.loss.Loss instance")
+        self.loss = loss
+        metrics = metrics or []
+        self.train_metrics = metrics if isinstance(metrics, list) \
+            else [metrics]
+        for m in self.train_metrics:
+            if not isinstance(m, metric_mod.EvalMetric):
+                raise ValueError(
+                    "metrics must be EvalMetric instances, got %r" % (m,))
+        self.train_metrics.append(_LossMetric("train_" +
+                                              type(loss).__name__.lower()))
+        self.context = context or current_context()
+        params = self.net.collect_params()
+        if initializer is not None:
+            self.net.initialize(initializer, force_reinit=True)
+        elif any(p._data is None and not p._deferred_init
+                 for p in params.values()):
+            self.net.initialize()
+        if trainer is None:
+            trainer = Trainer(params, "adam",
+                              {"learning_rate": 1e-3})
+        if not isinstance(trainer, Trainer):
+            raise ValueError("trainer must be a gluon.Trainer")
+        self.trainer = trainer
+        self.val_metrics = [_LossMetric("validation_" +
+                                        type(loss).__name__.lower())]
+
+    # ------------------------------------------------------------ eval --
+    def evaluate_batch(self, batch, val_metrics, batch_axis=0):
+        data, label = batch[0], batch[1]
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        for m in val_metrics:
+            if getattr(m, "_is_loss_metric", False):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        val_metrics = val_metrics or self.val_metrics
+        for m in val_metrics:
+            m.reset()
+        for batch in val_data:
+            self.evaluate_batch(_as_pair(batch), val_metrics, batch_axis)
+        return val_metrics
+
+    # ------------------------------------------------------------- fit --
+    def fit_batch(self, batch, batch_axis=0):
+        from .... import autograd
+        data, label = batch[0], batch[1]
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        batch_size = data.shape[batch_axis]
+        self.trainer.step(batch_size)
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_axis=0):
+        if not epochs and not batches:
+            epochs = 1
+        event_handlers = self._prepare_handlers(event_handlers, val_data,
+                                                epochs, batches)
+        groups = _dispatch_groups(event_handlers)
+        stop = False
+        for h in groups["train_begin"]:
+            h.train_begin(self)
+        while not stop:
+            for h in groups["epoch_begin"]:
+                h.epoch_begin(self)
+            for batch in train_data:
+                batch = _as_pair(batch)
+                for h in groups["batch_begin"]:
+                    h.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch,
+                                                         batch_axis)
+                for h in groups["batch_end"]:
+                    if h.batch_end(self, batch=batch, pred=pred,
+                                   label=label, loss=loss):
+                        stop = True
+                if stop:
+                    break
+            if stop:
+                break
+            for h in groups["epoch_end"]:
+                if h.epoch_end(self):
+                    stop = True
+        for h in groups["train_end"]:
+            h.train_end(self)
+
+    def _prepare_handlers(self, event_handlers, val_data, epochs,
+                          batches):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler)
+                        for h in handlers):
+            handlers.append(ValidationHandler(
+                val_data, eval_fn=lambda val_data:
+                self.evaluate(val_data)))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        return handlers
+
+
+def _as_pair(batch):
+    if isinstance(batch, (list, tuple)):
+        return batch
+    # mx.io DataBatch
+    return (batch.data[0], batch.label[0])
+
+
+def _dispatch_groups(handlers):
+    """Sort handlers into per-event lists ordered by priority (lower
+    runs first; handlers without priority run in registration order)."""
+    events = {"train_begin": TrainBegin, "epoch_begin": EpochBegin,
+              "batch_begin": BatchBegin, "batch_end": BatchEnd,
+              "epoch_end": EpochEnd, "train_end": TrainEnd}
+    groups = {}
+    for key, base in events.items():
+        group = [h for h in handlers if isinstance(h, base)]
+        group.sort(key=lambda h: getattr(h, "priority", 0))
+        groups[key] = group
+    return groups

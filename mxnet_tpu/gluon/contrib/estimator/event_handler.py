@@ -1,0 +1,310 @@
+"""Estimator event handlers.
+
+Reference: python/mxnet/gluon/contrib/estimator/event_handler.py — the
+mixin classes (TrainBegin..BatchEnd) and the stock handlers. Bodies are
+original; the hook-method contract matches the reference so user
+handlers port over unchanged."""
+
+import logging
+import os
+import time
+
+import numpy as np
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin(object):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(object):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(object):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(object):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(object):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(object):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop training at a max epoch or batch count."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets train metrics each epoch and updates them per batch."""
+
+    def __init__(self, train_metrics):
+        self.train_metrics = train_metrics or []
+        # run before other handlers that read metric values
+        self.priority = -np.inf
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.train_metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs["pred"]
+        label = kwargs["label"]
+        loss = kwargs["loss"]
+        for metric in self.train_metrics:
+            if getattr(metric, "_is_loss_metric", False):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Runs validation every `epoch_period` epochs (or `batch_period`
+    batches)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None, priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                     BatchBegin, BatchEnd):
+    """Logs training progress at epoch (default) or batch granularity."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        if log_interval != "epoch" and not isinstance(log_interval, int):
+            raise ValueError(
+                "log_interval must be 'epoch' or an integer batch count")
+        self.metrics = metrics or []
+        self.log_interval = log_interval
+        self.logger = logging.getLogger(__name__)
+        self.priority = np.inf  # run last, after metrics updated
+        self._train_start = None
+        self._batch_count = 0
+        self._epoch_start = None
+        self.current_epoch = 0
+
+    def _fmt_metrics(self):
+        return ", ".join("%s: %.4f" % (m.get()[0], _scalar(m.get()[1]))
+                         for m in self.metrics)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.3fs; %s",
+                         time.time() - self._train_start,
+                         self._fmt_metrics())
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self._epoch_start = time.time()
+        self._batch_count = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.logger.info("[Epoch %d] finished in %.3fs: %s",
+                         self.current_epoch,
+                         time.time() - self._epoch_start,
+                         self._fmt_metrics())
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batch_count += 1
+        if isinstance(self.log_interval, int) and \
+                self._batch_count % self.log_interval == 0:
+            self.logger.info("[Epoch %d][Batch %d] %s",
+                             self.current_epoch, self._batch_count,
+                             self._fmt_metrics())
+
+
+def _scalar(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Saves model parameters (and trainer states) every epoch_period
+    epochs; optionally keeps the best checkpoint by a monitored metric."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.verbose = verbose
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved_checkpoints = []
+        if save_best and monitor is None:
+            raise ValueError(
+                "save_best requires a monitor metric")
+        if mode == "min" or (mode == "auto" and monitor is not None and
+                             "acc" not in monitor.get()[0].lower()):
+            self._better = lambda new, best: new < best
+            self.best = np.inf
+        else:
+            self._better = lambda new, best: new > best
+            self.best = -np.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_epoch = 0
+        self.current_batch = 0
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+        estimator.net.save_parameters(path)
+        if estimator.trainer is not None:
+            try:
+                estimator.trainer.save_states(path + ".states")
+            except Exception:
+                pass
+        self.saved_checkpoints.append(path)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for f in (old, old + ".states"):
+                if os.path.exists(f):
+                    os.remove(f)
+        return path
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self.current_batch)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % self.current_epoch)
+        if self.save_best:
+            val = _scalar(self.monitor.get()[1])
+            if self._better(val, self.best):
+                self.best = val
+                path = os.path.join(
+                    self.model_dir, "%s-best.params" % self.model_prefix)
+                estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stops training when the monitored metric stops improving."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        name = monitor.get()[0].lower()
+        if mode == "min" or (mode == "auto" and "acc" not in name):
+            self._better = lambda new, best: new < best - self.min_delta
+            self._best_init = np.inf
+        else:
+            self._better = lambda new, best: new > best + self.min_delta
+            self._best_init = -np.inf
+        self.best = self._best_init
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        self.best = self.baseline if self.baseline is not None \
+            else self._best_init
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        val = _scalar(self.monitor.get()[1])
+        if self._better(val, self.best):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch:
+            logging.getLogger(__name__).info(
+                "Early stopping at epoch %d (best %s: %.4f)",
+                self.stopped_epoch, self.monitor.get()[0], self.best)

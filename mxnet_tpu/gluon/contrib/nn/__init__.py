@@ -1,0 +1,3 @@
+"""Contrib layers (reference: gluon/contrib/nn/)."""
+from .basic_layers import *  # noqa: F401,F403
+from .basic_layers import __all__  # noqa: F401

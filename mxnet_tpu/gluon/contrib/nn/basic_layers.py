@@ -1,0 +1,155 @@
+"""Contrib neural-network layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py (Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle1D/2D/3D). Implementations are original; SyncBatchNorm is
+TPU-native — see its docstring."""
+
+from ... import nn
+from ...block import Block, HybridBlock
+from ...nn import BatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "SparseEmbedding", "SyncBatchNorm", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class Concurrent(nn.Sequential):
+    """Feeds the input to every child and concatenates their outputs
+    along `axis`."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(Concurrent, self).__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.concat(*[child(x) for child in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(nn.HybridSequential):
+    """Hybridizable Concurrent."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super(HybridConcurrent, self).__init__(prefix=prefix,
+                                               params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[child(x) for child in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Returns its input — the skip-connection placeholder for
+    Concurrent blocks."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with sparse_grad semantics. TPU-native note: XLA has no
+    sparse memory ops, so the gradient is a dense scatter-add (SURVEY §7
+    hard part (a)); the class exists for API parity and behaves exactly
+    like Embedding(sparse_grad=True) in the reference's forward."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super(SparseEmbedding, self).__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, grad_stype="row_sparse")
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.Embedding(x, self.weight.data(), **self._kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim}, " \
+            "{dtype})".format(**self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm.
+
+    Reference: src/operator/contrib/sync_batch_norm.cc — a key-slot
+    barrier that all-reduces mean/var across GPUs through the engine.
+    TPU-native: under GSPMD the batch axis is a *global* array dimension
+    sharded over 'dp', so the plain BatchNorm reduction already spans
+    every device — XLA inserts the psum over dp automatically. This
+    subclass therefore only keeps the reference's signature
+    (num_devices is accepted and unused) and documents the semantics:
+    statistics are exact global-batch statistics, which is what the
+    reference op approximates with its engine barrier."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super(SyncBatchNorm, self).__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class _PixelShuffle(HybridBlock):
+    ndim = None
+
+    def __init__(self, factor):
+        super(_PixelShuffle, self).__init__()
+        if isinstance(factor, int):
+            factor = (factor,) * self.ndim
+        self._factors = tuple(int(f) for f in factor)
+        assert len(self._factors) == self.ndim
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """[N, C*f, W] -> [N, C, W*f] sub-pixel upsampling (Shi et al. 2016).
+    Pure reshape/transpose — free under XLA. Uses MXNet reshape codes
+    (0 copy, -1 infer, -3 merge, -4 split) so it stays hybridizable."""
+
+    ndim = 1
+
+    def hybrid_forward(self, F, x):
+        f, = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))       # N, C, f, W
+        x = F.transpose(x, axes=(0, 1, 3, 2))            # N, C, W, f
+        return F.reshape(x, shape=(0, 0, -3))            # N, C, W*f
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """[N, C*fh*fw, H, W] -> [N, C, H*fh, W*fw]."""
+
+    ndim = 2
+
+    def hybrid_forward(self, F, x):
+        fh, fw = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, fh * fw, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, fh, fw, 0, 0))  # N,C,fh,fw,H,W
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))       # N,C,H,fh,W,fw
+        return F.reshape(x, shape=(0, 0, -3, -3))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """[N, C*fd*fh*fw, D, H, W] -> [N, C, D*fd, H*fh, W*fw]."""
+
+    ndim = 3
+
+    def hybrid_forward(self, F, x):
+        fd, fh, fw = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, fd * fh * fw, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, fd, fh * fw, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, fh, fw, 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))

@@ -1,0 +1,3 @@
+"""Contrib recurrent cells (reference: gluon/contrib/rnn/)."""
+from .conv_rnn_cell import *  # noqa: F401,F403
+from .conv_rnn_cell import __all__  # noqa: F401

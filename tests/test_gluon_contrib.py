@@ -1,0 +1,194 @@
+"""gluon.contrib tests — estimator fit loop w/ handlers, contrib layers,
+conv RNN cells, IntervalSampler (reference:
+tests/python/unittest/test_gluon_contrib.py, test_gluon_estimator.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import contrib
+from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                               CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               LoggingHandler)
+
+
+def _toy_loader(n=64, d=8, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    data = [(nd.array(X[i:i + batch]), nd.array(y[i:i + batch]))
+            for i in range(0, n, batch)]
+    return data
+
+
+def test_estimator_trains_mlp_with_handlers(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    acc = mx.metric.Accuracy()
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=acc, trainer=trainer)
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="mlp",
+                             monitor=acc, save_best=True)
+    train = _toy_loader()
+    est.fit(train_data=train, val_data=_toy_loader(seed=1), epochs=8,
+            event_handlers=[ckpt])
+    name, value = acc.get()
+    assert value > 0.9, (name, value)
+    # checkpoints written
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "mlp-epoch8.params"))
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "mlp-best.params"))
+    # validation metrics populated
+    assert est.val_metrics[0].num_inst > 0
+
+
+def test_estimator_early_stopping():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(2))
+    net.initialize()
+    acc = mx.metric.Accuracy()
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=acc,
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.0}))
+    stopper = EarlyStoppingHandler(monitor=acc, patience=1)
+    est.fit(train_data=_toy_loader(), epochs=50,
+            event_handlers=[stopper])
+    # lr=0 -> no improvement -> must stop long before 50 epochs
+    assert stopper.stop_training
+    assert stopper.current_epoch < 10
+
+
+def test_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib.nn import (HybridConcurrent, Identity)
+    block = HybridConcurrent(axis=1)
+    block.add(Identity())
+    block.add(gluon.nn.Dense(4))
+    block.initialize()
+    x = nd.random.uniform(shape=(3, 4))
+    out = block(x)
+    assert out.shape == (3, 8)
+    np.testing.assert_allclose(out.asnumpy()[:, :4], x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_pixelshuffle():
+    from mxnet_tpu.gluon.contrib.nn import (PixelShuffle1D,
+                                            PixelShuffle2D,
+                                            PixelShuffle3D)
+    b1 = PixelShuffle1D(2)
+    assert b1(nd.zeros((1, 4, 3))).shape == (1, 2, 6)
+    b2 = PixelShuffle2D((2, 3))
+    assert b2(nd.zeros((1, 12, 3, 4))).shape == (1, 2, 6, 12)
+    b3 = PixelShuffle3D(2)
+    assert b3(nd.zeros((1, 8, 2, 3, 4))).shape == (1, 1, 4, 6, 8)
+    # value correctness for 2D: known permutation
+    x = nd.array(np.arange(1 * 4 * 2 * 2, dtype=np.float32)
+                 .reshape(1, 4, 2, 2))
+    y = PixelShuffle2D(2)(x).asnumpy()
+    assert y.shape == (1, 1, 4, 4)
+    # channel c, offset (i,j) maps to output (h*2+i, w*2+j)
+    src = x.asnumpy()
+    for h in range(2):
+        for w in range(2):
+            for i in range(2):
+                for j in range(2):
+                    assert y[0, 0, h * 2 + i, w * 2 + j] == \
+                        src[0, i * 2 + j, h, w]
+
+
+def test_pixelshuffle_hybridized():
+    from mxnet_tpu.gluon.contrib.nn import PixelShuffle2D
+    b = PixelShuffle2D(2)
+    eager = b(nd.array(np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)))
+    b2 = PixelShuffle2D(2)
+    b2.hybridize()
+    hybrid = b2(nd.array(np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)))
+    np.testing.assert_allclose(eager.asnumpy(), hybrid.asnumpy())
+
+
+def test_sync_batch_norm():
+    from mxnet_tpu.gluon.contrib.nn import SyncBatchNorm
+    bn = SyncBatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.random.uniform(shape=(8, 4, 5, 5))
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = bn(x)
+    assert y.shape == x.shape
+    # training-mode stats: per-channel mean ~0
+    m = y.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-3)
+
+
+@pytest.mark.parametrize("cell_cls,dims,nstates", [
+    ("Conv1DRNNCell", 1, 1), ("Conv2DRNNCell", 2, 1),
+    ("Conv1DLSTMCell", 1, 2), ("Conv2DLSTMCell", 2, 2),
+    ("Conv2DGRUCell", 2, 1), ("Conv3DLSTMCell", 3, 2),
+])
+def test_conv_rnn_cells(cell_cls, dims, nstates):
+    cls = getattr(contrib.rnn, cell_cls)
+    spatial = (8, 8, 8)[:dims]
+    cell = cls(input_shape=(3,) + spatial, hidden_channels=5,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    B, T = 2, 3
+    x = nd.random.uniform(shape=(B, T, 3) + spatial)
+    outputs, states = cell.unroll(T, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (B, T, 5) + spatial
+    assert len(states) == nstates
+    for s in states:
+        assert s.shape == (B, 5) + spatial
+
+
+def test_conv_lstm_gate_math():
+    """ConvLSTM with 1x1 kernels over 1x1 spatial degenerates to the
+    dense LSTMCell equations — cross-check against it."""
+    rng = np.random.RandomState(0)
+    H = 4
+    conv = contrib.rnn.Conv1DLSTMCell(input_shape=(3, 1),
+                                      hidden_channels=H,
+                                      i2h_kernel=1, h2h_kernel=1)
+    dense = gluon.rnn.LSTMCell(H, input_size=3)
+    conv.initialize()
+    dense.initialize()
+    wi = rng.randn(4 * H, 3).astype(np.float32) * 0.5
+    wh = rng.randn(4 * H, H).astype(np.float32) * 0.5
+    conv.i2h_weight.set_data(nd.array(wi.reshape(4 * H, 3, 1)))
+    conv.h2h_weight.set_data(nd.array(wh.reshape(4 * H, H, 1)))
+    dense.i2h_weight.set_data(nd.array(wi))
+    dense.h2h_weight.set_data(nd.array(wh))
+    x = nd.array(rng.randn(2, 3).astype(np.float32))
+    hc = [nd.zeros((2, H)), nd.zeros((2, H))]
+    out_d, _ = dense(x, hc)
+    out_c, _ = conv(x.reshape(2, 3, 1),
+                    [nd.zeros((2, H, 1)), nd.zeros((2, H, 1))])
+    np.testing.assert_allclose(out_c.asnumpy()[..., 0],
+                               out_d.asnumpy(), rtol=1e-5)
+
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+    s = IntervalSampler(10, 3)
+    idx = list(s)
+    assert sorted(idx) == list(range(10))
+    assert idx[:4] == [0, 3, 6, 9]
+    s2 = IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9]
+    assert len(s2) == 4
+
+
+def test_sparse_embedding():
+    emb = contrib.nn.SparseEmbedding(20, 6)
+    emb.initialize()
+    out = emb(nd.array([1, 3, 1]))
+    assert out.shape == (3, 6)
+    np.testing.assert_allclose(out.asnumpy()[0], out.asnumpy()[2])
