@@ -41,6 +41,7 @@ from . import sparse
 ndarray.sparse = sparse  # compressed-storage sparse module (nd.sparse)
 from . import parallel
 from . import module
+mod = module  # reference alias (mx.mod)
 from . import monitor
 from .monitor import Monitor
 from . import profiler

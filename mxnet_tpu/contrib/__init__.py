@@ -1,3 +1,4 @@
 """Contrib namespace (reference: python/mxnet/contrib/__init__.py)."""
 
 from . import amp
+from . import quantization

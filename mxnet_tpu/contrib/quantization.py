@@ -1,0 +1,257 @@
+"""Post-training INT8 quantization.
+
+Reference: python/mxnet/contrib/quantization.py (quantize_model, 923
+LoC) + src/operator/quantization/calibrate.cc (minmax and KL-entropy
+threshold selection) + quantize_graph_pass.cc.
+
+Pipeline (same stages as the reference, on the TPU-native graph):
+1. calibrate: run the fp32 symbol over calibration batches collecting
+   each quantizable layer's input distribution — min/max ('naive') or
+   KL-optimal thresholds ('entropy', the calibrate.cc histogram
+   algorithm).
+2. rewrite: replace FullyConnected / Convolution nodes with
+   _contrib_quantized_* nodes carrying the calibrated input range as
+   attrs and referencing offline-quantized int8 weights.
+3. return (qsym, qarg_params, aux_params) exactly like the reference
+   quantize_model, ready for bind/Module.
+"""
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import symbol as sym_mod
+from ..ops.quantization_ops import quantize_weight
+
+__all__ = ["quantize_model", "calib_graph"]
+
+QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+               "Convolution": "_contrib_quantized_conv"}
+
+
+def _optimal_threshold_kl(abs_hist, abs_edges, num_quantized_bins=128):
+    """KL-divergence threshold search over an |x| histogram
+    (calibrate.cc GetOptimalThreshold, the TensorRT algorithm): for each
+    candidate clip threshold, compare the clipped distribution P with
+    its int8-quantized reconstruction Q and keep the threshold with the
+    smallest divergence."""
+    num_bins = len(abs_hist)
+    best_kl = np.inf
+    best_threshold = float(abs_edges[-1])
+    hist = abs_hist.astype(np.float64)
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, num_bins // 128)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()      # outliers clip into the edge
+        total = p.sum()
+        if total == 0:
+            continue
+        # quantize the first i bins down to num_quantized_bins levels,
+        # then expand back, spreading each level's mass uniformly over
+        # its source bins that were non-empty
+        factor = i / num_quantized_bins
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            start = int(np.floor(j * factor))
+            stop = min(max(int(np.floor((j + 1) * factor)), start + 1),
+                       i)
+            chunk = hist[start:stop]
+            nz = int((chunk != 0).sum())
+            if nz:
+                q[start:stop] = np.where(chunk != 0,
+                                         chunk.sum() / nz, 0.0)
+        pn = p / total
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        qn = q / qsum
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(
+            pn[mask] / np.maximum(qn[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl = kl
+            best_threshold = float(abs_edges[i])
+    return best_threshold
+
+
+class _LayerCollector(object):
+    """Accumulates per-tensor statistics across calibration batches."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.minmax = {}        # name -> [min, max]
+        self.samples = {}       # name -> list of abs-value histograms
+
+    def update(self, name, arr):
+        a = arr if isinstance(arr, np.ndarray) else arr.asnumpy()
+        mn, mx = float(a.min()), float(a.max())
+        if name in self.minmax:
+            old = self.minmax[name]
+            self.minmax[name] = [min(old[0], mn), max(old[1], mx)]
+        else:
+            self.minmax[name] = [mn, mx]
+        if self.mode == "entropy":
+            self.samples.setdefault(name, []).append(a.ravel())
+
+    def thresholds(self):
+        out = {}
+        for name, (mn, mx) in self.minmax.items():
+            if self.mode == "entropy":
+                vals = np.abs(np.concatenate(self.samples[name]))
+                amax = max(abs(mn), abs(mx), 1e-10)
+                hist, edges = np.histogram(vals, bins=2048,
+                                           range=(0, amax))
+                t = _optimal_threshold_kl(hist, edges)
+                out[name] = (-t, t)
+            else:
+                out[name] = (mn, mx)
+        return out
+
+
+def calib_graph(symbol, arg_params, aux_params, calib_data, data_names,
+                collector, num_calib_examples=None, ctx=None):
+    """Run fp32 forward over calibration batches, collecting the input
+    tensor of every quantizable node (the reference collects via
+    monitor callbacks on the executor)."""
+    from ..context import cpu
+    ctx = ctx or cpu()
+    # outputs we need: each quantizable node's data input tensor
+    node_index = {id(n): i for i, n in enumerate(symbol._nodes)}
+    want = {}           # layer name -> (node list index, out index)
+    for node in symbol._active_nodes():
+        if node.op in QUANTIZABLE:
+            src_sym, oi = node.inputs[0]
+            src = src_sym._nodes[src_sym._outputs[0][0]]
+            want[node.name] = (node_index[id(src)], oi)
+    tap_refs = sorted(set(want.values()))
+    if not tap_refs:
+        return
+    tap_pos = {ref: i for i, ref in enumerate(tap_refs)}
+    group = sym_mod.Group([sym_mod.Symbol(symbol._nodes, [ref])
+                           for ref in tap_refs])
+    shapes = {}
+    first = next(iter(calib_data))
+    calib_data.reset()
+    for dn, arr in zip(data_names, first.data):
+        shapes[dn] = arr.shape
+    ex = group.simple_bind(ctx, grad_req="null", **shapes)
+    wanted_args = set(group.list_arguments())
+    wanted_aux = set(group.list_auxiliary_states())
+    ex.copy_params_from(
+        {k: v for k, v in arg_params.items() if k in wanted_args},
+        {k: v for k, v in (aux_params or {}).items() if k in wanted_aux})
+    seen = 0
+    for batch in calib_data:
+        feed = dict(zip(data_names, batch.data))
+        outs = ex.forward(is_train=False, **feed)
+        for layer, ref in want.items():
+            collector.update(layer, outs[tap_pos[ref]])
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    calib_data.reset()
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None,
+                   label_names=("softmax_label",), logger=None):
+    """Reference quantize_model API: returns (qsym, qarg_params,
+    aux_params)."""
+    logger = logger or logging.getLogger(__name__)
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError("quantized_dtype %s not supported (int8 only)"
+                         % quantized_dtype)
+    excluded = set(excluded_sym_names)
+
+    thresholds = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_mode=%s requires calib_data"
+                             % calib_mode)
+        collector = _LayerCollector(calib_mode)
+        calib_graph(sym, arg_params, aux_params, calib_data,
+                    list(data_names), collector, num_calib_examples,
+                    ctx=ctx)
+        thresholds = collector.thresholds()
+        logger.info("calibrated %d layers (%s mode)", len(thresholds),
+                    calib_mode)
+
+    qarg_params = dict(arg_params)
+    nodes = sym._nodes
+    new_syms = {}   # id(old node) -> Symbol producing its replacement
+    out_map = {}
+    for node in sym._active_nodes():
+        if node.is_var():
+            continue
+        new_inputs = []
+        for s, oi in node.inputs:
+            src = s._nodes[s._outputs[0][0]]
+            rep = new_syms.get(id(src))
+            if rep is not None:
+                new_inputs.append(rep[oi] if
+                                  len(rep._outputs) > oi else rep)
+            else:
+                new_inputs.append(sym_mod.Symbol(s._nodes,
+                                                 [s._outputs[0]]))
+        if node.op in QUANTIZABLE and node.name not in excluded and \
+                (calib_mode == "none" or node.name in thresholds):
+            in_names = list(node.attrs.get("__input_names__", ()))
+            wname = node.name + "_weight"
+            bname = node.name + "_bias"
+            w = arg_params.get(wname)
+            if w is None:
+                new_syms[id(node)] = _recompose(node, new_inputs)
+                continue
+            qw, wscale = quantize_weight(w._data)
+            qarg_params[wname + "_quantize"] = nd.NDArray(qw, w._ctx)
+            mn, mx = thresholds.get(node.name, (0.0, 0.0))
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            attrs.update({"data_min": float(mn), "data_max": float(mx),
+                          "weight_scale": float(wscale)})
+            qop = QUANTIZABLE[node.op]
+            qweight_var = sym_mod.var(wname + "_quantize",
+                                      shape=tuple(w.shape),
+                                      dtype="int8")
+            ins = [new_inputs[0], qweight_var]
+            names = ["data", "weight"]
+            if not node.attrs.get("no_bias", False) and \
+                    bname in arg_params:
+                bidx = in_names.index("bias") if "bias" in in_names \
+                    else None
+                bias_sym = new_inputs[bidx] if bidx is not None \
+                    else sym_mod.var(bname)
+                bnode = bias_sym._nodes[bias_sym._outputs[0][0]]
+                if bnode.is_var():
+                    # quantized ops have no auto param-shape rule; pin
+                    # the known bias shape for inference
+                    bnode.attrs.setdefault(
+                        "__shape__", tuple(arg_params[bname].shape))
+                ins.append(bias_sym)
+                names.append("bias")
+            attrs["__input_names__"] = tuple(names)
+            new_syms[id(node)] = sym_mod._compose(
+                qop, ins, attrs, node.name + "_quantized")
+        else:
+            new_syms[id(node)] = _recompose(node, new_inputs)
+        out_map[id(node)] = new_syms[id(node)]
+
+    outs = []
+    for ni, oi in sym._outputs:
+        node = nodes[ni]
+        rep = out_map.get(id(node))
+        if rep is None:
+            outs.append(sym_mod.Symbol(nodes, [(ni, oi)]))
+        else:
+            outs.append(rep[oi] if len(rep._outputs) > oi else rep)
+    qsym = sym_mod.Group(outs) if len(outs) > 1 else outs[0]
+    return qsym, qarg_params, dict(aux_params)
+
+
+def _recompose(node, new_inputs):
+    """Copy a node on top of (possibly rewritten) inputs."""
+    attrs = dict(node.attrs)
+    return sym_mod._compose(node.op, new_inputs, attrs, node.name)

@@ -272,7 +272,12 @@ class Module(BaseModule):
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
 
-        args = {n: nd.zeros(s, ctx=self._context[0])
+        # variables may pin dtype via __dtype__ (int8 quantized weights)
+        var_dtypes = {node.name: node.attrs["__dtype__"]
+                      for node in self._symbol._active_nodes()
+                      if node.is_var() and "__dtype__" in node.attrs}
+        args = {n: nd.zeros(s, ctx=self._context[0],
+                            dtype=var_dtypes.get(n, "float32"))
                 for n, s in zip(arg_names, arg_shapes)}
         auxs = {n: nd.zeros(s, ctx=self._context[0])
                 for n, s in zip(aux_names, aux_shapes)}

@@ -1,0 +1,136 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ — quantize(_v2)/dequantize/
+requantize plus quantized_conv/quantized_fully_connected, and the
+calibration machinery in calibrate.cc.
+
+TPU-native design: symmetric signed-int8 quantization (the reference's
+int8 path); the quantized compute ops consume fp32 tensors plus
+calibrated ranges carried as static attrs, quantize on the fly to int8,
+run the matmul/conv with int8 inputs accumulating in int32
+(`preferred_element_type=int32` — the MXU's native int8 path on real
+TPU hardware), and rescale to fp32. This folds the reference's
+quantize→compute→requantize→dequantize chains into one fused node per
+layer — the XLA-idiomatic shape of the same arithmetic, bit-accurate
+int8 compute included."""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+INT8_MAX = 127.0
+
+
+def _scale(min_range, max_range):
+    """Symmetric scale: int8 = round(x * 127 / amax)."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return INT8_MAX / jnp.maximum(amax, 1e-10)
+
+
+def _quantize_int8(x, scale):
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) * scale),
+                 -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8)
+
+
+@register(name="_contrib_quantize_v2", num_outputs=3,
+          differentiable=False)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """fp32 -> (int8, min_range, max_range). Without calib ranges the
+    range is the tensor's own min/max (quantize_v2.cc)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    s = _scale(mn, mx)
+    return _quantize_int8(data, s), mn.reshape(1), mx.reshape(1)
+
+
+@register(name="_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 -> fp32 using the stored range (dequantize.cc)."""
+    s = _scale(min_range.reshape(()), max_range.reshape(()))
+    return data.astype(jnp.float32) / s
+
+
+@register(name="_contrib_requantize", num_outputs=3,
+          differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 with a (possibly calibrated) output
+    range (requantize.cc)."""
+    in_s = _scale(min_range.reshape(()), max_range.reshape(()))
+    real = data.astype(jnp.float32) / in_s
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    out_s = _scale(mn, mx)
+    return _quantize_int8(real, out_s), mn.reshape(1), mx.reshape(1)
+
+
+def _int8_matmul(qx, qw):
+    """[M,K]i8 x [N,K]i8 -> [M,N]i32 (MXU int8 path)."""
+    return jax.lax.dot_general(
+        qx, qw, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+@register(name="_contrib_quantized_fully_connected",
+          differentiable=False)
+def quantized_fully_connected(data, weight, bias=None, num_hidden=1,
+                              no_bias=False, flatten=True,
+                              data_min=0.0, data_max=0.0,
+                              weight_scale=1.0):
+    """FullyConnected in int8: inputs quantized with calibrated
+    [data_min, data_max], weight arrives pre-quantized int8 with
+    `weight_scale`; fp32 bias is added after rescale
+    (quantized_fully_connected.cc)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    xs = _scale(jnp.float32(data_min), jnp.float32(data_max))
+    qx = _quantize_int8(x, xs)
+    acc = _int8_matmul(qx, weight)                 # int32
+    y = acc.astype(jnp.float32) / (xs * weight_scale)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+@register(name="_contrib_quantized_conv", differentiable=False)
+def quantized_conv(data, weight, bias=None, kernel=(), stride=(),
+                   dilate=(), pad=(), num_filter=1, num_group=1,
+                   no_bias=False, layout="NCHW",
+                   data_min=0.0, data_max=0.0, weight_scale=1.0):
+    """Convolution in int8 with int32 accumulation
+    (quantized_conv.cc)."""
+    nd_ = len(kernel) if kernel else data.ndim - 2
+    stride = tuple(stride) or (1,) * nd_
+    dilate = tuple(dilate) or (1,) * nd_
+    pad = tuple(pad) or (0,) * nd_
+    xs = _scale(jnp.float32(data_min), jnp.float32(data_max))
+    qx = _quantize_int8(data, xs)
+    spec = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd_]
+    dn = jax.lax.conv_dimension_numbers(qx.shape, weight.shape, spec)
+    acc = jax.lax.conv_general_dilated(
+        qx, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) / (xs * weight_scale)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd_)
+    return y
+
+
+def quantize_weight(w):
+    """Offline weight quantization: returns (int8 array, scale)."""
+    amax = jnp.maximum(jnp.max(jnp.abs(w)), 1e-10)
+    s = INT8_MAX / amax
+    return _quantize_int8(w, s), float(s)

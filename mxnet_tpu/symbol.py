@@ -388,7 +388,12 @@ class Symbol:
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
-        type_dict = type_dict or {}
+        type_dict = dict(type_dict or {})
+        # variables may pin their dtype via the __dtype__ attr
+        # (e.g. int8 quantized weights)
+        for node in self._active_nodes():
+            if node.is_var() and "__dtype__" in node.attrs:
+                type_dict.setdefault(node.name, node.attrs["__dtype__"])
         args = {}
         for name, shp in zip(arg_names, arg_shapes):
             if shp is None:
