@@ -75,13 +75,46 @@ def _optimal_threshold_kl(abs_hist, abs_edges, num_quantized_bins=128):
     return best_threshold
 
 
+class _StreamingHistogram(object):
+    """Fixed-size |x| histogram folded into across batches.
+
+    Memory is O(bins) regardless of how much calibration data streams
+    through (the reference's calibrate.cc accumulates into a fixed-width
+    histogram the same way). When a batch exceeds the current range the
+    range doubles and adjacent bins fold together, so old counts stay on
+    exact bin boundaries.
+    """
+
+    BINS = 2048
+
+    def __init__(self):
+        self.range = None
+        self.counts = np.zeros(self.BINS, np.int64)
+
+    def add(self, absvals):
+        amax = float(absvals.max()) if absvals.size else 0.0
+        if self.range is None:
+            self.range = max(amax, 1e-10)
+        while amax > self.range:
+            folded = self.counts.reshape(-1, 2).sum(axis=1)
+            self.counts = np.concatenate(
+                [folded, np.zeros(self.BINS // 2, np.int64)])
+            self.range *= 2
+        hist, _ = np.histogram(absvals, bins=self.BINS,
+                               range=(0.0, self.range))
+        self.counts += hist
+
+    def edges(self):
+        return np.linspace(0.0, self.range, self.BINS + 1)
+
+
 class _LayerCollector(object):
     """Accumulates per-tensor statistics across calibration batches."""
 
     def __init__(self, mode):
         self.mode = mode
         self.minmax = {}        # name -> [min, max]
-        self.samples = {}       # name -> list of abs-value histograms
+        self.hists = {}         # name -> _StreamingHistogram (entropy mode)
 
     def update(self, name, arr):
         a = arr if isinstance(arr, np.ndarray) else arr.asnumpy()
@@ -92,17 +125,15 @@ class _LayerCollector(object):
         else:
             self.minmax[name] = [mn, mx]
         if self.mode == "entropy":
-            self.samples.setdefault(name, []).append(a.ravel())
+            self.hists.setdefault(
+                name, _StreamingHistogram()).add(np.abs(a.ravel()))
 
     def thresholds(self):
         out = {}
         for name, (mn, mx) in self.minmax.items():
             if self.mode == "entropy":
-                vals = np.abs(np.concatenate(self.samples[name]))
-                amax = max(abs(mn), abs(mx), 1e-10)
-                hist, edges = np.histogram(vals, bins=2048,
-                                           range=(0, amax))
-                t = _optimal_threshold_kl(hist, edges)
+                hist = self.hists[name]
+                t = _optimal_threshold_kl(hist.counts, hist.edges())
                 out[name] = (-t, t)
             else:
                 out[name] = (mn, mx)
@@ -110,17 +141,19 @@ class _LayerCollector(object):
 
 
 def calib_graph(symbol, arg_params, aux_params, calib_data, data_names,
-                collector, num_calib_examples=None, ctx=None):
+                collector, num_calib_examples=None, ctx=None,
+                excluded_names=()):
     """Run fp32 forward over calibration batches, collecting the input
     tensor of every quantizable node (the reference collects via
     monitor callbacks on the executor)."""
     from ..context import cpu
     ctx = ctx or cpu()
+    excluded_names = set(excluded_names)
     # outputs we need: each quantizable node's data input tensor
     node_index = {id(n): i for i, n in enumerate(symbol._nodes)}
     want = {}           # layer name -> (node list index, out index)
     for node in symbol._active_nodes():
-        if node.op in QUANTIZABLE:
+        if node.op in QUANTIZABLE and node.name not in excluded_names:
             src_sym, oi = node.inputs[0]
             src = src_sym._nodes[src_sym._outputs[0][0]]
             want[node.name] = (node_index[id(src)], oi)
@@ -174,7 +207,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         collector = _LayerCollector(calib_mode)
         calib_graph(sym, arg_params, aux_params, calib_data,
                     list(data_names), collector, num_calib_examples,
-                    ctx=ctx)
+                    ctx=ctx, excluded_names=excluded)
         thresholds = collector.thresholds()
         logger.info("calibrated %d layers (%s mode)", len(thresholds),
                     calib_mode)

@@ -1,8 +1,13 @@
 """Gluon losses.
 
-Reference: python/mxnet/gluon/loss.py:78-859 — 14 loss classes. All are
-HybridBlocks composing elementwise/reduce ops; XLA fuses each whole loss
-into the surrounding computation.
+API parity target: python/mxnet/gluon/loss.py (the 14 loss HybridBlocks).
+Structure is not the reference's: the per-class weighting + batch-mean
+boilerplate lives once in `_ElementwiseLoss`, concrete losses only state
+their pointwise residual, and the binary-cross-entropy family uses the
+softplus identities  softplus(x) = relu(x) + softplus(-|x|)  and
+softplus(-x) = softplus(-|x|) + relu(-x)  to collapse the reference's
+three-term stable forms into single softrelu calls (XLA fuses either way;
+the short form is the one a jnp author would write).
 """
 
 import numpy as np
@@ -16,22 +21,8 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
 from .block import HybridBlock
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """Apply weighting to loss (gluon/loss.py:34)."""
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        assert isinstance(weight, (int, float)), "weight must be a number"
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return F.reshape_like(x, y)
-
-
 class Loss(HybridBlock):
-    """Base class for loss (gluon/loss.py:52)."""
+    """Base loss: holds the global weight and the batch axis."""
 
     def __init__(self, weight, batch_axis, **kwargs):
         super(Loss, self).__init__(**kwargs)
@@ -39,41 +30,73 @@ class Loss(HybridBlock):
         self._batch_axis = batch_axis
 
     def __repr__(self):
-        s = "{name}(batch_axis={_batch_axis}, w={_weight})"
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return "{}(batch_axis={}, w={})".format(
+            self.__class__.__name__, self._batch_axis, self._weight)
+
+    def _scale(self, F, loss, sample_weight):
+        """Per-sample weighting then the constant loss weight."""
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        if self._weight is not None:
+            assert isinstance(self._weight, (int, float)), \
+                "weight must be a number"
+            loss = loss * self._weight
+        return loss
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
-class L2Loss(Loss):
-    """L = 0.5 * w * (pred - label)^2 (gluon/loss.py:78)."""
+class _ElementwiseLoss(Loss):
+    """Losses of the shape mean_over_non_batch(scale * residual(...)).
+
+    Subclasses implement `residual(F, pred, label)`; everything else —
+    label reshape, sample weighting, the non-batch mean — is shared here
+    instead of repeated per class.
+    """
+
+    _half_weight = False     # L2 folds a factor 1/2 into the weight
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = F.reshape_like(label, pred)
+        loss = self.residual(F, pred, label)
+        if self._half_weight:
+            weight = (1.0 if self._weight is None else self._weight) / 2
+            loss = loss * weight
+            if sample_weight is not None:
+                loss = F.broadcast_mul(loss, sample_weight)
+        else:
+            loss = self._scale(F, loss, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+    def residual(self, F, pred, label):
+        raise NotImplementedError
+
+
+class L2Loss(_ElementwiseLoss):
+    """0.5 * w * (pred - label)^2."""
+
+    _half_weight = True
 
     def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super(L2Loss, self).__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def residual(self, F, pred, label):
+        return F.square(label - pred)
 
 
-class L1Loss(Loss):
-    """L = w * |pred - label| (gluon/loss.py:120)."""
+class L1Loss(_ElementwiseLoss):
+    """w * |pred - label|."""
 
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super(L1Loss, self).__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def residual(self, F, pred, label):
+        return F.abs(label - pred)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    """BCE with optional fused sigmoid (gluon/loss.py:161)."""
+    """BCE over logits (default) or probabilities (from_sigmoid=True)."""
 
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
@@ -83,26 +106,22 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
-        label = _reshape_like(F, label, pred)
+        label = F.reshape_like(label, pred)
         if not self._from_sigmoid:
             if pos_weight is None:
-                # stable formulation: max(x,0) - x*z + log(1+exp(-|x|))
-                loss = F.relu(pred) - pred * label + \
-                    F.Activation(-F.abs(pred), act_type="softrelu")
+                # -z*log σ(x) - (1-z)*log σ(-x)  ==  softplus(x) - x*z
+                loss = F.softrelu(pred) - pred * label
             else:
+                # positive term reweighted: x - x*z + (1+(pw-1)z)*softplus(-x)
                 log_weight = 1 + F.broadcast_mul(pos_weight - 1, label)
-                loss = pred - pred * label + log_weight * \
-                    (F.Activation(-F.abs(pred), act_type="softrelu") +
-                     F.relu(-pred))
+                loss = pred - pred * label + log_weight * F.softrelu(-pred)
         else:
             eps = 1e-12
-            if pos_weight is None:
-                loss = -(F.log(pred + eps) * label +
-                         F.log(1. - pred + eps) * (1. - label))
-            else:
-                loss = -(F.broadcast_mul(F.log(pred + eps) * label, pos_weight)
-                         + F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            pos_term = F.log(pred + eps) * label
+            if pos_weight is not None:
+                pos_term = F.broadcast_mul(pos_term, pos_weight)
+            loss = -(pos_term + F.log(1.0 - pred + eps) * (1.0 - label))
+        loss = self._scale(F, loss, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
 
 
@@ -110,7 +129,7 @@ SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """Softmax + CE, sparse or dense labels (gluon/loss.py:238)."""
+    """log-softmax + NLL; labels sparse class ids or dense distributions."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -121,22 +140,22 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits else \
+            F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            loss = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            label = F.reshape_like(label, logp)
+            loss = -F.sum(logp * label, axis=self._axis, keepdims=True)
+        loss = self._scale(F, loss, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
-class KLDivLoss(Loss):
-    """Kullback-Leibler divergence (gluon/loss.py:312)."""
+class KLDivLoss(_ElementwiseLoss):
+    """label * (log label - log pred); pred already log-prob by default."""
 
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
@@ -144,26 +163,23 @@ class KLDivLoss(Loss):
         self._from_logits = from_logits
         self._axis = axis
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
+    def residual(self, F, pred, label):
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return label * (F.log(label + 1e-12) - pred)
 
 
 class CTCLoss(Loss):
-    """Connectionist temporal classification loss (gluon/loss.py:378)."""
+    """Connectionist temporal classification over the framework CTC op."""
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
         assert layout in ("NTC", "TNC"), \
-            "Only 'NTC' and 'TNC' layouts for pred are supported, got: %s" % layout
+            "pred layout must be 'NTC' or 'TNC', got: %s" % layout
         assert label_layout in ("NT", "TN"), \
-            "Only 'NT' and 'TN' layouts for label are supported, got: %s" % label_layout
+            "label layout must be 'NT' or 'TN', got: %s" % label_layout
         self._layout = layout
         self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super(CTCLoss, self).__init__(weight, batch_axis, **kwargs)
+        super(CTCLoss, self).__init__(weight, label_layout.find("N"), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
@@ -175,95 +191,80 @@ class CTCLoss(Loss):
                          use_data_lengths=pred_lengths is not None,
                          use_label_lengths=label_lengths is not None,
                          blank_label="last")
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._scale(F, loss, sample_weight)
 
 
-class HuberLoss(Loss):
-    """Smoothed L1 (gluon/loss.py:441)."""
+class HuberLoss(_ElementwiseLoss):
+    """Quadratic inside |err| <= rho, linear outside."""
 
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super(HuberLoss, self).__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def residual(self, F, pred, label):
+        err = F.abs(label - pred)
+        return F.where(err > self._rho,
+                       err - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(err))
 
 
-class HingeLoss(Loss):
-    """L = max(0, margin - pred*label) (gluon/loss.py:491)."""
+class HingeLoss(_ElementwiseLoss):
+    """max(0, margin - pred*label) for signed labels."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super(HingeLoss, self).__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def residual(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
 
 
-class SquaredHingeLoss(Loss):
-    """L = max(0, margin - pred*label)^2 (gluon/loss.py:538)."""
+class SquaredHingeLoss(_ElementwiseLoss):
+    """max(0, margin - pred*label)^2 for signed labels."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super(SquaredHingeLoss, self).__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def residual(self, F, pred, label):
+        return F.square(F.relu(self._margin - pred * label))
 
 
-class LogisticLoss(Loss):
-    """Logistic loss with binary or signed labels (gluon/loss.py:585)."""
+class LogisticLoss(_ElementwiseLoss):
+    """BCE over logits with 'signed' (±1) or 'binary' (0/1) labels."""
 
     def __init__(self, weight=None, batch_axis=0, label_format="signed",
                  **kwargs):
         super(LogisticLoss, self).__init__(weight, batch_axis, **kwargs)
-        self._label_format = label_format
-        if self._label_format not in ["signed", "binary"]:
+        if label_format not in ("signed", "binary"):
             raise ValueError(
                 "label_format can only be signed or binary, recieved %s."
                 % label_format)
+        self._label_format = label_format
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+    def residual(self, F, pred, label):
         if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = (label + 1.0) / 2.0       # map {-1,1} -> {0,1}
+        return F.softrelu(pred) - pred * label
 
 
 class TripletLoss(Loss):
-    """L = max(|f(x)-f(+)|^2 - |f(x)-f(-)|^2 + margin, 0)
-    (gluon/loss.py:637)."""
+    """max(0, ||a-p||^2 - ||a-n||^2 + margin) per anchor."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super(TripletLoss, self).__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        positive = F.reshape_like(positive, pred)
+        negative = F.reshape_like(negative, pred)
+        gap = F.sum(F.square(positive - pred) - F.square(negative - pred),
+                    axis=self._batch_axis, exclude=True)
+        return self._scale(F, F.relu(gap + self._margin), sample_weight)
 
 
 class PoissonNLLLoss(Loss):
-    """Negative log likelihood under Poisson (gluon/loss.py:691)."""
+    """NLL under Poisson; optional Stirling correction for large targets."""
 
     def __init__(self, weight=None, from_logits=True, batch_axis=0,
                  compute_full=False, **kwargs):
@@ -271,42 +272,38 @@ class PoissonNLLLoss(Loss):
         self._from_logits = from_logits
         self._compute_full = compute_full
 
-    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
-        target = _reshape_like(F, target, pred)
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        target = F.reshape_like(target, pred)
         if self._from_logits:
             loss = F.exp(pred) - target * pred
         else:
             loss = pred - target * F.log(pred + epsilon)
         if self._compute_full:
-            stirling_factor = target * F.log(target) - target + \
-                0.5 * F.log(2 * target * np.pi)
-            stirling_factor = F.where(target > 1, stirling_factor,
-                                      F.zeros_like(stirling_factor))
-            loss = loss + stirling_factor
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            # log(k!) ~ k log k - k + 0.5 log(2 pi k), applied where k > 1
+            stirling = target * F.log(target) - target + \
+                0.5 * F.log(2 * np.pi * target)
+            loss = loss + F.where(target > 1, stirling,
+                                  F.zeros_like(stirling))
+        loss = self._scale(F, loss, sample_weight)
         return F.mean(loss)
 
 
 class CosineEmbeddingLoss(Loss):
-    """Cosine distance between vectors (gluon/loss.py:756)."""
+    """1 - cos(a, b) for label 1, max(0, cos(a, b) - margin) for label -1."""
 
     def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
         super(CosineEmbeddingLoss, self).__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = _reshape_like(F, input1, input2)
-        cos_sim = self._cosine_similarity(F, input1, input2)
+        input1 = F.reshape_like(input1, input2)
+        dot = F.sum(input1 * input2, axis=-1).reshape((-1, 1))
+        norms = F.norm(input1, axis=-1).reshape((-1, 1)) * \
+            F.norm(input2, axis=-1).reshape((-1, 1))
+        cos_sim = dot / F.broadcast_maximum(
+            norms, F.ones_like(norms) * 1e-12)
         label = label.reshape((-1, 1)) if hasattr(label, "reshape") else label
-        z = F.where(label == 1, 1.0 - cos_sim,
-                    F.relu(cos_sim - self._margin))
-        z = _apply_weighting(F, z, self._weight, sample_weight)
-        return z
-
-    def _cosine_similarity(self, F, x, y, axis=-1):
-        x_norm = F.norm(x, axis=axis).reshape((-1, 1))
-        y_norm = F.norm(y, axis=axis).reshape((-1, 1))
-        x_dot_y = F.sum(x * y, axis=axis).reshape((-1, 1))
-        eps_arr = 1e-12
-        return x_dot_y / F.broadcast_maximum(
-            x_norm * y_norm, F.ones_like(x_norm) * eps_arr)
+        loss = F.where(label == 1, 1.0 - cos_sim,
+                       F.relu(cos_sim - self._margin))
+        return self._scale(F, loss, sample_weight)

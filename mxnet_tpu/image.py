@@ -267,42 +267,61 @@ class CastAug(Augmenter):
             else nd.array(_as_np(src), dtype=self.typ)
 
 
-class BrightnessJitterAug(Augmenter):
+# ITU-R BT.601 luma weights, shared by the photometric jitter family
+_LUMA = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+
+class _PhotometricJitterAug(Augmenter):
+    """Shared machinery: blend the image toward a reference signal by a
+    random strength drawn from U(1-jitter, 1+jitter)."""
+
+    def __init__(self, jitter, **kwargs):
+        super(_PhotometricJitterAug, self).__init__(**kwargs)
+        self.jitter = jitter
+
+    def reference(self, arr):
+        """The signal to blend toward at alpha -> 0; subclasses override."""
+        raise NotImplementedError
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.jitter, self.jitter)
+        arr = _as_np(src).astype(np.float32)
+        return nd.array(arr * alpha + self.reference(arr) * (1.0 - alpha))
+
+
+class BrightnessJitterAug(_PhotometricJitterAug):
+    """Blend toward black."""
+
     def __init__(self, brightness):
-        super(BrightnessJitterAug, self).__init__(brightness=brightness)
+        super(BrightnessJitterAug, self).__init__(brightness,
+                                                  brightness=brightness)
         self.brightness = brightness
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
-        return src * alpha
+    def reference(self, arr):
+        return 0.0
 
 
-class ContrastJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+class ContrastJitterAug(_PhotometricJitterAug):
+    """Blend toward the image's mean luma (a flat gray)."""
 
     def __init__(self, contrast):
-        super(ContrastJitterAug, self).__init__(contrast=contrast)
+        super(ContrastJitterAug, self).__init__(contrast, contrast=contrast)
         self.contrast = contrast
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
-        arr = _as_np(src).astype(np.float32)
-        gray = (arr * self._coef).sum() * (3.0 / arr.size)
-        return nd.array(arr * alpha + gray * (1.0 - alpha))
+    def reference(self, arr):
+        return (arr * _LUMA).sum() * (3.0 / arr.size)
 
 
-class SaturationJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+class SaturationJitterAug(_PhotometricJitterAug):
+    """Blend toward the per-pixel luma (desaturate)."""
 
     def __init__(self, saturation):
-        super(SaturationJitterAug, self).__init__(saturation=saturation)
+        super(SaturationJitterAug, self).__init__(saturation,
+                                                  saturation=saturation)
         self.saturation = saturation
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
-        arr = _as_np(src).astype(np.float32)
-        gray = (arr * self._coef).sum(axis=2, keepdims=True)
-        return nd.array(arr * alpha + gray * (1.0 - alpha))
+    def reference(self, arr):
+        return (arr * _LUMA).sum(axis=2, keepdims=True)
 
 
 class HueJitterAug(Augmenter):

@@ -1,19 +1,23 @@
-"""Evaluation metrics.
+"""Evaluation metrics with device-side batch statistics.
 
-Reference: python/mxnet/metric.py:68-1666 — EvalMetric hierarchy with
-registry, CompositeEvalMetric, and ~20 concrete metrics.
-
-TPU note: metric state (sum_metric/num_inst) is host-side python floats;
-predictions are pulled to host once per update. Heavy per-batch math
-(argmax/topk) runs on device via jnp before the single transfer.
+API parity target: python/mxnet/metric.py (EvalMetric hierarchy, registry,
+CompositeEvalMetric, the ~20 concrete metrics and the `mx.metric.np`
+factory). The implementation is TPU-native rather than a transcription:
+every concrete metric declares a pure *stat kernel* — a jnp function
+mapping one (label, pred) batch to a short vector of sufficient
+statistics. Kernels are jit-compiled once per input shape and run on
+device, so the host sees a single tiny transfer per update instead of
+pulling whole prediction arrays through `asnumpy` the way the reference
+metrics do. Host-side state is just the running reduction of those
+statistics (a few floats per metric).
 """
 
 import math
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
-from . import ndarray
 from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
@@ -32,49 +36,56 @@ def register(klass, *aliases):
 
 
 def create(metric, *args, **kwargs):
-    """mx.metric.create (metric.py:46)."""
+    """mx.metric.create — resolve str / callable / list / instance."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
+        out = CompositeEvalMetric()
         for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+            out.add(create(child, *args, **kwargs))
+        return out
     if isinstance(metric, str):
-        if metric.lower() not in _METRIC_REGISTRY:
-            raise ValueError("Metric must be either callable or in registry: %s"
-                             % metric)
-        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+        klass = _METRIC_REGISTRY.get(metric.lower())
+        if klass is None:
+            raise ValueError(
+                "metric %r is not registered and not callable" % metric)
+        return klass(*args, **kwargs)
     raise TypeError("metric should be callable, str, EvalMetric or list")
 
 
-def _as_np(x):
+def _on_device(x):
+    """Move one update() argument onto the device untouched."""
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _to_numpy(x):
     if isinstance(x, NDArray):
         return x.asnumpy()
     return _np.asarray(x)
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    """metric.py:36 helper."""
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Raise when label/pred lists (or shapes, with shape=True) disagree."""
+    a = labels.shape if shape else len(labels)
+    b = preds.shape if shape else len(preds)
+    if a != b:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(a, b))
     if wrap:
-        if isinstance(labels, NDArray):
+        if isinstance(labels, (NDArray, _np.ndarray)):
             labels = [labels]
-        if isinstance(preds, NDArray):
+        if isinstance(preds, (NDArray, _np.ndarray)):
             preds = [preds]
     return labels, preds
 
 
 class EvalMetric(object):
-    """Base metric (metric.py:68)."""
+    """Base metric: running (sum_metric, num_inst) with local+global views."""
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
@@ -158,15 +169,13 @@ class EvalMetric(object):
 
 
 class CompositeEvalMetric(EvalMetric):
-    """Manages multiple metrics (metric.py:315)."""
+    """Fans update() out to children; get() concatenates their results."""
 
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -175,16 +184,14 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+            raise ValueError("Metric index {} is out of range 0 and {}"
+                             .format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = {name: label for name, label in labels.items()
-                      if name in self.label_names}
+            labels = {k: v for k, v in labels.items() if k in self.label_names}
         if self.output_names is not None:
-            preds = {name: pred for name, pred in preds.items()
-                     if name in self.output_names}
+            preds = {k: v for k, v in preds.items() if k in self.output_names}
         for metric in self.metrics:
             metric.update_dict(labels, preds)
 
@@ -193,286 +200,238 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
 
     def reset_local(self):
-        try:
-            for metric in self.metrics:
-                metric.reset_local()
-        except AttributeError:
-            pass
+        for metric in getattr(self, "metrics", []):
+            metric.reset_local()
+
+    def _gather(self, getter):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = getter(metric)
+            names.extend([name] if isinstance(name, str) else name)
+            values.extend(
+                [value] if isinstance(value, (float, int, _np.generic))
+                else value)
+        return names, values
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, _np.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._gather(lambda m: m.get())
 
     def get_global(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get_global()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, _np.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._gather(lambda m: m.get_global())
 
     def get_config(self):
         config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        config.update({"metrics": [m.get_config() for m in self.metrics]})
         return config
 
 
+class _KernelMetric(EvalMetric):
+    """A metric driven by a jitted device-side stat kernel.
+
+    Subclasses implement `batch_stats(label, pred) -> tuple of scalars`
+    as pure jnp; `update` runs it on device (compiled once per shape) and
+    folds the fetched scalars into host accumulators via `accumulate`.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._jitted = jax.jit(self.batch_stats)
+
+    def batch_stats(self, label, pred):
+        raise NotImplementedError()
+
+    def check_shapes(self, label, pred):
+        """Host-side shape validation before the kernel; override to add."""
+
+    def accumulate(self, stats):
+        # default: stats == (metric_sum, instance_count)
+        s, n = stats
+        self._inc(float(s), int(n))
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.check_shapes(label, pred)
+            out = self._jitted(_on_device(label), _on_device(pred))
+            self.accumulate([float(v) for v in out])
+
+
 @register
-class Accuracy(EvalMetric):
-    """Classification accuracy (metric.py:393)."""
+class Accuracy(_KernelMetric):
+    """Fraction of rows whose argmax (along `axis`) equals the label."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
+        self.axis = axis
         super().__init__(name, axis=axis, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred_np = _as_np(pred_label)
-            if pred_np.ndim > _as_np(label).ndim:
-                pred_np = _np.argmax(pred_np, axis=self.axis)
-            pred_np = pred_np.astype("int32")
-            label_np = _as_np(label).astype("int32")
-            label_np, pred_np = check_label_shapes(label_np, pred_np)
-            correct = (pred_np.flat == label_np.flat).sum()
-            self._inc(float(correct), len(pred_np.flat))
+    def check_shapes(self, label, pred):
+        pred_shape = tuple(pred.shape)
+        if len(pred_shape) > len(tuple(label.shape)):
+            axis = self.axis % len(pred_shape)
+            pred_shape = pred_shape[:axis] + pred_shape[axis + 1:]
+        if int(_np.prod(pred_shape)) != int(_np.prod(label.shape)):
+            raise ValueError(
+                "Shape of labels {} does not match shape of predictions {}"
+                .format(tuple(label.shape), tuple(pred.shape)))
+
+    def batch_stats(self, label, pred):
+        if pred.ndim > label.ndim:
+            pred = jnp.argmax(pred, axis=self.axis)
+        label = label.reshape(-1).astype(jnp.int32)
+        pred = pred.reshape(-1).astype(jnp.int32)
+        return jnp.sum(pred == label), label.size
 
 
 @register
-class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (metric.py:480)."""
+class TopKAccuracy(_KernelMetric):
+    """Label appears among the k largest scores — lax.top_k on device."""
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
+        self.top_k = top_k
+        assert top_k > 1, "Please use Accuracy if top_k is no more than 1"
         super().__init__(name, top_k=top_k, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        self.name += "_%d" % top_k
+
+    def batch_stats(self, label, pred):
+        if pred.ndim == 1:
+            hit = pred.astype(jnp.int32) == label.astype(jnp.int32)
+            return jnp.sum(hit), label.size
+        assert pred.ndim == 2, "Predictions should be no more than 2 dims"
+        k = min(self.top_k, pred.shape[1])
+        _, idx = jax.lax.top_k(pred, k)           # (n, k) indices, MXU-free
+        hit = idx == label.reshape(-1, 1).astype(idx.dtype)
+        return jnp.sum(hit), pred.shape[0]
+
+
+class _ConfusionMetric(_KernelMetric):
+    """Shared machinery for binary-confusion metrics (F1, MCC).
+
+    The kernel reduces a batch to the 4 confusion counts on device; the
+    derived score is computed on host from the running counts.  `average`
+    follows the reference: 'macro' re-derives the score per batch and
+    averages; anything else ('micro') scores the pooled counts.
+    """
+
+    def __init__(self, name, average, output_names=None, label_names=None):
+        self.average = average
+        self._counts = _np.zeros(4)   # tp, fp, fn, tn
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def batch_stats(self, label, pred):
+        if pred.ndim > 1:
+            hard = jnp.argmax(pred, axis=1)
+        else:
+            hard = (pred > 0.5).astype(jnp.int32)
+        hard = hard.reshape(-1).astype(jnp.bool_)
+        truth = label.reshape(-1).astype(jnp.bool_)
+        tp = jnp.sum(hard & truth)
+        fp = jnp.sum(hard & ~truth)
+        fn = jnp.sum(~hard & truth)
+        tn = jnp.sum(~hard & ~truth)
+        return tp, fp, fn, tn
+
+    def score(self, tp, fp, fn, tn):
+        raise NotImplementedError()
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_np = _np.argsort(_as_np(pred_label).astype("float32"), axis=-1)
-            label_np = _as_np(label).astype("int32")
-            num_samples = pred_np.shape[0]
-            num_dims = len(pred_np.shape)
-            if num_dims == 1:
-                self._inc(float((pred_np.flat == label_np.flat).sum()),
-                          num_samples)
-            elif num_dims == 2:
-                num_classes = pred_np.shape[1]
-                top_k = min(num_classes, self.top_k)
-                correct = 0.0
-                for j in range(top_k):
-                    correct += (pred_np[:, num_classes - 1 - j].flat ==
-                                label_np.flat).sum()
-                self._inc(float(correct), num_samples)
+        for label, pred in zip(labels, preds):
+            lbl = _to_numpy(label)
+            if len(_np.unique(lbl.astype("int32"))) > 2:
+                raise ValueError(
+                    "%s currently only supports binary classification."
+                    % self.__class__.__name__)
+            stats = self._jitted(_on_device(lbl), _on_device(pred))
+            self._counts += _np.array([float(v) for v in stats])
+        if self.average == "macro":
+            self.sum_metric += self.score(*self._counts)
+            self.global_sum_metric += self.score(*self._counts)
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self._counts[:] = 0
+        else:
+            total = self._counts.sum()
+            self.sum_metric = self.score(*self._counts) * total
+            self.global_sum_metric = self.sum_metric
+            self.num_inst = total
+            self.global_num_inst = total
 
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_counts"):
+            self._counts[:] = 0
 
-class _BinaryClassificationMetrics(object):
-    """Running TP/FP/TN/FN used by F1 and MCC (metric.py:573)."""
-
-    def __init__(self):
-        self.reset_stats()
-
-    def update_binary_stats(self, label, pred):
-        pred_np = _as_np(pred)
-        label_np = _as_np(label).astype("int32")
-        pred_label = _np.argmax(pred_np, axis=1) if pred_np.ndim > 1 else \
-            (pred_np > 0.5).astype("int32")
-        check_label_shapes(label_np, pred_label)
-        if len(_np.unique(label_np)) > 2:
-            raise ValueError("%s currently only supports binary classification."
-                             % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label_np.flat == 1)
-        label_false = 1 - label_true
-        true_pos = (pred_true.flat * label_true).sum()
-        false_pos = (pred_true.flat * label_false).sum()
-        false_neg = (pred_false.flat * label_true).sum()
-        true_neg = (pred_false.flat * label_false).sum()
-        self.true_positives += true_pos
-        self.false_positives += false_pos
-        self.false_negatives += false_neg
-        self.true_negatives += true_neg
-
-    @property
-    def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.
-
-    @property
-    def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.
-
-    @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.
-
-    @property
-    def matthewscc(self):
-        if not self.total_examples:
-            return 0.
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
-        denom = 1.
-        for t in filter(lambda t: t != 0., terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / \
-            math.sqrt(denom)
-
-    @property
-    def total_examples(self):
-        return (self.false_negatives + self.false_positives +
-                self.true_negatives + self.true_positives)
-
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+    def reset_local(self):
+        self.reset()
 
 
 @register
-class F1(EvalMetric):
-    """Binary F1 (metric.py:683)."""
+class F1(_ConfusionMetric):
+    """Harmonic mean of precision and recall over binary predictions."""
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
+        super().__init__(name, average, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.global_sum_metric += self.metrics.fscore
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self.metrics.reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.global_sum_metric = self.sum_metric
-            self.num_inst = self.metrics.total_examples
-            self.global_num_inst = self.num_inst
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0.
-        self.metrics.reset_stats()
-
-    reset_local = reset
+    def score(self, tp, fp, fn, tn):
+        precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+        recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+        if precision + recall == 0:
+            return 0.0
+        return 2 * precision * recall / (precision + recall)
 
 
 @register
-class MCC(EvalMetric):
-    """Matthews correlation coefficient (metric.py:776)."""
+class MCC(_ConfusionMetric):
+    """Matthews correlation coefficient from the confusion counts."""
 
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
+        super().__init__(name, average, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
-            self.global_sum_metric += self._metrics.matthewscc
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = self._metrics.matthewscc * self._metrics.total_examples
-            self.global_sum_metric = self.sum_metric
-            self.num_inst = self._metrics.total_examples
-            self.global_num_inst = self.num_inst
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0.
-        self._metrics.reset_stats()
-
-    reset_local = reset
+    def score(self, tp, fp, fn, tn):
+        if tp + fp + fn + tn == 0:
+            return 0.0
+        denom = 1.0
+        for term in (tp + fp, tp + fn, tn + fp, tn + fn):
+            if term != 0.0:
+                denom *= term
+        return (tp * tn - fp * fn) / math.sqrt(denom)
 
 
 @register
-class Perplexity(EvalMetric):
-    """Perplexity (metric.py:880)."""
+class Perplexity(_KernelMetric):
+    """exp(mean NLL of the prob the model assigns to the label)."""
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
+        self.ignore_label = ignore_label
+        self.axis = axis
         super().__init__(name, ignore_label=ignore_label, axis=axis,
                          output_names=output_names, label_names=label_names,
                          has_global_stats=True)
-        self.ignore_label = ignore_label
-        self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label).astype("int32").reshape(-1)
-            pred_np = _as_np(pred).astype("float64")
-            pred_np = pred_np.reshape(-1, pred_np.shape[-1])
-            probs = pred_np[_np.arange(label_np.shape[0]), label_np]
-            if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(pred_np.dtype)
-                num -= int(ignore.sum())
-                probs = probs * (1 - ignore) + ignore
-            loss -= _np.sum(_np.log(_np.maximum(1e-10, probs)))
-            num += label_np.shape[0]
-        self._inc(loss, num)
+    def batch_stats(self, label, pred):
+        flat = label.reshape(-1).astype(jnp.int32)
+        probs = jnp.take_along_axis(
+            pred.reshape(-1, pred.shape[-1]),
+            flat[:, None], axis=-1)[:, 0].astype(jnp.float32)
+        count = flat.size
+        if self.ignore_label is not None:
+            keep = flat != self.ignore_label
+            probs = jnp.where(keep, probs, 1.0)
+            count = jnp.sum(keep)
+        nll = -jnp.sum(jnp.log(jnp.maximum(probs, 1e-10)))
+        return nll, count
 
     def get(self):
         if self.num_inst == 0:
@@ -482,174 +441,151 @@ class Perplexity(EvalMetric):
     def get_global(self):
         if self.global_num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
+        return (self.name,
+                math.exp(self.global_sum_metric / self.global_num_inst))
+
+
+class _RegressionMetric(_KernelMetric):
+    """Per-batch mean of an elementwise error; num_inst counts batches."""
+
+    def __init__(self, name, output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def elem_error(self, diff):
+        raise NotImplementedError()
+
+    def finalize(self, mean_err):
+        return mean_err
+
+    def batch_stats(self, label, pred):
+        label = label.reshape(label.shape[0], -1).astype(jnp.float32)
+        pred = pred.reshape(pred.shape[0], -1).astype(jnp.float32)
+        return (jnp.mean(self.elem_error(label - pred)), 1)
+
+    def accumulate(self, stats):
+        self._inc(self.finalize(stats[0]), 1)
 
 
 @register
-class MAE(EvalMetric):
-    """Mean absolute error (metric.py:971)."""
-
+class MAE(_RegressionMetric):
     def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+        super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            self._inc(float(_np.abs(label_np - pred_np).mean()), 1)
+    def elem_error(self, diff):
+        return jnp.abs(diff)
 
 
 @register
-class MSE(EvalMetric):
-    """Mean squared error (metric.py:1021)."""
-
+class MSE(_RegressionMetric):
     def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+        super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            self._inc(float(((label_np - pred_np) ** 2.0).mean()), 1)
+    def elem_error(self, diff):
+        return diff * diff
 
 
 @register
-class RMSE(EvalMetric):
-    """Root mean squared error (metric.py:1071)."""
-
+class RMSE(_RegressionMetric):
     def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
+        super().__init__(name, output_names, label_names)
+
+    def elem_error(self, diff):
+        return diff * diff
+
+    def finalize(self, mean_err):
+        return math.sqrt(mean_err)
+
+
+class _LabelProbMetric(_KernelMetric):
+    """Sum of -log(prob at the true label) over rows."""
+
+    def __init__(self, eps, name, output_names=None, label_names=None):
+        self.eps = eps
+        super().__init__(name, eps=eps, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            self._inc(float(_np.sqrt(((label_np - pred_np) ** 2.0).mean())), 1)
+    def batch_stats(self, label, pred):
+        flat = label.reshape(-1).astype(jnp.int32)
+        probs = jnp.take_along_axis(pred, flat[:, None], axis=-1)[:, 0]
+        nll = -jnp.sum(jnp.log(probs.astype(jnp.float32) + self.eps))
+        return nll, flat.size
 
 
 @register
-class CrossEntropy(EvalMetric):
-    """Cross-entropy of predicted prob at the label (metric.py:1122)."""
-
+class CrossEntropy(_LabelProbMetric):
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            label_np = label_np.ravel()
-            assert label_np.shape[0] == pred_np.shape[0]
-            prob = pred_np[_np.arange(label_np.shape[0]), _np.int64(label_np)]
-            cross_entropy = (-_np.log(prob + self.eps)).sum()
-            self._inc(float(cross_entropy), label_np.shape[0])
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
-class NegativeLogLikelihood(EvalMetric):
-    """NLL (metric.py:1180)."""
-
+class NegativeLogLikelihood(_LabelProbMetric):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            label_np = label_np.ravel()
-            num_examples = pred_np.shape[0]
-            assert label_np.shape[0] == num_examples, \
-                (label_np.shape[0], num_examples)
-            prob = pred_np[_np.arange(num_examples, dtype=_np.int64),
-                           _np.int64(label_np)]
-            nll = (-_np.log(prob + self.eps)).sum()
-            self._inc(float(nll), num_examples)
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
-class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (metric.py:1238)."""
+class PearsonCorrelation(_KernelMetric):
+    """Pearson r between flattened label and pred, one jnp.corrcoef call."""
 
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, False, True)
-            label_np = _as_np(label).ravel().astype(_np.float64)
-            pred_np = _as_np(pred).ravel().astype(_np.float64)
-            self._inc(float(_np.corrcoef(pred_np, label_np)[0, 1]), 1)
+    def batch_stats(self, label, pred):
+        assert label.shape == pred.shape, (label.shape, pred.shape)
+        x = pred.reshape(-1).astype(jnp.float32)
+        y = label.reshape(-1).astype(jnp.float32)
+        return jnp.corrcoef(x, y)[0, 1], 1
+
+    def accumulate(self, stats):
+        self._inc(float(stats[0]), 1)
 
 
 @register
-class Loss(EvalMetric):
-    """Mean of a loss output (metric.py:1296)."""
+class Loss(_KernelMetric):
+    """Running mean of a loss output (no labels involved)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
+        self._loss_jit = jax.jit(jnp.sum)
 
     def update(self, _, preds):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            loss = float(_as_np(pred).sum())
-            self._inc(loss, int(_np.prod(pred.shape)))
+            self._inc(float(self._loss_jit(_on_device(pred))),
+                      int(_np.prod(pred.shape)))
 
 
 @register
 class Torch(Loss):
-    """Legacy alias (metric.py:1330)."""
-
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class Caffe(Loss):
-    """Legacy alias (metric.py:1338)."""
-
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class CustomMetric(EvalMetric):
-    """Wraps a feval function (metric.py:1346)."""
+    """Wraps a user feval(label_np, pred_np) -> value or (sum, count).
+
+    User fevals are arbitrary numpy — this is the one metric family that
+    legitimately runs on host.
+    """
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
@@ -662,20 +598,17 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label_np = _as_np(label)
-            pred_np = _as_np(pred)
-            reval = self._feval(label_np, pred_np)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self._inc(sum_metric, num_inst)
+            result = self._feval(_to_numpy(label), _to_numpy(pred))
+            if isinstance(result, tuple):
+                self._inc(*result)
             else:
-                self._inc(reval, 1)
+                self._inc(result, 1)
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
-# `acc`, `ce`, `nll_loss` aliases (metric registry names in the reference)
+# registry aliases matching the reference's registered names
 register(Accuracy, "acc")
 register(CrossEntropy, "ce")
 register(NegativeLogLikelihood, "nll_loss")
@@ -683,7 +616,7 @@ register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """mx.metric.np — make a CustomMetric from a numpy feval (metric.py:1422)."""
+    """mx.metric.np — build a CustomMetric from a numpy feval."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

@@ -18,16 +18,13 @@ from ..model import BatchEndParam
 
 
 def _check_input_names(symbol, names, typename, throw):
-    """base_module.py:33 sanity check."""
+    """Verify every declared input name exists among the symbol's args."""
     args = symbol.list_arguments()
+    param_suffixes = ("_weight", "_bias", "_gamma", "_beta")
     for name in names:
         if name in args:
             continue
-        candidates = [arg for arg in args if
-                      not arg.endswith("_weight") and
-                      not arg.endswith("_bias") and
-                      not arg.endswith("_gamma") and
-                      not arg.endswith("_beta")]
+        candidates = [a for a in args if not a.endswith(param_suffixes)]
         msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
               "input with name '%s' is not found in symbol.list_arguments(). " \
               "Did you mean one of:\n\t%s\033[0m" % (
@@ -35,6 +32,25 @@ def _check_input_names(symbol, names, typename, throw):
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
+
+
+def _lookahead(iterable):
+    """Yield (item, is_last) pairs, holding one item of lookahead.
+
+    The training loop wants to know mid-iteration whether another batch
+    follows (the reference keeps a `next_data_batch`/`end_of_batch` state
+    machine inside fit for the same purpose; a generator is cleaner and
+    lets `prepare` hooks run on the upcoming batch).
+    """
+    it = iter(iterable)
+    try:
+        current = next(it)
+    except StopIteration:
+        return
+    for upcoming in it:
+        yield current, False, upcoming
+        current = upcoming
+    yield current, True, None
 
 
 class BaseModule(object):
@@ -63,31 +79,27 @@ class BaseModule(object):
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
-        """Evaluate on eval_data (base_module.py:176)."""
+        """Run inference over eval_data and accumulate eval_metric."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, mx_metric.EvalMetric):
             eval_metric = mx_metric.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
+        nbatch = 0
+        for eval_batch in eval_data:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            for callback in _as_list(batch_end_callback or []):
+                callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                       eval_metric=eval_metric,
+                                       locals=locals()))
+            nbatch += 1
+        for callback in _as_list(score_end_callback or []):
+            callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                   eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
@@ -105,7 +117,7 @@ class BaseModule(object):
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False, sparse_row_id_fn=None):
-        """base_module.py:305."""
+        """Forward over the data and collect (optionally merged) outputs."""
         assert self.binded and self.params_initialized
         if isinstance(eval_data, (nd.NDArray, np.ndarray)):
             if isinstance(eval_data, np.ndarray):
@@ -114,31 +126,23 @@ class BaseModule(object):
             return self.get_outputs()[0]
         if not isinstance(eval_data, mx_io.DataIter):
             raise ValueError("eval_data must be of type NDArray or DataIter")
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        per_batch = [
+            [out.copy() for out in outputs]
+            for outputs, _, _ in self.iter_predict(eval_data,
+                                                   num_batch=num_batch,
+                                                   reset=reset)]
+        if not per_batch or not merge_batches:
+            return per_batch
+        num_outputs = len(per_batch[0])
+        if any(len(outs) != num_outputs for outs in per_batch):
+            raise AssertionError(
+                "Cannot merge batches, as num of outputs is not the same "
+                "in mini-batches. Maybe bucketing is used?")
+        merged = [nd.concatenate([outs[i] for outs in per_batch])
+                  for i in range(num_outputs)]
+        if num_outputs == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -148,75 +152,40 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """The canonical training loop (base_module.py:409)."""
+        """The canonical training loop."""
         from .. import initializer as init_mod
         assert num_epoch is not None, "please specify number of epochs"
-        if initializer is None:
-            initializer = init_mod.Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
         if not isinstance(eval_metric, mx_metric.EvalMetric):
             eval_metric = mx_metric.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
 
-        # training loop (base_module.py:500)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-
+            self._run_epoch(train_data, eval_metric, epoch, monitor,
+                            batch_end_callback, sparse_row_id_fn)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # sync a consistent host-side snapshot of the params
+            arg_snap, aux_snap = self.get_params()
+            self.set_params(arg_snap, aux_snap)
+            for callback in _as_list(epoch_end_callback or []):
+                callback(epoch, self.symbol, arg_snap, aux_snap)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
@@ -228,6 +197,30 @@ class BaseModule(object):
                                      name, val)
 
             train_data.reset()
+
+    def _run_epoch(self, train_data, eval_metric, epoch, monitor,
+                   batch_end_callback, sparse_row_id_fn):
+        """One pass over train_data: step, metric, callbacks per batch."""
+        for nbatch, (batch, _, upcoming) in enumerate(_lookahead(train_data)):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            if isinstance(batch, list):
+                self.update_metric(eval_metric, [b.label for b in batch],
+                                   pre_sliced=True)
+            else:
+                self.update_metric(eval_metric, batch.label)
+            if upcoming is not None:
+                self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
+            if monitor is not None:
+                monitor.toc_print()
+            if batch_end_callback is not None:
+                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                       eval_metric=eval_metric,
+                                       locals=locals())
+                for callback in _as_list(batch_end_callback):
+                    callback(params)
 
     # ------------------------------------------------- symbol/params API --
     @property

@@ -229,16 +229,38 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red = tuple(i for i in range(data.ndim) if i != ax)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # Single fused pass over the activation stream: E[x-s] and
+        # E[(x-s)^2] accumulate in fp32 together (one reduction kernel,
+        # often folded into the producing conv's epilogue), instead of the
+        # mean-then-var two-pass formulation which re-reads `data` — BN is
+        # HBM-bound on TPU, so the extra pass is ~40% of ResNet step time.
+        # The shift s = running mean keeps the E[y^2]-E[y]^2 algebra
+        # well-conditioned: raw E[x^2]-E[x]^2 cancels catastrophically in
+        # fp32 when |mean| >> std, and the running mean tracks the batch
+        # mean after the first few updates, making y near zero-mean.
+        stat_shape = [1] * data.ndim
+        stat_shape[ax] = data.shape[ax]
+        shift = lax.stop_gradient(
+            moving_mean.astype(jnp.float32)).reshape(stat_shape)
+        centered = data.astype(jnp.float32) - shift
+        mean_c = jnp.mean(centered, axis=red)
+        var = jnp.maximum(
+            jnp.mean(centered * centered, axis=red) - mean_c * mean_c, 0.0)
+        mean = (mean_c + shift.reshape(-1)).astype(moving_mean.dtype)
+        var = var.astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
-    xhat = (data - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
-    out = xhat * g.reshape(shape) + beta.reshape(shape)
-    # mixed precision: fp32 gamma/beta with bf16 data must not upcast
-    # the activation stream (AMP keeps norm params fp32)
+    # Precompute per-channel scale/bias in fp32 (tiny), then apply as one
+    # fused multiply-add in the activation dtype: out = x*scale + bias.
+    # AMP keeps norm params fp32; the bf16 stream is never upcast in HBM.
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = (g.astype(jnp.float32) * inv).astype(data.dtype)
+    bias = (beta.astype(jnp.float32)
+            - g.astype(jnp.float32) * mean.astype(jnp.float32) * inv
+            ).astype(data.dtype)
+    out = data * scale.reshape(shape) + bias.reshape(shape)
     return out.astype(data.dtype), mean, var
 
 

@@ -236,6 +236,29 @@ def test_batchnorm_inference():
     assert_close(out.asnumpy(), expect, rtol=1e-4, atol=1e-4)
 
 
+def test_batchnorm_train_large_mean():
+    """Single-pass batch stats must not cancel catastrophically when the
+    per-channel mean dwarfs the std (e.g. activations ~ N(1000, 0.1))."""
+    rng = np.random.RandomState(3)
+    x = (1000.0 + 0.1 * rng.randn(8, 4, 6, 6)).astype(np.float32)
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    # running mean near the true mean, as it would be after a few updates
+    mov_mean = np.full(4, 1000.0, np.float32)
+    mov_var = np.ones(4, np.float32)
+    out, mean, var = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mov_mean),
+        nd.array(mov_var), fix_gamma=False, eps=1e-5, is_train=True,
+        output_mean_var=True)
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2, 3)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(axis=(0, 2, 3)),
+                               rtol=1e-2)
+    got = out.asnumpy()
+    assert abs(got.std() - 1.0) < 0.05, got.std()
+    assert abs(got.mean()) < 0.05, got.mean()
+
+
 def test_layernorm():
     x = np.random.rand(4, 10).astype(np.float32)
     out = nd.LayerNorm(nd.array(x), nd.ones((10,)), nd.zeros((10,)), axis=-1)

@@ -39,7 +39,6 @@ def test_quantized_fc_matches_fp32():
     ref = x @ w.T + b
     from mxnet_tpu.ops.quantization_ops import quantize_weight
     qw, ws = quantize_weight(nd.array(w)._data)
-    out = nd._g_op_test_helper = None
     y = mx.nd.contrib.quantized_fully_connected(
         nd.array(x), nd.NDArray(qw, mx.cpu()), nd.array(b),
         num_hidden=16, data_min=-1.0, data_max=1.0, weight_scale=ws)
