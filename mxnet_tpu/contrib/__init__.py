@@ -1,4 +1,5 @@
 """Contrib namespace (reference: python/mxnet/contrib/__init__.py)."""
 
 from . import amp
+from . import onnx
 from . import quantization

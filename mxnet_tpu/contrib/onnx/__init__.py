@@ -1,0 +1,19 @@
+"""ONNX interop (mx.contrib.onnx).
+
+API parity target: python/mxnet/contrib/onnx/ — `export_model`
+(mx2onnx/export_model.py), `import_model` / `get_model_metadata`
+(onnx2mx/import_model.py).
+
+This environment ships no `onnx` python package, so the IR schema is
+vendored (`onnx.proto`, the public Apache-2.0 ONNX definition with
+upstream field numbers) and compiled with protoc into `onnx_pb2` —
+serialized models are byte-compatible with any ONNX runtime. A
+structural validator (`checker.validate_model`) stands in for
+onnx.checker.
+"""
+
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata
+from . import checker
+
+__all__ = ["export_model", "import_model", "get_model_metadata", "checker"]

@@ -1,0 +1,102 @@
+"""Structural validator for exported models (onnx.checker stand-in).
+
+Enforces the ONNX graph invariants that matter for interchange: SSA form
+(each tensor produced once), topological ordering of node inputs, typed
+graph inputs/outputs, initializer/dims consistency, and a declared opset.
+Raises ValidationError with a readable message on the first violation.
+"""
+
+import numpy as np
+
+from . import onnx_pb2 as _pb
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _fail(msg, *args):
+    raise ValidationError(msg % args)
+
+
+def check_tensor(tensor):
+    if not tensor.name:
+        _fail("initializer with empty name")
+    if tensor.data_type == _pb.TensorProto.UNDEFINED:
+        _fail("initializer %s has UNDEFINED data type", tensor.name)
+    count = int(np.prod(tensor.dims)) if tensor.dims else 1
+    if tensor.raw_data:
+        itemsize = {
+            _pb.TensorProto.FLOAT: 4, _pb.TensorProto.DOUBLE: 8,
+            _pb.TensorProto.FLOAT16: 2, _pb.TensorProto.BFLOAT16: 2,
+            _pb.TensorProto.INT8: 1, _pb.TensorProto.UINT8: 1,
+            _pb.TensorProto.INT16: 2, _pb.TensorProto.INT32: 4,
+            _pb.TensorProto.INT64: 8, _pb.TensorProto.BOOL: 1,
+        }.get(tensor.data_type)
+        if itemsize and len(tensor.raw_data) != count * itemsize:
+            _fail("initializer %s: raw_data holds %d bytes, dims %s need %d",
+                  tensor.name, len(tensor.raw_data), tuple(tensor.dims),
+                  count * itemsize)
+
+
+def check_graph(graph):
+    if not graph.name:
+        _fail("graph has no name")
+    known = set()
+    for vi in graph.input:
+        if not vi.name:
+            _fail("graph input with empty name")
+        if not vi.type.HasField("tensor_type"):
+            _fail("graph input %s has no tensor type", vi.name)
+        known.add(vi.name)
+    for init in graph.initializer:
+        check_tensor(init)
+        known.add(init.name)
+
+    produced = set(known)
+    for node in graph.node:
+        if not node.op_type:
+            _fail("node %s has empty op_type", node.name)
+        for name in node.input:
+            if name and name not in produced:
+                _fail("node %s (%s) consumes %r before any producer",
+                      node.name, node.op_type, name)
+        for name in node.output:
+            if not name:
+                _fail("node %s has an empty output name", node.name)
+            if name in produced and name not in known:
+                _fail("tensor %r produced twice (SSA violation)", name)
+            produced.add(name)
+        for attr in node.attribute:
+            if not attr.name:
+                _fail("node %s has an unnamed attribute", node.name)
+            if attr.type == _pb.AttributeProto.UNDEFINED:
+                _fail("node %s attribute %s has UNDEFINED type",
+                      node.name, attr.name)
+
+    if not graph.output:
+        _fail("graph has no outputs")
+    for vi in graph.output:
+        if vi.name not in produced:
+            _fail("graph output %r is never produced", vi.name)
+
+
+def check_model(model):
+    """Validate a ModelProto (bytes, path, or message)."""
+    if isinstance(model, (bytes, bytearray)):
+        parsed = _pb.ModelProto()
+        parsed.ParseFromString(bytes(model))
+        model = parsed
+    elif isinstance(model, str):
+        parsed = _pb.ModelProto()
+        with open(model, "rb") as f:
+            parsed.ParseFromString(f.read())
+        model = parsed
+    if model.ir_version < 3:
+        _fail("ir_version %d too old", model.ir_version)
+    if not model.opset_import:
+        _fail("model declares no opset_import")
+    check_graph(model.graph)
+
+
+validate_model = check_model

@@ -1,0 +1,466 @@
+"""Symbol graph -> ONNX ModelProto exporter.
+
+API parity target: python/mxnet/contrib/onnx/mx2onnx/export_model.py and
+_op_translations.py. The walk here is over the reference-layout symbol
+JSON (tojson), emitting one or more NodeProtos per mx node through the
+converter registry below.
+"""
+
+import ast
+import json
+
+import numpy as np
+
+from . import onnx_pb2 as _pb
+
+# opset 11: the last opset where Dropout.ratio is an attribute and the
+# first where Gemm's C input is optional — both match what we emit
+_OPSET_VERSION = 11
+_IR_VERSION = 7
+
+_DTYPE_TO_ONNX = {
+    "float32": _pb.TensorProto.FLOAT,
+    "float64": _pb.TensorProto.DOUBLE,
+    "float16": _pb.TensorProto.FLOAT16,
+    "bfloat16": _pb.TensorProto.BFLOAT16,
+    "int8": _pb.TensorProto.INT8,
+    "uint8": _pb.TensorProto.UINT8,
+    "int32": _pb.TensorProto.INT32,
+    "int64": _pb.TensorProto.INT64,
+    "bool": _pb.TensorProto.BOOL,
+}
+
+_MX2ONNX = {}
+
+
+def mx_op(*names):
+    def wrap(fn):
+        for n in names:
+            _MX2ONNX[n] = fn
+        return fn
+    return wrap
+
+
+# ------------------------------------------------------------- helpers --
+def _tuple(value, length=None):
+    """Parse an mx attr that may be '(2, 2)', '2', or already a tuple."""
+    if isinstance(value, str):
+        value = ast.literal_eval(value)
+    if not isinstance(value, (tuple, list)):
+        value = (value,)
+    out = tuple(int(v) for v in value)
+    if length is not None and len(out) == 1:
+        out = out * length
+    return out
+
+
+def _bool(value):
+    if isinstance(value, str):
+        return value.lower() in ("true", "1")
+    return bool(value)
+
+
+def _attr(node_proto, name, value):
+    a = node_proto.attribute.add()
+    a.name = name
+    if isinstance(value, bool):
+        a.type = _pb.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = _pb.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = _pb.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = _pb.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (tuple, list)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            a.type = _pb.AttributeProto.INTS
+            a.ints.extend(int(v) for v in value)
+        else:
+            a.type = _pb.AttributeProto.FLOATS
+            a.floats.extend(float(v) for v in value)
+    else:
+        raise TypeError("unsupported attribute %s=%r" % (name, value))
+
+
+class GraphBuilder(object):
+    """Accumulates NodeProtos/initializers while walking the mx graph."""
+
+    def __init__(self, params):
+        self.params = params          # name -> numpy array
+        self.nodes = []
+        self.initializers = {}        # name -> numpy array emitted
+        self._uid = 0
+
+    def fresh(self, base):
+        self._uid += 1
+        return "%s__onnx%d" % (base, self._uid)
+
+    def add_node(self, op_type, inputs, outputs, name=None, **attrs):
+        n = _pb.NodeProto()
+        n.op_type = op_type
+        n.name = name or self.fresh(op_type.lower())
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            _attr(n, k, v)
+        self.nodes.append(n)
+        return n
+
+    def add_initializer(self, name, array):
+        self.initializers[name] = np.asarray(array)
+        return name
+
+    def const_i64(self, base, values):
+        """Emit an int64 constant initializer (Reshape shapes etc.)."""
+        name = self.fresh(base)
+        return self.add_initializer(name, np.asarray(values, np.int64))
+
+
+# -------------------------------------------------------- op converters --
+@mx_op("Convolution")
+def _conv(gb, name, attrs, ins, outs):
+    kernel = _tuple(attrs["kernel"])
+    nd = len(kernel)
+    kw = {
+        "kernel_shape": kernel,
+        "strides": _tuple(attrs.get("stride", (1,) * nd), nd),
+        "dilations": _tuple(attrs.get("dilate", (1,) * nd), nd),
+        "group": int(attrs.get("num_group", 1)),
+    }
+    pad = _tuple(attrs.get("pad", (0,) * nd), nd)
+    kw["pads"] = pad + pad
+    gb.add_node("Conv", ins, outs, name=name, **kw)
+
+
+@mx_op("Deconvolution")
+def _deconv(gb, name, attrs, ins, outs):
+    kernel = _tuple(attrs["kernel"])
+    nd = len(kernel)
+    pad = _tuple(attrs.get("pad", (0,) * nd), nd)
+    gb.add_node("ConvTranspose", ins, outs, name=name,
+                kernel_shape=kernel,
+                strides=_tuple(attrs.get("stride", (1,) * nd), nd),
+                dilations=_tuple(attrs.get("dilate", (1,) * nd), nd),
+                group=int(attrs.get("num_group", 1)),
+                pads=pad + pad)
+
+
+@mx_op("Pooling")
+def _pooling(gb, name, attrs, ins, outs):
+    pool_type = attrs.get("pool_type", "max")
+    if _bool(attrs.get("global_pool", False)):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[pool_type]
+        gb.add_node(op, ins, outs, name=name)
+        return
+    kernel = _tuple(attrs["kernel"])
+    nd = len(kernel)
+    pad = _tuple(attrs.get("pad", (0,) * nd), nd)
+    kw = {
+        "kernel_shape": kernel,
+        "strides": _tuple(attrs.get("stride", (1,) * nd), nd),
+        "pads": pad + pad,
+    }
+    if pool_type == "avg":
+        # ops/nn.py pooling divides by the count of in-bounds elements
+        kw["count_include_pad"] = 0
+        gb.add_node("AveragePool", ins, outs, name=name, **kw)
+    elif pool_type == "max":
+        gb.add_node("MaxPool", ins, outs, name=name, **kw)
+    else:
+        raise ValueError("Pooling type %s not exportable" % pool_type)
+
+
+@mx_op("FullyConnected")
+def _fc(gb, name, attrs, ins, outs):
+    data = ins[0]
+    if _bool(attrs.get("flatten", True)):
+        flat = gb.fresh(name + "_flat")
+        gb.add_node("Flatten", [data], [flat], axis=1)
+        data = flat
+    if _bool(attrs.get("no_bias", False)):
+        num_hidden = int(attrs["num_hidden"])
+        bias = gb.fresh(name + "_zero_bias")
+        gb.add_initializer(bias, np.zeros(num_hidden, np.float32))
+        gemm_in = [data, ins[1], bias]
+    else:
+        gemm_in = [data, ins[1], ins[2]]
+    gb.add_node("Gemm", gemm_in, outs, name=name,
+                alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@mx_op("Activation")
+def _activation(gb, name, attrs, ins, outs):
+    op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "softrelu": "Softplus", "softsign": "Softsign"}[attrs["act_type"]]
+    gb.add_node(op, ins, outs, name=name)
+
+
+@mx_op("LeakyReLU")
+def _leaky(gb, name, attrs, ins, outs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        gb.add_node("LeakyRelu", ins, outs, name=name,
+                    alpha=float(attrs.get("slope", 0.25)))
+    elif act == "elu":
+        gb.add_node("Elu", ins, outs, name=name,
+                    alpha=float(attrs.get("slope", 0.25)))
+    elif act == "prelu":
+        gb.add_node("PRelu", ins, outs, name=name)
+    else:
+        raise ValueError("LeakyReLU act_type %s not exportable" % act)
+
+
+@mx_op("BatchNorm")
+def _batchnorm(gb, name, attrs, ins, outs):
+    if _bool(attrs.get("fix_gamma", True)) and ins[1] in gb.params:
+        # frozen gamma: the executor treats gamma as 1 regardless of the
+        # stored value, so export ones to preserve semantics
+        gb.initializers[ins[1]] = np.ones_like(gb.params[ins[1]])
+    gb.add_node("BatchNormalization", ins, outs, name=name,
+                epsilon=float(attrs.get("eps", 1e-3)),
+                momentum=float(attrs.get("momentum", 0.9)))
+
+
+@mx_op("softmax", "SoftmaxActivation")
+def _softmax(gb, name, attrs, ins, outs):
+    gb.add_node("Softmax", ins, outs, name=name,
+                axis=int(attrs.get("axis", -1)))
+
+
+@mx_op("SoftmaxOutput")
+def _softmax_output(gb, name, attrs, ins, outs):
+    # label input is a training-only artifact; inference graph drops it
+    gb.add_node("Softmax", ins[:1], outs, name=name, axis=1)
+
+
+@mx_op("Flatten")
+def _flatten(gb, name, attrs, ins, outs):
+    gb.add_node("Flatten", ins, outs, name=name, axis=1)
+
+
+@mx_op("Dropout")
+def _dropout(gb, name, attrs, ins, outs):
+    gb.add_node("Dropout", ins, outs, name=name,
+                ratio=float(attrs.get("p", 0.5)))
+
+
+@mx_op("Concat")
+def _concat(gb, name, attrs, ins, outs):
+    gb.add_node("Concat", ins, outs, name=name,
+                axis=int(attrs.get("dim", 1)))
+
+
+@mx_op("Reshape")
+def _reshape(gb, name, attrs, ins, outs):
+    shape = _tuple(attrs["shape"])
+    shape_name = gb.const_i64(name + "_shape", shape)
+    gb.add_node("Reshape", [ins[0], shape_name], outs, name=name)
+
+
+@mx_op("transpose")
+def _transpose(gb, name, attrs, ins, outs):
+    kw = {}
+    if "axes" in attrs:
+        kw["perm"] = _tuple(attrs["axes"])
+    gb.add_node("Transpose", ins, outs, name=name, **kw)
+
+
+@mx_op("clip")
+def _clip(gb, name, attrs, ins, outs):
+    lo = gb.add_initializer(gb.fresh(name + "_min"),
+                            np.float32(attrs["a_min"]))
+    hi = gb.add_initializer(gb.fresh(name + "_max"),
+                            np.float32(attrs["a_max"]))
+    gb.add_node("Clip", [ins[0], lo, hi], outs, name=name)
+
+
+@mx_op("Embedding")
+def _embedding(gb, name, attrs, ins, outs):
+    # mx Embedding(data, weight) == Gather(weight, indices)
+    idx = gb.fresh(name + "_idx")
+    gb.add_node("Cast", [ins[0]], [idx], to=int(_pb.TensorProto.INT64))
+    gb.add_node("Gather", [ins[1], idx], outs, name=name, axis=0)
+
+
+@mx_op("Pad")
+def _pad(gb, name, attrs, ins, outs):
+    width = _tuple(attrs["pad_width"])
+    ndim = len(width) // 2
+    begins = width[0::2]
+    ends = width[1::2]
+    pads = gb.const_i64(name + "_pads", list(begins) + list(ends))
+    mode = attrs.get("mode", "constant")
+    value = gb.add_initializer(gb.fresh(name + "_value"),
+                               np.float32(attrs.get("constant_value", 0.0)))
+    gb.add_node("Pad", [ins[0], pads, value], outs, name=name, mode=mode)
+    del ndim
+
+
+def _simple(onnx_op, n_in=None):
+    def conv(gb, name, attrs, ins, outs):
+        gb.add_node(onnx_op, ins if n_in is None else ins[:n_in],
+                    outs, name=name)
+    return conv
+
+
+for _mx_name, _onnx_name in [
+        ("elemwise_add", "Add"), ("broadcast_add", "Add"), ("_plus", "Add"),
+        ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+        ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+        ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+        ("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+        ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"), ("abs", "Abs"),
+        ("negative", "Neg"), ("identity", "Identity"), ("erf", "Erf"),
+        ("add_n", "Sum"), ("dot", "MatMul"), ("batch_dot", "MatMul"),
+        ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
+        ("maximum", "Max"), ("minimum", "Min"),
+]:
+    _MX2ONNX[_mx_name] = _simple(_onnx_name)
+
+
+def _reduce(onnx_op):
+    def conv(gb, name, attrs, ins, outs):
+        kw = {"keepdims": int(_bool(attrs.get("keepdims", False)))}
+        if attrs.get("axis") not in (None, "None", "()"):
+            kw["axes"] = _tuple(attrs["axis"])
+        gb.add_node(onnx_op, ins, outs, name=name, **kw)
+    return conv
+
+
+_MX2ONNX["mean"] = _reduce("ReduceMean")
+_MX2ONNX["sum"] = _reduce("ReduceSum")
+_MX2ONNX["max"] = _reduce("ReduceMax")
+_MX2ONNX["min"] = _reduce("ReduceMin")
+_MX2ONNX["prod"] = _reduce("ReduceProd")
+
+
+# ------------------------------------------------------------ model walk --
+def _np_param(value):
+    if isinstance(value, np.ndarray):
+        return value
+    return value.asnumpy()          # NDArray
+
+
+def _tensor_proto(name, array):
+    t = _pb.TensorProto()
+    t.name = name
+    array = np.ascontiguousarray(array)
+    t.dims.extend(array.shape)
+    t.data_type = _DTYPE_TO_ONNX[array.dtype.name]
+    t.raw_data = array.tobytes()
+    return t
+
+
+def _value_info(name, dtype, shape):
+    vi = _pb.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = _DTYPE_TO_ONNX[np.dtype(dtype).name]
+    for d in shape:
+        dim = vi.type.tensor_type.shape.dim.add()
+        dim.dim_value = int(d)
+    return vi
+
+
+def create_model(sym, params, input_shapes, input_dtype=np.float32,
+                 graph_name="mxnet_tpu_model"):
+    """Build a ModelProto from (Symbol, params, {input: shape})."""
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    params = {k.split(":", 1)[-1]: _np_param(v) for k, v in params.items()}
+
+    gb = GraphBuilder(params)
+    out_name = {}           # (node_idx, out_idx) -> onnx tensor name
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            out_name[(i, 0)] = node["name"]
+        else:
+            out_name[(i, 0)] = node["name"]
+            for extra in range(1, 4):
+                out_name[(i, extra)] = "%s_out%d" % (node["name"], extra)
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        if op == "null":
+            continue
+        conv = _MX2ONNX.get(op)
+        if conv is None:
+            raise NotImplementedError(
+                "mx op %r has no ONNX converter" % op)
+        ins = [out_name[(ni, oi)] for ni, oi, _ in node["inputs"]]
+        conv(gb, node["name"], node.get("attrs", {}), ins,
+             [out_name[(i, 0)]])
+
+    model = _pb.ModelProto()
+    model.ir_version = _IR_VERSION
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "0.1.0"
+    opset = model.opset_import.add()
+    opset.version = _OPSET_VERSION
+    g = model.graph
+    g.name = graph_name
+    g.node.extend(gb.nodes)
+
+    # data inputs = graph vars that are not params
+    referenced = set()
+    for n in gb.nodes:
+        referenced.update(n.input)
+    for name, shape in input_shapes.items():
+        g.input.append(_value_info(name, input_dtype, shape))
+    for name, arr in params.items():
+        if name in referenced and name not in gb.initializers:
+            gb.initializers[name] = arr
+    for name, arr in gb.initializers.items():
+        g.initializer.append(_tensor_proto(name, arr))
+        g.input.append(_value_info(name, arr.dtype, arr.shape))
+
+    # outputs: infer shapes when possible
+    try:
+        _, out_shapes, _ = sym.infer_shape(**input_shapes)
+    except Exception:
+        out_shapes = [()] * len(sym.list_outputs())
+    heads = [gb_head for gb_head in graph["heads"]]
+    for (ni, oi, _), shape in zip(heads, out_shapes):
+        g.output.append(_value_info(out_name[(ni, oi)], input_dtype,
+                                    shape or ()))
+    return model
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False,
+                 input_names=None):
+    """mx.contrib.onnx.export_model — serialize to onnx_file_path.
+
+    `sym` may be a Symbol or a path to a saved symbol JSON; `params` a
+    dict (optionally with arg:/aux: prefixes) or a path to .params.
+    `input_shape` is a list of shapes matching the graph's data inputs.
+    """
+    from ... import ndarray as nd
+    from ... import symbol as sym_mod
+    if isinstance(sym, str):
+        with open(sym) as f:
+            sym = sym_mod.load_json(f.read())
+    if isinstance(params, str):
+        params = nd.load(params)
+    if isinstance(input_shape, dict):
+        input_shapes = dict(input_shape)
+    else:
+        if not isinstance(input_shape, (list, tuple)) or \
+                input_shape and not isinstance(input_shape[0],
+                                               (list, tuple)):
+            input_shape = [input_shape]
+        param_names = {k.split(":", 1)[-1] for k in params}
+        data_names = input_names or \
+            [n for n in sym.list_arguments()
+             if n not in param_names and not n.endswith("_label")]
+        input_shapes = dict(zip(data_names, input_shape))
+    model = create_model(sym, params, input_shapes, input_type)
+    if verbose:
+        print("exporting %d nodes -> %s" % (len(model.graph.node),
+                                            onnx_file_path))
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

@@ -1,0 +1,403 @@
+"""ONNX ModelProto -> Symbol importer.
+
+API parity target: python/mxnet/contrib/onnx/onnx2mx/import_model.py and
+import_onnx.py. Builds the graph by composing `sym.*` ops; initializers
+become arg/aux params keyed by their ONNX tensor names.
+"""
+
+import numpy as np
+
+from . import onnx_pb2 as _pb
+
+_ONNX_TO_NP = {
+    _pb.TensorProto.FLOAT: np.float32,
+    _pb.TensorProto.DOUBLE: np.float64,
+    _pb.TensorProto.FLOAT16: np.float16,
+    _pb.TensorProto.INT8: np.int8,
+    _pb.TensorProto.UINT8: np.uint8,
+    _pb.TensorProto.INT16: np.int16,
+    _pb.TensorProto.INT32: np.int32,
+    _pb.TensorProto.INT64: np.int64,
+    _pb.TensorProto.BOOL: np.bool_,
+}
+
+_ONNX2MX = {}
+
+
+def onnx_op(*names):
+    def wrap(fn):
+        for n in names:
+            _ONNX2MX[n] = fn
+        return fn
+    return wrap
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == _pb.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == _pb.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == _pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == _pb.AttributeProto.INTS:
+            out[a.name] = tuple(int(v) for v in a.ints)
+        elif a.type == _pb.AttributeProto.FLOATS:
+            out[a.name] = tuple(float(v) for v in a.floats)
+        elif a.type == _pb.AttributeProto.TENSOR:
+            out[a.name] = _to_array(a.t)
+    return out
+
+
+def _to_array(tensor):
+    dtype = _ONNX_TO_NP[tensor.data_type]
+    shape = tuple(tensor.dims)
+    if tensor.raw_data:
+        arr = np.frombuffer(tensor.raw_data, dtype=dtype)
+    elif tensor.float_data:
+        arr = np.asarray(tensor.float_data, np.float32).astype(dtype)
+    elif tensor.int64_data:
+        arr = np.asarray(tensor.int64_data, np.int64).astype(dtype)
+    elif tensor.int32_data:
+        arr = np.asarray(tensor.int32_data, np.int32).astype(dtype)
+    elif tensor.double_data:
+        arr = np.asarray(tensor.double_data, np.float64).astype(dtype)
+    else:
+        arr = np.zeros(int(np.prod(shape)) if shape else 0, dtype)
+    return np.array(arr).reshape(shape)
+
+
+def _sym_pads(pads):
+    """ONNX [b0..bn, e0..en] -> symmetric mx pad tuple, or raise."""
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if tuple(begin) != tuple(end):
+        raise NotImplementedError("asymmetric pads %s" % (pads,))
+    return tuple(begin)
+
+
+class _Importer(object):
+    def __init__(self, graph):
+        import mxnet_tpu.symbol as sym_mod
+        self.S = sym_mod
+        self.graph = graph
+        self.tensors = {}       # onnx tensor name -> Symbol
+        self.arrays = {}        # initializer name -> numpy (for Reshape &c)
+        self.aux_names = set()
+
+    def const(self, node_input):
+        """The numpy value behind a static input (initializer)."""
+        return self.arrays[node_input]
+
+    def sym_of(self, name):
+        if name not in self.tensors:
+            self.tensors[name] = self.S.var(name)
+        return self.tensors[name]
+
+    def run(self):
+        for init in self.graph.initializer:
+            self.arrays[init.name] = _to_array(init)
+        for node in self.graph.node:
+            conv = _ONNX2MX.get(node.op_type)
+            if conv is None:
+                raise NotImplementedError(
+                    "ONNX op %r has no mx converter" % node.op_type)
+            result = conv(self, node, _attrs(node))
+            outs = list(node.output)
+            if not isinstance(result, (list, tuple)):
+                result = [result]
+            for name, s in zip(outs, result):
+                self.tensors[name] = s
+        outputs = [self.tensors[o.name] for o in self.graph.output]
+        out = outputs[0] if len(outputs) == 1 else self.S.Group(outputs)
+        arg_names = set(out.list_arguments())
+        aux_names = set(out.list_auxiliary_states())
+        from mxnet_tpu import ndarray as nd
+        args, auxs = {}, {}
+        for name, arr in self.arrays.items():
+            if name in aux_names:
+                auxs[name] = nd.array(arr)
+            elif name in arg_names:
+                args[name] = nd.array(arr.astype(np.float32)
+                                      if arr.dtype == np.float64 else arr)
+        return out, args, auxs
+
+
+# ------------------------------------------------------------ converters --
+@onnx_op("Conv")
+def _conv(im, node, attrs):
+    kw = {"kernel": attrs["kernel_shape"],
+          "num_group": attrs.get("group", 1)}
+    if "strides" in attrs:
+        kw["stride"] = attrs["strides"]
+    if "dilations" in attrs:
+        kw["dilate"] = attrs["dilations"]
+    if "pads" in attrs:
+        kw["pad"] = _sym_pads(attrs["pads"])
+    w = im.const(node.input[1])
+    kw["num_filter"] = w.shape[0]
+    ins = [im.sym_of(i) for i in node.input]
+    if len(ins) == 2:
+        kw["no_bias"] = True
+    return im.S.Convolution(*ins, name=node.name or None, **kw)
+
+
+@onnx_op("ConvTranspose")
+def _deconv(im, node, attrs):
+    kw = {"kernel": attrs["kernel_shape"],
+          "num_group": attrs.get("group", 1)}
+    if "strides" in attrs:
+        kw["stride"] = attrs["strides"]
+    if "pads" in attrs:
+        kw["pad"] = _sym_pads(attrs["pads"])
+    w = im.const(node.input[1])
+    kw["num_filter"] = w.shape[1] * attrs.get("group", 1)
+    ins = [im.sym_of(i) for i in node.input]
+    if len(ins) == 2:
+        kw["no_bias"] = True
+    return im.S.Deconvolution(*ins, name=node.name or None, **kw)
+
+
+@onnx_op("MaxPool", "AveragePool")
+def _pool(im, node, attrs):
+    kw = {"kernel": attrs["kernel_shape"],
+          "pool_type": "max" if node.op_type == "MaxPool" else "avg"}
+    if "strides" in attrs:
+        kw["stride"] = attrs["strides"]
+    if "pads" in attrs:
+        kw["pad"] = _sym_pads(attrs["pads"])
+    return im.S.Pooling(im.sym_of(node.input[0]), name=node.name or None,
+                        **kw)
+
+
+@onnx_op("GlobalMaxPool", "GlobalAveragePool")
+def _gpool(im, node, attrs):
+    ptype = "max" if node.op_type == "GlobalMaxPool" else "avg"
+    return im.S.Pooling(im.sym_of(node.input[0]), kernel=(1, 1),
+                        pool_type=ptype, global_pool=True,
+                        name=node.name or None)
+
+
+@onnx_op("Gemm")
+def _gemm(im, node, attrs):
+    if attrs.get("alpha", 1.0) != 1.0 or attrs.get("transA", 0):
+        raise NotImplementedError("general Gemm")
+    w_name = node.input[1]
+    w = im.const(w_name)
+    if not attrs.get("transB", 0):
+        # FullyConnected computes x W^T; materialize the transposed weight
+        # under a fresh name so other consumers of the initializer keep
+        # the original layout
+        w = np.ascontiguousarray(w.T)
+        w_name = "%s__T_%s" % (w_name, node.name or "gemm")
+        im.arrays[w_name] = w
+    ins = [im.sym_of(node.input[0]), im.sym_of(w_name)] + \
+        [im.sym_of(i) for i in node.input[2:]]
+    return im.S.FullyConnected(ins[0], ins[1],
+                               ins[2] if len(ins) > 2 else None,
+                               num_hidden=w.shape[0], flatten=False,
+                               no_bias=len(ins) <= 2,
+                               name=node.name or None)
+
+
+@onnx_op("MatMul")
+def _matmul(im, node, attrs):
+    return im.S.dot(im.sym_of(node.input[0]), im.sym_of(node.input[1]),
+                    name=node.name or None)
+
+
+@onnx_op("BatchNormalization")
+def _bn(im, node, attrs):
+    ins = [im.sym_of(i) for i in node.input]
+    return im.S.BatchNorm(ins[0], gamma=ins[1], beta=ins[2],
+                          moving_mean=ins[3], moving_var=ins[4],
+                          eps=attrs.get("epsilon", 1e-5),
+                          momentum=attrs.get("momentum", 0.9),
+                          fix_gamma=False, name=node.name or None)
+
+
+@onnx_op("Softmax")
+def _softmax(im, node, attrs):
+    # opset < 13 default axis is 1 (with flatten-to-2D semantics)
+    return im.S.softmax(im.sym_of(node.input[0]),
+                        axis=attrs.get("axis", 1),
+                        name=node.name or None)
+
+
+@onnx_op("Flatten")
+def _flatten(im, node, attrs):
+    if attrs.get("axis", 1) != 1:
+        raise NotImplementedError("Flatten axis != 1")
+    return im.S.Flatten(im.sym_of(node.input[0]), name=node.name or None)
+
+
+@onnx_op("Dropout")
+def _dropout(im, node, attrs):
+    return im.S.Dropout(im.sym_of(node.input[0]),
+                        p=attrs.get("ratio", 0.5), name=node.name or None)
+
+
+@onnx_op("Concat")
+def _concat(im, node, attrs):
+    return im.S.Concat(*[im.sym_of(i) for i in node.input],
+                       dim=attrs.get("axis", 1), name=node.name or None)
+
+
+@onnx_op("Reshape")
+def _reshape(im, node, attrs):
+    shape = tuple(int(v) for v in im.const(node.input[1]))
+    return im.S.Reshape(im.sym_of(node.input[0]), shape=shape,
+                        name=node.name or None)
+
+
+@onnx_op("Transpose")
+def _transpose(im, node, attrs):
+    kw = {}
+    if "perm" in attrs:
+        kw["axes"] = attrs["perm"]
+    return im.S.transpose(im.sym_of(node.input[0]),
+                          name=node.name or None, **kw)
+
+
+@onnx_op("Clip")
+def _clip(im, node, attrs):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if len(node.input) > 1:
+        lo = float(im.const(node.input[1]))
+    if len(node.input) > 2:
+        hi = float(im.const(node.input[2]))
+    return im.S.clip(im.sym_of(node.input[0]), a_min=lo, a_max=hi,
+                     name=node.name or None)
+
+
+@onnx_op("Gather")
+def _gather(im, node, attrs):
+    return im.S.take(im.sym_of(node.input[0]), im.sym_of(node.input[1]),
+                     axis=attrs.get("axis", 0), name=node.name or None)
+
+
+@onnx_op("Cast")
+def _cast(im, node, attrs):
+    dtype = np.dtype(_ONNX_TO_NP[attrs["to"]]).name
+    return im.S.Cast(im.sym_of(node.input[0]), dtype=dtype,
+                     name=node.name or None)
+
+
+@onnx_op("LeakyRelu")
+def _leaky(im, node, attrs):
+    return im.S.LeakyReLU(im.sym_of(node.input[0]), act_type="leaky",
+                          slope=attrs.get("alpha", 0.01),
+                          name=node.name or None)
+
+
+@onnx_op("Elu")
+def _elu(im, node, attrs):
+    return im.S.LeakyReLU(im.sym_of(node.input[0]), act_type="elu",
+                          slope=attrs.get("alpha", 1.0),
+                          name=node.name or None)
+
+
+@onnx_op("Pad")
+def _pad(im, node, attrs):
+    if len(node.input) > 1:
+        raw = [int(v) for v in im.const(node.input[1])]
+    else:
+        raw = list(attrs["pads"])
+    n = len(raw) // 2
+    width = []
+    for b, e in zip(raw[:n], raw[n:]):
+        width.extend([b, e])
+    value = 0.0
+    if len(node.input) > 2:
+        value = float(im.const(node.input[2]))
+    return im.S.Pad(im.sym_of(node.input[0]),
+                    mode=attrs.get("mode", "constant"),
+                    pad_width=tuple(width), constant_value=value,
+                    name=node.name or None)
+
+
+def _unary(mx_name):
+    def conv(im, node, attrs):
+        return getattr(im.S, mx_name)(im.sym_of(node.input[0]),
+                                      name=node.name or None)
+    return conv
+
+
+def _binary(mx_name):
+    def conv(im, node, attrs):
+        return getattr(im.S, mx_name)(im.sym_of(node.input[0]),
+                                      im.sym_of(node.input[1]),
+                                      name=node.name or None)
+    return conv
+
+
+for _o, _m in [("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+               ("Softplus", "softrelu"), ("Exp", "exp"), ("Log", "log"),
+               ("Sqrt", "sqrt"), ("Abs", "abs"), ("Neg", "negative"),
+               ("Identity", "identity"), ("Erf", "erf")]:
+    _ONNX2MX[_o] = _unary(_m)
+
+for _o, _m in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+               ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+               ("Max", "broadcast_maximum"), ("Min", "broadcast_minimum")]:
+    _ONNX2MX[_o] = _binary(_m)
+
+
+@onnx_op("Sum")
+def _sum(im, node, attrs):
+    return im.S.add_n(*[im.sym_of(i) for i in node.input],
+                      name=node.name or None)
+
+
+def _reduce(mx_name):
+    def conv(im, node, attrs):
+        kw = {"keepdims": bool(attrs.get("keepdims", 1))}
+        if "axes" in attrs:
+            kw["axis"] = attrs["axes"]
+        return getattr(im.S, mx_name)(im.sym_of(node.input[0]),
+                                      name=node.name or None, **kw)
+    return conv
+
+
+for _o, _m in [("ReduceMean", "mean"), ("ReduceSum", "sum"),
+               ("ReduceMax", "max"), ("ReduceMin", "min"),
+               ("ReduceProd", "prod")]:
+    _ONNX2MX[_o] = _reduce(_m)
+
+
+# ------------------------------------------------------------- public API --
+def _load(model_file):
+    model = _pb.ModelProto()
+    if isinstance(model_file, (bytes, bytearray)):
+        model.ParseFromString(bytes(model_file))
+    else:
+        with open(model_file, "rb") as f:
+            model.ParseFromString(f.read())
+    return model
+
+
+def import_model(model_file):
+    """mx.contrib.onnx.import_model -> (sym, arg_params, aux_params)."""
+    model = _load(model_file)
+    return _Importer(model.graph).run()
+
+
+def get_model_metadata(model_file):
+    """Input/output names and shapes recorded in the model."""
+    model = _load(model_file)
+    inits = {t.name for t in model.graph.initializer}
+
+    def info(values):
+        out = []
+        for vi in values:
+            shape = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, shape))
+        return out
+
+    return {
+        "input_tensor_data": [x for x in info(model.graph.input)
+                              if x[0] not in inits],
+        "output_tensor_data": info(model.graph.output),
+    }

@@ -55,18 +55,9 @@ class _ElementwiseLoss(Loss):
     instead of repeated per class.
     """
 
-    _half_weight = False     # L2 folds a factor 1/2 into the weight
-
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         label = F.reshape_like(label, pred)
-        loss = self.residual(F, pred, label)
-        if self._half_weight:
-            weight = (1.0 if self._weight is None else self._weight) / 2
-            loss = loss * weight
-            if sample_weight is not None:
-                loss = F.broadcast_mul(loss, sample_weight)
-        else:
-            loss = self._scale(F, loss, sample_weight)
+        loss = self._scale(F, self.residual(F, pred, label), sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
 
     def residual(self, F, pred, label):
@@ -76,13 +67,11 @@ class _ElementwiseLoss(Loss):
 class L2Loss(_ElementwiseLoss):
     """0.5 * w * (pred - label)^2."""
 
-    _half_weight = True
-
     def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super(L2Loss, self).__init__(weight, batch_axis, **kwargs)
 
     def residual(self, F, pred, label):
-        return F.square(label - pred)
+        return 0.5 * F.square(label - pred)
 
 
 class L1Loss(_ElementwiseLoss):

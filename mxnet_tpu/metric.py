@@ -302,7 +302,10 @@ class TopKAccuracy(_KernelMetric):
 
     def batch_stats(self, label, pred):
         if pred.ndim == 1:
-            hit = pred.astype(jnp.int32) == label.astype(jnp.int32)
+            # reference parity: a 1-D pred is ranked (argsort) and the
+            # resulting ordering indices are compared against the label
+            ranked = jnp.argsort(pred).astype(jnp.int32)
+            hit = ranked == label.astype(jnp.int32)
             return jnp.sum(hit), label.size
         assert pred.ndim == 2, "Predictions should be no more than 2 dims"
         k = min(self.top_k, pred.shape[1])

@@ -34,23 +34,7 @@ def _check_input_names(symbol, names, typename, throw):
         logging.warning(msg)
 
 
-def _lookahead(iterable):
-    """Yield (item, is_last) pairs, holding one item of lookahead.
-
-    The training loop wants to know mid-iteration whether another batch
-    follows (the reference keeps a `next_data_batch`/`end_of_batch` state
-    machine inside fit for the same purpose; a generator is cleaner and
-    lets `prepare` hooks run on the upcoming batch).
-    """
-    it = iter(iterable)
-    try:
-        current = next(it)
-    except StopIteration:
-        return
-    for upcoming in it:
-        yield current, False, upcoming
-        current = upcoming
-    yield current, True, None
+_END = object()   # sentinel: the data iterator is exhausted
 
 
 class BaseModule(object):
@@ -200,8 +184,16 @@ class BaseModule(object):
 
     def _run_epoch(self, train_data, eval_metric, epoch, monitor,
                    batch_end_callback, sparse_row_id_fn):
-        """One pass over train_data: step, metric, callbacks per batch."""
-        for nbatch, (batch, _, upcoming) in enumerate(_lookahead(train_data)):
+        """One pass over train_data: step, metric, callbacks per batch.
+
+        The next batch is pulled only AFTER the current one is consumed —
+        iterators following the MXNet contract may reuse their internal
+        buffers on every next() call.
+        """
+        data_iter = iter(train_data)
+        batch = next(data_iter, _END)
+        nbatch = 0
+        while batch is not _END:
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
@@ -211,7 +203,8 @@ class BaseModule(object):
                                    pre_sliced=True)
             else:
                 self.update_metric(eval_metric, batch.label)
-            if upcoming is not None:
+            upcoming = next(data_iter, _END)
+            if upcoming is not _END:
                 self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
             if monitor is not None:
                 monitor.toc_print()
@@ -221,6 +214,8 @@ class BaseModule(object):
                                        locals=locals())
                 for callback in _as_list(batch_end_callback):
                     callback(params)
+            nbatch += 1
+            batch = upcoming
 
     # ------------------------------------------------- symbol/params API --
     @property

@@ -1,0 +1,130 @@
+"""ONNX export/import round trips (contrib.onnx).
+
+Reference behavior: python/mxnet/contrib/onnx mx2onnx/onnx2mx. No onnx
+package exists in this environment, so fidelity is checked the strong
+way: export -> structural validation -> re-import -> numerically
+identical forward outputs between the original and round-tripped graphs.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import onnx as onnx_mx
+
+
+def _init_params(net, shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    args = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in shapes:
+            continue
+        args[name] = nd.array(rng.uniform(-0.2, 0.2, shp).astype(np.float32))
+    auxs = {}
+    for name, shp in zip(net.list_auxiliary_states(), aux_shapes):
+        fill = np.zeros(shp, np.float32) if name.endswith("mean") \
+            else np.ones(shp, np.float32)
+        auxs[name] = nd.array(fill + rng.uniform(0, 0.1, shp).astype(np.float32))
+    return args, auxs
+
+
+def _forward(net, args, auxs, data):
+    ex = net.simple_bind(mx.cpu(), grad_req="null",
+                         **{"data": data.shape})
+    ex.copy_params_from(args, auxs)
+    return ex.forward(is_train=False, data=nd.array(data))[0].asnumpy()
+
+
+def _roundtrip(net, shapes, tmp_path, seed=0):
+    args, auxs = _init_params(net, shapes, seed)
+    params = {}
+    params.update({"arg:%s" % k: v for k, v in args.items()})
+    params.update({"aux:%s" % k: v for k, v in auxs.items()})
+    path = str(tmp_path / "model.onnx")
+    onnx_mx.export_model(net, params, [shapes["data"]],
+                         onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+
+    rng = np.random.RandomState(99)
+    x = rng.uniform(-1, 1, shapes["data"]).astype(np.float32)
+    y1 = _forward(net, args, auxs, x)
+    y2 = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)
+    return path
+
+
+def _lenet():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=8, name="conv1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(5, 5), num_filter=16, name="conv2")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.softmax(net, axis=-1, name="prob")
+
+
+def test_lenet_roundtrip(tmp_path):
+    _roundtrip(_lenet(), {"data": (2, 1, 28, 28)}, tmp_path)
+
+
+def test_batchnorm_residual_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                         no_bias=True, name="c1")
+    b1 = sym.BatchNorm(c1, fix_gamma=False, name="bn1")
+    r1 = sym.Activation(b1, act_type="relu")
+    c2 = sym.Convolution(r1, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                         no_bias=True, name="c2")
+    b2 = sym.BatchNorm(c2, fix_gamma=False, name="bn2")
+    out = sym.Pooling(b2 + r1, kernel=(1, 1), global_pool=True,
+                      pool_type="avg")
+    net = sym.Flatten(out)
+    _roundtrip(net, {"data": (2, 3, 8, 8)}, tmp_path)
+
+
+def test_mlp_no_bias_and_dropout_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, no_bias=True, name="fc1")
+    net = sym.Activation(net, act_type="sigmoid")
+    net = sym.Dropout(net, p=0.25)
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    _roundtrip(net, {"data": (3, 8)}, tmp_path)
+
+
+def test_metadata_and_checker_rejects(tmp_path):
+    path = _roundtrip(_lenet(), {"data": (2, 1, 28, 28)}, tmp_path)
+    meta = onnx_mx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 1, 28, 28))]
+    assert meta["output_tensor_data"][0][1] == (2, 10)
+
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as pb
+    bad = pb.ModelProto()
+    with open(path, "rb") as f:
+        bad.ParseFromString(f.read())
+    bad.graph.node[0].input.insert(0, "never_defined")
+    with pytest.raises(onnx_mx.checker.ValidationError):
+        onnx_mx.checker.check_model(bad.SerializeToString())
+
+
+def test_softmax_output_head_exports(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=5, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    args, auxs = _init_params(net, {"data": (2, 4)})
+    params = {"arg:%s" % k: v for k, v in args.items()
+              if k != "softmax_label"}
+    path = str(tmp_path / "head.onnx")
+    onnx_mx.export_model(net, params, [(2, 4)], onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    out = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
