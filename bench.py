@@ -15,7 +15,9 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 363.69
-BATCH = 128
+# bs=256: throughput is flat in batch (the step is HBM-bound, PERF.md),
+# but the larger batch amortizes per-step host overhead slightly
+BATCH = 256
 
 
 def build_train_step(batch, image_size=224, classes=1000, lr=0.1):

@@ -1,0 +1,164 @@
+"""Per-operator micro-benchmark harness.
+
+Parity target: benchmark/opperf/ (opperf.py run_all_mxnet_operator
+_benchmarks and the nd_operations/ suites). Times eager forward (and,
+for differentiable ops, forward+backward through autograd) of registered
+operators on standard shapes, reporting avg milliseconds after warmup.
+
+    python benchmark/opperf.py                        # curated default set
+    python benchmark/opperf.py --ops relu,dot,Convolution
+    python benchmark/opperf.py --output-format json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _default_specs():
+    """op name -> (positional array shapes, attrs). Shapes follow the
+    reference's DEFAULT_* profiles (large 1024x1024-class tensors)."""
+    big = (1024, 1024)
+    conv_x = (32, 3, 64, 64)
+    specs = {}
+    for name in ("relu", "sigmoid", "tanh", "exp", "log", "sqrt", "abs",
+                 "negative", "softrelu" if True else None, "erf", "square"):
+        if name:
+            specs[name] = ([big], {})
+    for name in ("elemwise_add", "elemwise_mul", "elemwise_sub",
+                 "elemwise_div", "broadcast_add", "broadcast_mul",
+                 "maximum", "minimum"):
+        specs[name] = ([big, big], {})
+    specs["dot"] = ([big, big], {})
+    specs["batch_dot"] = ([(32, 256, 256), (32, 256, 256)], {})
+    specs["sum"] = ([big], {})
+    specs["mean"] = ([big], {})
+    specs["max"] = ([big], {})
+    specs["argmax"] = ([big], {"axis": 1})
+    specs["softmax"] = ([big], {})
+    specs["log_softmax"] = ([big], {})
+    specs["transpose"] = ([big], {})
+    specs["FullyConnected"] = (
+        [(64, 1024), (512, 1024), (512,)], {"num_hidden": 512})
+    specs["Convolution"] = (
+        [conv_x, (64, 3, 3, 3), (64,)],
+        {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)})
+    specs["Pooling"] = (
+        [conv_x], {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})
+    specs["BatchNorm"] = (
+        [conv_x, (3,), (3,), (3,), (3,)], {"fix_gamma": False,
+                                           "is_train": True})
+    specs["LayerNorm"] = ([big, (1024,), (1024,)], {})
+    specs["Activation"] = ([big], {"act_type": "relu"})
+    specs["Dropout"] = ([big], {"p": 0.5})
+    specs["Concat"] = ([big, big], {"dim": 1})
+    specs["Reshape"] = ([big], {"shape": (512, 2048)})
+    return specs
+
+
+def bench_op(name, shapes, attrs, runs=10, warmup=2, backward=True):
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu import ops as op_registry
+
+    rng = np.random.RandomState(0)
+    arrays = [nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+              for s in shapes]
+    fn = getattr(nd, name)
+
+    def fwd():
+        out = fn(*arrays, **attrs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out
+
+    for _ in range(warmup):
+        float(fwd().asnumpy().ravel()[0])
+    tic = time.time()
+    for _ in range(runs):
+        out = fwd()
+    float(out.asnumpy().ravel()[0])
+    fwd_ms = (time.time() - tic) / runs * 1e3
+
+    result = {"op": name, "avg_fwd_ms": round(fwd_ms, 4),
+              "shapes": [list(s) for s in shapes]}
+
+    op = op_registry.get(name)
+    if backward and op is not None and op.differentiable:
+        for a in arrays:
+            a.attach_grad()
+
+        def step():
+            with autograd.record():
+                out = fn(*arrays, **attrs)
+                if isinstance(out, (list, tuple)):
+                    out = out[0]
+                loss = out.sum() if out.dtype in ("float32", "float16")\
+                    else out
+            loss.backward()
+            return arrays[0].grad
+
+        for _ in range(warmup):
+            float(step().asnumpy().ravel()[0])
+        tic = time.time()
+        for _ in range(runs):
+            g = step()
+        float(g.asnumpy().ravel()[0])
+        result["avg_fwd_bwd_ms"] = round(
+            (time.time() - tic) / runs * 1e3, 4)
+    return result
+
+
+def run_benchmarks(op_names=None, runs=10, warmup=2):
+    specs = _default_specs()
+    names = op_names or sorted(specs)
+    results = []
+    for name in names:
+        if name not in specs:
+            print("no default spec for op %r — skipping" % name,
+                  file=sys.stderr)
+            continue
+        shapes, attrs = specs[name]
+        try:
+            results.append(bench_op(name, shapes, attrs, runs, warmup))
+        except Exception as exc:            # keep the sweep alive
+            results.append({"op": name, "error": str(exc)[:200]})
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="operator micro-benchmarks",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--ops", type=str, default="",
+                        help="comma-separated op names (default: all)")
+    parser.add_argument("--runs", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--output-format", choices=("table", "json"),
+                        default="table")
+    args = parser.parse_args()
+
+    names = [n for n in args.ops.split(",") if n] or None
+    results = run_benchmarks(names, args.runs, args.warmup)
+    if args.output_format == "json":
+        print(json.dumps(results, indent=2))
+    else:
+        print("%-24s %12s %14s" % ("op", "fwd ms", "fwd+bwd ms"))
+        for r in results:
+            if "error" in r:
+                print("%-24s ERROR %s" % (r["op"], r["error"][:60]))
+            else:
+                print("%-24s %12.4f %14s"
+                      % (r["op"], r["avg_fwd_ms"],
+                         ("%.4f" % r["avg_fwd_bwd_ms"])
+                         if "avg_fwd_bwd_ms" in r else "—"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Shared plumbing for the image-classification examples.
+
+Parity target: example/image-classification/common/{fit,data}.py — the
+fit() driver with kvstore/optimizer/checkpoint wiring and the data
+factory. This environment has no network egress, so every example can
+run on synthetic data (`--benchmark 1` in the reference enables the
+same thing); real data is used when the expected files exist.
+"""
+
+import argparse
+import logging
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mx_io
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", type=str, default="mlp")
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", type=str, default="")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--model-prefix", type=str, default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="use synthetic data")
+    parser.add_argument("--data-dir", type=str, default="data")
+    return parser
+
+
+def synthetic_iter(num_classes, data_shape, batch_size, num_batches=40,
+                   seed=0):
+    """Deterministic fake dataset shaped like the real one."""
+    rng = np.random.RandomState(seed)
+    n = batch_size * num_batches
+    x = rng.uniform(-1, 1, (n,) + data_shape).astype(np.float32)
+    y = rng.randint(0, num_classes, (n,)).astype(np.float32)
+    return mx_io.NDArrayIter(x, y, batch_size=batch_size, shuffle=True,
+                             label_name="softmax_label")
+
+
+def mnist_iters(args, data_shape=(1, 28, 28)):
+    """MNIST from --data-dir when the idx files exist, else synthetic."""
+    files = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    paths = [os.path.join(args.data_dir, f) for f in files]
+    if not args.benchmark and all(os.path.exists(p) for p in paths):
+        train = mx_io.MNISTIter(image=paths[0], label=paths[1],
+                                batch_size=args.batch_size,
+                                data_shape=data_shape, shuffle=True)
+        val = mx_io.MNISTIter(image=paths[2], label=paths[3],
+                              batch_size=args.batch_size,
+                              data_shape=data_shape, shuffle=False)
+        return train, val
+    logging.info("MNIST files not found (or --benchmark): synthetic data")
+    train = synthetic_iter(args.num_classes, data_shape, args.batch_size)
+    val = synthetic_iter(args.num_classes, data_shape, args.batch_size,
+                         num_batches=8, seed=1)
+    return train, val
+
+
+def _lr_scheduler(args, steps_per_epoch):
+    if not args.lr_step_epochs:
+        return None
+    epochs = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    steps = [max(1, e * steps_per_epoch) for e in epochs]
+    return mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor, base_lr=args.lr)
+
+
+def fit(args, network, train, val=None):
+    """Bind network into a Module and run the canonical fit loop."""
+    logging.basicConfig(level=logging.INFO)
+    kv = mx.kvstore.create(args.kv_store)
+    steps_per_epoch = max(1, args.num_examples // args.batch_size)
+
+    checkpoint = None
+    if args.model_prefix:
+        checkpoint = mx.callback.do_checkpoint(args.model_prefix)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    mod = mx.mod.Module(network, context=mx.cpu())
+    optimizer_params = {
+        "learning_rate": args.lr,
+        "wd": args.wd,
+        "lr_scheduler": _lr_scheduler(args, steps_per_epoch),
+    }
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+    mod.fit(train,
+            eval_data=val,
+            eval_metric="acc",
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            begin_epoch=begin_epoch,
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches),
+            epoch_end_callback=checkpoint)
+    return mod
+
+
+__all__ = ["add_fit_args", "fit", "mnist_iters", "synthetic_iter",
+           "argparse"]

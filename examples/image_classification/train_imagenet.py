@@ -1,0 +1,71 @@
+"""Train a ResNet on ImageNet records (or synthetic data).
+
+Parity target: example/image-classification/train_imagenet.py. Feed it
+--data-train pointing at a RecordIO file produced by tools/im2rec.py;
+with --benchmark 1 (or no records) it trains on synthetic data, which
+is what the reference uses for throughput measurement too.
+
+    python examples/image_classification/train_imagenet.py \
+        --network resnet --num-layers 50 --batch-size 128 --benchmark 1
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "symbols"))
+
+from mxnet_tpu import io as mx_io
+
+import common
+import resnet
+
+
+def get_data(args, data_shape):
+    if not args.benchmark and args.data_train and \
+            os.path.exists(args.data_train):
+        train = mx_io.ImageRecordIter(
+            path_imgrec=args.data_train,
+            data_shape=data_shape,
+            batch_size=args.batch_size,
+            shuffle=True,
+            rand_mirror=True)
+        val = None
+        if args.data_val and os.path.exists(args.data_val):
+            val = mx_io.ImageRecordIter(
+                path_imgrec=args.data_val,
+                data_shape=data_shape,
+                batch_size=args.batch_size,
+                shuffle=False)
+        return train, val
+    train = common.synthetic_iter(args.num_classes, data_shape,
+                                  args.batch_size,
+                                  num_batches=args.disp_batches + 4)
+    return train, None
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train ImageNet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common.add_fit_args(parser)
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--data-train", type=str, default="")
+    parser.add_argument("--data-val", type=str, default="")
+    parser.set_defaults(network="resnet", num_classes=1000,
+                        num_examples=1281167, batch_size=128, lr=0.1,
+                        lr_step_epochs="30,60,80")
+    args = parser.parse_args()
+
+    data_shape = tuple(int(d) for d in args.image_shape.split(","))
+    net = resnet.get_symbol(args.num_classes, args.num_layers, data_shape)
+    train, val = get_data(args, data_shape)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Train an MLP or LeNet on MNIST.
+
+Parity target: example/image-classification/train_mnist.py. Runs on the
+idx files under --data-dir when present, otherwise on synthetic data
+(this environment has no download path).
+
+    python examples/image_classification/train_mnist.py --network lenet
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+import common
+
+
+def mlp(num_classes):
+    data = sym.Variable("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def lenet(num_classes):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = sym.Convolution(net, kernel=(5, 5), num_filter=50, name="conv2")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=500, name="fc1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+NETS = {"mlp": mlp, "lenet": lenet}
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train MNIST",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_classes=10, num_examples=60000,
+                        batch_size=64, lr=0.05)
+    args = parser.parse_args()
+
+    net = NETS[args.network](args.num_classes)
+    train, val = common.mnist_iters(args)
+    mod = common.fit(args, net, train, val)
+    name, acc = mod.score(val, "acc")[0]
+    print("final validation %s=%.4f" % (name, acc))
+    return mod
+
+
+if __name__ == "__main__":
+    main()

@@ -64,7 +64,9 @@ def check_graph(graph):
         for name in node.output:
             if not name:
                 _fail("node %s has an empty output name", node.name)
-            if name in produced and name not in known:
+            if name in produced:
+                # covers both double production and shadowing a graph
+                # input / initializer — SSA violations either way
                 _fail("tensor %r produced twice (SSA violation)", name)
             produced.add(name)
         for attr in node.attribute:

@@ -89,11 +89,16 @@ def _attr(node_proto, name, value):
 class GraphBuilder(object):
     """Accumulates NodeProtos/initializers while walking the mx graph."""
 
-    def __init__(self, params):
+    def __init__(self, params, shapes=None):
         self.params = params          # name -> numpy array
+        self.shapes = shapes or {}    # tensor name -> shape tuple (or None)
         self.nodes = []
         self.initializers = {}        # name -> numpy array emitted
         self._uid = 0
+
+    def rank(self, tensor_name):
+        shape = self.shapes.get(tensor_name)
+        return len(shape) if shape else None
 
     def fresh(self, base):
         self._uid += 1
@@ -225,16 +230,41 @@ def _batchnorm(gb, name, attrs, ins, outs):
                 momentum=float(attrs.get("momentum", 0.9)))
 
 
+def _emit_softmax(gb, name, axis, ins, outs):
+    """Opset-11 Softmax flattens all dims from `axis` before normalizing,
+    so only last-axis softmax maps directly; other axes go through a
+    transpose sandwich."""
+    rank = gb.rank(ins[0])
+    if rank is not None and axis is not None:
+        axis = axis % rank
+        if axis == rank - 1:
+            gb.add_node("Softmax", ins, outs, name=name, axis=-1)
+            return
+        perm = list(range(rank))
+        perm[axis], perm[-1] = perm[-1], perm[axis]
+        moved = gb.fresh(name + "_pre")
+        soft = gb.fresh(name + "_soft")
+        gb.add_node("Transpose", ins, [moved], perm=perm)
+        gb.add_node("Softmax", [moved], [soft], name=name, axis=-1)
+        gb.add_node("Transpose", [soft], outs, perm=perm)
+        return
+    if axis in (-1, None):
+        gb.add_node("Softmax", ins, outs, name=name, axis=-1)
+        return
+    raise NotImplementedError(
+        "softmax over axis %r needs a known input rank to export with "
+        "opset-11 coerce-to-2D semantics" % (axis,))
+
+
 @mx_op("softmax", "SoftmaxActivation")
 def _softmax(gb, name, attrs, ins, outs):
-    gb.add_node("Softmax", ins, outs, name=name,
-                axis=int(attrs.get("axis", -1)))
+    _emit_softmax(gb, name, int(attrs.get("axis", -1)), ins, outs)
 
 
 @mx_op("SoftmaxOutput")
 def _softmax_output(gb, name, attrs, ins, outs):
     # label input is a training-only artifact; inference graph drops it
-    gb.add_node("Softmax", ins[:1], outs, name=name, axis=1)
+    _emit_softmax(gb, name, 1, ins[:1], outs)
 
 
 @mx_op("Flatten")
@@ -315,18 +345,47 @@ for _mx_name, _onnx_name in [
         ("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
         ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"), ("abs", "Abs"),
         ("negative", "Neg"), ("identity", "Identity"), ("erf", "Erf"),
-        ("add_n", "Sum"), ("dot", "MatMul"), ("batch_dot", "MatMul"),
+        ("add_n", "Sum"),
         ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
         ("maximum", "Max"), ("minimum", "Min"),
 ]:
     _MX2ONNX[_mx_name] = _simple(_onnx_name)
 
 
+def _dot_conv(default_rank):
+    def conv(gb, name, attrs, ins, outs):
+        inputs = list(ins)
+        for slot, flag in ((0, "transpose_a"), (1, "transpose_b")):
+            if not _bool(attrs.get(flag, False)):
+                continue
+            rank = gb.rank(inputs[slot]) or default_rank
+            perm = list(range(rank))
+            perm[-2], perm[-1] = perm[-1], perm[-2]
+            moved = gb.fresh("%s_%s" % (name, flag))
+            gb.add_node("Transpose", [inputs[slot]], [moved], perm=perm)
+            inputs[slot] = moved
+        gb.add_node("MatMul", inputs, outs, name=name)
+    return conv
+
+
+_MX2ONNX["dot"] = _dot_conv(2)
+_MX2ONNX["batch_dot"] = _dot_conv(3)
+
+
 def _reduce(onnx_op):
     def conv(gb, name, attrs, ins, outs):
         kw = {"keepdims": int(_bool(attrs.get("keepdims", False)))}
         if attrs.get("axis") not in (None, "None", "()"):
-            kw["axes"] = _tuple(attrs["axis"])
+            axes = _tuple(attrs["axis"])
+            if _bool(attrs.get("exclude", False)):
+                rank = gb.rank(ins[0])
+                if rank is None:
+                    raise NotImplementedError(
+                        "reduce with exclude=True needs a known input "
+                        "rank to export the complement axis list")
+                keep = {a % rank for a in axes}
+                axes = tuple(a for a in range(rank) if a not in keep)
+            kw["axes"] = axes
         gb.add_node(onnx_op, ins, outs, name=name, **kw)
     return conv
 
@@ -372,7 +431,23 @@ def create_model(sym, params, input_shapes, input_dtype=np.float32,
     nodes = graph["nodes"]
     params = {k.split(":", 1)[-1]: _np_param(v) for k, v in params.items()}
 
-    gb = GraphBuilder(params)
+    # per-tensor shapes (for rank-dependent conversions: reduce exclude,
+    # softmax axis semantics, dot transposes)
+    shapes = {name: tuple(shape) for name, shape in input_shapes.items()}
+    shapes.update({name: tuple(arr.shape) for name, arr in params.items()})
+    try:
+        internals = sym.get_internals()
+        _, internal_shapes, _ = internals.infer_shape_partial(**input_shapes)
+        for out_nm, shp in zip(internals.list_outputs(), internal_shapes):
+            if shp:
+                shapes[out_nm] = tuple(shp)
+                for suffix in ("_output", "_output0"):
+                    if out_nm.endswith(suffix):
+                        shapes[out_nm[:-len(suffix)]] = tuple(shp)
+    except Exception:
+        pass
+
+    gb = GraphBuilder(params, shapes)
     out_name = {}           # (node_idx, out_idx) -> onnx tensor name
     for i, node in enumerate(nodes):
         if node["op"] == "null":

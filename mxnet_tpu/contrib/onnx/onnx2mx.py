@@ -183,7 +183,14 @@ def _gpool(im, node, attrs):
 def _gemm(im, node, attrs):
     if attrs.get("alpha", 1.0) != 1.0 or attrs.get("transA", 0):
         raise NotImplementedError("general Gemm")
-    w_name = node.input[1]
+    beta = attrs.get("beta", 1.0)
+    if beta == 0.0:
+        inputs = list(node.input[:2])            # C disabled
+    elif beta == 1.0:
+        inputs = [i for i in node.input if i]
+    else:
+        raise NotImplementedError("Gemm with beta=%r" % (beta,))
+    w_name = inputs[1]
     w = im.const(w_name)
     if not attrs.get("transB", 0):
         # FullyConnected computes x W^T; materialize the transposed weight
@@ -192,8 +199,8 @@ def _gemm(im, node, attrs):
         w = np.ascontiguousarray(w.T)
         w_name = "%s__T_%s" % (w_name, node.name or "gemm")
         im.arrays[w_name] = w
-    ins = [im.sym_of(node.input[0]), im.sym_of(w_name)] + \
-        [im.sym_of(i) for i in node.input[2:]]
+    ins = [im.sym_of(inputs[0]), im.sym_of(w_name)] + \
+        [im.sym_of(i) for i in inputs[2:]]
     return im.S.FullyConnected(ins[0], ins[1],
                                ins[2] if len(ins) > 2 else None,
                                num_hidden=w.shape[0], flatten=False,
@@ -262,12 +269,16 @@ def _transpose(im, node, attrs):
 
 @onnx_op("Clip")
 def _clip(im, node, attrs):
+    # absent bounds mean unbounded (opset 11 uses optional inputs, older
+    # models use attributes); empty-string input slots are "not provided"
     lo = attrs.get("min")
     hi = attrs.get("max")
-    if len(node.input) > 1:
+    if len(node.input) > 1 and node.input[1]:
         lo = float(im.const(node.input[1]))
-    if len(node.input) > 2:
+    if len(node.input) > 2 and node.input[2]:
         hi = float(im.const(node.input[2]))
+    lo = float("-inf") if lo is None else lo
+    hi = float("inf") if hi is None else hi
     return im.S.clip(im.sym_of(node.input[0]), a_min=lo, a_max=hi,
                      name=node.name or None)
 

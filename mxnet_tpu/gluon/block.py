@@ -16,6 +16,7 @@ import re
 import threading
 
 from .. import autograd
+from .. import name as _name
 from .. import ndarray as nd
 from .. import symbol as _symbol
 from ..base import MXNetError
@@ -42,7 +43,8 @@ class _BlockScope(object):
     def __init__(self, block):
         self._block = block
         self._counter = {}
-        self._old_scope = None
+        self._old_scopes = []       # stack: restore targets per entry
+        self._name_managers = []    # stack: one fresh Prefix per entry
 
     @staticmethod
     def create(prefix, params, hint):
@@ -74,14 +76,24 @@ class _BlockScope(object):
     def __enter__(self):
         if self._block._empty_prefix:
             return self
-        self._old_scope = getattr(_BlockScope._current, "value", None)
+        self._old_scopes.append(getattr(_BlockScope._current, "value", None))
         _BlockScope._current.value = self
+        # ops composed inside this scope — including explicitly-named ones
+        # like the layer-internal name='fwd' — get the block prefix, so
+        # node names stay unique across sibling blocks (the reference
+        # enters _name.Prefix(block.prefix) the same way). A fresh Prefix
+        # per entry keeps nested/concurrent entries reentrant: NameManager
+        # stores its restore pointer on the instance.
+        manager = _name.Prefix(self._block.prefix)
+        manager.__enter__()
+        self._name_managers.append(manager)
         return self
 
     def __exit__(self, ptype, value, trace):
         if self._block._empty_prefix:
             return
-        _BlockScope._current.value = self._old_scope
+        self._name_managers.pop().__exit__(ptype, value, trace)
+        _BlockScope._current.value = self._old_scopes.pop()
 
 
 def _flatten(args, fmt_name):

@@ -1,0 +1,521 @@
+"""Detection / optical-flow operator family.
+
+Reference semantics: src/operator/correlation.cc (FlowNet correlation),
+src/operator/contrib/multibox_prior.cc / multibox_target.cc /
+multibox_detection.cc (SSD), src/operator/contrib/proposal.cc
+(Faster-RCNN RPN), src/operator/contrib/deformable_convolution.cc and
+deformable_psroi_pooling.cc (DCN / R-FCN).
+
+TPU-native shapes: everything is static — displacement grids unroll at
+trace time, NMS is a fixed-trip-count lax.fori_loop over a top-k set,
+and ragged results are padded with -1 instead of being dynamically
+sized. Bilinear sampling (deformable ops) is expressed as four gathers
+with blend weights, which XLA lowers to vectorized dynamic-slices.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+# ------------------------------------------------------------ correlation --
+@register(name="Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation volume between two feature maps.
+
+    Output channel (j, i) holds the kernel-window-averaged, channel-summed
+    product (or |difference|) of data1 and data2 displaced by
+    (j*stride2, i*stride2), scaled by 1/(K*K*C) as the reference does.
+    """
+    b, c, h, w = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    top_h = (ph - 2 * border + stride1 - 1) // stride1
+    top_w = (pw - 2 * border + stride1 - 1) // stride1
+    radius = max_displacement // stride2
+    grid = 2 * radius + 1
+    norm = float(kernel_size * kernel_size * c)
+
+    planes = []
+    for j in range(-radius, radius + 1):
+        for i in range(-radius, radius + 1):
+            dy, dx = j * stride2, i * stride2
+            shifted = p2[:, :, max_displacement + dy:
+                         ph - max_displacement + dy,
+                         max_displacement + dx:pw - max_displacement + dx]
+            base = p1[:, :, max_displacement:ph - max_displacement,
+                      max_displacement:pw - max_displacement]
+            if is_multiply:
+                prod = base * shifted
+            else:
+                prod = jnp.abs(base - shifted)
+            summed = jnp.sum(prod, axis=1, keepdims=True)
+            # kernel-window sum centred on the stride1 grid
+            win = lax.reduce_window(
+                summed, 0.0, lax.add,
+                (1, 1, kernel_size, kernel_size),
+                (1, 1, stride1, stride1),
+                [(0, 0), (0, 0), (0, 0), (0, 0)])
+            planes.append(win[:, :, :top_h, :top_w] / norm)
+    out = jnp.concatenate(planes, axis=1)
+    return out.reshape(b, grid * grid, top_h, top_w)
+
+
+# --------------------------------------------------------------- multibox --
+def _corner_iou(a, b):
+    """IoU between (N,4) and (M,4) corner boxes -> (N, M)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    inter = jnp.prod(jnp.clip(br - tl, 0.0, None), axis=-1)
+    area_a = jnp.prod(jnp.clip(a[:, 2:] - a[:, :2], 0.0, None), axis=-1)
+    area_b = jnp.prod(jnp.clip(b[:, 2:] - b[:, :2], 0.0, None), axis=-1)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-12)
+
+
+def _parse_floats(value, default):
+    if value is None:
+        return tuple(default)
+    if isinstance(value, str):
+        import ast
+        value = ast.literal_eval(value)   # "(1,2)" strings from JSON attrs
+    if not isinstance(value, (tuple, list)):
+        value = (value,)
+    return tuple(float(v) for v in value)
+
+
+@register(name="_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generator: (1, H*W*A, 4) corner boxes in [0, 1] units,
+    A = len(sizes) + len(ratios) - 1 (size_i paired with ratios[0], then
+    sizes[0] paired with each remaining ratio)."""
+    sizes = _parse_floats(sizes, (1.0,))
+    ratios = _parse_floats(ratios, (1.0,))
+    steps = _parse_floats(steps, (-1.0, -1.0))
+    offsets = _parse_floats(offsets, (0.5, 0.5))
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+
+    half = []
+    for s in sizes:
+        r = ratios[0] ** 0.5
+        half.append((s * r / 2.0, s / r / 2.0))
+    for ratio in ratios[1:]:
+        r = ratio ** 0.5
+        half.append((sizes[0] * r / 2.0, sizes[0] / r / 2.0))
+
+    boxes = []
+    for hw, hh in half:
+        boxes.append(jnp.stack(
+            [cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1))
+    out = jnp.stack(boxes, axis=2).reshape(1, h * w * len(half), 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def _encode_locs(anchors, matched_gt, variances):
+    """Corner anchors + matched corner gts -> (dx, dy, dw, dh) targets."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(matched_gt[:, 2] - matched_gt[:, 0], 1e-12)
+    gh = jnp.maximum(matched_gt[:, 3] - matched_gt[:, 1], 1e-12)
+    gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+    gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+    v0, v1, v2, v3 = variances
+    return jnp.stack([
+        (gcx - acx) / jnp.maximum(aw, 1e-12) / v0,
+        (gcy - acy) / jnp.maximum(ah, 1e-12) / v1,
+        jnp.log(gw / jnp.maximum(aw, 1e-12)) / v2,
+        jnp.log(gh / jnp.maximum(ah, 1e-12)) / v3,
+    ], axis=-1)
+
+
+@register(name="_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          differentiable=False, num_outputs=3)
+def multibox_target(anchors, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=_VARIANCES):
+    """SSD target matcher -> (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N)).
+
+    Matching follows the reference: every gt claims its best anchor
+    (bipartite stage), then any anchor whose best-gt IoU clears
+    overlap_threshold matches that gt. cls_target is gt class + 1, 0 for
+    background; with negative mining, background anchors beyond
+    ratio*num_pos with the smallest background-confidence deficit are
+    ignored (ignore_label).
+    """
+    variances = _parse_floats(variances, _VARIANCES)
+    anchors = anchors.reshape(-1, 4)
+    num_anchors = anchors.shape[0]
+
+    def one_sample(gts, scores):
+        valid = gts[:, 0] >= 0
+        iou = _corner_iou(anchors, gts[:, 1:5])          # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # bipartite: each valid gt grabs its own argmax anchor
+        best_anchor = jnp.argmax(iou, axis=0)            # (M,)
+        forced_gt = jnp.full((num_anchors,), -1, jnp.int32)
+        order = jnp.arange(gts.shape[0], dtype=jnp.int32)
+        forced_gt = forced_gt.at[best_anchor].set(
+            jnp.where(valid, order, forced_gt[best_anchor]))
+
+        best_iou = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        matched_gt = jnp.where(forced_gt >= 0, forced_gt,
+                               jnp.where(best_iou >= overlap_threshold,
+                                         best_gt, -1))
+        is_pos = matched_gt >= 0
+        gt_idx = jnp.clip(matched_gt, 0, gts.shape[0] - 1)
+        cls_target = jnp.where(
+            is_pos, gts[gt_idx, 0].astype(jnp.int32) + 1, 0)
+
+        if negative_mining_ratio > 0:
+            num_pos = jnp.sum(is_pos)
+            max_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                int(minimum_negative_samples))
+            # mine the hardest backgrounds: smallest background-class
+            # confidence margin first
+            probs = jax.nn.softmax(scores, axis=0)       # (C+1, N)
+            bg_conf = probs[0]
+            candidate = (~is_pos) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(candidate, 1.0 - bg_conf, -1.0)
+            rank = jnp.argsort(jnp.argsort(-hardness))
+            keep_neg = candidate & (rank < max_neg)
+            cls_target = jnp.where(is_pos, cls_target,
+                                   jnp.where(keep_neg, 0,
+                                             jnp.int32(ignore_label)))
+
+        loc = _encode_locs(anchors, gts[gt_idx, 1:5], variances)
+        loc = jnp.where(is_pos[:, None], loc, 0.0)
+        mask = jnp.where(is_pos[:, None],
+                         jnp.ones((num_anchors, 4), jnp.float32), 0.0)
+        return loc.reshape(-1), mask.reshape(-1), cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return loc_t, loc_m, cls_t.astype(jnp.float32)
+
+
+def _decode_locs(anchors, deltas, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    v0, v1, v2, v3 = variances
+    cx = deltas[:, 0] * v0 * aw + acx
+    cy = deltas[:, 1] * v1 * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2] * v2, -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3] * v3, -10, 10)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _greedy_nms_mask(boxes, scores, threshold, topk):
+    """Suppressed-flag vector via a fixed-trip greedy pass over the topk
+    highest-scoring boxes."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = _corner_iou(boxes[order], boxes[order])
+    alive = scores[order] > -jnp.inf
+
+    def body(i, alive):
+        suppress = (iou[i] > threshold) & (jnp.arange(n) > i) & alive[i]
+        return alive & ~suppress
+
+    steps = n if topk < 0 else min(topk, n)
+    alive = lax.fori_loop(0, steps, body, alive)
+    inv = jnp.argsort(order)
+    return alive[inv]
+
+
+@register(name="_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=_VARIANCES, nms_topk=-1):
+    """SSD decode + per-class NMS -> (B, N, 6) rows of
+    [class_id, score, xmin, ymin, xmax, ymax], suppressed rows = -1.
+    class_id is 0-based over foreground classes (background stripped),
+    as the reference emits."""
+    variances = _parse_floats(variances, _VARIANCES)
+    if background_id != 0:
+        raise NotImplementedError("background_id must be 0")
+    anchors = anchor.reshape(-1, 4)
+
+    def one_sample(probs, deltas):
+        boxes = _decode_locs(anchors, deltas.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        fg = probs[1:]                                  # strip background
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        nms_class = jnp.zeros_like(cls_id) if force_suppress else cls_id
+        sc = jnp.where(keep, score, -jnp.inf)
+        # class-aware NMS: boxes of different classes never overlap once
+        # shifted apart by class index
+        shifted = boxes + nms_class[:, None] * 4.0
+        alive = _greedy_nms_mask(shifted, sc, nms_threshold, nms_topk)
+        ok = keep & alive
+        out = jnp.concatenate([
+            jnp.where(ok, cls_id, -1.0)[:, None],
+            jnp.where(ok, score, -1.0)[:, None],
+            jnp.where(ok[:, None], boxes, -1.0)], axis=-1)
+        # valid rows first, highest score first
+        order = jnp.argsort(-out[:, 1])
+        return out[order]
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------- proposal --
+@register(name="_contrib_Proposal", aliases=("Proposal",),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """Faster-RCNN RPN proposals: anchors + deltas -> clipped, size-
+    filtered, NMS'd rois (B*post_nms, 5) [batch_idx, x1, y1, x2, y2]."""
+    scales = _parse_floats(scales, (4, 8, 16, 32))
+    ratios = _parse_floats(ratios, (0.5, 1, 2))
+    b, two_a, h, w = cls_prob.shape
+    num_anchors = len(scales) * len(ratios)
+
+    # base anchors around (0, 0) at feature_stride, reference layout
+    base = float(feature_stride)
+    anchors = []
+    for ratio in ratios:
+        size = base * base
+        ws = jnp.round(jnp.sqrt(size / ratio))
+        hs = jnp.round(ws * ratio)
+        for scale in scales:
+            wsc, hsc = ws * scale, hs * scale
+            cx = (base - 1) / 2.0
+            cy = (base - 1) / 2.0
+            anchors.append(jnp.stack([cx - (wsc - 1) / 2, cy - (hsc - 1) / 2,
+                                      cx + (wsc - 1) / 2, cy + (hsc - 1) / 2]))
+    base_anchors = jnp.stack(anchors)                     # (A, 4)
+
+    sx = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([sxg, syg, sxg, syg], axis=-1)     # (H, W, 4)
+    all_anchors = (shifts[:, :, None, :] +
+                   base_anchors[None, None, :, :]).reshape(-1, 4)
+
+    n = h * w * num_anchors
+    pre = min(rpn_pre_nms_top_n, n)
+    post = rpn_post_nms_top_n
+
+    def one_sample(probs, deltas, info):
+        fg = probs[num_anchors:].transpose(1, 2, 0).reshape(-1)
+        dl = deltas.reshape(num_anchors, 4, h, w) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        widths = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        heights = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        ctr_x = all_anchors[:, 0] + (widths - 1) / 2
+        ctr_y = all_anchors[:, 1] + (heights - 1) / 2
+        cx = dl[:, 0] * widths + ctr_x
+        cy = dl[:, 1] * heights + ctr_y
+        bw = jnp.exp(jnp.clip(dl[:, 2], -10, 10)) * widths
+        bh = jnp.exp(jnp.clip(dl[:, 3], -10, 10)) * heights
+        boxes = jnp.stack([cx - (bw - 1) / 2, cy - (bh - 1) / 2,
+                           cx + (bw - 1) / 2, cy + (bh - 1) / 2], axis=-1)
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1),
+            jnp.clip(boxes[:, 1], 0, im_h - 1),
+            jnp.clip(boxes[:, 2], 0, im_w - 1),
+            jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=-1)
+        min_size = rpn_min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        sc = jnp.where(keep, fg, -jnp.inf)
+        top_sc, top_idx = lax.top_k(sc, pre)
+        top_boxes = boxes[top_idx]
+        alive = _greedy_nms_mask(top_boxes, top_sc, threshold, -1)
+        final = jnp.where(alive, top_sc, -jnp.inf)
+        sel_sc, sel = lax.top_k(final, min(post, pre))
+        rois = top_boxes[sel]
+        valid = sel_sc > -jnp.inf
+        rois = jnp.where(valid[:, None], rois, 0.0)
+        if rois.shape[0] < post:
+            padn = post - rois.shape[0]
+            rois = jnp.concatenate(
+                [rois, jnp.zeros((padn, 4), rois.dtype)])
+            sel_sc = jnp.concatenate(
+                [sel_sc, jnp.full((padn,), -jnp.inf, sel_sc.dtype)])
+        return rois, jnp.where(sel_sc == -jnp.inf, 0.0, sel_sc)
+
+    rois, scores = jax.vmap(one_sample)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=rois.dtype), post)
+    out = jnp.concatenate([batch_idx[:, None],
+                           rois.reshape(-1, 4)], axis=-1)
+    if output_score:
+        return out, scores.reshape(-1, 1)
+    return out
+
+
+# ------------------------------------------------------------- deformable --
+def _bilinear_gather(img, ys, xs):
+    """Sample img (C, H, W) at float coords (ys, xs) of any shape ->
+    (C,) + coord shape. Out-of-bounds contributions are zero, matching
+    the reference's deformable_im2col boundary handling."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            vals = img[:, yi, xi]
+            out = out + vals * (wy * wx * inb)[None]
+    return out
+
+
+@register(name="_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=1, num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout="NCHW"):
+    """DCNv1: each kernel tap samples the input at its learned offset via
+    bilinear interpolation, then an ordinary dense contraction applies
+    the weights (one einsum onto the MXU instead of im2col + GEMM)."""
+    b, c, h, w = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    out_h = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = num_deformable_group
+    cg = c // dg
+
+    oy = jnp.arange(out_h, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(out_w, dtype=jnp.float32) * sw - pw
+    oyg, oxg = jnp.meshgrid(oy, ox, indexing="ij")       # (Ho, Wo)
+
+    off = offset.reshape(b, dg, kh * kw, 2, out_h, out_w)
+
+    def sample_one(img, offs):
+        # img (C, H, W); offs (dg, K*K, 2, Ho, Wo)
+        cols = []
+        for k in range(kh * kw):
+            ky, kx = divmod(k, kw)
+            base_y = oyg + ky * dh
+            base_x = oxg + kx * dw
+            per_group = []
+            for g in range(dg):
+                ys = base_y + offs[g, k, 0]
+                xs = base_x + offs[g, k, 1]
+                per_group.append(
+                    _bilinear_gather(img[g * cg:(g + 1) * cg], ys, xs))
+            cols.append(jnp.concatenate(per_group, axis=0))
+        return jnp.stack(cols, axis=1)                   # (C, K*K, Ho, Wo)
+
+    cols = jax.vmap(sample_one)(data, off)               # (B, C, KK, Ho, Wo)
+    wmat = weight.reshape(num_filter, c // num_group, kh * kw)
+    if num_group == 1:
+        out = jnp.einsum("bckhw,ock->bohw", cols, wmat)
+    else:
+        cols_g = cols.reshape(b, num_group, c // num_group, kh * kw,
+                              out_h, out_w)
+        wg = wmat.reshape(num_group, num_filter // num_group,
+                          c // num_group, kh * kw)
+        out = jnp.einsum("bgckhw,gock->bgohw", cols_g, wg) \
+            .reshape(b, num_filter, out_h, out_w)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register(name="_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=1, group_size=1, pooled_size=1,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """R-FCN position-sensitive ROI pooling with optional learned part
+    offsets. data channels = output_dim * group_size^2; each pooled bin
+    (ph, pw) averages sample_per_part^2 bilinear samples from its
+    position-sensitive channel slice."""
+    part_size = part_size or pooled_size
+    b, c, h, w = data.shape
+    ps = pooled_size
+    g = group_size
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - 0.5
+        y1 = roi[2] * spatial_scale - 0.5
+        x2 = (roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = (roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / ps
+        bin_h = rh / ps
+        img = data[bidx]
+
+        out = jnp.zeros((output_dim, ps, ps), data.dtype)
+        for phi in range(ps):
+            for pwi in range(ps):
+                if no_trans:
+                    off_x = off_y = 0.0
+                else:
+                    pidx_y = phi * part_size // ps
+                    pidx_x = pwi * part_size // ps
+                    off_x = tr[0, pidx_y, pidx_x] * trans_std * rw
+                    off_y = tr[1, pidx_y, pidx_x] * trans_std * rh
+                ys = y1 + phi * bin_h + off_y + \
+                    (jnp.arange(sample_per_part) + 0.5) * \
+                    (bin_h / sample_per_part)
+                xs = x1 + pwi * bin_w + off_x + \
+                    (jnp.arange(sample_per_part) + 0.5) * \
+                    (bin_w / sample_per_part)
+                ysg, xsg = jnp.meshgrid(ys, xs, indexing="ij")
+                gy = min(phi * g // ps, g - 1)
+                gx = min(pwi * g // ps, g - 1)
+                chan0 = (gy * g + gx) * output_dim
+                slice_ = lax.dynamic_slice_in_dim(img, chan0, output_dim, 0)
+                vals = _bilinear_gather(slice_, ysg, xsg)
+                out = out.at[:, phi, pwi].set(vals.mean(axis=(1, 2)))
+        return out
+
+    if trans is None or no_trans:
+        trans2 = jnp.zeros((rois.shape[0], 2, part_size, part_size),
+                           data.dtype)
+    else:
+        trans2 = trans.reshape(
+            rois.shape[0], -1, part_size, part_size)[:, :2]
+    return jax.vmap(one_roi)(rois, trans2)

@@ -24,9 +24,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "current_mesh",
            "use_mesh", "set_mesh", "shard", "replicate", "all_reduce",
-           "all_gather", "reduce_scatter", "ring_permute", "device_count"]
+           "all_gather", "reduce_scatter", "ring_permute", "device_count",
+           "init_distributed"]
 
 _CURRENT_MESH = None
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Join a multi-host SPMD job (the tools/launch.py bootstrap).
+
+    Replaces the reference's ps-lite scheduler rendezvous
+    (DMLC_PS_ROOT_URI / DMLC_ROLE env contract consumed by
+    tools/launch.py + dmlc_tracker): every worker calls in with a shared
+    coordinator address and its process id, after which jax.devices()
+    spans all hosts and the mesh/collective layer works unchanged.
+    Arguments default to the MXNET_TPU_* environment set by the
+    launcher. No-op when the job has a single process and no
+    coordinator is configured.
+    """
+    import os
+    coordinator = coordinator or os.environ.get("MXNET_TPU_COORDINATOR")
+    num_processes = int(num_processes or
+                        os.environ.get("MXNET_TPU_NUM_PROC", "1"))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("MXNET_TPU_PROC_ID", "0"))
+    if coordinator is None and num_processes == 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
 
 
 def device_count():

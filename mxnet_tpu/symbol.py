@@ -58,6 +58,8 @@ OP_INPUTS = {
 # Aux states: inputs updated by the op during training rather than learned
 # by gradient (reference: MutableInput lists; BatchNorm moving stats).
 OP_AUX = {"BatchNorm": ("moving_mean", "moving_var")}
+# default initializer registry names for auto-created aux states
+_AUX_DEFAULT_INIT = {"moving_mean": "zeros", "moving_var": "ones"}
 
 # Loss heads whose missing `label` input is auto-created as `{name}_label`
 # (the reference's ListArguments auto-var rule that makes `softmax_label`
@@ -143,10 +145,14 @@ class _Node:
 _name_counter = {}
 
 
-def _auto_name(op_name):
+def _auto_name(op_name, name=None):
+    """Route op names through the active NameManager: auto-generated
+    names draw from the scope's counter; explicit names pick up the
+    scope prefix (so layer-internal fixed names like 'fwd' stay unique
+    across sibling blocks)."""
     from .name import NameManager
     base = op_name.lower().lstrip("_")
-    return NameManager.current().get(None, base)
+    return NameManager.current().get(name, base)
 
 
 class Symbol:
@@ -592,8 +598,11 @@ def _parse_attr(v):
 
 # ---------------------------------------------------------- composition --
 def _compose(op_name, input_syms, attrs, name):
-    """Create a node applying `op_name` to input symbols."""
-    name = name or _auto_name(op_name)
+    """Create a node applying `op_name` to input symbols. `name`, when
+    given, is already scope-resolved by the caller (func/_auto_name) —
+    resolving again here would apply the active Prefix twice."""
+    if not name:
+        name = _auto_name(op_name)
     nodes = _merge_nodes(input_syms)
     node = _Node(op_name, name, attrs,
                  [(s, s._outputs[0][1]) for s in input_syms])
@@ -658,7 +667,7 @@ def _make_sym_func(op_name):
                 input_names.append(k)
             elif v is not None:
                 attrs[k] = v
-        nm = name or _auto_name(op_name)
+        nm = _auto_name(op_name, name)
 
         # auto-create missing parameter variables (MXNet composition rule)
         if not has_varargs and op_name in PARAM_SHAPE_RULES:
@@ -670,6 +679,12 @@ def _make_sym_func(op_name):
                 if _param_unused(op_name, pname, attrs):
                     continue
                 vattrs = {"__aux__": True} if pname in aux_set else {}
+                # ops declare default inits for their aux states (the
+                # reference stamps __init__ on aux vars at composition,
+                # batch_norm.cc); initializers route on this attr
+                default_init = _AUX_DEFAULT_INIT.get(pname)
+                if pname in aux_set and default_init:
+                    vattrs["__init__"] = default_init
                 v = var("%s_%s" % (nm, pname), attr=vattrs)
                 input_syms.append(v)
                 input_names.append(pname)

@@ -1,0 +1,91 @@
+"""End-user entry points: examples/ scripts and tools/ CLIs.
+
+Parity targets: example/image-classification/train_mnist.py,
+benchmark_score.py, tools/im2rec.py, tools/launch.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, **env_extra):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    env.update(env_extra)
+    return subprocess.run(cmd, cwd=ROOT, env=env, capture_output=True,
+                          text=True, timeout=600)
+
+
+def test_train_mnist_runs_synthetic():
+    r = _run([sys.executable, "examples/image_classification/train_mnist.py",
+              "--network", "mlp", "--benchmark", "1", "--batch-size", "32",
+              "--num-epochs", "1", "--num-examples", "1280"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final validation accuracy" in r.stdout
+
+
+def test_benchmark_score_runs():
+    r = _run([sys.executable,
+              "examples/image_classification/benchmark_score.py",
+              "--networks", "squeezenet1.1", "--batch-sizes", "2",
+              "--image-shape", "3,64,64", "--steps", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "img/s" in r.stdout
+
+
+def test_im2rec_list_and_pack_roundtrip(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = np.random.RandomState(i).randint(
+                0, 255, (32, 40, 3), np.uint8)
+            cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+    prefix = str(tmp_path / "pack")
+    r = _run([sys.executable, "tools/im2rec.py", prefix, str(root),
+              "--list", "--recursive"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(prefix + ".lst")
+    r = _run([sys.executable, "tools/im2rec.py", prefix, str(root),
+              "--resize", "28"])
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    labels = set()
+    for k in rec.keys:
+        header, img = recordio.unpack_img(rec.read_idx(k))
+        assert min(img.shape[:2]) == 28
+        labels.add(int(header.label))
+    assert labels == {0, 1}
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    marker = str(tmp_path / "out")
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "open(%r + os.environ['MXNET_TPU_PROC_ID'], 'w')"
+        ".write(os.environ['MXNET_TPU_NUM_PROC'])\n" % marker)
+    r = _run([sys.executable, "tools/launch.py", "-n", "3",
+              sys.executable, str(script)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    for i in range(3):
+        assert open(marker + str(i)).read() == "3"
+
+
+def test_train_imagenet_benchmark_tiny():
+    r = _run([sys.executable,
+              "examples/image_classification/train_imagenet.py",
+              "--benchmark", "1", "--batch-size", "8", "--num-epochs", "1",
+              "--num-layers", "18", "--image-shape", "3,32,32",
+              "--num-classes", "10", "--num-examples", "64",
+              "--disp-batches", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]

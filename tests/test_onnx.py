@@ -114,6 +114,76 @@ def test_metadata_and_checker_rejects(tmp_path):
         onnx_mx.checker.check_model(bad.SerializeToString())
 
 
+def test_rank_dependent_exports_roundtrip(tmp_path):
+    """Non-last-axis softmax, exclude-reduce, and transposed dot need the
+    shape-aware conversion paths."""
+    data = sym.Variable("data")
+    soft = sym.softmax(data, axis=1)                  # (2, 3, 4): axis 1
+    red = sym.mean(soft, axis=0, exclude=True, keepdims=False)
+    net = sym.dot(red, sym.Variable("w"), transpose_b=True)
+    rng = np.random.RandomState(5)
+    # exclude-reduce of (2, 3, 4) over {1, 2} leaves (2,); dot with w^T
+    # contracts it against w's trailing axis
+    w = nd.array(rng.rand(4, 2).astype(np.float32))
+    path = str(tmp_path / "rankdep.onnx")
+    onnx_mx.export_model(net, {"arg:w": w},
+                         {"data": (2, 3, 4)}, onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    x = rng.rand(2, 3, 4).astype(np.float32)
+
+    def fwd(s, args):
+        ex = s.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 4),
+                           **{k: tuple(v.shape) for k, v in args.items()})
+        ex.copy_params_from(args, {})
+        return ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+    y1 = fwd(net, {"w": w})
+    y2 = fwd(sym2, args2)
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_import_unbounded(tmp_path):
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as pb
+    model = pb.ModelProto()
+    model.ir_version = 7
+    model.opset_import.add().version = 11
+    g = model.graph
+    g.name = "clip_min_only"
+    vi = g.input.add()
+    vi.name = "data"
+    vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+    for d in (2, 3):
+        vi.type.tensor_type.shape.dim.add().dim_value = d
+    lo = g.initializer.add()
+    lo.name = "lo"
+    lo.data_type = pb.TensorProto.FLOAT
+    lo.raw_data = np.float32(0.25).tobytes()
+    n = g.node.add()
+    n.op_type = "Clip"
+    n.input.extend(["data", "lo", ""])       # min only, max unbounded
+    n.output.append("y")
+    out = g.output.add()
+    out.name = "y"
+    out.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+    sym2, args2, auxs2 = onnx_mx.import_model(model.SerializeToString())
+    x = np.array([[0.0, 0.5, 9.0], [-1.0, 2.0, 100.0]], np.float32)
+    y = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(y, np.clip(x, 0.25, None))
+
+
+def test_checker_rejects_initializer_shadowing(tmp_path):
+    path = _roundtrip(_lenet(), {"data": (1, 1, 28, 28)}, tmp_path)
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as pb
+    bad = pb.ModelProto()
+    with open(path, "rb") as f:
+        bad.ParseFromString(f.read())
+    # a node writing over an initializer name is an SSA violation
+    bad.graph.node[0].output[0] = bad.graph.initializer[0].name
+    with pytest.raises(onnx_mx.checker.ValidationError):
+        onnx_mx.checker.check_model(bad.SerializeToString())
+
+
 def test_softmax_output_head_exports(tmp_path):
     data = sym.Variable("data")
     net = sym.FullyConnected(data, num_hidden=5, name="fc")

@@ -1,0 +1,188 @@
+"""Detection / flow op family vs brute-force numpy references.
+
+Reference ops: src/operator/correlation.cc, contrib/multibox_*.cc,
+contrib/proposal.cc, contrib/deformable_convolution.cc,
+contrib/deformable_psroi_pooling.cc.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_correlation_identity():
+    """Correlating a map with itself at zero displacement gives the
+    channel-mean of squares; off-center planes match a shifted product."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=2, stride1=1, stride2=1,
+                         pad_size=2).asnumpy()
+    assert out.shape == (2, 25, 8, 8)
+    center = out[:, 12]                       # displacement (0, 0)
+    np.testing.assert_allclose(center, (x * x).sum(1) / 3.0, rtol=1e-5)
+    # displacement (dy=0, dx=+1) = plane index 13
+    xp = np.pad(x, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    shifted = xp[:, :, 2:10, 3:11]
+    np.testing.assert_allclose(out[:, 13], (x * shifted).sum(1) / 3.0,
+                               rtol=1e-5)
+
+
+def test_correlation_kernel_window_and_subtract():
+    rng = np.random.RandomState(1)
+    a = rng.rand(1, 2, 6, 6).astype(np.float32)
+    b = rng.rand(1, 2, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=3,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=2, is_multiply=False).asnumpy()
+    # output grid excludes a border of max_displacement + kernel_radius
+    # (= 2) on each side of the padded 10x10 map -> 6x6
+    assert out.shape == (1, 9, 6, 6)
+    pa = np.pad(a, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    pb = np.pad(b, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    diff = np.abs(pa - pb).sum(1)             # (1, 10, 10)
+    expect = np.zeros((1, 6, 6), np.float32)
+    for y in range(6):
+        for x in range(6):
+            # window centred on border + (y, x)
+            expect[0, y, x] = diff[0, 1 + y:4 + y, 1 + x:4 + x].sum() / 18.0
+    np.testing.assert_allclose(out[0, 4], expect[0], rtol=1e-4)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 6))
+    out = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                      ratios=(1.0, 2.0)).asnumpy()
+    assert out.shape == (1, 4 * 6 * 3, 4)
+    # first anchor at cell (0,0): center ((0.5)/6, 0.5/4), size 0.5, ratio 1
+    cx, cy = 0.5 / 6, 0.5 / 4
+    np.testing.assert_allclose(out[0, 0],
+                               [cx - 0.25, cy - 0.25, cx + 0.25, cy + 0.25],
+                               atol=1e-6)
+    # third anchor: size 0.5, ratio 2 -> half-w = 0.25*sqrt(2)
+    hw = 0.25 * np.sqrt(2)
+    hh = 0.25 / np.sqrt(2)
+    np.testing.assert_allclose(out[0, 2],
+                               [cx - hw, cy - hh, cx + hw, cy + hh],
+                               atol=1e-6)
+
+
+def test_multibox_target_matching():
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 1.0]]], np.float32)
+    # one gt of class 2 aligned with anchor 1; one padded row
+    label = np.array([[[2, 0.52, 0.52, 0.98, 0.98],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    cls_t = cls_t.asnumpy()
+    np.testing.assert_array_equal(cls_t[0], [0, 3, 0])   # class 2 -> id 3
+    mask = loc_m.asnumpy().reshape(1, 3, 4)
+    np.testing.assert_array_equal(mask[0, 1], np.ones(4))
+    np.testing.assert_array_equal(mask[0, 0], np.zeros(4))
+    # matched anchor's encoded target recovers the gt when decoded
+    t = loc_t.asnumpy().reshape(1, 3, 4)[0, 1]
+    aw = ah = 0.5
+    acx = acy = 0.75
+    cx = t[0] * 0.1 * aw + acx
+    cy = t[1] * 0.1 * ah + acy
+    w = np.exp(t[2] * 0.2) * aw
+    h = np.exp(t[3] * 0.2) * ah
+    np.testing.assert_allclose([cx - w / 2, cy - h / 2, cx + w / 2,
+                                cy + h / 2],
+                               [0.52, 0.52, 0.98, 0.98], atol=1e-5)
+
+
+def test_multibox_detection_decodes_and_suppresses():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # class probs (B, C+1, N): anchor 0/1 strongly class 1, anchor 2 class 2
+    cls_prob = np.array([[[0.05, 0.1, 0.1],
+                          [0.9, 0.8, 0.1],
+                          [0.05, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchor 1 suppressed by anchor 0 (same class, IoU ~0.8)
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.8, 0.9], atol=1e-6)
+    cls_of_best = kept[np.argmax(kept[:, 1]), 0]
+    assert cls_of_best == 0.0                 # foreground class id 0
+
+
+def test_proposal_shapes_and_clip():
+    rng = np.random.RandomState(0)
+    b, a, h, w = 1, 6, 4, 4
+    cls_prob = rng.rand(b, 2 * a, h, w).astype(np.float32)
+    bbox_pred = (0.1 * rng.randn(b, 4 * a, h, w)).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, threshold=0.7,
+        rpn_min_size=4, scales=(2, 4), ratios=(0.5, 1.0, 2.0),
+        feature_stride=16).asnumpy()
+    assert rois.shape == (10, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all()
+    assert (rois[:, [1, 3]] <= 63).all() and (rois[:, [2, 4]] <= 63).all()
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 4, 7, 7).astype(np.float32)
+    wgt = rng.rand(5, 4, 3, 3).astype(np.float32)
+    bias = rng.rand(5).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out = mx.nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(wgt), nd.array(bias),
+        kernel=(3, 3), num_filter=5).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(wgt), nd.array(bias),
+                         kernel=(3, 3), num_filter=5).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_integer_offset_shifts():
+    """An integer offset of (0, +1) on every tap equals convolving the
+    input shifted left by one pixel."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    wgt = rng.rand(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    off[:, 1::2] = 1.0                         # x-offsets = +1
+    out = mx.nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(wgt), no_bias=True,
+        kernel=(3, 3), num_filter=3).asnumpy()
+    x_shift = np.zeros_like(x)
+    x_shift[:, :, :, :-1] = x[:, :, :, 1:]
+    ref = nd.Convolution(nd.array(x_shift), nd.array(wgt), no_bias=True,
+                         kernel=(3, 3), num_filter=3).asnumpy()
+    # rightmost output column touches the zero-padded shifted border
+    np.testing.assert_allclose(out[..., :-1], ref[..., :-1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_pooling_no_trans_uniform():
+    """Pooling a constant-per-channel map returns that constant in the
+    position-sensitive channel of each bin."""
+    c_out, g = 2, 2
+    data = np.zeros((1, c_out * g * g, 8, 8), np.float32)
+    for ch in range(c_out * g * g):
+        data[0, ch] = ch
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=c_out, group_size=g, pooled_size=2,
+        sample_per_part=2, no_trans=True).asnumpy()
+    assert out.shape == (1, c_out, 2, 2)
+    for phi in range(2):
+        for pwi in range(2):
+            chan0 = (phi * g + pwi) * c_out
+            np.testing.assert_allclose(out[0, :, phi, pwi],
+                                       [chan0, chan0 + 1], atol=1e-4)

@@ -1,0 +1,170 @@
+"""Pack an image folder into RecordIO (.rec/.idx/.lst).
+
+Parity target: tools/im2rec.py (393 LoC) — the two subcommands of the
+reference CLI, expressed the same way:
+
+  list mode:   python tools/im2rec.py PREFIX IMAGE_ROOT --list \
+                   [--recursive] [--train-ratio R] [--test-ratio R]
+  pack mode:   python tools/im2rec.py PREFIX IMAGE_ROOT \
+                   [--resize N] [--quality Q] [--num-thread T]
+
+List mode walks IMAGE_ROOT assigning one integer label per
+subdirectory (sorted), writing PREFIX.lst lines "idx\tlabel\tpath".
+Pack mode re-encodes every listed image (optionally resized so the
+short side is --resize) into PREFIX.rec with an index file PREFIX.idx.
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio
+
+try:
+    import cv2
+except ImportError:
+    cv2 = None
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive):
+    """Yield (relative_path, label) with one label per sorted subdir."""
+    if recursive:
+        label = 0
+        for current, dirs, files in sorted(os.walk(root)):
+            dirs.sort()
+            images = [f for f in sorted(files)
+                      if f.lower().endswith(_EXTS)]
+            if not images:
+                continue
+            for f in images:
+                rel = os.path.relpath(os.path.join(current, f), root)
+                yield rel, label
+            label += 1
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(_EXTS):
+                yield f, 0
+
+
+def write_list(prefix, image_label_pairs, train_ratio, test_ratio,
+               shuffle=True, seed=42):
+    pairs = list(image_label_pairs)
+    if shuffle:
+        random.Random(seed).shuffle(pairs)
+    n = len(pairs)
+    n_train = int(n * train_ratio)
+    n_test = int(n * test_ratio)
+    chunks = []
+    if test_ratio > 0:
+        chunks.append(("_test", pairs[:n_test]))
+    if train_ratio + test_ratio < 1.0:
+        chunks.append(("_val", pairs[n_test + n_train:]))
+    suffix = "_train" if chunks else ""
+    chunks.insert(0, (suffix, pairs[n_test:n_test + n_train]))
+    for suffix, chunk in chunks:
+        path = "%s%s.lst" % (prefix, suffix)
+        with open(path, "w") as f:
+            for i, (img, label) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, label, img))
+        print("wrote %s (%d entries)" % (path, len(chunk)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def encode_image(path, resize, quality, color, encoding):
+    if cv2 is None:
+        raise RuntimeError("pack mode requires cv2 (OpenCV)")
+    flag = {1: cv2.IMREAD_COLOR, 0: cv2.IMREAD_GRAYSCALE,
+            -1: cv2.IMREAD_UNCHANGED}[color]
+    img = cv2.imread(path, flag)
+    if img is None:
+        return None
+    if resize:
+        h, w = img.shape[:2]
+        if h > w:
+            size = (resize, int(h * resize / w))
+        else:
+            size = (int(w * resize / h), resize)
+        img = cv2.resize(img, size)
+    if encoding == ".png":
+        ok, buf = cv2.imencode(encoding, img)
+    else:
+        ok, buf = cv2.imencode(encoding, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+    return buf.tobytes() if ok else None
+
+
+def pack(args):
+    lst = args.prefix + ".lst"
+    if not os.path.exists(lst):
+        print("list file %s not found — run --list first" % lst,
+              file=sys.stderr)
+        return 1
+    record = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    count, start = 0, time.time()
+    for idx, label, rel in read_list(lst):
+        path = os.path.join(args.root, rel)
+        payload = encode_image(path, args.resize, args.quality,
+                               args.color, args.encoding)
+        if payload is None:
+            print("skipping unreadable image %s" % path, file=sys.stderr)
+            continue
+        header = recordio.IRHeader(0, label, idx, 0)
+        record.write_idx(idx, recordio.pack(header, payload))
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images in %.1fs" % (count,
+                                                 time.time() - start))
+    record.close()
+    print("wrote %s.rec / %s.idx (%d images)"
+          % (args.prefix, args.prefix, count))
+    return 0
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="create an image list / RecordIO pack",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("prefix", help="output prefix (and .lst location)")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create the .lst file instead of packing")
+    parser.add_argument("--recursive", action="store_true",
+                        help="label images by subdirectory")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0.0)
+    parser.add_argument("--no-shuffle", action="store_true")
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize the short edge to this many pixels")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--color", type=int, default=1,
+                        choices=(-1, 0, 1))
+    parser.add_argument("--encoding", type=str, default=".jpg",
+                        choices=(".jpg", ".png"))
+    args = parser.parse_args()
+
+    if args.list:
+        write_list(args.prefix, list_images(args.root, args.recursive),
+                   args.train_ratio, args.test_ratio,
+                   shuffle=not args.no_shuffle)
+        return 0
+    return pack(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
