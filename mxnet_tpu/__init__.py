@@ -17,6 +17,7 @@ from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import engine
+from . import storage
 from . import ops
 from . import ndarray
 from . import ndarray as nd

@@ -118,7 +118,7 @@ def _subgraph_free_inputs(subgraph, local_names):
 def sym_foreach(body, data, init_states, name=None):
     """Symbolic foreach (symbol/contrib.py:212): traces body into a
     subgraph and emits a `_foreach` node lowered onto lax.scan."""
-    name = name or _sym._auto_name("_foreach")
+    name = _sym._auto_name("_foreach", name)
     data_list = _as_list(data)
     states_list = _as_list(init_states)
     data_vars = [_sym.var("%s_data%d" % (name, i))
@@ -161,7 +161,7 @@ def sym_while_loop(cond, func, loop_vars, max_iterations=None, name=None):
     traced into subgraphs; emits `_while_loop` (masked lax.scan)."""
     if max_iterations is None:
         raise ValueError("max_iterations must be specified")
-    name = name or _sym._auto_name("_while_loop")
+    name = _sym._auto_name("_while_loop", name)
     vars_list = _as_list(loop_vars)
     var_vars = [_sym.var("%s_var%d" % (name, i))
                 for i in range(len(vars_list))]
@@ -201,7 +201,7 @@ def sym_while_loop(cond, func, loop_vars, max_iterations=None, name=None):
 def sym_cond(pred, then_func, else_func, name=None):
     """Symbolic cond (symbol/contrib.py:598): branches traced into
     subgraphs; emits `_cond` lowered onto lax.cond."""
-    name = name or _sym._auto_name("_cond")
+    name = _sym._auto_name("_cond", name)
     then_out = _as_list(then_func())
     else_out = _as_list(else_func())
     assert len(then_out) == len(else_out), \

@@ -14,8 +14,16 @@ engine-shaped on this stack:
     pool with FIFO ordering per key, mirroring FnProperty queues
     (include/mxnet/engine.h:95-112).
 
-`set_bulk_size` / NaiveEngine toggles are kept as API no-ops: op bulking is
-what XLA fusion + jit tracing do natively.
+`set_bulk_size` is kept as an API no-op: op bulking is what XLA fusion +
+jit tracing do natively. `MXNET_ENGINE_TYPE=NaiveEngine` IS honored: it
+makes every eager dispatch block until its outputs are materialized —
+the same synchronous, deterministic-ordering debug mode the reference's
+NaiveEngine provides (src/engine/naive_engine.cc). With
+`MXNET_ENFORCE_DETERMINISM=1` the RNG key chain is pinned to the
+partitionable threefry derivation so random streams are reproducible
+across process topologies (the TPU compute itself is already
+deterministic — there is no atomics-ordering nondeterminism to forbid,
+which is what the reference flag guards against in cuDNN).
 """
 
 import os
@@ -25,6 +33,41 @@ import threading
 import jax
 
 _BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+_ENGINE_TYPE = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+_ENFORCE_DETERMINISM = os.environ.get(
+    "MXNET_ENFORCE_DETERMINISM", "0").lower() not in ("0", "", "false")
+
+if _ENFORCE_DETERMINISM:  # pragma: no cover - env-dependent
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def engine_type():
+    return _ENGINE_TYPE
+
+
+def set_engine_type(name):
+    """Switch engines at runtime (reference: MXNET_ENGINE_TYPE is
+    read once at startup; runtime switching is a debugging convenience)."""
+    global _ENGINE_TYPE
+    prev = _ENGINE_TYPE
+    _ENGINE_TYPE = name
+    return prev
+
+
+def is_naive():
+    return _ENGINE_TYPE == "NaiveEngine"
+
+
+def enforce_determinism():
+    return _ENFORCE_DETERMINISM
+
+
+def sync_outputs(arrays):
+    """NaiveEngine semantics: the dispatch that produced `arrays` does
+    not return until they are materialized on device."""
+    for a in arrays:
+        if hasattr(a, "block_until_ready"):
+            a.block_until_ready()
 
 
 class _Worker(threading.Thread):

@@ -183,8 +183,7 @@ class Executor:
                            and n in self.grad_dict]
 
         self.outputs = []
-        self._cached_grads = None
-        self._saved_inputs = None
+        self._saved_vjp = None
 
         node_device = None
         if self._group2ctx:
@@ -220,36 +219,42 @@ class Executor:
             outs, _ = fwd_infer(arg_arrays, aux_arrays, key)
             return outs
 
-        def train_fn(diff_arrays, rest_arrays, aux_arrays, key, head_grads):
+        def fwd_res_fn(diff_arrays, rest_arrays, aux_arrays, key):
+            """Forward + pullback residuals. The returned vjp closure is a
+            jax.tree_util.Partial (a pytree of residual arrays), so it
+            crosses the jit boundary intact: backward() replays ONLY the
+            transposed computation — custom head gradients cost no second
+            forward (the reference executor also keeps fwd/bwd as two
+            engine segments, graph_executor.cc RunOps)."""
             def f(diff):
                 full = dict(rest_arrays)
                 full.update(dict(zip(diff_names, diff)))
                 outs, aux_up = fwd_train(full, aux_arrays, key)
                 return outs, aux_up
             outs, vjp, aux_up = jax.vjp(f, list(diff_arrays), has_aux=True)
-            heads = [h if h is not None else jnp.ones_like(o)
-                     for h, o in zip(head_grads, outs)]
-            (grads,) = vjp(type(outs)(heads) if isinstance(outs, (tuple, list))
-                           else heads[0])
-            return outs, aux_up, grads
+            return outs, aux_up, vjp
+
+        def bwd_fn(vjp, heads):
+            (grads,) = vjp(heads)
+            return grads
 
         if node_device is None:
-            # single-placement graphs compile to ONE XLA computation;
-            # placed (group2ctx) graphs run op-by-op so each segment can
-            # live on its own device with transfers at group boundaries
+            # single-placement graphs compile whole-program; placed
+            # (group2ctx) graphs run op-by-op so each segment can live on
+            # its own device with transfers at group boundaries
             infer_fn = jax.jit(infer_fn)
-            train_fn = jax.jit(train_fn)
+            fwd_res_fn = jax.jit(fwd_res_fn)
+            bwd_fn = jax.jit(bwd_fn)
         self._infer_fn = infer_fn
-        self._train_fn = train_fn
+        self._fwd_res_fn = fwd_res_fn
+        self._bwd_fn = bwd_fn
 
     # ------------------------------------------------------------ run ---
     def forward(self, is_train=False, **kwargs):
-        """is_train=True compiles+runs forward AND backward (with default
-        ones head-grads) as one fused XLA program — optimal for the standard
-        Module train step (forward → backward() with no custom heads). Use
-        is_train=False for pure inference: it runs the cheap forward-only
-        program. backward(out_grads=...) with custom heads re-runs the fused
-        program with those heads (costs one extra forward)."""
+        """is_train=True runs the forward program that also emits pullback
+        residuals; backward() then replays only the transposed computation
+        for whatever head gradients are supplied (defaults to ones). Use
+        is_train=False for pure inference — the residual-free program."""
         from . import ndarray as nd
         from . import random as rnd
         for k, v in kwargs.items():
@@ -264,39 +269,34 @@ class Executor:
         aux_arrays = {k: v._data for k, v in self.aux_dict.items()}
         key = rnd.next_key()
         if is_train:
-            self._saved_inputs = (arg_arrays, aux_arrays, key)
-            outs, aux_up, grads = self._run_train(arg_arrays, aux_arrays, key,
-                                                  [None] * len(self._symbol._outputs))
-            self._cached_grads = grads
+            diff = [arg_arrays[n] for n in self._diff_args]
+            rest = {k: v for k, v in arg_arrays.items()}
+            outs, aux_up, vjp = self._fwd_res_fn(diff, rest, aux_arrays,
+                                                 key)
+            self._saved_vjp = (vjp, outs)
             for name, val in aux_up.items():
                 self.aux_dict[name]._data = val
         else:
-            self._saved_inputs = None
-            self._cached_grads = None
+            self._saved_vjp = None
             outs = self._infer_fn(arg_arrays, aux_arrays, key)
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         return self.outputs
 
-    def _run_train(self, arg_arrays, aux_arrays, key, head_grads):
-        diff = [arg_arrays[n] for n in self._diff_args]
-        rest = {k: v for k, v in arg_arrays.items()}
-        outs, aux_up, grads = self._train_fn(diff, rest, aux_arrays, key,
-                                             head_grads)
-        return outs, aux_up, grads
-
     def backward(self, out_grads=None):
         from . import ndarray as nd
-        if self._saved_inputs is None:
+        if self._saved_vjp is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        if out_grads is not None:
+        vjp, outs = self._saved_vjp
+        if out_grads is None:
+            heads = [jnp.ones_like(o) for o in outs]
+        else:
             if isinstance(out_grads, nd.NDArray):
                 out_grads = [out_grads]
             heads = [g._data if isinstance(g, nd.NDArray) else jnp.asarray(g)
                      for g in out_grads]
-            arg_arrays, aux_arrays, key = self._saved_inputs
-            _, _, grads = self._run_train(arg_arrays, aux_arrays, key, heads)
-        else:
-            grads = self._cached_grads
+        cotangent = type(outs)(heads) if isinstance(outs, (tuple, list)) \
+            else heads[0]
+        grads = self._bwd_fn(vjp, cotangent)
         for name, g in zip(self._diff_args, grads):
             req = self._grad_req.get(name, "write")
             tgt = self.grad_dict[name]

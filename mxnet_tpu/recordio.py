@@ -4,11 +4,12 @@ Reference: python/mxnet/recordio.py:37,216,344 (MXRecordIO,
 MXIndexedRecordIO, IRHeader/pack/unpack) over dmlc-core's C++ recordio
 writer; src/io/image_recordio.h:110 (IRHeader layout).
 
-TPU-native: pure-Python implementation of the same on-disk format
-(kMagic-delimited, length+content, 4-byte aligned) so record files are
-interchangeable with reference tooling. The hot decode path for training
-runs through the C++ pipeline in src/ (see mxnet_tpu.io pipeline); this
-module is the format layer.
+TPU-native: this module owns the on-disk format (kMagic-delimited,
+length+content, 4-byte aligned) in Python so record files stay
+interchangeable with reference tooling; the hot paths — whole-file
+index scans and batched scatter reads — dispatch to the native
+library built from src/io/recordio_scan.cc (ctypes, GIL-released
+thread pool) with a pure-Python fallback.
 """
 
 import ctypes
@@ -145,6 +146,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
+        self._native_lengths = None
         if not self.writable and os.path.isfile(self.idx_path):
             with open(self.idx_path) as fin:
                 for line in fin.readlines():
@@ -180,6 +182,68 @@ class MXIndexedRecordIO(MXRecordIO):
         self.write(buf)
         self.keys.append(key)
         self.idx[key] = pos
+
+    def build_index(self, write=True):
+        """(Re)build the key -> offset table by scanning the .rec file —
+        covers files produced without an .idx sidecar. The scan runs in
+        the native library (src/io/recordio_scan.cc) when available,
+        falling back to a Python frame walk."""
+        from . import _native
+        scanned = _native.recordio_scan(self.uri)
+        if scanned is not None:
+            offsets = [int(o) for o in scanned[0]]
+        else:
+            offsets = []
+            with open(self.uri, "rb") as f:
+                pos = 0
+                while True:
+                    head = f.read(8)
+                    if len(head) < 8:
+                        break
+                    magic, lrec = struct.unpack("<II", head)
+                    if magic != _kMagic:
+                        raise RuntimeError(
+                            "Invalid record magic in %s" % self.uri)
+                    cflag, length = _decode_lrec(lrec)
+                    if cflag in (0, 1):       # logical record start
+                        offsets.append(pos)
+                    pos += 8 + length + (4 - length % 4) % 4
+                    f.seek(pos)
+        self.keys = [self.key_type(i) for i in range(len(offsets))]
+        self.idx = dict(zip(self.keys, offsets))
+        if write:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        return self.keys
+
+    def read_batch(self, indices, num_threads=4):
+        """Payloads of many records in one call. Uses the native
+        scatter-reader thread pool when available; otherwise sequential
+        read_idx calls."""
+        assert not self.writable, \
+            "read_batch requires read mode (close the writer and reopen)"
+        from . import _native
+        offsets = [self.idx[i] for i in indices]
+        length_of = getattr(self, "_native_lengths", None)
+        if length_of is None and _native.recordio_lib() is not None:
+            scanned = _native.recordio_scan(self.uri)
+            if scanned is not None:
+                off_arr, len_arr = scanned
+                length_of = dict(zip((int(o) for o in off_arr),
+                                     (int(n) for n in len_arr)))
+            self._native_lengths = length_of or {}
+        if length_of:
+            try:
+                lengths = [length_of[o] for o in offsets]
+            except KeyError:
+                lengths = None
+            if lengths is not None:
+                out = _native.recordio_read(self.uri, offsets, lengths,
+                                            num_threads)
+                if out is not None:
+                    return out
+        return [self.read_idx(i) for i in indices]
 
 
 # image record header (src/io/image_recordio.h:110 / recordio.py:344)

@@ -1,0 +1,94 @@
+"""Storage accounting.
+
+Reference: src/storage/ — the pooled allocator with per-device usage
+stats and profiler hooks (storage_manager.h, pooled_memory_storage).
+On TPU the PJRT runtime owns allocation (arena + BFC inside the
+runtime), so there is no user-space pool to manage; what this module
+keeps is the OBSERVABILITY the reference pool provided:
+
+  * `device_memory_stats()` — the runtime's live byte counters per
+    device (PJRT `memory_stats`, the analogue of the pool's used/peak).
+  * allocation tracking — opt-in (`start_tracking()`): every NDArray
+    constructed while tracking is counted per context, decremented on
+    collection, so leak hunts and per-phase footprints work like
+    MXNET_PROFILE_MEMORY did against the reference pool.
+"""
+
+import threading
+import weakref
+
+__all__ = ["device_memory_stats", "start_tracking", "stop_tracking",
+           "reset_stats", "summary"]
+
+_TRACKING = False
+_LOCK = threading.Lock()
+_LIVE = {}      # ctx str -> [count, bytes]
+_PEAK = {}      # ctx str -> peak bytes
+_TOTAL = {}     # ctx str -> cumulative alloc count
+
+
+def _note_alloc(arr):
+    try:
+        nbytes = arr._data.size * arr._data.dtype.itemsize
+    except Exception:
+        return
+    key = str(arr._ctx)
+    with _LOCK:
+        live = _LIVE.setdefault(key, [0, 0])
+        live[0] += 1
+        live[1] += nbytes
+        _PEAK[key] = max(_PEAK.get(key, 0), live[1])
+        _TOTAL[key] = _TOTAL.get(key, 0) + 1
+    weakref.finalize(arr, _note_free, key, nbytes)
+
+
+def _note_free(key, nbytes):
+    with _LOCK:
+        live = _LIVE.get(key)
+        if live:
+            live[0] -= 1
+            live[1] -= nbytes
+
+
+def start_tracking():
+    """Count NDArray allocations per context from this point on."""
+    global _TRACKING
+    _TRACKING = True
+
+
+def stop_tracking():
+    global _TRACKING
+    _TRACKING = False
+
+
+def reset_stats():
+    with _LOCK:
+        _LIVE.clear()
+        _PEAK.clear()
+        _TOTAL.clear()
+
+
+def summary():
+    """Tracked allocation stats: {ctx: {live, live_bytes, peak_bytes,
+    total_allocs}} (only NDArrays created while tracking)."""
+    with _LOCK:
+        return {
+            ctx: {"live": live[0], "live_bytes": live[1],
+                  "peak_bytes": _PEAK.get(ctx, 0),
+                  "total_allocs": _TOTAL.get(ctx, 0)}
+            for ctx, live in _LIVE.items()}
+
+
+def device_memory_stats(device=None):
+    """Per-device byte counters from the PJRT runtime (bytes_in_use,
+    peak_bytes_in_use, ... where the platform reports them)."""
+    import jax
+    devices = [device] if device is not None else jax.local_devices()
+    out = {}
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        out[str(dev)] = stats or {}
+    return out

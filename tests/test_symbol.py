@@ -98,6 +98,29 @@ def test_executor_train_backward():
                                (p - onehot).sum(0), rtol=1e-4, atol=1e-5)
 
 
+def test_executor_backward_custom_head_grads():
+    """backward(out_grads=...) replays only the cached pullback: scaled
+    heads give exactly scaled gradients, and repeated backward calls off
+    one forward are consistent (no forward recompute with fresh rng)."""
+    data = sym.var("data")
+    w = sym.var("w")
+    out = sym.FullyConnected(data, w, num_hidden=3, no_bias=True,
+                             name="fc")
+    ex = out.simple_bind(mx.cpu(), data=(4, 5), w=(3, 5))
+    rng = np.random.RandomState(1)
+    ex.arg_dict["data"][:] = rng.rand(4, 5)
+    ex.arg_dict["w"][:] = rng.rand(3, 5)
+    ex.forward(is_train=True)
+    heads = rng.rand(4, 3).astype(np.float32)
+    ex.backward(out_grads=[mx.nd.array(heads)])
+    g1 = ex.grad_dict["w"].asnumpy().copy()
+    ex.backward(out_grads=[mx.nd.array(2.0 * heads)])
+    g2 = ex.grad_dict["w"].asnumpy()
+    np.testing.assert_allclose(g2, 2.0 * g1, rtol=1e-5)
+    expect = heads.T @ ex.arg_dict["data"].asnumpy()
+    np.testing.assert_allclose(g1, expect, rtol=1e-4)
+
+
 def test_executor_batchnorm_aux_update():
     data = sym.var("data")
     bn = sym.BatchNorm(data=data, name="bn", momentum=0.5, fix_gamma=False)

@@ -18,6 +18,7 @@ machine without TPUs.
 
 import argparse
 import os
+import shlex
 import subprocess
 import sys
 
@@ -77,10 +78,11 @@ def launch_ssh(args, command):
     procs = []
     for i in range(args.num_workers):
         exports = " ".join(
-            "%s=%s" % (k, v) for k, v in worker_env(args, i, base={}).items())
+            "%s=%s" % (k, shlex.quote(v))
+            for k, v in worker_env(args, i, base={}).items())
         remote = "cd %s && env %s %s" % (
-            args.remote_cwd or "~", exports,
-            " ".join(command))
+            shlex.quote(args.remote_cwd) if args.remote_cwd else "~",
+            exports, " ".join(shlex.quote(c) for c in command))
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[i], remote]))
     code = 0
