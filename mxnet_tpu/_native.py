@@ -39,10 +39,18 @@ def _build(source_path, tag):
     out = os.path.join(_cache_dir(), "lib%s_%s.so" % (tag, digest))
     if os.path.exists(out):
         return out
+    # per-process tmp name: concurrent cold-cache builds (data-loader
+    # workers) must not interleave into one file; os.replace makes the
+    # last finished build win atomically
+    tmp = "%s.%d.tmp" % (out, os.getpid())
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           source_path, "-o", out + ".tmp"]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(out + ".tmp", out)
+           source_path, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
 
 

@@ -3,3 +3,6 @@
 from . import amp
 from . import onnx
 from . import quantization
+from . import svrg_optimization
+from . import tensorboard
+from . import text

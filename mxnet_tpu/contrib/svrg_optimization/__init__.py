@@ -1,0 +1,14 @@
+"""SVRG (stochastic variance-reduced gradient) training.
+
+API parity target: python/mxnet/contrib/svrg_optimization/ (SVRGModule
+driving an _SVRGOptimizer). Design divergence, documented: the reference
+smuggles the variance-reduction term through a wrapper optimizer and
+special kvstore keys; here the correction g(w) - g(w_snapshot) + mu is
+applied to the gradient arrays directly inside SVRGModule.update(), so
+any stock optimizer works unmodified and the update math is in one
+place.
+"""
+
+from .svrg_module import SVRGModule
+
+__all__ = ["SVRGModule"]

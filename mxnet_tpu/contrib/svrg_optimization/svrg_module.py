@@ -1,0 +1,155 @@
+"""SVRGModule — Module with stochastic variance-reduced gradients.
+
+Reference behavior (contrib/svrg_optimization/svrg_module.py): every
+`update_freq` epochs, snapshot the parameters and accumulate the full-
+dataset gradient mu at the snapshot; each step then updates with
+    g_i(w) - g_i(w_s) + mu
+which is unbiased with variance shrinking as w approaches w_s
+(Johnson & Zhang, 2013).
+
+TPU-native mechanics: a shadow Module bound to the same symbol holds
+the snapshot weights; per step it replays the batch to get g_i(w_s) as
+one extra compiled forward+backward, and the correction is applied to
+the primary module's gradient arrays before the optimizer runs.
+"""
+
+from ... import ndarray as nd
+from ...module import Module
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 update_freq=2, **kwargs):
+        import logging
+        logger = logger or logging
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if update_freq < 1:
+            raise ValueError("update_freq must be at least 1")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._full_grads = None        # name -> NDArray (mu)
+        self._cur_batch = None
+
+    # ------------------------------------------------------- lifecycle --
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, None,
+                               grad_req)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        self._take_snapshot()
+
+    def _take_snapshot(self):
+        args, auxs = self.get_params()
+        self._mod_aux.init_params(
+            initializer=None,
+            arg_params={k: v.copy() for k, v in args.items()},
+            aux_params={k: v.copy() for k, v in auxs.items()},
+            allow_missing=False, force_init=True)
+
+    # ----------------------------------------------------------- steps --
+    def forward_backward(self, data_batch):
+        self._cur_batch = data_batch
+        super().forward_backward(data_batch)
+
+    def update_full_grads(self, train_data):
+        """Accumulate mu = (1/N) sum_i g_i(w_s) over the whole dataset at
+        the current snapshot, and refresh the snapshot first."""
+        self._take_snapshot()
+        train_data.reset()
+        totals = {}
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            for name, grad in zip(self._grad_names(self._mod_aux),
+                                  self._grad_arrays(self._mod_aux)):
+                if grad is None:
+                    continue
+                if name in totals:
+                    totals[name] += grad
+                else:
+                    totals[name] = grad.copy()
+            nbatch += 1
+        train_data.reset()
+        if nbatch:
+            self._full_grads = {k: v / float(nbatch)
+                                for k, v in totals.items()}
+
+    @staticmethod
+    def _grad_names(mod):
+        return mod._symbol.list_arguments()
+
+    @staticmethod
+    def _grad_arrays(mod):
+        return mod._exec.grad_arrays
+
+    def update(self):
+        """Apply the variance-reduction correction, then the optimizer."""
+        if self._full_grads is not None and self._cur_batch is not None:
+            self._mod_aux.forward_backward(self._cur_batch)
+            aux_grads = dict(zip(self._grad_names(self._mod_aux),
+                                 self._grad_arrays(self._mod_aux)))
+            for name, grad in zip(self._grad_names(self),
+                                  self._grad_arrays(self)):
+                snap_g = aux_grads.get(name)
+                mu = self._full_grads.get(name)
+                if grad is None or snap_g is None or mu is None:
+                    continue
+                grad[:] = grad - snap_g + mu
+        super().update()
+
+    # -------------------------------------------------------------- fit --
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """The base fit loop with the SVRG schedule: refresh the snapshot
+        + full gradient every `update_freq` epochs."""
+        from ... import metric as mx_metric
+        from ... import initializer as init_mod
+        assert num_epoch is not None, "please specify number of epochs"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, mx_metric.EvalMetric):
+            eval_metric = mx_metric.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            self._run_epoch(train_data, eval_metric, epoch, monitor,
+                            batch_end_callback, sparse_row_id_fn)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if eval_data is not None:
+                res = self.score(eval_data,
+                                 validation_metric or eval_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+            train_data.reset()

@@ -1,0 +1,59 @@
+"""TensorBoard logging callback.
+
+API parity target: python/mxnet/contrib/tensorboard.py
+(LogMetricsCallback). The writer dependency is optional: any object
+with an `add_scalar(tag, value, global_step)` method works (tensorboardX
+/ torch.utils.tensorboard SummaryWriter, or the bundled _TsvWriter
+fallback that appends tag\tstep\tvalue lines so runs are inspectable
+without any tensorboard install).
+"""
+
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class _TsvWriter(object):
+    """Dependency-free fallback writer: one .tsv per run directory."""
+
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._path = os.path.join(logging_dir,
+                                  "scalars_%d.tsv" % int(time.time()))
+
+    def add_scalar(self, tag, value, global_step=None):
+        with open(self._path, "a") as f:
+            f.write("%s\t%s\t%r\n" % (tag, global_step, value))
+
+    def flush(self):
+        pass
+
+
+def _make_writer(logging_dir):
+    for mod, attr in (("torch.utils.tensorboard", "SummaryWriter"),
+                      ("tensorboardX", "SummaryWriter")):
+        try:
+            module = __import__(mod, fromlist=[attr])
+            return getattr(module, attr)(logging_dir)
+        except Exception:
+            continue
+    return _TsvWriter(logging_dir)
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback streaming eval metrics to TensorBoard."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

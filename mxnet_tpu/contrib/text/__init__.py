@@ -1,0 +1,14 @@
+"""Text utilities: vocabulary + token embeddings.
+
+API parity target: python/mxnet/contrib/text/ (vocab.Vocabulary,
+embedding.TokenEmbedding/CustomEmbedding/CompositeEmbedding + registry,
+utils.count_tokens_from_str). Pretrained-archive auto-download is out of
+scope in this offline environment: loaders work from local files.
+"""
+
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -1,0 +1,199 @@
+"""Token embeddings backed by device NDArray matrices.
+
+API parity target: python/mxnet/contrib/text/embedding.py
+(TokenEmbedding with registry, GloVe/FastText file loaders,
+CustomEmbedding, CompositeEmbedding, get_pretrained_file_names). The
+archive auto-download machinery is replaced by explicit local file
+paths (this environment is offline); file formats are identical, so
+any downloaded GloVe/fastText .txt/.vec file loads unchanged.
+"""
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "list_embedding_names", "TokenEmbedding",
+           "GloVe", "FastText", "CustomEmbedding", "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(embedding_name, **kwargs):
+    klass = _REGISTRY.get(embedding_name.lower())
+    if klass is None:
+        raise KeyError(
+            "embedding %r is not registered (have: %s)"
+            % (embedding_name, sorted(_REGISTRY)))
+    return klass(**kwargs)
+
+
+def list_embedding_names():
+    return sorted(_REGISTRY)
+
+
+class TokenEmbedding(object):
+    """idx <-> token <-> vector store over one (V, D) device matrix."""
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=nd.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None        # NDArray (V, D)
+
+    # ------------------------------------------------------- properties --
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    # ---------------------------------------------------------- loading --
+    def _load_embedding_file(self, path, elem_delim=" ", encoding="utf8"):
+        """Parse a GloVe/fastText-format text file: `token v0 v1 ...`."""
+        tokens = []
+        vectors = []
+        vec_len = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue           # fastText header "count dim"
+                token, elems = parts[0], parts[1:]
+                if not elems:
+                    continue
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    logging.warning(
+                        "skipping token %r with vector length %d != %d",
+                        token, len(elems), vec_len)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                tokens.append(token)
+                vectors.append(np.asarray(elems, np.float32))
+        if vec_len is None:
+            raise ValueError("no vectors found in %s" % path)
+        matrix = np.empty((1 + len(tokens), vec_len), np.float32)
+        matrix[0] = self._init_unknown_vec(shape=(vec_len,)).asnumpy()
+        for i, vec in enumerate(vectors, start=1):
+            matrix[i] = vec
+        for i, token in enumerate(tokens, start=1):
+            self._token_to_idx[token] = i
+            self._idx_to_token.append(token)
+        self._idx_to_vec = nd.array(matrix)
+
+    # ----------------------------------------------------------- lookup --
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idx.append(self._token_to_idx[t])
+            elif lower_case_backup:
+                idx.append(self._token_to_idx.get(t.lower(), 0))
+            else:
+                idx.append(0)
+        vecs = self._idx_to_vec[nd.array(idx, dtype="int32")]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        if new_vectors.ndim == 1:
+            new_vectors = new_vectors.reshape((1, -1))
+        for token, vec in zip(tokens, new_vectors):
+            if token not in self._token_to_idx:
+                raise ValueError(
+                    "token %r is not indexed in this embedding" % token)
+            self._idx_to_vec[self._token_to_idx[token]] = vec
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors loaded from a local `glove.*.txt` file."""
+
+    def __init__(self, pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            raise ValueError(
+                "offline environment: pass pretrained_file_path to a "
+                "local glove .txt file")
+        self._load_embedding_file(pretrained_file_path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText vectors loaded from a local `.vec` file."""
+
+    def __init__(self, pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            raise ValueError(
+                "offline environment: pass pretrained_file_path to a "
+                "local fastText .vec file")
+        self._load_embedding_file(pretrained_file_path)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Any `token v0 v1 ...` formatted file."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path, elem_delim,
+                                  encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings' vectors over one vocabulary."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise TypeError("vocabulary must be a Vocabulary")
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._vocabulary = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        pieces = [emb.get_vecs_by_tokens(self._idx_to_token)
+                  for emb in token_embeddings]
+        self._idx_to_vec = nd.concat(*pieces, dim=1) if len(pieces) > 1 \
+            else pieces[0]
+
+    @property
+    def vocabulary(self):
+        return self._vocabulary

@@ -1,0 +1,20 @@
+"""Tokenization helpers (contrib/text/utils.py parity)."""
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count whitespace/delimiter-separated tokens into a Counter."""
+    source_str = filter(None,
+                        re.split(token_delim + "|" + seq_delim, source_str))
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    if to_lower:
+        counter.update(token.lower() for token in source_str)
+    else:
+        counter.update(source_str)
+    return counter

@@ -1,0 +1,80 @@
+"""Vocabulary — token <-> index mapping built from a Counter.
+
+API parity target: python/mxnet/contrib/text/vocab.py. Indexing layout
+matches the reference: the unknown token occupies index 0, reserved
+tokens follow, then counted tokens by descending frequency (ties broken
+lexically).
+"""
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary(object):
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be at least 1")
+        if reserved_tokens is not None:
+            seen = set(reserved_tokens)
+            if len(seen) != len(reserved_tokens) or unknown_token in seen:
+                raise ValueError(
+                    "reserved tokens must be unique and exclude the "
+                    "unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) \
+            if reserved_tokens else None
+        self._idx_to_token = [unknown_token] + \
+            (list(reserved_tokens) if reserved_tokens else [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        ranked = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = None if most_freq_count is None \
+            else most_freq_count - len(self._idx_to_token)
+        for token, freq in ranked:
+            if freq < min_freq or (budget is not None and budget <= 0):
+                break
+            if token in self._token_to_idx:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            if budget is not None:
+                budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index (or list); unknown -> 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("token index %d out of range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -188,6 +188,9 @@ class MXIndexedRecordIO(MXRecordIO):
         covers files produced without an .idx sidecar. The scan runs in
         the native library (src/io/recordio_scan.cc) when available,
         falling back to a Python frame walk."""
+        assert not self.writable, \
+            "build_index requires read mode (close the writer first: its " \
+            "buffered tail would be missing from the scan)"
         from . import _native
         scanned = _native.recordio_scan(self.uri)
         if scanned is not None:

@@ -25,6 +25,7 @@ _LOCK = threading.Lock()
 _LIVE = {}      # ctx str -> [count, bytes]
 _PEAK = {}      # ctx str -> peak bytes
 _TOTAL = {}     # ctx str -> cumulative alloc count
+_EPOCH = 0      # bumped by reset_stats; stale finalizers are ignored
 
 
 def _note_alloc(arr):
@@ -39,11 +40,14 @@ def _note_alloc(arr):
         live[1] += nbytes
         _PEAK[key] = max(_PEAK.get(key, 0), live[1])
         _TOTAL[key] = _TOTAL.get(key, 0) + 1
-    weakref.finalize(arr, _note_free, key, nbytes)
+        epoch = _EPOCH
+    weakref.finalize(arr, _note_free, key, nbytes, epoch)
 
 
-def _note_free(key, nbytes):
+def _note_free(key, nbytes, epoch):
     with _LOCK:
+        if epoch != _EPOCH:
+            return      # counters were reset after this allocation
         live = _LIVE.get(key)
         if live:
             live[0] -= 1
@@ -62,7 +66,9 @@ def stop_tracking():
 
 
 def reset_stats():
+    global _EPOCH
     with _LOCK:
+        _EPOCH += 1
         _LIVE.clear()
         _PEAK.clear()
         _TOTAL.clear()

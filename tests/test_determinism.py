@@ -1,0 +1,67 @@
+"""Determinism oracle: cross-backend consistency + bitwise replay.
+
+Reference counterparts: NaiveEngine + MXNET_ENFORCE_DETERMINISM
+(docs/faq/env_var.md) and the CPU-vs-GPU check_consistency harness
+(python/mxnet/test_utils.py). On this stack the oracle is CPU-eager vs
+compiled-backend: check_consistency appends the TPU context whenever a
+real chip is attached, so the same test doubles as the
+interpreter-vs-TPU comparison on hardware.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, test_utils
+
+
+def test_check_consistency_conv_bn_stack():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="c1")
+    net = sym.BatchNorm(net, fix_gamma=False, name="b1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.FullyConnected(net, num_hidden=5, name="f1")
+    from mxnet_tpu import context
+    ctxs = [mx.cpu()]
+    if context.num_tpus():
+        ctxs.append(context.tpu())
+    test_utils.check_consistency(
+        net, ctx_list=[{"ctx": c, "data": (2, 3, 8, 8)} for c in ctxs],
+        scale=0.1, rtol=1e-3, atol=1e-4)
+
+
+def test_seeded_training_replays_bitwise():
+    """Same seed -> bitwise-identical params after a dropout-bearing
+    train loop, run twice (the MXNET_ENFORCE_DETERMINISM guarantee)."""
+
+    def run():
+        mx.random.seed(77)
+        from mxnet_tpu import gluon, autograd
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dropout(0.5),
+                gluon.nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.rand(8, 6).astype(np.float32))
+        y = nd.array(rng.randint(0, 4, (8,)).astype(np.int32))
+        lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+        for _ in range(5):
+            with autograd.record():
+                loss = lossfn(net(x), y).mean()
+            loss.backward()
+            trainer.step(1)
+        # parameter names carry global layer counters that differ between
+        # runs; the values (in declaration order) are what must replay
+        return [v.data().asnumpy()
+                for v in net.collect_params().values()]
+
+    first = run()
+    second = run()
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)

@@ -69,6 +69,16 @@ def launch_local(args, command):
     return code
 
 
+def _quote_path(token):
+    """shlex.quote, but keep a leading ~/ outside the quotes so the
+    remote shell still expands the home directory."""
+    if token == "~":
+        return token
+    if token.startswith("~/"):
+        return "~/" + shlex.quote(token[2:])
+    return shlex.quote(token)
+
+
 def launch_ssh(args, command):
     hosts = parse_hostfile(args.hostfile)
     if len(hosts) < args.num_workers:
@@ -81,8 +91,8 @@ def launch_ssh(args, command):
             "%s=%s" % (k, shlex.quote(v))
             for k, v in worker_env(args, i, base={}).items())
         remote = "cd %s && env %s %s" % (
-            shlex.quote(args.remote_cwd) if args.remote_cwd else "~",
-            exports, " ".join(shlex.quote(c) for c in command))
+            _quote_path(args.remote_cwd) if args.remote_cwd else "~",
+            exports, " ".join(_quote_path(c) for c in command))
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[i], remote]))
     code = 0
