@@ -111,45 +111,10 @@ class SVRGModule(Module):
                 grad[:] = grad - snap_g + mu
         super().update()
 
-    # -------------------------------------------------------------- fit --
-    def fit(self, train_data, eval_data=None, eval_metric="acc",
-            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
-            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
-            eval_end_callback=None, eval_batch_end_callback=None,
-            initializer=None, arg_params=None, aux_params=None,
-            allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The base fit loop with the SVRG schedule: refresh the snapshot
-        + full gradient every `update_freq` epochs."""
-        from ... import metric as mx_metric
-        from ... import initializer as init_mod
-        assert num_epoch is not None, "please specify number of epochs"
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
-        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
-                         arg_params=arg_params, aux_params=aux_params,
-                         allow_missing=allow_missing, force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
-        if not isinstance(eval_metric, mx_metric.EvalMetric):
-            eval_metric = mx_metric.create(eval_metric)
-        for epoch in range(begin_epoch, num_epoch):
-            if (epoch - begin_epoch) % self.update_freq == 0:
-                self.update_full_grads(train_data)
-            eval_metric.reset()
-            self._run_epoch(train_data, eval_metric, epoch, monitor,
-                            batch_end_callback, sparse_row_id_fn)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            if eval_data is not None:
-                res = self.score(eval_data,
-                                 validation_metric or eval_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
+    def _prepare_epoch(self, epoch_offset, train_data):
+        """SVRG schedule hook into the base fit loop: refresh the
+        snapshot + full gradient every `update_freq` epochs. All other
+        fit behavior (callbacks, checkpoints, monitors, eval) is the
+        inherited loop."""
+        if epoch_offset % self.update_freq == 0:
+            self.update_full_grads(train_data)

@@ -81,6 +81,7 @@ class TokenEmbedding(object):
         """Parse a GloVe/fastText-format text file: `token v0 v1 ...`."""
         tokens = []
         vectors = []
+        seen = set()
         vec_len = None
         with io.open(path, "r", encoding=encoding) as f:
             for line_num, line in enumerate(f):
@@ -98,8 +99,11 @@ class TokenEmbedding(object):
                         "skipping token %r with vector length %d != %d",
                         token, len(elems), vec_len)
                     continue
-                if token in self._token_to_idx:
+                if token in self._token_to_idx or token in seen:
+                    logging.warning(
+                        "skipping duplicated token %r in %s", token, path)
                     continue
+                seen.add(token)
                 tokens.append(token)
                 vectors.append(np.asarray(elems, np.float32))
         if vec_len is None:

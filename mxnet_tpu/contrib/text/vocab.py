@@ -31,8 +31,9 @@ class Vocabulary(object):
 
     def _index_counter(self, counter, most_freq_count, min_freq):
         ranked = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
-        budget = None if most_freq_count is None \
-            else most_freq_count - len(self._idx_to_token)
+        # most_freq_count bounds the COUNTED tokens only — unknown and
+        # reserved tokens are not charged against it (reference contract)
+        budget = None if most_freq_count is None else most_freq_count
         for token, freq in ranked:
             if freq < min_freq or (budget is not None and budget <= 0):
                 break

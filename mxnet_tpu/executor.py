@@ -118,7 +118,7 @@ def build_graph_fn(symbol, is_train, node_device=None):
                     call.update({n: a for n, a in zip(pnames, ins)})
                 out = op.fn(**call)
 
-            if node.op == "BatchNorm":
+            if node.op in ("BatchNorm", "_contrib_SyncBatchNorm"):
                 # fold running-stat update (reference mutates aux in-place,
                 # src/operator/nn/batch_norm.cc; we return new values)
                 y, mean, var = out

@@ -158,6 +158,7 @@ class BaseModule(object):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            self._prepare_epoch(epoch - begin_epoch, train_data)
             self._run_epoch(train_data, eval_metric, epoch, monitor,
                             batch_end_callback, sparse_row_id_fn)
             for name, val in eval_metric.get_name_value():
@@ -181,6 +182,10 @@ class BaseModule(object):
                                      name, val)
 
             train_data.reset()
+
+    def _prepare_epoch(self, epoch_offset, train_data):
+        """Hook before each training epoch (e.g. SVRG full-gradient
+        refresh); default no-op."""
 
     def _run_epoch(self, train_data, eval_metric, epoch, monitor,
                    batch_end_callback, sparse_row_id_fn):

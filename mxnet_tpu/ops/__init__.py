@@ -108,6 +108,7 @@ from . import random_ops  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import contrib_ops  # noqa: E402,F401
 from . import vision_ops  # noqa: E402,F401
+from . import optimizer_ops  # noqa: E402,F401
 from . import image_ops  # noqa: E402,F401
 from . import control_flow_ops  # noqa: E402,F401
 from . import quantization_ops  # noqa: E402,F401

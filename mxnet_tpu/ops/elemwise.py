@@ -140,6 +140,16 @@ for _n, _f in _UNARY:
     _mku()
 
 
+@register(name="smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """src/operator/tensor/elemwise_binary_scalar_op_extended.cc —
+    f(x) = 0.5 (sx)^2 for |x| < 1/s^2, |x| - 0.5/s^2 otherwise."""
+    sigma2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / sigma2,
+                     0.5 * sigma2 * data * data,
+                     jnp.abs(data) - 0.5 / sigma2)
+
+
 @register(name="softrelu")
 def softrelu(data):
     return _softrelu(data)

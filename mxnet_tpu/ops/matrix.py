@@ -260,12 +260,22 @@ def where(condition, x, y):
 
 @register(name="boolean_mask_dense")
 def boolean_mask_dense(data, mask):
-    """contrib boolean_mask (src/operator/contrib/boolean_mask.cc) has a
-    data-dependent output shape — impossible under XLA static shapes. The
-    dense variant zeroes masked-out rows and keeps shape; callers needing
-    compaction use nd.contrib.boolean_mask which falls back to host."""
+    """Static-shape companion of contrib boolean_mask: zeroes masked-out
+    rows and keeps the input shape (usable under jit, unlike the
+    compacted variant below)."""
     m = (mask != 0).astype(data.dtype)
     return data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+
+
+@register(name="_contrib_boolean_mask", differentiable=False)
+def boolean_mask(data, index, axis=0):
+    """contrib boolean_mask (src/operator/contrib/boolean_mask.cc):
+    compacted rows where index != 0. The output shape depends on the
+    DATA, so this op is eager-only — inside jit/symbolic tracing jax
+    raises a concretization error (use boolean_mask_dense there)."""
+    keep = jnp.asarray(index) != 0
+    idx = jnp.nonzero(keep)[0]          # data-dependent: eager only
+    return jnp.take(data, idx, axis=axis)
 
 
 # ------------------------------------------------------------- ordering --

@@ -264,6 +264,27 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out.astype(data.dtype), mean, var
 
 
+@register(name="_contrib_SyncBatchNorm", num_outputs=3)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key="", is_train=False):
+    """src/operator/contrib/sync_batch_norm.cc — cross-device BN.
+
+    TPU-native: under GSPMD the batch axis is a global array dimension,
+    so BatchNorm's reduction already spans every device (XLA inserts the
+    psum over the data-parallel axis). The op therefore shares the
+    BatchNorm kernel — including its (out, mean, var) contract so the
+    executor folds the running-stat update identically. ndev/key are
+    accepted for signature parity; the engine-barrier machinery they
+    configured has no analogue here.
+    """
+    return batch_norm(
+        data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats,
+        output_mean_var=output_mean_var, is_train=is_train)
+
+
 @register(name="LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """src/operator/nn/layer_norm.cc."""
@@ -771,6 +792,74 @@ _maereg_core = _make_regression_output(
 _logreg_core = _make_regression_output(
     jax.nn.sigmoid,
     lambda d, l: jax.nn.sigmoid(d) - l.reshape(d.shape))
+
+
+# SVM head (src/operator/svm_output.cc): identity forward; backward is
+# the multiclass hinge gradient (L2-SVM by default, L1 with use_linear).
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fvjp(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bvjp(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    lab = label.reshape(-1).astype(jnp.int32)
+    x_y = jnp.take_along_axis(data, lab[:, None], axis=1)
+    z = margin - x_y + data                      # (N, C); z at y == margin
+    onehot = jax.nn.one_hot(lab, data.shape[1], dtype=data.dtype)
+    if use_linear:
+        viol = ((z > 0) & (onehot == 0)).astype(data.dtype)
+    else:
+        viol = jnp.where(onehot == 0, 2.0 * jnp.maximum(z, 0.0), 0.0)
+    grad = reg_coef * (viol - onehot * viol.sum(axis=1, keepdims=True))
+    return grad * jnp.ones_like(g), jnp.zeros_like(label)
+
+
+_svm_core.defvjp(_svm_fvjp, _svm_bvjp)
+
+
+@register(name="SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """src/operator/svm_output.cc — SVM loss head."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
+
+
+# KL sparsity regularizer (src/operator/identity_attach_KL_sparse_reg.cc):
+# identity forward; backward adds the KL(ρ||ρ̂) gradient pushing each
+# unit's batch-mean activation toward sparseness_target. The reference
+# keeps ρ̂ as a momentum-smoothed aux state; here ρ̂ is the batch mean
+# (momentum accepted for signature parity).
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _kl_sparse_core(data, sparseness_target, penalty):
+    return data
+
+
+def _kl_fvjp(data, sparseness_target, penalty):
+    return data, data
+
+
+def _kl_bvjp(sparseness_target, penalty, data, g):
+    rho_hat = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6,
+                       1.0 - 1e-6)
+    t = sparseness_target
+    kl_grad = penalty * (-t / rho_hat + (1.0 - t) / (1.0 - rho_hat))
+    return (g + kl_grad * jnp.ones_like(data) / data.shape[0],)
+
+
+_kl_sparse_core.defvjp(_kl_fvjp, _kl_bvjp)
+
+
+@register(name="IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """src/operator/identity_attach_KL_sparse_reg.cc."""
+    return _kl_sparse_core(data, float(sparseness_target), float(penalty))
 
 
 @register(name="LinearRegressionOutput")

@@ -1,0 +1,234 @@
+"""Optimizer update operators.
+
+Reference: src/operator/optimizer_op.cc (sgd/adam/rmsprop/ftrl/ftml/
+signsgd/signum/nag + the fused multi-weight and multi-precision
+variants) and src/operator/contrib/adamw.cc. These expose the update
+math as callable ops (nd.sgd_update(w, g, out=w, ...)) the way the
+reference does; the Optimizer classes in optimizer.py use the same
+formulas through their own jit-fused helpers.
+
+State semantics follow the reference's FMutateInputs contract: state
+inputs (mom/mean/var/...) are updated IN PLACE by the dispatcher
+(mutate_inputs), and the op's only declared output is the new weight —
+so `nd.sgd_mom_update(w, g, mom, out=w, ...)` leaves both w and mom
+advanced, exactly like the reference kernels.
+"""
+
+import jax.numpy as jnp
+
+from . import register
+
+
+def _rescaled(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+# --------------------------------------------------------------- plain --
+@register(name="sgd_update", differentiable=False)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register(name="sgd_mom_update", differentiable=False,
+          mutate_inputs=("mom",))
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * (g + wd * weight)
+    return weight + mom, mom
+
+
+@register(name="nag_mom_update", differentiable=False,
+          mutate_inputs=("mom",))
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    mom = momentum * mom + g
+    return weight - lr * (momentum * mom + g), mom
+
+
+@register(name="signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register(name="signum_update", differentiable=False,
+          mutate_inputs=("mom",))
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - (1.0 - momentum) * (g + wd * weight)
+    w = weight - lr * jnp.sign(-mom)
+    if wd_lh > 0:
+        w = w - lr * wd_lh * weight
+    return w, mom
+
+
+@register(name="adam_update", differentiable=False,
+          mutate_inputs=("mean", "var"))
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * g * g
+    return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
+
+
+@register(name="_contrib_adamw_update", differentiable=False,
+          aliases=("_contrib_mp_adamw_update", "adamw_update"),
+          mutate_inputs=("mean", "var"))
+def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """contrib/adamw.cc — decoupled weight decay; rescale_grad arrives as
+    a tensor (the AMP loss-scale), eta is the schedule multiplier."""
+    scale = rescale_grad if rescale_grad is not None else 1.0
+    g = grad * scale
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * g * g
+    step = lr * mean / (jnp.sqrt(var) + epsilon) + lr * wd * weight
+    return weight - eta * step, mean, var
+
+
+@register(name="rmsprop_update", differentiable=False,
+          mutate_inputs=("n",))
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    n = gamma1 * n + (1.0 - gamma1) * g * g
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register(name="rmspropalex_update", differentiable=False,
+          mutate_inputs=("n", "g", "delta"))
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    n = gamma1 * n + (1.0 - gamma1) * gr * gr
+    g = gamma1 * g + (1.0 - gamma1) * gr
+    delta = gamma2 * delta - lr * gr / jnp.sqrt(n - g * g + epsilon)
+    w = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g, delta
+
+
+@register(name="ftrl_update", differentiable=False,
+          mutate_inputs=("z", "n"))
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * weight
+    n = n + g * g
+    w = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) /
+        ((beta + jnp.sqrt(n)) / lr + wd),
+        0.0)
+    return w, z, n
+
+
+@register(name="ftml_update", differentiable=False,
+          mutate_inputs=("d", "v", "z"))
+def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                t=1):
+    g = _rescaled(grad, rescale_grad, clip_grad) + wd * weight
+    v = beta2 * v + (1.0 - beta2) * g * g
+    d_t = (1.0 - beta1 ** t) / lr * \
+        (jnp.sqrt(v / (1.0 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    return -z / d_t, d_t, v, z
+
+
+# --------------------------------------------- fused multi-weight SGD --
+def _multi_sgd(arrays, num_weights, lrs, wds, momentum, rescale_grad,
+               clip_gradient, has_mom):
+    """Shared driver: `arrays` is the reference's interleaved layout
+    [w0, g0, (m0,)? w1, g1, (m1,)? ...]."""
+    stride = 3 if has_mom else 2
+    assert len(arrays) == stride * num_weights, \
+        "expected %d arrays for %d weights" % (stride * num_weights,
+                                               num_weights)
+    new_weights = []
+    new_moms = []
+    for i in range(num_weights):
+        w = arrays[i * stride]
+        g = _rescaled(arrays[i * stride + 1], rescale_grad, clip_gradient)
+        if has_mom:
+            mom = momentum * arrays[i * stride + 2] - \
+                lrs[i] * (g + wds[i] * w)
+            new_weights.append(w + mom)
+            new_moms.append(mom)
+        else:
+            new_weights.append(w - lrs[i] * (g + wds[i] * w))
+    return new_weights + new_moms
+
+
+def _parse_list(value, n):
+    import ast
+    if isinstance(value, str):
+        value = ast.literal_eval(value)
+    if not isinstance(value, (list, tuple)):
+        value = (value,) * n
+    return [float(v) for v in value]
+
+
+@register(name="multi_sgd_update", differentiable=False,
+          num_outputs="n")
+def multi_sgd_update(*arrays, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    return _multi_sgd(list(arrays), num_weights,
+                      _parse_list(lrs, num_weights),
+                      _parse_list(wds, num_weights),
+                      0.0, rescale_grad, clip_gradient, has_mom=False)
+
+
+@register(name="multi_sgd_mom_update", differentiable=False,
+          num_outputs="n")
+def multi_sgd_mom_update(*arrays, lrs=(0.01,), wds=(0.0,), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    """Returns the updated weights followed by the updated momenta (the
+    reference mutates the momentum inputs; callers here re-bind both)."""
+    return _multi_sgd(list(arrays), num_weights,
+                      _parse_list(lrs, num_weights),
+                      _parse_list(wds, num_weights),
+                      momentum, rescale_grad, clip_gradient, has_mom=True)
+
+
+@register(name="preloaded_multi_sgd_update", differentiable=False,
+          num_outputs="n")
+def preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
+                               clip_gradient=-1.0, num_weights=1):
+    """Like multi_sgd_update but lrs/wds arrive as the trailing two
+    device arrays (the reference preloads them to avoid host sync)."""
+    lrs, wds = arrays[-2], arrays[-1]   # stay on device (traced scalars)
+    return _multi_sgd(list(arrays[:-2]), num_weights, lrs, wds, 0.0,
+                      rescale_grad, clip_gradient, has_mom=False)
+
+
+@register(name="preloaded_multi_sgd_mom_update", differentiable=False,
+          num_outputs="n")
+def preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1):
+    lrs, wds = arrays[-2], arrays[-1]   # stay on device (traced scalars)
+    return _multi_sgd(list(arrays[:-2]), num_weights, lrs, wds, momentum,
+                      rescale_grad, clip_gradient, has_mom=True)

@@ -380,6 +380,27 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     return out
 
 
+@register(name="_contrib_MultiProposal", aliases=("MultiProposal",),
+          differentiable=False)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """contrib/multi_proposal.cc — batched Proposal. The Proposal op here
+    already vmaps over the batch, so MultiProposal shares it."""
+    return proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+@register(name="_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                  pooled_size=1, group_size=0):
+    """R-FCN position-sensitive ROI pooling (contrib/psroi_pooling.cc).
+    Implemented as the no-offset case of the deformable variant: each
+    bin averages a fixed bilinear sample grid instead of enumerating
+    integer pixels — same estimator, static shapes for XLA."""
+    return deformable_psroi_pooling(
+        data, rois, None, spatial_scale=spatial_scale,
+        output_dim=output_dim, group_size=group_size or pooled_size,
+        pooled_size=pooled_size, sample_per_part=2, no_trans=True)
+
+
 # ------------------------------------------------------------- deformable --
 def _bilinear_gather(img, ys, xs):
     """Sample img (C, H, W) at float coords (ys, xs) of any shape ->

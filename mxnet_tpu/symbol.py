@@ -32,6 +32,8 @@ OP_INPUTS = {
     "Deconvolution": ("data", "weight", "bias"),
     "FullyConnected": ("data", "weight", "bias"),
     "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "_contrib_SyncBatchNorm": ("data", "gamma", "beta", "moving_mean",
+                               "moving_var"),
     "LayerNorm": ("data", "gamma", "beta"),
     "GroupNorm": ("data", "gamma", "beta"),
     "InstanceNorm": ("data", "gamma", "beta"),
@@ -57,7 +59,8 @@ OP_INPUTS = {
 
 # Aux states: inputs updated by the op during training rather than learned
 # by gradient (reference: MutableInput lists; BatchNorm moving stats).
-OP_AUX = {"BatchNorm": ("moving_mean", "moving_var")}
+OP_AUX = {"BatchNorm": ("moving_mean", "moving_var"),
+          "_contrib_SyncBatchNorm": ("moving_mean", "moving_var")}
 # default initializer registry names for auto-created aux states
 _AUX_DEFAULT_INIT = {"moving_mean": "zeros", "moving_var": "ones"}
 
@@ -118,6 +121,9 @@ PARAM_SHAPE_RULES = {
                        "bias": lambda ds, at: (at.get("num_hidden", 1),)},
     "BatchNorm": {k: _NORM_PARAM for k in
                   ("gamma", "beta", "moving_mean", "moving_var")},
+    "_contrib_SyncBatchNorm": {k: _NORM_PARAM for k in
+                               ("gamma", "beta", "moving_mean",
+                                "moving_var")},
     "LayerNorm": {"gamma": lambda ds, at: (ds[at.get("axis", -1) % len(ds)],),
                   "beta": lambda ds, at: (ds[at.get("axis", -1) % len(ds)],)},
     "GroupNorm": {"gamma": _NORM_PARAM, "beta": _NORM_PARAM},
@@ -221,7 +227,8 @@ class Symbol:
                 out.append(node.name)
                 continue
             op = ops.get(node.op)
-            if op.num_outputs == 1 or node.op in ("BatchNorm",):
+            if op.num_outputs == 1 or \
+                    node.op in ("BatchNorm", "_contrib_SyncBatchNorm"):
                 suffix = "_output"
             else:
                 suffix = "_output%d" % oi
@@ -470,7 +477,7 @@ def _node_num_outputs(node):
         # the traced body, not the op class)
         return int(node.attrs["__num_outputs__"])
     op = ops.get(node.op)
-    if node.op == "BatchNorm":
+    if node.op in ("BatchNorm", "_contrib_SyncBatchNorm"):
         return 1  # mean/var are internal plumbing, not user outputs
     if op.num_outputs == "n":
         if node.op in ("SliceChannel", "split"):

@@ -1,0 +1,132 @@
+"""Callable optimizer-update ops and the new loss-head/misc ops.
+
+Reference: src/operator/optimizer_op.cc, contrib/adamw.cc,
+svm_output.cc, identity_attach_KL_sparse_reg.cc, smooth_l1.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_sgd_update_out_alias():
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 2.0, np.float32))
+    nd.sgd_update(w, g, out=w, lr=0.5, wd=0.0)
+    np.testing.assert_allclose(w.asnumpy(), np.zeros(4))
+
+
+def test_sgd_mom_update_mutates_state():
+    w = nd.array(np.ones((3,), np.float32))
+    g = nd.array(np.full((3,), 1.0, np.float32))
+    mom = nd.zeros((3,))
+    nd.sgd_mom_update(w, g, mom, out=w, lr=0.1, momentum=0.9, wd=0.0)
+    np.testing.assert_allclose(mom.asnumpy(), -0.1 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), 0.9 * np.ones(3), rtol=1e-6)
+    nd.sgd_mom_update(w, g, mom, out=w, lr=0.1, momentum=0.9, wd=0.0)
+    np.testing.assert_allclose(mom.asnumpy(), -0.19 * np.ones(3), rtol=1e-5)
+
+
+def test_adam_update_matches_reference_math():
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(5).astype(np.float32)
+    g0 = rng.rand(5).astype(np.float32)
+    w = nd.array(w0)
+    mean, var = nd.zeros((5,)), nd.zeros((5,))
+    nd.adam_update(w, nd.array(g0), mean, var, out=w, lr=0.01,
+                   beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0)
+    m = 0.1 * g0
+    v = 0.001 * g0 * g0
+    expect = w0 - 0.01 * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(mean.asnumpy(), m, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.zeros((4,))
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    nd.contrib.adamw_update(w, g, mean, var, nd.array([1.0]), out=w,
+                            lr=0.1, wd=0.5, eta=1.0)
+    # zero grad: update is purely the decoupled decay lr*wd*w
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.05, rtol=1e-6)
+
+
+def test_multi_sgd_update():
+    w1, w2 = nd.array(np.ones(3)), nd.array(np.full(2, 2.0))
+    g1, g2 = nd.array(np.ones(3)), nd.array(np.ones(2))
+    out = nd.multi_sgd_update(w1, g1, w2, g2, lrs=(0.5, 0.25),
+                              wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(out[0].asnumpy(), 0.5 * np.ones(3))
+    np.testing.assert_allclose(out[1].asnumpy(), 1.75 * np.ones(2))
+
+
+def test_ftrl_and_rmsprop_run():
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 0.5, np.float32))
+    n = nd.zeros((4,))
+    nd.rmsprop_update(w, g, n, out=w, lr=0.1, gamma1=0.9)
+    assert float(n.asnumpy()[0]) > 0
+    z, n2 = nd.zeros((4,)), nd.zeros((4,))
+    w2 = nd.array(np.ones(4, np.float32))
+    nd.ftrl_update(w2, g, z, n2, out=w2, lr=0.1, lamda1=0.01)
+    assert np.isfinite(w2.asnumpy()).all()
+
+
+def test_svm_output_gradients():
+    x = nd.array(np.array([[2.0, 1.0, 0.0],
+                           [0.0, 0.0, 5.0]], np.float32))
+    y = nd.array(np.array([0, 2], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, y, margin=1.0,
+                           regularization_coefficient=1.0,
+                           use_linear=True)
+    out.backward()
+    g = x.grad.asnumpy()
+    # sample 0: z = 1 - 2 + [2,1,0] = [1,0,-1] -> violation only at j=1
+    # (z_1 = 0 is not > 0); wait: z_1 = 1-2+1 = 0 -> not violated
+    np.testing.assert_allclose(g[0], [0.0, 0.0, 0.0], atol=1e-6)
+    # sample 1: x_y = 5; z = 1-5+[0,0,5] = [-4,-4,1]: no violations
+    np.testing.assert_allclose(g[1], [0.0, 0.0, 0.0], atol=1e-6)
+    # a violated case
+    x2 = nd.array(np.array([[0.0, 2.0]], np.float32))
+    y2 = nd.array(np.array([0], np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x2, y2, use_linear=True)
+    out.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [[-1.0, 1.0]], atol=1e-6)
+
+
+def test_smooth_l1():
+    x = np.array([-3.0, -0.2, 0.0, 0.4, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_identity_kl_sparse_reg():
+    rng = np.random.RandomState(0)
+    act = rng.uniform(0.4, 0.6, (8, 4)).astype(np.float32)
+    x = nd.array(act)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                           penalty=0.01)
+        loss = out.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # gradient = 1 (from sum) + KL push; mean activation ~0.5 > target
+    # 0.1, so the KL term is positive (pushes activations down)
+    assert (g > 1.0).all()
+
+
+def test_sync_batch_norm_op():
+    x = np.random.RandomState(1).rand(6, 3, 4, 4).astype(np.float32)
+    out = nd.contrib.SyncBatchNorm(
+        nd.array(x), nd.ones((3,)), nd.zeros((3,)), nd.zeros((3,)),
+        nd.ones((3,)), fix_gamma=False, is_train=True, ndev=1)
+    got = out.asnumpy()
+    assert abs(got.mean()) < 1e-3 and abs(got.std() - 1.0) < 1e-2
