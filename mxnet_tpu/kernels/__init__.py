@@ -1,0 +1,12 @@
+"""Hand-written Pallas TPU kernels.
+
+The reference ships hand kernels where its compilers fell short (CUDA
+.cu files, cuDNN call-outs); here XLA covers almost everything and this
+package holds the few deliberate exceptions, written with Pallas
+(MXU/VMEM-aware blocking). Kernels run compiled on TPU and in Pallas
+interpret mode elsewhere, so their tests execute on any backend.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,114 @@
+"""Blocked (flash) attention as a Pallas TPU kernel.
+
+Softmax(QK^T)V without materialising the [Tq, Tk] score matrix in HBM:
+each grid step owns one query block in VMEM and streams key/value
+blocks, maintaining the online-softmax running max/denominator. This is
+the kernel counterpart of parallel/ring.py's jnp-level blockwise
+attention — the ring layer rotates K/V shards across devices, and this
+kernel is the dense per-device block compute.
+
+Layout: the (batch, head) pair is the leading grid axis, query blocks
+the second; K/V for the pair sit in VMEM whole (fine up to a few
+thousand keys at typical head dims; the ring layer keeps per-device
+sequence shards in that regime).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                 seq_k):
+    # q_ref: [block_q, D]; k_ref/v_ref: [Tk, D]; o_ref: [block_q, D]
+    block_q, head_dim = q_ref.shape
+    q = q_ref[...].astype(jnp.float32) * scale
+    q_start = pl.program_id(1) * block_q
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = alpha * l + p.sum(axis=1)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o_new = alpha[:, None] * o + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    num_kb = seq_k // block_k
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; bound
+        # the stream at the query block's last row
+        last = (q_start + block_q + block_k - 1) // block_k
+        num_kb = jnp.minimum(num_kb, last)
+    o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q",
+                                             "block_k", "interpret"))
+def _flash_bh(q, k, v, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] with T divisible by the block sizes."""
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    scale = 1.0 / (head_dim ** 0.5)
+    kernel = functools.partial(_attn_kernel, block_k=block_k,
+                               causal=causal, scale=scale, seq_k=seq_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, seq_k, head_dim), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, head_dim), lambda b, qi: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, head_dim),
+                               lambda b, qi: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+                    interpret=None):
+    """Multi-head attention over [B, T, H, D] tensors.
+
+    Equivalent to softmax(q k^T / sqrt(D)) v computed blockwise in
+    VMEM. Block sizes clamp to the sequence lengths; sequences must be
+    divisible by the (clamped) blocks. `interpret` defaults to True off
+    TPU so the same code runs everywhere.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            "sequence lengths (%d, %d) must divide by blocks (%d, %d)"
+            % (seq_q, seq_k, block_q, block_k))
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * heads, x.shape[1], head_dim)
+    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal,
+                    block_q, block_k, interpret)
+    return out.reshape(b, heads, seq_q, head_dim).transpose(0, 2, 1, 3)

@@ -50,6 +50,10 @@ class TransformerConfig:
     pp_axis: str = None         # set to 'pp' to pipeline the layer stack
     num_microbatches: int = 0   # 0 = one per pipeline stage
     use_ring_attention: bool = True
+    # single-device attention through the Pallas flash kernel
+    # (kernels/flash_attention.py) instead of the dense jnp path;
+    # sequences must divide the kernel's blocks
+    use_flash_kernel: bool = False
 
 
 def _norm_shape(cfg):
@@ -142,6 +146,10 @@ def _attention(x, p, cfg, mesh, manual_sp=False):
     elif mesh is not None and cfg.use_ring_attention and cfg.sp_axis:
         o = ring_attention_sharded(q, k, v, mesh, axis_name=cfg.sp_axis,
                                    causal=True)
+    elif cfg.use_flash_kernel:
+        from ..kernels import flash_attention
+        # flash_attention clamps its default blocks to the sequence
+        o = flash_attention(q, k, v, causal=True).astype(x.dtype)
     else:
         T = x.shape[1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,

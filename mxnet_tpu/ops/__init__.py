@@ -43,7 +43,10 @@ class Op:
         self.differentiable = differentiable
         self.stateful_rng = stateful_rng
         self.num_outputs = num_outputs
-        self.mutate_inputs = tuple(mutate_inputs)
+        # names, positions, or a callable attrs -> positions (for ops
+        # whose state slots depend on an attr, e.g. num_weights)
+        self.mutate_inputs = mutate_inputs if callable(mutate_inputs) \
+            else tuple(mutate_inputs)
         self._sig = None
 
     def make_fn(self, attrs):

@@ -267,7 +267,7 @@ def boolean_mask_dense(data, mask):
     return data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
 
 
-@register(name="_contrib_boolean_mask", differentiable=False)
+@register(name="_contrib_boolean_mask")
 def boolean_mask(data, index, axis=0):
     """contrib boolean_mask (src/operator/contrib/boolean_mask.cc):
     compacted rows where index != 0. The output shape depends on the

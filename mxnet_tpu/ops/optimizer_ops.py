@@ -76,28 +76,55 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
-    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    # reference order (optimizer_op-inl.h AdamUpdate): rescale + wd
+    # first, THEN clip the combined term
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
     mean = beta1 * mean + (1.0 - beta1) * g
     var = beta2 * var + (1.0 - beta2) * g * g
     return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
 
 
+def _adamw_math(w32, grad, mean, var, scale, lr, beta1, beta2, epsilon, wd,
+                eta, clip_gradient):
+    g = grad.astype(jnp.float32) * scale
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * g * g
+    # decoupled decay is NOT scaled by lr:
+    #   w -= eta * (lr * m / (sqrt(v) + eps) + wd * w)    (adamw.cc:73)
+    w32 = w32 - eta * (lr * mean / (jnp.sqrt(var) + epsilon) + wd * w32)
+    return w32, mean, var
+
+
 @register(name="_contrib_adamw_update", differentiable=False,
-          aliases=("_contrib_mp_adamw_update", "adamw_update"),
-          mutate_inputs=("mean", "var"))
+          aliases=("adamw_update",), mutate_inputs=("mean", "var"))
 def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                  clip_gradient=-1.0):
     """contrib/adamw.cc — decoupled weight decay; rescale_grad arrives as
     a tensor (the AMP loss-scale), eta is the schedule multiplier."""
     scale = rescale_grad if rescale_grad is not None else 1.0
-    g = grad * scale
-    if clip_gradient is not None and clip_gradient >= 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
-    mean = beta1 * mean + (1.0 - beta1) * g
-    var = beta2 * var + (1.0 - beta2) * g * g
-    step = lr * mean / (jnp.sqrt(var) + epsilon) + lr * wd * weight
-    return weight - eta * step, mean, var
+    w, mean, var = _adamw_math(weight, grad, mean, var, scale, lr, beta1,
+                               beta2, epsilon, wd, eta, clip_gradient)
+    return w.astype(weight.dtype), mean, var
+
+
+@register(name="_contrib_mp_adamw_update", differentiable=False,
+          mutate_inputs=("mean", "var", "weight32"))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=None,
+                    lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0):
+    """contrib/adamw.cc multi-precision variant: the fp32 master copy
+    (weight32) carries the update; the low-precision weight output is
+    its cast."""
+    scale = rescale_grad if rescale_grad is not None else 1.0
+    w32, mean, var = _adamw_math(weight32, grad, mean, var, scale, lr,
+                                 beta1, beta2, epsilon, wd, eta,
+                                 clip_gradient)
+    return w32.astype(weight.dtype), mean, var, w32
 
 
 @register(name="rmsprop_update", differentiable=False,
@@ -149,7 +176,10 @@ def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
 def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
                 t=1):
-    g = _rescaled(grad, rescale_grad, clip_grad) + wd * weight
+    # reference order (FTMLKernel): rescale + wd first, then clip
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
     v = beta2 * v + (1.0 - beta2) * g * g
     d_t = (1.0 - beta1 ** t) / lr * \
         (jnp.sqrt(v / (1.0 - beta2 ** t)) + epsilon)
@@ -201,13 +231,18 @@ def multi_sgd_update(*arrays, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
                       0.0, rescale_grad, clip_gradient, has_mom=False)
 
 
+def _mom_slots(attrs):
+    n = int(attrs.get("num_weights", 1))
+    return tuple(3 * i + 2 for i in range(n))
+
+
 @register(name="multi_sgd_mom_update", differentiable=False,
-          num_outputs="n")
+          num_outputs="n", mutate_inputs=_mom_slots)
 def multi_sgd_mom_update(*arrays, lrs=(0.01,), wds=(0.0,), momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0,
                          num_weights=1):
-    """Returns the updated weights followed by the updated momenta (the
-    reference mutates the momentum inputs; callers here re-bind both)."""
+    """Outputs the updated weights; the momentum inputs advance in place
+    (FMutateInputs contract, positions resolved from num_weights)."""
     return _multi_sgd(list(arrays), num_weights,
                       _parse_list(lrs, num_weights),
                       _parse_list(wds, num_weights),
@@ -226,7 +261,7 @@ def preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
 
 
 @register(name="preloaded_multi_sgd_mom_update", differentiable=False,
-          num_outputs="n")
+          num_outputs="n", mutate_inputs=_mom_slots)
 def preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
                                    clip_gradient=-1.0, num_weights=1):
     lrs, wds = arrays[-2], arrays[-1]   # stay on device (traced scalars)

@@ -49,8 +49,44 @@ def test_adamw_decoupled_decay():
     mean, var = nd.zeros((4,)), nd.zeros((4,))
     nd.contrib.adamw_update(w, g, mean, var, nd.array([1.0]), out=w,
                             lr=0.1, wd=0.5, eta=1.0)
-    # zero grad: update is purely the decoupled decay lr*wd*w
-    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.05, rtol=1e-6)
+    # zero grad: update is purely the decoupled decay eta*wd*w — NOT
+    # scaled by lr (adamw.cc: w -= eta*(lr*m/(sqrt(v)+eps) + wd*w))
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.5, rtol=1e-6)
+
+
+def test_mp_adamw_updates_master_copy():
+    w = nd.array(np.ones((4,), np.float32)).astype("float16")  # bf16 store
+    g = nd.zeros((4,)).astype("float16")
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    w32 = nd.array(np.ones((4,), np.float32))
+    nd.contrib.mp_adamw_update(w, g, mean, var, w32, nd.array([1.0]),
+                               out=w, lr=0.1, wd=0.5, eta=1.0)
+    np.testing.assert_allclose(w32.asnumpy(), 0.5 * np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), 0.5 * np.ones(4), rtol=1e-2)
+
+
+def test_multi_sgd_mom_mutates_momenta():
+    w1, w2 = nd.array(np.ones(3)), nd.array(np.ones(2))
+    g1, g2 = nd.array(np.ones(3)), nd.array(np.ones(2))
+    m1, m2 = nd.zeros((3,)), nd.zeros((2,))
+    out = nd.multi_sgd_mom_update(w1, g1, m1, w2, g2, m2,
+                                  lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                  momentum=0.9, num_weights=2)
+    np.testing.assert_allclose(m1.asnumpy(), -0.1 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(m2.asnumpy(), -0.1 * np.ones(2), rtol=1e-6)
+    np.testing.assert_allclose(out[0].asnumpy(), 0.9 * np.ones(3),
+                               rtol=1e-6)
+
+
+def test_boolean_mask_gradient():
+    x = nd.array(np.arange(6.0, dtype=np.float32).reshape(3, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.boolean_mask(x, nd.array([1, 0, 1]))
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[1, 1], [0, 0], [1, 1]])
 
 
 def test_multi_sgd_update():
