@@ -60,12 +60,11 @@ class PallasModule(object):
                 datas = [a._data if hasattr(a, "_data") else a
                          for a in args]
                 kw = {"grid": grid_dims} if grid_dims is not None else {}
-                import jax as _jax
                 call = pl.pallas_call(
                     kernel_fn, out_shape=out_shape_fn(*datas),
                     # interpret off-TPU: the same kernel source runs on
                     # any backend (compiled for real on the chip)
-                    interpret=_jax.default_backend() != "tpu", **kw)
+                    interpret=jax.default_backend() != "tpu", **kw)
                 return call(*datas)
 
         return _Kernel()

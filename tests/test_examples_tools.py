@@ -81,6 +81,24 @@ def test_launch_local_spawns_workers(tmp_path):
         assert open(marker + str(i)).read() == "3"
 
 
+def test_parse_log_summarizes_epochs(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [20] Speed: 1500.00 samples/sec\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Time cost=10.0\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.6\n")
+    r = _run([sys.executable, "tools/parse_log.py", str(log)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "train-accuracy" in r.stdout and "0.6" in r.stdout
+
+
+def test_diagnose_runs():
+    r = _run([sys.executable, "tools/diagnose.py"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "mxnet_tpu" in r.stdout and "Devices" in r.stdout
+
+
 def test_train_imagenet_benchmark_tiny():
     r = _run([sys.executable,
               "examples/image_classification/train_imagenet.py",

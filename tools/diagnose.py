@@ -1,0 +1,104 @@
+"""Print environment diagnostics for bug reports.
+
+Parity target: tools/diagnose.py (platform/python/deps/build-info
+dump). TPU-native additions: jax/backend/device inventory and the
+native-component cache state.
+"""
+
+import os
+import platform
+import sys
+import time
+
+
+def _section(title):
+    print("----------%s Info----------" % title)
+
+
+def check_python():
+    _section("Python")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_platform():
+    _section("Platform")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_deps():
+    _section("Dependencies")
+    for mod in ("numpy", "jax", "jaxlib", "cv2", "google.protobuf"):
+        try:
+            m = __import__(mod, fromlist=["__version__"])
+            print("%-12s : %s" % (mod, getattr(m, "__version__", "?")))
+        except Exception as exc:
+            print("%-12s : NOT AVAILABLE (%s)" % (mod, exc))
+
+
+def check_mxnet_tpu():
+    _section("mxnet_tpu")
+    start = time.time()
+    try:
+        import mxnet_tpu as mx
+        print("Version      :", getattr(mx, "__version__", "?"))
+        print("Directory    :", os.path.dirname(mx.__file__))
+        print("Import time  : %.2fs" % (time.time() - start))
+        from mxnet_tpu import runtime
+        feats = runtime.Features()
+        enabled = [name for name in feats.keys()
+                   if feats.is_enabled(name)]
+        print("Features     :", ", ".join(sorted(enabled)) or "-")
+    except Exception as exc:
+        print("import FAILED:", exc)
+        return False
+    return True
+
+
+def check_devices():
+    _section("Devices")
+    try:
+        import jax
+        print("default      :", jax.default_backend())
+        for dev in jax.local_devices():
+            print("device       :", dev)
+    except Exception as exc:
+        print("jax device query FAILED:", exc)
+
+
+def check_native():
+    _section("Native components")
+    try:
+        from mxnet_tpu import _native
+        lib = _native.recordio_lib()
+        print("recordio lib :", "loaded" if lib else "unavailable "
+              "(pure-Python fallback active)")
+    except Exception as exc:
+        print("native check FAILED:", exc)
+
+
+def check_environment():
+    _section("Environment")
+    for key, value in sorted(os.environ.items()):
+        if key.startswith(("MXNET_", "JAX_", "XLA_", "OMP_")):
+            print("%s=%s" % (key, value))
+
+
+def main():
+    check_platform()
+    check_python()
+    check_deps()
+    ok = check_mxnet_tpu()
+    check_devices()
+    check_native()
+    check_environment()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Summarize training logs produced by the fit loop / Speedometer.
+
+Parity target: tools/parse_log.py — parse "Epoch[N] ... Train-acc=..."
+style lines into a table of per-epoch train/validation metrics and
+timing.
+
+    python tools/parse_log.py train.log
+    python tools/parse_log.py train.log --format markdown
+"""
+
+import argparse
+import re
+import sys
+
+_TRAIN = re.compile(
+    r"Epoch\[(\d+)\]\s+Train-([^=\s]+)=([0-9.eE+-]+|nan)")
+_VALID = re.compile(
+    r"Epoch\[(\d+)\]\s+Validation-([^=\s]+)=([0-9.eE+-]+|nan)")
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.]+)")
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\s*\[\d+\]\s+Speed:\s*([0-9.]+)")
+
+
+def parse(lines):
+    epochs = {}
+
+    def row(epoch):
+        return epochs.setdefault(int(epoch), {"speeds": []})
+
+    for line in lines:
+        for match in _TRAIN.finditer(line):
+            row(match.group(1))["train-" + match.group(2)] = \
+                float(match.group(3))
+        for match in _VALID.finditer(line):
+            row(match.group(1))["val-" + match.group(2)] = \
+                float(match.group(3))
+        match = _TIME.search(line)
+        if match:
+            row(match.group(1))["time"] = float(match.group(2))
+        match = _SPEED.search(line)
+        if match:
+            row(match.group(1))["speeds"].append(float(match.group(2)))
+    return epochs
+
+
+def render(epochs, fmt):
+    metrics = sorted({k for row in epochs.values() for k in row
+                      if k not in ("speeds",)})
+    header = ["epoch"] + metrics + ["samples/s"]
+    rows = []
+    for epoch in sorted(epochs):
+        row = epochs[epoch]
+        speed = sum(row["speeds"]) / len(row["speeds"]) \
+            if row["speeds"] else None
+        cells = [str(epoch)] + [
+            ("%.6g" % row[m]) if m in row else "-" for m in metrics]
+        cells.append("%.1f" % speed if speed is not None else "-")
+        rows.append(cells)
+    if fmt == "markdown":
+        out = ["| " + " | ".join(header) + " |",
+               "|" + "|".join("---" for _ in header) + "|"]
+        out += ["| " + " | ".join(r) + " |" for r in rows]
+    else:
+        widths = [max(len(h), *(len(r[i]) for r in rows)) if rows
+                  else len(h) for i, h in enumerate(header)]
+        out = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+        out += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+                for r in rows]
+    return "\n".join(out)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse training logs")
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=("table", "markdown"),
+                        default="table")
+    args = parser.parse_args()
+    with open(args.logfile) as f:
+        epochs = parse(f)
+    if not epochs:
+        print("no epoch lines found", file=sys.stderr)
+        return 1
+    print(render(epochs, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
