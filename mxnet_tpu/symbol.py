@@ -64,6 +64,23 @@ OP_AUX = {"BatchNorm": ("moving_mean", "moving_var"),
 # default initializer registry names for auto-created aux states
 _AUX_DEFAULT_INIT = {"moving_mean": "zeros", "moving_var": "ones"}
 
+
+def _rnn_param_init(attrs):
+    """__init__ attr for the RNN packed-parameter var: the FusedRNN
+    initializer needs the cell geometry to lay out gate weights/biases
+    (the reference stamps the same via rnn_cell.FusedRNNCell)."""
+    return json.dumps(["fusedrnn", {
+        "init": None,
+        "num_hidden": attrs.get("state_size", 1),
+        "num_layers": attrs.get("num_layers", 1),
+        "mode": attrs.get("mode", "lstm"),
+        "bidirectional": bool(attrs.get("bidirectional", False)),
+    }])
+
+
+# per-(op, param) default __init__ stamps for auto-created variables
+_PARAM_DEFAULT_INIT = {("RNN", "parameters"): _rnn_param_init}
+
 # Loss heads whose missing `label` input is auto-created as `{name}_label`
 # (the reference's ListArguments auto-var rule that makes `softmax_label`
 # appear in list_arguments()). Value = label-shape rule from data shape.
@@ -692,6 +709,9 @@ def _make_sym_func(op_name):
                 default_init = _AUX_DEFAULT_INIT.get(pname)
                 if pname in aux_set and default_init:
                     vattrs["__init__"] = default_init
+                param_init = _PARAM_DEFAULT_INIT.get((op_name, pname))
+                if param_init is not None:
+                    vattrs["__init__"] = param_init(attrs)
                 v = var("%s_%s" % (nm, pname), attr=vattrs)
                 input_syms.append(v)
                 input_names.append(pname)

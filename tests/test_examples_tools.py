@@ -81,6 +81,27 @@ def test_launch_local_spawns_workers(tmp_path):
         assert open(marker + str(i)).read() == "3"
 
 
+def test_model_parallel_matrix_factorization_runs():
+    r = _run([sys.executable,
+              "examples/model_parallel/matrix_factorization.py",
+              "--num-epochs", "2", "--num-users", "50",
+              "--num-items", "30"],
+             XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "cpu(1)" in r.stdout          # second group really placed
+    mse = float(r.stdout.rsplit("mse=", 1)[1])
+    assert mse < 5.0
+
+
+def test_bucketing_lstm_learns():
+    r = _run([sys.executable, "examples/rnn/bucketing_lstm.py",
+              "--num-epochs", "2", "--buckets", "6,8",
+              "--batch-size", "8"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    ppl = float(r.stdout.rsplit("perplexity=", 1)[1].split()[0])
+    assert ppl < 8.0                     # far below the 16-way uniform
+
+
 def test_parse_log_summarizes_epochs(tmp_path):
     log = tmp_path / "train.log"
     log.write_text(
