@@ -380,6 +380,96 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     return out
 
 
+# ------------------------------------------------------- graph sampling --
+@register(name="_contrib_dgl_csr_neighbor_uniform_sample",
+          differentiable=False, num_outputs="n", stateful_rng=True)
+def dgl_csr_neighbor_uniform_sample(indptr, indices, *seeds,
+                                    num_args=2, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    rng_key=None):
+    """contrib/dgl_graph.cc uniform neighbor sampling over a CSR graph.
+
+    Inputs are the CSR pieces (indptr, indices) plus one or more seed
+    vertex arrays; per seed array returns a padded vertex id vector of
+    length max_num_vertices whose first entry count is stored in its
+    trailing element (the reference's layout for the sampled subgraph
+    vertex list). Eager-only: sampling is data-dependent.
+    """
+    import numpy as onp
+    indptr_np = onp.asarray(indptr).astype(onp.int64)
+    indices_np = onp.asarray(indices).astype(onp.int64)
+    if rng_key is not None:
+        try:
+            seed_bits = onp.asarray(jax.random.key_data(rng_key)).ravel()
+        except Exception:
+            seed_bits = onp.asarray(rng_key).ravel()
+        seed = int(onp.uint32(seed_bits[-1]))
+    else:
+        seed = 0
+    rng = onp.random.RandomState(seed)
+    cap = int(max_num_vertices) - 1
+    outs = []
+    for seed_arr in seeds:
+        frontier = [int(v) for v in onp.asarray(seed_arr).ravel()
+                    if v >= 0]
+        visited = list(dict.fromkeys(frontier))[:cap]
+        seen = set(visited)
+        for _ in range(int(num_hops)):
+            if len(visited) >= cap:
+                break           # cap during sampling, not after
+            nxt = []
+            for v in frontier:
+                lo, hi = indptr_np[v], indptr_np[v + 1]
+                neigh = indices_np[lo:hi]
+                if len(neigh) > num_neighbor:
+                    neigh = rng.choice(neigh, size=int(num_neighbor),
+                                       replace=False)
+                nxt.extend(int(u) for u in neigh)
+            fresh = []
+            for u in dict.fromkeys(nxt):
+                if u not in seen:
+                    seen.add(u)
+                    fresh.append(u)
+                    if len(visited) + len(fresh) >= cap:
+                        break
+            visited.extend(fresh)
+            frontier = fresh
+        out = onp.full((max_num_vertices,), -1, onp.int64)
+        out[:len(visited)] = visited
+        out[-1] = len(visited)
+        outs.append(jnp.asarray(out))
+    return outs
+
+
+@register(name="_contrib_dgl_subgraph", differentiable=False,
+          num_outputs="n")
+def dgl_subgraph(indptr, indices, *vertex_sets, return_mapping=False):
+    """contrib/dgl_graph.cc vertex-induced subgraph extraction: for each
+    vertex set, the CSR (indptr, indices) of the induced subgraph with
+    vertices renumbered by their position in the set. Eager-only."""
+    if return_mapping:
+        raise NotImplementedError(
+            "dgl_subgraph return_mapping=True (original edge ids) is not "
+            "implemented; call with return_mapping=False")
+    import numpy as onp
+    indptr_np = onp.asarray(indptr).astype(onp.int64)
+    indices_np = onp.asarray(indices).astype(onp.int64)
+    outs = []
+    for vset in vertex_sets:
+        verts = [int(v) for v in onp.asarray(vset).ravel() if v >= 0]
+        remap = {v: i for i, v in enumerate(verts)}
+        sub_indptr = [0]
+        sub_indices = []
+        for v in verts:
+            for u in indices_np[indptr_np[v]:indptr_np[v + 1]]:
+                if int(u) in remap:
+                    sub_indices.append(remap[int(u)])
+            sub_indptr.append(len(sub_indices))
+        outs.append(jnp.asarray(onp.asarray(sub_indptr, onp.int64)))
+        outs.append(jnp.asarray(onp.asarray(sub_indices, onp.int64)))
+    return outs
+
+
 @register(name="_contrib_MultiProposal", aliases=("MultiProposal",),
           differentiable=False)
 def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):

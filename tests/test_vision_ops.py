@@ -168,6 +168,30 @@ def test_deformable_convolution_integer_offset_shifts():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_dgl_neighbor_sample_and_subgraph():
+    # ring graph 0-1-2-3-4-0 (undirected, CSR)
+    indptr = np.array([0, 2, 4, 6, 8, 10], np.int64)
+    indices = np.array([1, 4, 0, 2, 1, 3, 2, 4, 3, 0], np.int64)
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        nd.array(indptr), nd.array(indices), nd.array([0]),
+        num_args=3, num_hops=1, num_neighbor=2, max_num_vertices=6)
+    ids = out[0].asnumpy().astype(int) if isinstance(out, list) else \
+        out.asnumpy().astype(int)
+    count = ids[-1]
+    sampled = set(ids[:count])
+    assert 0 in sampled and sampled <= {0, 1, 4}
+    assert count == 3                         # both neighbors kept
+
+    subs = mx.nd.contrib.dgl_subgraph(
+        nd.array(indptr), nd.array(indices), nd.array([0, 1, 2]))
+    sub_indptr = subs[0].asnumpy().astype(int)
+    sub_indices = subs[1].asnumpy().astype(int)
+    np.testing.assert_array_equal(sub_indptr, [0, 1, 3, 4])
+    # vertex 0 keeps only neighbor 1; vertex 1 keeps 0 and 2; vertex 2
+    # keeps 1 (4 and 3 fall outside the set)
+    np.testing.assert_array_equal(sub_indices, [1, 0, 2, 1])
+
+
 def test_deformable_psroi_pooling_no_trans_uniform():
     """Pooling a constant-per-channel map returns that constant in the
     position-sensitive channel of each bin."""
