@@ -50,6 +50,10 @@ from . import runtime
 from . import test_utils
 from . import visualization
 from . import operator
+# the reference exposes custom ops as the `Custom` op in the nd namespace
+# (src/operator/custom/custom.cc); symbolic Custom is unsupported — host
+# callbacks cannot live inside a single compiled XLA graph (operator.py).
+ndarray.Custom = operator.Custom
 from . import registry
 from . import rtc
 from . import library

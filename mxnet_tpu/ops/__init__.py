@@ -17,6 +17,13 @@ machinery:
 What the registry still owns: the op *name* surface (so `nd.*`, `sym.*` and
 Symbol JSON stay MXNet-compatible), parameter parsing/validation, and
 flags (non-differentiable outputs, rng statefulness, mutable inputs).
+
+Deliberately unregistered reference names: the explicitly-registered
+backward ops (`_broadcast_backward`, `_contrib_backward_*`,
+`_split_v2_backward`, ...) — gradients come from jax.vjp on the forward
+fn, so backward never exists as a standalone graph node here — and
+`Custom`, which is an eager host-callback path (`nd.Custom`,
+operator.py) that cannot live inside a compiled XLA graph.
 """
 
 import functools
@@ -115,3 +122,4 @@ from . import optimizer_ops  # noqa: E402,F401
 from . import image_ops  # noqa: E402,F401
 from . import control_flow_ops  # noqa: E402,F401
 from . import quantization_ops  # noqa: E402,F401
+from . import numpy_ops  # noqa: E402,F401

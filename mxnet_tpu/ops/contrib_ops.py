@@ -298,3 +298,99 @@ def count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
     sign = s.reshape(-1)
     out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
     return out.at[..., idx].add(data * sign)
+
+
+@register(name="_contrib_RROIAlign", differentiable=False)
+def rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sampling_ratio=-1):
+    """src/operator/contrib/rroi_align.cc — rotated ROI align. rois are
+    [batch_idx, xc, yc, w, h, theta_degrees]; each pooled bin averages a
+    grid of bilinear samples taken on the rotated box. The reference's
+    adaptive grid (ceil(roi/pool)) is data-dependent; under jit we fix
+    the grid to 2x2 when sampling_ratio<=0 (documented divergence)."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    sr = 2 if sampling_ratio <= 0 else sampling_ratio
+
+    def one(roi):
+        img = data[roi[0].astype("int32")]
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * jnp.pi / 180.0
+        ct, st = jnp.cos(theta), jnp.sin(theta)
+        bh, bw = rh / ph, rw / pw
+        py, sy = jnp.arange(ph), jnp.arange(sr)
+        px, sx = jnp.arange(pw), jnp.arange(sr)
+        yy = -rh / 2 + py[:, None] * bh + (sy[None, :] + 0.5) * bh / sr
+        xx = -rw / 2 + px[:, None] * bw + (sx[None, :] + 0.5) * bw / sr
+        yg = yy.reshape(-1)[:, None]            # (ph*sr, 1)
+        xg = xx.reshape(-1)[None, :]            # (1, pw*sr)
+        x = xg * ct + yg * st + cx              # (ph*sr, pw*sr)
+        y = yg * ct - xg * st + cy
+        inside = (y >= -1.0) & (y <= h) & (x >= -1.0) & (x <= w)
+        y = jnp.clip(y, 0, h - 1)
+        x = jnp.clip(x, 0, w - 1)
+        y0 = jnp.floor(y); x0 = jnp.floor(x)
+        y0i = y0.astype("int32"); x0i = x0.astype("int32")
+        y1i = jnp.minimum(y0i + 1, h - 1); x1i = jnp.minimum(x0i + 1, w - 1)
+        wy1 = y - y0; wy0 = 1 - wy1
+        wx1 = x - x0; wx0 = 1 - wx1
+        flat = img.reshape(c, -1)
+        def gather(yi, xi):
+            return flat[:, (yi * w + xi).reshape(-1)].reshape((c,) + y.shape)
+        g = (gather(y0i, x0i) * (wy0 * wx0) + gather(y0i, x1i) * (wy0 * wx1)
+             + gather(y1i, x0i) * (wy1 * wx0) + gather(y1i, x1i) * (wy1 * wx1))
+        g = jnp.where(inside, g, 0.0)
+        g = g.reshape(c, ph, sr, pw, sr)
+        return jnp.mean(g, axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+@register(name="_contrib_bipartite_matching", num_outputs=2,
+          differentiable=False)
+def bipartite_matching(data, is_ascend=False, threshold=1e-12, topk=-1):
+    """bounding_box.cc `_contrib_bipartite_matching` — greedy score-ordered
+    matching. data: (..., row, col); returns (row_match, col_match) holding
+    the matched counterpart index or -1. The reference sorts all scores and
+    walks them greedily; iteratively extracting the best unmatched pair is
+    the same argument order expressed as a lax loop."""
+    shape = data.shape
+    row, col = shape[-2], shape[-1]
+    flat = data.reshape((-1, row, col)).astype(jnp.float32)
+    steps = min(row, col) if topk < 0 else min(topk, min(row, col))
+    big = jnp.float32(3.4e38)
+    sgn = 1.0 if is_ascend else -1.0
+
+    def one(mat):
+        def body(_, state):
+            rm, cm = state
+            masked = jnp.where((rm[:, None] < 0) & (cm[None, :] < 0),
+                               sgn * mat, big)
+            idx = jnp.argmin(masked.reshape(-1))
+            r, cidx = idx // col, idx % col
+            s = mat[r, cidx]
+            ok = (s <= threshold) if is_ascend else (s >= threshold)
+            ok &= masked[r, cidx] < big
+            rm = jnp.where(ok, rm.at[r].set(cidx), rm)
+            cm = jnp.where(ok, cm.at[cidx].set(r), cm)
+            return rm, cm
+        rm, cm = jax.lax.fori_loop(0, steps, body,
+                                   (jnp.full((row,), -1.0, jnp.float32),
+                                    jnp.full((col,), -1.0, jnp.float32)))
+        return rm, cm
+
+    rms, cms = jax.vmap(one)(flat)
+    return (rms.reshape(shape[:-2] + (row,)).astype(data.dtype),
+            cms.reshape(shape[:-2] + (col,)).astype(data.dtype))
+
+
+@register(name="_contrib_SparseEmbedding")
+def sparse_embedding(data, weight, input_dim=1, output_dim=1,
+                     dtype="float32", sparse_grad=True):
+    """contrib SparseEmbedding — identical forward to Embedding; the
+    reference's row_sparse gradient storage is a dense gradient here
+    (SURVEY §7 hard part (a): sparse-as-dense divergence)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)

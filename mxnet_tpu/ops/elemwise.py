@@ -203,11 +203,6 @@ def amp_multicast(*data, num_outputs=1):
     return tuple(d.astype(widest) for d in data)
 
 
-@register(name="gamma_sampled_like_guard", differentiable=False)
-def _guard(data):  # internal helper op used by tests for registry behavior
-    return data
-
-
 @register(name="add_n", aliases=("ElementWiseSum",))
 def add_n(*args):
     """src/operator/tensor/elemwise_sum.cc — sum of N arrays in one pass."""

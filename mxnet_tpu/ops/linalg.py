@@ -12,7 +12,7 @@ from jax import lax
 from . import register
 
 
-@register(name="linalg_gemm")
+@register(name="linalg_gemm", aliases=("_linalg_gemm",))
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
                 beta=1.0, axis=-2):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
@@ -20,19 +20,19 @@ def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
     return alpha * jnp.matmul(a, b) + beta * C
 
 
-@register(name="linalg_gemm2")
+@register(name="linalg_gemm2", aliases=("_linalg_gemm2",))
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b)
 
 
-@register(name="linalg_potrf")
+@register(name="linalg_potrf", aliases=("_linalg_potrf",))
 def linalg_potrf(A):
     return jnp.linalg.cholesky(A)
 
 
-@register(name="linalg_potri")
+@register(name="linalg_potri", aliases=("_linalg_potri",))
 def linalg_potri(A):
     # A is the cholesky factor L; potri returns (L L^T)^-1
     eye = jnp.eye(A.shape[-1], dtype=A.dtype)
@@ -41,14 +41,14 @@ def linalg_potri(A):
     return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
 
 
-@register(name="linalg_trsm")
+@register(name="linalg_trsm", aliases=("_linalg_trsm",))
 def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     return lax.linalg.triangular_solve(
         A, alpha * B, left_side=not rightside, lower=lower,
         transpose_a=transpose)
 
 
-@register(name="linalg_trmm")
+@register(name="linalg_trmm", aliases=("_linalg_trmm",))
 def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     tri = jnp.tril(A) if lower else jnp.triu(A)
     if transpose:
@@ -56,18 +56,18 @@ def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
 
 
-@register(name="linalg_sumlogdiag")
+@register(name="linalg_sumlogdiag", aliases=("_linalg_sumlogdiag",))
 def linalg_sumlogdiag(A):
     d = jnp.diagonal(A, axis1=-2, axis2=-1)
     return jnp.sum(jnp.log(d), axis=-1)
 
 
-@register(name="linalg_extractdiag")
+@register(name="linalg_extractdiag", aliases=("_linalg_extractdiag",))
 def linalg_extractdiag(A, offset=0):
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
 
 
-@register(name="linalg_makediag")
+@register(name="linalg_makediag", aliases=("_linalg_makediag",))
 def linalg_makediag(A, offset=0):
     n = A.shape[-1] + abs(offset)
     out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
@@ -77,48 +77,48 @@ def linalg_makediag(A, offset=0):
     return out.at[..., idx - offset, idx].set(A)
 
 
-@register(name="linalg_extracttrian")
+@register(name="linalg_extracttrian", aliases=("_linalg_extracttrian",))
 def linalg_extracttrian(A, offset=0, lower=True):
     n = A.shape[-1]
     rows, cols = jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset)
     return A[..., rows, cols]
 
 
-@register(name="linalg_syrk")
+@register(name="linalg_syrk", aliases=("_linalg_syrk",))
 def linalg_syrk(A, transpose=False, alpha=1.0):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
 
 
-@register(name="linalg_gelqf", num_outputs=2)
+@register(name="linalg_gelqf", aliases=("_linalg_gelqf",), num_outputs=2)
 def linalg_gelqf(A):
     q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
     return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
 
 
-@register(name="linalg_syevd", num_outputs=2)
+@register(name="linalg_syevd", aliases=("_linalg_syevd",), num_outputs=2)
 def linalg_syevd(A):
     w, v = jnp.linalg.eigh(A)
     return jnp.swapaxes(v, -1, -2), w
 
 
-@register(name="linalg_inverse", aliases=("inverse",))
+@register(name="linalg_inverse", aliases=("inverse", "_linalg_inverse"))
 def linalg_inverse(A):
     return jnp.linalg.inv(A)
 
 
-@register(name="linalg_det", aliases=("det",))
+@register(name="linalg_det", aliases=("det", "_linalg_det"))
 def linalg_det(A):
     return jnp.linalg.det(A)
 
 
-@register(name="linalg_slogdet", aliases=("slogdet",), num_outputs=2)
+@register(name="linalg_slogdet", aliases=("slogdet", "_linalg_slogdet"), num_outputs=2)
 def linalg_slogdet(A):
     sign, logdet = jnp.linalg.slogdet(A)
     return sign, logdet
 
 
-@register(name="linalg_maketrian")
+@register(name="linalg_maketrian", aliases=("_linalg_maketrian",))
 def linalg_maketrian(A, offset=0, lower=True):
     # inverse of extracttrian for square output
     m = A.shape[-1]

@@ -320,7 +320,8 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
     raise ValueError(ret_typ)
 
 
-@register(name="shuffle", differentiable=False, stateful_rng=True)
+@register(name="shuffle", aliases=("_shuffle",),
+          differentiable=False, stateful_rng=True)
 def shuffle(data, rng_key=None):
     return jax.random.permutation(rng_key, data, axis=0)
 
@@ -379,7 +380,8 @@ def size_array(data):
     return jnp.asarray([data.size], dtype="int64")
 
 
-@register(name="histogram", differentiable=False, num_outputs=2)
+@register(name="histogram", aliases=("_histogram",),
+          differentiable=False, num_outputs=2)
 def histogram(data, bins=10, range=None):
     cnt, edges = jnp.histogram(data, bins=bins, range=range)
     return cnt.astype("float32"), edges
@@ -493,3 +495,101 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
     d = jnp.moveaxis(data, axis, 0)
     out = jnp.take_along_axis(d, rev_idx.reshape(rev_idx.shape + (1,) * (d.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+# ------------------------------------------------- reference-parity ops --
+@register(name="_split_v2", num_outputs="n")
+def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    """src/operator/tensor/matrix_op.cc `_split_v2` — split by equal
+    sections, or at explicit section-START boundaries: `indices` includes
+    the leading 0 (the python `split_v2` wrapper prepends it), and output
+    i spans [indices[i], indices[i+1]) — so len(indices) outputs."""
+    ax = axis % data.ndim
+    if sections and sections > 0:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        bounds = list(indices) + [data.shape[ax]]
+        parts = [jax.lax.slice_in_dim(data, bounds[i], bounds[i + 1], axis=ax)
+                 for i in range(len(indices))]
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+def _region(begin, end, step, shape):
+    idx = []
+    for i in range(len(shape)):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] not in (None, 0) else None
+        idx.append(slice(b, e, s))
+    return tuple(idx)
+
+
+@register(name="_slice_assign", aliases=("_crop_assign",))
+def slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """`x[begin:end:step] = y` as a pure op (matrix_op.cc `_slice_assign`)."""
+    return lhs.at[_region(begin, end, step, lhs.shape)].set(rhs)
+
+
+@register(name="_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    return data.at[_region(begin, end, step, data.shape)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+@register(name="_ravel_multi_index", aliases=("ravel_multi_index",),
+          differentiable=False)
+def ravel_multi_index(data, shape=()):
+    """src/operator/tensor/ravel.cc — data is (ndim, N) coordinates."""
+    strides = []
+    acc = 1
+    for d in reversed(shape):
+        strides.append(acc)
+        acc *= d
+    strides = jnp.asarray(strides[::-1], data.dtype).reshape(-1, 1)
+    return jnp.sum(data * strides, axis=0)
+
+
+@register(name="_unravel_index", aliases=("unravel_index",),
+          differentiable=False)
+def unravel_index(data, shape=()):
+    coords = jnp.unravel_index(data, shape)
+    return jnp.stack([c.astype(data.dtype) for c in coords], axis=0)
+
+
+@register(name="_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    """Internal reference op (tensor/elemwise_unary_op_basic.cc) used by
+    sparse gradient graphs: forwards lhs, rhs only pins shape/stype."""
+    return lhs
+
+
+@register(name="_zeros_without_dtype", differentiable=False)
+def zeros_without_dtype(shape=(), ctx=None, dtype=None):
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int) else (shape,),
+                     jnp.dtype(dtype) if dtype not in (None, -1) else jnp.float32)
+
+
+@register(name="_rnn_param_concat")
+def rnn_param_concat(*data, dim=0, num_args=None):
+    """tensor/matrix_op.cc `_rnn_param_concat` — 1-D parameter pack concat
+    used when fusing per-gate RNN weights into the packed layout."""
+    return jnp.concatenate([d.reshape(-1) if d.ndim != 1 else d for d in data],
+                           axis=0)
+
+
+@register(name="cast_storage", differentiable=False)
+def cast_storage_op(data, stype="default"):
+    """ndarray-level storage casts happen in mxnet_tpu.sparse (host-side
+    wrappers); inside a graph every array is dense on TPU, so the op is
+    the identity (documented divergence, SURVEY §7 hard part (a))."""
+    return data
+
+
+@register(name="_sparse_retain")
+def sparse_retain(data, indices):
+    """sparse_retain dense emulation: keep the listed rows, zero the rest
+    (reference semantics on row_sparse restricted to a dense layout)."""
+    keep = jnp.zeros((data.shape[0],), bool).at[indices.astype("int32")].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)

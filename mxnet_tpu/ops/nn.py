@@ -214,7 +214,7 @@ def fully_connected(data, weight, bias=None, num_hidden=1, no_bias=False,
 
 
 # ---------------------------------------------------------------- norms --
-@register(name="BatchNorm", num_outputs=3)
+@register(name="BatchNorm", aliases=("BatchNorm_v1",), num_outputs=3)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False, is_train=False):

@@ -1,0 +1,144 @@
+"""NumPy-semantics internal operators (`_np_*` / `_npi_*`).
+
+Reference: src/operator/numpy/ — the registered kernels behind `mx.np`.
+In this framework `mx.np` delegates straight to jnp (numpy/__init__.py),
+so these registrations exist for graph-level parity: symbols and Symbol
+JSON produced by reference numpy frontends resolve to real ops here.
+Semantics are NumPy's (axis=None reduces everything, dtype kwargs,
+true-division), unlike the classic ops' MXNet conventions.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+@register(name="_np_sum", aliases=("_npi_sum",))
+def np_sum(a, axis=None, dtype=None, keepdims=False, initial=None):
+    out = jnp.sum(a, axis=axis, keepdims=keepdims,
+                  dtype=jnp.dtype(dtype) if dtype else None)
+    return out + initial if initial is not None else out
+
+
+@register(name="_np_prod")
+def np_prod(a, axis=None, dtype=None, keepdims=False):
+    return jnp.prod(a, axis=axis, keepdims=keepdims,
+                    dtype=jnp.dtype(dtype) if dtype else None)
+
+
+@register(name="_np_cumsum", aliases=("_npi_cumsum",))
+def np_cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis,
+                      dtype=jnp.dtype(dtype) if dtype else None)
+
+
+@register(name="_np_dot")
+def np_dot(a, b):
+    return jnp.dot(a, b)
+
+
+@register(name="_npi_tensordot")
+def npi_tensordot(a, b, a_axes_summed=(), b_axes_summed=()):
+    return jnp.tensordot(a, b, axes=(tuple(a_axes_summed),
+                                     tuple(b_axes_summed)))
+
+
+@register(name="_npi_tensordot_int_axes")
+def npi_tensordot_int_axes(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=int(axes))
+
+
+@register(name="_np_transpose")
+def np_transpose(a, axes=None):
+    return jnp.transpose(a, axes=tuple(axes) if axes else None)
+
+
+@register(name="_np_reshape", aliases=("_npi_reshape",))
+def np_reshape(a, newshape=(), order="C"):
+    return jnp.reshape(a, newshape)
+
+
+@register(name="_np_squeeze")
+def np_squeeze(a, axis=None):
+    return jnp.squeeze(a, axis=axis)
+
+
+@register(name="_np_broadcast_to", aliases=("_npi_broadcast_to",))
+def np_broadcast_to(array, shape=()):
+    return jnp.broadcast_to(array, tuple(shape))
+
+
+@register(name="_np_copy")
+def np_copy(a):
+    return jnp.asarray(a)
+
+
+@register(name="_np_ones_like")
+def np_ones_like(a):
+    return jnp.ones_like(a)
+
+
+@register(name="_np_zeros_like")
+def np_zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+@register(name="_npi_zeros", differentiable=False)
+def npi_zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), jnp.dtype(dtype))
+
+
+@register(name="_npi_ones", differentiable=False)
+def npi_ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), jnp.dtype(dtype))
+
+
+@register(name="_npi_arange", differentiable=False)
+def npi_arange(start=0, stop=None, step=1, dtype="float32"):
+    return jnp.arange(start, stop, step, jnp.dtype(dtype))
+
+
+@register(name="_npi_argmax", differentiable=False)
+def npi_argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdims and axis is not None else out
+
+
+@register(name="_npi_log")
+def npi_log(x):
+    return jnp.log(x)
+
+
+@register(name="_npi_concatenate", aliases=("_npi_stack_concat_guard",))
+def npi_concatenate(*data, axis=0):
+    if axis is None:
+        return jnp.concatenate([d.reshape(-1) for d in data], axis=0)
+    return jnp.concatenate(data, axis=axis)
+
+
+@register(name="_npi_stack")
+def npi_stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register(name="_npi_true_divide")
+def npi_true_divide(lhs, rhs):
+    return jnp.true_divide(lhs, rhs)
+
+
+@register(name="_npi_true_divide_scalar")
+def npi_true_divide_scalar(data, scalar=1.0):
+    return jnp.true_divide(data, scalar)
+
+
+@register(name="_npi_rtrue_divide_scalar")
+def npi_rtrue_divide_scalar(data, scalar=1.0):
+    return jnp.true_divide(scalar, data)
+
+
+@register(name="_npi_uniform", differentiable=False, stateful_rng=True)
+def npi_uniform(low=0.0, high=1.0, size=(), dtype="float32", rng_key=None):
+    size = (size,) if isinstance(size, int) else tuple(size or ())
+    return jax.random.uniform(rng_key, size, jnp.dtype(dtype),
+                              minval=low, maxval=high)

@@ -99,8 +99,9 @@ def _adamw_math(w32, grad, mean, var, scale, lr, beta1, beta2, epsilon, wd,
     return w32, mean, var
 
 
-@register(name="_contrib_adamw_update", differentiable=False,
-          aliases=("adamw_update",), mutate_inputs=("mean", "var"))
+@register(name="_contrib_adamw_update",
+          aliases=("_adamw_update", "adamw_update"),
+          differentiable=False, mutate_inputs=("mean", "var"))
 def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                  clip_gradient=-1.0):
@@ -112,7 +113,8 @@ def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001,
     return w.astype(weight.dtype), mean, var
 
 
-@register(name="_contrib_mp_adamw_update", differentiable=False,
+@register(name="_contrib_mp_adamw_update", aliases=("_mp_adamw_update",),
+          differentiable=False,
           mutate_inputs=("mean", "var", "weight32"))
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=None,
                     lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
@@ -267,3 +269,120 @@ def preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
     lrs, wds = arrays[-2], arrays[-1]   # stay on device (traced scalars)
     return _multi_sgd(list(arrays[:-2]), num_weights, lrs, wds, momentum,
                       rescale_grad, clip_gradient, has_mom=True)
+
+
+# ------------------------------------------------- multi-precision (mp_) --
+# Reference: optimizer_op.cc MP_SGD kernels — the master copy `weight32`
+# carries the update in fp32; the declared output is the low-precision
+# weight cast back down. weight32 (and mom) advance in place.
+def _mp_sgd_math(weight, grad, weight32, lr, wd, rescale_grad, clip_gradient):
+    g = _rescaled(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register(name="mp_sgd_update", differentiable=False,
+          mutate_inputs=("weight32",))
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    return _mp_sgd_math(weight, grad, weight32, lr, wd, rescale_grad,
+                        clip_gradient)
+
+
+@register(name="mp_sgd_mom_update", differentiable=False,
+          mutate_inputs=("mom", "weight32"))
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _rescaled(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register(name="mp_nag_mom_update", differentiable=False,
+          mutate_inputs=("mom", "weight32"))
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad.astype(jnp.float32), rescale_grad, clip_gradient) \
+        + wd * weight32
+    mom = momentum * mom + g
+    w32 = weight32 - lr * (momentum * mom + g)
+    return w32.astype(weight.dtype), mom, w32
+
+
+def _mp_w32_slots(attrs):
+    n = int(attrs.get("num_weights", 1))
+    return tuple(3 * i + 2 for i in range(n))
+
+
+@register(name="multi_mp_sgd_update", differentiable=False,
+          num_outputs="n", mutate_inputs=_mp_w32_slots)
+def multi_mp_sgd_update(*arrays, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1):
+    """Interleaved [w0, g0, w32_0, w1, g1, w32_1, ...]."""
+    lrs = _parse_list(lrs, num_weights)
+    wds = _parse_list(wds, num_weights)
+    outs, w32s = [], []
+    for i in range(num_weights):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        lo, hi = _mp_sgd_math(w, g, w32, lrs[i], wds[i], rescale_grad,
+                              clip_gradient)
+        outs.append(lo)
+        w32s.append(hi)
+    return outs + w32s
+
+
+def _mp_mom_slots(attrs):
+    n = int(attrs.get("num_weights", 1))
+    return tuple(4 * i + j for i in range(n) for j in (2, 3))
+
+
+@register(name="multi_mp_sgd_mom_update", differentiable=False,
+          num_outputs="n", mutate_inputs=_mp_mom_slots)
+def multi_mp_sgd_mom_update(*arrays, lrs=(0.01,), wds=(0.0,), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1):
+    """Interleaved [w0, g0, mom0, w32_0, ...]; mom and w32 advance in place."""
+    lrs = _parse_list(lrs, num_weights)
+    wds = _parse_list(wds, num_weights)
+    outs, states = [], []
+    for i in range(num_weights):
+        w, g, mom, w32 = arrays[4 * i:4 * i + 4]
+        gg = _rescaled(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        mom = momentum * mom - lrs[i] * (gg + wds[i] * w32)
+        w32 = w32 + mom
+        outs.append(w32.astype(w.dtype))
+        states.extend([mom, w32])
+    return outs + states
+
+
+# ------------------------------------------------------------- adagrad --
+@register(name="_sparse_adagrad_update", differentiable=False,
+          mutate_inputs=("history",))
+def sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """optimizer_op.cc:893 — history += g^2; w -= lr * g / sqrt(history+eps).
+    The reference's row_sparse laziness (update only rows present in the
+    gradient) is a dense no-op here: a dense grad touches every row."""
+    if wd:
+        # match the reference's fail-fast (optimizer_op-inl.h:2206) instead
+        # of silently training without decay
+        raise ValueError("sparse adagrad_update does not support wd.")
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    history = history + g * g
+    return weight - lr * g / jnp.sqrt(history + epsilon), history
+
+
+@register(name="_contrib_group_adagrad_update", differentiable=False,
+          mutate_inputs=("history",))
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """contrib/optimizer_op.cc — one accumulator per row: history_r +=
+    mean_j(g_rj^2); w_rj -= lr * g_rj / sqrt(history_r + eps)."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    row = g.reshape(g.shape[0], -1)
+    history = history + jnp.mean(row * row, axis=1).reshape(history.shape)
+    denom = jnp.sqrt(history + epsilon).reshape(
+        (g.shape[0],) + (1,) * (g.ndim - 1))
+    return weight - lr * g / denom, history

@@ -134,3 +134,151 @@ def quantize_weight(w):
     amax = jnp.maximum(jnp.max(jnp.abs(w)), 1e-10)
     s = INT8_MAX / amax
     return _quantize_int8(w, s), float(s)
+
+
+@register(name="_contrib_quantize", num_outputs=3, differentiable=False)
+def quantize(data, min_range, max_range, out_type="int8"):
+    """quantize.cc (v1) — the range arrives as two scalar inputs instead
+    of static attrs."""
+    mn = min_range.reshape(()).astype(jnp.float32)
+    mx = max_range.reshape(()).astype(jnp.float32)
+    s = _scale(mn, mx)
+    return _quantize_int8(data, s), mn.reshape(1), mx.reshape(1)
+
+
+@register(name="_contrib_quantized_flatten", num_outputs=3,
+          differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), min_data, max_data)
+
+
+@register(name="_contrib_quantized_act", num_outputs=3,
+          differentiable=False)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """quantized_activation.cc — relu only in the reference int8 path.
+    max(0, q) keeps the scale, so the range passes through with the
+    negative side clamped."""
+    if act_type != "relu":
+        raise NotImplementedError(
+            "int8 activation supports relu only (as the reference)")
+    zero = jnp.zeros((), data.dtype)
+    # ranges pass through UNCHANGED: the symmetric scale is set by
+    # max(|min|,|max|), so clamping min to 0 would silently rescale the
+    # untouched int8 payload
+    return (jnp.maximum(data, zero), min_data, max_data)
+
+
+@register(name="_contrib_quantized_pooling", num_outputs=3,
+          differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      global_pool=False, stride=(), pad=(),
+                      pooling_convention="valid", layout="NCHW"):
+    """quantized_pooling.cc — max pool stays in int8; avg pool accumulates
+    in int32 and divides back, range unchanged."""
+    nd_ = len(kernel) if kernel else data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd_
+        pad = (0,) * nd_
+    stride = tuple(stride) or (1,) * nd_
+    pad = tuple(pad) or (0,) * nd_
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        lowest = (jnp.iinfo(data.dtype).min if jnp.issubdtype(data.dtype, jnp.integer)
+                  else -jnp.inf)
+        out = jax.lax.reduce_window(
+            data, jnp.asarray(lowest, data.dtype), jax.lax.max,
+            window, strides, pads)
+    elif pool_type == "avg":
+        wide = data.astype(jnp.int32) if data.dtype == jnp.int8 else data
+        acc = jax.lax.reduce_window(
+            wide, jnp.asarray(0, wide.dtype), jax.lax.add,
+            window, strides, pads)
+        denom = 1
+        for k in kernel:
+            denom *= k
+        # lax.div truncates integer quotients toward zero like the
+        # reference C++ (// would floor negative sums to one step lower)
+        out = (jax.lax.div(acc, jnp.asarray(denom, acc.dtype))
+               if acc.dtype == jnp.int32 else acc / denom).astype(data.dtype)
+    else:
+        raise NotImplementedError("int8 pooling: max/avg only")
+    return out, min_data, max_data
+
+
+@register(name="_contrib_quantized_elemwise_add", num_outputs=3,
+          differentiable=False)
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """quantized_elemwise_add.cc — rescale both int8 operands to a common
+    real scale, add in int32, emit the widened range."""
+    ls = _scale(lhs_min.reshape(()), lhs_max.reshape(()))
+    rs = _scale(rhs_min.reshape(()), rhs_max.reshape(()))
+    real = lhs.astype(jnp.float32) / ls + rhs.astype(jnp.float32) / rs
+    mn = jnp.minimum(lhs_min.reshape(()) + rhs_min.reshape(()), 0.0)
+    mx = lhs_max.reshape(()) + rhs_max.reshape(())
+    s32 = jnp.float32(2147483647.0) / jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    out = jnp.clip(jnp.round(real * s32), -2147483647.0,
+                   2147483647.0).astype(jnp.int32)
+    return out, mn.reshape(1), mx.reshape(1)
+
+
+@register(name="_contrib_quantized_concat", num_outputs=3,
+          differentiable=False)
+def quantized_concat(*args, dim=1, num_args=None):
+    """quantized_concat.cc — inputs [d0..dn, min0, max0, ..]: requantize
+    every piece to the widest range, then concat."""
+    n = num_args if num_args is not None else len(args) // 3
+    datas, mins, maxs = args[:n], args[n::2], args[n + 1::2]
+    mins = [m.reshape(()) for m in mins]
+    maxs = [m.reshape(()) for m in maxs]
+    out_min = mins[0]
+    out_max = maxs[0]
+    for m in mins[1:]:
+        out_min = jnp.minimum(out_min, m)
+    for m in maxs[1:]:
+        out_max = jnp.maximum(out_max, m)
+    out_s = _scale(out_min, out_max)
+    pieces = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        s = _scale(mn, mx)
+        pieces.append(_quantize_int8(d.astype(jnp.float32) / s, out_s))
+    return (jnp.concatenate(pieces, axis=dim), out_min.reshape(1),
+            out_max.reshape(1))
+
+
+@register(name="_contrib_quantized_batch_norm", num_outputs=3,
+          differentiable=False)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None):
+    """quantized_batchnorm.cc — inference BN folded to a per-channel
+    scale/shift applied on the dequantized values, requantized to the
+    calibrated output range."""
+    s = _scale(min_data.reshape(()), max_data.reshape(()))
+    x = data.astype(jnp.float32) / s
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    y = x * inv.reshape(shape) + (beta - moving_mean * inv).reshape(shape)
+    if min_calib_range is None or max_calib_range is None:
+        mn, mx = jnp.min(y), jnp.max(y)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    return _quantize_int8(y, _scale(mn, mx)), mn.reshape(1), mx.reshape(1)
+
+
+@register(name="_contrib_calibrate_entropy", num_outputs=2,
+          differentiable=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """calibrate.cc — KL-divergence-optimal threshold from an |x|
+    histogram; returns (min_threshold, max_threshold) as scalars. Runs on
+    host (np) like the reference: it is a calibration-time op, not part
+    of a compiled graph."""
+    import numpy as np
+    from ..contrib.quantization import _optimal_threshold_kl
+    h = np.asarray(hist, dtype=np.float64)
+    e = np.asarray(hist_edges, dtype=np.float64)
+    thr = _optimal_threshold_kl(h, e, (num_quantized_bins + 1) // 2)
+    return (jnp.asarray([-thr], jnp.float32), jnp.asarray([thr], jnp.float32))

@@ -100,7 +100,8 @@ def sample_unique_zipfian(range_max=1, shape=(), rng_key=None):
 
 
 # Distribution-parameter tensor sampling (src/operator/random/multisample_op.cc)
-@register(name="sample_uniform", differentiable=False, stateful_rng=True)
+@register(name="sample_uniform", aliases=("_sample_uniform",),
+          differentiable=False, stateful_rng=True)
 def sample_uniform(low, high, shape=(), dtype="float32", rng_key=None):
     s = _shape(shape)
     u = jax.random.uniform(rng_key, low.shape + s, dtype=jnp.dtype(dtype))
@@ -108,7 +109,8 @@ def sample_uniform(low, high, shape=(), dtype="float32", rng_key=None):
         (high - low).reshape(low.shape + (1,) * len(s)) * u
 
 
-@register(name="sample_normal", differentiable=False, stateful_rng=True)
+@register(name="sample_normal", aliases=("_sample_normal",),
+          differentiable=False, stateful_rng=True)
 def sample_normal(mu, sigma, shape=(), dtype="float32", rng_key=None):
     s = _shape(shape)
     z = jax.random.normal(rng_key, mu.shape + s, dtype=jnp.dtype(dtype))
@@ -116,7 +118,8 @@ def sample_normal(mu, sigma, shape=(), dtype="float32", rng_key=None):
         sigma.reshape(sigma.shape + (1,) * len(s)) * z
 
 
-@register(name="sample_gamma", differentiable=False, stateful_rng=True)
+@register(name="sample_gamma", aliases=("_sample_gamma",),
+          differentiable=False, stateful_rng=True)
 def sample_gamma(alpha, beta, shape=(), dtype="float32", rng_key=None):
     s = _shape(shape)
     a = alpha.reshape(alpha.shape + (1,) * len(s))
@@ -125,14 +128,16 @@ def sample_gamma(alpha, beta, shape=(), dtype="float32", rng_key=None):
     return g * beta.reshape(beta.shape + (1,) * len(s))
 
 
-@register(name="sample_exponential", differentiable=False, stateful_rng=True)
+@register(name="sample_exponential", aliases=("_sample_exponential",),
+          differentiable=False, stateful_rng=True)
 def sample_exponential(lam, shape=(), dtype="float32", rng_key=None):
     s = _shape(shape)
     e = jax.random.exponential(rng_key, lam.shape + s, dtype=jnp.dtype(dtype))
     return e / lam.reshape(lam.shape + (1,) * len(s))
 
 
-@register(name="sample_poisson", differentiable=False, stateful_rng=True)
+@register(name="sample_poisson", aliases=("_sample_poisson",),
+          differentiable=False, stateful_rng=True)
 def sample_poisson(lam, shape=(), dtype="float32", rng_key=None):
     s = _shape(shape)
     p = jax.random.poisson(rng_key, lam.reshape(lam.shape + (1,) * len(s)),
@@ -140,27 +145,106 @@ def sample_poisson(lam, shape=(), dtype="float32", rng_key=None):
     return p.astype(jnp.dtype(dtype))
 
 
+@register(name="sample_negative_binomial", aliases=("_sample_negative_binomial",),
+          differentiable=False, stateful_rng=True)
+def sample_negative_binomial(k, p, shape=(), dtype="float32", rng_key=None):
+    s = _shape(shape)
+    k1, k2 = jax.random.split(rng_key)
+    kk = k.reshape(k.shape + (1,) * len(s))
+    pp = p.reshape(p.shape + (1,) * len(s))
+    lam = jax.random.gamma(k1, kk, k.shape + s) * ((1 - pp) / pp)
+    return jax.random.poisson(k2, lam, k.shape + s).astype(jnp.dtype(dtype))
+
+
+@register(name="sample_generalized_negative_binomial",
+          aliases=("_sample_generalized_negative_binomial",),
+          differentiable=False, stateful_rng=True)
+def sample_gen_negative_binomial(mu, alpha, shape=(), dtype="float32",
+                                 rng_key=None):
+    s = _shape(shape)
+    k1, k2 = jax.random.split(rng_key)
+    m = mu.reshape(mu.shape + (1,) * len(s))
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(k1, 1.0 / a, mu.shape + s) * (a * m)
+    return jax.random.poisson(k2, g, mu.shape + s).astype(jnp.dtype(dtype))
+
+
 # --------------------------------------------------------------- pdf ops --
-@register(name="_backward_guard_pdf", differentiable=False)
-def _noop(x):
-    return x
+# Reference: src/operator/random/pdf_op.{cc,h} — per-sample (log-)density
+# given a leading batch of distribution parameters. Parameter tensors have
+# shape (s...); sample adds a trailing draws axis (s..., m). Gradients come
+# from jax.vjp on the closed-form log-density instead of the hand-written
+# PDF_*_Grad kernels.
+def _pbc(parm, sample):
+    """Broadcast a parameter tensor against the sample's trailing draw axis."""
+    return parm[..., None] if sample.ndim > parm.ndim else parm
 
 
-@register(name="pdf_uniform")
+@register(name="_random_pdf_uniform", aliases=("pdf_uniform",))
 def pdf_uniform(sample, low, high, is_log=False):
-    p = 1.0 / (high - low)
-    inside = (sample >= low[..., None]) & (sample <= high[..., None]) \
-        if sample.ndim > low.ndim else (sample >= low) & (sample <= high)
-    pb = p[..., None] if sample.ndim > low.ndim else p
-    out = jnp.where(inside, pb, 0.0)
+    low, high = _pbc(low, sample), _pbc(high, sample)
+    inside = (sample >= low) & (sample <= high)
+    out = jnp.where(inside, 1.0 / (high - low), 0.0)
     return jnp.log(out) if is_log else out
 
 
-@register(name="pdf_normal")
+@register(name="_random_pdf_normal", aliases=("pdf_normal",))
 def pdf_normal(sample, mu, sigma, is_log=False):
-    if sample.ndim > mu.ndim:
-        mu = mu[..., None]
-        sigma = sigma[..., None]
+    mu, sigma = _pbc(mu, sample), _pbc(sigma, sample)
     logp = -0.5 * jnp.square((sample - mu) / sigma) - jnp.log(
         sigma * jnp.sqrt(2 * jnp.pi))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register(name="_random_pdf_gamma", aliases=("pdf_gamma",))
+def pdf_gamma(sample, alpha, beta, is_log=False):
+    a, b = _pbc(alpha, sample), _pbc(beta, sample)
+    logp = a * jnp.log(b) + (a - 1) * jnp.log(sample) - b * sample \
+        - jax.scipy.special.gammaln(a)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register(name="_random_pdf_exponential", aliases=("pdf_exponential",))
+def pdf_exponential(sample, lam, is_log=False):
+    lam = _pbc(lam, sample)
+    logp = jnp.log(lam) - lam * sample
+    return logp if is_log else jnp.exp(logp)
+
+
+@register(name="_random_pdf_poisson", aliases=("pdf_poisson",))
+def pdf_poisson(sample, lam, is_log=False):
+    lam = _pbc(lam, sample)
+    logp = sample * jnp.log(lam) - lam - jax.scipy.special.gammaln(sample + 1)
+    return logp if is_log else jnp.exp(logp)
+
+
+def _negbin_logpdf(x, limit, prob):
+    """lgamma(x+l) - lgamma(x+1) - lgamma(l) + l*log(p) + x*log(1-p); `prob`
+    is the failure probability, matching the reference kernel."""
+    lg = jax.scipy.special.gammaln
+    return (lg(x + limit) - lg(x + 1) - lg(limit)
+            + limit * jnp.log(prob) + x * jnp.log(1 - prob))
+
+
+@register(name="_random_pdf_negative_binomial", aliases=("pdf_negative_binomial",))
+def pdf_negative_binomial(sample, k, p, is_log=False):
+    logp = _negbin_logpdf(sample, _pbc(k, sample), _pbc(p, sample))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register(name="_random_pdf_generalized_negative_binomial",
+          aliases=("pdf_generalized_negative_binomial",))
+def pdf_gen_negative_binomial(sample, mu, alpha, is_log=False):
+    mu, alpha = _pbc(mu, sample), _pbc(alpha, sample)
+    logp = _negbin_logpdf(sample, 1.0 / alpha, 1.0 / (mu * alpha + 1.0))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register(name="_random_pdf_dirichlet", aliases=("pdf_dirichlet",))
+def pdf_dirichlet(sample, alpha, is_log=False):
+    """alpha: (s..., k); sample: (s..., [m,] k) — density over the last axis."""
+    lg = jax.scipy.special.gammaln
+    a = alpha[..., None, :] if sample.ndim > alpha.ndim else alpha
+    logp = jnp.sum((a - 1) * jnp.log(sample), axis=-1) \
+        + lg(jnp.sum(a, axis=-1)) - jnp.sum(lg(a), axis=-1)
     return logp if is_log else jnp.exp(logp)

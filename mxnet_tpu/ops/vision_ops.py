@@ -630,3 +630,155 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         trans2 = trans.reshape(
             rois.shape[0], -1, part_size, part_size)[:, :2]
     return jax.vmap(one_roi)(rois, trans2)
+
+
+@register(name="_contrib_edge_id", differentiable=False)
+def edge_id(indptr, indices, data, u, v):
+    """contrib/dgl_graph.cc `_contrib_edge_id`: out[i] = edge value stored
+    at (u[i], v[i]) in the CSR graph, -1 when absent. The reference takes
+    one CSR NDArray; on TPU the CSR pieces arrive as three dense inputs
+    (same convention as the other graph ops here). Eager-only."""
+    import numpy as onp
+    ip = onp.asarray(indptr).astype(onp.int64)
+    ix = onp.asarray(indices).astype(onp.int64)
+    dat = onp.asarray(data)
+    uu = onp.asarray(u).astype(onp.int64).ravel()
+    vv = onp.asarray(v).astype(onp.int64).ravel()
+    out = onp.full(uu.shape, -1, dat.dtype)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = ip[a], ip[a + 1]
+        hit = onp.nonzero(ix[lo:hi] == b)[0]
+        if hit.size:
+            out[i] = dat[lo + hit[0]]
+    return jnp.asarray(out)
+
+
+@register(name="_contrib_dgl_adjacency", differentiable=False)
+def dgl_adjacency(data):
+    """CSR edge-id values -> adjacency ones (float32); structure (indptr/
+    indices) passes through outside the op."""
+    return jnp.ones_like(data, dtype=jnp.float32)
+
+
+@register(name="_contrib_getnnz", differentiable=False)
+def getnnz(indptr, indices, axis=None, num_cols=0):
+    """Number of stored values of a CSR graph: total (axis=None), per-row
+    (axis=1), or per-column (axis=0, needs num_cols when the graph has
+    trailing empty columns). Eager-only host op."""
+    import numpy as onp
+    ip = onp.asarray(indptr).astype(onp.int64)
+    ix = onp.asarray(indices).astype(onp.int64)
+    if axis is None:
+        return jnp.asarray(onp.asarray([ix.shape[0]], onp.int64))
+    if axis == 1:
+        return jnp.asarray(ip[1:] - ip[:-1])
+    n = int(num_cols) or (int(ix.max()) + 1 if ix.size else 0)
+    return jnp.asarray(onp.bincount(ix, minlength=n).astype(onp.int64))
+
+
+@register(name="_contrib_dgl_csr_neighbor_non_uniform_sample",
+          differentiable=False, num_outputs="n", stateful_rng=True)
+def dgl_csr_neighbor_non_uniform_sample(indptr, indices, probability, *seeds,
+                                        num_args=3, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100, rng_key=None):
+    """Weighted variant of the uniform sampler: neighbors are drawn
+    without replacement with probability proportional to
+    `probability[vertex]`. Same padded-vertex-vector output layout."""
+    import numpy as onp
+    indptr_np = onp.asarray(indptr).astype(onp.int64)
+    indices_np = onp.asarray(indices).astype(onp.int64)
+    prob = onp.asarray(probability).astype(onp.float64).ravel()
+    if rng_key is not None:
+        try:
+            seed_bits = onp.asarray(jax.random.key_data(rng_key)).ravel()
+        except Exception:
+            seed_bits = onp.asarray(rng_key).ravel()
+        seed = int(onp.uint32(seed_bits[-1]))
+    else:
+        seed = 0
+    rng = onp.random.RandomState(seed)
+    cap = int(max_num_vertices) - 1
+    outs = []
+    for seed_arr in seeds:
+        frontier = [int(v) for v in onp.asarray(seed_arr).ravel() if v >= 0]
+        visited = list(dict.fromkeys(frontier))[:cap]
+        seen = set(visited)
+        for _ in range(int(num_hops)):
+            if len(visited) >= cap:
+                break
+            nxt = []
+            for vtx in frontier:
+                lo, hi = indptr_np[vtx], indptr_np[vtx + 1]
+                neigh = indices_np[lo:hi]
+                if len(neigh) > num_neighbor:
+                    p = prob[neigh]
+                    tot = p.sum()
+                    if tot > 0:
+                        nz = int((p > 0).sum())
+                        if nz <= num_neighbor:
+                            # fewer positive-weight neighbors than requested:
+                            # take exactly those (choice would raise)
+                            neigh = neigh[p > 0]
+                        else:
+                            neigh = rng.choice(neigh, size=int(num_neighbor),
+                                               replace=False, p=p / tot)
+                    else:
+                        neigh = rng.choice(neigh, size=int(num_neighbor),
+                                           replace=False)
+                nxt.extend(int(x) for x in neigh)
+            fresh = []
+            for x in dict.fromkeys(nxt):
+                if x not in seen:
+                    seen.add(x)
+                    fresh.append(x)
+                    if len(visited) + len(fresh) >= cap:
+                        break
+            visited.extend(fresh)
+            frontier = fresh
+        out = onp.full((max_num_vertices,), -1, onp.int64)
+        out[:len(visited)] = visited
+        out[-1] = len(visited)
+        outs.append(jnp.asarray(out))
+    return outs
+
+
+@register(name="_contrib_dgl_graph_compact", differentiable=False,
+          num_outputs="n")
+def dgl_graph_compact(*args, graph_sizes=(), return_mapping=False,
+                      num_args=None):
+    """contrib/dgl_graph.cc `_contrib_dgl_graph_compact`: drop the empty
+    trailing rows/columns a sampled sub-CSR carries and renumber vertices
+    by their position in the sampled vertex list. Inputs arrive as
+    (indptr, indices, data, vertices) quadruples per graph — the CSR-
+    pieces convention used by all graph ops here. Eager-only."""
+    if return_mapping:
+        raise NotImplementedError(
+            "dgl_graph_compact return_mapping=True is not implemented")
+    import numpy as onp
+    if isinstance(graph_sizes, int):
+        graph_sizes = (graph_sizes,)
+    quads = [args[i:i + 4] for i in range(0, len(args), 4)]
+    outs = []
+    for k, (indptr, indices, data, verts) in enumerate(quads):
+        ip = onp.asarray(indptr).astype(onp.int64)
+        ix = onp.asarray(indices).astype(onp.int64)
+        dat = onp.asarray(data)
+        size = int(graph_sizes[k]) if k < len(graph_sizes) else \
+            int(onp.asarray(verts).ravel()[-1])
+        vs = [int(v) for v in onp.asarray(verts).ravel()[:size]]
+        remap = {v: i for i, v in enumerate(vs)}
+        new_ip = [0]
+        new_ix = []
+        new_dat = []
+        for v in vs:
+            for j in range(int(ip[v]), int(ip[v + 1])):
+                col = int(ix[j])
+                if col in remap:
+                    new_ix.append(remap[col])
+                    new_dat.append(dat[j])
+            new_ip.append(len(new_ix))
+        outs.append(jnp.asarray(onp.asarray(new_ip, onp.int64)))
+        outs.append(jnp.asarray(onp.asarray(new_ix, onp.int64)))
+        outs.append(jnp.asarray(onp.asarray(new_dat, dat.dtype)))
+    return outs

@@ -497,14 +497,41 @@ def _node_num_outputs(node):
     if node.op in ("BatchNorm", "_contrib_SyncBatchNorm"):
         return 1  # mean/var are internal plumbing, not user outputs
     if op.num_outputs == "n":
-        if node.op in ("SliceChannel", "split"):
-            return int(node.attrs.get("num_outputs", 1))
-        if node.op == "topk":
-            return 2 if node.attrs.get("ret_typ") == "both" else 1
-        if node.op == "RNN":
-            return 3 if node.attrs.get("mode", "lstm") == "lstm" else 2
+        resolver = _VARIADIC_ARITY.get(node.op)
+        if resolver is not None:
+            return resolver(node.attrs)
         return 1
     return op.num_outputs
+
+
+def _seed_count(attrs, csr_inputs):
+    """Graph samplers: outputs = one vertex vector per seed array."""
+    return max(int(attrs.get("num_args", csr_inputs + 1)) - csr_inputs, 1)
+
+
+# arity of num_outputs=="n" ops as a function of their static attrs
+# (the symbolic analogue of the reference's set_num_outputs lambdas)
+_VARIADIC_ARITY = {
+    "SliceChannel": lambda a: int(a.get("num_outputs", 1)),
+    "split": lambda a: int(a.get("num_outputs", 1)),
+    "topk": lambda a: 2 if a.get("ret_typ") == "both" else 1,
+    "RNN": lambda a: 3 if a.get("mode", "lstm") == "lstm" else 2,
+    "_split_v2": lambda a: (int(a["sections"]) if int(a.get("sections", 0)) > 0
+                            else len(tuple(a.get("indices", ())))),
+    "amp_multicast": lambda a: int(a.get("num_outputs", 1)),
+    "multi_sgd_update": lambda a: int(a.get("num_weights", 1)),
+    "multi_sgd_mom_update": lambda a: int(a.get("num_weights", 1)),
+    "preloaded_multi_sgd_update": lambda a: int(a.get("num_weights", 1)),
+    "preloaded_multi_sgd_mom_update": lambda a: int(a.get("num_weights", 1)),
+    "multi_mp_sgd_update": lambda a: int(a.get("num_weights", 1)),
+    "multi_mp_sgd_mom_update": lambda a: int(a.get("num_weights", 1)),
+    "_contrib_dgl_csr_neighbor_uniform_sample": lambda a: _seed_count(a, 2),
+    "_contrib_dgl_csr_neighbor_non_uniform_sample":
+        lambda a: _seed_count(a, 3),
+    "_contrib_dgl_subgraph": lambda a: 2 * _seed_count(a, 2),
+    "_contrib_dgl_graph_compact": lambda a: 3 * max(
+        int(a.get("num_args", 4)) // 4, 1),
+}
 
 
 def _out_key(nodes, ni, oi):

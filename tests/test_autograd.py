@@ -230,3 +230,16 @@ def test_rnn_op_grad():
         loss = out[0].sum() if isinstance(out, list) else out.sum()
     loss.backward()
     assert params.grad.asnumpy().std() > 0
+
+
+def test_astype_preserves_tape():
+    """astype inside record() must route through Cast so mixed-precision
+    chains (bf16 logits -> fp32 loss) stay differentiable."""
+    import numpy as np
+    x = mx.nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * 2).astype("float16")
+        loss = mx.nd.sum(y.astype("float32") * 3)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0)

@@ -1,0 +1,481 @@
+"""Reference-parity tests for the op families added in round 2:
+_split_v2 / slice-assign / ravel / pdf family / multi-precision optimizer
+updates / int8 quantized ops / graph ops / _np internal ops.
+
+Reference semantics: src/operator/tensor/matrix_op.cc, random/pdf_op.cc,
+optimizer_op.cc (MP kernels), quantization/, contrib/dgl_graph.cc,
+contrib/bounding_box.cc (bipartite matching), contrib/rroi_align.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_split_v2_sections_and_indices():
+    a = np.arange(24).reshape(6, 4).astype(np.float32)
+    parts = nd._split_v2(nd.array(a), sections=3, axis=0)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].asnumpy(), a[2:4])
+    # raw-op convention: indices are section STARTS incl. the leading 0
+    # (python/mxnet/ndarray/ndarray.py split_v2 prepends it)
+    parts = nd._split_v2(nd.array(a), indices=(0, 1, 4), axis=0)
+    assert [p.shape[0] for p in parts] == [1, 3, 2]
+    parts = nd._split_v2(nd.array(a), sections=4, axis=1, squeeze_axis=True)
+    assert parts[0].shape == (6,)
+    np.testing.assert_allclose(parts[2].asnumpy(), a[:, 2])
+    # wrapper accepts split points without the leading 0
+    parts = nd.split_v2(nd.array(a), (1, 4), axis=0)
+    assert [p.shape[0] for p in parts] == [1, 3, 2]
+    np.testing.assert_allclose(parts[1].asnumpy(), a[1:4])
+
+
+def test_split_v2_symbolic_arity():
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    out = sym._split_v2(data, indices=(0, 2, 5), axis=0)
+    assert len(out.list_outputs()) == 3
+    ex = out.bind(mx.cpu(), {"data": nd.array(np.arange(12, dtype=np.float32))})
+    o = ex.forward()
+    assert [x.shape[0] for x in o] == [2, 3, 7]
+
+
+def test_slice_assign():
+    a = np.zeros((4, 5), np.float32)
+    rhs = np.ones((2, 3), np.float32) * 7
+    out = nd._slice_assign(nd.array(a), nd.array(rhs),
+                           begin=(1, 1), end=(3, 4))
+    expect = a.copy()
+    expect[1:3, 1:4] = rhs
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    out = nd._slice_assign_scalar(nd.array(a), scalar=5.0,
+                                  begin=(0, 0), end=(2, 2))
+    expect = a.copy()
+    expect[0:2, 0:2] = 5.0
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (5, 7, 3)
+    coords = np.array([[4, 0, 2], [6, 1, 5], [2, 2, 0]], np.int32)
+    flat = nd._ravel_multi_index(nd.array(coords, dtype="int32"),
+                                 shape=shape)
+    expect = np.ravel_multi_index(tuple(coords), shape)
+    np.testing.assert_array_equal(flat.asnumpy(), expect)
+    back = nd._unravel_index(flat, shape=shape)
+    np.testing.assert_array_equal(back.asnumpy(), coords)
+
+
+def test_rnn_param_concat_and_identity_like():
+    a, b = np.arange(6, dtype=np.float32), np.arange(4, dtype=np.float32)
+    out = nd._rnn_param_concat(nd.array(a.reshape(2, 3)), nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), np.concatenate([a, b]))
+    lhs = nd.array(np.ones((2, 2), np.float32))
+    out = nd._identity_with_attr_like_rhs(lhs, nd.array(np.zeros((2, 2))))
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_sparse_retain_dense():
+    a = np.arange(12).reshape(4, 3).astype(np.float32)
+    out = nd._sparse_retain(nd.array(a), nd.array(np.array([1, 3]),
+                                                  dtype="int32"))
+    expect = np.zeros_like(a)
+    expect[[1, 3]] = a[[1, 3]]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+# ------------------------------------------------------------- pdf ops --
+def test_pdf_family_matches_closed_forms():
+    from scipy import stats
+    x = np.array([[0.5, 1.5, 2.5]], np.float32)
+    lam = np.array([1.3], np.float32)
+    np.testing.assert_allclose(
+        nd._random_pdf_exponential(nd.array(x), nd.array(lam)).asnumpy(),
+        stats.expon.pdf(x, scale=1 / lam), rtol=1e-5)
+    a, b = np.array([2.0], np.float32), np.array([1.5], np.float32)
+    # reference pdf_op.h PDF_Gamma treats beta as a RATE
+    np.testing.assert_allclose(
+        nd._random_pdf_gamma(nd.array(x), nd.array(a), nd.array(b)).asnumpy(),
+        stats.gamma.pdf(x, a=2.0, scale=1 / 1.5), rtol=1e-5)
+    k = np.array([0.0, 1.0, 3.0], np.float32).reshape(1, 3)
+    np.testing.assert_allclose(
+        nd._random_pdf_poisson(nd.array(k), nd.array(lam)).asnumpy(),
+        stats.poisson.pmf(k, mu=lam), rtol=1e-5)
+    mu, sig = np.array([0.5], np.float32), np.array([2.0], np.float32)
+    np.testing.assert_allclose(
+        nd._random_pdf_normal(nd.array(x), nd.array(mu),
+                              nd.array(sig)).asnumpy(),
+        stats.norm.pdf(x, 0.5, 2.0), rtol=1e-5)
+    lo, hi = np.array([0.0], np.float32), np.array([2.0], np.float32)
+    np.testing.assert_allclose(
+        nd._random_pdf_uniform(nd.array(x), nd.array(lo),
+                               nd.array(hi)).asnumpy(),
+        stats.uniform.pdf(x, 0, 2), rtol=1e-5)
+    kk = np.array([3.0], np.float32)
+    pp = np.array([0.6], np.float32)
+    cnt = np.array([[0.0, 2.0, 5.0]], np.float32)
+    # reference kernel: p is the FAILURE probability
+    np.testing.assert_allclose(
+        nd._random_pdf_negative_binomial(
+            nd.array(cnt), nd.array(kk), nd.array(pp)).asnumpy(),
+        stats.nbinom.pmf(cnt, 3, 0.6), rtol=1e-5)
+
+
+def test_pdf_dirichlet_and_gennegbinomial():
+    alpha = np.array([[1.5, 2.0, 2.5]], np.float32)
+    s = np.array([[0.2, 0.3, 0.5]], np.float32)
+    from scipy import stats
+    got = mx.nd._random_pdf_dirichlet(mx.nd.array(s),
+                                      mx.nd.array(alpha)).asnumpy()
+    np.testing.assert_allclose(got, stats.dirichlet.pdf(s[0], alpha[0]),
+                               rtol=1e-4)
+    mu, a = np.array([2.0], np.float32), np.array([0.5], np.float32)
+    x = np.array([[0.0, 1.0, 4.0]], np.float32)
+    # limit=1/alpha, prob=1/(mu*alpha+1): nbinom(n=2, p=0.5)
+    np.testing.assert_allclose(
+        mx.nd._random_pdf_generalized_negative_binomial(
+            mx.nd.array(x), mx.nd.array(mu), mx.nd.array(a)).asnumpy(),
+        stats.nbinom.pmf(x, 2, 0.5), rtol=1e-5)
+    # is_log consistency
+    lg = mx.nd._random_pdf_dirichlet(mx.nd.array(s), mx.nd.array(alpha),
+                                     is_log=True).asnumpy()
+    np.testing.assert_allclose(np.exp(lg), got, rtol=1e-5)
+
+
+def test_parameterized_samplers_shapes():
+    mx.random.seed(7)
+    k = nd.array(np.array([2.0, 5.0], np.float32))
+    p = nd.array(np.array([0.4, 0.7], np.float32))
+    out = nd.sample_negative_binomial(k, p, shape=(1000,))
+    assert out.shape == (2, 1000)
+    m = out.asnumpy().mean(axis=1)
+    expect = k.asnumpy() * (1 - p.asnumpy()) / p.asnumpy()
+    np.testing.assert_allclose(m, expect, rtol=0.25)
+    mu = nd.array(np.array([3.0], np.float32))
+    al = nd.array(np.array([0.4], np.float32))
+    out = nd.sample_generalized_negative_binomial(mu, al, shape=(2000,))
+    np.testing.assert_allclose(out.asnumpy().mean(), 3.0, rtol=0.2)
+
+
+# ---------------------------------------------------- optimizer parity --
+def test_mp_sgd_updates_master_weights():
+    w32 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    w16 = nd.array(w32.astype(np.float16), dtype="float16")
+    g16 = nd.array(np.full((4, 3), 0.25, np.float16), dtype="float16")
+    master = nd.array(w32)
+    out = nd.mp_sgd_update(w16, g16, master, lr=0.1, wd=0.01)
+    expect = w32 - 0.1 * (0.25 + 0.01 * w32)
+    np.testing.assert_allclose(master.asnumpy(), expect, rtol=1e-6)
+    assert out.dtype == np.float16
+    np.testing.assert_allclose(out.asnumpy(), expect.astype(np.float16),
+                               rtol=1e-3)
+
+
+def test_mp_sgd_mom_and_nag_state_advance():
+    w32 = np.ones((3,), np.float32)
+    for op, formula in [("mp_sgd_mom_update", "mom"),
+                        ("mp_nag_mom_update", "nag")]:
+        w16 = nd.array(w32.astype(np.float16), dtype="float16")
+        g = nd.array(np.full((3,), 0.5, np.float16), dtype="float16")
+        mom = nd.array(np.zeros((3,), np.float32))
+        master = nd.array(w32)
+        getattr(nd, op)(w16, g, mom, master, lr=0.1, momentum=0.9)
+        if formula == "mom":
+            expect_mom = -0.1 * 0.5
+            expect_w = 1.0 + expect_mom
+        else:
+            expect_mom = 0.5
+            expect_w = 1.0 - 0.1 * (0.9 * 0.5 + 0.5)
+        np.testing.assert_allclose(mom.asnumpy(), expect_mom, rtol=1e-6)
+        np.testing.assert_allclose(master.asnumpy(), expect_w, rtol=1e-6)
+
+
+def test_multi_mp_sgd():
+    ws = [np.random.RandomState(i).randn(3).astype(np.float32)
+          for i in range(2)]
+    arrays = []
+    masters = []
+    for w in ws:
+        w16 = nd.array(w.astype(np.float16), dtype="float16")
+        g16 = nd.array((w * 0 + 0.5).astype(np.float16), dtype="float16")
+        m = nd.array(w)
+        masters.append(m)
+        arrays += [w16, g16, m]
+    outs = nd.multi_mp_sgd_update(*arrays, lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                                  num_weights=2)
+    for i, (w, m) in enumerate(zip(ws, masters)):
+        expect = w - (0.1, 0.2)[i] * 0.5
+        np.testing.assert_allclose(m.asnumpy(), expect, rtol=1e-6)
+
+
+def test_sparse_and_group_adagrad():
+    w = np.ones((4, 2), np.float32)
+    g = np.full((4, 2), 2.0, np.float32)
+    hist = nd.array(np.zeros((4, 2), np.float32))
+    with pytest.raises(ValueError):      # reference fails fast on wd != 0
+        nd._sparse_adagrad_update(nd.array(w), nd.array(g), hist, lr=0.1,
+                                  wd=0.01)
+    out = nd._sparse_adagrad_update(nd.array(w), nd.array(g), hist, lr=0.1,
+                                    epsilon=1e-7)
+    np.testing.assert_allclose(hist.asnumpy(), 4.0)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * 2.0 / 2.0,
+                               rtol=1e-5)
+    ghist = nd.array(np.zeros((4, 1), np.float32))
+    out = nd._contrib_group_adagrad_update(nd.array(w), nd.array(g), ghist,
+                                           lr=0.1)
+    np.testing.assert_allclose(ghist.asnumpy(), 4.0)   # mean over the row
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-4)
+
+
+# ------------------------------------------------------------ quantized --
+def test_quantize_v1_roundtrip():
+    x = np.linspace(-2, 2, 32).astype(np.float32).reshape(4, 8)
+    q, mn, mxr = nd._contrib_quantize(nd.array(x), nd.array([-2.0]),
+                                      nd.array([2.0]))
+    assert q.dtype == np.int8
+    back = nd._contrib_dequantize(q, mn, mxr)
+    assert np.abs(back.asnumpy() - x).max() < 2.0 / 127 + 1e-6
+
+
+def test_quantized_act_pool_flatten():
+    x = np.linspace(-2, 2, 64).astype(np.float32).reshape(1, 1, 8, 8)
+    q, mn, mxr = nd._contrib_quantize_v2(nd.array(x), min_calib_range=-2.0,
+                                         max_calib_range=2.0)
+    a, amn, amx = nd._contrib_quantized_act(q, mn, mxr, act_type="relu")
+    assert a.asnumpy().min() >= 0
+    # asymmetric range: the scale is max(|min|,|max|) — relu must NOT
+    # clamp the min range or the untouched payload silently rescales
+    x2 = np.array([1.0, -3.0, 0.5], np.float32)
+    q2, mn2, mx2 = nd._contrib_quantize_v2(nd.array(x2), min_calib_range=-4.0,
+                                           max_calib_range=2.0)
+    a2, amn2, amx2 = nd._contrib_quantized_act(q2, mn2, mx2, act_type="relu")
+    deq2 = nd._contrib_dequantize(a2, amn2, amx2).asnumpy()
+    np.testing.assert_allclose(deq2, [1.0, 0.0, 0.5], atol=4.0 / 127 + 1e-6)
+    p, pmn, pmx = nd._contrib_quantized_pooling(q, mn, mxr, kernel=(2, 2),
+                                                stride=(2, 2),
+                                                pool_type="max")
+    assert p.shape == (1, 1, 4, 4)
+    ref = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    deq = nd._contrib_dequantize(p, pmn, pmx).asnumpy()
+    assert np.abs(deq - ref).max() < 2.0 / 127 + 1e-6
+    f, fmn, fmx = nd._contrib_quantized_flatten(q, mn, mxr)
+    assert f.shape == (1, 64)
+    # int8 avg pool truncates negative sums toward zero like C++ int division
+    neg = np.full((1, 1, 2, 2), -1, np.int8)
+    neg[0, 0, 0, 0] = 0
+    p2, _, _ = nd._contrib_quantized_pooling(
+        nd.array(neg, dtype="int8"), mn, mxr, kernel=(2, 2), stride=(2, 2),
+        pool_type="avg")
+    assert int(p2.asnumpy().ravel()[0]) == 0    # -3 // 4 would give -1
+
+
+def test_quantized_elemwise_add_and_concat():
+    x = np.linspace(-1, 1, 16).astype(np.float32)
+    y = np.linspace(-0.5, 0.5, 16).astype(np.float32)
+    qx, xmn, xmx = nd._contrib_quantize_v2(nd.array(x), min_calib_range=-1.0,
+                                           max_calib_range=1.0)
+    qy, ymn, ymx = nd._contrib_quantize_v2(nd.array(y),
+                                           min_calib_range=-0.5,
+                                           max_calib_range=0.5)
+    s, smn, smx = nd._contrib_quantized_elemwise_add(qx, qy, xmn, xmx,
+                                                     ymn, ymx)
+    assert s.dtype == np.int32
+    real = s.asnumpy().astype(np.float64) * \
+        max(abs(float(smn.asnumpy()[0])),
+            abs(float(smx.asnumpy()[0]))) / 2147483647.0
+    np.testing.assert_allclose(real, x + y, atol=2e-2)
+    c, cmn, cmx = nd._contrib_quantized_concat(qx, qy, xmn, xmx, ymn, ymx,
+                                               dim=0, num_args=2)
+    assert c.shape == (32,)
+    deq = nd._contrib_dequantize(c, cmn, cmx).asnumpy()
+    np.testing.assert_allclose(deq, np.concatenate([x, y]), atol=2e-2)
+
+
+def test_quantized_batch_norm():
+    x = np.random.RandomState(3).randn(2, 4, 5, 5).astype(np.float32)
+    gamma = np.random.RandomState(4).rand(4).astype(np.float32) + 0.5
+    beta = np.zeros(4, np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    q, mn, mxr = nd._contrib_quantize_v2(nd.array(x), min_calib_range=-4.0,
+                                         max_calib_range=4.0)
+    out, omn, omx = nd._contrib_quantized_batch_norm(
+        q, nd.array(gamma), nd.array(beta), nd.array(mean), nd.array(var),
+        mn, mxr, eps=1e-5)
+    deq = nd._contrib_dequantize(out, omn, omx).asnumpy()
+    expect = (x - mean.reshape(1, -1, 1, 1)) / \
+        np.sqrt(var.reshape(1, -1, 1, 1) + 1e-5) * \
+        gamma.reshape(1, -1, 1, 1)
+    assert np.abs(deq - expect).max() < 0.15
+
+
+def test_calibrate_entropy_op_reasonable():
+    rs = np.random.RandomState(0)
+    vals = np.abs(rs.randn(100000)).astype(np.float32)
+    hist, edges = np.histogram(vals, bins=1024, range=(0, 8))
+    mn, mxr = nd._contrib_calibrate_entropy(
+        nd.array(hist.astype(np.float32)),
+        nd.array(edges.astype(np.float32)))
+    thr = float(mxr.asnumpy()[0])
+    assert 2.0 < thr < 8.0          # KL threshold clips the gaussian tail
+    assert float(mn.asnumpy()[0]) == -thr
+
+
+# ---------------------------------------------------------- graph ops --
+def _csr_pieces():
+    indptr = nd.array(np.array([0, 2, 3, 5]), dtype="int64")
+    indices = nd.array(np.array([1, 2, 0, 0, 2]), dtype="int64")
+    data = nd.array(np.array([1, 2, 3, 4, 5]), dtype="int64")
+    return indptr, indices, data
+
+
+def test_edge_id_and_getnnz_and_adjacency():
+    ip, ix, d = _csr_pieces()
+    u = nd.array(np.array([0, 0, 1, 2, 2, 2]), dtype="int64")
+    v = nd.array(np.array([1, 0, 0, 0, 2, 1]), dtype="int64")
+    out = nd._contrib_edge_id(ip, ix, d, u, v)
+    np.testing.assert_array_equal(out.asnumpy(), [1, -1, 3, 4, 5, -1])
+    assert int(nd._contrib_getnnz(ip, ix).asnumpy()[0]) == 5
+    np.testing.assert_array_equal(
+        nd._contrib_getnnz(ip, ix, axis=1).asnumpy(), [2, 1, 2])
+    np.testing.assert_array_equal(
+        nd._contrib_getnnz(ip, ix, axis=0, num_cols=3).asnumpy(), [2, 1, 2])
+    ones = nd._contrib_dgl_adjacency(d)
+    assert ones.dtype == np.float32
+    np.testing.assert_allclose(ones.asnumpy(), 1.0)
+
+
+def test_dgl_non_uniform_sample_and_compact():
+    # ring of 6 vertices, edges to (i+1)%6 and (i+2)%6
+    n = 6
+    rows = [[(i + 1) % n, (i + 2) % n] for i in range(n)]
+    indptr = np.cumsum([0] + [len(r) for r in rows])
+    indices = np.concatenate(rows)
+    prob = np.ones(n, np.float32)
+    out = nd._contrib_dgl_csr_neighbor_non_uniform_sample(
+        nd.array(indptr, dtype="int64"), nd.array(indices, dtype="int64"),
+        nd.array(prob), nd.array(np.array([0]), dtype="int64"),
+        num_hops=1, num_neighbor=2, max_num_vertices=6)
+    vs = out[0].asnumpy() if not isinstance(out, list) else out[0].asnumpy()
+    count = vs[-1]
+    got = set(vs[:count])
+    assert 0 in got and got <= {0, 1, 2}
+    # zero-weight neighbors: fewer positive-p neighbors than requested must
+    # not crash — the op takes exactly the positive-weight ones
+    rows3 = [[1, 2, 3], [0], [0], [0]]
+    ip3 = np.cumsum([0] + [len(r) for r in rows3])
+    ix3 = np.concatenate(rows3)
+    prob3 = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+    out3 = nd._contrib_dgl_csr_neighbor_non_uniform_sample(
+        nd.array(ip3, dtype="int64"), nd.array(ix3, dtype="int64"),
+        nd.array(prob3), nd.array(np.array([0]), dtype="int64"),
+        num_hops=1, num_neighbor=2, max_num_vertices=6)
+    vs3 = out3[0].asnumpy()
+    assert set(vs3[:vs3[-1]]) == {0, 1}
+    # compact a 3-vertex subgraph out of the full graph
+    ip, ix, d = _csr_pieces()
+    verts = nd.array(np.array([0, 2, 1, -1]), dtype="int64")
+    outs = nd._contrib_dgl_graph_compact(ip, ix, d, verts,
+                                         graph_sizes=(3,))
+    cip, cix, cdat = [o.asnumpy() for o in outs]
+    # new order [0,2,1] (remap 0->0, 2->1, 1->2):
+    # row 0: cols 1,2 -> 2,1; row 2: cols 0,2 -> 0,1; row 1: col 0 -> 0
+    np.testing.assert_array_equal(cip, [0, 2, 4, 5])
+    np.testing.assert_array_equal(cix, [2, 1, 0, 1, 0])
+    np.testing.assert_array_equal(cdat, [1, 2, 4, 5, 3])
+
+
+def test_bipartite_matching_greedy_order():
+    score = np.array([[0.5, 0.6, 0.3],
+                      [0.2, 0.8, 0.1]], np.float32)
+    rm, cm = nd._contrib_bipartite_matching(nd.array(score),
+                                            threshold=1e-12)
+    np.testing.assert_array_equal(rm.asnumpy(), [0, 1])
+    np.testing.assert_array_equal(cm.asnumpy(), [0, 1, -1])
+    # threshold suppresses weak matches
+    rm, cm = nd._contrib_bipartite_matching(nd.array(score), threshold=0.7)
+    np.testing.assert_array_equal(rm.asnumpy(), [-1, 1])
+    # ascending mode picks the smallest scores
+    rm, cm = nd._contrib_bipartite_matching(nd.array(score), is_ascend=True,
+                                            threshold=0.55)
+    assert rm.asnumpy()[1] == 2          # 0.1 first
+    assert rm.asnumpy()[0] == 0          # then 0.5 (0.2/0.3 cols taken? no:
+    # greedy: 0.1(r1,c2) -> 0.2(r1 taken) -> 0.3(r0,c2 taken) -> 0.5(r0,c0)
+
+
+def test_rroi_align_axis_aligned_matches_crop():
+    # theta=0 rroi over an exact pixel box ~ average of that box
+    data = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    # center (2.5, 2.5), w=h=2 -> covers rows/cols 1.5..3.5
+    rois = np.array([[0, 2.5, 2.5, 2.0, 2.0, 0.0]], np.float32)
+    out = nd._contrib_RROIAlign(nd.array(data), nd.array(rois),
+                                pooled_size=(1, 1), spatial_scale=1.0,
+                                sampling_ratio=2)
+    got = float(out.asnumpy().ravel()[0])
+    assert abs(got - data[0, 0, 2:4, 2:4].mean()) < 1.0
+    # rotating by 90 degrees on a symmetric box keeps the center average
+    rois90 = np.array([[0, 2.5, 2.5, 2.0, 2.0, 90.0]], np.float32)
+    out90 = nd._contrib_RROIAlign(nd.array(data), nd.array(rois90),
+                                  pooled_size=(1, 1), spatial_scale=1.0,
+                                  sampling_ratio=2)
+    assert abs(float(out90.asnumpy().ravel()[0]) - got) < 1e-3
+
+
+def test_sparse_embedding_forward():
+    w = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+    idx = np.array([[1, 3], [5, 9]], np.float32)
+    out = nd._contrib_SparseEmbedding(nd.array(idx), nd.array(w),
+                                      input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w[idx.astype(np.int64)])
+
+
+# ------------------------------------------------------------- np ops --
+def test_np_internal_ops():
+    a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(2).randn(4, 2).astype(np.float32)
+    np.testing.assert_allclose(nd._np_sum(nd.array(a)).asnumpy(), a.sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        nd._np_sum(nd.array(a), axis=1, keepdims=True).asnumpy(),
+        a.sum(axis=1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(nd._np_dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd._npi_tensordot_int_axes(nd.array(a), nd.array(b),
+                                   axes=1).asnumpy(), a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd._npi_tensordot(nd.array(a), nd.array(a), a_axes_summed=(0, 1),
+                          b_axes_summed=(0, 1)).asnumpy(),
+        (a * a).sum(), rtol=1e-4)
+    np.testing.assert_allclose(
+        nd._np_cumsum(nd.array(a), axis=0).asnumpy(), a.cumsum(axis=0),
+        rtol=1e-5)
+    assert nd._np_transpose(nd.array(a)).shape == (4, 3)
+    assert nd._np_reshape(nd.array(a), newshape=(2, 6)).shape == (2, 6)
+    assert nd._np_squeeze(nd.array(a.reshape(3, 1, 4))).shape == (3, 4)
+    assert nd._np_broadcast_to(nd.array(a), shape=(2, 3, 4)).shape == (2, 3, 4)
+    assert nd._npi_zeros(shape=(2, 2)).asnumpy().sum() == 0
+    assert nd._npi_ones(shape=(2, 2), dtype="int32").dtype == np.int32
+    np.testing.assert_array_equal(
+        nd._npi_arange(start=1, stop=7, step=2).asnumpy(), [1, 3, 5])
+    assert int(nd._npi_argmax(nd.array(a)).asnumpy()) == a.argmax()
+    np.testing.assert_allclose(
+        nd._npi_concatenate(nd.array(a), nd.array(a), axis=None).shape[0], 24)
+    assert nd._npi_stack(nd.array(a), nd.array(a), axis=0).shape == (2, 3, 4)
+    np.testing.assert_allclose(
+        nd._npi_true_divide(nd.array(a), nd.array(np.abs(a) + 1)).asnumpy(),
+        a / (np.abs(a) + 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd._npi_rtrue_divide_scalar(nd.array(np.abs(a) + 1),
+                                    scalar=2.0).asnumpy(),
+        2.0 / (np.abs(a) + 1), rtol=1e-5)
+    mx.random.seed(0)
+    u = nd._npi_uniform(low=0, high=1, size=(50,))
+    assert u.shape == (50,) and 0 <= float(u.asnumpy().min())
+
+
+def test_batchnorm_v1_alias_and_custom_exposed():
+    assert "BatchNorm_v1" in mx.ops._ALIAS or "BatchNorm_v1" in mx.ops._REGISTRY
+    assert callable(nd.Custom)
