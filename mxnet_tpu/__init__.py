@@ -40,6 +40,8 @@ from . import callback
 from . import model
 from . import sparse
 ndarray.sparse = sparse  # compressed-storage sparse module (nd.sparse)
+ndarray.csr_matrix = sparse.csr_matrix
+ndarray.row_sparse_array = sparse.row_sparse_array
 from . import parallel
 from . import module
 mod = module  # reference alias (mx.mod)
@@ -66,5 +68,7 @@ from .attribute import AttrScope
 from . import contrib
 from . import utils
 from . import models
+from . import gluon
+from . import rnn
 from . import numpy as np
 from . import numpy_extension as npx

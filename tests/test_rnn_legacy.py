@@ -1,0 +1,177 @@
+"""Tests for the legacy symbolic mx.rnn package (reference:
+python/mxnet/rnn/ + tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bind_unroll(cell, length, input_dim, batch=2, **unroll_kw):
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(length, data, **unroll_kw)
+    if isinstance(outputs, list):
+        outputs = mx.sym.Group(outputs)
+    rs = np.random.RandomState(0)
+    args = {"data": nd.array(rs.rand(batch, length, input_dim)
+                             .astype(np.float32))}
+    for name in outputs.list_arguments():
+        if name == "data":
+            continue
+        shape = None
+        args[name] = None
+    # infer shapes then make random params
+    arg_shapes, _, _ = outputs.infer_shape(data=(batch, length, input_dim))
+    for name, shp in zip(outputs.list_arguments(), arg_shapes):
+        if name != "data":
+            args[name] = nd.array(rs.rand(*shp).astype(np.float32) * 0.1)
+    ex = outputs.bind(mx.cpu(), args)
+    return ex.forward(), outputs
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(num_hidden=8, prefix="rnn_")
+    outs, sym = _bind_unroll(cell, 3, 4)
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 8)
+    names = sorted(cell.params._params)
+    assert names == ["rnn_h2h_bias", "rnn_h2h_weight",
+                     "rnn_i2h_bias", "rnn_i2h_weight"]
+
+
+def test_lstm_cell_unroll_merged():
+    cell = mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_")
+    outs, sym = _bind_unroll(cell, 3, 4, merge_outputs=True)
+    assert outs[0].shape == (2, 3, 8)
+
+
+def test_gru_matches_manual_step():
+    """One unrolled GRU step equals the hand-computed gate math."""
+    nh, ni = 3, 2
+    cell = mx.rnn.GRUCell(num_hidden=nh, prefix="gru_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(1, data, merge_outputs=False)
+    out = outputs[0]
+    rs = np.random.RandomState(1)
+    x = rs.rand(1, 1, ni).astype(np.float32)
+    params = {}
+    shapes, _, _ = out.infer_shape(data=(1, 1, ni))
+    for name, shp in zip(out.list_arguments(), shapes):
+        if name != "data":
+            params[name] = rs.rand(*shp).astype(np.float32) * 0.3
+    ex = out.bind(mx.cpu(), {"data": nd.array(x),
+                             **{k: nd.array(v) for k, v in params.items()}})
+    got = ex.forward()[0].asnumpy()
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+    xs = x[0]
+    i2h = xs @ params["gru_i2h_weight"].T + params["gru_i2h_bias"]
+    h0 = np.zeros((1, nh), np.float32)
+    h2h = h0 @ params["gru_h2h_weight"].T + params["gru_h2h_bias"]
+    ir, iz, io = np.split(i2h, 3, axis=1)
+    hr, hz, ho = np.split(h2h, 3, axis=1)
+    r = sigmoid(ir + hr)
+    z = sigmoid(iz + hz)
+    cand = np.tanh(io + r * ho)
+    expect = (1 - z) * cand + z * h0
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_stack_and_residual():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(num_hidden=8,
+                                                 prefix="l1_")))
+    outs, sym = _bind_unroll(stack, 3, 8, merge_outputs=True)
+    assert outs[0].shape == (2, 3, 8)
+    assert len(stack.state_info) == 3          # lstm h,c + gru h
+
+
+def test_bidirectional_concat():
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=4, prefix="l_"),
+        mx.rnn.LSTMCell(num_hidden=4, prefix="r_"))
+    outs, sym = _bind_unroll(bi, 3, 5, merge_outputs=True)
+    assert outs[0].shape == (2, 3, 8)          # 2 * num_hidden
+
+
+def test_fused_cell_unroll_and_unfuse():
+    fused = mx.rnn.FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                                prefix="lstm_")
+    outs, sym = _bind_unroll(fused, 4, 6, merge_outputs=True)
+    assert outs[0].shape == (2, 4, 8)
+    stack = fused.unfuse()
+    assert isinstance(stack, mx.rnn.SequentialRNNCell)
+    outs2, _ = _bind_unroll(stack, 4, 6, merge_outputs=True)
+    assert outs2[0].shape == (2, 4, 8)
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="lstm_")
+    rs = np.random.RandomState(0)
+    fused = {
+        "lstm_i2h_weight": nd.array(rs.rand(16, 5).astype(np.float32)),
+        "lstm_i2h_bias": nd.array(rs.rand(16).astype(np.float32)),
+        "lstm_h2h_weight": nd.array(rs.rand(16, 4).astype(np.float32)),
+        "lstm_h2h_bias": nd.array(rs.rand(16).astype(np.float32)),
+    }
+    unpacked = cell.unpack_weights(dict(fused))
+    assert "lstm_i2h_i_weight" in unpacked
+    assert unpacked["lstm_i2h_f_weight"].shape == (4, 5)
+    packed = cell.pack_weights(unpacked)
+    for k, v in fused.items():
+        np.testing.assert_allclose(packed[k].asnumpy(), v.asnumpy())
+
+
+def test_zoneout_and_dropout_cells():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(num_hidden=4, prefix="rnn_"),
+                              zoneout_outputs=0.3)
+    outs, _ = _bind_unroll(cell, 3, 4)
+    assert outs[0].shape == (2, 4)
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.RNNCell(num_hidden=4, prefix="a_"))
+    stack.add(mx.rnn.DropoutCell(0.5))
+    stack.add(mx.rnn.RNNCell(num_hidden=4, prefix="b_"))
+    outs, _ = _bind_unroll(stack, 2, 4, merge_outputs=True)
+    assert outs[0].shape == (2, 2, 4)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "b"],
+             ["c"], ["a", "b"], ["b", "c"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert all(all(isinstance(i, int) for i in s) for s in coded)
+    assert set(vocab.keys()) >= {"a", "b", "c"}
+    it = mx.rnn.BucketSentenceIter(coded, batch_size=2, buckets=[2, 4],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 4
+    batches = list(it)
+    assert batches
+    for b in batches:
+        assert b.bucket_key in (2, 4)
+        assert b.data[0].shape == (2, b.bucket_key)
+        # labels are the next-token shift of data
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    # unknown token handling
+    with pytest.raises(AssertionError):
+        mx.rnn.encode_sentences([["zzz"]], vocab=vocab)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="lstm_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, data, merge_outputs=True)
+    rs = np.random.RandomState(0)
+    shapes, _, _ = outputs.infer_shape(data=(1, 2, 3))
+    args = {n: nd.array(rs.rand(*s).astype(np.float32))
+            for n, s in zip(outputs.list_arguments(), shapes)
+            if n != "data"}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, outputs, args, {})
+    sym2, args2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    for k, v in args.items():
+        np.testing.assert_allclose(args2[k].asnumpy(), v.asnumpy(),
+                                   rtol=1e-6)
