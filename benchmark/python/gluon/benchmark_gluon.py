@@ -1,0 +1,50 @@
+"""Model-zoo inference/training throughput benchmark.
+
+Parity target: benchmark/python/gluon/benchmark_gluon.py (scores the
+gluon model zoo at given batch sizes). Hybridizes each net (one XLA
+computation) and reports img/s.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+import numpy as np
+
+
+def score(net, batch, size, warmup=2, repeat=10):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    x = nd.array(np.random.rand(batch, 3, size, size).astype(np.float32))
+    for _ in range(warmup):
+        net(x).wait_to_read()
+    t0 = time.time()
+    for _ in range(repeat):
+        out = net(x)
+    out.wait_to_read()
+    return batch * repeat / (time.time() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = getattr(vision, args.model)()
+    net.initialize()
+    if not args.no_hybridize:
+        net.hybridize()
+    ips = score(net, args.batch_size, args.image_size)
+    print("%s bs=%d: %.1f img/s" % (args.model, args.batch_size, ips))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""INT8 vs float op benchmark.
+
+Parity target: benchmark/python/quantization/benchmark_op.py (compares
+quantized_conv/FC against their float counterparts). On TPU the int8
+path runs on the MXU with int32 accumulation.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+import numpy as np
+
+
+def bench(fn, warmup=2, repeat=20):
+    for _ in range(warmup):
+        out = fn()
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    out.wait_to_read()
+    t0 = time.time()
+    for _ in range(repeat):
+        out = fn()
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    out.wait_to_read()
+    return (time.time() - t0) / repeat * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--channels", type=int, default=64)
+    ap.add_argument("--size", type=int, default=56)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.ops.quantization_ops import quantize_weight
+
+    N, C, S = args.batch, args.channels, args.size
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(N, C, S, S).astype(np.float32))
+    w = nd.array((rs.rand(C, C, 3, 3).astype(np.float32) - 0.5) * 0.1)
+
+    ms_f = bench(lambda: nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=C, no_bias=True))
+    print("float conv  : %7.2f ms" % ms_f)
+
+    qw, ws = quantize_weight(w._data)
+    qwn = nd.array(np.asarray(qw))
+    ms_q = bench(lambda: nd._contrib_quantized_conv(
+        x, qwn, kernel=(3, 3), pad=(1, 1), num_filter=C, no_bias=True,
+        data_min=0.0, data_max=1.0, weight_scale=ws))
+    print("int8 conv   : %7.2f ms  (%.2fx)" % (ms_q, ms_f / ms_q))
+
+    M = 1024
+    a = nd.array(rs.rand(M, M).astype(np.float32))
+    b = nd.array((rs.rand(M, M).astype(np.float32) - 0.5) * 0.1)
+    ms_f = bench(lambda: nd.FullyConnected(a, b, num_hidden=M,
+                                           no_bias=True))
+    print("float FC    : %7.2f ms" % ms_f)
+    qb, bs = quantize_weight(b._data)
+    qbn = nd.array(np.asarray(qb))
+    ms_q = bench(lambda: nd._contrib_quantized_fully_connected(
+        a, qbn, num_hidden=M, no_bias=True, data_min=0.0, data_max=1.0,
+        weight_scale=bs))
+    print("int8 FC     : %7.2f ms  (%.2fx)" % (ms_q, ms_f / ms_q))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Sparse-path benchmark: csr dot / row_sparse retain / cast_storage.
+
+Parity target: benchmark/python/sparse/{dot,cast_storage,sparse_op}.py.
+On TPU sparsity is emulated over dense layouts (SURVEY §7 hard part a),
+so this benchmark reports the dense-emulation cost against plain dense
+ops — the honest number for this architecture.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+import numpy as np
+
+
+def bench(fn, warmup=2, repeat=10):
+    for _ in range(warmup):
+        out = fn()
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
+    t0 = time.time()
+    for _ in range(repeat):
+        out = fn()
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
+    return (time.time() - t0) / repeat * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--cols", type=int, default=1024)
+    ap.add_argument("--density", type=float, default=0.05)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sparse
+
+    rs = np.random.RandomState(0)
+    R, C, d = args.rows, args.cols, args.density
+    dense_np = rs.rand(R, C).astype(np.float32) * \
+        (rs.rand(R, C) < d).astype(np.float32)
+    dense = nd.array(dense_np)
+    rhs = nd.array(rs.rand(C, 256).astype(np.float32))
+
+    csr = sparse.csr_matrix(dense_np)
+    ms = bench(lambda: sparse.dot(csr, rhs))
+    print("csr dot (dense emulation)  : %7.2f ms" % ms)
+    ms_d = bench(lambda: nd.dot(dense, rhs))
+    print("dense dot                  : %7.2f ms" % ms_d)
+
+    idx = nd.array(np.sort(rs.choice(R, R // 10, replace=False))
+                   .astype(np.int64), dtype="int64")
+    ms = bench(lambda: nd._sparse_retain(dense, idx))
+    print("sparse_retain (masked)     : %7.2f ms" % ms)
+
+    ms = bench(lambda: sparse.cast_storage(dense, "row_sparse"))
+    print("cast_storage dense->rsp    : %7.2f ms (host compaction)" % ms)
+
+
+if __name__ == "__main__":
+    main()

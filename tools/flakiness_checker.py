@@ -1,0 +1,93 @@
+"""Flakiness checker — rerun one test many times with fresh seeds.
+
+Parity target: tools/flakiness_checker.py (the reference drives
+nosetests with MXNET_TEST_COUNT/MXNET_TEST_SEED; here the runner is
+pytest and the seed env is read by tests/conftest.py's seeding).
+
+    python tools/flakiness_checker.py tests/test_ndarray.py::test_dot \
+        --num-trials 200 --seed 42
+"""
+
+import argparse
+import logging
+import os
+import random
+import subprocess
+import sys
+
+logging.basicConfig(level=logging.INFO)
+
+DEFAULT_NUM_TRIALS = 100
+
+
+def find_test(spec):
+    """Accept `path::test`, `path:test`, or a bare test name searched for
+    under tests/."""
+    for sep in ("::", ":"):
+        if sep in spec:
+            path, name = spec.split(sep, 1)
+            return path, name
+    # bare test name: search tests/
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests")
+    hits = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if not (f.startswith("test_") and f.endswith(".py")):
+                continue
+            p = os.path.join(dirpath, f)
+            with open(p, errors="ignore") as fh:
+                if ("def %s(" % spec) in fh.read():
+                    hits.append(p)
+    if not hits:
+        raise SystemExit("could not find a test named %r under tests/" % spec)
+    if len(hits) > 1:
+        logging.warning("multiple files define %s; using %s", spec, hits[0])
+    return hits[0], spec
+
+
+def run_trials(path, name, num_trials, seed, verbosity):
+    failures = 0
+    for trial in range(num_trials):
+        env = dict(os.environ)
+        trial_seed = seed if seed is not None else random.randint(0, 2**31)
+        env["MXNET_TEST_SEED"] = str(trial_seed)
+        env["MXNET_MODULE_SEED"] = str(trial_seed)
+        cmd = [sys.executable, "-m", "pytest", "-x",
+               "-q" if verbosity < 2 else "-v",
+               "%s::%s" % (path, name)]
+        code = subprocess.call(
+            cmd, env=env,
+            stdout=None if verbosity >= 2 else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if verbosity < 2 else None)
+        if code != 0:
+            failures += 1
+            logging.info("trial %d FAILED (seed %d)", trial, trial_seed)
+        elif verbosity >= 1 and (trial + 1) % 10 == 0:
+            logging.info("%d/%d trials, %d failures", trial + 1,
+                         num_trials, failures)
+    return failures
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="tests/file.py::test_name, or a bare "
+                    "test name searched under tests/")
+    ap.add_argument("-n", "--num-trials", type=int,
+                    default=DEFAULT_NUM_TRIALS)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="fix the seed for every trial (default: random "
+                    "per trial)")
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args()
+    path, name = find_test(args.test)
+    logging.info("testing %s::%s for %d trials", path, name,
+                 args.num_trials)
+    failures = run_trials(path, name, args.num_trials, args.seed,
+                          args.verbosity)
+    logging.info("%d/%d trials failed", failures, args.num_trials)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
