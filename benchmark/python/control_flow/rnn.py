@@ -20,6 +20,8 @@ import numpy as np
 def bench(fn, warmup=2, repeat=10):
     for _ in range(warmup):
         out = fn()
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
     t0 = time.time()
     for _ in range(repeat):
         out = fn()

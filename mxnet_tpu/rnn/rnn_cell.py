@@ -127,13 +127,15 @@ class BaseRNNCell(object):
         for info in self.state_info:
             self._init_counter += 1
             shape = tuple(info["shape"])
-            if shape and shape[0] == 0:
+            if 0 in shape:
+                # the 0 marks the batch axis (index 0 for NC states,
+                # index 1 for the fused cells' LNC states)
                 if not batch_size:
                     raise ValueError(
                         "begin_state with unknown batch needs batch_size= "
                         "(static shapes) — or pass begin_state=None to "
                         "unroll, which infers it from the inputs")
-                shape = (batch_size,) + shape[1:]
+                shape = tuple(batch_size if s == 0 else s for s in shape)
             kw = dict(kwargs)
             states.append(func(
                 shape, name="%sbegin_state_%d" % (self._prefix,
@@ -176,16 +178,15 @@ class BaseRNNCell(object):
         from .. import ndarray as nd
         for group in ("i2h", "h2h"):
             for suffix in ("weight", "bias"):
-                pieces = []
-                for gate in self._gate_names:
-                    name = "%s%s%s_%s" % (self._prefix, group, gate, suffix)
-                    if name not in args:
-                        pieces = None
-                        break
-                    pieces.append(args.pop(name))
-                if pieces:
-                    args["%s%s_%s" % (self._prefix, group, suffix)] = \
-                        nd.concat(*pieces, dim=0)
+                names = ["%s%s%s_%s" % (self._prefix, group, gate, suffix)
+                         for gate in self._gate_names]
+                # all-or-nothing: popping a partial gate set would lose
+                # parameters silently
+                if not all(n in args for n in names):
+                    continue
+                pieces = [args.pop(n) for n in names]
+                args["%s%s_%s" % (self._prefix, group, suffix)] = \
+                    nd.concat(*pieces, dim=0)
         return args
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
@@ -380,6 +381,85 @@ class FusedRNNCell(BaseRNNCell):
         raise NotImplementedError(
             "FusedRNNCell cannot be stepped; use unroll")
 
+    def _dirs(self):
+        return ("l", "r") if self._bidirectional else ("l",)
+
+    def _layout(self, input_size):
+        """[(name, shape)] in packed order (ops/nn.py _unpack_rnn_params:
+        all weights layer-major, then all biases)."""
+        h = self._num_hidden
+        ng = self._num_gates
+        d = 2 if self._bidirectional else 1
+        slots = []
+        for layer in range(self._num_layers):
+            isz = input_size if layer == 0 else h * d
+            for dr in self._dirs():
+                for gate in self._gate_names:
+                    slots.append(("%s%s%d_i2h%s_weight" % (
+                        self._prefix, dr, layer, gate), (h, isz)))
+                for gate in self._gate_names:
+                    slots.append(("%s%s%d_h2h%s_weight" % (
+                        self._prefix, dr, layer, gate), (h, h)))
+        for layer in range(self._num_layers):
+            for dr in self._dirs():
+                for gate in self._gate_names:
+                    slots.append(("%s%s%d_i2h%s_bias" % (
+                        self._prefix, dr, layer, gate), (h,)))
+                for gate in self._gate_names:
+                    slots.append(("%s%s%d_h2h%s_bias" % (
+                        self._prefix, dr, layer, gate), (h,)))
+        return slots
+
+    def _infer_input_size(self, total):
+        h = self._num_hidden
+        ng = self._num_gates
+        d = 2 if self._bidirectional else 1
+        per = total // (d * ng * h)
+        return int(per - (self._num_layers - 1) * (h * d + h + 2) - h - 2)
+
+    def unpack_weights(self, args):
+        """Slice the packed vector into the per-gate arrays the unfused
+        cells (unfuse()) use — checkpoint interchange both ways."""
+        args = args.copy()
+        name = self._prefix + "parameters"
+        if name not in args:
+            return args
+        from .. import ndarray as nd
+        import numpy as onp
+        buf = args.pop(name).asnumpy().reshape(-1)
+        off = 0
+        for slot_name, shape in self._layout(self._infer_input_size(
+                buf.size)):
+            n = 1
+            for s in shape:
+                n *= s
+            args[slot_name] = nd.array(
+                onp.ascontiguousarray(buf[off:off + n].reshape(shape)))
+            off += n
+        if off != buf.size:
+            raise ValueError(
+                "packed RNN parameter vector has %d elements, layout "
+                "consumed %d" % (buf.size, off))
+        return args
+
+    def pack_weights(self, args):
+        args = args.copy()
+        probe = "%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])
+        if probe not in args:
+            return args
+        from .. import ndarray as nd
+        import numpy as onp
+        input_size = args[probe].shape[1]
+        pieces = []
+        for slot_name, shape in self._layout(input_size):
+            if slot_name not in args:
+                raise KeyError("missing %s while packing FusedRNNCell "
+                               "parameters" % slot_name)
+            pieces.append(args.pop(slot_name).asnumpy().reshape(-1))
+        args[self._prefix + "parameters"] = nd.array(
+            onp.concatenate(pieces))
+        return args
+
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
@@ -528,7 +608,7 @@ class DropoutCell(BaseRNNCell):
         inputs, _ = _normalize_sequence(length, inputs, layout,
                                         merge_outputs)
         if isinstance(inputs, symbol.Symbol):
-            return self(inputs, []), []
+            return self(inputs, [])[0], []
         return [self(i, [])[0] for i in inputs], []
 
 

