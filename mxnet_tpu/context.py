@@ -85,15 +85,23 @@ class Context:
 
 
 def _devices_by_platform(platform):
+    """Devices a Context index may denote. In a multi-process SPMD job
+    only THIS process's devices are addressable for eager placement, so
+    cpu(0)/tpu(0) means local device 0 (reference semantics: each worker
+    sees its own GPUs); the global mesh is the parallel layer's job."""
     try:
+        if jax.process_count() > 1:
+            return [d for d in jax.local_devices()
+                    if d.platform == platform]
         return jax.devices(platform)
     except RuntimeError:
         return []
 
 
 def _accelerators():
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs
+    if jax.process_count() > 1:
+        return [d for d in jax.local_devices() if d.platform != "cpu"]
+    return [d for d in jax.devices() if d.platform != "cpu"]
 
 
 def _default_context():

@@ -229,10 +229,12 @@ class KVStoreDevice(KVStore):
 
 
 @functools.lru_cache(maxsize=256)
+@functools.lru_cache(maxsize=None)
 def _allreduce_jit(mesh_devices, shape, dtype):
     """Compiled worker-axis reduction: input one shard per device along a
     'worker' axis, output replicated — XLA lowers this to an all-reduce
-    over ICI/DCN (the dist_tpu_sync wire path)."""
+    over ICI/DCN (the dist_tpu_sync wire path). Cached per
+    (devices, shape, dtype) so repeated pushes reuse the executable."""
     mesh = Mesh(np.asarray(mesh_devices), ("worker",))
     in_s = NamedSharding(mesh, P("worker"))
     out_s = NamedSharding(mesh, P())
@@ -264,8 +266,14 @@ class KVStoreTPUSync(KVStore):
     def init(self, key, value):
         """Stored values live replicated over the whole mesh so the
         update_on_kvstore path (replicated grad x stored weight) is one
-        SPMD computation with no device mismatch."""
+        SPMD computation with no device mismatch. In a multi-process job
+        the store stays process-local: every rank holds an identical
+        copy and applies identical (all-reduced) updates — the same
+        invariant, without non-addressable global arrays in the eager
+        path."""
         super().init(key, value)
+        if jax.process_count() > 1:
+            return
         keys, _ = self._normalize(key, value)
         for k in keys:
             v = self._store[k]
@@ -274,6 +282,8 @@ class KVStoreTPUSync(KVStore):
     def _aggregate(self, k, datas):
         n = len(datas)
         devs = self._flat_devices
+        if jax.process_count() > 1:
+            return self._cross_process_allreduce(datas)
         if n <= 1 or n != len(devs):
             # worker count doesn't match the mesh (e.g. a single pushed
             # value, or fewer replicas than devices): the fused on-device
@@ -290,6 +300,33 @@ class KVStoreTPUSync(KVStore):
         reduce_fn = _allreduce_jit(devs, (n,) + shape,
                                    str(datas[0].dtype))
         return reduce_fn(global_arr)
+
+    def _cross_process_allreduce(self, datas):
+        """Multi-host push: sum the local contributions, then one global
+        all-reduce with one shard per process (the dist_sync wire path —
+        every rank calls in collectively, mirroring the reference's
+        NumWorkers()-merge in kvstore_dist_server.h:346). Returns the
+        summed value as a process-local array so the updater/pull path
+        stays eager-friendly."""
+        local = jnp.asarray(_sum_n(*datas) if len(datas) > 1 else datas[0])
+        nproc = jax.process_count()
+        per_proc = []
+        for p in range(nproc):
+            per_proc.append(next(d for d in jax.devices()
+                                 if d.process_index == p))
+        per_proc = tuple(per_proc)
+        mine = jax.device_put(local[None],
+                              per_proc[jax.process_index()])
+        mesh = Mesh(np.asarray(per_proc), ("worker",))
+        global_arr = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(local.shape),
+            NamedSharding(mesh, P("worker")), [mine])
+        reduce_fn = _allreduce_jit(per_proc,
+                                   (nproc,) + tuple(local.shape),
+                                   str(local.dtype))
+        out = reduce_fn(global_arr)
+        # fully-replicated: the local shard IS the global sum
+        return out.addressable_data(0)
 
     @property
     def type(self):

@@ -50,6 +50,11 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
                      else os.environ.get("MXNET_TPU_PROC_ID", "0"))
     if coordinator is None and num_processes == 1:
         return False
+    # honor JAX_PLATFORMS before the backend initializes: discovery
+    # plugins can override the env var (the tests/conftest.py gotcha),
+    # and the local launcher depends on its cpu pin sticking
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

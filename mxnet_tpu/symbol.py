@@ -851,6 +851,31 @@ class _SymLinalgNamespace:
 linalg = _SymLinalgNamespace()
 
 
+class _SymRandomNamespace:
+    """sym.random.* (python/mxnet/symbol/random.py) — scalar-parameter
+    draws resolve to the `_random_*` ops, symbol parameters to the
+    `_sample_*` ops, same split as the nd namespace."""
+
+    def __getattr__(self, item):
+        scalar_op = "_random_" + item
+        tensor_op = "sample_" + item
+        if not (ops.exists(scalar_op) or ops.exists(tensor_op)):
+            raise AttributeError(item)
+
+        def f(*args, **kwargs):
+            if any(isinstance(a, Symbol) for a in args) or \
+                    any(isinstance(v, Symbol) for v in kwargs.values()):
+                fn = _g.get(tensor_op) or _make_sym_func(tensor_op)
+            else:
+                fn = _g.get(scalar_op) or _make_sym_func(scalar_op)
+            return fn(*args, **kwargs)
+        f.__name__ = item
+        return f
+
+
+random = _SymRandomNamespace()
+
+
 # ----------------------------------------------------- graph inference --
 def _infer_graph(nodes, known_shapes, known_dtypes, partial=False):
     """Walk the graph computing per-node output ShapeDtype via

@@ -51,8 +51,11 @@ def worker_env(args, proc_id, base=None):
     })
     if args.launcher == "local":
         # each local process simulates one device so collective code
-        # paths run without hardware
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # paths run without hardware; OVERRIDE any inherited accelerator
+        # platform — N local processes sharing one real chip would fight
+        # over it (init_distributed re-pins this inside python, since
+        # discovery plugins can override the env var)
+        env["JAX_PLATFORMS"] = "cpu"
         env.setdefault("XLA_FLAGS",
                        "--xla_force_host_platform_device_count=1")
     return env
