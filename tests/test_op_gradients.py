@@ -1,0 +1,211 @@
+"""Finite-difference gradient sweep across the differentiable op surface.
+
+Mirrors the reference's check_numeric_gradient breadth in
+tests/python/unittest/test_operator.py (SURVEY §4 pattern (1)): every
+case builds a small symbolic graph, compares the executor's backward
+against central finite differences.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, test_utils
+
+
+def _rand(*shape, lo=-1.0, hi=1.0, seed=0):
+    rs = np.random.RandomState(seed + sum(shape))
+    return (rs.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def _check(s, location, atol=1e-3, **kw):
+    test_utils.check_numeric_gradient(s, location, numeric_eps=1e-3,
+                                      rtol=2e-2, atol=atol, **kw)
+
+
+X = sym.Variable("x")
+Y = sym.Variable("y")
+
+UNARY_CASES = [
+    ("sigmoid", lambda: sym.sigmoid(X), dict(lo=-2, hi=2)),
+    ("tanh", lambda: sym.tanh(X), dict(lo=-2, hi=2)),
+    ("relu", lambda: sym.relu(X), dict(lo=0.1, hi=2)),
+    ("softrelu", lambda: sym.Activation(X, act_type="softrelu"),
+     dict(lo=-2, hi=2)),
+    ("exp", lambda: sym.exp(X), dict(lo=-1, hi=1)),
+    ("log", lambda: sym.log(X), dict(lo=0.2, hi=3)),
+    ("sqrt", lambda: sym.sqrt(X), dict(lo=0.2, hi=3)),
+    ("rsqrt", lambda: sym.rsqrt(X), dict(lo=0.3, hi=3)),
+    ("square", lambda: sym.square(X), dict(lo=-2, hi=2)),
+    ("cbrt", lambda: sym.cbrt(X), dict(lo=0.3, hi=2)),
+    ("expm1", lambda: sym.expm1(X), dict(lo=-1, hi=1)),
+    ("log1p", lambda: sym.log1p(X), dict(lo=-0.5, hi=2)),
+    ("sin", lambda: sym.sin(X), dict(lo=-2, hi=2)),
+    ("cos", lambda: sym.cos(X), dict(lo=-2, hi=2)),
+    ("arctan", lambda: sym.arctan(X), dict(lo=-2, hi=2)),
+    ("arcsinh", lambda: sym.arcsinh(X), dict(lo=-2, hi=2)),
+    ("erf", lambda: sym.erf(X), dict(lo=-1.5, hi=1.5)),
+    ("gamma", lambda: sym.gamma(X), dict(lo=1.2, hi=3)),
+    ("gammaln", lambda: sym.gammaln(X), dict(lo=1.2, hi=3)),
+    ("abs-smooth", lambda: sym.abs(X), dict(lo=0.2, hi=2)),
+    ("softsign", lambda: sym.softsign(X), dict(lo=-2, hi=2)),
+    ("reciprocal", lambda: sym.reciprocal(X), dict(lo=0.4, hi=2)),
+]
+
+
+@pytest.mark.parametrize("name,build,rng",
+                         [(n, b, r) for n, b, r in UNARY_CASES],
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_gradients(name, build, rng):
+    _check(build(), {"x": _rand(3, 4, **rng)})
+
+
+REDUCE_CASES = [
+    ("sum", lambda: sym.sum(X, axis=1)),
+    ("mean", lambda: sym.mean(X, axis=0)),
+    ("sum_all", lambda: sym.sum(X)),
+    ("prod", lambda: sym.prod(X, axis=1)),
+    ("norm", lambda: sym.norm(X)),
+    ("nansum", lambda: sym.nansum(X, axis=1)),
+]
+
+
+@pytest.mark.parametrize("name,build", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+def test_reduce_gradients(name, build):
+    _check(build(), {"x": _rand(3, 4, lo=0.5, hi=2.0)})
+
+
+BINARY_CASES = [
+    ("broadcast_add", lambda: sym.broadcast_add(X, Y), (3, 4), (1, 4)),
+    ("broadcast_mul", lambda: sym.broadcast_mul(X, Y), (3, 4), (3, 1)),
+    ("broadcast_div", lambda: sym.broadcast_div(X, Y), (3, 4), (1, 4)),
+    ("broadcast_sub", lambda: sym.broadcast_sub(X, Y), (2, 3, 4), (1, 1, 4)),
+    ("broadcast_power", lambda: sym.broadcast_power(X, Y), (3, 4), (1, 4)),
+    ("broadcast_hypot", lambda: sym.broadcast_hypot(X, Y), (3, 4), (3, 4)),
+    ("dot", lambda: sym.dot(X, Y), (3, 4), (4, 5)),
+    ("batch_dot", lambda: sym.batch_dot(X, Y), (2, 3, 4), (2, 4, 5)),
+]
+
+
+@pytest.mark.parametrize("name,build,xs,ys", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_gradients(name, build, xs, ys):
+    lo, hi = (0.5, 2.0) if name in ("broadcast_div",
+                                    "broadcast_power",
+                                    "broadcast_hypot") else (-1.0, 1.0)
+    _check(build(), {"x": _rand(*xs, lo=lo, hi=hi),
+                     "y": _rand(*ys, lo=lo, hi=hi, seed=5)})
+
+
+SHAPE_CASES = [
+    ("transpose", lambda: sym.transpose(X, axes=(1, 0, 2)), (2, 3, 4)),
+    ("reshape", lambda: sym.Reshape(X, shape=(4, 6)), (2, 3, 4)),
+    ("slice", lambda: sym.slice(X, begin=(0, 1), end=(2, 3)), (3, 4)),
+    ("flip", lambda: sym.reverse(X, axis=1), (3, 4)),
+    ("tile", lambda: sym.tile(X, reps=(2, 1)), (3, 4)),
+    ("repeat", lambda: sym.repeat(X, repeats=2, axis=0), (3, 4)),
+    ("pad", lambda: sym.Pad(X, mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     (1, 1, 3, 4)),
+    ("expand_dims", lambda: sym.expand_dims(X, axis=1), (3, 4)),
+    ("clip-interior", lambda: sym.clip(X, a_min=-10, a_max=10), (3, 4)),
+    ("where", lambda: sym.where(sym.Variable("c"), X, Y), None),
+    ("swapaxes", lambda: sym.swapaxes(X, dim1=0, dim2=1), (3, 4)),
+    ("depth_to_space", lambda: sym.depth_to_space(X, block_size=2),
+     (1, 4, 2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,build,shape", SHAPE_CASES,
+                         ids=[c[0] for c in SHAPE_CASES])
+def test_shape_op_gradients(name, build, shape):
+    if name == "where":
+        cond = (np.random.RandomState(0).rand(3, 4) > 0.5) \
+            .astype(np.float32)
+        _check(build(), {"c": cond, "x": _rand(3, 4),
+                         "y": _rand(3, 4, seed=3)}, grad_nodes=["x", "y"])
+    else:
+        _check(build(), {"x": _rand(*shape)})
+
+
+NN_CASES = [
+    ("FullyConnected",
+     lambda: sym.FullyConnected(X, sym.Variable("w"), sym.Variable("b"),
+                                num_hidden=5),
+     {"x": (2, 4), "w": (5, 4), "b": (5,)}),
+    ("Convolution",
+     lambda: sym.Convolution(X, sym.Variable("w"), sym.Variable("b"),
+                             kernel=(3, 3), num_filter=2, pad=(1, 1)),
+     {"x": (1, 2, 5, 5), "w": (2, 2, 3, 3), "b": (2,)}),
+    ("Deconvolution",
+     lambda: sym.Deconvolution(X, sym.Variable("w"), kernel=(2, 2),
+                               num_filter=2, no_bias=True),
+     {"x": (1, 2, 4, 4), "w": (2, 2, 2, 2)}),
+    ("Pooling-avg",
+     lambda: sym.Pooling(X, kernel=(2, 2), stride=(2, 2),
+                         pool_type="avg"),
+     {"x": (1, 2, 4, 4)}),
+    ("LayerNorm",
+     lambda: sym.LayerNorm(X, sym.Variable("g"), sym.Variable("b")),
+     {"x": (3, 6), "g": (6,), "b": (6,)}),
+    ("softmax", lambda: sym.softmax(X, axis=-1), {"x": (3, 5)}),
+    # spread the logits: near-uniform inputs give softmax ~ 1/N and a
+    # sum-of-log-softmax gradient of ~0 everywhere, where FD noise
+    # dominates any relative comparison
+    ("log_softmax", lambda: sym.log_softmax(X * 3.0, axis=-1),
+     {"x": (3, 5)}),
+    ("Embedding-out",
+     lambda: sym.sum(sym.Embedding(sym.Variable("idx"), X, input_dim=6,
+                                   output_dim=3)),
+     {"x": (6, 3)}),
+    ("L2Normalization", lambda: sym.L2Normalization(X), {"x": (3, 5)}),
+    ("LeakyReLU",
+     lambda: sym.LeakyReLU(X, act_type="leaky", slope=0.1),
+     {"x": (3, 4)}),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes", NN_CASES,
+                         ids=[c[0] for c in NN_CASES])
+def test_nn_gradients(name, build, shapes):
+    if name == "Embedding-out":
+        idx = np.array([[0, 2], [3, 5]], np.float32)
+        _check(build(), {"idx": idx, "x": _rand(*shapes["x"])},
+               grad_nodes=["x"])
+    elif name == "log_softmax":
+        # gradients of sum(log_softmax) can be ~1e-3 while the output
+        # sum is ~10: float32 central differences bottom out at exactly
+        # 0 there, so near-zero entries need an absolute floor
+        loc = {k: _rand(*s, seed=i)
+               for i, (k, s) in enumerate(shapes.items())}
+        _check(build(), loc, atol=0.1)
+    elif name == "LeakyReLU":
+        # keep every sample at least 0.1 away from the kink at 0 —
+        # central differences straddle it otherwise
+        base = _rand(*shapes["x"], lo=0.1, hi=1.0)
+        sign = np.where(_rand(*shapes["x"], seed=9) > 0, 1.0, -1.0)
+        _check(build(), {"x": (base * sign).astype(np.float32)})
+    else:
+        loc = {k: _rand(*s, seed=i)
+               for i, (k, s) in enumerate(shapes.items())}
+        _check(build(), loc)
+
+
+def test_linalg_gradients():
+    # potrf on an SPD matrix; gemm2 plain
+    a = _rand(3, 3, lo=0.1, hi=0.5)
+    spd = a @ a.T + 2 * np.eye(3, dtype=np.float32)
+    _check(sym.linalg.potrf(X), {"x": spd})
+    _check(sym.linalg.gemm2(X, Y), {"x": _rand(3, 4), "y": _rand(4, 2)})
+    _check(sym.linalg.sumlogdiag(X),
+           {"x": spd})
+
+
+def test_pdf_op_gradients():
+    s = sym.Variable("s")
+    mu = sym.Variable("mu")
+    sig = sym.Variable("sig")
+    out = sym._random_pdf_normal(s, mu, sig, is_log=True)
+    _check(out, {"s": _rand(2, 5, lo=-1, hi=1),
+                 "mu": np.array([0.1, -0.2], np.float32),
+                 "sig": np.array([1.1, 0.9], np.float32)})
