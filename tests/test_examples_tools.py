@@ -169,3 +169,21 @@ def test_distributed_training_two_workers(tmp_path):
     assert len(accs) == 2 and min(accs) > 0.9
     # ranks hold identical models -> identical accuracy
     assert abs(accs[0] - accs[1]) < 1e-6
+
+
+def test_sparse_linear_classification_learns():
+    r = _run([sys.executable, "examples/sparse/linear_classification.py",
+              "--num-epochs", "8", "--dim", "300",
+              "--num-samples", "2048", "--lr", "1.0"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.rsplit("accuracy=", 1)[1])
+    assert acc > 0.85
+
+
+def test_sparse_factorization_machine_learns():
+    r = _run([sys.executable, "examples/sparse/factorization_machine.py",
+              "--num-epochs", "6", "--dim", "200",
+              "--num-samples", "2048"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.rsplit("accuracy=", 1)[1])
+    assert acc > 0.7
