@@ -5,3 +5,4 @@ from . import estimator
 from . import nn
 from . import rnn
 from . import data
+from . import cnn

@@ -1,0 +1,4 @@
+"""Contrib CNN layers (reference: gluon/contrib/cnn/)."""
+from .conv_layers import DeformableConvolution  # noqa: F401
+
+__all__ = ["DeformableConvolution"]

@@ -1,0 +1,72 @@
+"""Deformable convolution block (reference:
+gluon/contrib/cnn/conv_layers.py DeformableConvolution). An internal
+ordinary convolution predicts per-tap sampling offsets; the deformable
+op (ops/vision_ops.py `_contrib_DeformableConvolution`) bilinearly
+samples at those offsets and contracts on the MXU."""
+
+from ...block import HybridBlock
+
+
+class DeformableConvolution(HybridBlock):
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None,
+                 weight_initializer=None, bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 prefix=None, params=None):
+        super(DeformableConvolution, self).__init__(prefix=prefix,
+                                                    params=params)
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        if isinstance(strides, int):
+            strides = (strides, strides)
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        if isinstance(dilation, int):
+            dilation = (dilation, dilation)
+        assert layout == "NCHW", "deformable conv supports NCHW"
+        self._channels = channels
+        self._kernel = tuple(kernel_size)
+        self._strides = tuple(strides)
+        self._padding = tuple(padding)
+        self._dilation = tuple(dilation)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._use_bias = use_bias
+        self._act = activation
+
+        offset_channels = 2 * self._kernel[0] * self._kernel[1] * \
+            num_deformable_group
+        self.offset_weight = self.params.get(
+            "offset_weight",
+            shape=(offset_channels, in_channels) + self._kernel,
+            init=offset_weight_initializer, allow_deferred_init=True)
+        self.offset_bias = self.params.get(
+            "offset_bias", shape=(offset_channels,),
+            init=offset_bias_initializer,
+            allow_deferred_init=True) if offset_use_bias else None
+        self.weight = self.params.get(
+            "weight", shape=(channels, in_channels) + self._kernel,
+            init=weight_initializer, allow_deferred_init=True)
+        self.bias = self.params.get(
+            "bias", shape=(channels,), init=bias_initializer,
+            allow_deferred_init=True) if use_bias else None
+
+    def hybrid_forward(self, F, x, offset_weight, weight, bias=None,
+                       offset_bias=None):
+        offset = F.Convolution(
+            x, offset_weight, offset_bias,
+            kernel=self._kernel, stride=self._strides, pad=self._padding,
+            dilate=self._dilation,
+            num_filter=2 * self._kernel[0] * self._kernel[1] * self._ndg,
+            no_bias=offset_bias is None)
+        out = F._contrib_DeformableConvolution(
+            x, offset, weight, bias, kernel=self._kernel,
+            stride=self._strides, pad=self._padding, dilate=self._dilation,
+            num_filter=self._channels, num_group=self._groups,
+            num_deformable_group=self._ndg, no_bias=bias is None)
+        if self._act:
+            out = F.Activation(out, act_type=self._act)
+        return out

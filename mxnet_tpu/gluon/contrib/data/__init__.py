@@ -1,4 +1,6 @@
 """Contrib data utilities (reference: gluon/contrib/data/)."""
 from .sampler import IntervalSampler
+from . import text
+from .text import WikiText2, WikiText103
 
-__all__ = ["IntervalSampler"]
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]

@@ -1,0 +1,79 @@
+"""Language-modeling text datasets (reference:
+gluon/contrib/data/text.py WikiText2/WikiText103).
+
+This environment has no egress, so the archive download step is
+replaced by reading pre-placed token files from `root` (the same
+`wiki.{train,valid,test}.tokens` layout the reference unpacks). A clear
+error names the missing file instead of attempting a fetch.
+"""
+
+import io
+import os
+
+import numpy as np
+
+from .... import ndarray as nd
+
+
+def _data_dir():
+    return os.environ.get("MXNET_HOME", os.path.join(
+        os.path.expanduser("~"), ".mxnet"))
+
+
+class _WikiText(object):
+    SEGMENT_FILES = {"train": "wiki.train.tokens",
+                     "validation": "wiki.valid.tokens",
+                     "test": "wiki.test.tokens"}
+
+    def __init__(self, root, segment, vocab, seq_len):
+        if segment not in self.SEGMENT_FILES:
+            raise ValueError("segment must be one of %s"
+                             % sorted(self.SEGMENT_FILES))
+        path = os.path.join(os.path.expanduser(root),
+                            self.SEGMENT_FILES[segment])
+        if not os.path.exists(path):
+            raise IOError(
+                "%s not found. This build cannot download datasets "
+                "(no network egress); place the extracted WikiText "
+                "token files under %r first." % (path, root))
+        with io.open(path, encoding="utf-8") as f:
+            tokens = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                tokens.extend(line.split() + ["<eos>"])
+        if vocab is None:
+            uniq = sorted(set(tokens))
+            vocab = {w: i for i, w in enumerate(uniq)}
+        self.vocabulary = vocab
+        coded = np.asarray([vocab[w] for w in tokens if w in vocab],
+                           dtype=np.float32)
+        n = (len(coded) - 1) // seq_len
+        data = coded[:n * seq_len].reshape(n, seq_len)
+        label = coded[1:n * seq_len + 1].reshape(n, seq_len)
+        self._samples = [nd.array(d) for d in data]
+        self._labels = [nd.array(l) for l in label]
+
+    def __getitem__(self, idx):
+        return self._samples[idx], self._labels[idx]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (local token files)."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        root = root or os.path.join(_data_dir(), "datasets", "wikitext-2")
+        super(WikiText2, self).__init__(root, segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset (local token files)."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        root = root or os.path.join(_data_dir(), "datasets",
+                                    "wikitext-103")
+        super(WikiText103, self).__init__(root, segment, vocab, seq_len)

@@ -15,7 +15,7 @@ from .... import image as _image
 from ...block import Block, HybridBlock
 from ...nn import Sequential, HybridSequential
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CropResize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
            "RandomSaturation", "RandomHue", "RandomColorJitter",
@@ -75,6 +75,28 @@ class Resize(Block):
             return _image.imresize(x, self._size, self._size, self._interp)
         return _image.imresize(x, self._size[0], self._size[1],
                                self._interp)
+
+
+class CropResize(Block):
+    """Fixed-window crop at (x, y, w, h), optionally resized to `size`
+    (reference transforms.py CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super(CropResize, self).__init__()
+        self._x = x
+        self._y = y
+        self._w = width
+        self._h = height
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, x):
+        out = _image.fixed_crop(x, self._x, self._y, self._w, self._h)
+        if self._size is not None:
+            size = (self._size, self._size) if isinstance(self._size, int) \
+                else tuple(self._size)
+            out = _image.imresize(out, size[0], size[1], self._interp)
+        return out
 
 
 class CenterCrop(Block):

@@ -192,3 +192,70 @@ def test_sparse_embedding():
     out = emb(nd.array([1, 3, 1]))
     assert out.shape == (3, 6)
     np.testing.assert_allclose(out.asnumpy()[0], out.asnumpy()[2])
+
+
+def test_lstmp_cell_shapes():
+    import numpy as np
+    cell = mx.gluon.contrib.rnn.LSTMPCell(8, 3)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(4, 6).astype(np.float32))
+    out, states = cell(x, cell.begin_state(batch_size=4))
+    assert out.shape == (4, 3)
+    assert [s.shape for s in states] == [(4, 3), (4, 8)]
+    o, _ = cell.unroll(5, mx.nd.array(
+        np.random.rand(2, 5, 6).astype(np.float32)), merge_outputs=True)
+    assert o.shape == (2, 5, 3)
+
+
+def test_variational_dropout_shares_mask_across_steps():
+    import numpy as np
+    base = mx.gluon.rnn.RNNCell(6)
+    vd = mx.gluon.contrib.rnn.VariationalDropoutCell(base,
+                                                     drop_outputs=0.5)
+    vd.initialize()
+    x = mx.nd.array(np.random.rand(2, 4, 6).astype(np.float32))
+    with mx.autograd.record(train_mode=True):
+        out, _ = vd.unroll(4, x, merge_outputs=False)
+    # one shared mask: the zero pattern is identical across steps
+    zeros = [set(map(tuple, np.argwhere(o.asnumpy() == 0)))
+             for o in out]
+    assert zeros[0] == zeros[1] == zeros[2] == zeros[3]
+
+
+def test_deformable_convolution_block():
+    import numpy as np
+    net = mx.gluon.contrib.cnn.DeformableConvolution(
+        4, kernel_size=3, padding=1, in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 8, 8).astype(np.float32))
+    out = net(x)
+    assert out.shape == (1, 4, 8, 8)
+    # zero-init offsets reduce to an ordinary convolution
+    ref = mx.nd.Convolution(x, net.weight.data(), net.bias.data(),
+                            kernel=(3, 3), pad=(1, 1), num_filter=4)
+    assert float(mx.nd.max(mx.nd.abs(out - ref)).asnumpy()) < 1e-5
+
+
+def test_wikitext_local_files(tmp_path):
+    p = tmp_path / "wiki.train.tokens"
+    p.write_text("a b c d\ne f g h\n" * 10)
+    ds = mx.gluon.contrib.data.WikiText2(root=str(tmp_path),
+                                         segment="train", seq_len=4)
+    assert len(ds) > 0
+    d, l = ds[0]
+    assert d.shape == (4,) and l.shape == (4,)
+    import pytest as _pytest
+    with _pytest.raises(IOError):
+        mx.gluon.contrib.data.WikiText103(root=str(tmp_path / "missing"))
+
+
+def test_crop_resize_transform():
+    import numpy as np
+    t = mx.gluon.data.vision.transforms.CropResize(2, 3, 10, 8,
+                                                   size=(5, 4))
+    img = mx.nd.array((np.random.rand(20, 20, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    out = t(img)
+    assert out.shape == (4, 5, 3)
+    t2 = mx.gluon.data.vision.transforms.CropResize(0, 0, 6, 6)
+    assert t2(img).shape == (6, 6, 3)
