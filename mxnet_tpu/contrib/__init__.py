@@ -6,3 +6,8 @@ from . import quantization
 from . import svrg_optimization
 from . import tensorboard
 from . import text
+from . import autograd
+from . import io
+from . import ndarray
+from . import symbol
+from . import tensorrt

@@ -1,0 +1,72 @@
+"""Legacy contrib autograd API (reference: contrib/autograd.py) — the
+pre-`mx.autograd` spelling kept for old user code; everything forwards
+to the modern tape in mxnet_tpu.autograd."""
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Flip global train mode; returns the previous value."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+def train_section():
+    """`with train_section():` == `with autograd.record():`."""
+    return _ag.record()
+
+
+def test_section():
+    """Recording scope with inference-mode operators."""
+    return _ag.record(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of backward (reference keeps it callable)."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap func to return (gradients, loss) w.r.t. its NDArray args."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            idx = argnum if isinstance(argnum, (list, tuple)) else [argnum]
+            variables = [args[i] for i in idx]
+        for v in variables:
+            assert isinstance(v, nd.NDArray), \
+                "type of autograd input should be NDArray"
+        grads = [nd.zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, nd.NDArray) else outputs)
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only version of grad_and_loss."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

@@ -1,0 +1,34 @@
+"""Contrib IO (reference: contrib/io.py) — bridge a Gluon DataLoader
+into the DataIter interface the Module API consumes."""
+
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon.data.DataLoader yielding (data, label) pairs."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super(DataLoaderIter, self).__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        first = next(iter(loader))
+        data, label = first[0], first[1]
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(name=data_name, shape=data.shape)]
+        self.provide_label = [DataDesc(name=label_name, shape=label.shape)]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def next(self):
+        try:
+            data, label = next(self._iter)
+        except StopIteration:
+            raise StopIteration
+        return DataBatch([data], [label], pad=0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

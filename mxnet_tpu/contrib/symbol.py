@@ -1,0 +1,7 @@
+"""mx.contrib.symbol — the symbolic `_contrib_*` namespace, same
+functions as `mx.sym.contrib`."""
+from ..symbol import contrib as _c
+
+
+def __getattr__(item):
+    return getattr(_c, item)

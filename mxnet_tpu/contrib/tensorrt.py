@@ -1,0 +1,26 @@
+"""TensorRT integration surface (reference: contrib/tensorrt.py).
+
+Unsupported by design: TensorRT is an NVIDIA inference runtime; on TPU
+the same role (whole-graph fusion + low-precision inference) is played
+by XLA compilation and the int8 path in contrib.quantization. These
+entry points exist so reference code fails with an actionable message
+instead of an AttributeError (same stance as rtc.CudaModule).
+"""
+
+__all__ = ["set_use_fp16", "get_use_fp16", "init_tensorrt_params"]
+
+_MSG = ("TensorRT is CUDA-specific and not part of the TPU build; XLA "
+        "already performs whole-graph fusion, and int8 inference lives "
+        "in mxnet_tpu.contrib.quantization.quantize_model")
+
+
+def set_use_fp16(status):
+    raise NotImplementedError(_MSG)
+
+
+def get_use_fp16():
+    raise NotImplementedError(_MSG)
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    raise NotImplementedError(_MSG)

@@ -104,3 +104,38 @@ def test_tensorboard_tsv_writer_direct(tmp_path):
     files = glob.glob(str(tmp_path / "tsv" / "scalars_*.tsv"))
     lines = open(files[0]).read().strip().splitlines()
     assert len(lines) == 2 and lines[0].startswith("train-accuracy\t")
+
+
+def test_contrib_legacy_autograd():
+    import numpy as np
+    g = mx.contrib.autograd.grad(lambda x: mx.nd.sum(x * x))
+    x = mx.nd.array(np.array([1., 2., 3.], np.float32))
+    np.testing.assert_allclose(g(x)[0].asnumpy(), [2., 4., 6.])
+    gl = mx.contrib.autograd.grad_and_loss(lambda x: mx.nd.sum(x * 3))
+    grads, loss = gl(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 3.0)
+    assert float(loss.asnumpy()) == 18.0
+    prev = mx.contrib.autograd.set_is_training(True)
+    mx.contrib.autograd.set_is_training(prev)
+
+
+def test_contrib_dataloader_iter():
+    import numpy as np
+    ds = mx.gluon.data.ArrayDataset(
+        mx.nd.array(np.random.rand(32, 4).astype(np.float32)),
+        mx.nd.array(np.arange(32, dtype=np.float32)))
+    loader = mx.gluon.data.DataLoader(ds, batch_size=8)
+    it = mx.contrib.io.DataLoaderIter(loader)
+    assert it.batch_size == 8
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_contrib_op_namespaces_and_tensorrt_stub():
+    assert callable(mx.contrib.ndarray.box_iou)
+    assert callable(mx.contrib.symbol.quadratic)
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        mx.contrib.tensorrt.init_tensorrt_params(None, {}, {})
