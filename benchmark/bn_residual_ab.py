@@ -1,0 +1,84 @@
+"""A/B probe: BatchNorm backward-residual dtype on the ResNet-50 step.
+
+PERF.md "Framework step vs hand-built step": the shipped BN computes
+`centered` in fp32, which the backward saves as a residual (4 B/elem on
+every BN input); this script patches in a bf16-centered variant (fp32
+accumulation only inside the reductions) and reports XLA cost analysis
+plus measured img/s. Run on a chip:
+
+    python benchmark/bn_residual_ab.py          # patched (bf16 residuals)
+    python benchmark/bn_residual_ab.py base     # shipped BN
+
+Compare 'bytes accessed' and img/s; flip ops/nn.py batch_norm if the
+patched variant wins on both.
+"""
+
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.utils import functionalize_block
+
+def batch_norm_bf16(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, is_train=False):
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        stat_shape = [1]*data.ndim; stat_shape[ax]=data.shape[ax]
+        shift = lax.stop_gradient(moving_mean.astype(data.dtype)).reshape(stat_shape)
+        centered = data - shift           # stays bf16 (residuals halve)
+        mean_c = jnp.mean(centered, axis=red, dtype=jnp.float32)
+        var = jnp.maximum(jnp.mean(jnp.square(centered), axis=red, dtype=jnp.float32) - mean_c*mean_c, 0.0)
+        mean = (mean_c + shift.reshape(-1).astype(jnp.float32)).astype(moving_mean.dtype)
+        var = var.astype(moving_var.dtype)
+    else:
+        mean, var = moving_mean, moving_var
+    shape=[1]*data.ndim; shape[ax]=data.shape[ax]
+    inv = lax.rsqrt(var.astype(jnp.float32)+eps)
+    scale=(g.astype(jnp.float32)*inv).astype(data.dtype)
+    bias=(beta.astype(jnp.float32)-g.astype(jnp.float32)*mean.astype(jnp.float32)*inv).astype(data.dtype)
+    out = data*scale.reshape(shape)+bias.reshape(shape)
+    return out.astype(data.dtype), mean, var
+
+import sys
+if "base" not in sys.argv:
+    mx.ops._REGISTRY["BatchNorm"].fn = batch_norm_bf16
+
+batch=256
+net = vision.resnet50_v1(classes=1000)
+net.initialize(mx.init.Xavier())
+x0 = mx.nd.zeros((batch,3,224,224))
+graph_fn, data_names, args, aux = functionalize_block(net, x0, is_train=True)
+key = jax.random.PRNGKey(0)
+def loss_of(args_f32, aux, x, y):
+    args_bf16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), args_f32)
+    inputs = dict(args_bf16); inputs[data_names[0]] = x.astype(jnp.bfloat16)
+    aux_bf16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), aux)
+    outs, aux_up = graph_fn(inputs, aux_bf16, key)
+    logits = outs[0].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, y[:,None], axis=-1)[:,0]
+    return nll.mean(), jax.tree.map(lambda a: a.astype(jnp.float32), aux_up)
+x = jnp.asarray(np.random.RandomState(0).rand(batch,3,224,224).astype("float32"))
+y = jnp.asarray(np.random.RandomState(0).randint(0,1000,(batch,)), jnp.int32)
+def step(a, mom, ax):
+    (l,axu),gr = jax.value_and_grad(loss_of, has_aux=True)(a,ax,x,y)
+    mom = jax.tree.map(lambda m,gg: 0.9*m+gg.astype(jnp.float32), mom, gr)
+    a = jax.tree.map(lambda p,m: p-0.1*m, a, mom)
+    return a, mom, axu, l
+mom = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), args)
+jitted = jax.jit(step, donate_argnums=(0,1,2))
+c = jitted.lower(args,mom,aux).compile()
+ca = c.cost_analysis(); ca = ca[0] if isinstance(ca,(list,tuple)) else ca
+print("cost: %.2f TFLOP  %.1f GB" % (ca.get('flops',0)/1e12, ca.get('bytes accessed',0)/1e9))
+import time
+args,mom,aux,loss = jitted(args,mom,aux); float(loss)
+args,mom,aux,loss = jitted(args,mom,aux); float(loss)
+t0=time.time()
+for _ in range(20):
+    args,mom,aux,loss = jitted(args,mom,aux)
+print("loss", float(loss))
+dt=time.time()-t0
+print("img/s:", batch*20/dt)
