@@ -241,23 +241,37 @@ def retain(arr, indices):
                             arr._ctx)
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("num_rows",))
+def _csr_dot(sp_data, sp_indices, row_ids, dense, num_rows):
+    gathered = sp_data[:, None] * dense[sp_indices]
+    return jax.ops.segment_sum(gathered, row_ids, num_segments=num_rows)
+
+
+@_functools.partial(jax.jit, static_argnames=("num_cols",))
+def _csr_t_dot(sp_data, sp_indices, row_ids, dense, num_cols):
+    contrib = sp_data[:, None] * dense[row_ids]
+    out = jnp.zeros((num_cols, dense.shape[1]), contrib.dtype)
+    return out.at[sp_indices].add(contrib)
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (dot-inl.h): csr x dense and csr.T x dense lower
-    to segment-sum / scatter-add on the TPU."""
+    to segment-sum / scatter-add, jit-compiled (cached per nnz/shape)."""
     from . import ndarray as nd
     if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
                                                       BaseSparseNDArray):
         dense = rhs._data
         if transpose_a:
             # out[c] += data[k] * dense[row_ids[k]] scattered to indices
-            contrib = lhs._sp_data[:, None] * dense[lhs._row_ids]
-            out = jnp.zeros((lhs.shape[1], dense.shape[1]), contrib.dtype)
-            out = out.at[lhs._sp_indices].add(contrib)
-            return NDArray(out, lhs._ctx)
-        gathered = lhs._sp_data[:, None] * dense[lhs._sp_indices]
-        out = jax.ops.segment_sum(gathered, lhs._row_ids,
-                                  num_segments=lhs.shape[0])
-        return NDArray(out, lhs._ctx)
+            return NDArray(_csr_t_dot(lhs._sp_data, lhs._sp_indices,
+                                      lhs._row_ids, dense,
+                                      num_cols=lhs.shape[1]), lhs._ctx)
+        return NDArray(_csr_dot(lhs._sp_data, lhs._sp_indices,
+                                lhs._row_ids, dense,
+                                num_rows=lhs.shape[0]), lhs._ctx)
     if isinstance(lhs, BaseSparseNDArray):
         lhs = lhs.todense()
     if isinstance(rhs, BaseSparseNDArray):

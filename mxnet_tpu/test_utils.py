@@ -261,3 +261,51 @@ def assert_exception(f, exception_type, *args, **kwargs):
     except exception_type:
         return
     raise AssertionError("Did not raise %s" % exception_type.__name__)
+
+
+def with_seed(seed=None):
+    """Reproducible-RNG test decorator (reference
+    tests/python/unittest/common.py with_seed): seeds numpy and
+    mx.random per test from MXNET_TEST_SEED, the decorator argument, or
+    a fresh draw — and prints the seed on failure so the run can be
+    replayed (tools/flakiness_checker.py sets the env var)."""
+    import functools
+    import os
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXNET_TEST_SEED")
+            this_seed = seed if seed is not None else \
+                (int(env) if env else np.random.randint(0, 2**31))
+            np.random.seed(this_seed)
+            from . import random as _mxrandom
+            _mxrandom.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except BaseException:
+                import logging
+                logging.error(
+                    "test %s failed with MXNET_TEST_SEED=%d — set the env "
+                    "var to reproduce", fn.__name__, this_seed)
+                raise
+        return wrapper
+    return deco
+
+
+def retry(n):
+    """Re-run a flaky test up to n times (reference common.py retry)."""
+    import functools
+    assert n > 0
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return fn(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+        return wrapper
+    return deco
