@@ -187,3 +187,11 @@ def test_sparse_factorization_machine_learns():
     assert r.returncode == 0, r.stderr[-2000:]
     acc = float(r.stdout.rsplit("accuracy=", 1)[1])
     assert acc > 0.7
+
+
+def test_sparse_wide_deep_learns():
+    r = _run([sys.executable, "examples/sparse/wide_deep.py",
+              "--num-epochs", "8", "--num-samples", "3072"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.rsplit("accuracy=", 1)[1])
+    assert acc > 0.75
