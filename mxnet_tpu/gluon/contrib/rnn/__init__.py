@@ -1,3 +1,6 @@
 """Contrib recurrent cells (reference: gluon/contrib/rnn/)."""
 from .conv_rnn_cell import *  # noqa: F401,F403
-from .conv_rnn_cell import __all__  # noqa: F401
+from .rnn_cell import LSTMPCell, VariationalDropoutCell  # noqa: F401
+from . import conv_rnn_cell, rnn_cell
+
+__all__ = list(conv_rnn_cell.__all__) + list(rnn_cell.__all__)

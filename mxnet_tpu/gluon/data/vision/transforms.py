@@ -91,12 +91,12 @@ class CropResize(Block):
         self._interp = interpolation
 
     def forward(self, x):
-        out = _image.fixed_crop(x, self._x, self._y, self._w, self._h)
+        size = None
         if self._size is not None:
-            size = (self._size, self._size) if isinstance(self._size, int) \
-                else tuple(self._size)
-            out = _image.imresize(out, size[0], size[1], self._interp)
-        return out
+            size = (self._size, self._size) \
+                if isinstance(self._size, int) else tuple(self._size)
+        return _image.fixed_crop(x, self._x, self._y, self._w, self._h,
+                                 size, self._interp)
 
 
 class CenterCrop(Block):

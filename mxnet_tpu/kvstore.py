@@ -229,7 +229,6 @@ class KVStoreDevice(KVStore):
 
 
 @functools.lru_cache(maxsize=256)
-@functools.lru_cache(maxsize=None)
 def _allreduce_jit(mesh_devices, shape, dtype):
     """Compiled worker-axis reduction: input one shard per device along a
     'worker' axis, output replicated — XLA lowers this to an all-reduce
@@ -262,6 +261,8 @@ class KVStoreTPUSync(KVStore):
         self._flat_devices = tuple(self._mesh.devices.reshape(-1))
         self._replicated = NamedSharding(
             Mesh(np.asarray(self._flat_devices), ("worker",)), P())
+        self._per_proc = None
+        self._proc_sharding = None
 
     def init(self, key, value):
         """Stored values live replicated over the whole mesh so the
@@ -309,24 +310,29 @@ class KVStoreTPUSync(KVStore):
         summed value as a process-local array so the updater/pull path
         stays eager-friendly."""
         local = jnp.asarray(_sum_n(*datas) if len(datas) > 1 else datas[0])
-        nproc = jax.process_count()
-        per_proc = []
-        for p in range(nproc):
-            per_proc.append(next(d for d in jax.devices()
-                                 if d.process_index == p))
-        per_proc = tuple(per_proc)
+        per_proc, sharding = self._process_topology()
         mine = jax.device_put(local[None],
                               per_proc[jax.process_index()])
-        mesh = Mesh(np.asarray(per_proc), ("worker",))
         global_arr = jax.make_array_from_single_device_arrays(
-            (nproc,) + tuple(local.shape),
-            NamedSharding(mesh, P("worker")), [mine])
+            (len(per_proc),) + tuple(local.shape), sharding, [mine])
         reduce_fn = _allreduce_jit(per_proc,
-                                   (nproc,) + tuple(local.shape),
+                                   (len(per_proc),) + tuple(local.shape),
                                    str(local.dtype))
         out = reduce_fn(global_arr)
         # fully-replicated: the local shard IS the global sum
         return out.addressable_data(0)
+
+    def _process_topology(self):
+        """One representative device per process + the worker sharding —
+        static for the job, computed once (pushes run per key per step)."""
+        if self._per_proc is None:
+            per_proc = tuple(
+                next(d for d in jax.devices() if d.process_index == p)
+                for p in range(jax.process_count()))
+            mesh = Mesh(np.asarray(per_proc), ("worker",))
+            self._per_proc = per_proc
+            self._proc_sharding = NamedSharding(mesh, P("worker"))
+        return self._per_proc, self._proc_sharding
 
     @property
     def type(self):
