@@ -8,6 +8,7 @@ once per batch; device-side normalize/flip also exist as jax ops for
 in-graph use (ops applied under jit fuse into the input pipeline).
 """
 
+import math
 import os
 import random as pyrandom
 
@@ -537,11 +538,16 @@ class ImageIter(DataIter):
             img = aug(img)
         return _as_np(img).transpose(2, 0, 1), label
 
+    def _label_batch_shape(self):
+        """Trailing label dims of one batch row — (label_width,) here;
+        ImageDetIter overrides with its (max_objects, object_width)."""
+        return (self.label_width,)
+
     def next(self):
         batch_data = np.zeros((self.batch_size,) + self.data_shape,
                               np.float32)
-        batch_label = np.zeros((self.batch_size, self.label_width),
-                               np.float32)
+        batch_label = np.zeros((self.batch_size,)
+                               + self._label_batch_shape(), np.float32)
         rows = []
         try:
             while len(rows) < self.batch_size:
@@ -567,15 +573,318 @@ class ImageIter(DataIter):
             for i, (arr, label) in enumerate(rows):
                 batch_data[i] = arr
                 batch_label[i] = label
-            label_out = batch_label[:, 0] if self.label_width == 1 \
-                else batch_label
+            label_out = batch_label[:, 0] if batch_label.ndim == 2 \
+                and self.label_width == 1 else batch_label
             return DataBatch(data=[nd.array(batch_data)],
                              label=[nd.array(label_out)], pad=pad)
         for i, (arr, label) in enumerate(rows):
             batch_data[i] = arr
             batch_label[i] = label
-        label_out = batch_label[:, 0] if self.label_width == 1 \
-            else batch_label
+        label_out = batch_label[:, 0] if batch_label.ndim == 2 \
+            and self.label_width == 1 else batch_label
         return DataBatch(data=[nd.array(batch_data)],
                          label=[nd.array(label_out)],
                          pad=self.batch_size - len(rows))
+
+
+# ---------------------------------------------------------- detection --
+# Reference: python/mxnet/image/detection.py — the SSD-style pipeline
+# where every augmentation transforms the image AND its box labels.
+# Label wire format (im2rec detection packing): [header_width A,
+# object_width B, <extra header>, obj0[B], obj1[B], ...] with each
+# object [cls_id, xmin, ymin, xmax, ymax] in normalized coordinates.
+
+class DetAugmenter(object):
+    """Base detection augmenter: __call__(src, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the detection pipeline (labels
+    pass through — only photometric/normalize augs are safe to borrow)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly apply exactly one of aug_list (or none, with skip_prob)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd.array(_as_np(src)[:, ::-1].copy())
+            out = label.copy()
+            valid = out[:, 0] >= 0
+            xmin = out[valid, 1].copy()
+            out[valid, 1] = 1.0 - out[valid, 3]
+            out[valid, 3] = 1.0 - xmin
+            label = out
+        return src, label
+
+
+def _box_overlap_frac(boxes, crop):
+    """Fraction of each box's area inside crop (x0, y0, x1, y1)."""
+    ix = np.maximum(0.0, np.minimum(boxes[:, 3], crop[2])
+                    - np.maximum(boxes[:, 1], crop[0]))
+    iy = np.maximum(0.0, np.minimum(boxes[:, 4], crop[3])
+                    - np.maximum(boxes[:, 2], crop[1]))
+    inter = ix * iy
+    area = np.maximum(1e-12, (boxes[:, 3] - boxes[:, 1])
+                      * (boxes[:, 4] - boxes[:, 2]))
+    return inter / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained to keep objects reasonably covered
+    (reference DetRandomCropAug semantics: sample up to max_attempts
+    crops in the area/aspect ranges, accept when every kept object is
+    covered at least min_object_covered; objects whose coverage falls
+    below min_eject_coverage are dropped from the label)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, math.sqrt(area * ratio))
+            ch = min(1.0, math.sqrt(area / ratio))
+            x0 = pyrandom.uniform(0, 1 - cw)
+            y0 = pyrandom.uniform(0, 1 - ch)
+            crop = (x0, y0, x0 + cw, y0 + ch)
+            valid = label[:, 0] >= 0
+            if not valid.any():
+                break
+            cov = _box_overlap_frac(label[valid], crop)
+            keep = cov >= self.min_eject_coverage
+            if not keep.any():
+                continue
+            if (cov[keep] >= self.min_object_covered).all():
+                out = np.full_like(label, -1.0)
+                kept = label[valid][keep].copy()
+                # clip to the crop window and renormalize
+                kept[:, 1] = (np.clip(kept[:, 1], x0, crop[2]) - x0) / cw
+                kept[:, 3] = (np.clip(kept[:, 3], x0, crop[2]) - x0) / cw
+                kept[:, 2] = (np.clip(kept[:, 2], y0, crop[3]) - y0) / ch
+                kept[:, 4] = (np.clip(kept[:, 4], y0, crop[3]) - y0) / ch
+                out[:len(kept)] = kept
+                px0, py0 = int(x0 * w), int(y0 * h)
+                px1, py1 = int(math.ceil(crop[2] * w)), \
+                    int(math.ceil(crop[3] * h))
+                return nd.array(arr[py0:py1, px0:px1].copy()), out
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Place the image on a larger canvas (zoom-out) and rescale boxes."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            ch = math.sqrt(area / ratio)
+            cw = math.sqrt(area * ratio)
+            if ch < 1.0 or cw < 1.0:
+                continue
+            nh, nw = int(h * ch), int(w * cw)
+            y0 = pyrandom.randint(0, nh - h)
+            x0 = pyrandom.randint(0, nw - w)
+            canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
+            canvas[...] = np.asarray(self.pad_val, arr.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = arr
+            out = label.copy()
+            valid = out[:, 0] >= 0
+            out[valid, 1] = (out[valid, 1] * w + x0) / nw
+            out[valid, 3] = (out[valid, 3] * w + x0) / nw
+            out[valid, 2] = (out[valid, 2] * h + y0) / nh
+            out[valid, 4] = (out[valid, 4] * h + y0) / nh
+            return nd.array(canvas), out
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0., rand_mirror=False, mean=None,
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmentation pipeline (reference
+    CreateDetAugmenter): geometric det-augs + borrowed photometric augs
+    + final forced resize to data_shape."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(1.0, area_range[0]), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(CastAug()))
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: batches (data, padded object labels).
+
+    Labels parse from the im2rec detection header [A, B, ...extra,
+    objects...]; every batch emits (batch, max_objects, object_width)
+    padded with -1 (reference ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        super(ImageDetIter, self).__init__(
+            batch_size=batch_size, data_shape=data_shape,
+            path_imgrec=path_imgrec, path_imglist=path_imglist,
+            path_root=path_root, path_imgidx=path_imgidx,
+            shuffle=shuffle, aug_list=[] if aug_list is None else aug_list,
+            imglist=imglist, data_name=data_name, label_name=label_name,
+            last_batch_handle=last_batch_handle,
+            **{k: v for k, v in kwargs.items() if k in ()})
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        # scan labels once for (max_objects, object_width)
+        max_obj, owidth = 1, 5
+        for idx in self.seq:
+            lab = self._raw_label(idx)
+            parsed = self._parse_det_label(lab)
+            max_obj = max(max_obj, parsed.shape[0])
+            owidth = parsed.shape[1]
+        self._max_objects = max_obj
+        self._object_width = owidth
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, max_obj, owidth), "float32")]
+
+    def _label_batch_shape(self):
+        return (self._max_objects, self._object_width)
+
+    def _raw_label(self, idx):
+        from . import recordio
+        if self.imgrec is not None:
+            header, _ = recordio.unpack(self.imgrec.read_idx(idx))
+            return np.asarray(header.label, dtype=np.float32)
+        return self.imglist[idx][0]
+
+    @staticmethod
+    def _parse_det_label(label):
+        """[A, B, extra..., obj0[B]...] -> (num_obj, B) array; raw flat
+        object lists (no header) fall back to width 5."""
+        label = np.asarray(label, dtype=np.float32).ravel()
+        if label.size >= 2 and 1 <= label[0] <= 16 and \
+                2 <= label[1] <= 16:
+            a, b = int(label[0]), int(label[1])
+            body = label[a:]
+        else:
+            b = 5
+            body = label
+        n = body.size // b
+        return body[:n * b].reshape(n, b).copy()
+
+    def _decoded_sample(self):
+        if self._cache:
+            return self._cache.pop(0)
+        label, s = self.next_sample()
+        img = imdecode(s)
+        parsed = self._parse_det_label(label)
+        padded = np.full((self._max_objects, self._object_width), -1.0,
+                         np.float32)
+        padded[:len(parsed)] = parsed
+        for aug in self.auglist:
+            img, padded = aug(img, padded)
+        return _as_np(img).transpose(2, 0, 1), padded
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Change batch shapes between bindings (reference reshape)."""
+        if data_shape is not None:
+            self.data_shape = data_shape
+            self.provide_data = [DataDesc(
+                self.provide_data[0].name,
+                (self.batch_size,) + data_shape, "float32")]
+        if label_shape is not None:
+            self._max_objects, self._object_width = label_shape
+            self.provide_label = [DataDesc(
+                self.provide_label[0].name,
+                (self.batch_size,) + tuple(label_shape), "float32")]
+
+    def sync_label_shape(self, it, verbose=False):
+        """Grow both iterators to the common max label shape (reference
+        sync_label_shape, used to align train and val iterators)."""
+        assert isinstance(it, ImageDetIter)
+        mo = max(self._max_objects, it._max_objects)
+        ow = max(self._object_width, it._object_width)
+        self.reshape(label_shape=(mo, ow))
+        it.reshape(label_shape=(mo, ow))
+        return it

@@ -271,3 +271,46 @@ def test_image_ops_and_hybrid_transforms():
     # symbol namespace composes
     s = sym.image.normalize(sym.Variable("x"), mean=(0.5,), std=(0.5,))
     assert "image_normalize" in s.tojson()
+
+
+def test_image_det_iter_and_augmenters(tmp_path):
+    """Detection pipeline (reference python/mxnet/image/detection.py):
+    header-parsed box labels, padded batches, label-aware geometric
+    augs keep coordinates normalized."""
+    import cv2
+    import numpy as np
+    imglist = []
+    for i in range(4):
+        img = (np.random.RandomState(i).rand(40, 60, 3) * 255) \
+            .astype(np.uint8)
+        cv2.imwrite(str(tmp_path / ("im%d.jpg" % i)), img)
+        objs = [[i % 3, 0.1, 0.2, 0.5, 0.6]]
+        if i % 2:
+            objs.append([1, 0.4, 0.3, 0.9, 0.8])
+        imglist.append(([2, 5] + [v for o in objs for v in o],
+                        "im%d.jpg" % i))
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=str(tmp_path),
+                               rand_crop=0.5, rand_pad=0.5,
+                               rand_mirror=True)
+    assert it.provide_label[0].shape == (2, 2, 5)
+    for b in it:
+        lab = b.label[0].asnumpy()
+        assert b.data[0].shape == (2, 3, 32, 32)
+        valid = lab[lab[:, :, 0] >= 0]
+        assert len(valid) >= 1
+        assert (valid[:, 1:] >= -1e-6).all() and \
+            (valid[:, 1:] <= 1 + 1e-6).all()
+    # deterministic flip: mirrored boxes stay consistent
+    flip = mx.image.DetHorizontalFlipAug(p=1.0)
+    src = mx.nd.array(np.zeros((10, 10, 3), np.uint8), dtype="uint8")
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    _, out = flip(src, label)
+    np.testing.assert_allclose(out[0], [0, 0.6, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+    # sync_label_shape grows the smaller iterator
+    it2 = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                                imglist=imglist[:1],
+                                path_root=str(tmp_path))
+    it.sync_label_shape(it2)
+    assert it2.provide_label[0].shape == it.provide_label[0].shape
