@@ -1,0 +1,170 @@
+"""Toy single-shot detector trained end to end.
+
+Parity target: example/ssd/ (gluon idiom): ImageDetIter feeding padded
+box labels, MultiBoxPrior anchors, conv heads for class scores + box
+offsets, MultiBoxTarget matching under autograd, MultiBoxDetection +
+NMS at inference. Synthetic data (one rectangle per image; class by
+shade) stands in for VOC.
+
+    python examples/ssd_detection.py --num-epochs 15
+"""
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+SIZE = 64
+CLASSES = 2
+ANCHOR_SIZES = (0.4, 0.7)
+ANCHOR_RATIOS = (1.0, 1.5)
+NUM_ANCHORS = len(ANCHOR_SIZES) + len(ANCHOR_RATIOS) - 1   # per cell
+
+
+def synthesize(root, n, seed):
+    """One rectangle per image; class 0 = dim, class 1 = bright."""
+    import cv2
+    rs = np.random.RandomState(seed)
+    imglist = []
+    for i in range(n):
+        img = np.full((SIZE, SIZE, 3), 30, np.uint8)
+        w = rs.randint(20, 44)
+        h = rs.randint(20, 44)
+        x0 = rs.randint(0, SIZE - w)
+        y0 = rs.randint(0, SIZE - h)
+        cls = rs.randint(0, CLASSES)
+        img[y0:y0 + h, x0:x0 + w] = 120 if cls == 0 else 230
+        fname = "s%d_%d.png" % (seed, i)
+        cv2.imwrite(os.path.join(root, fname), img)
+        box = [float(cls), x0 / SIZE, y0 / SIZE, (x0 + w) / SIZE,
+               (y0 + h) / SIZE]
+        imglist.append(([2, 5] + box, fname))
+    return imglist
+
+
+def build_net(mx):
+    """Tiny backbone down to 8x8 + one detection head."""
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential(prefix="ssd_")
+    with net.name_scope():
+        for ch in (16, 32, 32):
+            net.add(gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"))
+            net.add(gluon.nn.MaxPool2D(2, 2))
+    cls_head = gluon.nn.Conv2D(NUM_ANCHORS * (CLASSES + 1), 3, padding=1,
+                               prefix="ssd_cls_")
+    loc_head = gluon.nn.Conv2D(NUM_ANCHORS * 4, 3, padding=1,
+                               prefix="ssd_loc_")
+    return net, cls_head, loc_head
+
+
+def forward(mx, net, cls_head, loc_head, x):
+    from mxnet_tpu import nd
+    feat = net(x)
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=ANCHOR_SIZES,
+                                       ratios=ANCHOR_RATIOS)
+    cls_pred = cls_head(feat)       # (B, A*(C+1), H, W)
+    loc_pred = loc_head(feat)       # (B, A*4, H, W)
+    B = x.shape[0]
+    cls_pred = nd.transpose(cls_pred, axes=(0, 2, 3, 1)) \
+        .reshape(B, -1, CLASSES + 1)
+    cls_pred = nd.transpose(cls_pred, axes=(0, 2, 1))   # (B, C+1, N)
+    loc_pred = nd.transpose(loc_pred, axes=(0, 2, 3, 1)).reshape(B, -1)
+    return anchors, cls_pred, loc_pred
+
+
+def evaluate(mx, net, cls_head, loc_head, it):
+    """Detection accuracy: the top post-NMS detection must have the gt
+    class and IoU > 0.5 with the gt box."""
+    from mxnet_tpu import nd
+    it.reset()
+    hits, total = 0, 0
+    for batch in it:
+        anchors, cls_pred, loc_pred = forward(mx, net, cls_head,
+                                              loc_head, batch.data[0])
+        probs = nd.softmax(cls_pred, axis=1)
+        out = nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
+                                           nms_threshold=0.45)
+        det = out.asnumpy()          # (B, N, 6): id score x0 y0 x1 y1
+        gt = batch.label[0].asnumpy()
+        for b in range(det.shape[0] - (batch.pad or 0)):
+            total += 1
+            valid = det[b][det[b, :, 0] >= 0]
+            if not len(valid):
+                continue
+            top = valid[np.argmax(valid[:, 1])]
+            g = gt[b][gt[b, :, 0] >= 0][0]
+            ix = max(0, min(top[4], g[3]) - max(top[2], g[1]))
+            iy = max(0, min(top[5], g[4]) - max(top[3], g[2]))
+            inter = ix * iy
+            union = (top[4] - top[2]) * (top[5] - top[3]) \
+                + (g[3] - g[1]) * (g[4] - g[2]) - inter
+            if int(top[0]) == int(g[0]) and inter / max(union, 1e-9) > 0.5:
+                hits += 1
+    return hits / max(total, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-samples", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_list = synthesize(tmp, args.num_samples, seed=0)
+        val_list = synthesize(tmp, 64, seed=7)
+        it = mx.image.ImageDetIter(
+            batch_size=args.batch_size, data_shape=(3, SIZE, SIZE),
+            imglist=train_list, path_root=tmp, mean=True, std=True)
+        val_it = mx.image.ImageDetIter(
+            batch_size=args.batch_size, data_shape=(3, SIZE, SIZE),
+            imglist=val_list, path_root=tmp, mean=True, std=True)
+
+        net, cls_head, loc_head = build_net(mx)
+        for blk in (net, cls_head, loc_head):
+            blk.initialize(mx.init.Xavier())
+        params = {}
+        for blk in (net, cls_head, loc_head):
+            params.update(blk.collect_params())
+        trainer = gluon.Trainer(params, "sgd",
+                                {"learning_rate": args.lr,
+                                 "momentum": 0.9})
+        ce_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+        for epoch in range(args.num_epochs):
+            it.reset()
+            tot, nb = 0.0, 0
+            for batch in it:
+                with autograd.record():
+                    anchors, cls_pred, loc_pred = forward(
+                        mx, net, cls_head, loc_head, batch.data[0])
+                    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                        anchors, batch.label[0], cls_pred,
+                        negative_mining_ratio=3.0)
+                    cls_l = ce_loss(cls_pred, cls_t)
+                    loc_l = nd.mean(nd.smooth_l1(
+                        (loc_pred - loc_t) * loc_m, scalar=1.0))
+                    loss = nd.mean(cls_l) + loc_l
+                loss.backward()
+                trainer.step(1)
+                tot += float(loss.asnumpy())
+                nb += 1
+            logging.info("Epoch[%d] loss=%.4f", epoch, tot / max(nb, 1))
+
+        acc = evaluate(mx, net, cls_head, loc_head, val_it)
+        print("final detection accuracy=%.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,3 +195,13 @@ def test_sparse_wide_deep_learns():
     assert r.returncode == 0, r.stderr[-2000:]
     acc = float(r.stdout.rsplit("accuracy=", 1)[1])
     assert acc > 0.75
+
+
+def test_ssd_detection_learns():
+    """End-to-end SSD loop: ImageDetIter -> MultiBoxPrior/Target under
+    autograd -> MultiBoxDetection eval (example/ssd parity)."""
+    r = _run([sys.executable, "examples/ssd_detection.py",
+              "--num-epochs", "12", "--num-samples", "192"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.rsplit("accuracy=", 1)[1])
+    assert acc > 0.6
