@@ -1,0 +1,136 @@
+"""DCGAN on a synthetic image distribution.
+
+Parity target: example/gluon/dcgan.py — adversarial training with a
+Conv2DTranspose generator and a conv discriminator, alternating
+real/fake discriminator steps with generator steps through the frozen
+discriminator. The "dataset" is centered bright blobs on dark
+backgrounds; success = generated samples concentrate their energy in
+the center the way real samples do.
+
+    python examples/dcgan.py --num-epochs 6
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+SIZE = 16
+LATENT = 16
+
+
+def real_batch(rs, n):
+    """Bright gaussian blob near the center, dark edges."""
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE].astype(np.float32)
+    out = np.empty((n, 1, SIZE, SIZE), np.float32)
+    for i in range(n):
+        cx = SIZE / 2 + rs.randn() * 1.5
+        cy = SIZE / 2 + rs.randn() * 1.5
+        sig = 2.5 + rs.rand()
+        img = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / (2 * sig ** 2))
+        out[i, 0] = img * 2 - 1          # [-1, 1]
+    return out
+
+
+def center_energy(imgs):
+    """Fraction of (shifted-positive) mass in the central quarter."""
+    p = imgs - imgs.min(axis=(2, 3), keepdims=True)
+    q = SIZE // 4
+    center = p[:, :, q:-q, q:-q].sum(axis=(1, 2, 3))
+    total = p.sum(axis=(1, 2, 3)) + 1e-8
+    return float((center / total).mean())
+
+
+def build(mx):
+    from mxnet_tpu import gluon
+    netG = gluon.nn.HybridSequential(prefix="gen_")
+    with netG.name_scope():
+        # latent (B, L, 1, 1) -> (B, 1, 16, 16)
+        netG.add(gluon.nn.Conv2DTranspose(32, 4, strides=1, padding=0,
+                                          use_bias=False))   # 4x4
+        netG.add(gluon.nn.BatchNorm())
+        netG.add(gluon.nn.Activation("relu"))
+        netG.add(gluon.nn.Conv2DTranspose(16, 4, strides=2, padding=1,
+                                          use_bias=False))   # 8x8
+        netG.add(gluon.nn.BatchNorm())
+        netG.add(gluon.nn.Activation("relu"))
+        netG.add(gluon.nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                          use_bias=False))   # 16x16
+        netG.add(gluon.nn.Activation("tanh"))
+    netD = gluon.nn.HybridSequential(prefix="disc_")
+    with netD.name_scope():
+        netD.add(gluon.nn.Conv2D(16, 4, strides=2, padding=1))  # 8x8
+        netD.add(gluon.nn.LeakyReLU(0.2))
+        netD.add(gluon.nn.Conv2D(32, 4, strides=2, padding=1))  # 4x4
+        netD.add(gluon.nn.LeakyReLU(0.2))
+        netD.add(gluon.nn.Conv2D(1, 4, strides=1, padding=0))   # 1x1
+        netD.add(gluon.nn.Flatten())
+    return netG, netD
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batches-per-epoch", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    rs = np.random.RandomState(0)
+    netG, netD = build(mx)
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    B = args.batch_size
+    ones = nd.ones((B,))
+    zeros = nd.zeros((B,))
+    for epoch in range(args.num_epochs):
+        dl_sum, gl_sum = 0.0, 0.0
+        for _ in range(args.batches_per_epoch):
+            real = nd.array(real_batch(rs, B))
+            latent = nd.array(rs.randn(B, LATENT, 1, 1)
+                              .astype(np.float32))
+            # --- discriminator step: real up, fake down
+            with autograd.record():
+                out_real = netD(real).reshape((-1,))
+                fake = netG(latent)
+                out_fake = netD(fake.detach()).reshape((-1,))
+                lossD = loss_fn(out_real, ones) + loss_fn(out_fake, zeros)
+            lossD.backward()
+            trainerD.step(B)
+            # --- generator step through the (frozen) discriminator
+            with autograd.record():
+                fake = netG(latent)
+                out = netD(fake).reshape((-1,))
+                lossG = loss_fn(out, ones)
+            lossG.backward()
+            trainerG.step(B)
+            dl_sum += float(nd.mean(lossD).asnumpy())
+            gl_sum += float(nd.mean(lossG).asnumpy())
+        logging.info("Epoch[%d] lossD=%.3f lossG=%.3f", epoch,
+                     dl_sum / args.batches_per_epoch,
+                     gl_sum / args.batches_per_epoch)
+
+    latent = nd.array(rs.randn(64, LATENT, 1, 1).astype(np.float32))
+    gen = netG(latent).asnumpy()
+    real_ce = center_energy(real_batch(rs, 64))
+    gen_ce = center_energy(gen)
+    print("center-energy real=%.3f generated=%.3f" % (real_ce, gen_ce))
+
+
+if __name__ == "__main__":
+    main()
