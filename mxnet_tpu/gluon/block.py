@@ -424,6 +424,24 @@ class HybridBlock(Block):
         """Infers shape of Parameters from inputs."""
         self._deferred_infer_shape(*args)
 
+    def infer_type(self, *args):
+        """Infers dtype of Parameters from inputs (reference
+        HybridBlock.infer_type). Parameters follow the input dtype —
+        under the bf16 AMP policy a float16/bfloat16 example input casts
+        the float parameters accordingly."""
+        flat_args, _ = _flatten(args, "input")
+        real = [a for a in flat_args if a is not None]
+        if not real:
+            return
+        dtype = real[0].dtype
+        import numpy as _np
+        if _np.dtype(dtype).kind != "f":
+            return
+        for param in self.collect_params().values():
+            if param._data is not None and \
+                    _np.dtype(param.dtype).kind == "f":
+                param.cast(dtype)
+
     def _deferred_infer_shape(self, *args):
         try:
             inputs, out = self._get_graph(*args)
