@@ -624,3 +624,75 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation from a KxK confusion matrix (the
+    multiclass generalization of MCC; reference metric.py PCC):
+
+        pcc = (N * tr(C) - sum_k t_k p_k)
+              / (sqrt(N^2 - sum t_k^2) * sqrt(N^2 - sum p_k^2))
+
+    with t = true counts per class, p = predicted counts per class —
+    the discrete Pearson correlation of the label/prediction indicator
+    vectors. The confusion matrix grows lazily as new class ids appear."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        self._conf = _np.zeros((1, 1), dtype=_np.float64)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _grow(self, k):
+        if k > self._conf.shape[0]:
+            c = _np.zeros((k, k), _np.float64)
+            c[:self._conf.shape[0], :self._conf.shape[0]] = self._conf
+            self._conf = c
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            lab = _np.asarray(label.asnumpy()).reshape(-1).astype(_np.int64)
+            p = _np.asarray(pred.asnumpy())
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.reshape(-1, p.shape[-1]).argmax(axis=-1)
+            else:
+                p = (p.reshape(-1) > 0.5)
+            p = p.astype(_np.int64)
+            n = min(len(lab), len(p))
+            self._grow(int(max(lab.max(initial=0), p.max(initial=0))) + 1)
+            _np.add.at(self._conf, (lab[:n], p[:n]), 1.0)
+            self.num_inst += n
+            self.global_num_inst += n
+
+    @property
+    def sum_metric(self):
+        c = self._conf
+        n = c.sum()
+        if n == 0:
+            return 0.0
+        t = c.sum(axis=1)
+        pr = c.sum(axis=0)
+        cov = n * _np.trace(c) - t @ pr
+        d1 = n * n - t @ t
+        d2 = n * n - pr @ pr
+        if d1 <= 0 or d2 <= 0:
+            return 0.0
+        return float(cov / math.sqrt(d1 * d2)) * self.num_inst
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        pass            # derived from the confusion matrix
+
+    global_sum_metric = sum_metric
+
+    @global_sum_metric.setter
+    def global_sum_metric(self, v):
+        pass
+
+    def reset(self):
+        self._conf = _np.zeros((1, 1), _np.float64)
+        self.num_inst = 0
+        self.global_num_inst = 0
+
+    reset_local = reset

@@ -733,3 +733,9 @@ class Updater(object):
 def get_updater(optimizer):
     """mx.optimizer.get_updater (optimizer.py end)."""
     return Updater(optimizer)
+
+
+@register
+class ccSGD(SGD):
+    """Deprecated reference alias of SGD (optimizer.py ccSGD) — kept so
+    old configs creating 'ccsgd' resolve."""

@@ -314,3 +314,24 @@ def test_image_det_iter_and_augmenters(tmp_path):
                                 path_root=str(tmp_path))
     it.sync_label_shape(it2)
     assert it2.provide_label[0].shape == it.provide_label[0].shape
+
+
+def test_pcc_metric_matches_mcc_binary():
+    import numpy as np
+    fp, fn, tp, tn = 1000, 1, 10000, 1
+    preds = [mx.nd.array(np.array(
+        [[.3, .7]] * fp + [[.7, .3]] * tn + [[.7, .3]] * fn
+        + [[.3, .7]] * tp, np.float32))]
+    labels = [mx.nd.array(np.array([0] * (fp + tn) + [1] * (fn + tp),
+                                   np.float32))]
+    pcc = mx.metric.PCC()
+    pcc.update(labels, preds)
+    mcc = mx.metric.MCC()
+    mcc.update(labels, preds)
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+    # multiclass: perfect = 1.0, reset works
+    p3 = [mx.nd.array(np.eye(3, dtype=np.float32)[np.array([0, 1, 2, 1])])]
+    l3 = [mx.nd.array(np.array([0, 1, 2, 1], np.float32))]
+    pcc.reset()
+    pcc.update(l3, p3)
+    assert abs(pcc.get()[1] - 1.0) < 1e-9

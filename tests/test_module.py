@@ -147,3 +147,56 @@ def test_sequential_module():
     mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.5, "rescale_grad": 1.0 / 32})
     score = mod.score(mx_io.NDArrayIter(x, y, batch_size=16), "acc")
     assert score[0][1] > 0.8, score
+
+
+def test_python_loss_module_trains_through_sequential():
+    """PythonLossModule's backward feeds real gradients into the
+    preceding Module (reference module/python_module.py)."""
+    import numpy as np
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="pyl_fc")
+    mlp_mod = mx.mod.Module(fc, data_names=("data",), label_names=None)
+    loss_mod = mx.mod.PythonLossModule(data_names=("data",),
+                                       label_names=("softmax_label",))
+    seq = mx.mod.SequentialModule()
+    seq.add(mlp_mod).add(loss_mod, take_labels=True, auto_wiring=True)
+    X = np.random.RandomState(0).randn(256, 4).astype(np.float32)
+    w = np.array([[1, 0, -1, 0], [0, 1, 0, -1], [1, 1, 1, 1]], np.float32)
+    y = (X @ w.T).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, 32, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 1.0})
+    for _ in range(25):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        out = seq.get_outputs()[0].asnumpy()
+        correct += (out.argmax(1) == batch.label[0].asnumpy()).sum()
+        total += out.shape[0]
+    assert correct / total > 0.8
+
+
+def test_python_loss_module_custom_grad_func():
+    import numpy as np
+    calls = []
+
+    def gf(scores, labels):
+        calls.append(1)
+        return mx.nd.ones(scores.shape) * 0.5
+    m = mx.mod.PythonLossModule(grad_func=gf)
+    m.bind(data_shapes=[("data", (2, 3))],
+           label_shapes=[("softmax_label", (2,))])
+    from mxnet_tpu.io import DataBatch
+    m.forward(DataBatch([mx.nd.ones((2, 3))],
+                        [mx.nd.zeros((2,))]), is_train=True)
+    m.backward()
+    assert calls
+    np.testing.assert_allclose(m.get_input_grads()[0].asnumpy(), 0.5)
