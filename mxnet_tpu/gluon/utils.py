@@ -110,3 +110,12 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
     raise MXNetError(
         "download('%s'): no network egress in this environment; place the "
         "file at '%s' manually" % (url, fname))
+
+
+def shape_is_known(shape):
+    """True when no dimension is unknown (reference gluon/utils.py —
+    0/-1 mark deferred dims)."""
+    if shape is None:
+        return False
+    unknown = (-1, 0, None)
+    return all(s not in unknown for s in shape)

@@ -958,3 +958,94 @@ def _infer_graph(nodes, known_shapes, known_dtypes, partial=False):
 
 def _node_out_name(node, k):
     return "%s#%d" % (node.name, k)
+
+
+# ------------------------------------------------- fluent methods -------
+# Reference Symbol fluent methods (python/mxnet/symbol/symbol.py):
+# s.relu(), s.sum(axis=..), s.slice_axis(...) delegate to the namespace
+# functions; NDArray-only operations raise NotImplementedForSymbol.
+_SYM_FLUENT = [
+    "abs", "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctanh",
+    "argmax", "argmax_channel", "argmin", "argsort", "broadcast_axes",
+    "broadcast_like", "broadcast_to", "cbrt", "ceil", "clip", "cos",
+    "cosh", "degrees", "depth_to_space", "diag", "exp", "expand_dims",
+    "expm1", "fix", "flatten", "flip", "floor", "log", "log10", "log1p",
+    "log2", "log_softmax", "max", "mean", "min", "nanprod", "nansum",
+    "norm", "one_hot", "ones_like", "pad", "pick", "prod", "radians",
+    "rcbrt", "reciprocal", "relu", "repeat", "reshape_like", "rint",
+    "round", "rsqrt", "shape_array", "sigmoid", "sign", "sin", "sinh",
+    "size_array", "slice", "slice_axis", "slice_like", "softmax",
+    "softmin", "sort", "space_to_depth", "split", "split_v2", "sqrt",
+    "square", "squeeze", "sum", "swapaxes", "take", "tan", "tanh",
+    "tile", "topk", "transpose", "trunc", "zeros_like",
+]
+
+
+def _make_sym_fluent(name):
+    opname = {"flip": "reverse", "split": "SliceChannel",
+              "split_v2": "_split_v2", "pad": "Pad",
+              "slice": "slice"}.get(name, name)
+
+    def method(self, *args, **kwargs):
+        fn = _g.get(opname) or (_make_sym_func(opname)
+                                if ops.exists(opname) else None)
+        if fn is None:
+            raise AttributeError(name)
+        return fn(self, *args, **kwargs)
+    method.__name__ = name
+    method.__doc__ = "Fluent form of sym.%s(self, ...)." % name
+    return method
+
+
+for _name in _SYM_FLUENT:
+    if not hasattr(Symbol, _name):
+        setattr(Symbol, _name, _make_sym_fluent(_name))
+
+
+class NotImplementedForSymbol(MXNetError):
+    """Raised by NDArray-only methods called on a Symbol (reference
+    symbol.py NotImplementedForSymbol)."""
+
+    def __init__(self, function, *_):
+        super().__init__("Function %s is not implemented for Symbol and "
+                         "only available in NDArray." % function)
+
+
+def _sym_na(name):
+    def method(self, *args, **kwargs):
+        raise NotImplementedForSymbol(name)
+    method.__name__ = name
+    return method
+
+
+for _name in ("asnumpy", "asscalar", "wait_to_read", "backward",
+              "as_in_context", "copy", "detach"):
+    if not hasattr(Symbol, _name):
+        setattr(Symbol, _name, _sym_na(_name))
+
+# the numpy-flavored symbol API resolves to the same Symbol class here
+# (both namespaces dispatch into the one op registry)
+Symbol.as_np_ndarray = lambda self: self
+Symbol.as_nd_ndarray = lambda self: self
+
+
+def _sym_list_attr(self, recursive=False):
+    """Attributes of this symbol's node (reference list_attr)."""
+    ni, _ = self._outputs[0]
+    return dict(self._nodes[ni].attrs)
+
+
+Symbol.list_attr = _sym_list_attr
+
+
+def _sym_debug_str(self):
+    lines = []
+    for i, node in enumerate(self._nodes):
+        ins = ", ".join(s._nodes[s._outputs[0][0]].name
+                        for s, _ in node.inputs) if node.inputs else ""
+        lines.append("%3d %-20s %-24s <- %s"
+                     % (i, node.op or "Variable", node.name, ins))
+    return "\n".join(lines)
+
+
+Symbol.debug_str = _sym_debug_str

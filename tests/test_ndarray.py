@@ -317,3 +317,20 @@ def test_csr_matrix_tuple():
     expect = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
     assert_close(m.asnumpy(), expect)
     assert m.stype == "csr"
+
+
+def test_fluent_methods_match_namespace():
+    import numpy as np
+    x = mx.nd.array(np.array([0.5, -1.2, 2.0], np.float32))
+    np.testing.assert_allclose(x.sin().asnumpy(), np.sin(x.asnumpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(x.ceil().asnumpy(), np.ceil(x.asnumpy()))
+    np.testing.assert_allclose(x.clip(a_min=-1, a_max=1).asnumpy(),
+                               np.clip(x.asnumpy(), -1, 1))
+    m = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = m.slice_assign_scalar(9.0, begin=(0, 0), end=(1, 2))
+    assert out.asnumpy()[0, 0] == 9.0
+    parts = m.split_v2((1,), axis=1)
+    assert [p.shape for p in parts] == [(2, 1), (2, 2)]
+    npview = x.as_np_ndarray()
+    np.testing.assert_allclose(np.asarray(npview), x.asnumpy())

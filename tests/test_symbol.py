@@ -181,3 +181,16 @@ def test_symbol_positional_attrs():
     e = sym.expand_dims(data, 1)
     _, out_shapes, _ = e.infer_shape(data=(2, 3))
     assert out_shapes == [(2, 1, 3)]
+
+
+def test_symbol_fluent_methods_and_stubs():
+    import numpy as np
+    import pytest as _pytest
+    x = mx.sym.Variable("x")
+    y = x.relu().sum(axis=1).sqrt()
+    ex = y.bind(mx.cpu(), {"x": mx.nd.array(np.ones((2, 4), np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 2.0)
+    with _pytest.raises(mx.base.MXNetError):
+        x.asnumpy()
+    assert "relu" in y.debug_str()
+    assert x.as_np_ndarray() is x
