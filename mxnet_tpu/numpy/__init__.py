@@ -29,6 +29,10 @@ class ndarray(_classic.NDArray):
         return "array(%s)" % _onp.array2string(self.asnumpy(),
                                                separator=", ")
 
+    def __array__(self, dtype=None):
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
     def __getitem__(self, key):
         out = super(ndarray, self).__getitem__(key)
         return _wrap(out._data) if isinstance(out, _classic.NDArray) else out
