@@ -1,0 +1,74 @@
+"""Error propagation (reference tests/python/unittest/test_exc_handling.py).
+
+The reference's threaded engine captures kernel exceptions, poisons the
+output vars, and rethrows at WaitForVar. Here dispatch is synchronous
+at trace time (shape/dtype errors surface immediately at the call) and
+device-side failures surface at the first sync point (asnumpy/
+wait_to_read) — this file pins that contract.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_shape_error_raises_at_call():
+    a = nd.array(np.ones((2, 3), np.float32))
+    b = nd.array(np.ones((4, 5), np.float32))
+    with pytest.raises(Exception):
+        nd.dot(a, b)                # 3 vs 4 contraction mismatch
+
+
+def test_unknown_op_is_clean_error():
+    with pytest.raises((MXNetError, AttributeError)):
+        nd.this_op_does_not_exist(nd.array([1.0]))
+
+
+def test_bad_reshape_raises():
+    a = nd.array(np.ones((2, 3), np.float32))
+    with pytest.raises(Exception):
+        a.reshape(7, 7)
+
+
+def test_nan_does_not_poison_subsequent_ops():
+    """A NaN-producing computation must not corrupt later independent
+    ops (the reference engine only poisons dependent vars)."""
+    bad = nd.array(np.array([0.0], np.float32))
+    nan_out = nd.log(bad - 1.0)
+    assert np.isnan(nan_out.asnumpy()).all()
+    ok = nd.array(np.ones((3,), np.float32)) * 2
+    np.testing.assert_allclose(ok.asnumpy(), 2.0)
+
+
+def test_executor_bind_shape_mismatch_message():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="exc_fc")
+    with pytest.raises(Exception):
+        # weight shape inconsistent with data
+        y.bind(mx.cpu(), {"x": nd.array(np.ones((2, 3), np.float32)),
+                          "exc_fc_weight": nd.array(
+                              np.ones((4, 9), np.float32)),
+                          "exc_fc_bias": nd.array(
+                              np.ones((4,), np.float32))}).forward()
+
+
+def test_backward_outside_record_raises():
+    a = nd.array(np.ones((2,), np.float32))
+    out = a * 3
+    with pytest.raises(MXNetError):
+        out.backward()
+
+
+def test_error_inside_autograd_leaves_tape_usable():
+    a = nd.array(np.ones((2, 2), np.float32))
+    a.attach_grad()
+    with pytest.raises(Exception):
+        with mx.autograd.record():
+            b = nd.dot(a, nd.array(np.ones((3, 3), np.float32)))
+    # the tape is not wedged: a fresh record/backward works
+    with mx.autograd.record():
+        c = nd.sum(a * 2)
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2.0)
