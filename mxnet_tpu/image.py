@@ -44,9 +44,9 @@ def _as_np(img):
     return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an image byte buffer to an HWC uint8 NDArray
-    (reference imdecode: python/mxnet/image/image.py:imdecode)."""
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    """cv2-only decode to an HWC uint8 numpy array — safe on worker
+    threads (no jax dispatch)."""
     _require_cv2()
     if isinstance(buf, (bytes, bytearray)):
         buf = np.frombuffer(buf, dtype=np.uint8)
@@ -57,7 +57,13 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
     if img.ndim == 2:
         img = img[:, :, None]
-    return nd.array(img, dtype="uint8")
+    return img
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC uint8 NDArray
+    (reference imdecode: python/mxnet/image/image.py:imdecode)."""
+    return nd.array(_imdecode_np(buf, flag, to_rgb), dtype="uint8")
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -492,6 +498,7 @@ class ImageIter(DataIter):
         self.shuffle = shuffle
         if num_parts > 1:
             self.seq = self.seq[part_index::num_parts]
+        self.preprocess_threads = int(kwargs.pop("preprocess_threads", 0))
         self.auglist = aug_list if aug_list is not None \
             else CreateAugmenter(data_shape, **kwargs)
         self.provide_data = [DataDesc(data_name,
@@ -512,6 +519,8 @@ class ImageIter(DataIter):
         if self.shuffle:
             pyrandom.shuffle(self.seq)
         self.cur = 0
+        if getattr(self, "_pending", None):
+            self._pending = []
 
     def next_sample(self):
         from . import recordio
@@ -527,16 +536,52 @@ class ImageIter(DataIter):
         with open(os.path.join(self.path_root or "", fname), "rb") as f:
             return label, f.read()
 
-    def _decoded_sample(self):
-        """Next (CHW float array, label row), from the rollover cache
-        first."""
-        if self._cache:
-            return self._cache.pop(0)
-        label, s = self.next_sample()
+    def _decode_one(self, label, s):
         img = imdecode(s)
         for aug in self.auglist:
             img = aug(img)
         return _as_np(img).transpose(2, 0, 1), label
+
+    def _decoded_sample(self):
+        """Next (CHW float array, label row), from the rollover cache
+        first. With preprocess_threads > 0 the JPEG decode (the
+        dominant cost; cv2 releases the GIL) runs on a thread pool a
+        batch ahead — the reference ImageRecordIter's threaded decode
+        loop (iter_image_recordio_2.cc:76,146). Augmenters stay on the
+        calling thread: several are jnp-backed and eager jax dispatch
+        is not safe to fan out across threads."""
+        if self._cache:
+            return self._cache.pop(0)
+        if self.preprocess_threads > 0:
+            if getattr(self, "_pool", None) is None:
+                import concurrent.futures as _cf
+                # our pool replaces OpenCV's internal one: concurrent
+                # cv2 calls from several threads deadlock its global
+                # worker pool otherwise (same reason the reference pins
+                # OMP threads around its decode loop)
+                try:
+                    cv2.setNumThreads(0)
+                except Exception:
+                    pass
+                self._pool = _cf.ThreadPoolExecutor(self.preprocess_threads)
+                self._pending = []
+            depth = max(self.batch_size, 2 * self.preprocess_threads)
+            try:
+                while len(self._pending) < depth:
+                    label, s = self.next_sample()
+                    self._pending.append(
+                        (label, self._pool.submit(_imdecode_np, s)))
+            except StopIteration:
+                pass
+            if not self._pending:
+                raise StopIteration
+            label, fut = self._pending.pop(0)
+            img = nd.array(fut.result(), dtype="uint8")
+            for aug in self.auglist:
+                img = aug(img)
+            return _as_np(img).transpose(2, 0, 1), label
+        label, s = self.next_sample()
+        return self._decode_one(label, s)
 
     def _label_batch_shape(self):
         """Trailing label dims of one batch row — (label_width,) here;
@@ -570,6 +615,10 @@ class ImageIter(DataIter):
                     self.cur = 0  # dataset smaller than the pad: keep cycling
                 rows.append(self._decoded_sample())
             self.cur = len(self.seq)  # next() must still end the epoch
+            if getattr(self, "_pending", None):
+                # drop samples the pad-fill prefetched past the epoch
+                # boundary: leftovers would keep next() serving forever
+                self._pending = []
             for i, (arr, label) in enumerate(rows):
                 batch_data[i] = arr
                 batch_label[i] = label

@@ -335,3 +335,34 @@ def test_pcc_metric_matches_mcc_binary():
     pcc.reset()
     pcc.update(l3, p3)
     assert abs(pcc.get()[1] - 1.0) < 1e-9
+
+
+def test_image_iter_preprocess_threads(tmp_path):
+    """Threaded decode (reference ImageRecordIter preprocess_threads):
+    same batches/epoch and full sample coverage as the serial path."""
+    import cv2
+    import numpy as np
+    imglist = []
+    for i in range(50):
+        img = (np.random.RandomState(i).rand(32, 32, 3) * 255) \
+            .astype(np.uint8)
+        cv2.imwrite(str(tmp_path / ("t%d.png" % i)), img)
+        imglist.append((float(i), "t%d.png" % i))
+    seen = {}
+    for threads in (0, 3):
+        it = mx.image.ImageIter(batch_size=16, data_shape=(3, 32, 32),
+                                imglist=list(imglist),
+                                path_root=str(tmp_path),
+                                preprocess_threads=threads)
+        for epoch in range(2):
+            if epoch:
+                it.reset()
+            labs = []
+            n = 0
+            for b in it:
+                n += 1
+                labs.extend(b.label[0].asnumpy().tolist())
+            assert n == 4                      # ceil(50/16) with pad
+            assert set(int(v) for v in labs) == set(range(50))
+        seen[threads] = sorted(labs)
+    assert seen[0] is not None and seen[3] is not None
