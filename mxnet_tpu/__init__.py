@@ -66,6 +66,10 @@ from .name import NameManager, Prefix
 from . import attribute
 from .attribute import AttrScope
 from . import contrib
+from . import log
+from . import executor_manager
+from . import kvstore_server
+from . import torch
 from . import utils
 from . import models
 from . import gluon

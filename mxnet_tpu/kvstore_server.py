@@ -1,0 +1,35 @@
+"""Parameter-server server-role entry (reference:
+python/mxnet/kvstore_server.py).
+
+There IS no server role in the TPU build: `dist_tpu_sync` replaces the
+ps-lite push/pull+server-ApplyUpdates protocol with a collective
+all-reduce in which every process is a worker (README divergence list;
+kvstore.py KVStoreTPUSync). These entry points keep scripts that probe
+DMLC_ROLE importable and explain the mapping instead of hanging."""
+
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer(object):
+    """Accepted for API parity; run() documents the divergence."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise RuntimeError(
+            "dist_tpu_sync has no server role: aggregation happens as an "
+            "XLA all-reduce across worker processes (launch them with "
+            "tools/launch.py; every rank calls kvstore.create("
+            "'dist_tpu_sync') and pushes/pulls synchronously)")
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        raise RuntimeError(
+            "DMLC_ROLE=%s requested, but the TPU build runs no ps-lite "
+            "roles — relaunch every process as a worker via "
+            "tools/launch.py (rendezvous replaces the scheduler)" % role)

@@ -1,0 +1,58 @@
+"""Colored logging helpers (reference: python/mxnet/log.py)."""
+
+import logging
+import sys
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+
+PY3 = True
+
+_COLORS = {WARNING: "\x1b[33m", INFO: "\x1b[32m", DEBUG: "\x1b[34m",
+           ERROR: "\x1b[31m", CRITICAL: "\x1b[35m"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-colored single-letter-prefix formatter (reference
+    log.py _Formatter): `W0730 12:00:00 message` with ANSI colors on
+    ttys."""
+
+    def __init__(self, colored=None):
+        self.colored = sys.stderr.isatty() if colored is None else colored
+        super(_Formatter, self).__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        label = record.levelname[0]
+        fmt = "%s%s%%(asctime)s %%(message)s%s" % (
+            _COLORS.get(record.levelno, "") if self.colored else "",
+            label, "\x1b[0m" if self.colored else "")
+        self._style._fmt = fmt
+        return super(_Formatter, self).format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger with the mxnet formatter attached once."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_init_done", False):
+        logger.setLevel(level)
+        return logger
+    logger._init_done = True
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler()
+        handler.setFormatter(_Formatter())
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated spelling kept for reference parity."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger instead")
+    return get_logger(name, filename, filemode, level)

@@ -1,0 +1,20 @@
+"""PyTorch tensor interop (reference: python/mxnet/torch.py).
+
+The reference bridged to Lua-torch kernels; that runtime is gone. The
+useful modern capability under the same module name is tensor exchange
+with PyTorch through DLPack — zero-copy on shared-memory backends."""
+
+from . import ndarray as nd
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def to_torch(array):
+    """NDArray -> torch.Tensor via the DLPack protocol."""
+    import torch as _torch
+    return _torch.from_dlpack(nd.to_dlpack_for_read(array))
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray via the DLPack protocol."""
+    return nd.from_dlpack(tensor)

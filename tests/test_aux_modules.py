@@ -156,3 +156,30 @@ def test_rtc_and_library_stubs():
         mx.rtc.CudaModule("__global__ void k(){}")
     with pytest.raises(mx.MXNetError):
         mx.library.load("/nonexistent/lib.so")
+
+
+def test_legacy_top_level_modules():
+    import numpy as np
+    # log
+    lg = mx.log.get_logger("aux_t", level=mx.log.INFO)
+    assert lg.level == mx.log.INFO
+    # executor_manager helpers
+    slices = mx.executor_manager.split_input_slice(8, [1, 1])
+    assert [s_.start for s_ in slices] == [0, 4]
+    import pytest as _pytest
+    x = mx.sym.Variable("x")
+    mx.executor_manager.check_arguments(x + 1)
+    # kvstore_server refuses ps roles with guidance
+    import os
+    os.environ["DMLC_ROLE"] = "server"
+    try:
+        with _pytest.raises(RuntimeError):
+            mx.kvstore_server._init_kvstore_server_module()
+    finally:
+        os.environ.pop("DMLC_ROLE")
+    # torch interop
+    import torch as _torch
+    t = mx.torch.to_torch(mx.nd.array(np.array([1., 2.])))
+    assert isinstance(t, _torch.Tensor)
+    back = mx.torch.from_torch(_torch.tensor([3., 4.]))
+    np.testing.assert_allclose(back.asnumpy(), [3., 4.])
