@@ -74,6 +74,8 @@ def main():
     logging.basicConfig(level=logging.INFO)
 
     import mxnet_tpu as mx
+    mx.random.seed(42)          # deterministic init -> reproducible runs
+    np.random.seed(42)          # ...and deterministic epoch shuffles
 
     wide, cats, dense, y = synthesize(args.num_samples, seed=0)
     vw, vc, vd, vy = synthesize(1024, seed=9)
