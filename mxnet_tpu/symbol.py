@@ -1049,3 +1049,38 @@ def _sym_debug_str(self):
 
 
 Symbol.debug_str = _sym_debug_str
+
+
+def _sym_infer_type_partial(self, *args, **kwargs):
+    """Like infer_type but tolerates unknowns (reference
+    infer_type_partial)."""
+    try:
+        return self.infer_type(*args, **kwargs)
+    except MXNetError:
+        n_args = len(self.list_arguments())
+        n_aux = len(self.list_auxiliary_states())
+        return ([None] * n_args, None, [None] * n_aux)
+
+
+Symbol.infer_type_partial = _sym_infer_type_partial
+
+
+def _sym_gradient(self, wrt):
+    """Reference Symbol.gradient is unimplemented in MXNet 1.x as well
+    (autodiff happens in bind/executor); keep the same contract."""
+    raise MXNetError(
+        "Symbol.gradient is not supported (same as the reference); "
+        "gradients come from Executor.backward / autograd")
+
+
+Symbol.gradient = _sym_gradient
+
+
+def _sym_get_backend_symbol(self, backend):
+    """Subgraph-backend partitioning (MKLDNN/TensorRT) has no analogue:
+    XLA compiles and fuses the whole graph. Returns self so pipelines
+    that call it unconditionally keep working."""
+    return self
+
+
+Symbol.get_backend_symbol = _sym_get_backend_symbol
