@@ -216,3 +216,10 @@ def test_dcgan_learns_distribution():
     line = [l for l in r.stdout.splitlines() if "center-energy" in l][-1]
     gen = float(line.rsplit("generated=", 1)[1])
     assert gen > 0.4
+
+
+def test_long_context_example_matches_dense():
+    r = _run([sys.executable, "examples/long_context.py",
+              "--seq-len", "1024", "--check"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "MATCHES dense attention" in r.stdout
