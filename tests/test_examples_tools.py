@@ -223,3 +223,15 @@ def test_long_context_example_matches_dense():
               "--seq-len", "1024", "--check"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "MATCHES dense attention" in r.stdout
+
+
+def test_transformer_lm_example_learns():
+    """The flagship SPMD transformer trains on the dp x tp x sp mesh."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run([sys.executable, "examples/transformer_lm.py",
+                        "--steps", "120"], capture_output=True,
+                       text=True, env=env, cwd=os.getcwd(), timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "LEARNED" in r.stdout
