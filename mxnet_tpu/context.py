@@ -138,3 +138,10 @@ def num_tpus():
 
 def current_context():
     return Context.default_ctx()
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes of the accelerator (reference
+    context.gpu_memory_info over cudaMemGetInfo)."""
+    from .util import get_gpu_memory
+    return get_gpu_memory(device_id)

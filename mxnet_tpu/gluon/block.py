@@ -312,6 +312,20 @@ class Block(object):
         out = self.forward(*args)
         for hook in self._forward_hooks:
             hook(self, args, out)
+        from ..util import is_np_array
+        if is_np_array():
+            # numpy-array semantics (util.set_np/use_np): emit
+            # mx.np.ndarray wrappers over the same buffers
+            from .. import numpy as _mxnp
+            from ..ndarray import NDArray as _ND
+
+            def _wrap(o):
+                if isinstance(o, _ND) and not isinstance(o, _mxnp.ndarray):
+                    return _mxnp.array(o._data)
+                if isinstance(o, (list, tuple)):
+                    return type(o)(_wrap(x) for x in o)
+                return o
+            out = _wrap(out)
         return out
 
     def forward(self, *args):

@@ -5,7 +5,9 @@ import inspect
 import threading
 
 __all__ = ["makedirs", "use_np_shape", "is_np_shape", "set_np_shape",
-           "np_shape", "wraps_safely"]
+           "np_shape", "wraps_safely", "set_np_array", "is_np_array",
+           "np_array", "set_np", "reset_np", "use_np", "use_np_array",
+           "get_gpu_count", "get_gpu_memory", "set_module"]
 
 _np_shape_flag = threading.local()
 
@@ -57,3 +59,114 @@ def wraps_safely(obj, attr_list=functools.WRAPPER_ASSIGNMENTS):
     """functools.wraps tolerant of missing attributes."""
     safe = [a for a in attr_list if hasattr(obj, a)]
     return functools.wraps(obj, assigned=safe)
+
+
+_np_array_flag = threading.local()
+
+
+def set_np_array(active):
+    """Enable/disable NumPy-array semantics: when on, Gluon blocks
+    return mx.np.ndarray outputs instead of classic NDArray (reference
+    util.py set_np_array; both types share the same jax buffers here,
+    so the switch only selects the wrapper)."""
+    prev = getattr(_np_array_flag, "value", False)
+    _np_array_flag.value = bool(active)
+    return prev
+
+
+def is_np_array():
+    return getattr(_np_array_flag, "value", False)
+
+
+def np_array(func=None, active=True):
+    """Decorator/context flipping array semantics (reference np_array)."""
+    class _Scope(object):
+        def __enter__(self):
+            self._prev = set_np_array(active)
+            return self
+
+        def __exit__(self, *exc):
+            set_np_array(self._prev)
+
+        def __call__(self, f):
+            @functools.wraps(f)
+            def wrapper(*args, **kwargs):
+                with _Scope():
+                    return f(*args, **kwargs)
+            return wrapper
+    scope = _Scope()
+    return scope(func) if func is not None else scope
+
+
+def set_np(shape=True, array=True):
+    """Turn on both NumPy semantics flags (reference set_np)."""
+    if not shape and array:
+        raise ValueError("NumPy array semantics require NumPy shape "
+                         "semantics")
+    set_np_shape(shape)
+    set_np_array(array)
+
+
+def reset_np():
+    """Back to classic semantics (reference reset_np)."""
+    set_np_shape(False)
+    set_np_array(False)
+
+
+def use_np_array(func):
+    """Class/function decorator applying np-array semantics (reference
+    use_np_array)."""
+    if inspect.isclass(func):
+        for name, m in inspect.getmembers(func, predicate=callable):
+            if name in ("forward", "hybrid_forward", "__call__"):
+                setattr(func, name, np_array(m))
+        return func
+    return np_array(func)
+
+
+def use_np(func):
+    """use_np_shape + use_np_array combined (reference use_np)."""
+    return use_np_array(use_np_shape(func) if not inspect.isclass(func)
+                        else func)
+
+
+def use_np_shape(func):
+    """Decorator form applying np shape semantics (zero-dim shapes are
+    always native here, so this only flips the compatibility flag)."""
+    if isinstance(func, bool):          # legacy use_np_shape(True) scope
+        return np_shape(func)
+    if inspect.isclass(func):
+        return func          # always-on natively
+    return np_shape(True)(func)
+
+
+def get_gpu_count():
+    """Accelerator count (reference util.get_gpu_count reads CUDA; here
+    the attached TPU/accelerator devices)."""
+    import jax
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+def get_gpu_memory(dev_id=0):
+    """(free, total) bytes of accelerator dev_id when the backend
+    exposes memory_stats; raises otherwise (parity with the reference's
+    CUDA-only behavior)."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if dev_id >= len(devs):
+        raise ValueError("Invalid device id %d" % dev_id)
+    stats = devs[dev_id].memory_stats()
+    if not stats:
+        raise RuntimeError("backend exposes no memory stats")
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total
+
+
+def set_module(module):
+    """Decorator overriding __module__ for doc purposes (reference)."""
+    def deco(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+    return deco

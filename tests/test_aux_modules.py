@@ -183,3 +183,27 @@ def test_legacy_top_level_modules():
     assert isinstance(t, _torch.Tensor)
     back = mx.torch.from_torch(_torch.tensor([3., 4.]))
     np.testing.assert_allclose(back.asnumpy(), [3., 4.])
+
+
+def test_np_semantics_flags_and_block_wrapping():
+    import numpy as np
+    net = mx.gluon.nn.Dense(3, prefix="nps_")
+    net.initialize()
+    x = mx.nd.array(np.ones((2, 4), np.float32))
+    assert type(net(x)).__name__ == "NDArray"
+    mx.util.set_np()
+    try:
+        out = net(x)
+        assert type(out).__name__ == "ndarray"      # mx.np array wrapper
+        assert mx.util.is_np_array()
+    finally:
+        mx.util.reset_np()
+    assert not mx.util.is_np_array()
+
+    @mx.util.use_np
+    def f(a):
+        assert mx.util.is_np_array()
+        return a
+    f(0)
+    assert not mx.util.is_np_array()
+    assert mx.util.get_gpu_count() == 0             # cpu test mesh
