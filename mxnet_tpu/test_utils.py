@@ -309,3 +309,237 @@ def retry(n):
                         raise
         return wrapper
     return deco
+
+
+# ----------------------------------------------- reference helper set --
+# (python/mxnet/test_utils.py) — the comparison/creation helpers the
+# reference test-suite style leans on; download-based helpers are out of
+# scope (zero-egress build).
+
+def default_dtype():
+    return np.float32
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+def get_etol(etol=None):
+    return 0 if etol is None else etol
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Elementwise closeness with NaNs masked out of BOTH arrays."""
+    a = np.copy(np.asarray(a))
+    b = np.copy(np.asarray(b))
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return np.allclose(a, b, rtol=get_rtol(rtol), atol=get_atol(atol))
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None, names=("a", "b")):
+    if not almost_equal_ignore_nan(a, b, rtol, atol):
+        raise AssertionError("%s and %s differ beyond tolerance "
+                             "(NaNs ignored)" % names)
+
+
+def assert_almost_equal_with_err(a, b, rtol=None, atol=None, etol=None,
+                                 names=("a", "b")):
+    """Allow a fraction etol of elements to violate the tolerance."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    etol = get_etol(etol)
+    bad = ~np.isclose(a, b, rtol=get_rtol(rtol), atol=get_atol(atol))
+    frac = bad.mean() if bad.size else 0.0
+    if frac > etol:
+        raise AssertionError(
+            "%s and %s: %.4f%% elements out of tolerance (etol %.4f%%)"
+            % (names[0], names[1], frac * 100, etol * 100))
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """(index, relative-error) of the worst disagreement."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    diff = np.abs(a - b) - get_atol(atol) - get_rtol(rtol) * np.abs(b)
+    idx = np.unravel_index(np.argmax(diff), a.shape) if a.size else ()
+    rel = np.abs(a - b) / (np.abs(b) + get_atol(atol))
+    return idx, float(rel[idx]) if a.size else 0.0
+
+
+def compare_ndarray_tuple(t1, t2, rtol=None, atol=None):
+    """Recursive closeness of (possibly nested) NDArray tuples."""
+    if t1 is None or t2 is None:
+        return
+    if isinstance(t1, tuple):
+        for a, b in zip(t1, t2):
+            compare_ndarray_tuple(a, b, rtol, atol)
+    else:
+        assert_almost_equal(t1.asnumpy(), t2.asnumpy(), rtol=rtol or 1e-5,
+                            atol=atol or 1e-8)
+
+
+def compare_optimizer(opt1, opt2, shape, dtype="float32", w_stype="default",
+                      g_stype="default", rtol=1e-4, atol=1e-5,
+                      ntests=2):
+    """Run both optimizers from identical state and require identical
+    trajectories (reference compare_optimizer)."""
+    rs = np.random.RandomState(0)
+    w_np = rs.rand(*shape).astype(dtype)
+    for i in range(ntests):
+        g_np = rs.rand(*shape).astype(dtype) * 0.1
+        w1 = nd.array(w_np.copy())
+        w2 = nd.array(w_np.copy())
+        g1 = nd.array(g_np)
+        g2 = nd.array(g_np)
+        s1 = opt1.create_state(0, w1)
+        s2 = opt2.create_state(0, w2)
+        opt1.update(0, w1, g1, s1)
+        opt2.update(0, w2, g2, s2)
+        compare_ndarray_tuple(s1 if isinstance(s1, tuple) else (s1,),
+                              s2 if isinstance(s2, tuple) else (s2,),
+                              rtol, atol)
+        assert_almost_equal(w1.asnumpy(), w2.asnumpy(), rtol=rtol,
+                            atol=atol)
+        w_np = w1.asnumpy()
+
+
+def create_vector(size, dtype=np.int64):
+    """arange vector (reference create_vector for large-tensor tests)."""
+    return nd.arange(0, size, dtype=dtype)
+
+
+def create_2d_tensor(rows, columns, dtype=np.int64):
+    a = np.arange(0, rows).reshape(rows, 1)
+    return nd.array(np.broadcast_to(a, (rows, columns)), dtype=dtype)
+
+
+def assign_each(input_, function):
+    """Elementwise python-function application (reference assign_each)."""
+    return np.vectorize(function)(np.asarray(input_))
+
+
+def assign_each2(input1, input2, function):
+    return np.vectorize(function)(np.asarray(input1), np.asarray(input2))
+
+
+def collapse_sum_like(a, shape):
+    """Sum `a` down to `shape` (inverse of broadcasting; reference
+    collapse_sum_like)."""
+    a = np.asarray(a)
+    extra = a.ndim - len(shape)
+    if extra:
+        a = a.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, s in enumerate(shape) if s == 1 and
+                 a.shape[i] != 1)
+    if axes:
+        a = a.sum(axis=axes, keepdims=True)
+    return a
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square goodness-of-fit of a sampler against expected bucket
+    probabilities; returns (statistic, p-value) (reference
+    chi_square_check)."""
+    from scipy import stats as sstats
+    if isinstance(buckets[0], (list, tuple)):
+        continuous = True
+    else:
+        continuous = False
+    samples = np.asarray(generator(nsamples)).reshape(-1)
+    counts = np.zeros(len(buckets))
+    for i, b in enumerate(buckets):
+        if continuous:
+            lo, hi = b
+            counts[i] = ((samples >= lo) & (samples < hi)).sum()
+        else:
+            counts[i] = (samples == b).sum()
+    expected = np.asarray(probs, np.float64) * len(samples)
+    stat, p = sstats.chisquare(counts, expected)
+    return stat, p
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a distribution's ppf (reference
+    gen_buckets_probs_with_ppf)."""
+    qs = np.linspace(0, 1, nbuckets + 1)
+    edges = [ppf(q) for q in qs]
+    buckets = [(edges[i], edges[i + 1]) for i in range(nbuckets)]
+    probs = [1.0 / nbuckets] * nbuckets
+    return buckets, probs
+
+
+def create_sparse_array(shape, stype, density=0.5, dtype=None,
+                        rsp_indices=None, data_init=None,
+                        modifier_func=None, shuffle_csr_indices=False):
+    """Random sparse NDArray (reference create_sparse_array, dense-backed
+    here)."""
+    from . import sparse as _sp
+    out = _sp.rand_sparse_ndarray(shape, stype, density=density,
+                                  dtype=dtype)
+    return out[0] if isinstance(out, tuple) else out
+
+
+def create_sparse_array_zd(shape, stype, density, **kwargs):
+    """Sparse array allowing zero density (reference _zd variant)."""
+    if density == 0:
+        from . import sparse as _sp
+        return _sp.zeros(stype, shape)
+    return create_sparse_array(shape, stype, density=density, **kwargs)
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Time forward(+backward) of a symbol (reference check_speed)."""
+    import time
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape(**kwargs)
+        rs = np.random.RandomState(0)
+        location = {n: rs.rand(*s).astype(np.float32)
+                    for n, s in zip(sym.list_arguments(), arg_shapes)}
+    ex = sym.simple_bind(ctx, grad_req=grad_req,
+                         **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = v
+    # warmup
+    ex.forward(is_train=(typ == "whole"))
+    if typ == "whole":
+        ex.backward([nd.ones(o.shape) for o in ex.outputs])
+    for o in ex.outputs:
+        o.wait_to_read()
+    t0 = time.time()
+    for _ in range(N):
+        ex.forward(is_train=(typ == "whole"))
+        if typ == "whole":
+            ex.backward([nd.ones(o.shape) for o in ex.outputs])
+    for o in ex.outputs:
+        o.wait_to_read()
+    return (time.time() - t0) / N
+
+
+def discard_stderr():
+    """Context manager silencing C-level stderr (reference
+    discard_stderr)."""
+    import contextlib
+    import os as _os
+
+    @contextlib.contextmanager
+    def _cm():
+        fd = _os.dup(2)
+        devnull = _os.open(_os.devnull, _os.O_WRONLY)
+        _os.dup2(devnull, 2)
+        try:
+            yield
+        finally:
+            _os.dup2(fd, 2)
+            _os.close(devnull)
+            _os.close(fd)
+    return _cm()
