@@ -52,7 +52,14 @@ def main():
     from jax.sharding import Mesh
     from mxnet_tpu.models import transformer as T
 
-    devs = np.array(jax.devices()[:args.dp * args.tp * args.sp])
+    need = args.dp * args.tp * args.sp
+    if len(jax.devices()) < need:
+        raise SystemExit(
+            "mesh dp=%d x tp=%d x sp=%d needs %d devices, found %d — "
+            "lower --dp/--tp/--sp, or run on CPU with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=%d"
+            % (args.dp, args.tp, args.sp, need, len(jax.devices()), need))
+    devs = np.array(jax.devices()[:need])
     mesh = Mesh(devs.reshape(args.dp, args.tp, args.sp),
                 ("dp", "tp", "sp"))
     cfg = T.TransformerConfig(vocab_size=32, d_model=64, n_heads=4,

@@ -23,6 +23,9 @@ def split_input_slice(batch_size, work_load_list):
     for i, load in enumerate(work_load_list):
         end = batch_size if i == len(work_load_list) - 1 else \
             min(batch_size, start + int(round(batch_size * load / total)))
+        if end <= start:
+            raise MXNetError(
+                "Too many slices. Some splits are empty.")
         slices.append(slice(start, end))
         start = end
     return slices

@@ -855,16 +855,23 @@ class ImageDetIter(ImageIter):
                  shuffle=False, aug_list=None, imglist=None,
                  data_name="data", label_name="label",
                  last_batch_handle="pad", **kwargs):
+        # split kwargs: iterator options go to ImageIter, the rest are
+        # detection-augmenter parameters
+        parent_keys = ("part_index", "num_parts", "preprocess_threads")
+        parent_kw = {k: kwargs.pop(k) for k in parent_keys if k in kwargs}
         super(ImageDetIter, self).__init__(
             batch_size=batch_size, data_shape=data_shape,
             path_imgrec=path_imgrec, path_imglist=path_imglist,
             path_root=path_root, path_imgidx=path_imgidx,
             shuffle=shuffle, aug_list=[] if aug_list is None else aug_list,
             imglist=imglist, data_name=data_name, label_name=label_name,
-            last_batch_handle=last_batch_handle,
-            **{k: v for k, v in kwargs.items() if k in ()})
+            last_batch_handle=last_batch_handle, **parent_kw)
         if aug_list is None:
             self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        elif kwargs:
+            raise TypeError(
+                "unexpected keyword arguments with an explicit aug_list: "
+                "%s" % sorted(kwargs))
         # scan labels once for (max_objects, object_width)
         max_obj, owidth = 1, 5
         for idx in self.seq:

@@ -639,15 +639,22 @@ class PCC(EvalMetric):
     vectors. The confusion matrix grows lazily as new class ids appear."""
 
     def __init__(self, name="pcc", output_names=None, label_names=None):
-        self._conf = _np.zeros((1, 1), dtype=_np.float64)
+        self._conf = _np.zeros((1, 1), dtype=_np.float64)    # local
+        self._gconf = _np.zeros((1, 1), dtype=_np.float64)   # epoch-global
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
+    @staticmethod
+    def _grown(conf, k):
+        if k <= conf.shape[0]:
+            return conf
+        c = _np.zeros((k, k), _np.float64)
+        c[:conf.shape[0], :conf.shape[0]] = conf
+        return c
+
     def _grow(self, k):
-        if k > self._conf.shape[0]:
-            c = _np.zeros((k, k), _np.float64)
-            c[:self._conf.shape[0], :self._conf.shape[0]] = self._conf
-            self._conf = c
+        self._conf = self._grown(self._conf, k)
+        self._gconf = self._grown(self._gconf, k)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, wrap=True)
@@ -662,12 +669,12 @@ class PCC(EvalMetric):
             n = min(len(lab), len(p))
             self._grow(int(max(lab.max(initial=0), p.max(initial=0))) + 1)
             _np.add.at(self._conf, (lab[:n], p[:n]), 1.0)
+            _np.add.at(self._gconf, (lab[:n], p[:n]), 1.0)
             self.num_inst += n
             self.global_num_inst += n
 
-    @property
-    def sum_metric(self):
-        c = self._conf
+    @staticmethod
+    def _pcc_of(c):
         n = c.sum()
         if n == 0:
             return 0.0
@@ -678,13 +685,19 @@ class PCC(EvalMetric):
         d2 = n * n - pr @ pr
         if d1 <= 0 or d2 <= 0:
             return 0.0
-        return float(cov / math.sqrt(d1 * d2)) * self.num_inst
+        return float(cov / math.sqrt(d1 * d2))
+
+    @property
+    def sum_metric(self):
+        return self._pcc_of(self._conf) * self.num_inst
 
     @sum_metric.setter
     def sum_metric(self, v):
         pass            # derived from the confusion matrix
 
-    global_sum_metric = sum_metric
+    @property
+    def global_sum_metric(self):
+        return self._pcc_of(self._gconf) * self.global_num_inst
 
     @global_sum_metric.setter
     def global_sum_metric(self, v):
@@ -692,7 +705,12 @@ class PCC(EvalMetric):
 
     def reset(self):
         self._conf = _np.zeros((1, 1), _np.float64)
+        self._gconf = _np.zeros((1, 1), _np.float64)
         self.num_inst = 0
         self.global_num_inst = 0
 
-    reset_local = reset
+    def reset_local(self):
+        """Clears only the per-interval stats (Speedometer auto_reset);
+        the epoch-global confusion matrix survives."""
+        self._conf = _np.zeros((1, 1), _np.float64)
+        self.num_inst = 0

@@ -1056,10 +1056,11 @@ def _sym_infer_type_partial(self, *args, **kwargs):
     infer_type_partial)."""
     try:
         return self.infer_type(*args, **kwargs)
-    except MXNetError:
+    except Exception:
         n_args = len(self.list_arguments())
         n_aux = len(self.list_auxiliary_states())
-        return ([None] * n_args, None, [None] * n_aux)
+        return ([None] * n_args, [None] * len(self._outputs),
+                [None] * n_aux)
 
 
 Symbol.infer_type_partial = _sym_infer_type_partial

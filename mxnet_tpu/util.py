@@ -52,8 +52,6 @@ class np_shape(object):
         return wrapper
 
 
-use_np_shape = np_shape
-
 
 def wraps_safely(obj, attr_list=functools.WRAPPER_ASSIGNMENTS):
     """functools.wraps tolerant of missing attributes."""

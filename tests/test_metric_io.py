@@ -366,3 +366,43 @@ def test_image_iter_preprocess_threads(tmp_path):
             assert set(int(v) for v in labs) == set(range(50))
         seen[threads] = sorted(labs)
     assert seen[0] is not None and seen[3] is not None
+
+
+def test_pcc_survives_reset_local():
+    """Speedometer's auto_reset calls reset_local between log intervals;
+    the epoch-global PCC must keep accumulating."""
+    import numpy as np
+    pcc = mx.metric.PCC()
+    p1 = [mx.nd.array(np.eye(2, dtype=np.float32)[np.array([0, 1, 0])])]
+    l1 = [mx.nd.array(np.array([0, 1, 1], np.float32))]
+    pcc.update(l1, p1)
+    pcc.reset_local()
+    p2 = [mx.nd.array(np.eye(2, dtype=np.float32)[np.array([1, 0])])]
+    l2 = [mx.nd.array(np.array([1, 0], np.float32))]
+    pcc.update(l2, p2)
+    name, local = pcc.get()
+    gname, global_ = pcc.get_global()
+    assert local == 1.0                 # only the post-reset interval
+    assert 0 < global_ < 1.0            # all 5 samples incl. the miss
+
+
+def test_image_det_iter_parent_kwargs(tmp_path):
+    import cv2
+    import numpy as np
+    imglist = []
+    for i in range(6):
+        cv2.imwrite(str(tmp_path / ("d%d.png" % i)),
+                    (np.random.RandomState(i).rand(16, 16, 3) * 255)
+                    .astype(np.uint8))
+        imglist.append(([2, 5, 0, 0.1, 0.1, 0.6, 0.6], "d%d.png" % i))
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                               imglist=imglist, path_root=str(tmp_path),
+                               preprocess_threads=2, num_parts=2,
+                               part_index=0)
+    total = sum(b.data[0].shape[0] for b in it)
+    assert total <= 4                    # half the dataset (+pad)
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                              imglist=imglist, path_root=str(tmp_path),
+                              aug_list=[], rand_mirror=True)
