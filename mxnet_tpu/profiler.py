@@ -205,3 +205,18 @@ class Marker(object):
 
     def mark(self, scope="process"):
         _record(self.name, "marker", scope)
+
+
+def dump_profile():
+    """Deprecated reference alias of dump()."""
+    import warnings
+    warnings.warn("profiler.dump_profile() is deprecated; use dump()",
+                  DeprecationWarning)
+    return dump()
+
+
+def set_kvstore_handle(handle):
+    """Server-side profiling wiring (reference sends profiler commands
+    over the kvstore channel to ps-lite servers). dist_tpu_sync has no
+    server role, so there is nothing to forward; accepted as a no-op
+    for source compatibility."""
