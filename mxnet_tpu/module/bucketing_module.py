@@ -144,6 +144,12 @@ class BucketingModule(BaseModule):
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
+        if getattr(self, "_load_prefix", None):
+            # restore a load()-requested checkpoint now that arrays exist
+            self._curr_module.load_params(
+                "%s-%04d.params" % (self._load_prefix, self._load_epoch))
+            self.params_initialized = True
+            self._load_prefix = None
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """bucketing_module.py:376."""
@@ -264,3 +270,40 @@ class BucketingModule(BaseModule):
         self._monitor = mon
         for mod in self._buckets.values():
             mod.install_monitor(mon)
+
+    # ------------------------------------------------- checkpointing --
+    def save_checkpoint(self, prefix, epoch, remove_amp_cast=False):
+        """Save params + per-bucket symbols + the bucket list (reference
+        bucketing_module.py save_checkpoint layout)."""
+        assert self._buckets, "Empty BucketingModule cannot be saved"
+        from .. import ndarray as nd
+        import numpy as np
+        self.save_params("%s-%04d.params" % (prefix, epoch))
+        for bucket_key in self._buckets:
+            symbol, _, _ = self._sym_gen(bucket_key)
+            symbol.save("%s-%s-symbol.json" % (prefix, bucket_key))
+        nd.save("%s.buckets" % prefix,
+                nd.array(np.asarray(list(self._buckets), dtype=np.int32),
+                         dtype="int32"))
+
+    @staticmethod
+    def load(prefix, epoch, sym_gen=None, default_bucket_key=None,
+             **kwargs):
+        """Recreate a BucketingModule from save_checkpoint files; the
+        original sym_gen must be supplied (symbols on disk are for
+        inspection/inference tooling)."""
+        assert sym_gen is not None, \
+            "sym_gen is required to load a BucketingModule"
+        assert default_bucket_key is not None
+        mod = BucketingModule(sym_gen, default_bucket_key=default_bucket_key,
+                              **kwargs)
+        mod._load_prefix = prefix
+        mod._load_epoch = epoch
+        return mod
+
+    def load_dict(self, sym_dict=None, sym_gen=None, default_bucket_key=None,
+                  arg_params=None, aux_params=None, **kwargs):
+        """Set parameters from dicts after bind (reference load_dict)."""
+        if arg_params is not None or aux_params is not None:
+            self.set_params(arg_params or {}, aux_params or {},
+                            allow_missing=True, allow_extra=True)

@@ -2,6 +2,8 @@
 end-to-end fit asserting accuracy threshold) and unittest/test_module.py.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -200,3 +202,31 @@ def test_python_loss_module_custom_grad_func():
     m.backward()
     assert calls
     np.testing.assert_allclose(m.get_input_grads()[0].asnumpy(), 0.5)
+
+
+def test_bucketing_module_checkpoint_roundtrip(tmp_path):
+    import numpy as np
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="bmod_fc")
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "bm")
+    mod.save_checkpoint(prefix, 3)
+    assert os.path.exists(prefix + "-0003.params")
+    assert os.path.exists(prefix + "-8-symbol.json")
+    assert os.path.exists(prefix + ".buckets")
+
+    mod2 = mx.mod.BucketingModule.load(prefix, 3, sym_gen=sym_gen,
+                                       default_bucket_key=8)
+    mod2.bind(data_shapes=[("data", (2, 8))],
+              label_shapes=[("softmax_label", (2,))])
+    a1 = mod.get_params()[0]["bmod_fc_weight"].asnumpy()
+    a2 = mod2.get_params()[0]["bmod_fc_weight"].asnumpy()
+    np.testing.assert_allclose(a1, a2)
