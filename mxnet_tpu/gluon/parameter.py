@@ -250,6 +250,21 @@ class Parameter(object):
         self._check_initialized()
         return [self._data]
 
+    def row_sparse_data(self, row_id):
+        """Rows `row_id` of a row_sparse parameter (reference
+        parameter.py row_sparse_data; dense-backed here, so this is a
+        gather of the requested rows)."""
+        if self._stype != "row_sparse":
+            raise RuntimeError(
+                "Cannot return a copy of Parameter %s via row_sparse_data()"
+                " because its storage type is %s" % (self.name, self._stype))
+        self._check_initialized()
+        from .. import ndarray as nd
+        return nd.take(self._data, row_id)
+
+    def list_row_sparse_data(self, row_id):
+        return [self.row_sparse_data(row_id)]
+
     def grad(self, ctx=None):
         if self._data is not None and self._grad is None:
             raise RuntimeError(
