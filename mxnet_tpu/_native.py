@@ -134,3 +134,51 @@ def recordio_read(path, offsets, lengths, num_threads=4):
         out.append(bytes(view[pos:pos + int(n)]))
         pos += int(n)
     return out
+
+
+_libsvm_lib = None
+_libsvm_tried = False
+
+
+def libsvm_lib():
+    """The compiled LibSVM parser, or None when unavailable."""
+    global _libsvm_lib, _libsvm_tried
+    with _lock:
+        if _libsvm_tried:
+            return _libsvm_lib
+        _libsvm_tried = True
+        src = os.path.join(_SRC_DIR, "io", "libsvm_scan.cc")
+        try:
+            lib = ctypes.CDLL(_build(src, "libsvm_scan"))
+        except Exception:
+            return None
+        lib.libsvm_count_rows.restype = ctypes.c_int64
+        lib.libsvm_count_rows.argtypes = [ctypes.c_char_p]
+        lib.libsvm_parse_dense.restype = ctypes.c_int64
+        lib.libsvm_parse_dense.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+        _libsvm_lib = lib
+        return lib
+
+
+def libsvm_parse(path, dim):
+    """Parse a LibSVM file into (data[rows, dim] float32, labels[rows]).
+    Returns None when the native parser is unavailable or rejects the
+    file (caller falls back to the Python parser)."""
+    lib = libsvm_lib()
+    if lib is None:
+        return None
+    rows = lib.libsvm_count_rows(path.encode())
+    if rows < 0:
+        return None
+    data = np.zeros((rows, dim), np.float32)
+    labels = np.zeros((rows,), np.float32)
+    got = lib.libsvm_parse_dense(
+        path.encode(), dim,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows)
+    if got < 0:
+        return None
+    return data[:got], labels[:got]

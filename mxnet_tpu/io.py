@@ -453,6 +453,12 @@ class LibSVMIter(NDArrayIter):
 
     @staticmethod
     def _parse(path, dim):
+        # native C++ parser first (src/io/libsvm_scan.cc — the
+        # reference's iter_libsvm.cc role); Python loop as fallback
+        from . import _native
+        parsed = _native.libsvm_parse(path, dim)
+        if parsed is not None:
+            return parsed
         rows, labels = [], []
         with open(path) as f:
             for line in f:

@@ -1,0 +1,93 @@
+// Native LibSVM parser (parity target: src/io/iter_libsvm.cc — the
+// reference parses LibSVM text in C++; the Python loop in io.py is the
+// fallback). Parses "label idx:val idx:val ..." lines straight into a
+// caller-provided dense row-major buffer plus a label vector.
+//
+// Exposed C ABI (ctypes):
+//   int64_t libsvm_count_rows(const char* path);
+//   int64_t libsvm_parse_dense(const char* path, int64_t dim,
+//                              float* data,   /* rows*dim, zeroed here */
+//                              float* labels, /* rows */
+//                              int64_t max_rows);
+//     returns rows parsed, or -1 on IO error, -2 on a malformed line,
+//     -3 when a feature index falls outside [0, dim).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Read the whole file; newline-split parsing beats getline for the
+// many-small-lines shape of LibSVM files.
+bool read_all(const char* path, std::vector<char>* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(n) + 1);
+  size_t got = n ? std::fread(out->data(), 1, static_cast<size_t>(n), f) : 0;
+  std::fclose(f);
+  if (static_cast<long>(got) != n) return false;
+  (*out)[got] = '\0';
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t libsvm_count_rows(const char* path) {
+  std::vector<char> buf;
+  if (!read_all(path, &buf)) return -1;
+  int64_t rows = 0;
+  bool content = false;
+  for (char c : buf) {
+    if (c == '\n') {
+      if (content) ++rows;
+      content = false;
+    } else if (c != '\0' && c != '\r' && c != ' ' && c != '\t') {
+      content = true;
+    }
+  }
+  if (content) ++rows;
+  return rows;
+}
+
+int64_t libsvm_parse_dense(const char* path, int64_t dim, float* data,
+                           float* labels, int64_t max_rows) {
+  std::vector<char> buf;
+  if (!read_all(path, &buf)) return -1;
+  char* p = buf.data();
+  int64_t row = 0;
+  while (*p && row < max_rows) {
+    // skip blank lines
+    while (*p == '\r' || *p == '\n') ++p;
+    if (!*p) break;
+    char* end;
+    float label = std::strtof(p, &end);
+    if (end == p) return -2;
+    p = end;
+    labels[row] = label;
+    float* drow = data + row * dim;
+    while (*p && *p != '\n') {
+      while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+      if (!*p || *p == '\n') break;
+      long idx = std::strtol(p, &end, 10);
+      if (end == p || *end != ':') return -2;
+      if (idx < 0 || idx >= dim) return -3;
+      p = end + 1;
+      float v = std::strtof(p, &end);
+      if (end == p) return -2;
+      p = end;
+      drow[idx] = v;
+    }
+    ++row;
+  }
+  return row;
+}
+
+}  // extern "C"
