@@ -170,3 +170,27 @@ def test_embedding_and_ctc_match_torch():
     ref = F.ctc_loss(logp, tgt, torch.tensor([T, T]), lens,
                      blank=0, reduction="none")
     np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_gru_fused_matches_torch():
+    """GRU gate math: both stacks use r,z,n ordering with the reset gate
+    applied to the h2h candidate INSIDE tanh."""
+    rs = np.random.RandomState(9)
+    T, N, I, H = 6, 2, 3, 5
+    x = rs.randn(T, N, I).astype(np.float32)
+    tg = torch.nn.GRU(I, H, num_layers=1, bias=True)
+    with torch.no_grad():
+        for p in tg.parameters():
+            p.copy_(torch.from_numpy(
+                rs.randn(*p.shape).astype(np.float32) * 0.3))
+    ref, _ = tg(_t(x))
+    packed = np.concatenate([
+        tg.weight_ih_l0.detach().numpy().reshape(-1),
+        tg.weight_hh_l0.detach().numpy().reshape(-1),
+        tg.bias_ih_l0.detach().numpy(),
+        tg.bias_hh_l0.detach().numpy()])
+    outs = nd.RNN(nd.array(x), nd.array(packed), state_size=H,
+                  num_layers=1, mode="gru", state_outputs=True)
+    out = outs[0] if isinstance(outs, (list, tuple)) else outs
+    np.testing.assert_allclose(out.asnumpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
