@@ -209,3 +209,66 @@ def test_pdf_op_gradients():
     _check(out, {"s": _rand(2, 5, lo=-1, hi=1),
                  "mu": np.array([0.1, -0.2], np.float32),
                  "sig": np.array([1.1, 0.9], np.float32)})
+
+
+VISION_GRAD_CASES = [
+    ("BilinearSampler",
+     lambda: sym.BilinearSampler(X, sym.Variable("grid")),
+     {"x": (1, 2, 5, 5), "grid": (1, 2, 4, 4)}),
+    ("SpatialTransformer",
+     lambda: sym.SpatialTransformer(
+         X, sym.Variable("loc"), target_shape=(4, 4),
+         transform_type="affine", sampler_type="bilinear"),
+     {"x": (1, 2, 5, 5), "loc": (1, 6)}),
+    ("ROIAlign",
+     lambda: sym.contrib.ROIAlign(X, sym.Variable("rois"),
+                                  pooled_size=(2, 2), spatial_scale=1.0),
+     {"x": (1, 2, 6, 6)}),
+    ("GridGenerator",
+     lambda: sym.BilinearSampler(X, sym.GridGenerator(
+         sym.Variable("loc"), transform_type="affine",
+         target_shape=(4, 4))),
+     {"x": (1, 2, 5, 5), "loc": (1, 6)}),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes", VISION_GRAD_CASES,
+                         ids=[c[0] for c in VISION_GRAD_CASES])
+def test_vision_gradients(name, build, shapes):
+    loc = {}
+    rs = np.random.RandomState(11)
+    for k, s in shapes.items():
+        if k == "grid":
+            loc[k] = (rs.rand(*s) * 1.2 - 0.6).astype(np.float32)
+        elif k == "loc":
+            base = np.array([1.0, 0.0, 0.05, 0.0, 1.0, 0.05], np.float32)
+            loc[k] = np.tile(base, (s[0], 1)) + \
+                rs.rand(*s).astype(np.float32) * 0.05
+        else:
+            loc[k] = rs.rand(*s).astype(np.float32)
+    grad_nodes = [k for k in shapes if k != "rois"]
+    if name == "ROIAlign":
+        loc["rois"] = np.array([[0, 0.5, 0.5, 4.0, 4.0]], np.float32)
+    _check(build(), loc, grad_nodes=grad_nodes, atol=5e-3)
+
+
+def test_loss_head_gradients_scale():
+    """SoftmaxOutput's backward is (p - onehot) * grad_scale regardless
+    of head gradient (reference MakeLoss semantics)."""
+    data = sym.Variable("x")
+    label = sym.Variable("softmax_label")
+    out = sym.SoftmaxOutput(data, label, grad_scale=2.0, name="so")
+    rs = np.random.RandomState(0)
+    xv = rs.randn(3, 4).astype(np.float32)
+    lv = np.array([0, 2, 3], np.float32)
+    ex = out.bind(mx.cpu(), {"x": mx.nd.array(xv),
+                             "softmax_label": mx.nd.array(lv)},
+                  args_grad={"x": mx.nd.zeros((3, 4))})
+    ex.forward(is_train=True)
+    ex.backward()
+    p = np.exp(xv - xv.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    onehot = np.eye(4, dtype=np.float32)[lv.astype(int)]
+    expect = (p - onehot) * 2.0 / 1.0
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), expect,
+                               rtol=1e-4, atol=1e-5)
