@@ -479,3 +479,33 @@ def test_np_internal_ops():
 def test_batchnorm_v1_alias_and_custom_exposed():
     assert "BatchNorm_v1" in mx.ops._ALIAS or "BatchNorm_v1" in mx.ops._REGISTRY
     assert callable(nd.Custom)
+
+
+def test_samplers_pass_chi_square():
+    """Distribution-level checks (reference test_random.py pattern):
+    each sampler's draws fit its distribution's equal-probability
+    buckets by a chi-square test."""
+    from scipy import stats
+    from mxnet_tpu import test_utils as tu
+    mx.random.seed(1234)
+    cases = [
+        ("uniform", lambda n: nd.random_uniform(
+            low=0, high=1, shape=(n,)).asnumpy(),
+         stats.uniform(0, 1).ppf),
+        ("normal", lambda n: nd.random_normal(
+            loc=0, scale=1, shape=(n,)).asnumpy(),
+         stats.norm(0, 1).ppf),
+        ("gamma", lambda n: nd.random_gamma(
+            alpha=3.0, beta=2.0, shape=(n,)).asnumpy(),
+         stats.gamma(3.0, scale=2.0).ppf),
+        ("exponential", lambda n: nd.random_exponential(
+            lam=1.5, shape=(n,)).asnumpy(),
+         stats.expon(scale=1 / 1.5).ppf),
+    ]
+    for name, gen, ppf in cases:
+        buckets, probs = tu.gen_buckets_probs_with_ppf(ppf, 10)
+        # clip infinite edges
+        buckets = [(max(lo, -1e9), min(hi, 1e9)) for lo, hi in buckets]
+        stat, p = tu.chi_square_check(gen, buckets, probs,
+                                      nsamples=50000)
+        assert p > 1e-4, "%s sampler failed chi-square (p=%g)" % (name, p)
