@@ -112,7 +112,13 @@ class Optimizer(object):
             self.update(index, weight_master_copy, grad32, original_state)
             weight._data = weight_master_copy._data.astype(jnp.bfloat16)
         else:
+            # keep the weight's storage dtype: fp32 state/lr arithmetic
+            # promotes bf16 weights to fp32 inside update(), and writing
+            # that back would silently un-cast a low-precision network
+            wdtype = weight.dtype
             self.update(index, weight, grad, state)
+            if weight.dtype != wdtype:
+                weight._data = weight._data.astype(wdtype)
 
     # -------------------------------------------------------- lr/wd mult --
     @property

@@ -207,3 +207,36 @@ def test_np_semantics_flags_and_block_wrapping():
     f(0)
     assert not mx.util.is_np_array()
     assert mx.util.get_gpu_count() == 0             # cpu test mesh
+
+
+def test_bf16_training_converges():
+    """train/test_dtype.py parity: a small net trained in low precision
+    (bf16 compute via the fp16 alias) with an fp32 loss reaches the
+    same quality bar as fp32."""
+    import numpy as np
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = (X[:, :4].sum(1) > X[:, 4:].sum(1)).astype(np.float32)
+
+    net = mx.gluon.nn.HybridSequential(prefix="bf16_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"))
+        net.add(mx.gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net.cast("float16")                     # bf16 on this stack
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    Xh = mx.nd.array(X, dtype="float16")
+    yh = mx.nd.array(y)
+    for epoch in range(30):
+        with mx.autograd.record():
+            out = net(Xh)
+            loss = loss_fn(out.astype("float32"), yh)
+        loss.backward()
+        trainer.step(X.shape[0])
+    pred = net(Xh).asnumpy().argmax(1)
+    acc = float((pred == y).mean())
+    assert acc > 0.9, acc
+    assert net(Xh).dtype == np.dtype("bfloat16")
