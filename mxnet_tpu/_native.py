@@ -159,26 +159,36 @@ def libsvm_lib():
             ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64]
+        lib.libsvm_parse_file.restype = ctypes.c_int64
+        lib.libsvm_parse_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+        lib.libsvm_free.restype = None
+        lib.libsvm_free.argtypes = [ctypes.c_void_p]
         _libsvm_lib = lib
         return lib
 
 
 def libsvm_parse(path, dim):
-    """Parse a LibSVM file into (data[rows, dim] float32, labels[rows]).
+    """Parse a LibSVM file into (data[rows, dim] float32, labels[rows])
+    with ONE file read (libsvm_parse_file allocates, we copy + free).
     Returns None when the native parser is unavailable or rejects the
     file (caller falls back to the Python parser)."""
     lib = libsvm_lib()
     if lib is None:
         return None
-    rows = lib.libsvm_count_rows(path.encode())
+    data_p = ctypes.POINTER(ctypes.c_float)()
+    labels_p = ctypes.POINTER(ctypes.c_float)()
+    rows = lib.libsvm_parse_file(path.encode(), dim,
+                                 ctypes.byref(data_p),
+                                 ctypes.byref(labels_p))
     if rows < 0:
         return None
-    data = np.zeros((rows, dim), np.float32)
-    labels = np.zeros((rows,), np.float32)
-    got = lib.libsvm_parse_dense(
-        path.encode(), dim,
-        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows)
-    if got < 0:
-        return None
-    return data[:got], labels[:got]
+    try:
+        data = np.ctypeslib.as_array(data_p, shape=(rows, dim)).copy()             if rows else np.zeros((0, dim), np.float32)
+        labels = np.ctypeslib.as_array(labels_p, shape=(rows,)).copy()             if rows else np.zeros((0,), np.float32)
+    finally:
+        lib.libsvm_free(data_p)
+        lib.libsvm_free(labels_p)
+    return data, labels

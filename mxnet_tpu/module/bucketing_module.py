@@ -150,6 +150,16 @@ class BucketingModule(BaseModule):
                 "%s-%04d.params" % (self._load_prefix, self._load_epoch))
             self.params_initialized = True
             self._load_prefix = None
+        if getattr(self, "_preset_params", None):
+            arg, aux = self._preset_params
+            self._curr_module.init_params(allow_missing=True)
+            self._curr_module.set_params(arg, aux, allow_missing=True,
+                                         allow_extra=True)
+            self.params_initialized = True
+            # the executor holds the fresh values; mark dirty at THIS
+            # level — get_params() pushes our flag down before syncing
+            self._params_dirty = True
+            self._preset_params = None
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """bucketing_module.py:376."""
@@ -301,9 +311,19 @@ class BucketingModule(BaseModule):
         mod._load_epoch = epoch
         return mod
 
-    def load_dict(self, sym_dict=None, sym_gen=None, default_bucket_key=None,
+    @staticmethod
+    def load_dict(sym_dict=None, sym_gen=None, default_bucket_key=None,
                   arg_params=None, aux_params=None, **kwargs):
-        """Set parameters from dicts after bind (reference load_dict)."""
-        if arg_params is not None or aux_params is not None:
-            self.set_params(arg_params or {}, aux_params or {},
-                            allow_missing=True, allow_extra=True)
+        """Create a BucketingModule from in-memory dicts (reference
+        load_dict contract): `sym_gen`/`default_bucket_key` define the
+        module (sym_dict is accepted for signature parity — symbols are
+        regenerated by sym_gen here), and arg/aux params install at
+        bind time."""
+        assert sym_gen is not None, \
+            "sym_gen is required to build a BucketingModule"
+        assert default_bucket_key is not None
+        mod = BucketingModule(sym_gen,
+                              default_bucket_key=default_bucket_key,
+                              **kwargs)
+        mod._preset_params = (arg_params or {}, aux_params or {})
+        return mod

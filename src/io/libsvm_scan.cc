@@ -25,9 +25,10 @@ namespace {
 bool read_all(const char* path, std::vector<char>* out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return false;
-  std::fseek(f, 0, SEEK_END);
+  if (std::fseek(f, 0, SEEK_END) != 0) { std::fclose(f); return false; }
   long n = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
+  if (n < 0) { std::fclose(f); return false; }  // FIFO/unseekable
+  if (std::fseek(f, 0, SEEK_SET) != 0) { std::fclose(f); return false; }
   out->resize(static_cast<size_t>(n) + 1);
   size_t got = n ? std::fread(out->data(), 1, static_cast<size_t>(n), f) : 0;
   std::fclose(f);
@@ -36,13 +37,7 @@ bool read_all(const char* path, std::vector<char>* out) {
   return true;
 }
 
-}  // namespace
-
-extern "C" {
-
-int64_t libsvm_count_rows(const char* path) {
-  std::vector<char> buf;
-  if (!read_all(path, &buf)) return -1;
+int64_t count_rows_in(const std::vector<char>& buf) {
   int64_t rows = 0;
   bool content = false;
   for (char c : buf) {
@@ -56,6 +51,69 @@ int64_t libsvm_count_rows(const char* path) {
   if (content) ++rows;
   return rows;
 }
+
+}  // namespace
+
+extern "C" {
+
+int64_t libsvm_count_rows(const char* path) {
+  std::vector<char> buf;
+  if (!read_all(path, &buf)) return -1;
+  return count_rows_in(buf);
+}
+
+// One-read entry point: allocates the output buffers internally and
+// hands ownership to the caller (free with libsvm_free). Avoids the
+// count-then-parse double file read.
+int64_t libsvm_parse_file(const char* path, int64_t dim, float** data_out,
+                          float** labels_out) {
+  std::vector<char> buf;
+  if (!read_all(path, &buf)) return -1;
+  int64_t rows = count_rows_in(buf);
+  float* data = static_cast<float*>(
+      std::calloc(static_cast<size_t>(rows) * dim, sizeof(float)));
+  float* labels = static_cast<float*>(
+      std::calloc(static_cast<size_t>(rows), sizeof(float)));
+  if ((rows && (!data || !labels))) {
+    std::free(data);
+    std::free(labels);
+    return -1;
+  }
+  char* p = buf.data();
+  int64_t row = 0;
+  while (*p && row < rows) {
+    while (*p == '\r' || *p == '\n') ++p;
+    if (!*p) break;
+    char* end;
+    float label = std::strtof(p, &end);
+    if (end == p) { std::free(data); std::free(labels); return -2; }
+    p = end;
+    labels[row] = label;
+    float* drow = data + row * dim;
+    while (*p && *p != '\n') {
+      while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+      if (!*p || *p == '\n') break;
+      long idx = std::strtol(p, &end, 10);
+      if (end == p || *end != ':') {
+        std::free(data); std::free(labels); return -2;
+      }
+      if (idx < 0 || idx >= dim) {
+        std::free(data); std::free(labels); return -3;
+      }
+      p = end + 1;
+      float v = std::strtof(p, &end);
+      if (end == p) { std::free(data); std::free(labels); return -2; }
+      p = end;
+      drow[idx] = v;
+    }
+    ++row;
+  }
+  *data_out = data;
+  *labels_out = labels;
+  return row;
+}
+
+void libsvm_free(void* p) { std::free(p); }
 
 int64_t libsvm_parse_dense(const char* path, int64_t dim, float* data,
                            float* labels, int64_t max_rows) {

@@ -230,3 +230,23 @@ def test_bucketing_module_checkpoint_roundtrip(tmp_path):
     a1 = mod.get_params()[0]["bmod_fc_weight"].asnumpy()
     a2 = mod2.get_params()[0]["bmod_fc_weight"].asnumpy()
     np.testing.assert_allclose(a1, a2)
+
+
+def test_bucketing_module_load_dict():
+    import numpy as np
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="ld_fc")
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    w = mx.nd.array(np.full((4, 8), 0.25, np.float32))
+    b = mx.nd.array(np.zeros((4,), np.float32))
+    mod = mx.mod.BucketingModule.load_dict(
+        sym_gen=sym_gen, default_bucket_key=8,
+        arg_params={"ld_fc_weight": w, "ld_fc_bias": b})
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    got = mod.get_params()[0]["ld_fc_weight"].asnumpy()
+    np.testing.assert_allclose(got, 0.25)
