@@ -292,9 +292,12 @@ class BucketingModule(BaseModule):
         for bucket_key in self._buckets:
             symbol, _, _ = self._sym_gen(bucket_key)
             symbol.save("%s-%s-symbol.json" % (prefix, bucket_key))
-        nd.save("%s.buckets" % prefix,
-                nd.array(np.asarray(list(self._buckets), dtype=np.int32),
-                         dtype="int32"))
+        # non-integer bucket keys (tuples) can't serialize this way —
+        # skip the reference-parity artifact then
+        if all(isinstance(k, int) for k in self._buckets):
+            nd.save("%s.buckets" % prefix,
+                    nd.array(np.asarray(list(self._buckets),
+                                        dtype=np.int32), dtype="int32"))
 
     @staticmethod
     def load(prefix, epoch, sym_gen=None, default_bucket_key=None,
