@@ -194,3 +194,20 @@ def test_gru_fused_matches_torch():
     out = outs[0] if isinstance(outs, (list, tuple)) else outs
     np.testing.assert_allclose(out.asnumpy(), ref.detach().numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_instance_and_group_norm_match_torch():
+    rs = np.random.RandomState(10)
+    x = rs.randn(2, 6, 5, 5).astype(np.float32)
+    g = rs.rand(6).astype(np.float32) + 0.5
+    b = rs.randn(6).astype(np.float32)
+    ours = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b),
+                           eps=1e-5).asnumpy()
+    ref = F.instance_norm(_t(x), weight=_t(g), bias=_t(b),
+                          eps=1e-5).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+    ours = nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b),
+                        num_groups=3, eps=1e-5).asnumpy()
+    ref = F.group_norm(_t(x), 3, weight=_t(g), bias=_t(b),
+                       eps=1e-5).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
