@@ -8,6 +8,7 @@ plus measured img/s. Run on a chip:
 
     python benchmark/bn_residual_ab.py          # patched (bf16 residuals)
     python benchmark/bn_residual_ab.py base     # shipped BN
+    python benchmark/bn_residual_ab.py cost-only   # skip the timed run
 
 Compare 'bytes accessed' and img/s; flip ops/nn.py batch_norm if the
 patched variant wins on both.
@@ -73,6 +74,8 @@ jitted = jax.jit(step, donate_argnums=(0,1,2))
 c = jitted.lower(args,mom,aux).compile()
 ca = c.cost_analysis(); ca = ca[0] if isinstance(ca,(list,tuple)) else ca
 print("cost: %.2f TFLOP  %.1f GB" % (ca.get('flops',0)/1e12, ca.get('bytes accessed',0)/1e9))
+if "cost-only" in sys.argv:
+    sys.exit(0)
 import time
 args,mom,aux,loss = jitted(args,mom,aux); float(loss)
 args,mom,aux,loss = jitted(args,mom,aux); float(loss)
