@@ -211,3 +211,50 @@ def test_instance_and_group_norm_match_torch():
     ref = F.group_norm(_t(x), 3, weight=_t(g), bias=_t(b),
                        eps=1e-5).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sgd_momentum_trajectory_matches_torch():
+    """MXNet folds lr into the momentum buffer (v_mx = -lr * v_torch);
+    with constant lr the weight trajectories coincide exactly."""
+    rs = np.random.RandomState(11)
+    w0 = rs.randn(6, 4).astype(np.float32)
+    grads = [rs.randn(6, 4).astype(np.float32) * 0.3 for _ in range(5)]
+
+    wt = torch.nn.Parameter(_t(w0.copy()))
+    opt_t = torch.optim.SGD([wt], lr=0.1, momentum=0.9)
+    for g in grads:
+        opt_t.zero_grad()
+        wt.grad = _t(g)
+        opt_t.step()
+
+    opt_m = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                                rescale_grad=1.0)
+    wm = mx.nd.array(w0.copy())
+    state = opt_m.create_state(0, wm)
+    for g in grads:
+        opt_m.update(0, wm, mx.nd.array(g), state)
+    np.testing.assert_allclose(wm.asnumpy(), wt.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adam_trajectory_matches_torch():
+    rs = np.random.RandomState(12)
+    w0 = rs.randn(5, 3).astype(np.float32)
+    grads = [rs.randn(5, 3).astype(np.float32) * 0.3 for _ in range(6)]
+
+    wt = torch.nn.Parameter(_t(w0.copy()))
+    opt_t = torch.optim.Adam([wt], lr=0.01, betas=(0.9, 0.999), eps=1e-8)
+    for g in grads:
+        opt_t.zero_grad()
+        wt.grad = _t(g)
+        opt_t.step()
+
+    opt_m = mx.optimizer.create("adam", learning_rate=0.01, beta1=0.9,
+                                beta2=0.999, epsilon=1e-8,
+                                rescale_grad=1.0)
+    wm = mx.nd.array(w0.copy())
+    state = opt_m.create_state(0, wm)
+    for g in grads:
+        opt_m.update(0, wm, mx.nd.array(g), state)
+    np.testing.assert_allclose(wm.asnumpy(), wt.detach().numpy(),
+                               rtol=1e-4, atol=1e-6)
