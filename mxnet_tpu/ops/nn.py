@@ -164,6 +164,13 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     kernel = _tuplize(kernel, nd)
     stride = _tuplize(stride, nd)
     pad = _tuplize(pad if pad != () else 0, nd)
+    for i in range(nd):
+        if pooling_convention != "full" and \
+                kernel[i] > data.shape[2 + i] + 2 * pad[i]:
+            raise ValueError(
+                "Pooling kernel %s exceeds padded input %s on axis %d "
+                "(valid convention); shrink the kernel, pad, or use "
+                "global_pool" % (kernel, data.shape[2:], i))
 
     pads = []
     for i in range(nd):

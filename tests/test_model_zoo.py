@@ -88,3 +88,30 @@ def test_model_zoo_train_step_decreases_loss():
         trainer.step(8)
         losses.append(float(loss.mean().asnumpy()))
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("factory,size", [
+    ("squeezenet1_1", 64),
+    ("mobilenet_v2_0_25", 64),
+    ("densenet121", 224),     # fixed AvgPool2D(7) tail needs 224 input
+])
+def test_more_zoo_hybridized_matches_eager(factory, size):
+    import numpy as np
+    net = getattr(vision, factory)(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, size, size)
+                    .astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(hybrid, eager, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_kernel_exceeding_input_is_actionable():
+    """A 7x7 valid pool on a 2x2 map must say so, not die inside XLA
+    slicing (reference errors with 'kernel size exceeds input')."""
+    p = mx.gluon.nn.AvgPool2D(pool_size=7)
+    p.initialize()
+    with pytest.raises(Exception) as exc:
+        p(mx.nd.array(np.ones((1, 3, 2, 2), np.float32)))
+    assert "kernel" in str(exc.value).lower()
