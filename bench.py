@@ -63,22 +63,15 @@ def build_train_step(batch, image_size=224, classes=1000, lr=0.1):
 
 def _probe_backend_alive(timeout_s=150):
     """A wedged TPU tunnel hangs jax backend init forever (observed:
-    hours). Probe device discovery in a THROWAWAY subprocess with a
-    timeout so the bench fails fast and loud instead of hanging the
-    round-end run. Returns True when devices enumerate."""
+    hours). Single implementation lives in mxnet_tpu._discover; the
+    bench wants fail-fast error JSON rather than the library's CPU
+    fallback, so it probes explicitly (cache disabled: the round-end
+    run must reflect the tunnel's state NOW)."""
     import os
-    import subprocess
-    import sys as _sys
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         return True      # CPU never wedges
-    try:
-        r = subprocess.run(
-            [_sys.executable, "-c",
-             "import jax; jax.devices(); print('OK')"],
-            timeout=timeout_s, capture_output=True)
-        return b"OK" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    from mxnet_tpu._discover import probe_backend_alive
+    return probe_backend_alive(timeout_s=timeout_s, use_cache=False)
 
 
 def main():

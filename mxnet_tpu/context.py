@@ -10,6 +10,8 @@ import threading
 
 import jax
 
+from ._discover import ensure_backend
+
 _thread_local = threading.local()
 
 
@@ -21,6 +23,10 @@ class Context:
     _default_ctx = threading.local()
 
     def __init__(self, device_type, device_id=0):
+        # NOTE: no ensure_backend() here — Contexts are constructed at
+        # import time (model_zoo ctx=cpu() default args) and must stay
+        # free of backend discovery; the guard runs at device RESOLUTION
+        # (jax_device/_accelerators) and in ndarray._resolve_ctx.
         if isinstance(device_type, Context):
             self.device_type, self.device_id = device_type.device_type, device_type.device_id
         else:
@@ -51,6 +57,7 @@ class Context:
     @property
     def jax_device(self):
         """The concrete jax.Device this context denotes."""
+        ensure_backend()  # wedge-proof first discovery (_discover.py)
         if self.device_type == "cpu" or self.device_type == "cpu_pinned" \
                 or self.device_type == "cpu_shared":
             devs = _devices_by_platform("cpu")
@@ -89,6 +96,7 @@ def _devices_by_platform(platform):
     only THIS process's devices are addressable for eager placement, so
     cpu(0)/tpu(0) means local device 0 (reference semantics: each worker
     sees its own GPUs); the global mesh is the parallel layer's job."""
+    ensure_backend()  # wedge-proof first discovery (_discover.py)
     try:
         if jax.process_count() > 1:
             return [d for d in jax.local_devices()
@@ -99,6 +107,7 @@ def _devices_by_platform(platform):
 
 
 def _accelerators():
+    ensure_backend()  # wedge-proof first discovery (_discover.py)
     if jax.process_count() > 1:
         return [d for d in jax.local_devices() if d.platform != "cpu"]
     return [d for d in jax.devices() if d.platform != "cpu"]

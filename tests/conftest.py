@@ -9,10 +9,14 @@ import os
 os.environ.setdefault("XLA_FLAGS",
                       (os.environ.get("XLA_FLAGS", "") +
                        " --xla_force_host_platform_device_count=8").strip())
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax
+# single wedge-proof platform-pinning implementation (mxnet_tpu/_discover.py):
+# honors JAX_PLATFORMS through jax.config before any backend init, because
+# plugin registration overrides the env var.
+from mxnet_tpu._discover import ensure_backend
 
-jax.config.update("jax_platforms", "cpu")
+ensure_backend()
 
 import numpy as np
 import pytest
