@@ -44,10 +44,19 @@ class _WikiText(object):
                     continue
                 tokens.extend(line.split() + ["<eos>"])
         if vocab is None:
-            uniq = sorted(set(tokens))
+            # always include <unk> so this vocab can code other segments
+            # (reference maps out-of-vocabulary tokens to <unk>, never
+            # drops them — dropping would shift the token stream and the
+            # data/label alignment)
+            uniq = sorted(set(tokens) | {"<unk>"})
             vocab = {w: i for i, w in enumerate(uniq)}
         self.vocabulary = vocab
-        coded = np.asarray([vocab[w] for w in tokens if w in vocab],
+        unk = vocab.get("<unk>")
+        if unk is None and any(w not in vocab for w in tokens):
+            raise ValueError(
+                "the supplied vocabulary has out-of-vocabulary tokens in "
+                "segment %r but no '<unk>' entry to map them to" % segment)
+        coded = np.asarray([vocab.get(w, unk) for w in tokens],
                            dtype=np.float32)
         n = (len(coded) - 1) // seq_len
         data = coded[:n * seq_len].reshape(n, seq_len)

@@ -536,22 +536,15 @@ class ImageIter(DataIter):
         with open(os.path.join(self.path_root or "", fname), "rb") as f:
             return label, f.read()
 
-    def _decode_one(self, label, s):
-        img = imdecode(s)
-        for aug in self.auglist:
-            img = aug(img)
-        return _as_np(img).transpose(2, 0, 1), label
-
-    def _decoded_sample(self):
-        """Next (CHW float array, label row), from the rollover cache
-        first. With preprocess_threads > 0 the JPEG decode (the
-        dominant cost; cv2 releases the GIL) runs on a thread pool a
-        batch ahead — the reference ImageRecordIter's threaded decode
-        loop (iter_image_recordio_2.cc:76,146). Augmenters stay on the
+    def _next_raw_decoded(self):
+        """Next (label, decoded HWC uint8 array). With
+        preprocess_threads > 0 the JPEG decode (the dominant cost; cv2
+        releases the GIL) runs on a thread pool a batch ahead — the
+        reference ImageRecordIter's threaded decode loop
+        (iter_image_recordio_2.cc:76,146). Augmenters stay on the
         calling thread: several are jnp-backed and eager jax dispatch
-        is not safe to fan out across threads."""
-        if self._cache:
-            return self._cache.pop(0)
+        is not safe to fan out across threads. Shared by ImageIter and
+        ImageDetIter (whose augmenters also transform labels)."""
         if self.preprocess_threads > 0:
             if getattr(self, "_pool", None) is None:
                 import concurrent.futures as _cf
@@ -576,12 +569,20 @@ class ImageIter(DataIter):
             if not self._pending:
                 raise StopIteration
             label, fut = self._pending.pop(0)
-            img = nd.array(fut.result(), dtype="uint8")
-            for aug in self.auglist:
-                img = aug(img)
-            return _as_np(img).transpose(2, 0, 1), label
+            return label, fut.result()
         label, s = self.next_sample()
-        return self._decode_one(label, s)
+        return label, _imdecode_np(s)
+
+    def _decoded_sample(self):
+        """Next (CHW float array, label row), from the rollover cache
+        first."""
+        if self._cache:
+            return self._cache.pop(0)
+        label, arr = self._next_raw_decoded()
+        img = nd.array(arr, dtype="uint8")
+        for aug in self.auglist:
+            img = aug(img)
+        return _as_np(img).transpose(2, 0, 1), label
 
     def _label_batch_shape(self):
         """Trailing label dims of one batch row — (label_width,) here;
@@ -706,10 +707,12 @@ def _box_overlap_frac(boxes, crop):
 
 class DetRandomCropAug(DetAugmenter):
     """Random crop constrained to keep objects reasonably covered
-    (reference DetRandomCropAug semantics: sample up to max_attempts
-    crops in the area/aspect ranges, accept when every kept object is
-    covered at least min_object_covered; objects whose coverage falls
-    below min_eject_coverage are dropped from the label)."""
+    (reference python/mxnet/image/detection.py:237-269: sample up to
+    max_attempts crops in the area/aspect ranges; a candidate is
+    accepted only when the MINIMUM coverage over all overlapping valid
+    objects exceeds min_object_covered; min_eject_coverage then applies
+    to the ACCEPTED crop's label update, dropping objects whose
+    remaining coverage is at or below it)."""
 
     def __init__(self, min_object_covered=0.1,
                  aspect_ratio_range=(0.75, 1.33),
@@ -736,22 +739,33 @@ class DetRandomCropAug(DetAugmenter):
             if not valid.any():
                 break
             cov = _box_overlap_frac(label[valid], crop)
-            keep = cov >= self.min_eject_coverage
+            # acceptance: min coverage over ALL overlapping objects must
+            # exceed min_object_covered (reference
+            # _check_satisfy_constraints: np.amin(coverages) >
+            # min_object_covered over coverages > 0) — crops that
+            # partially lose any object beyond the threshold are retried
+            overlapping = cov[cov > 0]
+            if overlapping.size == 0 or \
+                    np.amin(overlapping) <= self.min_object_covered:
+                continue
+            # label update of the accepted crop: eject objects whose
+            # coverage is at or below min_eject_coverage (reference
+            # _update_labels: valid &= coverage > min_eject_coverage)
+            keep = cov > self.min_eject_coverage
             if not keep.any():
                 continue
-            if (cov[keep] >= self.min_object_covered).all():
-                out = np.full_like(label, -1.0)
-                kept = label[valid][keep].copy()
-                # clip to the crop window and renormalize
-                kept[:, 1] = (np.clip(kept[:, 1], x0, crop[2]) - x0) / cw
-                kept[:, 3] = (np.clip(kept[:, 3], x0, crop[2]) - x0) / cw
-                kept[:, 2] = (np.clip(kept[:, 2], y0, crop[3]) - y0) / ch
-                kept[:, 4] = (np.clip(kept[:, 4], y0, crop[3]) - y0) / ch
-                out[:len(kept)] = kept
-                px0, py0 = int(x0 * w), int(y0 * h)
-                px1, py1 = int(math.ceil(crop[2] * w)), \
-                    int(math.ceil(crop[3] * h))
-                return nd.array(arr[py0:py1, px0:px1].copy()), out
+            out = np.full_like(label, -1.0)
+            kept = label[valid][keep].copy()
+            # clip to the crop window and renormalize
+            kept[:, 1] = (np.clip(kept[:, 1], x0, crop[2]) - x0) / cw
+            kept[:, 3] = (np.clip(kept[:, 3], x0, crop[2]) - x0) / cw
+            kept[:, 2] = (np.clip(kept[:, 2], y0, crop[3]) - y0) / ch
+            kept[:, 4] = (np.clip(kept[:, 4], y0, crop[3]) - y0) / ch
+            out[:len(kept)] = kept
+            px0, py0 = int(x0 * w), int(y0 * h)
+            px1, py1 = int(math.ceil(crop[2] * w)), \
+                int(math.ceil(crop[3] * h))
+            return nd.array(arr[py0:py1, px0:px1].copy()), out
         return src, label
 
 
@@ -910,10 +924,13 @@ class ImageDetIter(ImageIter):
         return body[:n * b].reshape(n, b).copy()
 
     def _decoded_sample(self):
+        # decode via the shared (optionally threaded) prefetch path;
+        # label parsing and the label-transforming det augmenters run on
+        # the calling thread
         if self._cache:
             return self._cache.pop(0)
-        label, s = self.next_sample()
-        img = imdecode(s)
+        label, arr = self._next_raw_decoded()
+        img = nd.array(arr, dtype="uint8")
         parsed = self._parse_det_label(label)
         padded = np.full((self._max_objects, self._object_width), -1.0,
                          np.float32)

@@ -259,3 +259,29 @@ def test_crop_resize_transform():
     assert out.shape == (4, 5, 3)
     t2 = mx.gluon.data.vision.transforms.CropResize(0, 0, 6, 6)
     assert t2(img).shape == (6, 6, 3)
+
+
+def test_wikitext_oov_maps_to_unk(tmp_path):
+    """ADVICE r2: a user vocab must map OOV tokens to <unk> (reference
+    behavior), never silently drop them — dropping shifts the stream and
+    the data/label alignment."""
+    import numpy as np
+    p = tmp_path / "wiki.train.tokens"
+    p.write_text("a b zzz c\n")
+    vocab = {"a": 0, "b": 1, "c": 2, "<eos>": 3, "<unk>": 4}
+    ds = mx.gluon.contrib.data.WikiText2(root=str(tmp_path),
+                                         segment="train", vocab=vocab,
+                                         seq_len=4)
+    d, l = ds[0]
+    # stream: a b <unk> c (<eos>) -> data [0,1,4,2], label [1,4,2,3]
+    np.testing.assert_array_equal(d.asnumpy(), [0, 1, 4, 2])
+    np.testing.assert_array_equal(l.asnumpy(), [1, 4, 2, 3])
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mx.gluon.contrib.data.WikiText2(
+            root=str(tmp_path), segment="train",
+            vocab={"a": 0, "b": 1, "c": 2, "<eos>": 3}, seq_len=4)
+    # auto-built vocab always carries <unk> so it can code other segments
+    auto = mx.gluon.contrib.data.WikiText2(root=str(tmp_path),
+                                           segment="train", seq_len=2)
+    assert "<unk>" in auto.vocabulary

@@ -406,3 +406,73 @@ def test_image_det_iter_parent_kwargs(tmp_path):
         mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
                               imglist=imglist, path_root=str(tmp_path),
                               aug_list=[], rand_mirror=True)
+
+
+def test_det_random_crop_constraint_semantics(monkeypatch):
+    """Reference _check_satisfy_constraints (detection.py:237-252): a
+    candidate crop is REJECTED when any overlapping object's coverage is
+    at or below min_object_covered; min_eject_coverage only prunes
+    labels of an accepted crop (ADVICE r2)."""
+    import numpy as np
+
+    # pin the sampled crop to (0.5, 0.0)-(1.0, 0.5): area/ratio fix the
+    # window at 0.5x0.5, the alternating x0/y0 calls place it
+    seq = {"n": 0}
+
+    def fake_uniform_xy(a, b):
+        # called alternately for x0 (uniform(0, 0.5)) then y0
+        seq["n"] += 1
+        return 0.5 if seq["n"] % 2 == 1 else 0.0
+
+    monkeypatch.setattr(mx.image.pyrandom, "uniform",
+                        lambda a, b: {(0.05, 1.0): 0.25,
+                                      (0.75, 1.33): 1.0}.get(
+                            (a, b), None) or fake_uniform_xy(a, b))
+
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.1,
+                                    min_eject_coverage=0.3,
+                                    max_attempts=3)
+    src = mx.nd.array(np.zeros((100, 100, 3), np.uint8), dtype="uint8")
+
+    # B's coverage ~0.038 <= 0.1: the whole crop must be retried/refused
+    label_reject = np.array([[0, 0.6, 0.1, 0.9, 0.4],
+                             [1, 0.0, 0.0, 0.52, 0.4]], np.float32)
+    seq["n"] = 0
+    out_img, out_lab = aug(src, label_reject.copy())
+    np.testing.assert_array_equal(out_lab, label_reject)  # unchanged
+    assert out_img.shape == src.shape
+
+    # B's coverage 0.2 (> covered 0.1, <= eject 0.3): crop accepted, B
+    # ejected from the label
+    label_eject = np.array([[0, 0.6, 0.1, 0.9, 0.4],
+                            [1, 0.3, 0.0, 0.55, 0.4]], np.float32)
+    seq["n"] = 0
+    out_img, out_lab = aug(src, label_eject.copy())
+    assert out_img.shape != src.shape          # cropped
+    assert (out_lab[0, 0] >= 0) and (out_lab[1, 0] == -1)
+
+
+def test_image_det_iter_threaded_decode_matches_sync(tmp_path):
+    """preprocess_threads routes ImageDetIter through the shared
+    threaded decode path and must not change the stream (ADVICE r2: it
+    used to be a silent no-op)."""
+    import cv2
+    import numpy as np
+    imglist = []
+    for i in range(5):
+        cv2.imwrite(str(tmp_path / ("t%d.png" % i)),
+                    (np.random.RandomState(i).rand(24, 24, 3) * 255)
+                    .astype(np.uint8))
+        imglist.append(([2, 5, i % 3, 0.1, 0.1, 0.6, 0.6],
+                        "t%d.png" % i))
+    kw = dict(batch_size=2, data_shape=(3, 24, 24), imglist=imglist,
+              path_root=str(tmp_path), aug_list=[])
+    sync_batches = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                    for b in mx.image.ImageDetIter(**kw)]
+    thr_batches = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                   for b in mx.image.ImageDetIter(preprocess_threads=2,
+                                                  **kw)]
+    assert len(sync_batches) == len(thr_batches) > 0
+    for (d0, l0), (d1, l1) in zip(sync_batches, thr_batches):
+        np.testing.assert_array_equal(d0, d1)
+        np.testing.assert_array_equal(l0, l1)
