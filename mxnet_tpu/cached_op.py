@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from . import autograd
 from . import random as _random
 from .base import MXNetError
-from .executor import build_graph_fn
+from .executor import apply_mirror, build_graph_fn, mirror_enabled
 
 
 class CachedOp:
@@ -60,6 +60,10 @@ class CachedOp:
                 full.update(zip(diff_names, diff_list))
                 outs, aux_up = graph_fn(full, aux, rng_key)
                 return tuple(outs), aux_up
+            # hybridize(backward_do_mirror=True) / MXNET_BACKWARD_DO_MIRROR:
+            # remat the traced graph so backward recomputes activations
+            # under the mirror policy instead of storing them
+            pure = apply_mirror(pure, mirror_enabled(self._flags))
             fn = jax.jit(pure)
         else:
             def pure(args, aux, rng_key):

@@ -29,6 +29,59 @@ def _clean_attrs(attrs):
     return {k: v for k, v in attrs.items() if not k.startswith("__")}
 
 
+# ------------------------------------------------- gradient mirroring ----
+# Reference: MXNET_BACKWARD_DO_MIRROR (src/nnvm/gradient.cc:285, switch at
+# src/executor/graph_executor.cc:351-357) — recompute cheap forward
+# activations in backward instead of storing them, trading FLOPs for
+# memory. TPU-native mapping: jax.checkpoint (remat) around the traced
+# graph. The policy mirrors the reference's mirror_fun granularity:
+#   dots (default)  save MXU results (matmul/conv outputs), recompute
+#                   elementwise/norm activations — the reference's
+#                   "mirror everything but heavy ops" heuristic
+#   full            save nothing that can be recomputed
+#   none            disabled
+def mirror_enabled(flags=None):
+    """Resolve the mirror knob: explicit flag wins, then the reference's
+    env var."""
+    import os
+    if flags:
+        for key in ("backward_do_mirror", "do_mirror"):
+            if key in flags:
+                v = flags[key]
+                return v if isinstance(v, bool) else str(v).lower() in (
+                    "1", "true", "yes")
+    return os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").lower() in (
+        "1", "true", "yes")
+
+
+def _save_mxu_results(prim, *_, **__):
+    # save outputs of MXU ops (matmul/conv — the reference's mirror pass
+    # likewise never recomputes Convolution/FullyConnected, only cheap
+    # activations, gradient.cc mirror_fun); everything else is
+    # rematerialized in backward
+    return getattr(prim, "name", str(prim)) in (
+        "dot_general", "conv_general_dilated")
+
+
+def _mirror_policy():
+    import os
+    name = os.environ.get("MXNET_MIRROR_POLICY", "dots")
+    if name == "full":
+        return None  # jax.checkpoint default: save nothing
+    if name == "dots":
+        return _save_mxu_results
+    raise MXNetError(
+        "MXNET_MIRROR_POLICY must be 'dots' or 'full', got %r" % name)
+
+
+def apply_mirror(fn, enabled):
+    """Wrap a traced graph function in jax.checkpoint when mirroring is
+    on; identity otherwise."""
+    if not enabled:
+        return fn
+    return jax.checkpoint(fn, policy=_mirror_policy())
+
+
 def node_eval_fn(node, for_inference=False):
     """Pure fn(*input_arrays) for one graph node (used by eval_shape)."""
     op = ops.get(node.op)
@@ -219,18 +272,23 @@ class Executor:
             outs, _ = fwd_infer(arg_arrays, aux_arrays, key)
             return outs
 
+        do_mirror = mirror_enabled()
+
         def fwd_res_fn(diff_arrays, rest_arrays, aux_arrays, key):
             """Forward + pullback residuals. The returned vjp closure is a
             jax.tree_util.Partial (a pytree of residual arrays), so it
             crosses the jit boundary intact: backward() replays ONLY the
             transposed computation — custom head gradients cost no second
             forward (the reference executor also keeps fwd/bwd as two
-            engine segments, graph_executor.cc RunOps)."""
+            engine segments, graph_executor.cc RunOps). With
+            MXNET_BACKWARD_DO_MIRROR the whole graph is rematerialized
+            under the mirror policy, shrinking the residual set."""
             def f(diff):
                 full = dict(rest_arrays)
                 full.update(dict(zip(diff_names, diff)))
                 outs, aux_up = fwd_train(full, aux_arrays, key)
                 return outs, aux_up
+            f = apply_mirror(f, do_mirror)
             outs, vjp, aux_up = jax.vjp(f, list(diff_arrays), has_aux=True)
             return outs, aux_up, vjp
 

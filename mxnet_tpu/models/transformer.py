@@ -54,6 +54,11 @@ class TransformerConfig:
     # (kernels/flash_attention.py) instead of the dense jnp path;
     # sequences must divide the kernel's blocks
     use_flash_kernel: bool = False
+    # activation recompute: checkpoint each transformer layer so backward
+    # rematerializes its activations instead of storing them (the
+    # reference's MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc:285,
+    # applied at the idiomatic per-layer granularity)
+    remat_layers: bool = False
 
 
 def _norm_shape(cfg):
@@ -201,6 +206,8 @@ def forward(params, tokens, cfg, mesh=None):
                                  manual_sp=ring)
             return xm + _ffn(_rms_norm(xm, p["ln2"]), p, cfg)
 
+        if cfg.remat_layers:
+            layer_fn = jax.checkpoint(layer_fn)
         stacked = stack_stage_params(params["layers"], n_stages)
         x = spmd_pipeline(
             layer_fn, stacked, x, mesh, axis_name=cfg.pp_axis,
@@ -209,12 +216,20 @@ def forward(params, tokens, cfg, mesh=None):
             microbatch_spec=P(None, None, cfg.sp_axis, None) if ring
             else P())
     else:
-        for p in params["layers"]:
-            x = x + _attention(_rms_norm(x, p["ln1"]), p, cfg, mesh)
-            x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+        def layer_body(p, xl):
+            xl = xl + _attention(_rms_norm(xl, p["ln1"]), p, cfg, mesh)
+            xl = xl + _ffn(_rms_norm(xl, p["ln2"]), p, cfg)
             if mesh is not None:
-                x = jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, act))
+                xl = jax.lax.with_sharding_constraint(
+                    xl, NamedSharding(mesh, act))
+            return xl
+
+        if cfg.remat_layers:
+            # save only layer boundaries; backward recomputes each
+            # layer's internals (attention scores, ffn hidden) on the fly
+            layer_body = jax.checkpoint(layer_body)
+        for p in params["layers"]:
+            x = layer_body(p, x)
     x = _rms_norm(x, params["ln_f"])
     return jnp.einsum("btd,vd->btv", x, params["embed"])
 

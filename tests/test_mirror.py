@@ -1,0 +1,165 @@
+"""Gradient mirroring / activation recompute (VERDICT r2 item 4).
+
+Reference: MXNET_BACKWARD_DO_MIRROR (src/nnvm/gradient.cc:285, executor
+switch src/executor/graph_executor.cc:351-357) — trade recompute FLOPs
+for backward memory. TPU mapping: jax.checkpoint around the traced graph
+(executor.apply_mirror) and per-layer remat on the transformer.
+
+Residual memory is measured directly: the executor's saved vjp closure
+is a pytree of residual arrays, so summing leaf bytes gives the saved-
+activation footprint on any backend."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _residual_bytes(executor):
+    vjp, _ = executor._saved_vjp
+    return sum(x.nbytes for x in jax.tree.leaves(vjp)
+               if hasattr(x, "nbytes"))
+
+
+def _deep_sym(n_layers=8, hidden=64):
+    x = mx.sym.Variable("data")
+    for i in range(n_layers):
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="tanh", name="act%d" % i)
+    return mx.sym.sum(x, name="out")
+
+
+def _bind_forward_backward(sym, env):
+    rng = np.random.RandomState(0)
+    args = {n: mx.nd.array(rng.randn(*s) * 0.1) for n, s in zip(
+        sym.list_arguments(),
+        sym.infer_shape(data=(16, 64))[0])}
+    grads = {n: mx.nd.zeros(a.shape) for n, a in args.items()}
+    for k, v in env.items():
+        import os
+        os.environ[k] = v
+    try:
+        ex = sym.bind(mx.cpu(), args, args_grad=grads)
+        ex.forward(is_train=True)
+        ex.backward()
+    finally:
+        import os
+        for k in env:
+            os.environ.pop(k, None)
+    return ex, grads
+
+
+def test_executor_mirror_shrinks_residuals_and_matches_grads():
+    sym = _deep_sym()
+    ex_base, g_base = _bind_forward_backward(sym, {})
+    ex_full, g_full = _bind_forward_backward(
+        sym, {"MXNET_BACKWARD_DO_MIRROR": "1", "MXNET_MIRROR_POLICY": "full"})
+    ex_dots, g_dots = _bind_forward_backward(
+        sym, {"MXNET_BACKWARD_DO_MIRROR": "1", "MXNET_MIRROR_POLICY": "dots"})
+
+    b_base = _residual_bytes(ex_base)
+    b_full = _residual_bytes(ex_full)
+    b_dots = _residual_bytes(ex_dots)
+    # full mirroring keeps only inputs; dots keeps MXU outputs too;
+    # both must be strictly smaller than the unmirrored residual set
+    assert b_full < b_base, (b_full, b_base)
+    assert b_dots < b_base, (b_dots, b_base)
+    assert b_full <= b_dots
+
+    for n in g_base:
+        np.testing.assert_allclose(g_base[n].asnumpy(),
+                                   g_full[n].asnumpy(), rtol=2e-5,
+                                   atol=2e-6)
+        np.testing.assert_allclose(g_base[n].asnumpy(),
+                                   g_dots[n].asnumpy(), rtol=2e-5,
+                                   atol=2e-6)
+
+
+def test_invalid_mirror_policy_raises():
+    import os
+    sym = _deep_sym(2)
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    os.environ["MXNET_MIRROR_POLICY"] = "bogus"
+    try:
+        with pytest.raises(mx.MXNetError):
+            _bind_forward_backward(sym, {})
+    finally:
+        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+        os.environ.pop("MXNET_MIRROR_POLICY", None)
+
+
+def _gluon_grads(mirror):
+    mx.random.seed(0)
+    rng = np.random.RandomState(1)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(3):
+            net.add(gluon.nn.Dense(32, activation="relu"))
+            net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dropout(0.3))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    if mirror:
+        net.hybridize(backward_do_mirror=True)
+    else:
+        net.hybridize()
+    x = mx.nd.array(rng.randn(8, 16))
+    params = net.collect_params()
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    return {k: p.grad().asnumpy() for k, p in params.items()
+            if p.grad_req != "null"}
+
+
+def test_hybridize_mirror_flag_grads_match():
+    """hybridize(backward_do_mirror=True) routes CachedOp through remat;
+    gradients (incl. through BatchNorm aux stats and Dropout rng) must be
+    identical to the unmirrored trace."""
+    base = _gluon_grads(False)
+    mirrored = _gluon_grads(True)
+    assert len(base) == len(mirrored) and base
+    # parameter names carry distinct auto name-scope prefixes
+    # (hybridsequential0_ vs hybridsequential1_); compare by sorted order
+    for kb, km in zip(sorted(base), sorted(mirrored)):
+        assert kb.split("_", 1)[1] == km.split("_", 1)[1], (kb, km)
+        # remat reorders float accumulation (activations are recomputed
+        # in backward), so equality is up to reassociation noise
+        np.testing.assert_allclose(base[kb], mirrored[km], rtol=2e-3,
+                                   atol=1e-5)
+
+
+def test_transformer_remat_layers_matches_and_shrinks_memory():
+    """cfg.remat_layers: same loss/grads, smaller compiled temp memory
+    (when the backend reports it)."""
+    from mxnet_tpu.models import transformer as T
+
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+               d_ff=128, max_len=64, use_ring_attention=False)
+    base_cfg = T.TransformerConfig(**cfg)
+    remat_cfg = T.TransformerConfig(remat_layers=True, **cfg)
+
+    params = T.init_params(base_cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 64)), jnp.int32)
+
+    g_base = jax.grad(T.loss_fn)(params, tokens, base_cfg)
+    g_remat = jax.grad(T.loss_fn)(params, tokens, remat_cfg)
+    for a, b in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+    def residual_bytes(cfg_):
+        # eager vjp stores the pullback residuals as concrete arrays —
+        # a backend-independent measure of saved-activation memory
+        _, vjp = jax.vjp(lambda p: T.loss_fn(p, tokens, cfg_), params)
+        return sum(x.nbytes for x in jax.tree.leaves(vjp)
+                   if hasattr(x, "nbytes"))
+
+    b_base, b_remat = residual_bytes(base_cfg), residual_bytes(remat_cfg)
+    assert b_remat < b_base, (b_remat, b_base)
