@@ -1,0 +1,182 @@
+"""Input-pipeline throughput: can the host feed the chip?
+
+Reference yardstick: the training step sustains ~2,000-2,700 img/s on
+one chip (PERF.md), so the pipeline must deliver >= ~4,000 img/s
+(1.5x) to never be the bottleneck. The reference does this with native
+TurboJPEG decode + OMP augmenters (iter_image_recordio_2.cc:76,146-157).
+
+Measures, on a synthetic ImageNet-shaped record file (224x224 JPEGs):
+  raw        RecordIO scan only (no decode)
+  decode     + JPEG decode
+  full       + augment (resize/crop/mirror) + batch to NCHW float32
+for the sync path, thread-pool path, and (if built) the native decoder.
+
+Usage: python benchmark/input_pipeline_bench.py [--n 2048] [--batch 128]
+Prints one JSON line per configuration.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"  # decode/augment is host work
+
+import numpy as np
+
+
+def make_record_file(path, n, size=224, quality=95):
+    import cv2
+    from mxnet_tpu import recordio
+    idx_path = os.path.splitext(path)[0] + ".idx"  # im2rec convention
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    rng = np.random.RandomState(0)
+    # realistic JPEG entropy: smooth random fields, not white noise
+    for i in range(n):
+        base = rng.rand(size // 8, size // 8, 3).astype(np.float32)
+        img = cv2.resize(base, (size, size),
+                         interpolation=cv2.INTER_CUBIC)
+        img = (np.clip(img, 0, 1) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ok
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+    return path
+
+
+def bench_raw_scan(path, n):
+    from mxnet_tpu import recordio
+    rec = recordio.MXRecordIO(path, "r")
+    t0 = time.time()
+    cnt = 0
+    while True:
+        item = rec.read()
+        if item is None:
+            break
+        cnt += 1
+    dt = time.time() - t0
+    rec.close()
+    assert cnt == n, (cnt, n)
+    return n / dt
+
+
+def bench_decode_only(path, n, threads):
+    """RecordIO scan + JPEG decode, no augmentation."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import _imdecode_np
+    rec = recordio.MXRecordIO(path, "r")
+    bufs = []
+    while True:
+        item = rec.read()
+        if item is None:
+            break
+        bufs.append(recordio.unpack(item)[1])
+    rec.close()
+    t0 = time.time()
+    if threads:
+        import cv2
+        cv2.setNumThreads(0)
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(threads) as pool:
+            for fut in [pool.submit(_imdecode_np, b) for b in bufs]:
+                fut.result()
+    else:
+        for b in bufs:
+            _imdecode_np(b)
+    return n / (time.time() - t0)
+
+
+def bench_image_iter(path, n, batch, threads, epochs=2):
+    """Full path: ImageIter = scan + decode + augment + NCHW batch."""
+    import mxnet_tpu as mx
+    it = mx.image.ImageIter(
+        batch_size=batch, data_shape=(3, 224, 224),
+        path_imgrec=path,
+        shuffle=False, rand_crop=True, rand_mirror=True,
+        preprocess_threads=threads)
+    # warm epoch (thread pool spin-up, caches)
+    for _ in it:
+        pass
+    total = 0
+    t0 = time.time()
+    for _ in range(epochs):
+        it.reset()
+        for b in it:
+            total += b.data[0].shape[0]
+    return total / (time.time() - t0)
+
+
+def bench_mp_dataloader(path, n, batch, workers, epochs=2):
+    """Gluon ImageRecordDataset + process-pool DataLoader with shm batch
+    passing (gluon/data/dataloader.py). Workers decode+augment; parent
+    does the single device conversion."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    ds = ImageRecordDataset(path).transform_first(
+        T.Compose([T.RandomResizedCrop(224), T.ToTensor()]))
+    loader = DataLoader(ds, batch_size=batch, num_workers=workers,
+                        last_batch="discard")
+    for _ in loader:  # warm pass (worker spin-up)
+        pass
+    total = 0
+    t0 = time.time()
+    for _ in range(epochs):
+        for d, l in loader:
+            total += d.shape[0]
+    return total / (time.time() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="ipbench_")
+    path = os.path.join(tmp, "synth.rec")
+    t0 = time.time()
+    make_record_file(path, args.n)
+    sys.stderr.write("record file built in %.1fs (%d images, %.1f MB)\n"
+                     % (time.time() - t0, args.n,
+                        os.path.getsize(path) / 1e6))
+
+    ncpu = os.cpu_count() or 1
+    results = {}
+    results["raw_scan"] = bench_raw_scan(path, args.n)
+    results["decode_sync"] = bench_decode_only(path, args.n, 0)
+    for t in (4, 8, min(16, ncpu)):
+        results["decode_t%d" % t] = bench_decode_only(path, args.n, t)
+    results["full_sync"] = bench_image_iter(path, args.n, args.batch, 0)
+    for t in (4, 8, min(16, ncpu)):
+        results["full_t%d" % t] = bench_image_iter(path, args.n,
+                                                   args.batch, t)
+    for w in (2, min(8, max(2, ncpu))):
+        try:
+            results["mp_loader_w%d" % w] = bench_mp_dataloader(
+                path, args.n, args.batch, w)
+        except Exception as e:  # keep the report even if mp fails here
+            sys.stderr.write("mp_loader_w%d failed: %s\n" % (w, e))
+
+    for k, v in results.items():
+        print(json.dumps({"metric": "input_pipeline_%s" % k,
+                          "value": round(v, 1), "unit": "img/s"}))
+    target = 4000.0
+    best = max(v for k, v in results.items() if k.startswith("full"))
+    print(json.dumps({"metric": "input_pipeline_best_full",
+                      "value": round(best, 1), "unit": "img/s",
+                      "meets_1p5x_step_rate": best >= target}))
+    if not args.keep:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,18 @@ class RecordFileDataset(Dataset):
         self._record = recordio.MXIndexedRecordIO(self.idx_file,
                                                   self.filename, "r")
 
+    def __getstate__(self):
+        # picklable for DataLoader spawn workers: each process re-opens
+        # its own reader (file offsets cannot be shared)
+        state = self.__dict__.copy()
+        state["_record"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._record = recordio.MXIndexedRecordIO(self.idx_file,
+                                                  self.filename, "r")
+
     def __getitem__(self, idx):
         return self._record.read_idx(self._record.keys[idx])
 

@@ -36,6 +36,12 @@ class Cast(HybridBlock):
         super(Cast, self).__init__()
         self._dtype = dtype
 
+    def forward(self, x):
+        # numpy passthrough keeps DataLoader worker pipelines jax-free
+        if isinstance(x, np.ndarray):
+            return x.astype(self._dtype)
+        return super(Cast, self).forward(x)
+
     def hybrid_forward(self, F, x):
         return F.cast(x, dtype=self._dtype)
 
@@ -43,6 +49,15 @@ class Cast(HybridBlock):
 class ToTensor(HybridBlock):
     """HWC uint8 [0,255] -> CHW float32 [0,1] via the _image_to_tensor op
     (handles NHWC batches too; rank is resolved at trace time)."""
+
+    def forward(self, x):
+        # numpy passthrough: per-image eager jax ops dominate process-
+        # pool DataLoader workers (benchmark/input_pipeline_bench.py)
+        if isinstance(x, np.ndarray):
+            out = x.astype(np.float32) / 255.0
+            axes = (2, 0, 1) if out.ndim == 3 else (0, 3, 1, 2)
+            return out.transpose(axes)
+        return super(ToTensor, self).forward(x)
 
     def hybrid_forward(self, F, x):
         return F.image.to_tensor(x)
@@ -56,6 +71,14 @@ class Normalize(HybridBlock):
         super(Normalize, self).__init__()
         self._mean = tuple(np.atleast_1d(np.asarray(mean, np.float32)))
         self._std = tuple(np.atleast_1d(np.asarray(std, np.float32)))
+        # per-image hot loop constants (numpy passthrough path)
+        self._mean_np = np.asarray(self._mean, np.float32).reshape(-1, 1, 1)
+        self._std_np = np.asarray(self._std, np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        if isinstance(x, np.ndarray):  # CHW or NCHW float
+            return (x.astype(np.float32) - self._mean_np) / self._std_np
+        return super(Normalize, self).forward(x)
 
     def hybrid_forward(self, F, x):
         return F.image.normalize(x, mean=self._mean, std=self._std)
@@ -126,16 +149,16 @@ class RandomResizedCrop(Block):
 class RandomFlipLeftRight(Block):
     def forward(self, x):
         if pyrandom.random() < 0.5:
-            arr = x.asnumpy()[:, ::-1]
-            return nd.array(arr.copy(), dtype=arr.dtype.name)
+            arr = _image._as_np(x)[:, ::-1]
+            return _image._like(x, np.ascontiguousarray(arr))
         return x
 
 
 class RandomFlipTopBottom(Block):
     def forward(self, x):
         if pyrandom.random() < 0.5:
-            arr = x.asnumpy()[::-1]
-            return nd.array(arr.copy(), dtype=arr.dtype.name)
+            arr = _image._as_np(x)[::-1]
+            return _image._like(x, np.ascontiguousarray(arr))
         return x
 
 

@@ -44,6 +44,18 @@ def _as_np(img):
     return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
 
 
+def _like(src, arr):
+    """Return `arr` in the container type of `src`: the public API is
+    NDArray-in/NDArray-out (reference image.py), but the iterator hot
+    loop feeds plain numpy through the augmenter chain — per-image
+    nd.array wrapping costs a device_put each and dominated the pipeline
+    (benchmark/input_pipeline_bench.py: ~390 img/s before, decode alone
+    is ~2,700 img/s on one core)."""
+    if isinstance(src, nd.NDArray):
+        return nd.array(arr, dtype=arr.dtype.name)
+    return arr
+
+
 def _imdecode_np(buf, flag=1, to_rgb=True):
     """cv2-only decode to an HWC uint8 numpy array — safe on worker
     threads (no jax dispatch)."""
@@ -76,7 +88,7 @@ def imresize(src, w, h, interp=1):
     img = cv2.resize(_as_np(src), (w, h), interpolation=int(interp))
     if img.ndim == 2:
         img = img[:, :, None]
-    return nd.array(img, dtype=img.dtype.name)
+    return _like(src, img)
 
 
 def scale_down(src_size, size):
@@ -102,15 +114,14 @@ def copyMakeBorder(src, top, bot, left, right, border_type=0, values=0):
     _require_cv2()
     img = cv2.copyMakeBorder(_as_np(src), top, bot, left, right,
                              border_type, value=values)
-    return nd.array(img, dtype=img.dtype.name)
+    return _like(src, img)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
     arr = _as_np(src)[y0:y0 + h, x0:x0 + w]
-    out = nd.array(arr, dtype=arr.dtype.name)
     if size is not None and (w, h) != size:
-        out = imresize(out, *size, interp=interp)
-    return out
+        arr = _as_np(imresize(arr, *size, interp=interp))
+    return _like(src, arr)
 
 
 def random_crop(src, size, interp=2):
@@ -151,12 +162,47 @@ def random_size_crop(src, size, area, ratio, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    src = src.astype("float32") if isinstance(src, nd.NDArray) \
-        else nd.array(src, dtype="float32")
-    out = src - nd.array(np.asarray(mean, np.float32))
+    arr = _as_np(src).astype(np.float32)
+    out = arr - np.asarray(_as_np(mean), np.float32)
     if std is not None:
-        out = out / nd.array(np.asarray(std, np.float32))
-    return out
+        out = out / np.asarray(_as_np(std), np.float32)
+    return _like(src, out)
+
+
+
+
+def _nchw_f32(batch_np):
+    """(B, H, W, C) host stack -> (B, C, H, W) float32 jax array via one
+    jitted XLA op. On an accelerator the uint8 stack transfers as-is
+    (4x fewer bytes than float) and the cast+layout change runs on
+    device; on CPU it is a single vectorized XLA kernel."""
+    import jax
+    import jax.numpy as jnp
+    from ._discover import ensure_backend
+    ensure_backend()  # may be the process's first jax touch (wedge guard)
+    global _nchw_jit
+    if _nchw_jit is None:
+        _nchw_jit = jax.jit(
+            lambda x: jnp.transpose(x.astype(jnp.float32), (0, 3, 1, 2)))
+    return _nchw_jit(np.ascontiguousarray(batch_np))
+
+
+_nchw_jit = None
+
+
+def _np_safe_aug(aug):
+    """True when an augmenter (and everything it wraps) is defined in
+    this module — such chains are type-preserving, so the iterator can
+    feed plain numpy through them (no per-image device_put). User
+    subclasses fall back to the NDArray contract."""
+    if type(aug).__module__ != __name__:
+        return False
+    children = []
+    for attr in ("ts", "aug_list"):
+        children.extend(getattr(aug, attr, ()) or ())
+    if getattr(aug, "augmenter", None) is not None:
+        children.append(aug.augmenter)
+    return all(_np_safe_aug(c) for c in children)
 
 
 # ----------------------------------------------------------- augmenters --
@@ -259,8 +305,8 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if pyrandom.random() < self.p:
-            arr = _as_np(src)[:, ::-1]
-            return nd.array(arr.copy(), dtype=arr.dtype.name)
+            # copy: downstream cv2 augs reject negative-stride views
+            return _like(src, np.ascontiguousarray(_as_np(src)[:, ::-1]))
         return src
 
 
@@ -270,8 +316,9 @@ class CastAug(Augmenter):
         self.typ = typ
 
     def __call__(self, src):
-        return src.astype(self.typ) if isinstance(src, nd.NDArray) \
-            else nd.array(_as_np(src), dtype=self.typ)
+        if isinstance(src, nd.NDArray):
+            return src.astype(self.typ)
+        return np.asarray(src).astype(self.typ)
 
 
 # ITU-R BT.601 luma weights, shared by the photometric jitter family
@@ -293,7 +340,7 @@ class _PhotometricJitterAug(Augmenter):
     def __call__(self, src):
         alpha = 1.0 + pyrandom.uniform(-self.jitter, self.jitter)
         arr = _as_np(src).astype(np.float32)
-        return nd.array(arr * alpha + self.reference(arr) * (1.0 - alpha))
+        return _like(src, arr * alpha + self.reference(arr) * (1.0 - alpha))
 
 
 class BrightnessJitterAug(_PhotometricJitterAug):
@@ -351,7 +398,7 @@ class HueJitterAug(Augmenter):
                        [0.0, w, u]])
         t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
         arr = _as_np(src).astype(np.float32)
-        return nd.array(np.dot(arr, t))
+        return _like(src, np.dot(arr, t).astype(np.float32))
 
 
 class ColorJitterAug(RandomOrderAug):
@@ -378,7 +425,7 @@ class LightingAug(Augmenter):
     def __call__(self, src):
         alpha = np.random.normal(0, self.alphastd, size=(3,))
         rgb = np.dot(self.eigvec * alpha, self.eigval)
-        return src + nd.array(rgb)
+        return _like(src, _as_np(src) + rgb.astype(np.float32))
 
 
 class ColorNormalizeAug(Augmenter):
@@ -402,8 +449,8 @@ class RandomGrayAug(Augmenter):
 
     def __call__(self, src):
         if pyrandom.random() < self.p:
-            return nd.array(np.dot(_as_np(src).astype(np.float32),
-                                   self._mat))
+            return _like(src, np.dot(_as_np(src).astype(np.float32),
+                                     self._mat))
         return src
 
 
@@ -475,6 +522,15 @@ class ImageIter(DataIter):
             self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
                                                      "r")
             self.seq = list(self.imgrec.keys)
+            if not self.seq:
+                # a wrong/missing .idx silently yields an empty epoch —
+                # fail loudly instead (tools/im2rec writes 'name.idx'
+                # next to 'name.rec'; MXIndexedRecordIO(w) with an
+                # explicit idx path may have put it elsewhere)
+                raise MXNetError(
+                    "record index %r has no entries — wrong or missing "
+                    ".idx for %r? (pass path_imgidx explicitly)"
+                    % (idx_path, path_imgrec))
         else:
             if path_imglist:
                 imglist = {}
@@ -573,27 +629,65 @@ class ImageIter(DataIter):
         label, s = self.next_sample()
         return label, _imdecode_np(s)
 
+    def _augs_np_fast(self):
+        flag = getattr(self, "_np_fast", None)
+        if flag is None:
+            flag = all(_np_safe_aug(a) for a in self.auglist)
+            self._np_fast = flag
+        return flag
+
     def _decoded_sample(self):
-        """Next (CHW float array, label row), from the rollover cache
-        first."""
+        """Next (HWC array, label row), from the rollover cache first.
+        Built-in augmenter chains run entirely in numpy; user augmenters
+        get the reference's NDArray-in/NDArray-out contract (at
+        per-image wrapping cost). Plain float32 CastAugs are deferred to
+        the batched device-side conversion (every built-in augmenter
+        upcasts internally as needed)."""
         if self._cache:
             return self._cache.pop(0)
         label, arr = self._next_raw_decoded()
-        img = nd.array(arr, dtype="uint8")
-        for aug in self.auglist:
-            img = aug(img)
-        return _as_np(img).transpose(2, 0, 1), label
+        if self._augs_np_fast():
+            img = arr
+            for aug in self.auglist:
+                if type(aug) is CastAug and aug.typ == "float32":
+                    continue
+                img = aug(img)
+        else:
+            img = nd.array(arr, dtype="uint8")
+            for aug in self.auglist:
+                img = aug(img)
+        return _as_np(img), label
 
     def _label_batch_shape(self):
         """Trailing label dims of one batch row — (label_width,) here;
         ImageDetIter overrides with its (max_objects, object_width)."""
         return (self.label_width,)
 
-    def next(self):
-        batch_data = np.zeros((self.batch_size,) + self.data_shape,
-                              np.float32)
+    def _assemble(self, rows, pad):
+        """Stack HWC rows and do ONE cast+NCHW transpose as a jitted XLA
+        op: the host contributes a contiguous uint8 (or float) stack and
+        the cast/layout change runs on the accelerator when one is
+        attached (and as one vectorized XLA op on CPU). This replaces
+        per-image float casts + strided CHW copies, which dominated the
+        pipeline (benchmark/input_pipeline_bench.py)."""
+        batch_np = np.stack([r[0] for r in rows])
         batch_label = np.zeros((self.batch_size,)
                                + self._label_batch_shape(), np.float32)
+        for i, (_, label) in enumerate(rows):
+            batch_label[i] = label
+        label_out = batch_label[:, 0] if batch_label.ndim == 2 \
+            and self.label_width == 1 else batch_label
+        arr = _nchw_f32(batch_np)
+        # label the context honestly: the jitted conversion leaves the
+        # batch on the default device (accelerator when present)
+        from .context import Context
+        dev = arr.devices().pop() if hasattr(arr, "devices") else None
+        ctx = Context("cpu", 0) if dev is None or dev.platform == "cpu" \
+            else Context("tpu", 0)
+        data = nd.NDArray(arr, ctx)
+        return DataBatch(data=[data], label=[nd.array(label_out)], pad=pad)
+
+    def next(self):
         rows = []
         try:
             while len(rows) < self.batch_size:
@@ -620,21 +714,8 @@ class ImageIter(DataIter):
                 # drop samples the pad-fill prefetched past the epoch
                 # boundary: leftovers would keep next() serving forever
                 self._pending = []
-            for i, (arr, label) in enumerate(rows):
-                batch_data[i] = arr
-                batch_label[i] = label
-            label_out = batch_label[:, 0] if batch_label.ndim == 2 \
-                and self.label_width == 1 else batch_label
-            return DataBatch(data=[nd.array(batch_data)],
-                             label=[nd.array(label_out)], pad=pad)
-        for i, (arr, label) in enumerate(rows):
-            batch_data[i] = arr
-            batch_label[i] = label
-        label_out = batch_label[:, 0] if batch_label.ndim == 2 \
-            and self.label_width == 1 else batch_label
-        return DataBatch(data=[nd.array(batch_data)],
-                         label=[nd.array(label_out)],
-                         pad=self.batch_size - len(rows))
+            return self._assemble(rows, pad)
+        return self._assemble(rows, pad=self.batch_size - len(rows))
 
 
 # ---------------------------------------------------------- detection --
@@ -683,7 +764,7 @@ class DetHorizontalFlipAug(DetAugmenter):
 
     def __call__(self, src, label):
         if pyrandom.random() < self.p:
-            src = nd.array(_as_np(src)[:, ::-1].copy())
+            src = _like(src, np.ascontiguousarray(_as_np(src)[:, ::-1]))
             out = label.copy()
             valid = out[:, 0] >= 0
             xmin = out[valid, 1].copy()
@@ -765,7 +846,7 @@ class DetRandomCropAug(DetAugmenter):
             px0, py0 = int(x0 * w), int(y0 * h)
             px1, py1 = int(math.ceil(crop[2] * w)), \
                 int(math.ceil(crop[3] * h))
-            return nd.array(arr[py0:py1, px0:px1].copy()), out
+            return _like(src, arr[py0:py1, px0:px1].copy()), out
         return src, label
 
 
@@ -802,7 +883,7 @@ class DetRandomPadAug(DetAugmenter):
             out[valid, 3] = (out[valid, 3] * w + x0) / nw
             out[valid, 2] = (out[valid, 2] * h + y0) / nh
             out[valid, 4] = (out[valid, 4] * h + y0) / nh
-            return nd.array(canvas), out
+            return _like(src, canvas), out
         return src, label
 
 
@@ -930,14 +1011,14 @@ class ImageDetIter(ImageIter):
         if self._cache:
             return self._cache.pop(0)
         label, arr = self._next_raw_decoded()
-        img = nd.array(arr, dtype="uint8")
+        img = arr if self._augs_np_fast() else nd.array(arr, dtype="uint8")
         parsed = self._parse_det_label(label)
         padded = np.full((self._max_objects, self._object_width), -1.0,
                          np.float32)
         padded[:len(parsed)] = parsed
         for aug in self.auglist:
             img, padded = aug(img, padded)
-        return _as_np(img).transpose(2, 0, 1), padded
+        return _as_np(img), padded
 
     def reshape(self, data_shape=None, label_shape=None):
         """Change batch shapes between bindings (reference reshape)."""
