@@ -1,0 +1,93 @@
+"""Flash-attention kernel vs dense jnp attention on chip.
+
+Run on the real TPU (no JAX_PLATFORMS override). At 8k-32k sequence the
+dense path materialises the [T, T] score matrix (64M-1G floats per
+batch*head) while the Pallas kernel streams K/V blocks through VMEM —
+this measures both the speed and the feasibility boundary (dense OOMs
+where flash keeps going).
+
+Prints one JSON line per (seq, path): fwd ms, fwd+bwd ms, TFLOP/s.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    from mxnet_tpu._discover import ensure_backend
+    ensure_backend()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels.flash_attention import flash_attention
+
+    B, H, D = 4, 8, 128
+    causal = True
+
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(D)
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", a, v.astype(a.dtype)) \
+            .astype(q.dtype)
+
+    def run(fn, q, k, v, steps=10):
+        out = fn(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(steps):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.time() - t0) / steps
+
+    def run_grad(fn, q, k, v, steps=10):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(steps):
+            out = g(q, k, v)
+        jax.block_until_ready(out)
+        return (time.time() - t0) / steps
+
+    for T in (8192, 16384, 32768):
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32),
+                        jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32),
+                        jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32),
+                        jnp.bfloat16)
+        # causal attention FLOPs: ~2 * 2 * B*H*T^2/2*D each for QK^T and
+        # PV = 2*B*H*T^2*D total (fwd)
+        flops = 2.0 * B * H * T * T * D
+
+        for name, fn in (("flash", lambda q, k, v: flash_attention(
+                q, k, v, causal=causal)), ("dense", jax.jit(dense))):
+            try:
+                fwd = run(fn, q, k, v)
+                fb = run_grad(fn, q, k, v)
+                print(json.dumps({
+                    "metric": "attn_%s_T%d" % (name, T),
+                    "fwd_ms": round(fwd * 1e3, 2),
+                    "fwd_bwd_ms": round(fb * 1e3, 2),
+                    "fwd_tflops": round(flops / fwd / 1e12, 2),
+                    "unit": "ms"}))
+            except Exception as e:
+                print(json.dumps({
+                    "metric": "attn_%s_T%d" % (name, T),
+                    "error": type(e).__name__,
+                    "detail": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,26 @@
-"""Blocked (flash) attention as a Pallas TPU kernel.
+"""Blocked (flash) attention as a Pallas TPU kernel — streamed K/V and
+a custom flash backward.
 
-Softmax(QK^T)V without materialising the [Tq, Tk] score matrix in HBM:
-each grid step owns one query block in VMEM and streams key/value
-blocks, maintaining the online-softmax running max/denominator. This is
-the kernel counterpart of parallel/ring.py's jnp-level blockwise
-attention — the ring layer rotates K/V shards across devices, and this
-kernel is the dense per-device block compute.
+Softmax(QK^T)V without materialising the [Tq, Tk] score matrix in HBM.
+Forward: grid (batch*heads, q_blocks, k_blocks); each step stages one
+[block_q, D] query block and one [block_k, D] key/value block into VMEM
+through BlockSpec index maps (K/V live in HBM and STREAM block by block
+— nothing holds the full sequence in VMEM, so sequence length is bounded
+by HBM, not VMEM). The online-softmax accumulator (o, m, l) lives in
+VMEM scratch and is carried across the k axis, which is the innermost,
+sequential ("arbitrary") grid dimension.
 
-Layout: the (batch, head) pair is the leading grid axis, query blocks
-the second; K/V for the pair sit in VMEM whole (fine up to a few
-thousand keys at typical head dims; the ring layer keeps per-device
-sequence shards in that regime).
+Backward: the standard flash decomposition with recompute —
+  delta = rowsum(dO * O)                      (jnp, fused by XLA)
+  dQ kernel: grid (bh, q_blocks, k_blocks), accumulates over k
+  dK/dV kernel: grid (bh, k_blocks, q_blocks), accumulates over q
+using the saved per-row logsumexp instead of the (m, l) pair, so only
+[T]-sized statistics are saved — activation memory is O(T), not O(T^2).
+
+This is the dense per-device block compute under parallel/ring.py's
+sequence-parallel ring; reference counterpart: the fused attention in
+src/operator/contrib/transformer.cu (MXNet's interleaved_matmul_*
+ops), re-thought for the MXU/VMEM hierarchy instead of warp shuffles.
 """
 
 import functools
@@ -18,74 +28,399 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                 seq_k):
-    # q_ref: [block_q, D]; k_ref/v_ref: [Tk, D]; o_ref: [block_q, D]
-    block_q, head_dim = q_ref.shape
-    q = q_ref[...].astype(jnp.float32) * scale
-    q_start = pl.program_id(1) * block_q
+def _causal_mask(s, q_start, k_start, block_q, block_k):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-    def body(kb, carry):
-        o, m, l = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+
+# ------------------------------------------------------------- forward --
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
+                *, causal, scale, num_kb):
+    block_q, head_dim = q_ref.shape
+    block_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # blocks strictly above the causal diagonal contribute nothing
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=1))
-        alpha = jnp.exp(m - m_new)
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = alpha * l + p.sum(axis=1)
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        o_new = alpha[:, None] * o + pv
-        return o_new, m_new, l_new
+        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        m_sc[...] = m_new
 
-    o0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    num_kb = seq_k // block_k
-    if causal:
-        # blocks strictly above the diagonal contribute nothing; bound
-        # the stream at the query block's last row
-        last = (q_start + block_q + block_k - 1) // block_k
-        num_kb = jnp.minimum(num_kb, last)
-    o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
-    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == num_kb - 1)
+    def _flush():
+        l = jnp.maximum(l_sc[...], 1e-30)
+        o_ref[...] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = m_sc[...] + jnp.log(l)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q",
-                                             "block_k", "interpret"))
-def _flash_bh(q, k, v, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, T, D] with T divisible by the block sizes."""
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    """q: [BH, Tq, D], k/v: [BH, Tk, D] -> (o [BH, Tq, D], lse [BH, Tq])."""
     bh, seq_q, head_dim = q.shape
     seq_k = k.shape[1]
     scale = 1.0 / (head_dim ** 0.5)
-    kernel = functools.partial(_attn_kernel, block_k=block_k,
-                               causal=causal, scale=scale, seq_k=seq_k)
-    return pl.pallas_call(
+    num_kb = seq_k // block_k
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               num_kb=num_kb)
+    o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, seq_q // block_q),
+        grid=(bh, seq_q // block_q, num_kb),
         in_specs=[
             pl.BlockSpec((None, block_q, head_dim),
-                         lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((None, seq_k, head_dim), lambda b, qi: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k, head_dim), lambda b, qi: (b, 0, 0)),
+                         lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, head_dim),
-                               lambda b, qi: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            # (o, m, l) online-softmax carry, persistent across the
+            # sequential k axis
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
+    return o, lse
+
+
+# ----------------------------------------------- ring-carry variant -----
+def _carry_kernel(off_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref, li_ref,
+                  o_ref, m_ref, l_ref, acc_sc, m_sc, l_sc,
+                  *, causal, scale, num_kb):
+    """One ring step: fold this device's current K/V shard into the
+    (o, m, l) online-softmax carry. Offsets of the q and kv shards in
+    the GLOBAL sequence arrive as scalars (SMEM) because they depend on
+    the traced ring position."""
+    block_q, head_dim = q_ref.shape
+    block_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(ki == 0)
+    def _load_carry():
+        acc_sc[...] = oi_ref[...].astype(jnp.float32)
+        m_sc[...] = mi_ref[...].astype(jnp.float32)
+        l_sc[...] = li_ref[...].astype(jnp.float32)
+
+    q_start = q_off + qi * block_q
+    k_start = kv_off + ki * block_k
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        m_sc[...] = m_new
+
+    @pl.when(ki == num_kb - 1)
+    def _flush():
+        o_ref[...] = acc_sc[...]
+        m_ref[...] = m_sc[...]
+        l_ref[...] = l_sc[...]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "vma"))
+def flash_carry_block(q, k, v, o, m, l, q_offset, kv_offset, causal,
+                      block_q=128, block_k=128, interpret=None,
+                      vma=None):
+    """UNNORMALIZED flash update for ring attention: q [BH, Tq, D],
+    k/v [BH, Tk, D], carry o [BH, Tq, D] (f32), m/l [BH, Tq] (f32);
+    offsets are traced int32 scalars (global positions of element 0).
+    Returns the updated (o, m, l). The caller normalizes o / l at the
+    end of the ring (parallel/ring.py)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            "ring shard lengths (%d, %d) must divide by blocks (%d, %d)"
+            % (seq_q, seq_k, block_q, block_k))
+    scale = 1.0 / (head_dim ** 0.5)
+    num_kb = seq_k // block_k
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+
+    def _struct(shape):
+        # under a partially-manual shard_map the checker needs to know
+        # which mesh axes the kernel outputs vary over (vma)
+        if vma:
+            try:
+                return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                            vma=frozenset(vma))
+            except TypeError:
+                pass
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    kernel = functools.partial(_carry_kernel, causal=causal, scale=scale,
+                               num_kb=num_kb)
+    grid = (bh, seq_q // block_q, num_kb)
+    qspec = pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, qi, ki: (b, qi, 0))
+    kspec = pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, qi, ki: (b, ki, 0))
+    rspec = pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets, whole
+            qspec, kspec, kspec, qspec, rspec, rspec,
+        ],
+        out_specs=[qspec, rspec, rspec],
+        out_shape=[_struct(o.shape), _struct(m.shape), _struct(l.shape)],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offsets, q, k, v, o, m, l)
+
+
+# ------------------------------------------------------------ backward --
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_sc, *, causal, scale, num_kb):
+    block_q, head_dim = q_ref.shape
+    block_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse_ref[...][:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[...][:, None])
+        dq_sc[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _flush():
+        dq_ref[...] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, causal, scale, num_qb):
+    block_k, head_dim = k_ref.shape
+    block_q = q_ref.shape[0]
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # for this k block, q blocks that end before the diagonal are dead
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse_ref[...][:, None])         # [bq, bk]
+        dv_sc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[...][:, None])        # [bq, bk]
+        # q is already scaled by 1/sqrt(D) above, which supplies the
+        # single scale factor of dK = scale * dS^T Q
+        dk_sc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _flush():
+        dk_ref[...] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    scale = 1.0 / (head_dim ** 0.5)
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+    # delta_i = sum_d dO_i O_i — tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          num_kb=num_kb),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, head_dim),
+                               lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          num_qb=num_qb),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------- custom vjp ---
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bh(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_bh_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bh_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, block_q,
+                            block_k, interpret)
+    return dq, dk, dv
+
+
+_flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
@@ -93,7 +428,9 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
     """Multi-head attention over [B, T, H, D] tensors.
 
     Equivalent to softmax(q k^T / sqrt(D)) v computed blockwise in
-    VMEM. Block sizes clamp to the sequence lengths; sequences must be
+    VMEM with K/V streamed from HBM (sequence length is HBM-bounded).
+    Differentiable via the flash backward (recompute + saved logsumexp).
+    Block sizes clamp to the sequence lengths; sequences must be
     divisible by the (clamped) blocks. `interpret` defaults to True off
     TPU so the same code runs everywhere.
     """

@@ -50,9 +50,10 @@ class TransformerConfig:
     pp_axis: str = None         # set to 'pp' to pipeline the layer stack
     num_microbatches: int = 0   # 0 = one per pipeline stage
     use_ring_attention: bool = True
-    # single-device attention through the Pallas flash kernel
-    # (kernels/flash_attention.py) instead of the dense jnp path;
-    # sequences must divide the kernel's blocks
+    # attention through the Pallas flash kernel (kernels/
+    # flash_attention.py): single-device dense path AND the per-shard
+    # block compute inside ring attention; sequences (or ring shards)
+    # must divide the kernel's blocks
     use_flash_kernel: bool = False
     # activation recompute: checkpoint each transformer layer so backward
     # rematerializes its activations instead of storing them (the
@@ -146,11 +147,17 @@ def _attention(x, p, cfg, mesh, manual_sp=False):
     k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
     v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
     if manual_sp:
-        # already inside a shard_map manual over sp (pipeline stage body)
-        o = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+        # already inside a shard_map manual over sp (pipeline stage
+        # body). The Pallas path only engages on real TPU: interpret-
+        # mode pallas cannot run under this partially-manual shard_map
+        # (see ring_attention_sharded); numerics are identical either way
+        o = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True,
+                           use_flash_kernel=cfg.use_flash_kernel
+                           and jax.default_backend() == "tpu")
     elif mesh is not None and cfg.use_ring_attention and cfg.sp_axis:
         o = ring_attention_sharded(q, k, v, mesh, axis_name=cfg.sp_axis,
-                                   causal=True)
+                                   causal=True,
+                                   use_flash_kernel=cfg.use_flash_kernel)
     elif cfg.use_flash_kernel:
         from ..kernels import flash_attention
         # flash_attention clamps its default blocks to the sequence

@@ -28,13 +28,23 @@ _NEG_INF = -1e30
 
 
 def local_attention_block(q, k, v, q_offset, kv_offset, causal, scale,
-                          carry=None):
+                          carry=None, use_flash_kernel=False, vma=None):
     """One flash-attention block update.
 
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D]. Offsets are the global
     positions of element 0 of the q/kv blocks (for causal masking).
     carry = (o, m, l) running output/max/denominator, or None to start.
+
+    use_flash_kernel routes the block through the Pallas streamed
+    kernel (kernels/flash_attention.flash_carry_block): the [Tq, Tk]
+    score matrix then never exists in HBM, so per-device shards are
+    bounded by HBM capacity rather than the score-matrix footprint.
+    Requires shard lengths divisible by the kernel blocks (clamped to
+    the shard).
     """
+    if use_flash_kernel:
+        return _flash_block(q, k, v, q_offset, kv_offset, causal, carry,
+                            vma)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -59,7 +69,42 @@ def local_attention_block(q, k, v, q_offset, kv_offset, causal, scale,
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=True):
+def _flash_block(q, k, v, q_offset, kv_offset, causal, carry, vma=None):
+    """local_attention_block via the Pallas carry kernel; carries the
+    same (o [B,Tq,H,D] f32, m/l [B,H,Tq] f32) layout as the jnp path."""
+    from ..kernels.flash_attention import flash_carry_block
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        B * x.shape[2], x.shape[1], D)
+    if carry is None:
+        o = jnp.zeros((B * H, Tq, D), jnp.float32)
+        m = jnp.full((B * H, Tq), _NEG_INF, jnp.float32)
+        l = jnp.zeros((B * H, Tq), jnp.float32)
+        if vma:
+            # fresh accumulators are mesh-invariant while q/k/v are
+            # sp-varying; pallas + the vma checker need them to agree
+            def _v(x):
+                try:
+                    return jax.lax.pcast(x, tuple(vma), to="varying")
+                except (AttributeError, TypeError, ValueError):
+                    return x
+            o, m, l = _v(o), _v(m), _v(l)
+    else:
+        o_c, m_c, l_c = carry
+        o = to_bh(o_c)
+        m = m_c.reshape(B * H, Tq)
+        l = l_c.reshape(B * H, Tq)
+    o, m, l = flash_carry_block(to_bh(q), to_bh(k), to_bh(v), o, m, l,
+                                q_offset, kv_offset, causal,
+                                vma=None if vma is None
+                                else tuple(sorted(vma)))
+    o_out = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    return o_out, m.reshape(B, H, Tq), l.reshape(B, H, Tq)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=True,
+                   use_flash_kernel=False):
     """Blockwise ring attention. Must run inside shard_map (or pmap) with
     the sequence dimension sharded over `axis_name`.
 
@@ -81,7 +126,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=True):
         kv_idx = (kv_idx - 1) % n
         o, m, l = local_attention_block(
             q, k_blk, v_blk, q_offset, kv_idx * T, causal, scale,
-            carry=(o, m, l))
+            carry=(o, m, l), use_flash_kernel=use_flash_kernel,
+            vma=(axis_name,))
         return (o, m, l, k_blk, v_blk, kv_idx)
 
     B, T, H, D = q.shape
@@ -97,7 +143,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=True):
     # own block first (no permute), then n-1 rotate+accumulate rounds —
     # exactly n-1 collective-permutes per call
     o0, m0, l0 = local_attention_block(q, k, v, q_offset, idx * T, causal,
-                                       scale, carry=None)
+                                       scale, carry=None,
+                                       use_flash_kernel=use_flash_kernel,
+                                       vma=(axis_name,))
     init = (_varying(o0), _varying(m0), _varying(l0), k, v, idx)
     o, m, l, _, _, _ = jax.lax.fori_loop(0, n - 1, body, init)
     # fully-masked rows (can't happen for causal same-length rings, but
@@ -107,14 +155,37 @@ def ring_attention(q, k, v, axis_name="sp", causal=True):
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
-                           batch_axis=None):
+                           batch_axis=None, use_flash_kernel=False):
     """Convenience wrapper: apply ring attention to GLOBAL arrays
     [B, T, H, D] whose T dim is (or will be) sharded over `axis_name`.
     Usable inside jit — shard_map is restricted to the sp (and optional
     batch) mesh axes, all other mesh axes stay auto-sharded."""
     spec = P(batch_axis, axis_name, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal)
     manual = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names=set(manual))(q, k, v)
+    kw = {}
+    if use_flash_kernel:
+        interpret = jax.default_backend() != "tpu"
+        partial_manual = bool(set(mesh.axis_names) - set(manual))
+        if interpret and partial_manual:
+            # interpret-mode pallas (CPU testing) cannot run under a
+            # vma-checked partially-manual shard_map (jax interpreter
+            # lowers block fetches to dynamic_slice with mesh-invariant
+            # indices). On real TPU the compiled kernel carries vma
+            # annotations and this limitation does not apply; on CPU
+            # keep the numerics via the jnp blockwise path.
+            use_flash_kernel = False
+        elif interpret:
+            # fully-manual mesh: disable the checker instead (outputs
+            # are per-shard by construction)
+            kw["check_vma"] = False
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal,
+                           use_flash_kernel=use_flash_kernel)
+    try:
+        smapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                                out_specs=spec, axis_names=set(manual),
+                                **kw)
+    except TypeError:  # older jax without check_vma
+        smapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                                out_specs=spec, axis_names=set(manual))
+    return smapped(q, k, v)

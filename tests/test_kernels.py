@@ -90,3 +90,105 @@ def test_pallas_module_consumer():
     out = kernel.launch([x, y])
     np.testing.assert_allclose(np.asarray(out),
                                2.0 * np.arange(8.0) + 1.0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_backward_matches_dense(causal):
+    """The custom flash backward (recompute + saved logsumexp) must
+    reproduce autodiff-through-dense-attention gradients."""
+    rng = np.random.RandomState(3)
+    B, T, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_dense(q, k, v):
+        out = dense(q, k, v)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_long_sequence_streams():
+    """8k sequence with 128-blocks: K/V stream per block (whole-sequence
+    VMEM residency would be impossible on real hardware at this size
+    times batch*heads; here we check numerics at length)."""
+    rng = np.random.RandomState(4)
+    B, T, H, D = 1, 8192, 1, 16
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.5)
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.5)
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True, block_q=256, block_k=256)
+    # spot-check rows against the dense computation (full dense at 8k is
+    # 64M scores — compute only selected query rows)
+    rows = [0, 1, 511, 4096, 8191]
+    qs = np.asarray(q)[0, rows, 0]        # [R, D]
+    s = qs @ np.asarray(k)[0, :, 0].T / np.sqrt(D)
+    for ri, r in enumerate(rows):
+        srow = s[ri, :r + 1]
+        p = np.exp(srow - srow.max())
+        p /= p.sum()
+        expect = p @ np.asarray(v)[0, :r + 1, 0]
+        np.testing.assert_allclose(np.asarray(out)[0, r, 0], expect,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_flash_kernel_matches_jnp_path():
+    """ring_attention(use_flash_kernel=True) — the Pallas carry kernel
+    under shard_map over the 8-device sp ring — must match the jnp
+    blockwise path and dense attention."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import ring as R
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.RandomState(7)
+    B, T, H, D = 2, 256, 2, 16      # 32 per shard
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    out_jnp = R.ring_attention_sharded(q, k, v, mesh, causal=True)
+    out_flash = R.ring_attention_sharded(q, k, v, mesh, causal=True,
+                                         use_flash_kernel=True)
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_jnp), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_transformer_ring_plus_flash_kernel():
+    """cfg.use_flash_kernel under ring attention: model forward matches
+    the jnp ring path on the 8-device mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.models import transformer as T
+
+    mesh = make_mesh({"dp": 1, "tp": 1, "sp": 8, "ep": 1})
+    kw = dict(vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+              d_ff=64, max_len=64)
+    cfg_jnp = T.TransformerConfig(use_ring_attention=True, **kw)
+    cfg_flash = T.TransformerConfig(use_ring_attention=True,
+                                    use_flash_kernel=True, **kw)
+    params = T.shard_params(T.init_params(cfg_jnp, seed=0), cfg_jnp, mesh)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 64)),
+                    jnp.int32), NamedSharding(mesh, P(None, None)))
+    l0 = float(T.loss_fn(params, tokens, cfg_jnp, mesh))
+    l1 = float(T.loss_fn(params, tokens, cfg_flash, mesh))
+    assert abs(l0 - l1) < 2e-4, (l0, l1)
