@@ -131,6 +131,88 @@ def run_benchmarks(op_names=None, runs=10, warmup=2):
     return results
 
 
+def dispatch_latency(iters=3000):
+    """us/op small-op dispatch latency: where does an eager call's time
+    go (SURVEY §3.1 — per-op dispatch is the reason CachedOp exists)?
+
+    Ladder: raw jnp (jax's own dispatch floor) -> nd eager
+    (imperative_invoke) -> nd eager under autograd.record (tape) ->
+    CachedOp(add graph) -> bound executor forward. All on (4, 4)
+    float32 so compute is negligible."""
+    import time
+    from mxnet_tpu._discover import ensure_backend
+    ensure_backend()  # wedge guard before the first raw jnp touch
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    a_j = jnp.ones((4, 4)); b_j = jnp.ones((4, 4))
+    a = mx.nd.ones((4, 4)); b = mx.nd.ones((4, 4))
+
+    def timeit(fn, sync):
+        fn()  # warm (compile)
+        sync()
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn()
+        sync()
+        return (time.time() - t0) / iters * 1e6
+
+    results = {}
+    jadd = jax.jit(lambda x, y: x + y)
+    results["raw_jnp_jit_add"] = timeit(
+        lambda: jadd(a_j, b_j), lambda: jadd(a_j, b_j).block_until_ready())
+    results["nd_eager_add"] = timeit(
+        lambda: a + b, lambda: (a + b).wait_to_read())
+
+    a.attach_grad()
+    def rec():
+        with autograd.record():
+            return a + b
+    results["nd_eager_add_recorded"] = timeit(
+        rec, lambda: rec().wait_to_read())
+
+    sa = mx.sym.Variable("a"); sb = mx.sym.Variable("b")
+    graph = sa + sb
+    cop = mx.nd.CachedOp(graph) if hasattr(mx.nd, "CachedOp") else None
+    if cop is None:
+        from mxnet_tpu.cached_op import CachedOp
+        cop = CachedOp(graph)
+    results["cached_op_add"] = timeit(
+        lambda: cop(a, b)[0], lambda: cop(a, b)[0].wait_to_read())
+
+    def cop_rec():
+        with autograd.record():
+            return cop(a, b)[0]
+    results["cached_op_add_recorded"] = timeit(
+        cop_rec, lambda: cop_rec().wait_to_read())
+
+    ex = graph.bind(mx.cpu(), {"a": a, "b": b})
+    results["executor_forward_add"] = timeit(
+        lambda: ex.forward()[0], lambda: ex.forward()[0].wait_to_read())
+
+    # a 20-op chain through CachedOp vs eager: amortization the reference
+    # gets from graph replay (cached_op.cc DynamicForward)
+    x = sa
+    for _ in range(20):
+        x = x + sb
+    chain = x
+    cop20 = type(cop)(chain)
+    results["eager_chain20"] = timeit(
+        lambda: sum20(a, b), lambda: sum20(a, b).wait_to_read())
+    results["cached_op_chain20"] = timeit(
+        lambda: cop20(a, b)[0], lambda: cop20(a, b)[0].wait_to_read())
+    return results
+
+
+def sum20(a, b):
+    x = a
+    for _ in range(20):
+        x = x + b
+    return x
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="operator micro-benchmarks",
@@ -141,7 +223,16 @@ def main():
     parser.add_argument("--warmup", type=int, default=2)
     parser.add_argument("--output-format", choices=("table", "json"),
                         default="table")
+    parser.add_argument("--dispatch", action="store_true",
+                        help="measure small-op dispatch latency (us/op)")
     args = parser.parse_args()
+
+    if args.dispatch:
+        res = dispatch_latency()
+        for k, v in res.items():
+            print(json.dumps({"metric": "dispatch_%s" % k,
+                              "value": round(v, 1), "unit": "us/op"}))
+        return
 
     names = [n for n in args.ops.split(",") if n] or None
     results = run_benchmarks(names, args.runs, args.warmup)

@@ -22,6 +22,17 @@ from . import random as _random
 from .base import MXNetError
 from .executor import apply_mirror, build_graph_fn, mirror_enabled
 
+# fixed key fed to RNG-free graphs (never consumed; avoids a per-call
+# host-side split)
+_ZERO_KEY = None
+
+
+def _zero_key():
+    global _ZERO_KEY
+    if _ZERO_KEY is None:
+        _ZERO_KEY = jax.random.PRNGKey(0)
+    return _ZERO_KEY
+
 
 class CachedOp:
     """Compiled callable over a Symbol.
@@ -34,6 +45,7 @@ class CachedOp:
     """
 
     def __init__(self, sym, flags=()):
+        from . import ops as _ops
         self._sym = sym
         self._flags = dict(flags) if flags else {}
         self._arg_names = sym.list_arguments()
@@ -41,6 +53,12 @@ class CachedOp:
         self._input_names = sym.list_inputs()
         self._num_outputs = len(sym.list_outputs())
         self._fns = {}  # (is_train, diff_names) -> jitted fn
+        # RNG-free graphs (the common case) skip the per-call host-side
+        # key split — a measurable slice of per-call latency
+        # (benchmark/opperf.py --dispatch)
+        self._needs_rng = any(
+            _ops.get(n.op).stateful_rng
+            for n in sym._active_nodes() if not n.is_var())
 
     @property
     def symbol(self):
@@ -64,7 +82,17 @@ class CachedOp:
             # remat the traced graph so backward recomputes activations
             # under the mirror policy instead of storing them
             pure = apply_mirror(pure, mirror_enabled(self._flags))
-            fn = jax.jit(pure)
+
+            def fwd_res(diff_list, rest, aux, rng_key):
+                # compile forward + residuals ONCE per signature; the
+                # vjp closure is a jax.tree_util.Partial and crosses the
+                # jit boundary (executor.fwd_res_fn does the same) — a
+                # per-call jax.vjp would re-trace the whole graph
+                outs_aux, vjp = jax.vjp(
+                    lambda d: pure(d, rest, aux, rng_key), diff_list,
+                    has_aux=False)
+                return outs_aux, vjp
+            fn = jax.jit(fwd_res)
         else:
             def pure(args, aux, rng_key):
                 outs, aux_up = graph_fn(args, aux, rng_key)
@@ -84,7 +112,7 @@ class CachedOp:
         by_name = dict(zip(self._input_names, inputs))
         args = {n: by_name[n]._data for n in self._arg_names}
         aux = {n: by_name[n]._data for n in self._aux_names}
-        rng_key = _random.next_key()
+        rng_key = _random.next_key() if self._needs_rng else _zero_key()
         is_train = autograd.is_training()
         recording = autograd.is_recording()
 
@@ -97,14 +125,17 @@ class CachedOp:
         if diff_names:
             fn = self._get_fn(is_train, diff_names)
             diff_list = [args[n] for n in diff_names]
-            outs, vjp_fn, aux_up = jax.vjp(
-                lambda d: fn(d, args, aux, rng_key), diff_list, has_aux=True)
+            (outs, aux_up), vjp_fn = fn(diff_list, args, aux, rng_key)
 
             diff_nds = [by_name[n] for n in diff_names]
 
             def tape_vjp(cts):
-                cts_t = cts if isinstance(cts, tuple) else (cts,)
-                (grads,) = vjp_fn(cts_t)
+                cts_t = tuple(cts) if isinstance(cts, (tuple, list)) \
+                    else (cts,)
+                # cotangent structure matches pure's (outs, aux_up); aux
+                # updates get zero cotangents
+                aux_ct = jax.tree.map(jnp.zeros_like, aux_up)
+                (grads,) = vjp_fn((cts_t, aux_ct))
                 return grads
 
             node = autograd.TapeNode(

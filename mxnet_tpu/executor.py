@@ -86,15 +86,11 @@ def node_eval_fn(node, for_inference=False):
     """Pure fn(*input_arrays) for one graph node (used by eval_shape)."""
     op = ops.get(node.op)
     attrs = _clean_attrs(node.attrs)
-    sig = ops.op_signature(node.op)
-    if "is_train" in sig.parameters:
+    has_varargs, param_names = ops.op_dispatch_meta(op)
+    if "is_train" in param_names:
         attrs.setdefault("is_train", False)
-    if op.stateful_rng and "rng_key" in sig.parameters:
+    if op.stateful_rng and "rng_key" in param_names:
         attrs.setdefault("rng_key", jax.random.PRNGKey(0))
-
-    import inspect
-    has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
-                      for p in sig.parameters.values())
     in_names = node.attrs.get("__input_names__")
 
     def fn(*arrays):
@@ -104,7 +100,7 @@ def node_eval_fn(node, for_inference=False):
         if in_names:
             call.update({n: a for n, a in zip(in_names, arrays)})
         else:
-            pnames = [p for p in sig.parameters if p not in attrs]
+            pnames = [p for p in param_names if p not in attrs]
             call.update({n: a for n, a in zip(pnames, arrays)})
         return op.fn(**call)
 
@@ -146,19 +142,16 @@ def build_graph_fn(symbol, is_train, node_device=None):
                 continue
             op = ops.get(node.op)
             attrs = _clean_attrs(node.attrs)
-            sig = ops.op_signature(node.op)
-            if "is_train" in sig.parameters:
+            has_varargs, param_names = ops.op_dispatch_meta(op)
+            if "is_train" in param_names:
                 attrs["is_train"] = is_train
-            if op.stateful_rng and "rng_key" in sig.parameters:
+            if op.stateful_rng and "rng_key" in param_names:
                 key, sub = jax.random.split(key)
                 attrs["rng_key"] = sub
             ins = []
             for s, oi in node.inputs:
                 src = s._nodes[s._outputs[0][0]]
                 ins.append(_place(node, vals[(id(src), oi)]))
-            import inspect
-            has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
-                              for p in sig.parameters.values())
             in_names = node.attrs.get("__input_names__")
             if has_varargs:
                 out = op.fn(*ins, **attrs)
@@ -167,7 +160,7 @@ def build_graph_fn(symbol, is_train, node_device=None):
                 if in_names:
                     call.update({n: a for n, a in zip(in_names, ins)})
                 else:
-                    pnames = [p for p in sig.parameters if p not in attrs]
+                    pnames = [p for p in param_names if p not in attrs]
                     call.update({n: a for n, a in zip(pnames, ins)})
                 out = op.fn(**call)
 
@@ -237,6 +230,12 @@ class Executor:
 
         self.outputs = []
         self._saved_vjp = None
+        # RNG-free graphs skip the per-forward host-side key split
+        # (benchmark/opperf.py --dispatch)
+        self._needs_rng = any(
+            ops.get(n.op).stateful_rng
+            for n in symbol._active_nodes() if not n.is_var())
+        self._zero_key = None
 
         node_device = None
         if self._group2ctx:
@@ -325,7 +324,12 @@ class Executor:
                     % (k, sorted(self.arg_dict)))
         arg_arrays = {k: v._data for k, v in self.arg_dict.items()}
         aux_arrays = {k: v._data for k, v in self.aux_dict.items()}
-        key = rnd.next_key()
+        if self._needs_rng:
+            key = rnd.next_key()
+        else:
+            if self._zero_key is None:
+                self._zero_key = jax.random.PRNGKey(0)
+            key = self._zero_key
         if is_train:
             diff = [arg_arrays[n] for n in self._diff_args]
             rest = {k: v for k, v in arg_arrays.items()}

@@ -55,6 +55,11 @@ class Op:
         self.mutate_inputs = mutate_inputs if callable(mutate_inputs) \
             else tuple(mutate_inputs)
         self._sig = None
+        # dispatch-time caches (filled on first use; see op_signature /
+        # op_dispatch_meta): re-deriving these with inspect on every
+        # eager call measurably costs in the small-op hot loop
+        self._has_varargs = None
+        self._param_names = None
 
     def make_fn(self, attrs):
         """Close the op over static attrs -> pure fn(*arrays)."""
@@ -106,6 +111,21 @@ def op_signature(name):
     if op._sig is None:
         op._sig = inspect.signature(op.fn)
     return op._sig
+
+
+def op_dispatch_meta(op):
+    """(has_varargs, param_names) cached on the Op — the eager dispatch
+    hot loop must not re-walk inspect.Parameter objects per call
+    (reference concern: SURVEY §3.1 per-op dispatch latency)."""
+    if op._has_varargs is None:
+        if op._sig is None:
+            op._sig = inspect.signature(op.fn)
+        params = op._sig.parameters
+        op._has_varargs = any(
+            p.kind == inspect.Parameter.VAR_POSITIONAL
+            for p in params.values())
+        op._param_names = tuple(params)
+    return op._has_varargs, op._param_names
 
 
 # Import op definition modules so the registry is populated at import time
