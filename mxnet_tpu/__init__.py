@@ -45,6 +45,8 @@ ndarray.row_sparse_array = sparse.row_sparse_array
 from . import parallel
 from . import module
 mod = module  # reference alias (mx.mod)
+from . import inspector
+from .inspector import TensorInspector
 from . import monitor
 from .monitor import Monitor
 from . import profiler

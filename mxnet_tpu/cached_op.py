@@ -66,7 +66,10 @@ class CachedOp:
 
     # ------------------------------------------------------------------
     def _get_fn(self, is_train, diff_names):
-        key = (is_train, diff_names)
+        from . import inspector as _inspector
+        # keyed on the NaN-guard flag so toggling set_nan_guard()
+        # retraces with/without the staged checks
+        key = (is_train, diff_names, _inspector.nan_guard_enabled())
         fn = self._fns.get(key)
         if fn is not None:
             return fn

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from . import ops
+from . import inspector as _inspector
 from .base import MXNetError
 from .symbol import OP_AUX
 
@@ -164,6 +165,17 @@ def build_graph_fn(symbol, is_train, node_device=None):
                     call.update({n: a for n, a in zip(pnames, ins)})
                 out = op.fn(**call)
 
+            if _inspector.nan_guard_enabled():
+                # MXNET_NAN_GUARD: host-side finite-ness check on every
+                # node output, tagged with its producer (TensorInspector
+                # parity, tensor_inspector.h NaNChecker). Staged at
+                # trace time via jax.debug.callback.
+                tag = "%s:%s" % (node.op, node.name)
+                if isinstance(out, (tuple, list)):
+                    out = type(out)(
+                        _inspector.guard_value(o, tag) for o in out)
+                else:
+                    out = _inspector.guard_value(out, tag)
             if node.op in ("BatchNorm", "_contrib_SyncBatchNorm"):
                 # fold running-stat update (reference mutates aux in-place,
                 # src/operator/nn/batch_norm.cc; we return new values)

@@ -1,0 +1,190 @@
+"""Tensor inspection and NaN guarding — on any intermediate, eager or
+compiled.
+
+Reference: src/common/tensor_inspector.h:815 (TensorInspector:
+print_string/interactive_print, check_value with NegativeChecker/
+NaNChecker, dump_to_file) — a debugging tool usable on any tensor at any
+point. TPU-native redesign: values inside a jit-compiled graph are not
+host-addressable, so inspection rides `jax.debug.callback` — the
+callback is staged into the XLA program and fires on the HOST with the
+materialized device value every execution, which is precisely the
+TensorInspector contract under a compiler.
+
+Three layers:
+* :func:`inspect` / :class:`TensorInspector` — explicit, user-placed
+  summaries/dumps of a tensor (works on NDArray, jax arrays, and inside
+  jit/hybridized graphs).
+* :func:`guard_value` — attach a finite-ness check to a value.
+* NaN-guard mode (``MXNET_NAN_GUARD=1`` or :func:`set_nan_guard`) —
+  executors/CachedOp guard every graph-node output with its op name, so
+  the first non-finite intermediate is reported at its source instead
+  of surfacing as a NaN loss many layers later.
+
+Reports go to the active sink (default: print to stderr + raise-on-bad
+for guards); tests install a capturing sink via :func:`set_sink`.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["inspect", "TensorInspector", "guard_value", "set_nan_guard",
+           "nan_guard_enabled", "set_sink"]
+
+_state = threading.local()
+
+
+def _sink():
+    return getattr(_state, "sink", None) or _default_sink
+
+
+def _default_sink(report):
+    sys.stderr.write(report["text"] + "\n")
+    if report.get("kind") == "guard" and report.get("bad"):
+        # a guard report means a non-finite intermediate: make it loud.
+        # (Raising inside a debug callback cannot abort the already-
+        # running XLA computation; the error text pinpoints the op.)
+        sys.stderr.write(
+            "*** NaN guard: non-finite value in %s ***\n" % report["tag"])
+
+
+def set_sink(fn):
+    """Install a report sink (callable(report_dict)) for this thread;
+    None restores the default stderr sink. Returns the previous sink."""
+    prev = getattr(_state, "sink", None)
+    _state.sink = fn
+    return prev
+
+
+# ------------------------------------------------------------- inspect --
+def _summarize(tag, value, kind):
+    v = np.asarray(value)
+    finite = np.isfinite(v.astype(np.float64)) if v.dtype.kind == "f" \
+        else np.ones(v.shape, bool)
+    n_nan = int(np.isnan(v).sum()) if v.dtype.kind == "f" else 0
+    n_inf = int(np.isinf(v).sum()) if v.dtype.kind == "f" else 0
+    report = {
+        "kind": kind, "tag": tag, "shape": tuple(v.shape),
+        "dtype": str(v.dtype), "nan": n_nan, "inf": n_inf,
+        "bad": bool(n_nan or n_inf),
+    }
+    if v.size:
+        fv = v[finite] if v.dtype.kind == "f" else v
+        if fv.size:
+            report.update(min=float(np.min(fv)), max=float(np.max(fv)),
+                          mean=float(np.mean(fv.astype(np.float64))))
+    report["text"] = (
+        "[%s] %s shape=%s dtype=%s min=%s max=%s mean=%s nan=%d inf=%d"
+        % (kind, tag, report["shape"], report["dtype"],
+           report.get("min"), report.get("max"),
+           ("%.6g" % report["mean"]) if "mean" in report else None,
+           n_nan, n_inf))
+    _sink()(report)
+
+
+def inspect(data, tag="tensor"):
+    """Print a summary (shape/dtype/min/max/mean/NaN/Inf counts) of
+    `data` — NDArray, jax array, or numpy. Inside jit (or a hybridized
+    block) the summary is computed on the host from the executed value
+    via jax.debug.callback; the value is returned unchanged so the call
+    can be inserted into a computation."""
+    arr = getattr(data, "_data", data)
+    if isinstance(arr, jax.core.Tracer):
+        jax.debug.callback(lambda v: _summarize(tag, v, "inspect"), arr)
+        return data
+    _summarize(tag, np.asarray(arr), "inspect")
+    return data
+
+
+class TensorInspector:
+    """Reference-shaped wrapper (tensor_inspector.h): construct over a
+    tensor, then print_string()/check_value()/dump_to_file()."""
+
+    def __init__(self, data, tag="tensor"):
+        self._data = getattr(data, "_data", data)
+        self._tag = tag
+
+    def print_string(self):
+        inspect(self._data, self._tag)
+        return self
+
+    def to_string(self):
+        v = np.asarray(self._data)
+        return np.array2string(v, threshold=64)
+
+    def check_value(self, checker=None):
+        """checker: callable(np.ndarray) -> bool array of violations, or
+        None for the NaN/Inf checker (reference CheckerType::NaNChecker).
+        Returns the number of violations (eager) or stages a host check
+        (traced)."""
+        if checker is None:
+            checker = lambda v: ~np.isfinite(v)
+        if isinstance(self._data, jax.core.Tracer):
+            tag = self._tag
+
+            def _cb(v):
+                bad = int(np.asarray(checker(np.asarray(v))).sum())
+                if bad:
+                    _sink()({"kind": "check", "tag": tag, "bad": True,
+                             "violations": bad,
+                             "text": "[check] %s: %d violations"
+                             % (tag, bad)})
+            jax.debug.callback(_cb, self._data)
+            return None
+        return int(np.asarray(checker(np.asarray(self._data))).sum())
+
+    def dump_to_file(self, path):
+        """Save the value as .npy (reference dump_to_file writes a
+        binary blob; .npy is the portable equivalent). Works under jit
+        via a host callback."""
+        if isinstance(self._data, jax.core.Tracer):
+            jax.debug.callback(
+                lambda v: np.save(path, np.asarray(v)), self._data)
+            return self
+        np.save(path, np.asarray(self._data))
+        return self
+
+
+# ----------------------------------------------------------- NaN guard --
+_guard_flag = None
+
+
+def nan_guard_enabled():
+    if _guard_flag is not None:
+        return _guard_flag
+    return os.environ.get("MXNET_NAN_GUARD", "0").lower() in ("1", "true")
+
+
+def set_nan_guard(enabled):
+    """Toggle NaN guarding programmatically (overrides the env var).
+    Guards are staged at TRACE time: executors bound and CachedOps
+    compiled while the guard is on carry the checks (CachedOp keys its
+    compiled-function cache on the flag, so toggling retraces)."""
+    global _guard_flag
+    _guard_flag = bool(enabled)
+
+
+def guard_value(x, tag):
+    """Attach a host-side finite-ness check to a traced or eager float
+    value; returns x. The report names `tag` (op:name), so the FIRST
+    non-finite intermediate pinpoints its producer."""
+    dt = getattr(x, "dtype", None)
+    if dt is None or jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_:
+        return x
+
+    def _cb(v):
+        v = np.asarray(v)
+        n_nan = int(np.isnan(v).sum())
+        n_inf = int(np.isinf(v).sum())
+        if n_nan or n_inf:
+            _sink()({"kind": "guard", "tag": tag, "bad": True,
+                     "nan": n_nan, "inf": n_inf,
+                     "text": "[guard] %s: nan=%d inf=%d shape=%s"
+                     % (tag, n_nan, n_inf, tuple(v.shape))})
+    jax.debug.callback(_cb, x)
+    return x

@@ -1,0 +1,98 @@
+"""TensorInspector parity (mxnet_tpu/inspector.py).
+
+Reference: src/common/tensor_inspector.h:815 — value summaries, NaN
+checking and file dumps on any intermediate. Here inspection works
+eagerly AND inside compiled graphs via jax.debug.callback, and
+MXNET_NAN_GUARD pinpoints the first non-finite intermediate by op."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import inspector
+
+
+@pytest.fixture
+def reports():
+    got = []
+    prev = inspector.set_sink(got.append)
+    yield got
+    inspector.set_sink(prev)
+
+
+def test_inspect_eager_summary(reports):
+    a = mx.nd.array([[1.0, 2.0], [3.0, float("nan")]])
+    inspector.inspect(a, tag="act0")
+    (r,) = reports
+    assert r["tag"] == "act0" and r["shape"] == (2, 2)
+    assert r["nan"] == 1 and r["bad"]
+    assert r["min"] == 1.0 and r["max"] == 3.0
+
+
+def test_inspect_inside_jit(reports):
+    @jax.jit
+    def f(x):
+        inspector.inspect(x * 2, tag="traced")
+        return x + 1
+
+    out = f(jnp.ones((3,)))
+    jax.block_until_ready(out)
+    jax.effects_barrier()
+    assert any(r["tag"] == "traced" and r["shape"] == (3,)
+               for r in reports)
+
+
+def test_tensor_inspector_check_and_dump(tmp_path, reports):
+    t = mx.TensorInspector(mx.nd.array([1.0, -2.0, 3.0]), tag="w")
+    assert t.check_value(lambda v: v < 0) == 1
+    assert t.check_value() == 0          # default NaN/Inf checker
+    t.dump_to_file(str(tmp_path / "w.npy"))
+    np.testing.assert_array_equal(np.load(str(tmp_path / "w.npy")),
+                                  [1.0, -2.0, 3.0])
+
+
+def test_nan_guard_pinpoints_op_in_hybrid_graph(reports):
+    """The first non-finite intermediate must be reported with its
+    producing op, from INSIDE the compiled graph."""
+    from mxnet_tpu.cached_op import CachedOp
+    a = mx.sym.Variable("a")
+    graph = mx.sym.sqrt(mx.sym.log(a), name="s")   # log(-1) -> nan
+    inspector.set_nan_guard(True)
+    try:
+        cop = CachedOp(graph)
+        out = cop(mx.nd.array([-1.0, 4.0]))[0]
+        out.wait_to_read()
+        jax.effects_barrier()
+    finally:
+        inspector.set_nan_guard(False)
+    tags = [r["tag"] for r in reports if r.get("kind") == "guard"]
+    assert tags and any(t.startswith("log") for t in tags), reports
+    # clean inputs produce no reports after toggling off (flag is part
+    # of the compiled-fn cache key, so this retraces without guards)
+    reports.clear()
+    out = cop(mx.nd.array([1.0, 4.0]))[0]
+    out.wait_to_read()
+    jax.effects_barrier()
+    assert not [r for r in reports if r.get("kind") == "guard"]
+
+
+def test_nan_guard_eager(reports):
+    inspector.set_nan_guard(True)
+    try:
+        out = mx.nd.log(mx.nd.array([-1.0]))
+        out.wait_to_read()
+        jax.effects_barrier()
+    finally:
+        inspector.set_nan_guard(False)
+    assert any(r.get("kind") == "guard" and "log" in r["tag"]
+               for r in reports)
+
+
+def test_guard_off_by_default(reports):
+    out = mx.nd.log(mx.nd.array([-1.0]))
+    out.wait_to_read()
+    jax.effects_barrier()
+    assert not reports
