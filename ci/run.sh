@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# One-command CI gate (round-2 verdict item 10).
+#
+# Reference counterpart: ci/docker/runtime_functions.sh (unittest_ubuntu_*
+# stages run by the Jenkins matrix). Here one script gates the tree:
+#
+#   ./ci/run.sh            # full gate: suite + multichip dryrun + bench
+#   ./ci/run.sh quick      # suite only (fail-fast)
+#
+# Stages:
+#   1. pytest tests/ on the 8-device virtual CPU mesh (includes the
+#      examples smoke set, tests/test_examples_tools.py)
+#   2. driver contract: dryrun_multichip(8) + entry() compile check
+#   3. bench.py fail-fast (error JSON + rc!=0 when the TPU tunnel is
+#      wedged; a real number when a chip is attached)
+#
+# Any stage failing fails the gate.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+FAILED=0
+
+stage() {
+    echo "==== [ci] $1 ===="
+}
+
+stage "pytest (8-device virtual CPU mesh)"
+if ! python -m pytest tests/ -q -x --durations=10; then
+    echo "[ci] FAIL: test suite"
+    exit 1
+fi
+
+if [ "$MODE" = "quick" ]; then
+    echo "[ci] quick gate PASSED"
+    exit 0
+fi
+
+stage "driver contract: dryrun_multichip(8) + entry()"
+if ! python __graft_entry__.py; then
+    echo "[ci] FAIL: __graft_entry__ contract"
+    FAILED=1
+fi
+
+stage "bench fail-fast"
+# on a wedged tunnel bench exits 3 with an error JSON — that is a PASS
+# for the gate (the guard worked); any other nonzero rc is a failure
+python bench.py
+rc=$?
+if [ $rc -ne 0 ] && [ $rc -ne 3 ]; then
+    echo "[ci] FAIL: bench.py rc=$rc"
+    FAILED=1
+fi
+
+if [ $FAILED -ne 0 ]; then
+    echo "[ci] gate FAILED"
+    exit 1
+fi
+echo "[ci] gate PASSED"
