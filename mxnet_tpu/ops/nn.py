@@ -249,10 +249,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         stat_shape[ax] = data.shape[ax]
         shift = lax.stop_gradient(
             moving_mean.astype(jnp.float32)).reshape(stat_shape)
-        centered = data.astype(jnp.float32) - shift
-        mean_c = jnp.mean(centered, axis=red)
-        var = jnp.maximum(
-            jnp.mean(centered * centered, axis=red) - mean_c * mean_c, 0.0)
+        if _bn_bf16_residual():
+            # keep `centered` in the ACTIVATION dtype: the backward
+            # saves it as a residual on every BN input, and the fp32
+            # form pins 2x the bf16 bytes (PERF.md ~22 GB/step suspect;
+            # benchmark/bn_residual_ab.py + activation_residual_ab.py).
+            # The reductions still accumulate in fp32.
+            centered = data - shift.astype(data.dtype)
+            mean_c = jnp.mean(centered, axis=red, dtype=jnp.float32)
+            var = jnp.maximum(
+                jnp.mean(centered * centered, axis=red,
+                         dtype=jnp.float32) - mean_c * mean_c, 0.0)
+        else:
+            centered = data.astype(jnp.float32) - shift
+            mean_c = jnp.mean(centered, axis=red)
+            var = jnp.maximum(
+                jnp.mean(centered * centered, axis=red)
+                - mean_c * mean_c, 0.0)
         mean = (mean_c + shift.reshape(-1)).astype(moving_mean.dtype)
         var = var.astype(moving_var.dtype)
     else:
@@ -343,11 +356,45 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     return data / jnp.power(knorm + alpha / nsize * s, beta)
 
 
+def _bn_bf16_residual():
+    import os
+    return os.environ.get("MXNET_BN_BF16_RESIDUAL", "0").lower() in (
+        "1", "true")
+
+
 # ----------------------------------------------------------- activation --
+@jax.custom_vjp
+def _relu_mask_residual(x):
+    return jnp.maximum(x, 0)
+
+
+def _relu_mr_fwd(x):
+    # save the SIGN MASK (1 byte/elem) instead of the activation
+    # (2-4 bytes/elem): relu backward needs only where(x > 0). This is
+    # the "8-bit activation compression for backward" lever from
+    # PERF.md, applied where compression is exact.
+    return jnp.maximum(x, 0), x > 0
+
+
+def _relu_mr_bwd(mask, ct):
+    return (jnp.where(mask, ct, jnp.zeros_like(ct)),)
+
+
+_relu_mask_residual.defvjp(_relu_mr_fwd, _relu_mr_bwd)
+
+
+def _relu_mask_enabled():
+    import os
+    return os.environ.get("MXNET_RELU_MASK_RESIDUAL", "0").lower() in (
+        "1", "true")
+
+
 @register(name="Activation")
 def activation(data, act_type="relu"):
     """src/operator/nn/activation.cc."""
     if act_type == "relu":
+        if _relu_mask_enabled():
+            return _relu_mask_residual(data)
         return jnp.maximum(data, 0)
     if act_type == "sigmoid":
         return lax.logistic(data)

@@ -75,19 +75,22 @@ def test_model_zoo_train_step_decreases_loss():
     from mxnet_tpu import gluon, autograd
     net = vision.get_resnet(1, 18, classes=4, thumbnail=True)
     net.initialize(mx.init.Xavier())
+    # lr 0.02 for 8 steps: at lr 0.1 the 4-step trajectory through BN
+    # was numerically chaotic — any reassociation-level change (e.g.
+    # jit-vs-eager vjp fusion) flipped the final comparison
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1})
+                            {"learning_rate": 0.02})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     x = mx.nd.random.uniform(shape=(8, 3, 32, 32))
     y = mx.nd.array(np.random.randint(0, 4, (8,)))
     losses = []
-    for _ in range(4):
+    for _ in range(8):
         with autograd.record():
             loss = loss_fn(net(x), y)
         loss.backward()
         trainer.step(8)
         losses.append(float(loss.mean().asnumpy()))
-    assert losses[-1] < losses[0]
+    assert np.mean(losses[-2:]) < losses[0], losses
 
 
 @pytest.mark.parametrize("factory,size", [
