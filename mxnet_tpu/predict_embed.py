@@ -1,0 +1,134 @@
+"""In-process support for the C predict ABI (src/predict/c_predict_api.cc).
+
+Reference: src/c_api/c_predict_api.cc:680 — the deployment path that
+lets a NON-Python program run inference. TPU-native architecture: the
+compute path is jax/XLA, which lives in CPython — so the C ABI embeds
+the interpreter (libpython) and drives THIS module. The C side stays a
+thin argument-marshalling shim; everything substantive (symbol JSON,
+parameter blobs, executor bind, jit caching) reuses the framework
+as-is, which keeps the ABI honest about what runs: the same compiled
+XLA program a Python user would get.
+
+The embedding contract (all called with the GIL held by the shim):
+    create(symbol_json, param_bytes, dev_type, input_names, shapes)
+        -> predictor id (int)
+    set_input(pid, name, flat_float32_bytes, shape) -> None
+    forward(pid) -> None
+    get_output_shape(pid, index) -> tuple
+    get_output(pid, index) -> contiguous float32 bytes
+    reshape(pid, input_names, shapes) -> None
+    free(pid) -> None
+Errors raise; the shim converts them into MXGetLastError() strings.
+"""
+
+import threading
+
+import numpy as np
+
+_predictors = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+class _Predictor:
+    def __init__(self, symbol_json, param_bytes, dev_type, input_shapes):
+        import mxnet_tpu as mx
+        sym = mx.sym.load_json(symbol_json)
+        arg_params, aux_params = {}, {}
+        if param_bytes:
+            loaded = mx.nd.load_frombuffer(param_bytes)
+            for k, v in loaded.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v
+                else:           # bare names (plain nd.save dict)
+                    arg_params[k] = v
+        ctx = mx.cpu() if dev_type == 1 else mx.gpu(0)
+        self._mx = mx
+        self._sym = sym
+        self._ctx = ctx
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._bind(input_shapes)
+
+    def _bind(self, input_shapes):
+        mx = self._mx
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**input_shapes)
+        arg_names = self._sym.list_arguments()
+        aux_names = self._sym.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in input_shapes:
+                args[name] = mx.nd.zeros(shape, ctx=self._ctx)
+            elif name in self._arg_params:
+                args[name] = self._arg_params[name].as_in_context(self._ctx)
+            else:
+                raise ValueError(
+                    "parameter %r missing from the param blob" % name)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in self._aux_params:
+                aux[name] = self._aux_params[name].as_in_context(self._ctx)
+            else:
+                aux[name] = mx.nd.zeros(shape, ctx=self._ctx)
+        self._input_shapes = dict(input_shapes)
+        self._exec = self._sym.bind(self._ctx, args, aux_states=aux,
+                                    grad_req="null")
+
+    def set_input(self, name, data, shape):
+        if name not in self._input_shapes:
+            raise KeyError("unknown input %r (declared: %s)"
+                           % (name, sorted(self._input_shapes)))
+        arr = np.frombuffer(data, dtype=np.float32).reshape(shape)
+        self._exec.arg_dict[name]._data = \
+            self._mx.nd.array(arr, ctx=self._ctx)._data
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+
+    def get_output_shape(self, index):
+        return tuple(self._outputs[index].shape)
+
+    def get_output(self, index):
+        out = self._outputs[index].asnumpy().astype(np.float32)
+        return np.ascontiguousarray(out).tobytes()
+
+    def reshape(self, input_shapes):
+        self._bind(input_shapes)
+
+
+def create(symbol_json, param_bytes, dev_type, input_names, shapes):
+    input_shapes = dict(zip(list(input_names), [tuple(s) for s in shapes]))
+    p = _Predictor(symbol_json, param_bytes, dev_type, input_shapes)
+    with _lock:
+        pid = _next_id[0]
+        _next_id[0] += 1
+        _predictors[pid] = p
+    return pid
+
+
+def set_input(pid, name, data, shape):
+    _predictors[pid].set_input(name, data, tuple(shape))
+
+
+def forward(pid):
+    _predictors[pid].forward()
+
+
+def get_output_shape(pid, index):
+    return _predictors[pid].get_output_shape(index)
+
+
+def get_output(pid, index):
+    return _predictors[pid].get_output(index)
+
+
+def reshape(pid, input_names, shapes):
+    _predictors[pid].reshape(
+        dict(zip(list(input_names), [tuple(s) for s in shapes])))
+
+
+def free(pid):
+    with _lock:
+        _predictors.pop(pid, None)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Build libmxnet_tpu_predict.so — the C predict ABI (embeds CPython).
+# Usage: ./src/predict/build.sh [outdir]
+set -euo pipefail
+cd "$(dirname "$0")"
+OUT="${1:-.}"
+PYINC="$(python3-config --includes)"
+PYPREFIX="$(python3-config --prefix)"
+g++ -O2 -std=c++17 -shared -fPIC c_predict_api.cc \
+    ${PYINC} -L"${PYPREFIX}/lib" -Wl,-rpath,"${PYPREFIX}/lib" \
+    -lpython3.12 -o "${OUT}/libmxnet_tpu_predict.so"
+echo "built ${OUT}/libmxnet_tpu_predict.so"
