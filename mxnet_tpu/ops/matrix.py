@@ -199,10 +199,16 @@ def pad(data, mode="constant", pad_width=(), constant_value=0.0):
 
 
 # ------------------------------------------------------------- indexing --
+def _idx_dtype(dim):
+    # int32 indices (TPU-native) unless the indexed axis exceeds int32
+    # range — large-tensor support (ndarray._large_tensor_ctx)
+    return "int64" if dim > 2**31 - 1 else "int32"
+
+
 @register(name="take")
 def take(a, indices, axis=0, mode="clip"):
     """src/operator/tensor/indexing_op.cc take."""
-    idx = indices.astype("int32")
+    idx = indices.astype(_idx_dtype(a.shape[axis]))
     if mode == "wrap":
         idx = jnp.mod(idx, a.shape[axis])
     elif mode == "clip":
@@ -212,7 +218,8 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register(name="batch_take")
 def batch_take(a, indices):
-    idx = jnp.clip(indices.astype("int32"), 0, a.shape[1] - 1)
+    idx = jnp.clip(indices.astype(_idx_dtype(a.shape[1])), 0,
+                   a.shape[1] - 1)
     return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
 
 

@@ -53,15 +53,22 @@ def norm(data, ord=2, axis=None, keepdims=False):
     return r
 
 
+def _arg_out_dtype(dim):
+    # reference argmax emits float32; beyond int32 range float32 cannot
+    # hold the position, so large-tensor mode emits int64 (documented
+    # divergence, tests/test_large_tensor.py)
+    return "int64" if dim > 2**31 - 1 else "float32"
+
+
 @register(name="argmax", differentiable=False)
 def argmax(data, axis=None, keepdims=False):
     if axis is None:
         res = jnp.argmax(data.reshape(-1))
-        return res.astype("float32")
+        return res.astype(_arg_out_dtype(data.size))
     r = jnp.argmax(data, axis=axis)
     if keepdims:
         r = jnp.expand_dims(r, axis)
-    return r.astype("float32")
+    return r.astype(_arg_out_dtype(data.shape[axis]))
 
 
 @register(name="argmin", differentiable=False)
