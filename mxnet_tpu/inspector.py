@@ -62,12 +62,28 @@ def set_sink(fn):
 
 
 # ------------------------------------------------------------- inspect --
+def _is_floatish(dtype):
+    # ml_dtypes floats (bfloat16, float8_*) report kind 'V' to numpy;
+    # they are the DOMINANT dtypes on this stack and must not blind the
+    # NaN accounting
+    if dtype.kind == "f":
+        return True
+    try:
+        import ml_dtypes
+        return dtype in (np.dtype(ml_dtypes.bfloat16),)
+    except ImportError:
+        return False
+
+
 def _summarize(tag, value, kind):
     v = np.asarray(value)
-    finite = np.isfinite(v.astype(np.float64)) if v.dtype.kind == "f" \
+    isf = _is_floatish(v.dtype)
+    if isf and v.dtype.kind != "f":
+        v = v.astype(np.float32)      # widen bf16 for the statistics
+    finite = np.isfinite(v.astype(np.float64)) if isf \
         else np.ones(v.shape, bool)
-    n_nan = int(np.isnan(v).sum()) if v.dtype.kind == "f" else 0
-    n_inf = int(np.isinf(v).sum()) if v.dtype.kind == "f" else 0
+    n_nan = int(np.isnan(v).sum()) if isf else 0
+    n_inf = int(np.isinf(v).sum()) if isf else 0
     report = {
         "kind": kind, "tag": tag, "shape": tuple(v.shape),
         "dtype": str(v.dtype), "nan": n_nan, "inf": n_inf,

@@ -372,7 +372,10 @@ def _relu_mr_fwd(x):
     # save the SIGN MASK (1 byte/elem) instead of the activation
     # (2-4 bytes/elem): relu backward needs only where(x > 0). This is
     # the "8-bit activation compression for backward" lever from
-    # PERF.md, applied where compression is exact.
+    # PERF.md. Subgradient at x == 0 is 0 (the torch/standard
+    # convention) whereas jnp.maximum's tie rule gives 0.5 — a
+    # measure-zero divergence between the two paths, both valid
+    # subgradients.
     return jnp.maximum(x, 0), x > 0
 
 

@@ -6,7 +6,8 @@ cd "$(dirname "$0")"
 OUT="${1:-.}"
 PYINC="$(python3-config --includes)"
 PYPREFIX="$(python3-config --prefix)"
+PYLIBS="$(python3-config --embed --libs 2>/dev/null || python3-config --libs)"
 g++ -O2 -std=c++17 -shared -fPIC c_predict_api.cc \
     ${PYINC} -L"${PYPREFIX}/lib" -Wl,-rpath,"${PYPREFIX}/lib" \
-    -lpython3.12 -o "${OUT}/libmxnet_tpu_predict.so"
+    ${PYLIBS} -o "${OUT}/libmxnet_tpu_predict.so"
 echo "built ${OUT}/libmxnet_tpu_predict.so"

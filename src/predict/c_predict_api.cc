@@ -79,6 +79,10 @@ bool ensure_python() {
                                  : "cpu",
            0);
     Py_InitializeEx(0);
+    // release the GIL acquired by initialization: entry points each
+    // take it via PyGILState_Ensure, and a held GIL here would
+    // deadlock every OTHER thread's first call
+    PyEval_SaveThread();
   }
   g_initialized = true;
   return true;

@@ -96,3 +96,13 @@ def test_guard_off_by_default(reports):
     out.wait_to_read()
     jax.effects_barrier()
     assert not reports
+
+
+def test_inspect_bf16_nan_detected(reports):
+    """ml_dtypes bfloat16 reports numpy kind 'V'; the NaN accounting
+    must still see through it (review finding r3)."""
+    a = mx.nd.array([1.0, float("nan"), 2.0]).astype("bfloat16")
+    inspector.inspect(a, tag="bf16act")
+    (r,) = reports
+    assert r["nan"] == 1 and r["bad"]
+    assert r["min"] == 1.0 and r["max"] == 2.0

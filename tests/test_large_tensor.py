@@ -5,8 +5,8 @@ TPU-native mapping: sizes beyond 2^31-1 automatically run dispatch under
 jax.enable_x64 (ndarray._large_tensor_ctx) so gather/scatter/slice index
 arithmetic is 64-bit; everything below keeps jax's 32-bit default.
 
-int8 arrays (~2.2 GB each) keep this runnable on the CI host; set
-MXNET_SKIP_LARGE_TENSOR=1 to skip on small machines."""
+int8 arrays (~2.2 GB each) keep this runnable on the CI host; opt-in
+via MXNET_RUN_LARGE_TENSOR=1 (ci/run.sh sets it when RAM allows)."""
 
 import os
 
@@ -15,9 +15,12 @@ import pytest
 
 import mxnet_tpu as mx
 
+# opt-IN like the reference's nightly suite: each test allocates
+# ~2.2 GB (with ~4.4 GB transients) — default pytest runs must not OOM
+# small hosts. ci/run.sh enables it on hosts with enough memory.
 pytestmark = pytest.mark.skipif(
-    os.environ.get("MXNET_SKIP_LARGE_TENSOR", "0") == "1",
-    reason="MXNET_SKIP_LARGE_TENSOR=1")
+    os.environ.get("MXNET_RUN_LARGE_TENSOR", "0") != "1",
+    reason="set MXNET_RUN_LARGE_TENSOR=1 (needs ~6 GB free RAM)")
 
 N = 2**31 + 16
 
