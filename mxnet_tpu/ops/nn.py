@@ -249,7 +249,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         stat_shape[ax] = data.shape[ax]
         shift = lax.stop_gradient(
             moving_mean.astype(jnp.float32)).reshape(stat_shape)
-        if _bn_bf16_residual():
+        if _bn_bf16_residual() and data.dtype == jnp.bfloat16:
             # keep `centered` in the ACTIVATION dtype: the backward
             # saves it as a residual on every BN input, and the fp32
             # form pins 2x the bf16 bytes (PERF.md ~22 GB/step suspect;
@@ -357,8 +357,14 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
 
 
 def _bn_bf16_residual():
+    # default ON: for bf16 activation streams the bf16-centered form
+    # halves the BN backward residual (measured -19% of total step
+    # residual bytes, benchmark/activation_residual_ab.py) with fp32
+    # accumulation for the statistics; MXNET_BN_BF16_RESIDUAL=0 reverts
+    # to fp32-centered residuals (the round-2 formulation). fp32
+    # activation streams are numerically identical either way.
     import os
-    return os.environ.get("MXNET_BN_BF16_RESIDUAL", "0").lower() in (
+    return os.environ.get("MXNET_BN_BF16_RESIDUAL", "1").lower() in (
         "1", "true")
 
 
