@@ -77,6 +77,7 @@ def _probe_backend_alive(timeout_s=150):
 def main():
     import os
     import jax
+    repeats = int(os.environ.get("MXNET_BENCH_REPEATS", "1"))
     if not _probe_backend_alive():
         print(json.dumps({
             "metric": "resnet50_train_img_per_sec_bs%d_tpu" % BATCH,
@@ -121,19 +122,29 @@ def main():
     args, mom, aux, loss = step(args, mom, aux, x, y)
     float(loss)
 
-    t0 = time.time()
-    for _ in range(steps):
-        args, mom, aux, loss = step(args, mom, aux, x, y)
-    loss = float(loss)
-    dt = time.time() - t0
+    rates = []
+    for _ in range(max(1, repeats)):
+        t0 = time.time()
+        for _ in range(steps):
+            args, mom, aux, loss = step(args, mom, aux, x, y)
+        loss = float(loss)
+        dt = time.time() - t0
+        rates.append(batch * steps / dt)
 
-    img_s = batch * steps / dt
+    img_s = rates[0] if repeats <= 1 else float(np.median(rates))
     result = {
         "metric": "resnet50_train_img_per_sec_bs%d_%s" % (batch, backend),
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }
+    if repeats > 1:
+        # repeatability data (MXNET_BENCH_REPEATS=N): median headline,
+        # spread recorded so a single measurement session is auditable
+        result["repeats"] = repeats
+        result["min"] = round(min(rates), 2)
+        result["max"] = round(max(rates), 2)
+        result["std"] = round(float(np.std(rates)), 2)
     print(json.dumps(result))
     if not np.isfinite(loss):
         print("WARNING: non-finite loss", file=sys.stderr)
