@@ -1,0 +1,86 @@
+/* Minimal C consumer of the predict ABI (libmxnet_tpu_predict.so).
+ *
+ * Reference counterpart: example/image-classification/predict-cpp.
+ * Build + run:
+ *   ./src/predict/build.sh ./src/predict
+ *   gcc -O2 examples/deploy/predict.c -L./src/predict \
+ *       -lmxnet_tpu_predict -Wl,-rpath,$PWD/src/predict -o predict
+ *   PYTHONPATH=$PWD ./predict model-symbol.json model-0000.params \
+ *       2 4   # batch, feature-dim of the exported model's input
+ *
+ * The model pair comes from Python:
+ *   net.export("model")            # gluon
+ *   # or: open("model-symbol.json","w").write(sym.tojson());
+ *   #     mx.nd.save("model-0000.params", {"arg:%s"%k: v ...})
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+extern const char *MXGetLastError();
+extern int MXPredCreate(const char *, const void *, int, int, int,
+                        mx_uint, const char **, const mx_uint *,
+                        const mx_uint *, PredictorHandle *);
+extern int MXPredSetInput(PredictorHandle, const char *, const mx_float *,
+                          mx_uint);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, mx_uint, mx_uint **,
+                                mx_uint *);
+extern int MXPredGetOutput(PredictorHandle, mx_uint, mx_float *, mx_uint);
+extern int MXPredFree(PredictorHandle);
+
+static char *slurp(const char *path, long *size) {
+    FILE *f = fopen(path, "rb");
+    if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+    fseek(f, 0, SEEK_END); *size = ftell(f); fseek(f, 0, SEEK_SET);
+    char *buf = (char *)malloc(*size + 1);
+    if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+    buf[*size] = 0;
+    fclose(f);
+    return buf;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr,
+                "usage: %s symbol.json params.bin batch feature_dim\n",
+                argv[0]);
+        return 1;
+    }
+    long jsize, psize;
+    char *json = slurp(argv[1], &jsize);
+    char *params = slurp(argv[2], &psize);
+    mx_uint batch = (mx_uint)atoi(argv[3]);
+    mx_uint dim = (mx_uint)atoi(argv[4]);
+
+    const char *keys[] = {"data"};
+    mx_uint indptr[] = {0, 2};
+    mx_uint shape[] = {batch, dim};
+    PredictorHandle h = NULL;
+    if (MXPredCreate(json, params, (int)psize, 1, 0, 1, keys, indptr,
+                     shape, &h) != 0) {
+        fprintf(stderr, "create: %s\n", MXGetLastError());
+        return 3;
+    }
+    mx_uint n = batch * dim;
+    mx_float *input = (mx_float *)malloc(n * sizeof(mx_float));
+    for (mx_uint i = 0; i < n; ++i) input[i] = (mx_float)i / n - 0.5f;
+    if (MXPredSetInput(h, "data", input, n) != 0 ||
+        MXPredForward(h) != 0) {
+        fprintf(stderr, "run: %s\n", MXGetLastError());
+        return 4;
+    }
+    mx_uint *oshape, ondim, total = 1;
+    MXPredGetOutputShape(h, 0, &oshape, &ondim);
+    for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+    mx_float *out = (mx_float *)malloc(total * sizeof(mx_float));
+    MXPredGetOutput(h, 0, out, total);
+    printf("output[0..%u):", total < 8 ? total : 8);
+    for (mx_uint i = 0; i < total && i < 8; ++i) printf(" %.5f", out[i]);
+    printf("\n");
+    MXPredFree(h);
+    return 0;
+}

@@ -166,3 +166,24 @@ def test_c_program_inference_matches_python(predict_lib, tmp_path):
                   grad_req="null")
     expect = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_deploy_example_compiles_and_runs(predict_lib, tmp_path):
+    """examples/deploy/predict.c — the documented deployment example —
+    must build and run against the shim."""
+    _, _, sym_path, params_path = _export_model(str(tmp_path))
+    exe = tmp_path / "deploy_example"
+    r = subprocess.run(
+        ["gcc", "-O1", os.path.join(ROOT, "examples/deploy/predict.c"),
+         "-L", str(predict_lib), "-lmxnet_tpu_predict",
+         "-Wl,-rpath," + str(predict_lib), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe), sym_path, params_path, "2", "4"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    assert r.stdout.startswith("output[0..6):")
