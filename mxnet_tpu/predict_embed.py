@@ -132,3 +132,37 @@ def reshape(pid, input_names, shapes):
 def free(pid):
     with _lock:
         _predictors.pop(pid, None)
+
+
+# --------------------------------------------------------- NDList -------
+# MXNDListCreate/Get: load an nd.save blob (e.g. a mean-image file) and
+# expose (key, float32 data, shape) triples to the C side.
+_ndlists = {}
+
+
+def ndlist_create(blob):
+    import mxnet_tpu as mx
+    loaded = mx.nd.load_frombuffer(blob)
+    if isinstance(loaded, dict):
+        items = list(loaded.items())
+    else:
+        items = [(str(i), v) for i, v in enumerate(loaded)]
+    entries = []
+    for k, v in items:
+        arr = np.ascontiguousarray(v.asnumpy().astype(np.float32))
+        entries.append((k, arr.tobytes(), tuple(arr.shape)))
+    with _lock:
+        nid = _next_id[0]
+        _next_id[0] += 1
+        _ndlists[nid] = entries
+    return nid, len(entries)
+
+
+def ndlist_get(nid, index):
+    k, data, shape = _ndlists[nid][index]
+    return k, data, shape
+
+
+def ndlist_free(nid):
+    with _lock:
+        _ndlists.pop(nid, None)

@@ -31,6 +31,7 @@
 typedef uint32_t mx_uint;
 typedef float mx_float;
 typedef void *PredictorHandle;
+typedef void *NDListHandle;
 
 #define MXTPU_API extern "C" __attribute__((visibility("default")))
 
@@ -300,6 +301,113 @@ MXTPU_API int MXPredReshape(mx_uint num_input_nodes,
   *out = handle;  // reference reshapes into a NEW handle; same-handle
                   // rebinding is the jit-native equivalent (recompile
                   // is keyed by shape)
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutputType(PredictorHandle handle, mx_uint index,
+                                  int *out_dtype) {
+  (void)handle;
+  (void)index;
+  *out_dtype = 0;  // kFloat32: the ABI surface is float32 (GetOutput)
+  return 0;
+}
+
+MXTPU_API int MXPredCreateEx(const char *symbol_json_str,
+                             const void *param_bytes, int param_size,
+                             int dev_type, int dev_id,
+                             mx_uint num_input_nodes,
+                             const char **input_keys,
+                             const mx_uint *input_shape_indptr,
+                             const mx_uint *input_shape_data,
+                             mx_uint num_provided_arg_dtypes,
+                             const char **provided_arg_dtype_names,
+                             const int *provided_arg_dtypes,
+                             PredictorHandle *out) {
+  // dtype hints are an inference-time AMP feature in the reference; the
+  // XLA program already runs the dtypes the symbol declares
+  (void)num_provided_arg_dtypes;
+  (void)provided_arg_dtype_names;
+  (void)provided_arg_dtypes;
+  return MXPredCreate(symbol_json_str, param_bytes, param_size, dev_type,
+                      dev_id, num_input_nodes, input_keys,
+                      input_shape_indptr, input_shape_data, out);
+}
+
+namespace {
+struct NDList {
+  long nid;
+  // per-entry storage the C pointers point into
+  std::vector<std::string> keys;
+  std::vector<std::string> data;
+  std::vector<std::vector<mx_uint>> shapes;
+};
+}  // namespace
+
+MXTPU_API int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out, mx_uint *out_length) {
+  if (!ensure_python()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(y#)", nd_file_bytes,
+                                 (Py_ssize_t)nd_file_size);
+  PyObject *ret = call_embed("ndlist_create", args);
+  Py_DECREF(args);
+  if (!ret) return -1;
+  long nid = PyLong_AsLong(PyTuple_GetItem(ret, 0));
+  long n = PyLong_AsLong(PyTuple_GetItem(ret, 1));
+  Py_DECREF(ret);
+  NDList *lst = new NDList();
+  lst->nid = nid;
+  lst->keys.resize(n);
+  lst->data.resize(n);
+  lst->shapes.resize(n);
+  for (long i = 0; i < n; ++i) {
+    PyObject *gargs = Py_BuildValue("(ll)", nid, i);
+    PyObject *item = call_embed("ndlist_get", gargs);
+    Py_DECREF(gargs);
+    if (!item) {
+      delete lst;
+      return -1;
+    }
+    lst->keys[i] = PyUnicode_AsUTF8(PyTuple_GetItem(item, 0));
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    PyBytes_AsStringAndSize(PyTuple_GetItem(item, 1), &buf, &blen);
+    lst->data[i].assign(buf, blen);
+    PyObject *shape = PyTuple_GetItem(item, 2);
+    Py_ssize_t nd = PyTuple_Size(shape);
+    lst->shapes[i].resize(nd);
+    for (Py_ssize_t d = 0; d < nd; ++d)
+      lst->shapes[i][d] =
+          (mx_uint)PyLong_AsUnsignedLong(PyTuple_GetItem(shape, d));
+    Py_DECREF(item);
+  }
+  // the python-side copies are no longer needed
+  PyObject *fargs = Py_BuildValue("(l)", nid);
+  PyObject *fr = call_embed("ndlist_free", fargs);
+  Py_DECREF(fargs);
+  Py_XDECREF(fr);
+  *out = lst;
+  *out_length = (mx_uint)n;
+  return 0;
+}
+
+MXTPU_API int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim) {
+  NDList *lst = static_cast<NDList *>(handle);
+  if (index >= lst->keys.size()) {
+    set_error("NDList index out of range");
+    return -1;
+  }
+  *out_key = lst->keys[index].c_str();
+  *out_data = reinterpret_cast<const mx_float *>(lst->data[index].data());
+  *out_shape = lst->shapes[index].data();
+  *out_ndim = (mx_uint)lst->shapes[index].size();
+  return 0;
+}
+
+MXTPU_API int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList *>(handle);
   return 0;
 }
 

@@ -39,7 +39,12 @@ extern int MXPredForward(PredictorHandle);
 extern int MXPredGetOutputShape(PredictorHandle, mx_uint, mx_uint **,
                                 mx_uint *);
 extern int MXPredGetOutput(PredictorHandle, mx_uint, mx_float *, mx_uint);
+extern int MXPredGetOutputType(PredictorHandle, mx_uint, int *);
 extern int MXPredFree(PredictorHandle);
+extern int MXNDListCreate(const char *, int, void **, mx_uint *);
+extern int MXNDListGet(void *, mx_uint, const char **, const mx_float **,
+                       const mx_uint **, mx_uint *);
+extern int MXNDListFree(void *);
 
 static char *slurp(const char *path, long *size) {
     FILE *f = fopen(path, "rb");
@@ -91,6 +96,19 @@ int main(int argc, char **argv) {
         return 7;
     }
     for (mx_uint i = 0; i < total; ++i) printf("%.8g\n", out[i]);
+    int dtype = -1;
+    if (MXPredGetOutputType(h, 0, &dtype) != 0 || dtype != 0) return 10;
+    // NDList: load the params blob itself as an ndarray list
+    void *lst = NULL;
+    mx_uint llen = 0;
+    if (MXNDListCreate(params, (int)psize, &lst, &llen) != 0) {
+        fprintf(stderr, "ndlist failed: %s\n", MXGetLastError());
+        return 11;
+    }
+    const char *k0; const mx_float *d0; const mx_uint *s0; mx_uint nd0;
+    if (MXNDListGet(lst, 0, &k0, &d0, &s0, &nd0) != 0) return 12;
+    printf("ndlist %u first=%s ndim=%u\n", llen, k0, nd0);
+    MXNDListFree(lst);
     // error surface: unknown input name must fail loudly, not crash
     if (MXPredSetInput(h, "nope", input, 8) == 0) return 8;
     if (MXPredFree(h) != 0) return 9;
@@ -157,7 +175,9 @@ def test_c_program_inference_matches_python(predict_lib, tmp_path):
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-2000:])
     lines = r.stdout.strip().splitlines()
     assert lines[0] == "shape 2 3"
-    got = np.array([float(x) for x in lines[1:]], np.float32).reshape(2, 3)
+    assert lines[-1].startswith("ndlist 4 first=arg:")
+    got = np.array([float(x) for x in lines[1:-1]],
+                   np.float32).reshape(2, 3)
 
     # in-process reference
     x = np.array([0.25 * (i - 3) for i in range(8)],
