@@ -13,6 +13,8 @@ per-step matmuls); there are no cuDNN/MKLDNN forks — one implementation,
 every backend.
 """
 
+import functools
+
 import numpy as _np
 
 import jax
@@ -143,6 +145,91 @@ def _window_reduce(data, kernel, stride, pads, combine, init_val, use_np=False):
     return acc
 
 
+def _pool_index_residual():
+    import os
+    # default ON: first-max tie semantics match the reference's pooling
+    # backward (mshadow assigns the gradient to the FIRST max position;
+    # jnp.maximum tie-splits 0.5/0.5 — materially different after relu,
+    # where windows are full of equal zeros), AND the saved residual is
+    # a 1-byte window index per OUTPUT element instead of the bf16
+    # max-tree intermediates. MXNET_POOL_INDEX_RESIDUAL=0 reverts.
+    return os.environ.get("MXNET_POOL_INDEX_RESIDUAL", "1").lower() in (
+        "1", "true")
+
+
+def _max_windows(data, kernel, stride, pads, init_val):
+    """All kernel-offset strided slices stacked on a leading K axis."""
+    import itertools
+    nsp = len(kernel)
+    nbatch = data.ndim - nsp
+    pad_cfg = [(0, 0)] * nbatch + list(pads)
+    padded = jnp.pad(data, pad_cfg, constant_values=init_val)
+    out_len = [(padded.shape[nbatch + d] - kernel[d]) // stride[d] + 1
+               for d in range(nsp)]
+    pieces = []
+    offsets = list(itertools.product(*[range(k) for k in kernel]))
+    for off in offsets:
+        starts = [0] * nbatch + list(off)
+        limits = list(padded.shape[:nbatch]) + \
+            [off[d] + (out_len[d] - 1) * stride[d] + 1 for d in range(nsp)]
+        strides = [1] * nbatch + list(stride)
+        pieces.append(lax.slice(padded, starts, limits, strides))
+    return jnp.stack(pieces), offsets, padded.shape, out_len
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _maxpool_index(data, kernel, stride, pads, in_shape, dtype_name):
+    out, _ = _maxpool_index_fwd(data, kernel, stride, pads, in_shape,
+                                dtype_name)
+    return out
+
+
+def _maxpool_index_fwd(data, kernel, stride, pads, in_shape, dtype_name):
+    init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.iinfo(data.dtype).min
+    win, _, padded_shape, _ = _max_windows(data, kernel, stride, pads,
+                                           init)
+    idx = jnp.argmax(win, axis=0).astype(jnp.uint8)   # first max wins
+    out = jnp.max(win, axis=0)
+    return out, idx
+
+
+def _maxpool_index_bwd(kernel, stride, pads, in_shape, dtype_name, res,
+                       ct):
+    import itertools
+    idx = res
+    in_dtype = jnp.dtype(dtype_name)
+    nsp = len(kernel)
+    nbatch = len(in_shape) - nsp
+    pad_cfg = [(0, 0)] * nbatch + list(pads)
+    padded_shape = list(in_shape)
+    for d in range(nsp):
+        padded_shape[nbatch + d] += pads[d][0] + pads[d][1]
+    g = jnp.zeros(padded_shape, jnp.float32)
+    ct32 = ct.astype(jnp.float32)
+    out_len = list(ct.shape[nbatch:])
+    for k, off in enumerate(
+            itertools.product(*[range(kd) for kd in kernel])):
+        contrib = jnp.where(idx == k, ct32, 0.0)
+        starts = [0] * nbatch + list(off)
+        limits = list(padded_shape[:nbatch]) + \
+            [off[d] + (out_len[d] - 1) * stride[d] + 1 for d in range(nsp)]
+        strides = [1] * nbatch + list(stride)
+        # transpose of lax.slice: scatter-add the contribution back
+        g = g.at[tuple(
+            slice(starts[i], limits[i], strides[i])
+            for i in range(len(padded_shape)))].add(contrib)
+    # un-pad
+    unpad = tuple(slice(pad_cfg[i][0],
+                        g.shape[i] - pad_cfg[i][1] or None)
+                  for i in range(len(padded_shape)))
+    g = g[unpad]
+    return (g.astype(in_dtype),)
+
+
+_maxpool_index.defvjp(_maxpool_index_fwd, _maxpool_index_bwd)
+
+
 @register(name="Pooling")
 def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", cudnn_off=False,
@@ -188,6 +275,10 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     # lax.reduce_window — it linearizes, so jax.vjp over a jitted CachedOp
     # graph works (reduce_window has no linearization rule as of jax 0.9).
     if pool_type == "max":
+        if _pool_index_residual():
+            return _maxpool_index(data, tuple(kernel), tuple(stride),
+                                  tuple(tuple(p) for p in pads),
+                                  tuple(data.shape), str(data.dtype))
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
             else jnp.iinfo(data.dtype).min
         return _window_reduce(data, kernel, stride, pads, jnp.maximum, init)

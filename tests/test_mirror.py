@@ -217,3 +217,66 @@ np.save(sys.argv[1], np.concatenate(
     # match tightly either way
     np.testing.assert_allclose(outs["compressed"], outs["base"],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_maxpool_index_residual_first_max_ties_and_grads():
+    """Index-residual max pooling (default): gradients match the
+    maximum-tree path on tie-free data, and ties follow the reference's
+    FIRST-max convention (mshadow pooling backward) instead of
+    jnp.maximum's 0.5/0.5 split."""
+    import os
+    import subprocess
+    import sys
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    # tie-free random data: both paths agree
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8) + np.arange(64).reshape(8, 8)
+                    * 1e-3)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+        (y * y).sum().backward()
+    g_index = x.grad.asnumpy().copy()
+
+    env = dict(os.environ)
+    env["MXNET_POOL_INDEX_RESIDUAL"] = "0"
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+        "from mxnet_tpu._discover import ensure_backend; ensure_backend()\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import autograd\n"
+        "rng = np.random.RandomState(0)\n"
+        "x = mx.nd.array(rng.randn(2, 3, 8, 8)"
+        " + np.arange(64).reshape(8, 8) * 1e-3)\n"
+        "x.attach_grad()\n"
+        "with autograd.record():\n"
+        "    y = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2),"
+        " pool_type='max')\n"
+        "    (y * y).sum().backward()\n"
+        "np.save(sys.argv[1], x.grad.asnumpy())\n"
+        % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "g.npy")
+        r = subprocess.run([sys.executable, "-c", code, out], env=env,
+                           capture_output=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+        g_tree = np.load(out)
+    np.testing.assert_allclose(g_index, g_tree, rtol=1e-5, atol=1e-6)
+
+    # ties: all-equal window routes the WHOLE cotangent to the first
+    # position (reference convention)
+    t = mx.nd.zeros((1, 1, 2, 2))
+    t.attach_grad()
+    with autograd.record():
+        y = mx.nd.Pooling(t, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+        y.sum().backward()
+    np.testing.assert_array_equal(
+        t.grad.asnumpy()[0, 0], [[1.0, 0.0], [0.0, 0.0]])
