@@ -484,8 +484,14 @@ _relu_mask_residual.defvjp(_relu_mr_fwd, _relu_mr_bwd)
 
 
 def _relu_mask_enabled():
+    # default ON: the saved residual is a 1-byte sign mask instead of
+    # the bf16 activation (-11% of ResNet step residual bytes,
+    # benchmark/activation_residual_ab.py), and the subgradient at
+    # x == 0 is 0 — the REFERENCE convention (mshadow_op.h relu_grad:
+    # a > 0 ? 1 : 0) and torch's, vs jnp.maximum's 0.5 tie split.
+    # MXNET_RELU_MASK_RESIDUAL=0 reverts.
     import os
-    return os.environ.get("MXNET_RELU_MASK_RESIDUAL", "0").lower() in (
+    return os.environ.get("MXNET_RELU_MASK_RESIDUAL", "1").lower() in (
         "1", "true")
 
 
