@@ -48,46 +48,47 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer(object):
-    """Throughput + metric logging every `frequent` batches
-    (callback.py:117)."""
+    """Throughput + metric logging every `frequent` batches.
+
+    API contract per reference callback.py:117: logs
+    "Epoch[e] Batch [a-b] Speed: s samples/sec metric=value...", resets
+    the local metric each report when auto_reset, and restarts its
+    window when the batch counter rewinds (new epoch)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_start = None     # (batch count, wall time) anchor
+
+    def _report(self, param, speed, lo, hi):
+        metric = param.eval_metric
+        if metric is None:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, hi, speed)
+            return
+        pairs = metric.get_name_value()
+        if self.auto_reset:
+            metric.reset_local()
+        fields = "".join("\t%s=%f" % p for p in pairs)
+        logging.info("Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec%s",
+                     param.epoch, lo, hi, speed, fields)
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset_local()
-                    msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch,
-                                 count - self.frequent, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        anchor = self._window_start
+        if anchor is None or anchor[0] > count:
+            # first call, or the counter rewound (epoch rollover):
+            # re-anchor without reporting
+            self._window_start = (count, time.time())
+            return
+        if count % self.frequent != 0 or count == anchor[0]:
+            return
+        elapsed = time.time() - anchor[1]
+        samples = (count - anchor[0]) * self.batch_size
+        speed = samples / elapsed if elapsed > 0 else float("inf")
+        self._report(param, speed, count - self.frequent, count)
+        self._window_start = (count, time.time())
 
 
 class ProgressBar(object):
