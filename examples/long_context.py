@@ -58,8 +58,13 @@ def main():
     shard = NamedSharding(mesh, P(None, "sp", None, None))
     q, k, v = (jax.device_put(x, shard) for x in (q, k, v))
 
+    # on a real TPU the per-shard block compute streams through the
+    # Pallas flash kernel (kernels/flash_attention.flash_carry_block);
+    # off-TPU the jnp blockwise path keeps numerics identical
+    use_flash = jax.default_backend() == "tpu"
     fn = jax.jit(lambda a, b, c: ring_attention_sharded(
-        a, b, c, mesh, axis_name="sp", causal=True))
+        a, b, c, mesh, axis_name="sp", causal=True,
+        use_flash_kernel=use_flash))
     out = fn(q, k, v)
     out.block_until_ready()
     t0 = time.time()
