@@ -198,3 +198,27 @@ def test_softmax_output_head_exports(tmp_path):
     x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
     out = _forward(sym2, args2, auxs2, x)
     np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+
+def test_resnet18_zoo_export_roundtrip(tmp_path):
+    """A real zoo graph (residual adds, BN chains, global pooling) through
+    gluon export -> ONNX export -> check -> import -> identical outputs."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_resnet(1, 18, classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.RandomState(0).uniform(-1, 1, (2, 3, 32, 32)) \
+        .astype(np.float32)
+    y_ref = net(nd.array(x)).asnumpy()
+    net.export(str(tmp_path / "m"))
+
+    loaded = nd.load(str(tmp_path / "m-0000.params"))
+    sym1 = sym.load(str(tmp_path / "m-symbol.json"))
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mx.export_model(sym1, loaded, [(2, 3, 32, 32)],
+                         onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    y2 = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(y_ref, y2, rtol=1e-4, atol=1e-5)
