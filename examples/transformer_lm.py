@@ -43,6 +43,12 @@ def main():
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
     ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--remat", action="store_true",
+                    help="checkpoint each layer (MXNET_BACKWARD_DO_MIRROR"
+                         " analogue at transformer granularity)")
+    ap.add_argument("--flash", action="store_true",
+                    help="Pallas flash kernel for the per-shard ring "
+                         "block compute (TPU)")
     args = ap.parse_args()
 
     import jax
@@ -64,7 +70,9 @@ def main():
                 ("dp", "tp", "sp"))
     cfg = T.TransformerConfig(vocab_size=32, d_model=64, n_heads=4,
                               n_layers=2, d_ff=128, max_len=args.seq,
-                              ep_axis=None)
+                              ep_axis=None,
+                              remat_layers=args.remat,
+                              use_flash_kernel=args.flash)
     with mesh:
         params = T.init_params(cfg, seed=0)
         params = T.shard_params(params, cfg, mesh)
