@@ -189,7 +189,15 @@ def _maxpool_index_fwd(data, kernel, stride, pads, in_shape, dtype_name):
         else jnp.iinfo(data.dtype).min
     win, _, padded_shape, _ = _max_windows(data, kernel, stride, pads,
                                            init)
-    idx = jnp.argmax(win, axis=0).astype(jnp.uint8)   # first max wins
+    # narrowest index type that can hold every window offset (a uint8
+    # would silently WRAP for kernels with >256 elements, scattering
+    # gradients to wrong positions)
+    n_off = 1
+    for kd in kernel:
+        n_off *= kd
+    idx_dt = jnp.uint8 if n_off <= 256 else (
+        jnp.uint16 if n_off <= 65536 else jnp.int32)
+    idx = jnp.argmax(win, axis=0).astype(idx_dt)      # first max wins
     out = jnp.max(win, axis=0)
     return out, idx
 

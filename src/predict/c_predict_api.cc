@@ -365,6 +365,12 @@ MXTPU_API int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
     PyObject *item = call_embed("ndlist_get", gargs);
     Py_DECREF(gargs);
     if (!item) {
+      // release the python-side staging copies too, or they leak for
+      // the process lifetime
+      PyObject *fargs = Py_BuildValue("(l)", nid);
+      PyObject *fr = call_embed("ndlist_free", fargs);
+      Py_DECREF(fargs);
+      Py_XDECREF(fr);
       delete lst;
       return -1;
     }

@@ -280,3 +280,37 @@ def test_maxpool_index_residual_first_max_ties_and_grads():
         y.sum().backward()
     np.testing.assert_array_equal(
         t.grad.asnumpy()[0, 0], [[1.0, 0.0], [0.0, 0.0]])
+
+
+def test_maxpool_index_residual_large_kernel():
+    """Window index must not wrap for kernels with > 256 offsets
+    (uint8 would route gradients to wrong positions)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(1, 1, 20, 20))
+    x.attach_grad()
+    with autograd.record():
+        # 17x17 kernel = 289 offsets > 256
+        y = mx.nd.Pooling(x, kernel=(17, 17), stride=(1, 1),
+                          pool_type="max")
+        y.sum().backward()
+    g = x.grad.asnumpy()[0, 0]
+    xa = x.asnumpy()[0, 0]
+    # each 17x17 window contributes 1.0 at its (first) argmax; verify
+    # total mass and that every contribution landed on a window max
+    assert g.sum() == y.size
+    nz = np.argwhere(g > 0)
+    for r, c in nz:
+        # the touched position must be the max of at least one window
+        # containing it
+        found = False
+        for wr in range(max(0, r - 16), min(4, r + 1)):
+            for wc in range(max(0, c - 16), min(4, c + 1)):
+                win = xa[wr:wr + 17, wc:wc + 17]
+                if xa[r, c] == win.max():
+                    found = True
+                    break
+            if found:
+                break
+        assert found, (r, c)
