@@ -3,12 +3,16 @@ CustomOp:428 / CustomOpProp:474 / register:694; C++ host
 src/operator/custom/custom.cc runs the callbacks on a dedicated
 thread).
 
-TPU-native scope: custom ops execute EAGERLY on the host between XLA
+TPU-native scope: eagerly, custom ops run on the host between XLA
 computations (the autograd tape records their backward like any other
-op). Inside hybridized/jit graphs they are not supported — a Python
-callback inside a compiled TPU program would stall the device (the
-reference has the same wart: custom ops break graph fusion and
-cross-device async). Use nd.Custom / mx.operator for the eager path."""
+op — nd.Custom). SYMBOLICALLY (sym.Custom, hybridize, executors) the
+user callbacks are staged into the compiled program via
+jax.pure_callback: the XLA program calls back onto the HOST at the
+node's position — the same architecture as the reference's dedicated
+custom-op thread (custom.cc), with the same costs (breaks fusion
+around the node, host round-trip per call). Inside the callback the
+user's NDArray code runs on the CPU backend, never re-entering the
+device that is executing the outer program."""
 
 from .base import MXNetError
 from . import autograd
@@ -131,6 +135,110 @@ class _CustomFunction(autograd.Function):
                           out_data=self._out_data,
                           in_grad=in_grads, aux=[])
         return in_grads if len(in_grads) > 1 else in_grads[0]
+
+
+def _instantiate(op_type, attrs):
+    if op_type not in _REGISTRY:
+        raise MXNetError(
+            "custom op type %s is not registered; registered: %s"
+            % (op_type, sorted(_REGISTRY)))
+    return _REGISTRY[op_type](**attrs)
+
+
+def _num_outputs_from_attrs(attrs):
+    """Arity resolver for the symbol layer (symbol._NUM_OUTPUTS_FROM_ATTRS)."""
+    a = {k: v for k, v in attrs.items()
+         if not k.startswith("__") and k != "op_type"}
+    return len(_instantiate(attrs["op_type"], a).list_outputs())
+
+
+def _register_symbolic():
+    """Register the graph-level `Custom` op: user callbacks staged into
+    compiled programs through jax.pure_callback (+ custom_vjp for the
+    user-defined backward)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from . import ops as _ops
+
+    def custom_fn(*datas, op_type=None, **attrs):
+        attrs = {k: v for k, v in attrs.items() if k != "is_train"}
+        prop = _instantiate(op_type, attrs)
+        in_shapes = [tuple(d.shape) for d in datas]
+        in_dtypes = [np.dtype(d.dtype) for d in datas]
+        out_shapes = [tuple(s) for s in prop.infer_shape(
+            [list(s) for s in in_shapes])[1]]
+        out_dtypes = [np.dtype(t) for t in prop.infer_type(in_dtypes)[1]]
+        op = prop.create_operator(None, in_shapes, in_dtypes)
+        n_out = len(out_shapes)
+        out_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                           for s, t in zip(out_shapes, out_dtypes))
+        in_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                          for s, t in zip(in_shapes, in_dtypes))
+
+        def _to_nd(arrays):
+            from . import ndarray as nd
+            from .context import Context
+            cpu = Context("cpu", 0)
+            return [nd.array(np.asarray(a), ctx=cpu) for a in arrays]
+
+        def _host_forward(*xs):
+            from . import ndarray as nd
+            ins = _to_nd(xs)
+            outs = [nd.zeros(s, dtype=t.name, ctx=ins[0].context
+                             if ins else None)
+                    for s, t in zip(out_shapes, out_dtypes)]
+            op.forward(is_train=True, req=["write"] * n_out,
+                       in_data=ins, out_data=outs, aux=[])
+            return tuple(np.asarray(o.asnumpy(), dtype=t)
+                         for o, t in zip(outs, out_dtypes))
+
+        def _host_backward(*args):
+            from . import ndarray as nd
+            gs = _to_nd(args[:n_out])
+            ins = _to_nd(args[n_out:n_out + len(datas)])
+            outs = _to_nd(args[n_out + len(datas):])
+            grads = [nd.zeros(s, dtype=t.name)
+                     for s, t in zip(in_shapes, in_dtypes)]
+            op.backward(req=["write"] * len(grads), out_grad=gs,
+                        in_data=ins, out_data=outs, in_grad=grads,
+                        aux=[])
+            return tuple(np.asarray(g.asnumpy(), dtype=t)
+                         for g, t in zip(grads, in_dtypes))
+
+        @jax.custom_vjp
+        def run(*xs):
+            return jax.pure_callback(_host_forward, out_struct, *xs,
+                                     vmap_method="sequential")
+
+        def run_fwd(*xs):
+            outs = jax.pure_callback(_host_forward, out_struct, *xs,
+                                     vmap_method="sequential")
+            return outs, (xs, outs)
+
+        def run_bwd(res, cts):
+            xs, outs = res
+            grads = jax.pure_callback(_host_backward, in_struct,
+                                      *(tuple(cts) + tuple(xs)
+                                        + tuple(outs)),
+                                      vmap_method="sequential")
+            return tuple(grads)
+
+        run.defvjp(run_fwd, run_bwd)
+        result = run(*datas)
+        return list(result) if n_out > 1 else result[0]
+
+    _ops.register(name="Custom", differentiable=True,
+                  num_outputs="n")(custom_fn)
+    # late registration: the sym namespace was synthesized before this
+    # module imported — attach the symbol function and arity resolver
+    from . import symbol as _symbol
+    _symbol.__dict__.setdefault(
+        "Custom", _symbol._make_sym_func("Custom"))
+    _symbol._VARIADIC_ARITY["Custom"] = _num_outputs_from_attrs
+
+
+_register_symbolic()
 
 
 def Custom(*inputs, **kwargs):

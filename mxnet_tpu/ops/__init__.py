@@ -21,9 +21,11 @@ flags (non-differentiable outputs, rng statefulness, mutable inputs).
 Deliberately unregistered reference names: the explicitly-registered
 backward ops (`_broadcast_backward`, `_contrib_backward_*`,
 `_split_v2_backward`, ...) — gradients come from jax.vjp on the forward
-fn, so backward never exists as a standalone graph node here — and
-`Custom`, which is an eager host-callback path (`nd.Custom`,
-operator.py) that cannot live inside a compiled XLA graph.
+fn, so backward never exists as a standalone graph node here. `Custom`
+registers late (operator._register_symbolic): user callbacks are staged
+into compiled graphs via jax.pure_callback with the user-defined
+backward as a custom_vjp, mirroring the reference's dedicated
+custom-op host thread (src/operator/custom/custom.cc).
 """
 
 import functools

@@ -139,3 +139,71 @@ def test_contrib_op_namespaces_and_tensorrt_stub():
     import pytest as _pytest
     with _pytest.raises(NotImplementedError):
         mx.contrib.tensorrt.init_tensorrt_params(None, {}, {})
+
+
+def test_symbolic_custom_op_in_compiled_graphs():
+    """sym.Custom: user CustomOp callbacks staged into jit-compiled
+    graphs via pure_callback, with the user-defined backward (reference
+    src/operator/custom/custom.cc runs them on a host thread)."""
+    import numpy as np
+    import mxnet_tpu.operator as op
+    from mxnet_tpu import gluon, autograd
+
+    @op.register("sq_plus")
+    class SqProp(op.CustomOpProp):
+        def __init__(self, bias="0.0"):
+            super().__init__(need_top_grad=True)
+            self.bias = float(bias)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            bias = self.bias
+
+            class SqOp(op.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0]
+                    self.assign(out_data[0], req[0], x * x + bias)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] * 2.0 * in_data[0])
+            return SqOp()
+
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+
+    # 1. bound executor (one compiled XLA program around the callback)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="sq_plus", bias="1.5") + 1.0
+    args = {"data": mx.nd.array(x)}
+    grads = {"data": mx.nd.zeros(x.shape)}
+    ex = net.bind(mx.cpu(), args, args_grad=grads)
+    y = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(y, x * x + 2.5, rtol=1e-5)
+    ex.backward(mx.nd.ones(x.shape))
+    np.testing.assert_allclose(grads["data"].asnumpy(), 2.0 * x,
+                               rtol=1e-5)
+
+    # 2. hybridized CachedOp path
+    from mxnet_tpu.cached_op import CachedOp
+    cop = CachedOp(mx.sym.Custom(mx.sym.Variable("data"),
+                                 op_type="sq_plus", bias="0.5"))
+    xin = mx.nd.array(x)
+    xin.attach_grad()
+    with autograd.record():
+        out = cop(xin)[0]
+        out.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), x * x + 0.5, rtol=1e-5)
+    np.testing.assert_allclose(xin.grad.asnumpy(), 2.0 * x, rtol=1e-5)
+
+    # 3. eager path unchanged
+    e = mx.nd.Custom(mx.nd.array(x), op_type="sq_plus", bias="2.0")
+    np.testing.assert_allclose(e.asnumpy(), x * x + 2.0, rtol=1e-5)
