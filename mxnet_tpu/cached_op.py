@@ -52,7 +52,9 @@ class CachedOp:
         self._aux_names = sym.list_auxiliary_states()
         self._input_names = sym.list_inputs()
         self._num_outputs = len(sym.list_outputs())
-        self._fns = {}  # (is_train, diff_names) -> jitted fn
+        # (is_train, diff_names, nan_guard, mirror) -> jitted fn;
+        # guard/mirror toggles force a retrace on purpose
+        self._fns = {}
         # RNG-free graphs (the common case) skip the per-call host-side
         # key split — a measurable slice of per-call latency
         # (benchmark/opperf.py --dispatch)
@@ -69,7 +71,8 @@ class CachedOp:
         from . import inspector as _inspector
         # keyed on the NaN-guard flag so toggling set_nan_guard()
         # retraces with/without the staged checks
-        key = (is_train, diff_names, _inspector.nan_guard_enabled())
+        key = (is_train, diff_names, _inspector.nan_guard_enabled(),
+               mirror_enabled(self._flags) if diff_names else False)
         fn = self._fns.get(key)
         if fn is not None:
             return fn

@@ -682,8 +682,12 @@ class ImageIter(DataIter):
         # batch on the default device (accelerator when present)
         from .context import Context
         dev = arr.devices().pop() if hasattr(arr, "devices") else None
-        ctx = Context("cpu", 0) if dev is None or dev.platform == "cpu" \
-            else Context("tpu", 0)
+        if dev is None or dev.platform == "cpu":
+            ctx = Context("cpu", 0)
+        else:
+            plat = {"cuda": "gpu", "rocm": "gpu"}.get(
+                dev.platform, dev.platform)
+            ctx = Context(plat if plat in ("gpu", "tpu") else "tpu", dev.id)
         data = nd.NDArray(arr, ctx)
         return DataBatch(data=[data], label=[nd.array(label_out)], pad=pad)
 

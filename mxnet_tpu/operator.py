@@ -106,8 +106,12 @@ class _CustomFunction(autograd.Function):
 
     def __call__(self, *inputs):
         # capture training state BEFORE Function.__call__ wraps forward in
-        # autograd.pause() (which would make is_recording() always False)
-        self._is_train = autograd.is_recording()
+        # autograd.pause() (which would reset the mode). Train MODE, not
+        # recording: `train_mode()` without record() must still run the
+        # op in training behavior, and `record(train_mode=False)` must
+        # not (reference Imperative::is_training(), custom.cc) — keeps
+        # eager consistent with the per-mode compiled graphs.
+        self._is_train = autograd.is_training()
         return super(_CustomFunction, self).__call__(*inputs)
 
     def forward(self, *inputs):
@@ -161,8 +165,13 @@ def _register_symbolic():
     import jax.numpy as jnp
     from . import ops as _ops
 
-    def custom_fn(*datas, op_type=None, **attrs):
-        attrs = {k: v for k, v in attrs.items() if k != "is_train"}
+    def custom_fn(*datas, op_type=None, is_train=False, **attrs):
+        # is_train is injected by the executor/CachedOp per traced mode
+        # (build_graph_fn is traced separately for train and inference, so
+        # each compiled program stages a callback with the right mode —
+        # reference passes ctx.is_train into CustomOperator::Forward,
+        # src/operator/custom/custom.cc).
+        is_train = bool(is_train)
         prop = _instantiate(op_type, attrs)
         in_shapes = [tuple(d.shape) for d in datas]
         in_dtypes = [np.dtype(d.dtype) for d in datas]
@@ -188,7 +197,7 @@ def _register_symbolic():
             outs = [nd.zeros(s, dtype=t.name, ctx=ins[0].context
                              if ins else None)
                     for s, t in zip(out_shapes, out_dtypes)]
-            op.forward(is_train=True, req=["write"] * n_out,
+            op.forward(is_train=is_train, req=["write"] * n_out,
                        in_data=ins, out_data=outs, aux=[])
             return tuple(np.asarray(o.asnumpy(), dtype=t)
                          for o, t in zip(outs, out_dtypes))

@@ -8,6 +8,8 @@ axis set (reference semantics in broadcast_reduce_op.h ReduceAxesCompute).
 
 import jax.numpy as jnp
 
+from ..base import MXNetError
+
 from . import register
 
 
@@ -57,7 +59,20 @@ def _arg_out_dtype(dim):
     # reference argmax emits float32; beyond int32 range float32 cannot
     # hold the position, so large-tensor mode emits int64 (documented
     # divergence, tests/test_large_tensor.py)
-    return "int64" if dim > 2**31 - 1 else "float32"
+    if dim > 2**31 - 1:
+        import jax
+        if not jax.config.jax_enable_x64:
+            # outside an x64 scope astype('int64') silently lowers to
+            # int32, truncating positions beyond 2^31 — fail loudly
+            # (large-tensor eager dispatch wraps itself in
+            # jax.experimental.enable_x64)
+            raise MXNetError(
+                "argmax/argmin over an axis longer than 2^31-1 requires "
+                "an x64 context inside compiled graphs; wrap the call in "
+                "jax.enable_x64(True) or use the eager large-tensor "
+                "dispatch")
+        return "int64"
+    return "float32"
 
 
 @register(name="argmax", differentiable=False)
@@ -74,11 +89,12 @@ def argmax(data, axis=None, keepdims=False):
 @register(name="argmin", differentiable=False)
 def argmin(data, axis=None, keepdims=False):
     if axis is None:
-        return jnp.argmin(data.reshape(-1)).astype("float32")
+        return jnp.argmin(data.reshape(-1)).astype(
+            _arg_out_dtype(data.size))
     r = jnp.argmin(data, axis=axis)
     if keepdims:
         r = jnp.expand_dims(r, axis)
-    return r.astype("float32")
+    return r.astype(_arg_out_dtype(data.shape[axis]))
 
 
 @register(name="argmax_channel", differentiable=False)

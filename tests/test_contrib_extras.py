@@ -207,3 +207,55 @@ def test_symbolic_custom_op_in_compiled_graphs():
     # 3. eager path unchanged
     e = mx.nd.Custom(mx.nd.array(x), op_type="sq_plus", bias="2.0")
     np.testing.assert_allclose(e.asnumpy(), x * x + 2.0, rtol=1e-5)
+
+
+def test_symbolic_custom_op_sees_real_is_train():
+    """The staged host callback receives the graph's actual mode — a
+    custom op that branches on is_train (e.g. custom dropout) must run
+    inference behavior under forward(is_train=False) (reference passes
+    ctx.is_train into CustomOperator::Forward, custom.cc)."""
+    import numpy as np
+    import mxnet_tpu.operator as op
+
+    @op.register("mode_probe")
+    class ModeProbeProp(op.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class ModeProbeOp(op.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    # +1 in train mode, -1 in inference
+                    delta = 1.0 if is_train else -1.0
+                    self.assign(out_data[0], req[0], in_data[0] + delta)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return ModeProbeOp()
+
+    x = np.zeros((2, 3), dtype=np.float32)
+    net = mx.sym.Custom(mx.sym.Variable("data"), op_type="mode_probe")
+    args = {"data": mx.nd.array(x)}
+    ex = net.bind(mx.cpu(), args, args_grad={"data": mx.nd.zeros(x.shape)})
+    y_inf = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y_inf, x - 1.0)
+    y_tr = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(y_tr, x + 1.0)
+
+    from mxnet_tpu.cached_op import CachedOp
+    from mxnet_tpu import autograd
+    cop = CachedOp(net)
+    np.testing.assert_allclose(cop(mx.nd.array(x))[0].asnumpy(), x - 1.0)
+    with autograd.record():
+        out = cop(mx.nd.array(x))[0]
+    np.testing.assert_allclose(out.asnumpy(), x + 1.0)
