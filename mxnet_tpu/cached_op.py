@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import engine as _engine
 from . import random as _random
 from .base import MXNetError
 from .executor import apply_mirror, build_graph_fn, mirror_enabled
@@ -25,6 +26,12 @@ from .executor import apply_mirror, build_graph_fn, mirror_enabled
 # fixed key fed to RNG-free graphs (never consumed; avoids a per-call
 # host-side split)
 _ZERO_KEY = None
+
+
+@jax.jit
+def _apply_vjp(vjp, ct):
+    (grads,) = vjp(ct)
+    return grads
 
 
 def _zero_key():
@@ -139,9 +146,14 @@ class CachedOp:
                 cts_t = tuple(cts) if isinstance(cts, (tuple, list)) \
                     else (cts,)
                 # cotangent structure matches pure's (outs, aux_up); aux
-                # updates get zero cotangents
+                # updates get zero cotangents. Apply the vjp closure
+                # INSIDE jit (it is a Partial — a pytree of residuals):
+                # calling it bare would interpret the backward jaxpr
+                # op-by-op eagerly — no XLA fusion, and on the CPU mesh
+                # the resulting flock of in-flight collective launches
+                # deadlocks (engine.py). Executor.bwd_fn does the same.
                 aux_ct = jax.tree.map(jnp.zeros_like, aux_up)
-                (grads,) = vjp_fn((cts_t, aux_ct))
+                grads = _apply_vjp(vjp_fn, (cts_t, aux_ct))
                 return grads
 
             node = autograd.TapeNode(
@@ -161,5 +173,11 @@ class CachedOp:
 
         for name, val in aux_up.items():
             by_name[name]._data = val
+
+        datas = [r._data for r in results]
+        if _engine.is_naive() or _engine.needs_serial_dispatch(datas):
+            # multi-device CPU launches must not overlap (collective
+            # rendezvous interleave hazard, engine.py); TPU never syncs
+            _engine.sync_outputs(datas)
 
         return results

@@ -70,6 +70,23 @@ def sync_outputs(arrays):
             a.block_until_ready()
 
 
+def needs_serial_dispatch(arrays):
+    """True when an eager dispatch must block before the next one: CPU
+    backend with an output sharded over more than one device. Concurrent
+    in-flight CPU executions containing collectives can interleave their
+    rendezvous differently across the per-device threads and deadlock;
+    TPU per-device streams execute programs in enqueue order (identical
+    across devices from the single dispatching thread), so the real
+    hardware path never pays this sync."""
+    if jax.default_backend() != "cpu":
+        return False
+    for a in arrays:
+        s = getattr(a, "sharding", None)
+        if s is not None and len(getattr(s, "device_set", ())) > 1:
+            return True
+    return False
+
+
 class _Worker(threading.Thread):
     def __init__(self):
         super().__init__(daemon=True)

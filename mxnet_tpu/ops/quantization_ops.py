@@ -63,7 +63,11 @@ def requantize(data, min_range, max_range, min_calib_range=None,
                max_calib_range=None):
     """int32 accumulator -> int8 with a (possibly calibrated) output
     range (requantize.cc)."""
-    in_s = _scale(min_range.reshape(()), max_range.reshape(()))
+    # input is INT32: its quantized range is 2^31-1, not 127
+    # (requantize.cc MinAbs(MaxValue<SrcDType>(), ...))
+    amax = jnp.maximum(jnp.abs(min_range.reshape(())),
+                       jnp.abs(max_range.reshape(())))
+    in_s = jnp.float32(2 ** 31 - 1) / jnp.maximum(amax, 1e-10)
     real = data.astype(jnp.float32) / in_s
     if min_calib_range is None or max_calib_range is None:
         mn = jnp.min(real)

@@ -94,9 +94,43 @@ def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", rng_key=No
 
 @register(name="_sample_unique_zipfian", differentiable=False, stateful_rng=True)
 def sample_unique_zipfian(range_max=1, shape=(), rng_key=None):
-    u = jax.random.uniform(rng_key, _shape(shape))
-    out = jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0
-    return out.astype("int64")
+    """Log-uniform (zipfian) candidate sampling, UNIQUE within each row
+    (sample_op.cc SampleUniqueZipfian: rejection until distinct).
+    Duplicate positions are resampled in a bounded while_loop — static
+    shapes, so it stays jittable."""
+    from jax import lax
+    shp = _shape(shape)
+    n = shp[-1] if shp else 1
+    batch = shp[:-1] if shp else ()
+
+    def draw(k, s):
+        u = jax.random.uniform(k, s)
+        return (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(
+            jnp.int32)
+
+    def dup_mask(v):
+        # True at every position holding a value already seen in-row
+        order = jnp.argsort(v, axis=-1)
+        sv = jnp.take_along_axis(v, order, -1)
+        dups = jnp.concatenate(
+            [jnp.zeros(sv.shape[:-1] + (1,), bool),
+             sv[..., 1:] == sv[..., :-1]], axis=-1)
+        return jnp.put_along_axis(jnp.zeros_like(dups), order, dups, -1,
+                                  inplace=False)
+
+    def cond(state):
+        v, _, i = state
+        return jnp.any(dup_mask(v)) & (i < 64)
+
+    def body(state):
+        v, k, i = state
+        k, sub = jax.random.split(k)
+        v = jnp.where(dup_mask(v), draw(sub, v.shape), v)
+        return v, k, i + 1
+
+    v0 = draw(rng_key, batch + (n,))
+    v, _, _ = lax.while_loop(cond, body, (v0, rng_key, 0))
+    return v.reshape(shp or ()).astype("int64")
 
 
 # Distribution-parameter tensor sampling (src/operator/random/multisample_op.cc)

@@ -46,6 +46,53 @@ def create(name, **kwargs):
     return _OPT_REGISTRY[name.lower()](**kwargs)
 
 
+def _align_update_devices(weight, grad, state):
+    """Reconcile weight/grad device placement before a fused update.
+
+    Data-parallel training with a batch sharded over a Mesh produces
+    grads committed to the mesh (replicated — XLA inserted the psum),
+    while weights initialized before the mesh existed sit committed to
+    one device; jit refuses to mix them. Promote the weight (and its
+    optimizer state) onto the wider device set — the update then runs
+    replicated on the mesh with no per-step broadcast, the sharded-
+    global-array analogue of the reference's per-device weight copies
+    (module/executor_group.py DP semantics). If instead the WEIGHT
+    spans more devices, bring the grad to it (pull-to-master)."""
+    gdata = getattr(grad, "_data", None)
+    wdata = getattr(weight, "_data", None)
+    gs = getattr(gdata, "sharding", None)
+    ws = getattr(wdata, "sharding", None)
+    if gs is None or ws is None:
+        return grad
+    try:
+        gdev, wdev = gs.device_set, ws.device_set
+    except AttributeError:
+        return grad
+    if gdev == wdev:
+        return grad
+    if len(gdev) > len(wdev):
+        weight._data = jax.device_put(wdata, gs)
+        _align_state_tree(state, gs)
+    else:
+        # shallow wrapper: the caller's grad must stay untouched, but
+        # the moved buffer needs no copy of the original
+        grad = NDArray(jax.device_put(gdata, ws), grad.context)
+    return grad
+
+
+def _align_state_tree(state, sharding):
+    if state is None:
+        return
+    if isinstance(state, (tuple, list)):
+        for s in state:
+            _align_state_tree(s, sharding)
+        return
+    data = getattr(state, "_data", None)
+    if data is not None and getattr(data, "sharding", None) is not None \
+            and data.sharding.device_set != sharding.device_set:
+        state._data = jax.device_put(data, sharding)
+
+
 def _flt(x):
     return jnp.asarray(x, dtype=jnp.float32)
 
@@ -106,6 +153,7 @@ class Optimizer(object):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
+        grad = _align_update_devices(weight, grad, state)
         if self.multi_precision and weight.dtype == jnp.bfloat16:
             weight_master_copy, original_state = state
             grad32 = grad.astype("float32")

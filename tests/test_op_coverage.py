@@ -1,0 +1,1093 @@
+"""Op-coverage gate + numeric sweep for the registry's long tail.
+
+Two jobs (VERDICT r3 item 5, reference pattern:
+tests/python/unittest/test_operator.py's per-op numerics):
+
+1. `test_op_numeric_sweep` — a table-driven oracle check for every op
+   that has no dedicated test elsewhere: each CASES entry builds inputs,
+   runs the registered op through the public `nd` namespace, and
+   compares against a NumPy-computed oracle.
+2. `test_all_ops_have_numeric_coverage` — the gate: enumerates
+   `ops.list_ops()` and fails if any op is neither exercised by name in
+   tests/ nor present in CASES nor on the documented ALLOWLIST. A new
+   op cannot land without numerics (or an explicit waiver) from now on.
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, ops
+
+
+def A(*vals, dtype="float32", shape=None):
+    arr = np.array(vals, dtype=dtype)
+    if shape is not None:
+        arr = arr.reshape(shape)
+    return nd.array(arr)
+
+
+def R(shape, seed=0, lo=-1.0, hi=1.0, dtype="float32"):
+    rs = np.random.RandomState(seed)
+    return nd.array((lo + (hi - lo) * rs.rand(*shape)).astype(dtype))
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+# ----------------------------------------------------------------------
+# CASES: op name -> callable returning (result, oracle[, rtol, atol])
+# ----------------------------------------------------------------------
+
+def _spd(x):  # NCHW space-to-depth oracle
+    n, c, h, w = x.shape
+    b = 2
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    return y.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+
+
+def _lrn(x, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0):
+    n, c, h, w = x.shape
+    out = np.empty_like(x)
+    half = nsize // 2
+    for i in range(c):
+        lo, hi = max(0, i - half), min(c, i + half + 1)
+        sq = (x[:, lo:hi] ** 2).sum(axis=1)
+        out[:, i] = x[:, i] / (knorm + (alpha / nsize) * sq) ** beta
+    return out
+
+
+def _interleaved_qk(qkv, heads):
+    # qkv: (T, N, 3*H*D) interleaved per head [q|k|v]
+    T, N, P = qkv.shape
+    d = P // heads // 3
+    x = qkv.reshape(T, N, heads, 3, d)
+    q, k = x[..., 0, :], x[..., 1, :]
+    q = q.transpose(1, 2, 0, 3).reshape(N * heads, T, d)
+    k = k.transpose(1, 2, 0, 3).reshape(N * heads, T, d)
+    return np.einsum("btd,bsd->bts", q / np.sqrt(d), k)
+
+
+def _interleaved_valatt(qkv, att, heads):
+    T, N, P = qkv.shape
+    d = P // heads // 3
+    v = qkv.reshape(T, N, heads, 3, d)[..., 2, :]
+    v = v.transpose(1, 2, 0, 3).reshape(N * heads, T, d)
+    out = np.einsum("bts,bsd->btd", att, v)
+    return out.reshape(N, heads, T, d).transpose(2, 0, 1, 3).reshape(
+        T, N, heads * d)
+
+
+def _rois_oracle(data, rois, size, scale):
+    # max-pool each roi bin (ROIPooling reference semantics, whole-pixel)
+    out = np.zeros((rois.shape[0], data.shape[1]) + size, data.dtype)
+    for ri, (b, x1, y1, x2, y2) in enumerate(rois):
+        b = int(b)
+        x1, y1 = int(round(x1 * scale)), int(round(y1 * scale))
+        x2, y2 = int(round(x2 * scale)), int(round(y2 * scale))
+        rw, rh = max(x2 - x1 + 1, 1), max(y2 - y1 + 1, 1)
+        for ph in range(size[0]):
+            for pw in range(size[1]):
+                hs = y1 + int(np.floor(ph * rh / size[0]))
+                he = y1 + int(np.ceil((ph + 1) * rh / size[0]))
+                ws = x1 + int(np.floor(pw * rw / size[1]))
+                we = x1 + int(np.ceil((pw + 1) * rw / size[1]))
+                hs, he = np.clip([hs, he], 0, data.shape[2])
+                ws, we = np.clip([ws, we], 0, data.shape[3])
+                if he > hs and we > ws:
+                    out[ri, :, ph, pw] = data[b, :, hs:he, ws:we].max(
+                        axis=(1, 2))
+    return out
+
+
+def case_unary(name, fn, lo=-0.9, hi=0.9):
+    def c():
+        x = R((3, 4), seed=7, lo=lo, hi=hi)
+        return getattr(nd, name)(x), fn(_np(x))
+    return c
+
+
+def case_scalar(name, fn, scalar=3.0, lo=-2.0, hi=2.0):
+    def c():
+        x = R((2, 5), seed=3, lo=lo, hi=hi)
+        return getattr(nd, name)(x, scalar=scalar), fn(_np(x), scalar)
+    return c
+
+
+def case_binary(name, fn):
+    def c():
+        a, b = R((3, 4), 1, -2, 2), R((1, 4), 2, -2, 2)
+        return getattr(nd, name)(a, b), fn(_np(a), _np(b))
+    return c
+
+
+def case_sampler(name, oracle_mean, oracle_std, kwargs, shape=(4000,),
+                 via_params=None):
+    """Numeric check on sampler moments under a fixed seed."""
+    def c():
+        mx.random.seed(1234)
+        if via_params is not None:
+            params = {k: nd.array(np.array(v, dtype="float32"))
+                      for k, v in via_params.items()}
+            out = getattr(nd, name)(shape=shape, **params)
+            got = _np(out).reshape(-1)
+        else:
+            out = getattr(nd, name)(shape=shape, **kwargs)
+            got = _np(out).reshape(-1)
+        return (nd.array(np.array([got.mean(), got.std()])),
+                np.array([oracle_mean, oracle_std]), 0.15, 0.15)
+    return c
+
+
+CASES = {}
+
+# ---- elementwise unary ------------------------------------------------
+for n, f in [
+    ("tan", np.tan), ("sinh", np.sinh), ("cosh", np.cosh),
+    ("arccos", np.arccos), ("arcsin", np.arcsin),
+    ("arctanh", np.arctanh), ("log2", lambda x: np.log2(np.abs(x) + 1.1)),
+    ("log10", lambda x: np.log10(np.abs(x) + 1.1)),
+    ("radians", np.radians), ("rint", np.rint), ("trunc", np.trunc),
+    ("logical_not", lambda x: (~(x != 0)).astype(np.float32)),
+]:
+    if n in ("log2", "log10"):
+        def make(nn, ff):
+            def c():
+                x = R((3, 4), 7, 0.2, 3.0)
+                return getattr(nd, nn)(x), ff(_np(x))
+            return c
+        CASES[n] = make(n, {"log2": np.log2, "log10": np.log10}[n])
+    else:
+        CASES[n] = case_unary(n, f)
+CASES["arccosh"] = case_unary("arccosh", np.arccosh, lo=1.1, hi=3.0)
+CASES["rcbrt"] = case_unary("rcbrt", lambda x: 1.0 / np.cbrt(x),
+                            lo=0.3, hi=2.0)
+CASES["erfinv"] = case_unary(
+    "erfinv", lambda x: np.vectorize(
+        lambda v: __import__("math").erf(v))(x), lo=-0.9, hi=0.9)
+
+
+def _erfinv_case():
+    from scipy_free_erfinv import nothing  # pragma: no cover
+CASES["erfinv"] = None  # replaced below
+
+
+def erfinv_case():
+    # oracle: erf(erfinv(x)) == x
+    x = R((3, 4), 7, -0.9, 0.9)
+    y = _np(nd.erfinv(x))
+    import math
+    return nd.array(np.vectorize(math.erf)(y).astype(np.float32)), _np(x)
+CASES["erfinv"] = erfinv_case
+
+
+def isinf_case():
+    x = nd.array(np.array([1.0, np.inf, -np.inf, np.nan], np.float32))
+    return nd.isinf(x), np.array([0, 1, 1, 0], np.float32)
+CASES["isinf"] = isinf_case
+
+
+def hard_sigmoid_case():
+    x = R((3, 4), 5, -4, 4)
+    return (nd.hard_sigmoid(x),
+            np.clip(0.2 * _np(x) + 0.5, 0, 1))
+CASES["hard_sigmoid"] = hard_sigmoid_case
+
+
+def softmin_case():
+    x = R((2, 5), 5, -2, 2)
+    e = np.exp(-_np(x) - (-_np(x)).max(-1, keepdims=True))
+    return nd.softmin(x), e / e.sum(-1, keepdims=True)
+CASES["softmin"] = softmin_case
+
+# ---- scalar ops -------------------------------------------------------
+for n, f in [
+    ("_plus_scalar", lambda x, s: x + s),
+    ("_minus_scalar", lambda x, s: x - s),
+    ("_rminus_scalar", lambda x, s: s - x),
+    ("_mul_scalar", lambda x, s: x * s),
+    ("_div_scalar", lambda x, s: x / s),
+    ("_npi_true_divide_scalar", lambda x, s: x / s),
+    ("_maximum_scalar", lambda x, s: np.maximum(x, s)),
+    ("_minimum_scalar", lambda x, s: np.minimum(x, s)),
+    ("_equal_scalar", lambda x, s: (x == s).astype(np.float32)),
+    ("_not_equal_scalar", lambda x, s: (x != s).astype(np.float32)),
+    ("_greater_scalar", lambda x, s: (x > s).astype(np.float32)),
+    ("_greater_equal_scalar", lambda x, s: (x >= s).astype(np.float32)),
+    ("_lesser_scalar", lambda x, s: (x < s).astype(np.float32)),
+    ("_lesser_equal_scalar", lambda x, s: (x <= s).astype(np.float32)),
+    ("_hypot_scalar", lambda x, s: np.hypot(x, s)),
+]:
+    CASES[n] = case_scalar(n, f, scalar=0.5)
+for n, f in [
+    ("_mod_scalar", lambda x, s: np.mod(x, s)),
+    ("_rmod_scalar", lambda x, s: np.mod(s, x)),
+    ("_power_scalar", lambda x, s: np.power(x, s)),
+    ("_rpower_scalar", lambda x, s: np.power(s, x)),
+    ("_rdiv_scalar", lambda x, s: s / x),
+]:
+    CASES[n] = case_scalar(n, f, scalar=1.5, lo=0.5, hi=2.0)
+
+# ---- broadcast / elemwise binary -------------------------------------
+for n, f in [
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_greater_equal",
+     lambda a, b: (a >= b).astype(np.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_lesser_equal",
+     lambda a, b: (a <= b).astype(np.float32)),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_logical_and",
+     lambda a, b: ((a != 0) & (b != 0)).astype(np.float32)),
+    ("broadcast_logical_or",
+     lambda a, b: ((a != 0) | (b != 0)).astype(np.float32)),
+    ("broadcast_logical_xor",
+     lambda a, b: ((a != 0) ^ (b != 0)).astype(np.float32)),
+]:
+    CASES[n] = case_binary(n, f)
+for n, f in [("elemwise_sub", lambda a, b: a - b),
+             ("elemwise_mul", lambda a, b: a * b)]:
+    def make_same_shape(nn, ff):
+        def c():  # elemwise_* requires identical shapes (no broadcast)
+            a, b = R((3, 4), 1, -2, 2), R((3, 4), 2, -2, 2)
+            return getattr(nd, nn)(a, b), ff(_np(a), _np(b))
+        return c
+    CASES[n] = make_same_shape(n, f)
+
+
+def broadcast_mod_case():
+    a, b = R((3, 4), 1, 0.5, 4.0), R((1, 4), 2, 0.5, 4.0)
+    return nd.broadcast_mod(a, b), np.mod(_np(a), _np(b))
+CASES["broadcast_mod"] = broadcast_mod_case
+
+
+def elemwise_div_case():
+    a, b = R((3, 4), 1, 0.5, 2.0), R((3, 4), 2, 0.5, 2.0)
+    return nd.elemwise_div(a, b), _np(a) / _np(b)
+CASES["elemwise_div"] = elemwise_div_case
+
+
+def broadcast_like_case():
+    a, b = R((1, 4), 1), R((3, 4), 2)
+    return nd.broadcast_like(a, b), np.broadcast_to(_np(a), (3, 4))
+CASES["broadcast_like"] = broadcast_like_case
+
+
+def add_n_case():
+    xs = [R((2, 3), s) for s in range(3)]
+    return nd.add_n(*xs), sum(_np(x) for x in xs)
+CASES["add_n"] = add_n_case
+
+# ---- shape / indexing -------------------------------------------------
+CASES["squeeze"] = lambda: (nd.squeeze(R((1, 3, 1, 2), 1)),
+                            _np(R((1, 3, 1, 2), 1)).squeeze())
+CASES["shape_array"] = lambda: (nd.shape_array(R((2, 5), 1)),
+                                np.array([2, 5], np.int64))
+CASES["size_array"] = lambda: (nd.size_array(R((2, 5), 1)),
+                               np.array([10], np.int64))
+CASES["reshape_like"] = lambda: (
+    nd.reshape_like(R((6,), 1), R((2, 3), 2)),
+    _np(R((6,), 1)).reshape(2, 3))
+
+
+def slice_like_case():
+    a, b = R((4, 5), 1), R((2, 3), 2)
+    return nd.slice_like(a, b), _np(a)[:2, :3]
+CASES["slice_like"] = slice_like_case
+
+
+def space_to_depth_case():
+    x = R((1, 2, 4, 4), 3)
+    return nd.space_to_depth(x, block_size=2), _spd(_np(x))
+CASES["space_to_depth"] = space_to_depth_case
+
+
+def diag_case():
+    x = R((4, 4), 2)
+    return nd.diag(x), np.diag(_np(x))
+CASES["diag"] = diag_case
+
+
+def argsort_case():
+    x = R((3, 5), 4)
+    return nd.argsort(x, axis=-1), np.argsort(
+        _np(x), axis=-1, kind="stable").astype(np.float32)
+CASES["argsort"] = argsort_case
+
+
+def argmin_case():
+    x = R((3, 5), 4)
+    return nd.argmin(x, axis=1), np.argmin(_np(x), 1).astype(np.float32)
+CASES["argmin"] = argmin_case
+
+
+def argmax_channel_case():
+    x = R((3, 5), 4)
+    return nd.argmax_channel(x), np.argmax(_np(x), -1).astype(np.float32)
+CASES["argmax_channel"] = argmax_channel_case
+
+
+def batch_take_case():
+    x = R((3, 4), 1)
+    idx = nd.array(np.array([0, 2, 1], np.float32))
+    return nd.batch_take(x, idx), _np(x)[np.arange(3), [0, 2, 1]]
+CASES["batch_take"] = batch_take_case
+
+
+def gather_nd_case():
+    x = R((3, 4), 1)
+    idx = nd.array(np.array([[0, 2], [1, 3]], np.float32))
+    return nd.gather_nd(x, idx), _np(x)[[0, 2], [1, 3]]
+CASES["gather_nd"] = gather_nd_case
+
+
+def scatter_nd_case():
+    data = nd.array(np.array([9.0, 8.0], np.float32))
+    idx = nd.array(np.array([[0, 1], [0, 1]], np.float32))
+    out = nd.scatter_nd(data, idx, shape=(2, 2))
+    want = np.zeros((2, 2), np.float32)
+    want[0, 0], want[1, 1] = 9.0, 8.0
+    return out, want
+CASES["scatter_nd"] = scatter_nd_case
+
+
+def scatter_set_nd_case():
+    lhs = R((2, 2), 1)
+    data = nd.array(np.array([5.0, 6.0], np.float32))
+    idx = nd.array(np.array([[0, 1], [0, 1]], np.float32))
+    out = nd._scatter_set_nd(lhs, idx, data)
+    want = _np(lhs).copy()
+    want[0, 0], want[1, 1] = 5.0, 6.0
+    return out, want
+CASES["_scatter_set_nd"] = scatter_set_nd_case
+
+
+def boolean_mask_dense_case():
+    # static-shape variant: masked-out rows are ZEROED, shape kept
+    x = R((4, 2), 1)
+    m = nd.array(np.array([1, 0, 1, 0], np.float32))
+    got = nd.boolean_mask_dense(x, m)
+    want = _np(x) * np.array([1, 0, 1, 0], np.float32)[:, None]
+    return got, want
+CASES["boolean_mask_dense"] = boolean_mask_dense_case
+
+
+def zeros_without_dtype_case():
+    out = nd._zeros_without_dtype(shape=(2, 3))
+    return out, np.zeros((2, 3), np.float32)
+CASES["_zeros_without_dtype"] = zeros_without_dtype_case
+
+# ---- reductions -------------------------------------------------------
+def nanprod_case():
+    x = np.array([[1.0, np.nan, 2.0], [3.0, 4.0, np.nan]], np.float32)
+    return nd.nanprod(nd.array(x), axis=1), np.nanprod(x, axis=1)
+CASES["nanprod"] = nanprod_case
+
+
+def moments_case():
+    x = R((3, 4), 2)
+    mean, var = nd.moments(x, axes=(0, 1))
+    return (nd.concat(mean.reshape((1,)), var.reshape((1,)), dim=0),
+            np.array([_np(x).mean(), _np(x).var()], np.float32))
+CASES["moments"] = moments_case
+
+# ---- linalg -----------------------------------------------------------
+def _spdm(seed, n=3, batch=True):
+    rs = np.random.RandomState(seed)
+    a = rs.rand(n, n).astype(np.float32)
+    m = a @ a.T + n * np.eye(n, dtype=np.float32)
+    return m[None] if batch else m
+
+
+CASES["linalg_det"] = lambda: (
+    nd.linalg_det(nd.array(_spdm(3))),
+    np.linalg.det(_spdm(3)).astype(np.float32), 1e-3, 1e-3)
+
+
+def linalg_slogdet_case():
+    m = _spdm(4)
+    sign, logabs = nd.linalg_slogdet(nd.array(m))
+    s, l = np.linalg.slogdet(m)
+    return (nd.concat(sign.reshape((1,)), logabs.reshape((1,)), dim=0),
+            np.array([s[0], l[0]], np.float32), 1e-3, 1e-3)
+CASES["linalg_slogdet"] = linalg_slogdet_case
+
+CASES["linalg_inverse"] = lambda: (
+    nd.linalg_inverse(nd.array(_spdm(5))),
+    np.linalg.inv(_spdm(5)), 1e-2, 1e-3)
+
+
+def linalg_gemm_case():
+    a, b, c = R((1, 2, 3), 1), R((1, 3, 4), 2), R((1, 2, 4), 3)
+    got = nd.linalg_gemm(a, b, c, alpha=2.0, beta=0.5)
+    return got, 2.0 * _np(a) @ _np(b) + 0.5 * _np(c)
+CASES["linalg_gemm"] = linalg_gemm_case
+
+
+def linalg_gemm2_case():
+    a, b = R((1, 2, 3), 1), R((1, 3, 4), 2)
+    return nd.linalg_gemm2(a, b, alpha=1.5), 1.5 * _np(a) @ _np(b)
+CASES["linalg_gemm2"] = linalg_gemm2_case
+
+
+def linalg_potrf_case():
+    m = _spdm(6)
+    l = nd.linalg_potrf(nd.array(m))
+    return nd.linalg_gemm2(l, l, transpose_b=True), m, 1e-3, 1e-3
+CASES["linalg_potrf"] = linalg_potrf_case
+
+
+def linalg_potri_case():
+    m = _spdm(7)
+    got = nd.linalg_potri(nd.linalg_potrf(nd.array(m)))
+    return got, np.linalg.inv(m), 1e-2, 1e-3
+CASES["linalg_potri"] = linalg_potri_case
+
+
+def linalg_trmm_case():
+    m = np.tril(_spdm(8)[0])[None]
+    b = R((1, 3, 3), 2)
+    return nd.linalg_trmm(nd.array(m), b), m @ _np(b), 1e-3, 1e-3
+CASES["linalg_trmm"] = linalg_trmm_case
+
+
+def linalg_trsm_case():
+    m = np.tril(_spdm(9)[0])[None]
+    b = R((1, 3, 3), 2)
+    got = nd.linalg_trsm(nd.array(m), b)
+    return nd.linalg_trmm(nd.array(m), got), _np(b), 1e-2, 1e-3
+CASES["linalg_trsm"] = linalg_trsm_case
+
+
+def linalg_syrk_case():
+    a = R((1, 2, 3), 4)
+    return (nd.linalg_syrk(a, alpha=1.0),
+            _np(a) @ _np(a).transpose(0, 2, 1))
+CASES["linalg_syrk"] = linalg_syrk_case
+
+
+def linalg_extractdiag_case():
+    x = R((1, 3, 3), 1)
+    return nd.linalg_extractdiag(x), np.diagonal(
+        _np(x), axis1=-2, axis2=-1)
+CASES["linalg_extractdiag"] = linalg_extractdiag_case
+
+
+def linalg_makediag_case():
+    x = R((1, 3), 1)
+    want = np.zeros((1, 3, 3), np.float32)
+    want[0][np.diag_indices(3)] = _np(x)[0]
+    return nd.linalg_makediag(x), want
+CASES["linalg_makediag"] = linalg_makediag_case
+
+
+def linalg_extracttrian_case():
+    x = R((1, 3, 3), 1)
+    xl = np.tril(_np(x)[0])
+    want = xl[np.tril_indices(3)][None]
+    return nd.linalg_extracttrian(x), want
+CASES["linalg_extracttrian"] = linalg_extracttrian_case
+
+
+def linalg_maketrian_case():
+    x = R((1, 6), 1)
+    got = nd.linalg_maketrian(x)
+    want = np.zeros((3, 3), np.float32)
+    want[np.tril_indices(3)] = _np(x)[0]
+    return got, want[None]
+CASES["linalg_maketrian"] = linalg_maketrian_case
+
+
+def linalg_sumlogdiag_case():
+    m = _spdm(2)
+    return (nd.linalg_sumlogdiag(nd.array(m)),
+            np.log(np.diagonal(m, axis1=-2, axis2=-1)).sum(-1),
+            1e-3, 1e-3)
+CASES["linalg_sumlogdiag"] = linalg_sumlogdiag_case
+
+
+def linalg_syevd_case():
+    m = _spdm(11)
+    u, lam = nd.linalg_syevd(nd.array(m))
+    w = np.linalg.eigvalsh(m[0])
+    return lam, w[None], 1e-2, 1e-2
+CASES["linalg_syevd"] = linalg_syevd_case
+
+
+def linalg_gelqf_case():
+    a = R((1, 2, 4), 3)
+    l, q = nd.linalg_gelqf(a)  # A = L @ Q, L lower-tri, Q row-orthonormal
+    rec = nd.linalg_gemm2(l, q)
+    return rec, _np(a), 1e-3, 1e-3
+CASES["linalg_gelqf"] = linalg_gelqf_case
+
+
+def khatri_rao_case():
+    a, b = R((2, 3), 1), R((4, 3), 2)
+    want = np.vstack([np.kron(_np(a)[:, i], _np(b)[:, i]).reshape(-1)
+                      for i in range(3)]).T
+    return nd.khatri_rao(a, b), want
+CASES["khatri_rao"] = khatri_rao_case
+
+# ---- nn layer ops -----------------------------------------------------
+def lrn_case():
+    x = R((2, 5, 3, 3), 1, 0.1, 1.0)
+    got = nd.LRN(x, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    return got, _lrn(_np(x)), 1e-3, 1e-4
+CASES["LRN"] = lrn_case
+
+
+def softmax_activation_case():
+    x = R((3, 5), 2)
+    e = np.exp(_np(x) - _np(x).max(-1, keepdims=True))
+    return nd.SoftmaxActivation(x), e / e.sum(-1, keepdims=True)
+CASES["SoftmaxActivation"] = softmax_activation_case
+
+
+def logistic_regression_output_case():
+    x, y = R((4, 3), 1), R((4, 3), 2, 0, 1)
+    return (nd.LogisticRegressionOutput(x, y),
+            1.0 / (1.0 + np.exp(-_np(x))))
+CASES["LogisticRegressionOutput"] = logistic_regression_output_case
+
+
+def mae_regression_output_case():
+    x, y = R((4, 3), 1), R((4, 3), 2)
+    return nd.MAERegressionOutput(x, y), _np(x)
+CASES["MAERegressionOutput"] = mae_regression_output_case
+
+
+def sequence_reverse_case():
+    x = R((4, 2, 3), 1)  # (seq, batch, feat)
+    return nd.SequenceReverse(x), _np(x)[::-1]
+CASES["SequenceReverse"] = sequence_reverse_case
+
+
+def slice_channel_case():
+    x = R((2, 6), 1)
+    outs = nd.SliceChannel(x, num_outputs=2, axis=1)
+    return outs[1], _np(x)[:, 3:]
+CASES["SliceChannel"] = slice_channel_case
+
+
+def upsampling_case():
+    x = R((1, 2, 3, 3), 1)
+    got = nd.UpSampling(x, scale=2, sample_type="nearest")
+    return got, _np(x).repeat(2, axis=2).repeat(2, axis=3)
+CASES["UpSampling"] = upsampling_case
+
+
+def roi_pooling_case():
+    data = R((1, 2, 8, 8), 1, 0, 1)
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 6, 6]], np.float32)
+    got = nd.ROIPooling(data, nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    return got, _rois_oracle(_np(data), rois, (2, 2), 1.0), 1e-4, 1e-4
+CASES["ROIPooling"] = roi_pooling_case
+
+
+def softmax_cross_entropy_case():
+    x = R((4, 5), 1)
+    y = nd.array(np.array([0, 2, 4, 1], np.float32))
+    logp = _np(x) - _np(x).max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    want = -logp[np.arange(4), [0, 2, 4, 1]].sum()
+    return nd.softmax_cross_entropy(x, y), np.array([want]), 1e-4, 1e-4
+CASES["softmax_cross_entropy"] = softmax_cross_entropy_case
+
+
+def ctc_loss_case():
+    # 1 timestep-3 vocab trivial case: loss = -log softmax(data)[label]
+    T, N, C = 2, 1, 3
+    data = R((T, N, C), 1)
+    label = nd.array(np.array([[1, 0]], np.float32))  # one label + pad
+    got = nd.CTCLoss(data, label)
+    # oracle via brute-force over alignments of label seq [1]
+    p = np.exp(_np(data)) / np.exp(_np(data)).sum(-1, keepdims=True)
+    # paths for label "1" over 2 steps with blank=0: (1,1),(0,1),(1,0)
+    want = -np.log(p[0, 0, 1] * p[1, 0, 1] + p[0, 0, 0] * p[1, 0, 1]
+                   + p[0, 0, 1] * p[1, 0, 0])
+    return got, np.array([want], np.float32), 1e-3, 1e-3
+CASES["CTCLoss"] = ctc_loss_case
+
+# ---- contrib ----------------------------------------------------------
+def adaptive_avg_pool_case():
+    x = R((1, 2, 4, 4), 1)
+    got = nd.contrib.AdaptiveAvgPooling2D(x, output_size=2)
+    want = _np(x).reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    return got, want
+CASES["_contrib_AdaptiveAvgPooling2D"] = adaptive_avg_pool_case
+
+
+def bilinear_resize_case():
+    x = R((1, 1, 2, 2), 1)
+    got = nd.contrib.BilinearResize2D(x, height=4, width=4)
+    # corners must match input corners (align_corners semantics)
+    g = _np(got)
+    want = _np(x)
+    got_corners = np.array([g[0, 0, 0, 0], g[0, 0, 0, -1],
+                            g[0, 0, -1, 0], g[0, 0, -1, -1]])
+    want_corners = np.array([want[0, 0, 0, 0], want[0, 0, 0, 1],
+                             want[0, 0, 1, 0], want[0, 0, 1, 1]])
+    return nd.array(got_corners), want_corners
+CASES["_contrib_BilinearResize2D"] = bilinear_resize_case
+
+
+def box_nms_case():
+    boxes = np.array([[1, 0.9, 0, 0, 10, 10],
+                      [1, 0.8, 1, 1, 10, 10],     # iou > 0.5 with #0
+                      [1, 0.7, 20, 20, 30, 30]], np.float32)
+    got = nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5)
+    g = _np(got)
+    keep_scores = sorted(g[g[:, 0] >= 0][:, 1].tolist(), reverse=True)
+    return (nd.array(np.array(keep_scores, np.float32)),
+            np.array([0.9, 0.7], np.float32))
+CASES["_contrib_box_nms"] = box_nms_case
+
+
+def div_sqrt_dim_case():
+    x = R((3, 4), 1)
+    return nd.contrib.div_sqrt_dim(x), _np(x) / np.sqrt(4.0)
+CASES["_contrib_div_sqrt_dim"] = div_sqrt_dim_case
+
+
+def fft_case():
+    x = R((2, 8), 1)
+    got = nd.contrib.fft(x)
+    f = np.fft.fft(_np(x), axis=-1)
+    want = np.empty((2, 16), np.float32)
+    want[:, 0::2], want[:, 1::2] = f.real, f.imag
+    return got, want, 1e-3, 1e-4
+CASES["_contrib_fft"] = fft_case
+
+
+def ifft_case():
+    x = R((2, 16), 1)
+    got = nd.contrib.ifft(x)
+    comp = _np(x)[:, 0::2] + 1j * _np(x)[:, 1::2]
+    want = np.fft.ifft(comp, axis=-1).real * comp.shape[-1]
+    return got, want.astype(np.float32), 1e-3, 1e-4
+CASES["_contrib_ifft"] = ifft_case
+
+
+def gradientmultiplier_case():
+    x = R((3, 4), 1)
+    return nd.contrib.gradientmultiplier(x, scalar=2.0), _np(x)
+CASES["_contrib_gradientmultiplier"] = gradientmultiplier_case
+
+
+def arange_like_case():
+    x = R((2, 5), 1)
+    return (nd.contrib.arange_like(x, axis=1),
+            np.arange(5, dtype=np.float32))
+CASES["_contrib_arange_like"] = arange_like_case
+
+
+def index_array_case():
+    x = R((2, 3), 1)
+    got = nd.contrib.index_array(x)
+    want = np.stack(np.meshgrid(np.arange(2), np.arange(3),
+                                indexing="ij"), -1).astype(np.int64)
+    return got, want
+CASES["_contrib_index_array"] = index_array_case
+
+
+def index_copy_case():
+    x = R((4, 2), 1)
+    idx = nd.array(np.array([1, 3], np.float32))
+    new = R((2, 2), 5)
+    got = nd.contrib.index_copy(x, idx, new)
+    want = _np(x).copy()
+    want[[1, 3]] = _np(new)
+    return got, want
+CASES["_contrib_index_copy"] = index_copy_case
+
+
+def interleaved_qk_case():
+    qkv = R((3, 2, 12), 1)  # T=3 N=2 heads=2 d=2
+    got = nd.contrib.interleaved_matmul_selfatt_qk(qkv, heads=2)
+    return got, _interleaved_qk(_np(qkv), 2), 1e-3, 1e-4
+CASES["_contrib_interleaved_matmul_selfatt_qk"] = interleaved_qk_case
+
+
+def interleaved_valatt_case():
+    qkv = R((3, 2, 12), 1)
+    att = R((4, 3, 3), 2, 0, 1)
+    got = nd.contrib.interleaved_matmul_selfatt_valatt(qkv, att, heads=2)
+    return got, _interleaved_valatt(_np(qkv), _np(att), 2), 1e-3, 1e-4
+CASES["_contrib_interleaved_matmul_selfatt_valatt"] = \
+    interleaved_valatt_case
+
+
+def count_sketch_case():
+    # linearity oracle: sketch(x+y) == sketch(x) + sketch(y) for same
+    # hash tables; plus L2-norm preservation in expectation is skipped
+    x, y = R((2, 8), 1), R((2, 8), 2)
+    h = nd.array(np.random.RandomState(3).randint(
+        0, 4, (1, 8)).astype(np.float32))
+    s = nd.array((np.random.RandomState(4).randint(
+        0, 2, (1, 8)) * 2 - 1).astype(np.float32))
+    a = nd.contrib.count_sketch(x, h, s, out_dim=4)
+    b = nd.contrib.count_sketch(y, h, s, out_dim=4)
+    both = nd.contrib.count_sketch(x + y, h, s, out_dim=4)
+    return both, _np(a) + _np(b), 1e-4, 1e-4
+CASES["_contrib_count_sketch"] = count_sketch_case
+
+
+def requantize_case():
+    # int32 quantized (with min/max) -> int8: value round-trip
+    xq = nd.array(np.array([[100000, -200000]], np.int32))
+    mn = nd.array(np.array([-1.0], np.float32))
+    mx_ = nd.array(np.array([1.0], np.float32))
+    out, omin, omax = nd.contrib.requantize(xq, mn, mx_)
+    real = _np(xq) * (1.0 / (2 ** 31 - 1))
+    rec = _np(out).astype(np.float32) * (_np(omax)[0] / 127.0)
+    return nd.array(rec), real, 0.05, 1e-4
+CASES["_contrib_requantize"] = requantize_case
+
+# ---- samplers (moment checks, fixed seed) ----------------------------
+CASES["sample_normal"] = case_sampler(
+    "sample_normal", 1.0, 2.0, {},
+    via_params={"mu": [1.0], "sigma": [2.0]}, shape=(4000,))
+CASES["sample_gamma"] = case_sampler(
+    "sample_gamma", 6.0, np.sqrt(12.0), {},
+    via_params={"alpha": [3.0], "beta": [2.0]}, shape=(4000,))
+CASES["sample_exponential"] = case_sampler(
+    "sample_exponential", 0.5, 0.5, {},
+    via_params={"lam": [2.0]}, shape=(4000,))
+CASES["sample_poisson"] = case_sampler(
+    "sample_poisson", 4.0, 2.0, {},
+    via_params={"lam": [4.0]}, shape=(4000,))
+CASES["sample_uniform"] = case_sampler(
+    "sample_uniform", 0.5, np.sqrt(1.0 / 12), {},
+    via_params={"low": [0.0], "high": [1.0]}, shape=(4000,))
+
+
+def random_poisson_case():
+    mx.random.seed(5)
+    out = _np(nd._random_poisson(lam=3.0, shape=(4000,))).reshape(-1)
+    return (nd.array(np.array([out.mean()])), np.array([3.0]),
+            0.1, 0.1)
+CASES["_random_poisson"] = random_poisson_case
+
+
+def random_randint_case():
+    mx.random.seed(6)
+    out = _np(nd._random_randint(low=0, high=10, shape=(4000,)))
+    got = np.array([out.min() >= 0, out.max() <= 9,
+                    abs(out.mean() - 4.5) < 0.5], np.float32)
+    return nd.array(got), np.ones(3, np.float32)
+CASES["_random_randint"] = random_randint_case
+
+
+def random_negative_binomial_case():
+    mx.random.seed(7)
+    k, p = 4.0, 0.5
+    out = _np(nd._random_negative_binomial(
+        k=k, p=p, shape=(4000,))).reshape(-1)
+    want_mean = k * (1 - p) / p
+    return (nd.array(np.array([out.mean()])),
+            np.array([want_mean]), 0.15, 0.3)
+CASES["_random_negative_binomial"] = random_negative_binomial_case
+
+
+def random_gen_negative_binomial_case():
+    mx.random.seed(8)
+    mu, alpha = 3.0, 0.4
+    out = _np(nd._random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=(4000,))).reshape(-1)
+    return (nd.array(np.array([out.mean()])), np.array([mu]),
+            0.15, 0.3)
+CASES["_random_generalized_negative_binomial"] = \
+    random_gen_negative_binomial_case
+
+
+def sample_multinomial_case():
+    mx.random.seed(9)
+    probs = nd.array(np.array([[0.2, 0.8]], np.float32))
+    out = _np(nd._sample_multinomial(probs, shape=2000)).reshape(-1)
+    return (nd.array(np.array([out.mean()])), np.array([0.8]),
+            0.1, 0.1)
+CASES["_sample_multinomial"] = sample_multinomial_case
+
+
+def sample_unique_zipfian_case():
+    out = _np(nd._sample_unique_zipfian(50, shape=(1, 20))[0])
+    got = np.array([out.min() >= 0, out.max() < 50,
+                    len(np.unique(out)) == out.size], np.float32)
+    return nd.array(got), np.ones(3, np.float32)
+CASES["_sample_unique_zipfian"] = sample_unique_zipfian_case
+
+# ---- optimizer update ops --------------------------------------------
+def signsgd_update_case():
+    w, g = R((4,), 1), R((4,), 2)
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = _first(nd.signsgd_update(w, g, lr=0.1))
+    return got, wn - 0.1 * np.sign(gn)
+CASES["signsgd_update"] = signsgd_update_case
+
+
+def signum_update_case():
+    w, g, m = R((4,), 1), R((4,), 2), R((4,), 3)
+    wn, gn, mn = _np(w).copy(), _np(g).copy(), _np(m).copy()
+    got = _first(nd.signum_update(w, g, m, lr=0.1, momentum=0.9))
+    mom = 0.9 * mn - (1 - 0.9) * gn
+    return got, wn + 0.1 * np.sign(mom)
+CASES["signum_update"] = signum_update_case
+
+
+def _first(x):
+    return x[0] if isinstance(x, (list, tuple)) else x
+
+
+def nag_mom_update_case():
+    w, g, m = R((4,), 1), R((4,), 2), R((4,), 3)
+    # snapshot before the call: fused update ops mutate weight/mom
+    wn, gn, mn = _np(w).copy(), _np(g).copy(), _np(m).copy()
+    got = _first(nd.nag_mom_update(w, g, m, lr=0.1, momentum=0.9))
+    mom = 0.9 * mn + gn
+    return got, wn - 0.1 * (gn + 0.9 * mom)
+CASES["nag_mom_update"] = nag_mom_update_case
+
+
+def ftml_update_case():
+    w, g = R((4,), 1), R((4,), 2)
+    d = nd.zeros((4,))
+    s = nd.zeros((4,))
+    z = nd.zeros((4,))
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = _first(nd.ftml_update(w, g, d, s, z, lr=0.1, t=1))
+    # t=1, d=v=z=0, beta1=0.6, beta2=0.999, eps=1e-8 (FTMLKernel)
+    b1, b2, eps = 0.6, 0.999, 1e-8
+    v = (1 - b2) * gn * gn
+    d_t = (1 - b1) / 0.1 * (np.sqrt(v / (1 - b2)) + eps)
+    sigma = d_t            # - beta1 * d, d = 0
+    z_t = (1 - b1) * gn - sigma * wn
+    return got, -z_t / d_t, 1e-3, 1e-4
+CASES["ftml_update"] = ftml_update_case
+
+
+def rmspropalex_update_case():
+    w, g = R((4,), 1), R((4,), 2)
+    n = nd.zeros((4,))
+    gavg = nd.zeros((4,))
+    delta = nd.zeros((4,))
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = _first(nd.rmspropalex_update(w, g, n, gavg, delta, lr=0.1))
+    # defaults rho=0.95, momentum=0.9, eps=1e-8
+    n_t = (1 - 0.95) * gn * gn
+    g_t = (1 - 0.95) * gn
+    d_t = -0.1 * gn / np.sqrt(n_t - g_t * g_t + 1e-8)
+    return got, wn + 0.9 * 0 + d_t, 1e-3, 1e-4
+CASES["rmspropalex_update"] = rmspropalex_update_case
+
+
+def multi_mp_sgd_mom_update_case():
+    w = R((4,), 1)
+    g = R((4,), 2)
+    m = nd.zeros((4,))
+    w32 = nd.array(_np(w).astype(np.float32))
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = nd.multi_mp_sgd_mom_update(w, g, m, w32, lrs=(0.1,),
+                                     wds=(0.0,), momentum=0.9)
+    out = got[0] if isinstance(got, (list, tuple)) else got
+    mom = 0.9 * 0 - 0.1 * gn
+    return out, wn + mom, 1e-3, 1e-4
+CASES["multi_mp_sgd_mom_update"] = multi_mp_sgd_mom_update_case
+
+
+def preloaded_multi_sgd_update_case():
+    w, g = R((4,), 1), R((4,), 2)
+    lr = nd.array(np.array([0.1], np.float32))
+    wd = nd.array(np.array([0.0], np.float32))
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = nd.preloaded_multi_sgd_update(w, g, lr, wd)
+    out = got[0] if isinstance(got, (list, tuple)) else got
+    return out, wn - 0.1 * gn, 1e-3, 1e-4
+CASES["preloaded_multi_sgd_update"] = preloaded_multi_sgd_update_case
+
+
+def preloaded_multi_sgd_mom_update_case():
+    w, g, m = R((4,), 1), R((4,), 2), nd.zeros((4,))
+    lr = nd.array(np.array([0.1], np.float32))
+    wd = nd.array(np.array([0.0], np.float32))
+    wn, gn = _np(w).copy(), _np(g).copy()
+    got = nd.preloaded_multi_sgd_mom_update(w, g, m, lr, wd, momentum=0.9)
+    out = got[0] if isinstance(got, (list, tuple)) else got
+    return out, wn - 0.1 * gn, 1e-3, 1e-4
+CASES["preloaded_multi_sgd_mom_update"] = preloaded_multi_sgd_mom_update_case
+
+
+def all_finite_case():
+    good = nd.all_finite(R((3,), 1))
+    bad = nd.all_finite(nd.array(np.array([1.0, np.inf], np.float32)))
+    return (nd.concat(good.reshape((1,)).astype("float32"),
+                      bad.reshape((1,)).astype("float32"), dim=0),
+            np.array([1.0, 0.0], np.float32))
+CASES["all_finite"] = all_finite_case
+
+
+def multi_all_finite_case():
+    got = nd.multi_all_finite(
+        R((3,), 1), nd.array(np.array([np.nan], np.float32)))
+    return got.astype("float32"), np.array([0.0], np.float32)
+CASES["multi_all_finite"] = multi_all_finite_case
+
+
+def amp_cast_case():
+    x = R((3,), 1)
+    got = nd.amp_cast(x, dtype="float16")
+    # TPU AMP maps float16 requests to bfloat16 (ops/elemwise.py)
+    return (nd.array(np.array([str(got.dtype) == "bfloat16"],
+                              np.float32)),
+            np.ones(1, np.float32))
+CASES["amp_cast"] = amp_cast_case
+
+
+def amp_multicast_case():
+    a = R((3,), 1)
+    b = nd.array(_np(R((3,), 2)).astype(np.float16))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    return outs[0], _np(a), 1e-2, 1e-2
+CASES["amp_multicast"] = amp_multicast_case
+
+
+# ---- image ops --------------------------------------------------------
+def image_to_tensor_case():
+    x = nd.array(np.arange(24, dtype=np.uint8).reshape(2, 3, 4))
+    got = nd._image_to_tensor(x)
+    want = np.arange(24, dtype=np.float32).reshape(2, 3, 4).transpose(
+        2, 0, 1) / 255.0
+    return got, want.astype(np.float32)
+CASES["_image_to_tensor"] = image_to_tensor_case
+
+
+def _identity_image_case(name, **kw):
+    def c():
+        x = R((4, 4, 3), 2, 0, 1)
+        got = getattr(nd, name)(x, **kw)
+        return got, _np(x)  # zero-range augmentation is the identity
+    return c
+
+
+CASES["_image_adjust_lighting"] = _identity_image_case(
+    "_image_adjust_lighting", alpha=(0.0, 0.0, 0.0))
+CASES["_image_random_brightness"] = _identity_image_case(
+    "_image_random_brightness", max_brightness=0.0)
+CASES["_image_random_contrast"] = _identity_image_case(
+    "_image_random_contrast", max_contrast=0.0)
+CASES["_image_random_saturation"] = _identity_image_case(
+    "_image_random_saturation", max_saturation=0.0)
+def image_random_hue_case():
+    # zero rotation is identity up to the YIQ round-trip's fp error
+    x = R((4, 4, 3), 2, 0, 1)
+    return nd._image_random_hue(x, max_hue=0.0), _np(x), 1e-2, 3e-3
+CASES["_image_random_hue"] = image_random_hue_case
+
+
+def _flip_case(name, axis):
+    def c():
+        x = R((2, 3, 3), 1)
+        outs = [_np(getattr(nd, name)(x)) for _ in range(40)]
+        xn = _np(x)
+        flipped = np.flip(xn, axis)
+        ok = all(np.allclose(o, xn) or np.allclose(o, flipped)
+                 for o in outs)
+        saw_both = (any(np.allclose(o, flipped) for o in outs)
+                    and any(np.allclose(o, xn) for o in outs))
+        return (nd.array(np.array([ok, saw_both], np.float32)),
+                np.ones(2, np.float32))
+    return c
+
+
+CASES["_image_random_flip_left_right"] = _flip_case(
+    "_image_random_flip_left_right", 1)
+CASES["_image_random_flip_top_bottom"] = _flip_case(
+    "_image_random_flip_top_bottom", 0)
+
+
+# ----------------------------------------------------------------------
+# Genuinely-hard waivers (each with a one-line reason). Gate fails if
+# this list grows past 30.
+# ----------------------------------------------------------------------
+ALLOWLIST = {
+    # stochastic sampling over graph structure: output is a random
+    # subgraph, no closed-form oracle; exercised for shape/validity in
+    # test_contrib_extras.py dgl tests via their public aliases
+    "_contrib_dgl_csr_neighbor_uniform_sample",
+    "_contrib_dgl_subgraph",
+    # likelihood of a marked point process: reference implementation is
+    # itself the only oracle; smoke-tested via finiteness in
+    # test_contrib_extras.py
+    "_contrib_hawkesll",
+    # region-proposal pipelines whose outputs interact with RNG-ordered
+    # partial sort; covered end-to-end by the SSD example test
+    "_contrib_MultiProposal",
+    "_contrib_PSROIPooling",
+}
+
+
+def _scanned_covered():
+    """Ops referenced by (normalized) name anywhere in tests/."""
+    src = []
+    here = os.path.dirname(os.path.abspath(__file__))
+    for f in glob.glob(os.path.join(here, "*.py")):
+        if os.path.basename(f) == "test_op_coverage.py":
+            continue
+        with open(f) as fh:
+            src.append(fh.read())
+    toks = {t.lower()
+            for t in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", "".join(src))}
+
+    def norm(n):
+        for p in ("_contrib_", "_image_", "_npi_", "_np_", "_sparse_",
+                  "_linalg_"):
+            if n.startswith(p):
+                n = n[len(p):]
+                break
+        return n.lstrip("_").lower()
+
+    def snake(n):
+        return re.sub(r"(?<!^)(?=[A-Z])", "_", n).lower()
+
+    covered = set()
+    for n in ops.list_ops():
+        cands = {n.lower(), norm(n), snake(norm(n)), snake(n).lstrip("_")}
+        if cands & toks:
+            covered.add(n)
+    return covered
+
+
+def test_all_ops_have_numeric_coverage():
+    names = set(ops.list_ops())
+    covered = _scanned_covered() | set(CASES) | ALLOWLIST
+    missing = sorted(names - covered)
+    assert not missing, (
+        "ops registered without a numeric test or documented waiver "
+        "(add an oracle case to CASES in this file, a dedicated test, "
+        "or — only if genuinely untestable — an ALLOWLIST entry): %s"
+        % missing)
+    assert len(ALLOWLIST) < 30, "waiver list too long — write tests"
+    # allowlisted ops must still exist (stale waivers rot)
+    stale = sorted(ALLOWLIST - names)
+    assert not stale, "ALLOWLIST entries for unregistered ops: %s" % stale
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_numeric_sweep(name):
+    res = CASES[name]()
+    got, want = res[0], res[1]
+    rtol = res[2] if len(res) > 2 else 1e-4
+    atol = res[3] if len(res) > 3 else 1e-5
+    np.testing.assert_allclose(
+        _np(got).astype(np.float64), np.asarray(want).astype(np.float64),
+        rtol=rtol, atol=atol,
+        err_msg="numeric oracle mismatch for op %r" % name)
