@@ -38,9 +38,7 @@ CHANNELS = (64, 256, 512, 1024, 2048)
 # ------------------------------------------------------------------
 
 def _hb_conv(x, w, stride=1, pad=0):
-    import jax.numpy as jnp
     from jax import lax
-    del jnp
     return lax.conv_general_dilated(
         x, w.astype(x.dtype), (stride, stride),
         [(pad, pad), (pad, pad)],

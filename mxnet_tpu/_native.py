@@ -33,7 +33,7 @@ def _cache_dir():
     return base
 
 
-def _build(source_path, tag):
+def _build(source_path, tag, extra_flags=()):
     with open(source_path, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     out = os.path.join(_cache_dir(), "lib%s_%s.so" % (tag, digest))
@@ -44,7 +44,7 @@ def _build(source_path, tag):
     # last finished build win atomically
     tmp = "%s.%d.tmp" % (out, os.getpid())
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           source_path, "-o", tmp]
+           source_path] + list(extra_flags) + ["-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, out)
@@ -192,3 +192,52 @@ def libsvm_parse(path, dim):
         lib.libsvm_free(data_p)
         lib.libsvm_free(labels_p)
     return data, labels
+
+
+_im2rec_lib = None
+_im2rec_tried = False
+
+
+def im2rec_lib():
+    """The compiled im2rec packer (needs OpenCV C++), or None."""
+    global _im2rec_lib, _im2rec_tried
+    with _lock:
+        if _im2rec_tried:
+            return _im2rec_lib
+        _im2rec_tried = True
+        src = os.path.join(_SRC_DIR, "io", "im2rec_pack.cc")
+        flags = ["-I/usr/include/opencv4", "-lopencv_imgcodecs",
+                 "-lopencv_imgproc", "-lopencv_core"]
+        try:
+            lib = ctypes.CDLL(_build(src, "im2rec_pack", flags))
+        except Exception:
+            return None
+        lib.mxtpu_im2rec_pack.restype = ctypes.c_int64
+        lib.mxtpu_im2rec_pack.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        _im2rec_lib = lib
+        return lib
+
+
+def im2rec_pack(list_path, root, rec_path, idx_path, resize=0,
+                quality=95, color=1, num_threads=4, use_png=False,
+                quiet=False):
+    """Pack the .lst entries into rec/idx natively; returns the packed
+    count, or None when the native packer is unavailable (caller falls
+    back to the Python loop)."""
+    lib = im2rec_lib()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(256)
+    n = lib.mxtpu_im2rec_pack(
+        list_path.encode(), root.encode(), rec_path.encode(),
+        (idx_path or "").encode(), int(resize), int(quality), int(color),
+        int(num_threads), int(bool(use_png)), int(bool(quiet)), err, 256)
+    if n < 0:
+        print("mxnet_tpu: native im2rec failed (%s); using the Python "
+              "packer" % err.value.decode(), file=sys.stderr)
+        return None
+    return int(n)

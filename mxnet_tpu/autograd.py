@@ -187,17 +187,8 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
             cts.append(c)
         ct_arg = tuple(cts) if node.num_outputs > 1 else cts[0]
         in_grads = node.vjp_fn(ct_arg)
-        if engine.is_naive() or engine.needs_serial_dispatch(in_grads):
-            # NaiveEngine: every dispatch blocks, backward included.
-            # Multi-device CPU backend: concurrent in-flight vjp
-            # programs containing collectives can interleave their
-            # rendezvous differently per device thread and deadlock, so
-            # serialize them (TPU per-device streams execute enqueued
-            # programs in enqueue order, which is identical across
-            # devices from the single dispatching thread — no sync
-            # needed there).
-            engine.sync_outputs([g for g in in_grads
-                                 if hasattr(g, "block_until_ready")])
+        engine.sync_if_needed([g for g in in_grads
+                               if hasattr(g, "block_until_ready")])
         for inp, g in zip(node.inputs, in_grads):
             if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
                 continue

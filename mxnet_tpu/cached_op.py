@@ -175,9 +175,6 @@ class CachedOp:
             by_name[name]._data = val
 
         datas = [r._data for r in results]
-        if _engine.is_naive() or _engine.needs_serial_dispatch(datas):
-            # multi-device CPU launches must not overlap (collective
-            # rendezvous interleave hazard, engine.py); TPU never syncs
-            _engine.sync_outputs(datas)
+        _engine.sync_if_needed(datas)
 
         return results

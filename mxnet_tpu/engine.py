@@ -87,6 +87,15 @@ def needs_serial_dispatch(arrays):
     return False
 
 
+def sync_if_needed(arrays):
+    """The one dispatch-exit barrier every eager/compiled launch site
+    calls: blocks when NaiveEngine is active (synchronous debug mode) or
+    when `needs_serial_dispatch` flags a multi-device CPU output (see
+    its docstring for the rendezvous-interleave hazard)."""
+    if is_naive() or needs_serial_dispatch(arrays):
+        sync_outputs(arrays)
+
+
 class _Worker(threading.Thread):
     def __init__(self):
         super().__init__(daemon=True)

@@ -354,10 +354,7 @@ class Executor:
         else:
             self._saved_vjp = None
             outs = self._infer_fn(arg_arrays, aux_arrays, key)
-        if _engine.is_naive() or _engine.needs_serial_dispatch(outs):
-            # multi-device CPU launches must not overlap (collective
-            # rendezvous interleave hazard, engine.py); TPU never syncs
-            _engine.sync_outputs(outs)
+        _engine.sync_if_needed(outs)
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         return self.outputs
 
@@ -376,8 +373,7 @@ class Executor:
         cotangent = type(outs)(heads) if isinstance(outs, (tuple, list)) \
             else heads[0]
         grads = self._bwd_fn(vjp, cotangent)
-        if _engine.is_naive() or _engine.needs_serial_dispatch(grads):
-            _engine.sync_outputs(grads)
+        _engine.sync_if_needed(grads)
         for name, g in zip(self._diff_args, grads):
             req = self._grad_req.get(name, "write")
             tgt = self.grad_dict[name]

@@ -118,18 +118,21 @@ def sample_unique_zipfian(range_max=1, shape=(), rng_key=None):
         return jnp.put_along_axis(jnp.zeros_like(dups), order, dups, -1,
                                   inplace=False)
 
+    # the mask rides in the loop state so each iteration pays ONE
+    # argsort pass (cond reads it, body consumes it and computes the
+    # next iteration's)
     def cond(state):
-        v, _, i = state
-        return jnp.any(dup_mask(v)) & (i < 64)
+        _, mask, _, i = state
+        return jnp.any(mask) & (i < 64)
 
     def body(state):
-        v, k, i = state
+        v, mask, k, i = state
         k, sub = jax.random.split(k)
-        v = jnp.where(dup_mask(v), draw(sub, v.shape), v)
-        return v, k, i + 1
+        v = jnp.where(mask, draw(sub, v.shape), v)
+        return v, dup_mask(v), k, i + 1
 
     v0 = draw(rng_key, batch + (n,))
-    v, _, _ = lax.while_loop(cond, body, (v0, rng_key, 0))
+    v, _, _, _ = lax.while_loop(cond, body, (v0, dup_mask(v0), rng_key, 0))
     return v.reshape(shp or ()).astype("int64")
 
 

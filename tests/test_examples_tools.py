@@ -235,3 +235,64 @@ def test_transformer_lm_example_learns():
                        text=True, env=env, cwd=os.getcwd(), timeout=540)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "LEARNED" in r.stdout
+
+
+def test_im2rec_native_matches_python_packer(tmp_path):
+    """src/io/im2rec_pack.cc writes byte-identical .rec/.idx to the
+    Python packer (same list, same resize/quality)."""
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import _native
+    if _native.im2rec_lib() is None:
+        pytest.skip("OpenCV C++ toolchain unavailable")
+    root = tmp_path / "imgs"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        for i in range(4):
+            img = np.random.RandomState(10 * i).randint(
+                0, 255, (48, 36, 3), np.uint8)
+            cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+    prefix_py = str(tmp_path / "py")
+    prefix_cc = str(tmp_path / "cc")
+    r = _run([sys.executable, "tools/im2rec.py", prefix_py, str(root),
+              "--list", "--recursive"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    import shutil
+    shutil.copy(prefix_py + ".lst", prefix_cc + ".lst")
+    r = _run([sys.executable, "tools/im2rec.py", prefix_py, str(root),
+              "--resize", "32"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _run([sys.executable, "tools/im2rec.py", prefix_cc, str(root),
+              "--resize", "32", "--num-thread", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "native x4" in r.stdout, r.stdout
+    with open(prefix_py + ".rec", "rb") as f:
+        py_rec = f.read()
+    with open(prefix_cc + ".rec", "rb") as f:
+        cc_rec = f.read()
+    assert py_rec == cc_rec
+    with open(prefix_py + ".idx") as f:
+        py_idx = f.read()
+    with open(prefix_cc + ".idx") as f:
+        cc_idx = f.read()
+    assert py_idx == cc_idx
+
+
+def test_kill_mxnet_local(tmp_path):
+    """tools/kill_mxnet.py kills a matching process locally."""
+    import getpass
+    import time
+    marker = "mxtpu_kill_test_%d" % os.getpid()
+    victim = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, time; time.sleep(60)  # %s" % marker])
+    try:
+        r = _run([sys.executable, "tools/kill_mxnet.py", "-",
+                  getpass.getuser(), marker])
+        assert r.returncode == 0, r.stderr[-2000:]
+        deadline = time.time() + 10
+        while victim.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert victim.poll() is not None
+    finally:
+        if victim.poll() is None:
+            victim.kill()

@@ -114,6 +114,23 @@ def pack(args):
         print("list file %s not found — run --list first" % lst,
               file=sys.stderr)
         return 1
+    if args.num_thread > 1:
+        # native multithreaded packer (src/io/im2rec_pack.cc), the
+        # counterpart of the reference's OpenMP im2rec.cc; identical
+        # .rec/.idx bytes to the Python loop below
+        from mxnet_tpu import _native
+        start = time.time()
+        n = _native.im2rec_pack(
+            lst, args.root, args.prefix + ".rec", args.prefix + ".idx",
+            resize=args.resize, quality=args.quality, color=args.color,
+            num_threads=args.num_thread,
+            use_png=args.encoding == ".png")
+        if n is not None:
+            print("wrote %s.rec / %s.idx (%d images, %.1fs, native x%d)"
+                  % (args.prefix, args.prefix, n, time.time() - start,
+                     args.num_thread))
+            return 0
+        # fall through to the Python packer when OpenCV C++ is absent
     record = recordio.MXIndexedRecordIO(args.prefix + ".idx",
                                         args.prefix + ".rec", "w")
     count, start = 0, time.time()
@@ -156,6 +173,9 @@ def main():
                         choices=(-1, 0, 1))
     parser.add_argument("--encoding", type=str, default=".jpg",
                         choices=(".jpg", ".png"))
+    parser.add_argument("--num-thread", type=int, default=1,
+                        help="pack with this many native threads "
+                             "(src/io/im2rec_pack.cc); 1 = Python loop")
     args = parser.parse_args()
 
     if args.list:

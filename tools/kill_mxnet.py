@@ -1,0 +1,80 @@
+"""Kill stray training processes locally and across a hostfile.
+
+Parity target: tools/kill-mxnet.py (same 3-arg CLI). Useful after a
+crashed tools/launch.py run leaves workers holding the TPU or the
+cross-process rendezvous port.
+
+  python tools/kill_mxnet.py <hostfile> <user> <prog>
+
+Each line of <hostfile> names a host (an optional ':port' suffix is
+ignored, matching launch.py's hostfile format); '-' runs locally only.
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def _remote_pattern(prog):
+    """Regex that matches `prog` but not its own command line: bracket
+    the first alphanumeric char so the ssh'd shell (whose cmdline
+    contains the pattern text) never matches itself."""
+    for i, ch in enumerate(prog):
+        if ch.isalnum():
+            return prog[:i] + "[" + ch + "]" + prog[i + 1:]
+    return prog
+
+
+def kill_local(user, prog):
+    """pgrep+kill with the killer itself (and its ancestors) excluded."""
+    out = subprocess.run(["pgrep", "-u", user, "-f", prog],
+                         capture_output=True, text=True)
+    exclude = {os.getpid(), os.getppid()}
+    killed = 0
+    for tok in out.stdout.split():
+        pid = int(tok)
+        if pid in exclude:
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            killed += 1
+        except (ProcessLookupError, PermissionError):
+            pass
+    return killed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="kill processes matching <prog> owned by <user> on "
+                    "every host in <hostfile> and locally")
+    parser.add_argument("hostfile",
+                        help="one host per line, or '-' for local only")
+    parser.add_argument("user")
+    parser.add_argument("prog")
+    args = parser.parse_args(argv)
+
+    procs = []
+    if args.hostfile != "-":
+        cmd = "pkill -9 -u %s -f %s || true" % (
+            shlex.quote(args.user),
+            shlex.quote(_remote_pattern(args.prog)))
+        with open(args.hostfile) as f:
+            hosts = [line.split(":")[0].strip() for line in f
+                     if line.strip()]
+        for host in hosts:
+            print("killing on %s: %s" % (host, cmd))
+            procs.append(subprocess.Popen(
+                ["ssh", "-oStrictHostKeyChecking=no", host, cmd],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    n = kill_local(args.user, args.prog)
+    print("killed %d local process(es)" % n)
+    for p in procs:
+        p.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
