@@ -104,10 +104,13 @@ class GraphBuilder(object):
         self._uid += 1
         return "%s__onnx%d" % (base, self._uid)
 
-    def add_node(self, op_type, inputs, outputs, name=None, **attrs):
+    def add_node(self, op_type, inputs, outputs, name=None, domain=None,
+                 **attrs):
         n = _pb.NodeProto()
         n.op_type = op_type
         n.name = name or self.fresh(op_type.lower())
+        if domain:
+            n.domain = domain
         n.input.extend(inputs)
         n.output.extend(outputs)
         for k, v in attrs.items():
@@ -397,6 +400,155 @@ _MX2ONNX["min"] = _reduce("ReduceMin")
 _MX2ONNX["prod"] = _reduce("ReduceProd")
 
 
+# ------------------------------------------------- detection / attention --
+@mx_op("ROIPooling")
+def _roi_pooling(gb, name, attrs, ins, outs):
+    # ONNX MaxRoiPool rois share mx's [batch_idx, x1, y1, x2, y2] rows
+    gb.add_node("MaxRoiPool", ins, outs, name=name,
+                pooled_shape=_tuple(attrs["pooled_size"]),
+                spatial_scale=float(attrs.get("spatial_scale", 1.0)))
+
+
+@mx_op("_contrib_ROIAlign")
+def _roi_align(gb, name, attrs, ins, outs):
+    if _bool(attrs.get("position_sensitive", False)):
+        raise NotImplementedError("position-sensitive ROIAlign has no "
+                                  "ONNX counterpart")
+    if _bool(attrs.get("aligned", False)):
+        raise NotImplementedError("aligned=True ROIAlign needs the "
+                                  "opset-16 half_pixel mode; export "
+                                  "targets opset 11")
+    ph, pw = _tuple(attrs["pooled_size"])
+    sr = int(attrs.get("sample_ratio", -1))
+    # mx rois are [R, 5] (batch idx + corners); ONNX RoiAlign wants the
+    # [R, 4] boxes and an int64 batch-index vector separately
+    ax1 = gb.const_i64(name + "_ax", [1])
+    s0 = gb.const_i64(name + "_s0", [0])
+    e1 = gb.const_i64(name + "_e1", [1])
+    s1 = gb.const_i64(name + "_s1", [1])
+    e5 = gb.const_i64(name + "_e5", [5])
+    bi_col = gb.fresh(name + "_bi_col")
+    boxes = gb.fresh(name + "_boxes")
+    bi_flat = gb.fresh(name + "_bi_flat")
+    bi = gb.fresh(name + "_bi")
+    gb.add_node("Slice", [ins[1], s0, e1, ax1], [bi_col])
+    gb.add_node("Slice", [ins[1], s1, e5, ax1], [boxes])
+    gb.add_node("Squeeze", [bi_col], [bi_flat], axes=(1,))
+    gb.add_node("Cast", [bi_flat], [bi], to=int(_pb.TensorProto.INT64))
+    # ops/contrib_ops.py roi_align defaults sample_ratio<=0 to 2 samples
+    # per bin; emit that explicitly (ONNX 0 means adaptive)
+    gb.add_node("RoiAlign", [ins[0], boxes, bi], outs, name=name,
+                mode="avg", output_height=ph, output_width=pw,
+                sampling_ratio=2 if sr <= 0 else sr,
+                spatial_scale=float(attrs.get("spatial_scale", 1.0)))
+
+
+# Data-dependent detection heads (greedy NMS, anchor matching) have no
+# static-shape decomposition in opset 11; they export as single nodes
+# in a custom domain carrying the mx attrs verbatim. Our importer (and
+# any runtime registering the domain) reconstructs the op exactly; the
+# reference exports none of these.
+CONTRIB_DOMAIN = "org.mxnet_tpu"
+
+_CONTRIB_PASSTHROUGH = (
+    ("_contrib_box_nms", 1), ("_contrib_box_non_maximum_suppression", 1),
+    ("_contrib_MultiBoxPrior", 1), ("MultiBoxPrior", 1),
+    ("_contrib_MultiBoxTarget", 3), ("MultiBoxTarget", 3),
+    ("_contrib_MultiBoxDetection", 1), ("MultiBoxDetection", 1),
+    ("_contrib_Proposal", 1), ("_contrib_MultiProposal", 1),
+    ("_contrib_box_iou", 1),
+)
+
+
+def _contrib_passthrough(canonical, n_out):
+    def conv(gb, name, attrs, ins, outs):
+        gb.add_node(canonical, ins, outs[:n_out], name=name,
+                    domain=CONTRIB_DOMAIN,
+                    **{k: str(v) for k, v in attrs.items()})
+    conv._n_out = n_out
+    return conv
+
+
+for _nm, _n_out in _CONTRIB_PASSTHROUGH:
+    _MX2ONNX[_nm] = _contrib_passthrough(_nm, _n_out)
+
+
+def _interleaved_shapes(gb, tensor_name, attrs):
+    shape = gb.shapes.get(tensor_name)
+    if not shape or len(shape) != 3:
+        raise NotImplementedError(
+            "interleaved-matmul export needs a known (seq, batch, "
+            "3*embed) input shape")
+    s, b, e3 = shape
+    h = int(attrs.get("heads", 1))
+    e = e3 // 3
+    return s, b, h, e, e // h
+
+
+def _slice_head(gb, name, x5, idx, tag):
+    """(s,b,h,3,hd) -> (s,b,h,hd): take q/k/v slot `idx` of axis 3."""
+    s3 = gb.const_i64("%s_%s_s" % (name, tag), [idx])
+    e3 = gb.const_i64("%s_%s_e" % (name, tag), [idx + 1])
+    ax = gb.const_i64("%s_%s_ax" % (name, tag), [3])
+    sliced = gb.fresh("%s_%s_sl" % (name, tag))
+    out = gb.fresh("%s_%s" % (name, tag))
+    gb.add_node("Slice", [x5, s3, e3, ax], [sliced])
+    gb.add_node("Squeeze", [sliced], [out], axes=(3,))
+    return out
+
+
+def _to_bh(gb, name, x, s, b, h, hd, tag):
+    """(s,b,h,hd) -> (b*h, s, hd)."""
+    moved = gb.fresh("%s_%s_t" % (name, tag))
+    gb.add_node("Transpose", [x], [moved], perm=(1, 2, 0, 3))
+    shp = gb.const_i64("%s_%s_shp" % (name, tag), [b * h, s, hd])
+    out = gb.fresh("%s_%s_bh" % (name, tag))
+    gb.add_node("Reshape", [moved, shp], [out])
+    return out
+
+
+@mx_op("_contrib_interleaved_matmul_selfatt_qk")
+def _interleaved_qk(gb, name, attrs, ins, outs):
+    """(s, b, 3e) head-interleaved qkv -> (b*h, s, s) scaled scores,
+    decomposed to standard opset-11 ops (transformer.cc semantics,
+    ops/contrib_ops.py numerics)."""
+    s, b, h, e, hd = _interleaved_shapes(gb, ins[0], attrs)
+    shp5 = gb.const_i64(name + "_shp5", [s, b, h, 3, hd])
+    x5 = gb.fresh(name + "_x5")
+    gb.add_node("Reshape", [ins[0], shp5], [x5])
+    q = _to_bh(gb, name, _slice_head(gb, name, x5, 0, "q"), s, b, h, hd,
+               "q")
+    k = _to_bh(gb, name, _slice_head(gb, name, x5, 1, "k"), s, b, h, hd,
+               "k")
+    kt = gb.fresh(name + "_kt")
+    gb.add_node("Transpose", [k], [kt], perm=(0, 2, 1))
+    raw = gb.fresh(name + "_raw")
+    gb.add_node("MatMul", [q, kt], [raw])
+    scale = gb.add_initializer(gb.fresh(name + "_scale"),
+                               np.float32(1.0 / np.sqrt(hd)))
+    gb.add_node("Mul", [raw, scale], outs, name=name)
+
+
+@mx_op("_contrib_interleaved_matmul_selfatt_valatt")
+def _interleaved_valatt(gb, name, attrs, ins, outs):
+    """(qkv, attention) -> (s, b, e) context, standard-op decomposition."""
+    s, b, h, e, hd = _interleaved_shapes(gb, ins[0], attrs)
+    shp5 = gb.const_i64(name + "_shp5", [s, b, h, 3, hd])
+    x5 = gb.fresh(name + "_x5")
+    gb.add_node("Reshape", [ins[0], shp5], [x5])
+    v = _to_bh(gb, name, _slice_head(gb, name, x5, 2, "v"), s, b, h, hd,
+               "v")
+    ctx = gb.fresh(name + "_ctx")
+    gb.add_node("MatMul", [ins[1], v], [ctx])
+    shp4 = gb.const_i64(name + "_shp4", [b, h, s, hd])
+    ctx4 = gb.fresh(name + "_ctx4")
+    gb.add_node("Reshape", [ctx, shp4], [ctx4])
+    moved = gb.fresh(name + "_moved")
+    gb.add_node("Transpose", [ctx4], [moved], perm=(2, 0, 1, 3))
+    shp3 = gb.const_i64(name + "_shp3", [s, b, e])
+    gb.add_node("Reshape", [moved, shp3], outs, name=name)
+
+
 # ------------------------------------------------------------ model walk --
 def _np_param(value):
     if isinstance(value, np.ndarray):
@@ -466,8 +618,9 @@ def create_model(sym, params, input_shapes, input_dtype=np.float32,
             raise NotImplementedError(
                 "mx op %r has no ONNX converter" % op)
         ins = [out_name[(ni, oi)] for ni, oi, _ in node["inputs"]]
+        n_out = getattr(conv, "_n_out", 1)
         conv(gb, node["name"], node.get("attrs", {}), ins,
-             [out_name[(i, 0)]])
+             [out_name[(i, k)] for k in range(n_out)])
 
     model = _pb.ModelProto()
     model.ir_version = _IR_VERSION
@@ -475,6 +628,10 @@ def create_model(sym, params, input_shapes, input_dtype=np.float32,
     model.producer_version = "0.1.0"
     opset = model.opset_import.add()
     opset.version = _OPSET_VERSION
+    if any(n.domain == CONTRIB_DOMAIN for n in gb.nodes):
+        custom = model.opset_import.add()
+        custom.domain = CONTRIB_DOMAIN
+        custom.version = 1
     g = model.graph
     g.name = graph_name
     g.node.extend(gb.nodes)

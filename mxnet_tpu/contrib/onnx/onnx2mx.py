@@ -99,11 +99,14 @@ class _Importer(object):
         for init in self.graph.initializer:
             self.arrays[init.name] = _to_array(init)
         for node in self.graph.node:
-            conv = _ONNX2MX.get(node.op_type)
-            if conv is None:
-                raise NotImplementedError(
-                    "ONNX op %r has no mx converter" % node.op_type)
-            result = conv(self, node, _attrs(node))
+            if node.domain == _CONTRIB_DOMAIN:
+                result = _import_contrib_node(self, node)
+            else:
+                conv = _ONNX2MX.get(node.op_type)
+                if conv is None:
+                    raise NotImplementedError(
+                        "ONNX op %r has no mx converter" % node.op_type)
+                result = conv(self, node, _attrs(node))
             outs = list(node.output)
             if not isinstance(result, (list, tuple)):
                 result = [result]
@@ -122,6 +125,27 @@ class _Importer(object):
                 args[name] = nd.array(arr.astype(np.float32)
                                       if arr.dtype == np.float64 else arr)
         return out, args, auxs
+
+
+# custom-domain nodes written by mx2onnx (detection heads whose
+# data-dependent shapes have no opset-11 decomposition): the node
+# op_type IS the mx op name and its attrs are the mx attrs verbatim
+from .mx2onnx import CONTRIB_DOMAIN as _CONTRIB_DOMAIN
+
+
+def _import_contrib_node(im, node):
+    fn = getattr(im.S, node.op_type, None)
+    if fn is None:
+        raise NotImplementedError(
+            "custom-domain op %r is not a registered mx op"
+            % node.op_type)
+    kwargs = {a.name: im.S._parse_attr(a.s.decode())
+              for a in node.attribute}
+    out = fn(*[im.sym_of(i) for i in node.input],
+             name=node.name or None, **kwargs)
+    if len(node.output) > 1:
+        return [out[k] for k in range(len(node.output))]
+    return out
 
 
 # ------------------------------------------------------------ converters --
@@ -210,8 +234,11 @@ def _gemm(im, node, attrs):
 
 @onnx_op("MatMul")
 def _matmul(im, node, attrs):
-    return im.S.dot(im.sym_of(node.input[0]), im.sym_of(node.input[1]),
-                    name=node.name or None)
+    # ONNX MatMul is numpy-matmul (batched over leading dims); mx `dot`
+    # contracts last-of-a with first-of-b, so linalg_gemm2 is the match
+    return im.S.linalg_gemm2(im.sym_of(node.input[0]),
+                             im.sym_of(node.input[1]),
+                             name=node.name or None)
 
 
 @onnx_op("BatchNormalization")
@@ -376,6 +403,92 @@ for _o, _m in [("ReduceMean", "mean"), ("ReduceSum", "sum"),
                ("ReduceMax", "max"), ("ReduceMin", "min"),
                ("ReduceProd", "prod")]:
     _ONNX2MX[_o] = _reduce(_m)
+
+
+@onnx_op("MaxRoiPool")
+def _max_roi_pool(im, node, attrs):
+    # ONNX rois rows are [batch_idx, x1, y1, x2, y2] — mx ROIPooling's
+    # exact layout
+    return im.S.ROIPooling(im.sym_of(node.input[0]),
+                           im.sym_of(node.input[1]),
+                           pooled_size=attrs["pooled_shape"],
+                           spatial_scale=attrs.get("spatial_scale", 1.0),
+                           name=node.name or None)
+
+
+@onnx_op("RoiAlign")
+def _roi_align(im, node, attrs):
+    if attrs.get("mode", "avg") != "avg":
+        raise NotImplementedError("RoiAlign mode=max")
+    if attrs.get("sampling_ratio", 0) <= 0:
+        import warnings
+        warnings.warn(
+            "RoiAlign sampling_ratio<=0 means adaptive ceil(roi/bin) "
+            "sampling in ONNX; this import uses a fixed 2 samples per "
+            "bin (ops/contrib_ops.py roi_align), which can differ "
+            "numerically for large ROIs", stacklevel=2)
+    # rebuild mx's [R, 5] rois: batch indices back in column 0
+    bi = im.S.Cast(im.S.expand_dims(im.sym_of(node.input[2]), axis=1),
+                   dtype="float32")
+    rois = im.S.Concat(bi, im.sym_of(node.input[1]), dim=1)
+    return im.S.contrib.ROIAlign(
+        im.sym_of(node.input[0]), rois,
+        pooled_size=(attrs.get("output_height", 1),
+                     attrs.get("output_width", 1)),
+        spatial_scale=attrs.get("spatial_scale", 1.0),
+        sample_ratio=attrs.get("sampling_ratio", -1),
+        name=node.name or None)
+
+
+@onnx_op("Slice")
+def _slice(im, node, attrs):
+    # opset 11: starts/ends/axes/steps arrive as initializer inputs
+    if len(node.input) > 1:
+        starts = [int(v) for v in im.const(node.input[1])]
+        ends = [int(v) for v in im.const(node.input[2])]
+        axes = [int(v) for v in im.const(node.input[3])] \
+            if len(node.input) > 3 and node.input[3] \
+            else list(range(len(starts)))
+        steps = [int(v) for v in im.const(node.input[4])] \
+            if len(node.input) > 4 and node.input[4] \
+            else [1] * len(starts)
+    else:                       # opset < 10 attribute form
+        starts = list(attrs["starts"])
+        ends = list(attrs["ends"])
+        axes = list(attrs.get("axes", range(len(starts))))
+        steps = [1] * len(starts)
+    if any(st != 1 for st in steps):
+        raise NotImplementedError("Slice with steps != 1")
+    out = im.sym_of(node.input[0])
+    int32_max = 2 ** 31 - 1
+    for a, b, e in zip(axes, starts, ends):
+        end = None if e >= int32_max else e
+        out = im.S.slice_axis(out, axis=a, begin=b, end=end)
+    return out
+
+
+@onnx_op("Squeeze")
+def _squeeze(im, node, attrs):
+    kw = {}
+    if "axes" in attrs:
+        kw["axis"] = attrs["axes"]
+    return im.S.squeeze(im.sym_of(node.input[0]),
+                        name=node.name or None, **kw)
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(im, node, attrs):
+    # axes are positions in the OUTPUT rank; inserting them in
+    # ascending order makes sequential expand_dims land each one where
+    # the spec says. Negative axes would need the (unknown) input rank
+    # to normalize — refuse loudly rather than transpose silently.
+    if any(ax < 0 for ax in attrs["axes"]):
+        raise NotImplementedError(
+            "Unsqueeze with negative axes needs shape inference")
+    out = im.sym_of(node.input[0])
+    for ax in sorted(attrs["axes"]):
+        out = im.S.expand_dims(out, axis=ax)
+    return out
 
 
 # ------------------------------------------------------------- public API --

@@ -222,3 +222,124 @@ def test_resnet18_zoo_export_roundtrip(tmp_path):
     sym2, args2, auxs2 = onnx_mx.import_model(path)
     y2 = _forward(sym2, args2, auxs2, x)
     np.testing.assert_allclose(y_ref, y2, rtol=1e-4, atol=1e-5)
+
+
+def _multi_input_roundtrip(net, input_vals, tmp_path, params=None,
+                           rtol=1e-4, atol=1e-5):
+    """Export a graph with several data inputs, re-import, compare."""
+    shapes = {k: v.shape for k, v in input_vals.items()}
+    path = str(tmp_path / "multi.onnx")
+    onnx_mx.export_model(net, params or {}, shapes, onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+
+    def fwd(s, extra_args, extra_auxs):
+        ex = s.simple_bind(
+            mx.cpu(), grad_req="null", **shapes,
+            **{k: tuple(v.shape) for k, v in extra_args.items()})
+        ex.copy_params_from(extra_args, extra_auxs)
+        feed = {k: nd.array(v) for k, v in input_vals.items()}
+        return [o.asnumpy() for o in ex.forward(is_train=False, **feed)]
+
+    y1 = fwd(net, {k.split(":", 1)[-1]: v for k, v in (params or {}).items()
+                   if not k.startswith("aux:")},
+             {k.split(":", 1)[-1]: v for k, v in (params or {}).items()
+              if k.startswith("aux:")})
+    y2 = fwd(sym2, args2, auxs2)
+    assert len(y1) == len(y2)
+    for a, b in zip(y1, y2):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    return path
+
+
+def test_roi_pooling_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    net = sym.ROIPooling(data, rois, pooled_size=(2, 2),
+                         spatial_scale=0.5, name="roi")
+    rng = np.random.RandomState(3)
+    vals = {
+        "data": rng.rand(2, 3, 12, 12).astype(np.float32),
+        "rois": np.array([[0, 0, 0, 10, 10], [1, 2, 2, 20, 20]],
+                         np.float32),
+    }
+    _multi_input_roundtrip(net, vals, tmp_path)
+
+
+def test_roi_align_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    net = sym.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                               spatial_scale=0.25, sample_ratio=2,
+                               name="ra")
+    rng = np.random.RandomState(4)
+    vals = {
+        "data": rng.rand(2, 4, 16, 16).astype(np.float32),
+        "rois": np.array([[0, 1, 1, 30, 30], [1, 8, 4, 60, 50]],
+                         np.float32),
+    }
+    _multi_input_roundtrip(net, vals, tmp_path)
+
+
+def test_box_nms_custom_domain_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.contrib.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                              score_index=1, id_index=0, name="nms")
+    rng = np.random.RandomState(5)
+    boxes = rng.rand(1, 8, 4).astype(np.float32)
+    boxes[..., 2:] = boxes[..., :2] + 0.3
+    rows = np.concatenate(
+        [rng.randint(0, 3, (1, 8, 1)).astype(np.float32),
+         rng.rand(1, 8, 1).astype(np.float32), boxes], axis=-1)
+    path = _multi_input_roundtrip(net, {"data": rows}, tmp_path)
+    # the head really exported as ONE custom-domain node
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as pb
+    model = pb.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    assert [n.domain for n in model.graph.node] == ["org.mxnet_tpu"]
+    assert any(o.domain == "org.mxnet_tpu" for o in model.opset_import)
+
+
+def test_multibox_ssd_head_roundtrip(tmp_path):
+    """MultiBoxPrior + MultiBoxDetection — the SSD inference head —
+    export as custom-domain nodes and round-trip numerically."""
+    feat = sym.Variable("data")
+    cls_prob = sym.Variable("cls_prob")
+    loc_pred = sym.Variable("loc_pred")
+    anchors = sym.contrib.MultiBoxPrior(feat, sizes=(0.4, 0.8),
+                                        ratios=(1.0, 2.0), name="priors")
+    det = sym.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                        nms_threshold=0.5,
+                                        threshold=0.01, name="det")
+    rng = np.random.RandomState(6)
+    h = w = 4
+    n_anchor = h * w * 3                     # len(sizes)+len(ratios)-1
+    raw = rng.rand(1, 3, n_anchor).astype(np.float32)
+    vals = {
+        "data": rng.rand(1, 8, h, w).astype(np.float32),
+        "cls_prob": (raw / raw.sum(1, keepdims=True)),
+        "loc_pred": (rng.rand(1, n_anchor * 4) * 0.1).astype(np.float32),
+    }
+    _multi_input_roundtrip(det, vals, tmp_path)
+
+
+def test_interleaved_attention_roundtrip(tmp_path):
+    """The transformer self-attention pair decomposes to standard
+    opset-11 ops (Reshape/Slice/Squeeze/Transpose/MatMul/Mul/Softmax)
+    and round-trips numerically."""
+    qkv = sym.Variable("data")
+    scores = sym.contrib.interleaved_matmul_selfatt_qk(qkv, heads=2,
+                                                       name="qk")
+    att = sym.softmax(scores, axis=-1)
+    out = sym.contrib.interleaved_matmul_selfatt_valatt(qkv, att, heads=2,
+                                                        name="valatt")
+    rng = np.random.RandomState(7)
+    vals = {"data": rng.randn(5, 2, 3 * 8).astype(np.float32)}
+    path = _multi_input_roundtrip(out, vals, tmp_path)
+    # everything is standard-domain: runnable by any opset-11 runtime
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as pb
+    model = pb.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    assert all(not n.domain for n in model.graph.node)
