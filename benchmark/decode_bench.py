@@ -1,0 +1,61 @@
+"""Autoregressive decode throughput: tokens/s through the KV-cache path.
+
+Measures models/transformer.py decode_step (flash_decode kernel vs the
+dense masked einsum) at growing cache lengths — decode is HBM-bound
+(cache bytes read per token), so tokens/s should track 1/length.
+
+    python - < benchmark/decode_bench.py
+    MXNET_DECODE_FLASH=0 python - < benchmark/decode_bench.py   # dense leg
+
+Run from /root/repo via stdin (axon plugin breaks under PYTHONPATH).
+"""
+
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("MXNET_DECODE_BATCH", "8"))
+STEPS = int(os.environ.get("MXNET_DECODE_STEPS", "64"))
+USE_FLASH = os.environ.get("MXNET_DECODE_FLASH", "1") not in ("0", "false")
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the axon plugin rewrites JAX_PLATFORMS to "axon,cpu" at import
+        # time; pin the config so an explicit cpu request stays cpu and
+        # never touches (or hangs on) the tunnel
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+
+    shapes = ((1024, 512, 8, 8), (4096, 512, 8, 8))
+    if os.environ.get("MXNET_DECODE_SMOKE"):   # CPU-sized correctness run
+        shapes = ((64, 32, 2, 1),)
+    for max_len, d_model, heads, layers in shapes:
+        cfg = tf.TransformerConfig(
+            vocab_size=32000, d_model=d_model, n_heads=heads,
+            n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+            dtype=jnp.bfloat16, use_flash_kernel=USE_FLASH)
+        params = tf.init_params(cfg, seed=0)
+        cache = tf.init_cache(cfg, BATCH)
+        step = tf.make_decode_step(cfg)
+        tok = jnp.zeros((BATCH,), jnp.int32)
+        # warm at the tail position (worst case: full cache read)
+        logits, cache = step(params, cache, tok, max_len - STEPS - 1)
+        logits.block_until_ready()
+        t0 = time.time()
+        for i in range(STEPS):
+            logits, cache = step(params, cache, tok,
+                                 max_len - STEPS + i)
+        logits.block_until_ready()
+        dt = time.time() - t0
+        toks = BATCH * STEPS
+        print("decode %s max_len=%d bs=%d: %.1f tok/s (%.2f ms/step)"
+              % ("flash" if USE_FLASH else "dense", max_len, BATCH,
+                 toks / dt, dt / STEPS * 1e3))
+
+
+if __name__ == "__main__":
+    main()

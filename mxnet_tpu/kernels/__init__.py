@@ -7,6 +7,6 @@ package holds the few deliberate exceptions, written with Pallas
 interpret mode elsewhere, so their tests execute on any backend.
 """
 
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_decode
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_decode"]

@@ -423,6 +423,116 @@ def _flash_bh_bwd(causal, block_q, block_k, interpret, res, do):
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
+# ------------------------------------------------------------- decode ---
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
+                   l_sc, *, scale, block_k, num_kb):
+    """T_q=1 step: one query row attends to the KV cache, streamed
+    block by block. The valid cache length arrives per bh-row through
+    SMEM; key positions at or past it are masked out of the online
+    softmax, so one compiled kernel serves every decode position."""
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    length = len_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    k_start = ki * block_k
+
+    @pl.when(k_start < length)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale       # (1, D)
+        k = k_ref[...].astype(jnp.float32)               # (block_k, D)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (1, block_k), 1)
+        s = jnp.where(k_pos < length, s, _NEG_INF)
+        m_prev = m_sc[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        m_sc[...] = m_new
+
+    @pl.when(ki == num_kb - 1)
+    def _flush():
+        l = jnp.maximum(l_sc[...], 1e-30)
+        o_ref[...] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_k", "interpret"))
+def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
+    """q [BH, 1, D], k/v [BH, Tmax, D], lengths [BH] -> o [BH, 1, D]."""
+    bh, t_max, head_dim = k.shape
+    scale = 1.0 / (head_dim ** 0.5)
+    num_kb = t_max // block_k
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               block_k=block_k, num_kb=num_kb)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, 1, head_dim), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, head_dim),
+                               lambda b, ki: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, head_dim), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lengths, q, k, v)
+
+
+def flash_decode(q, k_cache, v_cache, lengths, block_k=128,
+                 interpret=None):
+    """Single-step (T_q=1) attention against a KV cache.
+
+    q: [B, H, D] — the current token's queries.
+    k_cache/v_cache: [B, Tmax, H, D] — preallocated cache; only the
+    first `lengths` positions of each row are attended.
+    lengths: int32 [B] (or scalar, broadcast) valid cache lengths.
+
+    Decode attention is HBM-bandwidth-bound (the whole cache is read
+    once per token); this kernel streams K/V blocks through VMEM with
+    the query row resident and masks by the dynamic length, so the same
+    compiled program serves every position. Inference-only (no vjp).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, heads, head_dim = q.shape
+    t_max = k_cache.shape[1]
+    block_k = min(block_k, t_max)
+    if t_max % block_k:
+        raise ValueError("block_k %d must divide the cache length %d"
+                         % (block_k, t_max))
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * heads, x.shape[1], head_dim)
+    o = _flash_decode_bh(
+        q.reshape(b, 1, heads, head_dim).transpose(0, 2, 1, 3).reshape(
+            b * heads, 1, head_dim),
+        to_bh(k_cache), to_bh(v_cache),
+        jnp.repeat(lengths, heads), block_k, interpret)
+    return o.reshape(b, heads, head_dim)
+
+
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
                     interpret=None):
     """Multi-head attention over [B, T, H, D] tensors.

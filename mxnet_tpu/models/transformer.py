@@ -29,7 +29,8 @@ from ..parallel.ring import ring_attention, ring_attention_sharded
 from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
-           "make_train_step", "param_specs"]
+           "make_train_step", "param_specs", "init_cache", "decode_step",
+           "make_decode_step", "generate"]
 
 
 @dataclass
@@ -250,6 +251,115 @@ def loss_fn(params, tokens, cfg, mesh=None):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+# ------------------------------------------------------------- decode ---
+# Autoregressive inference: a per-layer KV cache plus a T_q=1 step.
+# Prefill could reuse forward(); the same step also serves prefill
+# token-by-token, which keeps one compiled program for everything.
+# The attention reads ride kernels/flash_attention.flash_decode on TPU
+# (cache streamed through VMEM, masked by the dynamic position) and a
+# dense masked einsum elsewhere — identical numerics.
+
+def init_cache(cfg, batch):
+    """Zeroed per-layer K/V caches sized to cfg.max_len."""
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch, cfg.max_len, cfg.n_heads, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _decode_attention(q, cache_k, cache_v, pos, cfg):
+    """q [B,H,D] vs cache [B,Tmax,H,D], attending positions <= pos."""
+    if cfg.use_flash_kernel:
+        import math
+        from ..kernels import flash_decode
+        # largest power-of-two block (<=128) dividing the cache length
+        block_k = math.gcd(cache_k.shape[1], 128)
+        return flash_decode(q, cache_k, cache_v, pos + 1,
+                            block_k=block_k)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   cache_k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    t_pos = jnp.arange(cache_k.shape[1])
+    s = jnp.where((t_pos <= pos)[None, None, :], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", a,
+                      cache_v.astype(jnp.float32)).astype(q.dtype)
+
+
+def decode_step(params, cache, tokens, pos, cfg):
+    """One autoregressive step.
+
+    tokens [B] int32 (the token at position `pos`), pos scalar int32.
+    Returns (logits [B, vocab] for the NEXT token, updated cache).
+    Static shapes throughout: `pos` is data, not shape, so one compiled
+    program decodes every position.
+    """
+    x = params["embed"][tokens] + jax.lax.dynamic_index_in_dim(
+        params["pos"], pos, 0, keepdims=False)
+    new_cache = []
+    for p, layer_cache in zip(params["layers"], cache):
+        h = _rms_norm(x, p["ln1"])
+        q = jnp.einsum("bd,dhk->bhk", h, p["wq"])
+        k_new = jnp.einsum("bd,dhk->bhk", h, p["wk"])
+        v_new = jnp.einsum("bd,dhk->bhk", h, p["wv"])
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["k"], k_new[:, None], pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["v"], v_new[:, None], pos, axis=1)
+        new_cache.append({"k": ck, "v": cv})
+        o = _decode_attention(q, ck, cv, pos, cfg)
+        x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bd,vd->bv", x, params["embed"]), new_cache
+
+
+def make_decode_step(cfg):
+    """Jitted decode_step with the cache donated (updated in place)."""
+    def step(params, cache, tokens, pos):
+        return decode_step(params, cache, tokens, pos, cfg)
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def generate(params, prompt, n_new, cfg, greedy=True, seed=0):
+    """Autoregressive generation: prompt [B, Tp] int32 -> [B, Tp+n_new].
+
+    The whole loop (prefill token-by-token + generation) is one
+    lax.scan over positions, so it stays a single compiled program.
+    """
+    b, t_prompt = prompt.shape
+    total = t_prompt + n_new
+    if total > cfg.max_len:
+        raise ValueError("prompt+n_new %d exceeds max_len %d"
+                         % (total, cfg.max_len))
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :t_prompt].set(prompt)
+    cache = init_cache(cfg, b)
+    key = jax.random.PRNGKey(seed)
+
+    def body(carry, pos):
+        buf, cache, key = carry
+        tok = jax.lax.dynamic_index_in_dim(buf, pos, 1, keepdims=False)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits).astype(jnp.int32)
+        # inside the prompt the next token is already given; past it we
+        # append the model's choice
+        keep_prompt = pos + 1 < t_prompt
+        cur = jax.lax.dynamic_index_in_dim(
+            buf, jnp.minimum(pos + 1, total - 1), 1, keepdims=False)
+        nxt = jnp.where(keep_prompt, cur, nxt)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, nxt[:, None], pos + 1, axis=1)
+        return (buf, cache, key), None
+
+    (buf, _, _), _ = jax.lax.scan(
+        body, (buf, cache, key), jnp.arange(total - 1))
+    return buf
 
 
 def make_train_step(cfg, mesh=None, lr=1e-2):
