@@ -97,6 +97,7 @@ def main():
         ("all_three", {"MXNET_BN_BF16_RESIDUAL": "1",
                        "MXNET_RELU_MASK_RESIDUAL": "1",
                        "MXNET_BACKWARD_DO_MIRROR": "1"}),
+        ("int8_conv", {"MXNET_INT8_RESIDUAL": "1"}),
     ]
     base = None
     for name, env in variants:

@@ -172,6 +172,8 @@ def report(tag, compiled):
 
 
 def main():
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
     import jax
     import jax.numpy as jnp
     rng = np.random.RandomState(0)

@@ -21,12 +21,9 @@ USE_FLASH = os.environ.get("MXNET_DECODE_FLASH", "1") not in ("0", "false")
 
 
 def main():
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
     import jax
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        # the axon plugin rewrites JAX_PLATFORMS to "axon,cpu" at import
-        # time; pin the config so an explicit cpu request stays cpu and
-        # never touches (or hangs on) the tunnel
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from mxnet_tpu.models import transformer as tf
 

@@ -214,3 +214,17 @@ def ensure_backend(timeout_s=None, probe_code=None):
 
 def _reset_for_tests():
     _state["checked"] = False
+
+
+def pin_platform_from_env():
+    """Make an explicit `JAX_PLATFORMS=cpu` request stick.
+
+    The axon plugin rewrites JAX_PLATFORMS to "axon,cpu" during jax
+    import, so env-only pinning silently re-enables the tunnel backend
+    — and a wedged tunnel then hangs backend init. Call this before the
+    first jax touch in scripts that honor the env var (benchmarks,
+    tests outside conftest)."""
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")

@@ -76,10 +76,14 @@ class CachedOp:
     # ------------------------------------------------------------------
     def _get_fn(self, is_train, diff_names):
         from . import inspector as _inspector
+        from .ops.nn import residual_knobs
         # keyed on the NaN-guard flag so toggling set_nan_guard()
-        # retraces with/without the staged checks
+        # retraces with/without the staged checks; ditto the residual-
+        # format env knobs (int8/bn/relu/pool), which are read at trace
+        # time
         key = (is_train, diff_names, _inspector.nan_guard_enabled(),
-               mirror_enabled(self._flags) if diff_names else False)
+               mirror_enabled(self._flags) if diff_names else False,
+               residual_knobs())
         fn = self._fns.get(key)
         if fn is not None:
             return fn

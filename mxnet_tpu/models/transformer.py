@@ -279,13 +279,14 @@ def _decode_attention(q, cache_k, cache_v, pos, cfg):
         block_k = math.gcd(cache_k.shape[1], 128)
         return flash_decode(q, cache_k, cache_v, pos + 1,
                             block_k=block_k)
-    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
-                   cache_k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhd,bthd->bht", q, cache_k,
+                   preferred_element_type=jnp.float32) / np.sqrt(
+                       q.shape[-1])
     t_pos = jnp.arange(cache_k.shape[1])
     s = jnp.where((t_pos <= pos)[None, None, :], s, -1e30)
     a = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bht,bthd->bhd", a,
-                      cache_v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bht,bthd->bhd", a.astype(cache_v.dtype), cache_v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def decode_step(params, cache, tokens, pos, cfg):

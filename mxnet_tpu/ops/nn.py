@@ -45,6 +45,62 @@ def _conv_dnums(nd):
                                       (lhs, rhs, lhs))
 
 
+def _conv_core(data, weight, stride, dilate, pad, num_group):
+    # bf16 convs: no preferred_element_type — the MXU already accumulates
+    # bf16 products in fp32, and forcing an fp32 output dtype breaks the
+    # conv transpose rule (fp32 cotangent meets bf16 operand in the
+    # weight-gradient conv)
+    return lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=_conv_dnums(data.ndim - 2),
+        feature_group_count=num_group)
+
+
+def _int8_residual_enabled():
+    # OPT-IN (lossy): MXNET_INT8_RESIDUAL=1 saves each conv's input
+    # activation as symmetric per-channel int8 (plus an fp32 scale) for
+    # the weight-gradient conv — halving the largest residual class of
+    # an AMP ResNet step at a ~1e-2 relative dW error (dX stays exact:
+    # it only needs the weights). This is PERF.md's "8-bit
+    # saved-activation compression" intensity lever; default OFF
+    # because it changes training numerics.
+    import os
+    return os.environ.get("MXNET_INT8_RESIDUAL", "0").lower() in (
+        "1", "true")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _conv_int8_residual(data, weight, stride, dilate, pad, num_group):
+    return _conv_core(data, weight, stride, dilate, pad, num_group)
+
+
+def _conv_i8_fwd(data, weight, stride, dilate, pad, num_group):
+    out = _conv_core(data, weight, stride, dilate, pad, num_group)
+    red = tuple(i for i in range(data.ndim) if i != 1)
+    amax = jnp.max(jnp.abs(data.astype(jnp.float32)), axis=red,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return out, (q, scale, weight)
+
+
+def _conv_i8_bwd(stride, dilate, pad, num_group, res, ct):
+    q, scale, weight = res
+    deq = (q.astype(jnp.float32) * scale).astype(weight.dtype)
+    # conv is bilinear: its transpose evaluated at the dequantized
+    # input gives dW from the int8 reconstruction (lossy) and dX from
+    # the exact weights
+    _, vjp = jax.vjp(
+        lambda d, w: _conv_core(d, w, stride, dilate, pad, num_group),
+        deq, weight)
+    return vjp(ct)
+
+
+_conv_int8_residual.defvjp(_conv_i8_fwd, _conv_i8_bwd)
+
+
 @register(name="Convolution")
 def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=1, num_group=1, no_bias=False,
@@ -59,16 +115,11 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = _tuplize(stride, nd)
     dilate = _tuplize(dilate, nd)
     pad = _tuplize(pad if pad != () else 0, nd)
-    dn = _conv_dnums(nd)
-    # bf16 convs: no preferred_element_type — the MXU already accumulates
-    # bf16 products in fp32, and forcing an fp32 output dtype breaks the
-    # conv transpose rule (fp32 cotangent meets bf16 operand in the
-    # weight-gradient conv)
-    out = lax.conv_general_dilated(
-        data, weight, window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group)
+    if _int8_residual_enabled():
+        out = _conv_int8_residual(data, weight, stride, dilate, pad,
+                                  num_group)
+    else:
+        out = _conv_core(data, weight, stride, dilate, pad, num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -143,6 +194,17 @@ def _window_reduce(data, kernel, stride, pads, combine, init_val, use_np=False):
             piece = lax.slice(padded, starts, limits, strides)
         acc = piece if acc is None else combine(acc, piece)
     return acc
+
+
+def residual_knobs():
+    """The trace-time residual-format flags as one tuple. Compiled-fn
+    caches (CachedOp._get_fn, the eager record-vjp cache) include it in
+    their keys so toggling an env knob in-process retraces instead of
+    silently reusing a stale program (the MXNET_BACKWARD_DO_MIRROR
+    cache-aliasing class). Executor latches them at bind time, like
+    mirror."""
+    return (_int8_residual_enabled(), _bn_bf16_residual(),
+            _relu_mask_enabled(), _pool_index_residual())
 
 
 def _pool_index_residual():

@@ -296,3 +296,33 @@ def test_kill_mxnet_local(tmp_path):
     finally:
         if victim.poll() is None:
             victim.kill()
+
+
+def test_bench_fold_cast_variant_matches():
+    """MXNET_FOLD_CAST=1 (persistent bf16 weights, cast folded into the
+    optimizer update — the reference's mp_sgd layout) must follow the
+    same loss trajectory as the per-step-cast default."""
+    script = (
+        "import os, sys; sys.path.insert(0, %r)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from mxnet_tpu._discover import ensure_backend; ensure_backend()\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "import bench\n"
+        "step, args, mom, aux = bench.build_train_step(4, 32, classes=10)\n"
+        "rng = np.random.RandomState(0)\n"
+        "x = jnp.asarray(rng.rand(4, 3, 32, 32).astype('float32'))\n"
+        "y = jnp.asarray(rng.randint(0, 10, (4,)), jnp.int32)\n"
+        "losses = []\n"
+        "for _ in range(3):\n"
+        "    args, mom, aux, loss = step(args, mom, aux, x, y)\n"
+        "    losses.append(float(loss))\n"
+        "print('LOSSES', losses)\n" % ROOT)
+    outs = {}
+    for name, env in (("base", {}), ("fold", {"MXNET_FOLD_CAST": "1"})):
+        r = _run([sys.executable, "-c", script], **env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("LOSSES")][0]
+        outs[name] = eval(line.split(" ", 1)[1])
+    np.testing.assert_allclose(outs["fold"], outs["base"],
+                               rtol=1e-5, atol=1e-6)

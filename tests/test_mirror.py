@@ -314,3 +314,86 @@ def test_maxpool_index_residual_large_kernel():
             if found:
                 break
         assert found, (r, c)
+
+
+def test_int8_conv_residual_dx_exact_dw_close():
+    """MXNET_INT8_RESIDUAL=1 (opt-in, lossy): the conv input-gradient
+    stays EXACT (it reads only the weights), the weight gradient is
+    computed from the int8-reconstructed activation with a small
+    relative error, and the saved residual really is int8."""
+    import os
+    import subprocess
+    import sys
+
+    script = r'''
+import os, sys
+sys.path.insert(0, %r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+from mxnet_tpu._discover import ensure_backend; ensure_backend()
+import numpy as np
+import jax
+import jax.numpy as jnp
+from mxnet_tpu import ops
+conv = ops.get("Convolution").fn
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(4, 3, 10, 10).astype("float32"))
+w = jnp.asarray(rng.randn(8, 3, 3, 3).astype("float32"))
+
+def f(x, w):
+    return (conv(x, w, no_bias=True, kernel=(3, 3), num_filter=8) ** 2).sum()
+
+(dx, dw) = jax.grad(f, argnums=(0, 1))(x, w)
+res = jax.vjp(lambda a: conv(a, w, no_bias=True, kernel=(3, 3),
+                             num_filter=8), x)[1]
+dtypes = sorted({str(l.dtype) for l in jax.tree.leaves(res)})
+np.savez(sys.argv[1], dx=np.asarray(dx), dw=np.asarray(dw),
+         dtypes=np.array(dtypes))
+''' % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    import numpy as np
+    import tempfile
+    outs = {}
+    with tempfile.TemporaryDirectory() as td:
+        for name, env in (("base", {}),
+                          ("int8", {"MXNET_INT8_RESIDUAL": "1"})):
+            out = os.path.join(td, name + ".npz")
+            e = dict(os.environ)
+            e.update(env)
+            r = subprocess.run([sys.executable, "-c", script, out],
+                               env=e, capture_output=True, timeout=300)
+            assert r.returncode == 0, r.stderr[-1500:]
+            outs[name] = np.load(out)
+    np.testing.assert_allclose(outs["int8"]["dx"], outs["base"]["dx"],
+                               rtol=1e-6, atol=1e-6)
+    ref = outs["base"]["dw"]
+    err = np.abs(outs["int8"]["dw"] - ref).max() / np.abs(ref).max()
+    assert err < 2e-2, err          # int8 reconstruction error bound
+    assert err > 0                  # and it IS the lossy path
+    assert "int8" in list(outs["int8"]["dtypes"])
+    assert "int8" not in list(outs["base"]["dtypes"])
+
+
+def test_residual_knob_toggle_retraces_cached_op(monkeypatch):
+    """In-process env toggles of the residual-format knobs must retrace
+    the CachedOp compiled fn, not reuse the stale program (the
+    MXNET_BACKWARD_DO_MIRROR cache-aliasing class)."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    monkeypatch.delenv("MXNET_INT8_RESIDUAL", raising=False)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 3, 8, 8)
+                    .astype("float32"))
+    with autograd.record():
+        net(x).sum().backward()
+    cached = net._cached_op
+    n_before = len(cached._fns)
+    monkeypatch.setenv("MXNET_INT8_RESIDUAL", "1")
+    with autograd.record():
+        net(x).sum().backward()
+    assert len(cached._fns) > n_before
