@@ -73,9 +73,8 @@ def build_train_step(batch, image_size=224, classes=1000, lr=0.1):
             return (args_f32, args_bf16), mom, aux_up, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
-        import jax.numpy as _jnp
         state = (args, jax.tree.map(
-            lambda a: _jnp.asarray(a).astype(_jnp.bfloat16), args))
+            lambda a: jnp.asarray(a).astype(jnp.bfloat16), args))
         mom = jax.tree.map(lambda p: np.zeros(p.shape, np.float32), args)
         return jitted, state, mom, aux
 

@@ -24,7 +24,7 @@ from mxnet_tpu.gluon.model_zoo import vision
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), steps=10,
-          dtype="float32"):
+          dtype="float32", fold_bn=False):
     net = vision.get_model(network, classes=1000)
     net.initialize(mx.init.Xavier())
     if dtype != "float32":
@@ -33,12 +33,36 @@ def score(network, batch_size, image_shape=(3, 224, 224), steps=10,
     rng = np.random.RandomState(0)
     x = nd.array(rng.uniform(-1, 1, (batch_size,) + image_shape)
                  .astype(dtype))
+    if fold_bn:
+        # deployment path: export the hybridized graph, fold every
+        # Conv+BN pair into the conv weights (contrib.fold_bn), time
+        # the bound executor
+        import tempfile
+        from mxnet_tpu import sym
+        from mxnet_tpu.contrib.fold_bn import fold_batch_norm
+        float(net(x).asnumpy().ravel()[0])     # build the cached graph
+        with tempfile.TemporaryDirectory() as td:
+            net.export(td + "/m")
+            loaded = nd.load(td + "/m-0000.params")
+            s = sym.load(td + "/m-symbol.json")
+        args = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                if k.startswith("arg:")}
+        auxs = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                if k.startswith("aux:")}
+        fsym, fargs, fauxs = fold_batch_norm(s, args, auxs)
+        ex = fsym.simple_bind(mx.current_context(), grad_req="null",
+                              type_dict={"data": np.dtype(dtype)},
+                              data=x.shape)
+        ex.copy_params_from(fargs, fauxs)
+        run = lambda: ex.forward(is_train=False, data=x)[0]
+    else:
+        run = lambda: net(x)
     # compile + warmup; the scalar fetch forces device completion
-    float(net(x).asnumpy().ravel()[0])
-    float(net(x).asnumpy().ravel()[0])
+    float(run().asnumpy().ravel()[0])
+    float(run().asnumpy().ravel()[0])
     tic = time.time()
     for _ in range(steps):
-        out = net(x)
+        out = run()
     float(out.asnumpy().ravel()[0])
     return batch_size * steps / (time.time() - tic)
 
@@ -53,14 +77,19 @@ def main():
     parser.add_argument("--image-shape", type=str, default="3,224,224")
     parser.add_argument("--dtype", type=str, default="float32")
     parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--fold-bn", action="store_true",
+                        help="fold Conv+BN pairs into conv weights "
+                             "(contrib.fold_bn deployment path)")
     args = parser.parse_args()
 
     shape = tuple(int(d) for d in args.image_shape.split(","))
     for network in args.networks.split(","):
         for bs in (int(b) for b in args.batch_sizes.split(",")):
-            speed = score(network, bs, shape, args.steps, args.dtype)
-            print("network: %-16s batch: %-4d  %.1f img/s"
-                  % (network, bs, speed))
+            speed = score(network, bs, shape, args.steps, args.dtype,
+                          fold_bn=args.fold_bn)
+            print("network: %-16s batch: %-4d  %.1f img/s%s"
+                  % (network, bs, speed,
+                     "  (bn-folded)" if args.fold_bn else ""))
 
 
 if __name__ == "__main__":

@@ -2,6 +2,7 @@
 
 from . import amp
 from . import onnx
+from . import fold_bn
 from . import quantization
 from . import svrg_optimization
 from . import tensorboard

@@ -1,0 +1,178 @@
+"""Inference-time Conv+BatchNorm fusion (graph + params rewrite).
+
+Reference counterpart: the conv+BN subgraph fusion the MKLDNN and
+TensorRT backends perform when quantizing / converting for deployment
+(src/operator/subgraph/mkldnn/mkldnn_conv.cc fuse_bn path). There it is
+a backend pass over NNVM subgraphs; here it is a pure function on the
+reference-layout symbol JSON plus the parameter dict — the TPU graph
+needs no backend machinery, because after folding, XLA sees a plain
+conv+bias and fuses the rest.
+
+Math (per output channel o, inference BN with global stats):
+    bn(conv(x, W) + b) = conv(x, W * s) + (b - mean) * s + beta
+    with s = gamma / sqrt(var + eps)
+so the BN node disappears into the conv's weights and bias. Exact for
+inference (is_train=False); training graphs must keep BN (batch stats).
+
+    folded_sym, folded_args, remaining_auxs = fold_batch_norm(
+        sym, args, auxs)
+"""
+
+import json
+
+import numpy as np
+
+__all__ = ["fold_batch_norm"]
+
+
+def _attr_bool(attrs, name, default):
+    v = attrs.get(name)
+    if v is None:
+        return default
+    return str(v).lower() in ("1", "true")
+
+
+def _np(value):
+    return value if isinstance(value, np.ndarray) else value.asnumpy()
+
+
+def fold_batch_norm(symbol, arg_params, aux_params):
+    """Fold every foldable Conv->BatchNorm pair; returns
+    (new_symbol, new_arg_params, remaining_aux_params). Foldable means:
+    the BN's data input is a Convolution output consumed ONLY by that
+    BN, the BN normalizes axis 1 (the conv's output-channel axis), and
+    only the BN's first output is consumed. Folded BNs' moving stats
+    are baked into the conv weights; unfoldable BNs (e.g. pre-
+    activation BNs fed by an add) keep theirs in the returned aux
+    dict."""
+    from .. import ndarray as nd_mod
+    from .. import symbol as sym_mod
+
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    args = {k: _np(v) for k, v in arg_params.items()}
+    auxs = {k: _np(v) for k, v in (aux_params or {}).items()}
+
+    # consumers per (node, out_index)
+    consumers = {}
+    for i, node in enumerate(nodes):
+        for ni, oi, _ in node["inputs"]:
+            consumers.setdefault((ni, oi), []).append(i)
+    for ni, oi, _ in graph["heads"]:
+        consumers.setdefault((ni, oi), []).append(-1)
+
+    drop_nodes = set()          # node indices to remove
+    redirect = {}               # (bn_idx, 0) -> (conv_idx, 0)
+    new_bias_nodes = {}         # conv_idx -> bias node dict (to insert)
+
+    for bi, bn in enumerate(nodes):
+        if bn["op"] != "BatchNorm":
+            continue
+        attrs = bn.get("attrs", {})
+        if int(attrs.get("axis", 1)) != 1:
+            continue
+        # use_global_stats is irrelevant here: inference executors use
+        # the moving stats either way, and folding is inference-only
+        ci, coi, _ = bn["inputs"][0]
+        conv = nodes[ci]
+        if conv["op"] != "Convolution" or coi != 0:
+            continue
+        if consumers.get((ci, 0), []) != [bi]:
+            continue            # conv output has other consumers
+        if any(consumers.get((bi, k)) for k in (1, 2)):
+            continue            # someone reads batch mean/var outputs
+        names = [nodes[n]["name"] for n, _, _ in bn["inputs"][1:5]]
+        g_name, b_name, mm_name, mv_name = names
+        if mm_name not in auxs or mv_name not in auxs:
+            continue
+        eps = float(attrs.get("eps", 1e-3))
+        fix_gamma = _attr_bool(attrs, "fix_gamma", True)
+        gamma = args.get(g_name)
+        beta = args.get(b_name)
+        if gamma is None or beta is None:
+            continue
+        if fix_gamma:
+            gamma = np.ones_like(gamma)
+        mean = auxs[mm_name]
+        var = auxs[mv_name]
+        s = (gamma / np.sqrt(var + eps)).astype(np.float32)
+
+        conv_attrs = conv.get("attrs", {})
+        w_name = nodes[conv["inputs"][1][0]]["name"]
+        w = args[w_name]
+        args[w_name] = (w.astype(np.float32)
+                        * s.reshape((-1,) + (1,) * (w.ndim - 1))
+                        ).astype(w.dtype)
+        no_bias = _attr_bool(conv_attrs, "no_bias", False)
+        if no_bias or len(conv["inputs"]) < 3:
+            old_b = np.zeros(w.shape[0], np.float32)
+            bias_name = conv["name"] + "_folded_bias"
+            new_bias_nodes[ci] = {"op": "null", "name": bias_name,
+                                  "attrs": {}, "inputs": []}
+            import ast
+            in_names = conv_attrs.get("__input_names__")
+            if in_names:
+                conv_attrs["__input_names__"] = str(
+                    tuple(ast.literal_eval(in_names)) + ("bias",))
+        else:
+            bias_name = nodes[conv["inputs"][2][0]]["name"]
+            old_b = args[bias_name].astype(np.float32)
+        args[bias_name] = ((old_b - mean) * s + beta).astype(w.dtype)
+        conv_attrs["no_bias"] = "False"
+        conv["attrs"] = conv_attrs
+
+        drop_nodes.add(bi)
+        for k in (1, 2, 3, 4):
+            pi = bn["inputs"][k][0]
+            # param nodes feeding only this BN disappear with it
+            if all(c == bi for c in consumers.get((pi, 0), [])):
+                drop_nodes.add(pi)
+        redirect[(bi, 0)] = (ci, 0)
+        for name in (g_name, b_name):
+            args.pop(name, None)
+        auxs.pop(mm_name, None)
+        auxs.pop(mv_name, None)
+
+    if not redirect:
+        return (symbol, {k: nd_mod.array(v) for k, v in args.items()},
+                {k: nd_mod.array(v) for k, v in auxs.items()})
+
+    # rebuild the node list: drop folded nodes, splice in bias params
+    new_nodes = []
+    index_of = {}
+    for i, node in enumerate(nodes):
+        if i in drop_nodes:
+            continue
+        if i in new_bias_nodes:
+            bias_node = new_bias_nodes[i]
+            index_of[("bias", i)] = len(new_nodes)
+            new_nodes.append(bias_node)
+        index_of[i] = len(new_nodes)
+        new_nodes.append(node)
+
+    def map_ref(ref):
+        ni, oi, vi = ref
+        while (ni, oi) in redirect:
+            ni, oi = redirect[(ni, oi)]
+        return [index_of[ni], oi, vi]
+
+    for i, node in enumerate(nodes):
+        if i in drop_nodes:
+            continue
+        inputs = [map_ref(r) for r in node["inputs"]]
+        if i in new_bias_nodes and len(inputs) == 2:
+            inputs.append([index_of[("bias", i)], 0, 0])
+        node["inputs"] = inputs
+    graph["heads"] = [map_ref(r) for r in graph["heads"]]
+    graph["nodes"] = new_nodes
+    graph["arg_nodes"] = [j for j, n in enumerate(new_nodes)
+                          if n["op"] == "null"]
+
+    new_sym = sym_mod.load_json(json.dumps(graph))
+    arg_names = set(new_sym.list_arguments())
+    aux_names = set(new_sym.list_auxiliary_states())
+    out_args = {k: nd_mod.array(v) for k, v in args.items()
+                if k in arg_names}
+    out_auxs = {k: nd_mod.array(v) for k, v in auxs.items()
+                if k in aux_names}
+    return new_sym, out_args, out_auxs

@@ -1,0 +1,99 @@
+"""Conv+BN inference fusion (contrib.fold_bn).
+
+Reference behavior: the MKLDNN subgraph backend's conv+BN fuse
+(src/operator/subgraph/mkldnn/mkldnn_conv.cc) — here a pure graph +
+params rewrite, exact for inference numerics.
+"""
+
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.fold_bn import fold_batch_norm
+
+
+def _bind_forward(s, args, auxs, x):
+    ex = s.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    ex.copy_params_from(args, auxs)
+    return ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+
+def test_fold_bn_toy_chain_exact():
+    """no_bias conv + fix_gamma=False BN, then biased conv +
+    fix_gamma=True BN: both fold, numerics match, aux states vanish."""
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                         no_bias=True, name="c1")
+    b1 = sym.BatchNorm(c1, fix_gamma=False, name="bn1")
+    r1 = sym.Activation(b1, act_type="relu")
+    c2 = sym.Convolution(r1, kernel=(1, 1), num_filter=6, name="c2")
+    b2 = sym.BatchNorm(c2, fix_gamma=True, name="bn2")
+    net = sym.Flatten(b2)
+
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    args = {n: nd.array(rng.randn(*s).astype("float32") * 0.2)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n != "data"}
+    auxs = {n: nd.array((rng.rand(*s) + 0.5).astype("float32"))
+            for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    y_ref = _bind_forward(net, args, auxs, x)
+
+    fsym, fargs, fauxs = fold_batch_norm(net, args, auxs)
+    g = json.loads(fsym.tojson())
+    assert not any(n["op"] == "BatchNorm" for n in g["nodes"])
+    assert not fsym.list_auxiliary_states()
+    y = _bind_forward(fsym, fargs, fauxs, x)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_bn_skips_shared_conv_output():
+    """A conv output consumed by BOTH a BN and another op must not be
+    folded (the other consumer needs the un-normalized value)."""
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c")
+    b = sym.BatchNorm(c, fix_gamma=False, name="bn")
+    net = sym.Group([sym.Flatten(b), sym.Flatten(c)])
+
+    rng = np.random.RandomState(1)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(2, 3, 4, 4))
+    args = {n: nd.array(rng.randn(*s).astype("float32"))
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n != "data"}
+    auxs = {n: nd.array((rng.rand(*s) + 0.5).astype("float32"))
+            for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    fsym, _, fauxs = fold_batch_norm(net, args, auxs)
+    g = json.loads(fsym.tojson())
+    assert any(n["op"] == "BatchNorm" for n in g["nodes"])
+    # the surviving BN keeps its moving stats
+    assert set(fauxs) == set(auxs)
+
+
+def test_fold_bn_resnet18_zoo(tmp_path):
+    """A real zoo graph: every BN folds away and the outputs match."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_resnet(1, 18, classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.RandomState(0).uniform(-1, 1, (2, 3, 32, 32)) \
+        .astype(np.float32)
+    y_ref = net(nd.array(x)).asnumpy()
+    net.export(str(tmp_path / "m"))
+
+    loaded = nd.load(str(tmp_path / "m-0000.params"))
+    args = {k.split(":", 1)[1]: v for k, v in loaded.items()
+            if k.startswith("arg:")}
+    auxs = {k.split(":", 1)[1]: v for k, v in loaded.items()
+            if k.startswith("aux:")}
+    s = sym.load(str(tmp_path / "m-symbol.json"))
+
+    fsym, fargs, fauxs = fold_batch_norm(s, args, auxs)
+    g = json.loads(fsym.tojson())
+    n_bn = sum(1 for n in g["nodes"] if n["op"] == "BatchNorm")
+    assert n_bn == 0, "%d BatchNorms left unfolded" % n_bn
+    y = _bind_forward(fsym, fargs, fauxs, x)
+    np.testing.assert_allclose(y, y_ref, rtol=2e-3, atol=2e-4)
