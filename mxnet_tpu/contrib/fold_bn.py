@@ -111,9 +111,12 @@ def fold_batch_norm(symbol, arg_params, aux_params):
                                   "attrs": {}, "inputs": []}
             import ast
             in_names = conv_attrs.get("__input_names__")
-            if in_names:
-                conv_attrs["__input_names__"] = str(
-                    tuple(ast.literal_eval(in_names)) + ("bias",))
+            # always record the input-name tuple: downstream rewrites
+            # (quantization) resolve the spliced bias through it, and
+            # reference-layout JSON may not carry the attr at all
+            base_names = tuple(ast.literal_eval(in_names)) if in_names \
+                else ("data", "weight")
+            conv_attrs["__input_names__"] = str(base_names + ("bias",))
         else:
             bias_name = nodes[conv["inputs"][2][0]]["name"]
             old_b = args[bias_name].astype(np.float32)

@@ -190,13 +190,21 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=(), calib_mode="naive",
                    calib_data=None, num_calib_examples=None,
                    quantized_dtype="int8", ctx=None,
-                   label_names=("softmax_label",), logger=None):
+                   label_names=("softmax_label",), logger=None,
+                   fold_bn=False):
     """Reference quantize_model API: returns (qsym, qarg_params,
-    aux_params)."""
+    aux_params). fold_bn=True first folds Conv+BN pairs into the conv
+    weights (contrib.fold_bn) — the reference's fuse-then-quantize
+    subgraph flow — so the quantized conv absorbs the normalization
+    instead of sandwiching an fp32 BN between int8 ops."""
     logger = logger or logging.getLogger(__name__)
     if quantized_dtype not in ("int8", "auto"):
         raise ValueError("quantized_dtype %s not supported (int8 only)"
                          % quantized_dtype)
+    if fold_bn:
+        from .fold_bn import fold_batch_norm
+        sym, arg_params, aux_params = fold_batch_norm(
+            sym, arg_params, aux_params)
     excluded = set(excluded_sym_names)
 
     thresholds = {}
@@ -251,20 +259,28 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                                       dtype="int8")
             ins = [new_inputs[0], qweight_var]
             names = ["data", "weight"]
-            if not node.attrs.get("no_bias", False) and \
-                    bname in arg_params:
+            if not node.attrs.get("no_bias", False):
+                # resolve the bias through the graph input, not the
+                # <name>_bias convention — rewrites like fold_bn splice
+                # bias vars under other names
                 bidx = in_names.index("bias") if "bias" in in_names \
                     else None
-                bias_sym = new_inputs[bidx] if bidx is not None \
-                    else sym_mod.var(bname)
-                bnode = bias_sym._nodes[bias_sym._outputs[0][0]]
-                if bnode.is_var():
-                    # quantized ops have no auto param-shape rule; pin
-                    # the known bias shape for inference
-                    bnode.attrs.setdefault(
-                        "__shape__", tuple(arg_params[bname].shape))
-                ins.append(bias_sym)
-                names.append("bias")
+                bias_sym = new_inputs[bidx] \
+                    if bidx is not None and bidx < len(new_inputs) \
+                    else (sym_mod.var(bname) if bname in arg_params
+                          else None)
+                if bias_sym is not None:
+                    bnode = bias_sym._nodes[bias_sym._outputs[0][0]]
+                    bias_param = arg_params.get(
+                        bnode.name if bnode.is_var() else bname)
+                    if bnode.is_var() and bias_param is not None:
+                        # quantized ops have no auto param-shape rule;
+                        # pin the known bias shape for inference
+                        bnode.attrs.setdefault(
+                            "__shape__", tuple(bias_param.shape))
+                    if bias_param is not None or not bnode.is_var():
+                        ins.append(bias_sym)
+                        names.append("bias")
             attrs["__input_names__"] = tuple(names)
             new_syms[id(node)] = sym_mod._compose(
                 qop, ins, attrs, node.name + "_quantized")

@@ -163,3 +163,56 @@ def test_quantize_model_excluded_layers():
     js = qsym.tojson()
     assert "fc2_quantized" in js
     assert "fc1_quantized" not in js
+
+
+def test_quantize_model_fold_bn_convnet():
+    """fold_bn=True: the Conv+BN pair folds before quantization, so the
+    quantized graph has no BatchNorm and accuracy holds."""
+    import json
+    rng = np.random.RandomState(3)
+    n = 256
+    X = rng.rand(n, 1, 12, 12).astype(np.float32)
+    yv = (X[:, 0, 3:9, 3:9].mean(axis=(1, 2)) >
+          X[:, 0].mean(axis=(1, 2))).astype(np.float32)
+
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                          no_bias=True, name="conv1")
+    net = sym.BatchNorm(net, fix_gamma=False, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=2, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    train_iter = mx.io.NDArrayIter(X, yv, batch_size=32, shuffle=True,
+                                   label_name="softmax_label")
+    mod.fit(train_iter, num_epoch=15,
+            optimizer_params={"learning_rate": 0.2})
+    fp32_acc = dict(mod.score(
+        mx.io.NDArrayIter(X, yv, batch_size=32,
+                          label_name="softmax_label"),
+        mx.metric.Accuracy()))["accuracy"]
+
+    arg_params, aux_params = mod.get_params()
+    calib = mx.io.NDArrayIter(X[:128], yv[:128], batch_size=32,
+                              label_name="softmax_label")
+    qsym, qargs, qaux = quantize_model(
+        net, arg_params, aux_params, data_names=("data",),
+        calib_mode="naive", calib_data=calib, fold_bn=True)
+    assert not any(nd_["op"] == "BatchNorm"
+                   for nd_ in json.loads(qsym.tojson())["nodes"])
+    assert "_contrib_quantized_conv" in qsym.tojson()
+    qmod = mx.mod.Module(qsym, data_names=("data",),
+                         label_names=("softmax_label",))
+    qmod.bind(data_shapes=[("data", (32, 1, 12, 12))],
+              label_shapes=[("softmax_label", (32,))],
+              for_training=False)
+    qmod.set_params(qargs, qaux, allow_missing=True, allow_extra=True)
+    int8_acc = dict(qmod.score(
+        mx.io.NDArrayIter(X, yv, batch_size=32,
+                          label_name="softmax_label"),
+        mx.metric.Accuracy()))["accuracy"]
+    assert int8_acc >= fp32_acc - 0.02, (fp32_acc, int8_acc)
