@@ -1,0 +1,125 @@
+"""Run the whole STATUS.md chip queue in order, one command.
+
+    python benchmark/run_chip_queue.py            # full queue
+    python benchmark/run_chip_queue.py --quick    # headline + A/Bs only
+
+Each leg runs as its own subprocess (serial — the build host has one
+core and concurrent runs starve the collective rendezvous, PERF.md
+operational note), with a timeout; failures are recorded and the queue
+continues. Results land in BENCH_TABLE.json at the repo root (raw
+stdout tails + parsed one-line metrics) so a single tunnel-alive
+window captures everything the round needs.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUEUE = [
+    # (name, argv or stdin-script, timeout_s, quick?)
+    ("cost_compare_timed",
+     {"stdin": "benchmark/cost_compare.py", "args": ["timed"]}, 3600, True),
+    ("bench_headline",
+     {"argv": [sys.executable, "bench.py"],
+      "env": {"MXNET_BENCH_REPEATS": "5"}}, 3600, True),
+    ("bench_int8_residual",
+     {"argv": [sys.executable, "bench.py"],
+      "env": {"MXNET_INT8_RESIDUAL": "1"}}, 1800, True),
+    ("bench_fold_cast",
+     {"argv": [sys.executable, "bench.py"],
+      "env": {"MXNET_FOLD_CAST": "1"}}, 1800, True),
+    ("decode_flash",
+     {"stdin": "benchmark/decode_bench.py"}, 1800, False),
+    ("decode_dense",
+     {"stdin": "benchmark/decode_bench.py",
+      "env": {"MXNET_DECODE_FLASH": "0"}}, 1800, False),
+    ("inference_fp32",
+     {"argv": [sys.executable,
+               "examples/image_classification/benchmark_score.py",
+               "--networks",
+               "alexnet,resnet50_v1,mobilenet1.0,squeezenet1.1,vgg16",
+               "--batch-sizes", "1,32"]}, 3600, False),
+    ("inference_bf16",
+     {"argv": [sys.executable,
+               "examples/image_classification/benchmark_score.py",
+               "--networks", "resnet50_v1,mobilenet1.0",
+               "--batch-sizes", "32", "--dtype", "bfloat16"]}, 1800,
+     False),
+    ("inference_fold_bn",
+     {"argv": [sys.executable,
+               "examples/image_classification/benchmark_score.py",
+               "--networks", "resnet50_v1", "--batch-sizes", "32",
+               "--fold-bn"]}, 1800, False),
+    ("flash_attention",
+     {"argv": [sys.executable, "benchmark/flash_attention_bench.py"]},
+     1800, False),
+    ("bandwidth",
+     {"argv": [sys.executable, "tools/bandwidth.py",
+               "--num-batches", "10"]}, 900, False),
+]
+
+
+def run_leg(name, spec, timeout):
+    env = dict(os.environ)
+    env.update(spec.get("env", {}))
+    env.pop("PYTHONPATH", None)       # axon plugin breaks under it
+    if "stdin" in spec:
+        with open(os.path.join(ROOT, spec["stdin"])) as f:
+            script = f.read()
+        argv = [sys.executable, "-"] + spec.get("args", [])
+        kwargs = {"input": script}
+    else:
+        argv = spec["argv"]
+        kwargs = {}
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, cwd=ROOT, env=env, timeout=timeout,
+                           capture_output=True, text=True, **kwargs)
+        ok = r.returncode == 0
+        out = r.stdout[-4000:]
+        err = r.stderr[-1500:] if not ok else ""
+    except subprocess.TimeoutExpired:
+        ok, out, err = False, "", "timeout after %ds" % timeout
+    return {"leg": name, "ok": ok, "seconds": round(time.time() - t0, 1),
+            "stdout": out, "stderr": err}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="headline + lever A/Bs only")
+    parser.add_argument("--out", default=os.path.join(ROOT,
+                                                      "BENCH_TABLE.json"))
+    args = parser.parse_args()
+
+    sys.path.insert(0, ROOT)
+    from mxnet_tpu._discover import probe_backend_alive
+    if not probe_backend_alive(use_cache=False):
+        print("TPU tunnel is wedged; not starting the queue",
+              file=sys.stderr)
+        return 3
+
+    results = []
+    for name, spec, timeout, quick in QUEUE:
+        if args.quick and not quick:
+            continue
+        print("==== %s ====" % name, flush=True)
+        res = run_leg(name, spec, timeout)
+        print(res["stdout"] or res["stderr"], flush=True)
+        results.append(res)
+        with open(args.out, "w") as f:   # checkpoint after every leg
+            json.dump(results, f, indent=1)
+    bad = [r["leg"] for r in results if not r["ok"]]
+    print("queue done: %d/%d legs ok%s"
+          % (len(results) - len(bad), len(results),
+             ("; failed: " + ", ".join(bad)) if bad else ""))
+    return 0 if not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,9 +271,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                           else None)
                 if bias_sym is not None:
                     bnode = bias_sym._nodes[bias_sym._outputs[0][0]]
-                    bias_param = arg_params.get(
-                        bnode.name if bnode.is_var() else bname)
-                    if bnode.is_var() and bias_param is not None:
+                    bias_param = arg_params.get(bnode.name) \
+                        if bnode.is_var() else None
+                    if bias_param is not None:
                         # quantized ops have no auto param-shape rule;
                         # pin the known bias shape for inference
                         bnode.attrs.setdefault(
