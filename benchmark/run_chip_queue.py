@@ -82,9 +82,18 @@ def run_leg(name, spec, timeout):
                            capture_output=True, text=True, **kwargs)
         ok = r.returncode == 0
         out = r.stdout[-4000:]
-        err = r.stderr[-1500:] if not ok else ""
-    except subprocess.TimeoutExpired:
-        ok, out, err = False, "", "timeout after %ds" % timeout
+        err = "" if ok else r.stderr[-1500:]
+    except subprocess.TimeoutExpired as e:
+        # keep whatever the leg printed before the kill — that partial
+        # output may be the only data from a tunnel-alive window
+        def _txt(v):
+            if isinstance(v, bytes):
+                return v.decode(errors="replace")
+            return v or ""
+        ok = False
+        out = _txt(e.stdout)[-4000:]
+        err = (_txt(e.stderr)[-1200:] +
+               "\ntimeout after %ds" % timeout).strip()
     return {"leg": name, "ok": ok, "seconds": round(time.time() - t0, 1),
             "stdout": out, "stderr": err}
 
@@ -110,7 +119,9 @@ def main():
             continue
         print("==== %s ====" % name, flush=True)
         res = run_leg(name, spec, timeout)
-        print(res["stdout"] or res["stderr"], flush=True)
+        print(res["stdout"], flush=True)
+        if res["stderr"]:
+            print(res["stderr"], file=sys.stderr, flush=True)
         results.append(res)
         with open(args.out, "w") as f:   # checkpoint after every leg
             json.dump(results, f, indent=1)

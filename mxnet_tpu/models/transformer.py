@@ -30,7 +30,7 @@ from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
-           "make_decode_step", "generate"]
+           "make_decode_step", "generate", "shard_cache"]
 
 
 @dataclass
@@ -268,6 +268,17 @@ def init_cache(cfg, batch):
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
+
+
+def shard_cache(cache, cfg, mesh):
+    """Lay the KV cache out for mesh-sharded serving: batch over dp,
+    heads over tp (matching the wq/wk/wv head shardings), sequence
+    replicated — each device holds its heads' full cache and the
+    attention needs no cross-device traffic; only wo's output
+    contraction all-reduces over tp (GSPMD inserts it)."""
+    spec = P(cfg.dp_axis, None, cfg.tp_axis, None)
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), cache)
 
 
 def _decode_attention(q, cache_k, cache_v, pos, cfg):

@@ -326,3 +326,13 @@ def test_bench_fold_cast_variant_matches():
         outs[name] = eval(line.split(" ", 1)[1])
     np.testing.assert_allclose(outs["fold"], outs["base"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_llm_serving_example():
+    """Train-then-serve through the KV-cache decode under the dp/tp
+    mesh: greedy generation reproduces the memorized pattern."""
+    r = _run([sys.executable, "examples/llm_serving.py"],
+             XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:])
+    assert "SERVED OK" in r.stdout
+    assert "mesh dp=2 tp=2" in r.stdout
