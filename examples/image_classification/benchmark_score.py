@@ -34,27 +34,11 @@ def score(network, batch_size, image_shape=(3, 224, 224), steps=10,
     x = nd.array(rng.uniform(-1, 1, (batch_size,) + image_shape)
                  .astype(dtype))
     if fold_bn:
-        # deployment path: export the hybridized graph, fold every
-        # Conv+BN pair into the conv weights (contrib.fold_bn), time
-        # the bound executor
-        import tempfile
-        from mxnet_tpu import sym
-        from mxnet_tpu.contrib.fold_bn import fold_batch_norm
-        float(net(x).asnumpy().ravel()[0])     # build the cached graph
-        with tempfile.TemporaryDirectory() as td:
-            net.export(td + "/m")
-            loaded = nd.load(td + "/m-0000.params")
-            s = sym.load(td + "/m-symbol.json")
-        args = {k.split(":", 1)[1]: v for k, v in loaded.items()
-                if k.startswith("arg:")}
-        auxs = {k.split(":", 1)[1]: v for k, v in loaded.items()
-                if k.startswith("aux:")}
-        fsym, fargs, fauxs = fold_batch_norm(s, args, auxs)
-        ex = fsym.simple_bind(mx.current_context(), grad_req="null",
-                              type_dict={"data": np.dtype(dtype)},
-                              data=x.shape)
-        ex.copy_params_from(fargs, fauxs)
-        run = lambda: ex.forward(is_train=False, data=x)[0]
+        # deployment path: trace + export + fold in one call
+        # (contrib.fold_bn.fold_block), then time the folded block
+        from mxnet_tpu.contrib.fold_bn import fold_block
+        folded = fold_block(net, x)
+        run = lambda: folded(x)
     else:
         run = lambda: net(x)
     # compile + warmup; the scalar fetch forces device completion

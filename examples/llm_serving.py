@@ -53,7 +53,6 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from mxnet_tpu.parallel import make_mesh
     from mxnet_tpu.models import transformer as T
 
@@ -87,6 +86,7 @@ def main():
     prompt_np = batch_tokens(5)[:2]
     prompt = jnp.asarray(prompt_np)
 
+    mesh = None
     if args.no_mesh:
         tag = "single-device"
     else:
@@ -95,12 +95,11 @@ def main():
         dp = 2 if n % (2 * tp) == 0 else 1
         mesh = make_mesh({"dp": dp, "tp": tp,
                           "rest": n // (dp * tp)})
-        cfg.dp_axis, cfg.tp_axis = "dp", "tp"
         params = T.shard_params(params, cfg, mesh)
         tag = "mesh dp=%d tp=%d" % (dp, tp)
 
     t0 = time.time()
-    out = T.generate(params, prompt, args.gen, cfg)
+    out = T.generate(params, prompt, args.gen, cfg, mesh=mesh)
     out = np.asarray(out)
     dt = time.time() - t0
     period = prompt_np[:, :4]

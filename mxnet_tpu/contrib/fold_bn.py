@@ -22,7 +22,7 @@ import json
 
 import numpy as np
 
-__all__ = ["fold_batch_norm"]
+__all__ = ["fold_batch_norm", "fold_block"]
 
 
 def _attr_bool(attrs, name, default):
@@ -179,3 +179,42 @@ def fold_batch_norm(symbol, arg_params, aux_params):
     out_auxs = {k: nd_mod.array(v) for k, v in auxs.items()
                 if k in aux_names}
     return new_sym, out_args, out_auxs
+
+
+def fold_block(net, x):
+    """One-call gluon deployment: HybridBlock -> BN-folded SymbolBlock.
+
+    Runs `net` once on `x` to build its cached graph, exports it, folds
+    every Conv+BN pair, and returns a gluon.SymbolBlock carrying the
+    folded params — drop-in for inference (`folded(x)`).
+    """
+    import os
+    import tempfile
+
+    from .. import ndarray as nd_mod
+    from ..gluon import SymbolBlock
+
+    net.hybridize()
+    net(x)                                  # trace the cached graph
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        net.export(prefix)
+        loaded = nd_mod.load(prefix + "-0000.params")
+        from .. import symbol as sym_mod
+        s = sym_mod.load(prefix + "-symbol.json")
+        args = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                if k.startswith("arg:")}
+        auxs = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                if k.startswith("aux:")}
+        fsym, fargs, fauxs = fold_batch_norm(s, args, auxs)
+        sym_file = os.path.join(td, "folded-symbol.json")
+        with open(sym_file, "w") as f:
+            f.write(fsym.tojson())
+        param_file = os.path.join(td, "folded.params")
+        packed = {"arg:%s" % k: v for k, v in fargs.items()}
+        packed.update({"aux:%s" % k: v for k, v in fauxs.items()})
+        nd_mod.save(param_file, packed)
+        param_names = set(fargs) | set(fauxs)
+        data_names = [n for n in fsym.list_arguments()
+                      if n not in param_names]
+        return SymbolBlock.imports(sym_file, data_names, param_file)

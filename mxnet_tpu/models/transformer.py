@@ -335,12 +335,50 @@ def make_decode_step(cfg):
     return jax.jit(step, donate_argnums=(1,))
 
 
-def generate(params, prompt, n_new, cfg, greedy=True, seed=0):
+def _sample_logits(logits, key, temperature, top_k, top_p):
+    """One sampling step over [B, V] logits — temperature scaling,
+    static top-k truncation, and nucleus (top-p) filtering, all
+    jit-compatible (static shapes; masking instead of gathering)."""
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every token whose PRECEDING cumulative mass < top_p (the
+        # first token is always kept)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+            axis=-1)
+        # threshold logit = smallest kept logit per row
+        thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
+             temperature=1.0, top_k=None, top_p=None, mesh=None):
     """Autoregressive generation: prompt [B, Tp] int32 -> [B, Tp+n_new].
 
+    Sampling: by default, passing any of `temperature` (!= 1.0),
+    `top_k`, or `top_p` samples with those controls; otherwise decoding
+    is greedy argmax. Passing greedy=True together with sampling
+    controls is a contradiction and raises. With `mesh`, the KV cache
+    is laid out dp/tp-sharded (shard_cache) to match TP-sharded params.
     The whole loop (prefill token-by-token + generation) is one
     lax.scan over positions, so it stays a single compiled program.
     """
+    sampling_requested = (temperature != 1.0 or top_k is not None
+                          or top_p is not None)
+    if greedy is None:
+        greedy = not sampling_requested
+    elif greedy and sampling_requested:
+        raise ValueError(
+            "greedy=True ignores temperature/top_k/top_p — pass "
+            "greedy=False (or omit greedy) to sample")
     b, t_prompt = prompt.shape
     total = t_prompt + n_new
     if total > cfg.max_len:
@@ -348,6 +386,8 @@ def generate(params, prompt, n_new, cfg, greedy=True, seed=0):
                          % (total, cfg.max_len))
     buf = jnp.zeros((b, total), jnp.int32).at[:, :t_prompt].set(prompt)
     cache = init_cache(cfg, b)
+    if mesh is not None:
+        cache = shard_cache(cache, cfg, mesh)
     key = jax.random.PRNGKey(seed)
 
     def body(carry, pos):
@@ -358,7 +398,7 @@ def generate(params, prompt, n_new, cfg, greedy=True, seed=0):
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits).astype(jnp.int32)
+            nxt = _sample_logits(logits, sub, temperature, top_k, top_p)
         # inside the prompt the next token is already given; past it we
         # append the model's choice
         keep_prompt = pos + 1 < t_prompt

@@ -97,3 +97,34 @@ def test_fold_bn_resnet18_zoo(tmp_path):
     assert n_bn == 0, "%d BatchNorms left unfolded" % n_bn
     y = _bind_forward(fsym, fargs, fauxs, x)
     np.testing.assert_allclose(y, y_ref, rtol=2e-3, atol=2e-4)
+
+
+def test_fold_block_gluon_one_call():
+    """fold_block: HybridBlock in, BN-folded SymbolBlock out, same
+    inference outputs."""
+    import json
+    from mxnet_tpu.contrib.fold_bn import fold_block
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(6, 3, padding=1, use_bias=False),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(5).rand(2, 3, 10, 10)
+                 .astype("float32"))
+    # push the moving stats off their init values so folding is tested
+    # against real statistics
+    from mxnet_tpu import autograd
+    for _ in range(3):
+        with autograd.record():
+            net(x).sum().backward()
+    y_ref = net(x).asnumpy()
+
+    folded = fold_block(net, x)
+    g = json.loads(folded._cached_graph[1].tojson())
+    assert not any(n["op"] == "BatchNorm" for n in g["nodes"])
+    y = folded(x).asnumpy()
+    np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)

@@ -265,3 +265,34 @@ def test_transformer_generate_greedy_consistent():
     assert np.array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
     out2 = tf.generate(params, out[:, :7], 3, cfg)
     assert np.array_equal(np.asarray(out2), np.asarray(out))
+
+
+def test_generate_sampling_controls():
+    """temperature/top_k/top_p sampling: top_k=1 equals greedy; a
+    near-zero temperature concentrates on the argmax; top_p masking
+    keeps valid distributions (no NaN, tokens in range)."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=13, d_model=24, n_heads=2,
+                               n_layers=1, d_ff=32, max_len=16)
+    params = tf.init_params(cfg, seed=9)
+    rng = np.random.RandomState(10)
+    prompt = jnp.asarray(rng.randint(0, 13, (2, 4)), jnp.int32)
+
+    greedy = np.asarray(tf.generate(params, prompt, 6, cfg))
+    top1 = np.asarray(tf.generate(params, prompt, 6, cfg, greedy=False,
+                                  top_k=1, seed=3))
+    assert np.array_equal(top1, greedy)
+
+    cold = np.asarray(tf.generate(params, prompt, 6, cfg, greedy=False,
+                                  temperature=1e-4, seed=4))
+    assert np.array_equal(cold, greedy)
+
+    nucleus = np.asarray(tf.generate(params, prompt, 6, cfg,
+                                     greedy=False, top_p=0.7, seed=5))
+    assert nucleus.shape == (2, 10)
+    assert ((nucleus >= 0) & (nucleus < 13)).all()
+    # sampling with a generous nucleus at T=1 differs from greedy with
+    # overwhelming probability on an untrained model
+    warm = np.asarray(tf.generate(params, prompt, 6, cfg, greedy=False,
+                                  temperature=1.5, top_p=0.95, seed=6))
+    assert not np.array_equal(warm, greedy)
