@@ -30,7 +30,7 @@ from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
-           "make_decode_step", "generate", "shard_cache"]
+           "make_decode_step", "generate", "shard_cache", "prefill"]
 
 
 @dataclass
@@ -143,10 +143,36 @@ def _rms_norm(x, g):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
 
 
-def _attention(x, p, cfg, mesh, manual_sp=False):
+def _qkv(x, p):
     q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
     k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
     v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
+    return q, k, v
+
+
+def _causal_attention(q, k, v, cfg, out_dtype):
+    """Single-device causal attention over [B, T, H, D] — flash kernel
+    (blocks sized gcd(T, 128), so ANY sequence length works) or the
+    dense masked softmax. Shared by training forward and prefill."""
+    if cfg.use_flash_kernel:
+        import math
+        from ..kernels import flash_attention
+        blk = math.gcd(q.shape[1], 128)
+        return flash_attention(q, k, v, causal=True, block_q=blk,
+                               block_k=blk).astype(out_dtype)
+    T = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s / np.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", a,
+                      v.astype(a.dtype)).astype(out_dtype)
+
+
+def _attention(x, p, cfg, mesh, manual_sp=False):
+    q, k, v = _qkv(x, p)
     if manual_sp:
         # already inside a shard_map manual over sp (pipeline stage
         # body). The Pallas path only engages on real TPU: interpret-
@@ -159,20 +185,8 @@ def _attention(x, p, cfg, mesh, manual_sp=False):
         o = ring_attention_sharded(q, k, v, mesh, axis_name=cfg.sp_axis,
                                    causal=True,
                                    use_flash_kernel=cfg.use_flash_kernel)
-    elif cfg.use_flash_kernel:
-        from ..kernels import flash_attention
-        # flash_attention clamps its default blocks to the sequence
-        o = flash_attention(q, k, v, causal=True).astype(x.dtype)
     else:
-        T = x.shape[1]
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                       preferred_element_type=jnp.float32)
-        s = s / np.sqrt(q.shape[-1])
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-        a = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", a, v.astype(a.dtype))
-        o = o.astype(x.dtype)
+        o = _causal_attention(q, k, v, cfg, x.dtype)
     return jnp.einsum("bthk,hkd->btd", o, p["wo"])
 
 
@@ -300,6 +314,50 @@ def _decode_attention(q, cache_k, cache_v, pos, cfg):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def prefill(params, cache, tokens, cfg):
+    """Process the whole prompt in ONE forward pass, filling the KV
+    cache for positions [0, Tp) — the serving-side complement of the
+    per-token decode_step (prompt cost: one batched MXU pass instead of
+    Tp tiny ones). Shares the q/k/v projection and causal-attention
+    block with the training forward (_qkv/_causal_attention); ring
+    (sp-sharded) attention is a training-path feature prefill does not
+    engage. Returns (last_logits [B, vocab], cache)."""
+    b, t_p = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:t_p]
+    new_cache = []
+    for p, layer_cache in zip(params["layers"], cache):
+        h = _rms_norm(x, p["ln1"])
+        q, k, v = _qkv(h, p)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["k"], k.astype(layer_cache["k"].dtype), 0,
+            axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["v"], v.astype(layer_cache["v"].dtype), 0,
+            axis=1)
+        new_cache.append({"k": ck, "v": cv})
+        o = _causal_attention(q, k, v, cfg, x.dtype)
+        x = x + jnp.einsum("bthk,hkd->btd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+    x = _rms_norm(x[:, -1], params["ln_f"])
+    return jnp.einsum("bd,vd->bv", x, params["embed"]), new_cache
+
+
+# jitted prefill per live config: generate() is the latency-sensitive
+# serving convenience, and re-wrapping jit per call would retrace every
+# request. Keyed by id() with the cfg held so the id stays valid;
+# serving processes use a handful of configs, so growth is bounded.
+_PREFILL_JIT_CACHE = {}
+
+
+def _jitted_prefill(cfg):
+    entry = _PREFILL_JIT_CACHE.get(id(cfg))
+    if entry is not None and entry[0] is cfg:
+        return entry[1]
+    fn = jax.jit(lambda p, c, t: prefill(p, c, t, cfg))
+    _PREFILL_JIT_CACHE[id(cfg)] = (cfg, fn)
+    return fn
+
+
 def decode_step(params, cache, tokens, pos, cfg):
     """One autoregressive step.
 
@@ -368,8 +426,8 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
     is greedy argmax. Passing greedy=True together with sampling
     controls is a contradiction and raises. With `mesh`, the KV cache
     is laid out dp/tp-sharded (shard_cache) to match TP-sharded params.
-    The whole loop (prefill token-by-token + generation) is one
-    lax.scan over positions, so it stays a single compiled program.
+    The prompt is prefilled in ONE batched forward (prefill), then the
+    generation steps run as one lax.scan — two compiled programs total.
     """
     sampling_requested = (temperature != 1.0 or top_k is not None
                           or top_p is not None)
@@ -384,33 +442,38 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
     if total > cfg.max_len:
         raise ValueError("prompt+n_new %d exceeds max_len %d"
                          % (total, cfg.max_len))
+    if n_new == 0:
+        return prompt
     buf = jnp.zeros((b, total), jnp.int32).at[:, :t_prompt].set(prompt)
     cache = init_cache(cfg, b)
     if mesh is not None:
         cache = shard_cache(cache, cfg, mesh)
     key = jax.random.PRNGKey(seed)
 
+    def choose(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+        key, sub = jax.random.split(key)
+        return _sample_logits(logits, sub, temperature, top_k,
+                              top_p), key
+
+    last_logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
+    nxt, key = choose(last_logits, key)
+    buf = buf.at[:, t_prompt].set(nxt)
+
     def body(carry, pos):
         buf, cache, key = carry
         tok = jax.lax.dynamic_index_in_dim(buf, pos, 1, keepdims=False)
         logits, cache = decode_step(params, cache, tok, pos, cfg)
-        if greedy:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            key, sub = jax.random.split(key)
-            nxt = _sample_logits(logits, sub, temperature, top_k, top_p)
-        # inside the prompt the next token is already given; past it we
-        # append the model's choice
-        keep_prompt = pos + 1 < t_prompt
-        cur = jax.lax.dynamic_index_in_dim(
-            buf, jnp.minimum(pos + 1, total - 1), 1, keepdims=False)
-        nxt = jnp.where(keep_prompt, cur, nxt)
+        nxt, key = choose(logits, key)
         buf = jax.lax.dynamic_update_slice_in_dim(
             buf, nxt[:, None], pos + 1, axis=1)
         return (buf, cache, key), None
 
-    (buf, _, _), _ = jax.lax.scan(
-        body, (buf, cache, key), jnp.arange(total - 1))
+    if n_new > 1:
+        (buf, _, _), _ = jax.lax.scan(
+            body, (buf, cache, key),
+            jnp.arange(t_prompt, total - 1))
     return buf
 
 

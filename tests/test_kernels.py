@@ -296,3 +296,34 @@ def test_generate_sampling_controls():
     warm = np.asarray(tf.generate(params, prompt, 6, cfg, greedy=False,
                                   temperature=1.5, top_p=0.95, seed=6))
     assert not np.array_equal(warm, greedy)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_prefill_matches_token_by_token(use_flash):
+    """Batched prompt prefill fills the same cache and produces the
+    same last-token logits as stepping decode_step through the prompt."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=19, d_model=32, n_heads=2,
+                               n_layers=2, d_ff=48, max_len=16,
+                               use_flash_kernel=use_flash)
+    params = tf.init_params(cfg, seed=11)
+    rng = np.random.RandomState(12)
+    toks = jnp.asarray(rng.randint(0, 19, (2, 7)), jnp.int32)
+
+    step_cache = tf.init_cache(cfg, 2)
+    for pos in range(7):
+        step_logits, step_cache = tf.decode_step(
+            params, step_cache, toks[:, pos], pos, cfg)
+
+    pre_logits, pre_cache = tf.prefill(params, tf.init_cache(cfg, 2),
+                                       toks, cfg)
+    np.testing.assert_allclose(np.asarray(pre_logits),
+                               np.asarray(step_logits),
+                               rtol=2e-4, atol=2e-4)
+    for lc_step, lc_pre in zip(step_cache, pre_cache):
+        np.testing.assert_allclose(
+            np.asarray(lc_pre["k"][:, :7]),
+            np.asarray(lc_step["k"][:, :7]), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(lc_pre["v"][:, :7]),
+            np.asarray(lc_step["v"][:, :7]), rtol=2e-4, atol=2e-4)
