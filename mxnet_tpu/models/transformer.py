@@ -157,7 +157,13 @@ def _causal_attention(q, k, v, cfg, out_dtype):
     if cfg.use_flash_kernel:
         import math
         from ..kernels import flash_attention
-        blk = math.gcd(q.shape[1], 128)
+        # one block when the sequence fits (or divides) 128; otherwise
+        # the largest common block — never a raise, never a 1-wide
+        # degenerate grid for short odd sequences
+        T = q.shape[1]
+        blk = min(T, 128)
+        if T % blk:
+            blk = math.gcd(T, 128)
         return flash_attention(q, k, v, causal=True, block_q=blk,
                                block_k=blk).astype(out_dtype)
     T = q.shape[1]
@@ -342,19 +348,26 @@ def prefill(params, cache, tokens, cfg):
     return jnp.einsum("bd,vd->bv", x, params["embed"]), new_cache
 
 
-# jitted prefill per live config: generate() is the latency-sensitive
+# jitted prefill per config VALUE: generate() is the latency-sensitive
 # serving convenience, and re-wrapping jit per call would retrace every
-# request. Keyed by id() with the cfg held so the id stays valid;
-# serving processes use a handful of configs, so growth is bounded.
+# request. Content keying means a mutated config retraces (no stale
+# program) and fresh-but-equal configs share one entry; the LRU bound
+# keeps a long-lived server from accumulating dead compiles.
 _PREFILL_JIT_CACHE = {}
+_PREFILL_JIT_LIMIT = 16
 
 
 def _jitted_prefill(cfg):
-    entry = _PREFILL_JIT_CACHE.get(id(cfg))
-    if entry is not None and entry[0] is cfg:
-        return entry[1]
-    fn = jax.jit(lambda p, c, t: prefill(p, c, t, cfg))
-    _PREFILL_JIT_CACHE[id(cfg)] = (cfg, fn)
+    import dataclasses
+    key = dataclasses.astuple(cfg)
+    fn = _PREFILL_JIT_CACHE.pop(key, None)
+    if fn is None:
+        frozen = dataclasses.replace(cfg)   # defensive copy: later
+        # mutations of the caller's cfg must not leak into the trace
+        fn = jax.jit(lambda p, c, t: prefill(p, c, t, frozen))
+    _PREFILL_JIT_CACHE[key] = fn            # re-insert = move to back
+    while len(_PREFILL_JIT_CACHE) > _PREFILL_JIT_LIMIT:
+        _PREFILL_JIT_CACHE.pop(next(iter(_PREFILL_JIT_CACHE)))
     return fn
 
 

@@ -47,6 +47,12 @@ def parse_args():
     p.add_argument("--test-results", type=int, default=1)
     p.add_argument("--gc-type", type=str, default="none")
     p.add_argument("--optimizer", type=str, default="None")
+    p.add_argument("--num-workers", type=int, default=1,
+                   help="cross-PROCESS mode: relaunch this tool under "
+                        "tools/launch.py with N local worker processes "
+                        "so the all-reduce crosses the multi-process "
+                        "wire path (reference: measure.py under a "
+                        "dist launcher)")
     return p.parse_args()
 
 
@@ -59,21 +65,33 @@ def run(kv_store="dist_tpu_sync", num_batches=10, disp_batches=1,
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs
 
+    rank = 0
+    if os.environ.get("MXNET_TPU_NUM_PROC"):
+        # launched under tools/launch.py: join the process group first
+        # so the kvstore collective spans every worker process
+        from mxnet_tpu import parallel
+        parallel.init_distributed()
+        rank = int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+
     kv = kvs.create(kv_store)
     if gc_type != "none":
         kv.set_gradient_compression({"type": gc_type})
     if optimizer != "None":
         kv.set_optimizer(mx.optimizer.create(optimizer))
 
-    n_workers = jax.device_count()
+    n_workers = jax.device_count()          # global collective width
+    n_local = jax.local_device_count()      # this process contributes
     rng = np.random.RandomState(0)
     shapes = RESNET_LIKE_SHAPES
     keys = list(range(len(shapes)))
     total_bytes = sum(int(np.prod(s)) for s in shapes) * 4
 
+    # every rank draws the SAME gradients (seed 0), so the global
+    # aggregate is (n_workers / n_local) x this process's local sum
     grads = [[mx.nd.array(rng.uniform(-1, 1, s).astype(np.float32))
-              for _ in range(n_workers)] for s in shapes]
-    expected = [sum(g.asnumpy() for g in glist) for glist in grads]
+              for _ in range(n_local)] for s in shapes]
+    expected = [sum(g.asnumpy() for g in glist) * (n_workers // n_local)
+                for glist in grads]
     outs = [mx.nd.empty(s) for s in shapes]
 
     for k, s in zip(keys, shapes):
@@ -94,7 +112,7 @@ def run(kv_store="dist_tpu_sync", num_batches=10, disp_batches=1,
             o.wait_to_read()
         dt = time.time() - t0
         times.append(dt)
-        if (b + 1) % disp_batches == 0:
+        if rank == 0 and (b + 1) % disp_batches == 0:
             algbw = total_bytes / dt / 1e9
             busbw = algbw * 2 * (n_workers - 1) / max(n_workers, 1)
             logging.info("batch %3d: %.3f s, algbw %6.2f GB/s, "
@@ -103,18 +121,21 @@ def run(kv_store="dist_tpu_sync", num_batches=10, disp_batches=1,
     if test_results and optimizer == "None" and gc_type == "none":
         for o, e in zip(outs, expected):
             np.testing.assert_allclose(o.asnumpy(), e, rtol=1e-4)
-        logging.info("results verified: pulled aggregate == exact sum "
-                     "over %d workers", n_workers)
+        if rank == 0:
+            logging.info("results verified: pulled aggregate == exact "
+                         "sum over %d workers", n_workers)
 
     best = min(times)
     algbw = total_bytes / best / 1e9
     # bus bandwidth degenerates to 0 at n=1; report the copy rate then
     busbw = algbw if n_workers == 1 else \
         algbw * 2 * (n_workers - 1) / n_workers
-    print('{"metric": "kvstore_allreduce_busbw", "value": %.3f, '
-          '"unit": "GB/s", "payload_mb": %.1f, "workers": %d, '
-          '"kv_store": "%s"}' % (busbw, total_bytes / 1e6, n_workers,
-                                 kv_store))
+    if rank == 0:
+        n_proc = int(os.environ.get("MXNET_TPU_NUM_PROC", "1"))
+        print('{"metric": "kvstore_allreduce_busbw", "value": %.3f, '
+              '"unit": "GB/s", "payload_mb": %.1f, "workers": %d, '
+              '"processes": %d, "kv_store": "%s"}'
+              % (busbw, total_bytes / 1e6, n_workers, n_proc, kv_store))
     return busbw
 
 
@@ -122,6 +143,22 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(message)s")
     args = parse_args()
+    if args.num_workers > 1 and not os.environ.get("MXNET_TPU_NUM_PROC"):
+        # relaunch ourselves as N local worker processes (the reference
+        # runs measure.py under its dist launcher the same way)
+        import subprocess
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..")
+        argv = [sys.executable, os.path.join(root, "tools", "launch.py"),
+                "-n", str(args.num_workers), "--launcher", "local",
+                sys.executable, os.path.abspath(__file__),
+                "--kv-store", args.kv_store,
+                "--num-batches", str(args.num_batches),
+                "--disp-batches", str(args.disp_batches),
+                "--test-results", str(args.test_results),
+                "--gc-type", args.gc_type,
+                "--optimizer", args.optimizer]
+        sys.exit(subprocess.call(argv, cwd=root))
     run(kv_store=args.kv_store, num_batches=args.num_batches,
         disp_batches=args.disp_batches, test_results=args.test_results,
         gc_type=args.gc_type, optimizer=args.optimizer)
