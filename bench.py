@@ -109,12 +109,27 @@ def main():
     import jax
     repeats = int(os.environ.get("MXNET_BENCH_REPEATS", "1"))
     if not _probe_backend_alive():
-        print(json.dumps({
+        record = {
             "metric": "resnet50_train_img_per_sec_bs%d_tpu" % BATCH,
             "value": None, "unit": "img/s", "vs_baseline": None,
             "error": "TPU backend unreachable (wedged tunnel): device "
                      "discovery hung past the probe timeout; rerun when "
-                     "the chip is attached"}))
+                     "the chip is attached"}
+        # carry the most recent on-chip measurement (maintained in
+        # BENCH_LAST_MEASURED.json whenever a chip session lands
+        # numbers) so a wedged round-end run still reports the
+        # measured state instead of a bare null
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_LAST_MEASURED.json")) as f:
+                last = json.load(f)
+            last["vs_baseline"] = round(
+                last["value"] / BASELINE_IMG_S, 3)
+            record["last_measured"] = last
+        except Exception:
+            pass
+        print(json.dumps(record))
         sys.exit(3)
     # honor JAX_PLATFORMS before backend init: plugin discovery
     # overrides the env var (the tests/conftest.py gotcha), and

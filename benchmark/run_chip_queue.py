@@ -125,6 +125,29 @@ def main():
         results.append(res)
         with open(args.out, "w") as f:   # checkpoint after every leg
             json.dump(results, f, indent=1)
+    # refresh the last-measured record bench.py falls back to on a
+    # wedged tunnel, so it always names the newest chip measurement
+    for r in results:
+        if r["leg"] != "bench_headline" or not r["ok"]:
+            continue
+        for ln in reversed(r["stdout"].splitlines()):
+            if not ln.startswith('{"metric"'):
+                continue
+            rec = json.loads(ln)
+            if rec.get("value"):
+                with open(os.path.join(ROOT,
+                                       "BENCH_LAST_MEASURED.json"),
+                          "w") as f:
+                    json.dump({
+                        "metric": rec["metric"],
+                        "value": rec["value"], "unit": rec["unit"],
+                        "when": time.strftime(
+                            "%Y-%m-%d %H:%M UTC", time.gmtime())
+                        + " (run_chip_queue headline, repeats=5)",
+                        "source": "BENCH_TABLE.json bench_headline",
+                        "rerun": "python benchmark/run_chip_queue.py",
+                    }, f, indent=1)
+            break
     bad = [r["leg"] for r in results if not r["ok"]]
     print("queue done: %d/%d legs ok%s"
           % (len(results) - len(bad), len(results),

@@ -152,8 +152,9 @@ def _qkv(x, p):
 
 def _causal_attention(q, k, v, cfg, out_dtype):
     """Single-device causal attention over [B, T, H, D] — flash kernel
-    (blocks sized gcd(T, 128), so ANY sequence length works) or the
-    dense masked softmax. Shared by training forward and prefill."""
+    (one block when T fits/divides 128, else gcd(T, 128)-sized blocks,
+    so ANY sequence length works) or the dense masked softmax. Shared
+    by training forward and prefill."""
     if cfg.use_flash_kernel:
         import math
         from ..kernels import flash_attention

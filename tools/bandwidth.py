@@ -81,17 +81,25 @@ def run(kv_store="dist_tpu_sync", num_batches=10, disp_batches=1,
 
     n_workers = jax.device_count()          # global collective width
     n_local = jax.local_device_count()      # this process contributes
-    rng = np.random.RandomState(0)
     shapes = RESNET_LIKE_SHAPES
     keys = list(range(len(shapes)))
     total_bytes = sum(int(np.prod(s)) for s in shapes) * 4
 
-    # every rank draws the SAME gradients (seed 0), so the global
-    # aggregate is (n_workers / n_local) x this process's local sum
-    grads = [[mx.nd.array(rng.uniform(-1, 1, s).astype(np.float32))
-              for _ in range(n_local)] for s in shapes]
-    expected = [sum(g.asnumpy() for g in glist) * (n_workers // n_local)
-                for glist in grads]
+    # per-RANK seeds: each process contributes distinct gradients, so a
+    # collective that fails to cross the process boundary (e.g. scales
+    # the local sum) cannot pass the verification below
+    n_proc = int(os.environ.get("MXNET_TPU_NUM_PROC", "1"))
+
+    def rank_draws(r):
+        rr = np.random.RandomState(1000 + r)
+        return [[rr.uniform(-1, 1, s).astype(np.float32)
+                 for _ in range(n_local)] for s in shapes]
+
+    mine = rank_draws(rank)
+    grads = [[mx.nd.array(a) for a in row] for row in mine]
+    all_rows = [rank_draws(r) for r in range(n_proc)]
+    expected = [sum(a for row in all_rows for a in row[i])
+                for i in range(len(shapes))]
     outs = [mx.nd.empty(s) for s in shapes]
 
     for k, s in zip(keys, shapes):
@@ -119,8 +127,11 @@ def run(kv_store="dist_tpu_sync", num_batches=10, disp_batches=1,
                          "busbw %6.2f GB/s", b, dt, algbw, busbw)
 
     if test_results and optimizer == "None" and gc_type == "none":
+        # atol covers fp32 reassociation on near-zero sums of many
+        # distinct per-rank terms
         for o, e in zip(outs, expected):
-            np.testing.assert_allclose(o.asnumpy(), e, rtol=1e-4)
+            np.testing.assert_allclose(o.asnumpy(), e, rtol=1e-4,
+                                       atol=1e-5)
         if rank == 0:
             logging.info("results verified: pulled aggregate == exact "
                          "sum over %d workers", n_workers)
@@ -143,6 +154,14 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(message)s")
     args = parse_args()
+    if args.num_workers > 1 and os.environ.get("MXNET_TPU_NUM_PROC"):
+        n_env = os.environ["MXNET_TPU_NUM_PROC"]
+        if n_env != str(args.num_workers):
+            raise SystemExit(
+                "--num-workers %d conflicts with MXNET_TPU_NUM_PROC=%s "
+                "already in the environment (a stale export from a "
+                "previous launch?); unset it or match the values"
+                % (args.num_workers, n_env))
     if args.num_workers > 1 and not os.environ.get("MXNET_TPU_NUM_PROC"):
         # relaunch ourselves as N local worker processes (the reference
         # runs measure.py under its dist launcher the same way)
