@@ -49,6 +49,9 @@ def main():
     ap.add_argument("--no-mesh", action="store_true")
     ap.add_argument("--flash", action="store_true",
                     help="decode through the Pallas flash kernel")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve from weight-only int8 params "
+                         "(quantize_weights_int8)")
     args = ap.parse_args()
 
     import jax
@@ -86,6 +89,8 @@ def main():
     prompt_np = batch_tokens(5)[:2]
     prompt = jnp.asarray(prompt_np)
 
+    if args.int8:
+        params = T.quantize_weights_int8(params)
     mesh = None
     if args.no_mesh:
         tag = "single-device"
@@ -105,8 +110,9 @@ def main():
     period = prompt_np[:, :4]
     expect = np.tile(period, (1, out.shape[1] // 4 + 1))[:, :out.shape[1]]
     match = (out == expect).mean()
-    print("served %s: %d tokens in %.2fs, pattern match %.2f"
-          % (tag, out.size, dt, match))
+    print("served %s%s: %d tokens in %.2fs, pattern match %.2f"
+          % (tag, " int8-weights" if args.int8 else "", out.size, dt,
+             match))
     print("sample:", out[0].tolist())
     if match < 0.95:
         print("FAILED: generation diverged from the learned pattern")

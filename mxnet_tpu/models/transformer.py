@@ -30,7 +30,8 @@ from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
-           "make_decode_step", "generate", "shard_cache", "prefill"]
+           "make_decode_step", "generate", "shard_cache", "prefill",
+           "quantize_weights_int8"]
 
 
 @dataclass
@@ -131,11 +132,22 @@ def init_params(cfg, seed=0):
 
 
 def shard_params(params, cfg, mesh):
-    """device_put every param with its PartitionSpec."""
+    """device_put every param with its PartitionSpec. Quantized trees
+    (quantize_weights_int8) shard too: the int8 payload takes the
+    weight's spec, its scale/dt sidecars replicate (scales are shared
+    along the leading axis, which no spec here partitions alone)."""
     specs = param_specs(cfg)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def place(x, s):
+        if _is_q8(x):
+            return {"q8": jax.device_put(x["q8"], NamedSharding(mesh, s)),
+                    "scale": jax.device_put(
+                        x["scale"], NamedSharding(mesh, P())),
+                    "dt": x["dt"]}
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(place, params, specs,
+                        is_leaf=lambda x: isinstance(x, P) or _is_q8(x))
 
 
 def _rms_norm(x, g):
@@ -291,6 +303,55 @@ def init_cache(cfg, batch):
             for _ in range(cfg.n_layers)]
 
 
+def quantize_weights_int8(params):
+    """Weight-only int8 for serving: every dense >=2-D weight becomes a
+    {"q8": int8, "scale": fp32} pair with scales shared only along the
+    leading (input) axis — per-output-channel for 2-D weights, finer
+    than per-channel for the 3-D head-split ones; 1-D params (norms)
+    stay as they are. Decode is HBM-bound on weight reads at small
+    batch, so int8 storage halves (vs bf16) or quarters (vs fp32) the
+    bytes per token. Under jit (make_decode_step, generate, the jitted
+    prefill) XLA fuses the dequantizing convert into each weight's
+    consuming matmul, so no full-precision copy is materialized; an
+    EAGER decode_step call on a q8 tree dequantizes the whole tree per
+    call — serve through the jitted entry points. Idempotent."""
+    def q(leaf):
+        if _is_q8(leaf):
+            return leaf
+        if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+            return leaf
+        x = jnp.asarray(leaf, jnp.float32)
+        amax = jnp.max(jnp.abs(x), axis=0, keepdims=True)
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        q8 = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        # "dt" is a zero-size carrier of the original dtype — an array
+        # leaf (jit-safe) rather than a string
+        return {"q8": q8, "scale": scale.astype(jnp.float32),
+                "dt": jnp.zeros((0,), leaf.dtype)}
+    return jax.tree.map(q, params, is_leaf=_is_q8)
+
+
+def _is_q8(leaf):
+    return isinstance(leaf, dict) and "q8" in leaf
+
+
+def _dequantize_weights(params):
+    """Inverse of quantize_weights_int8, applied INSIDE the compiled
+    step — the convert fuses into each weight's consuming matmul."""
+    def dq(leaf):
+        if _is_q8(leaf):
+            return (leaf["q8"].astype(jnp.float32) * leaf["scale"]
+                    ).astype(leaf["dt"].dtype)
+        return leaf
+    return jax.tree.map(dq, params, is_leaf=_is_q8)
+
+
+def _maybe_dequantize(params):
+    return _dequantize_weights(params) \
+        if any(_is_q8(l) for l in jax.tree.leaves(
+            params, is_leaf=_is_q8)) else params
+
+
 def shard_cache(cache, cfg, mesh):
     """Lay the KV cache out for mesh-sharded serving: batch over dp,
     heads over tp (matching the wq/wk/wv head shardings), sequence
@@ -329,6 +390,7 @@ def prefill(params, cache, tokens, cfg):
     block with the training forward (_qkv/_causal_attention); ring
     (sp-sharded) attention is a training-path feature prefill does not
     engage. Returns (last_logits [B, vocab], cache)."""
+    params = _maybe_dequantize(params)
     b, t_p = tokens.shape
     x = params["embed"][tokens] + params["pos"][:t_p]
     new_cache = []
@@ -378,8 +440,10 @@ def decode_step(params, cache, tokens, pos, cfg):
     tokens [B] int32 (the token at position `pos`), pos scalar int32.
     Returns (logits [B, vocab] for the NEXT token, updated cache).
     Static shapes throughout: `pos` is data, not shape, so one compiled
-    program decodes every position.
+    program decodes every position. Accepts quantize_weights_int8
+    trees: the dequantizing converts fuse into each weight's matmul.
     """
+    params = _maybe_dequantize(params)
     x = params["embed"][tokens] + jax.lax.dynamic_index_in_dim(
         params["pos"], pos, 0, keepdims=False)
     new_cache = []

@@ -327,3 +327,35 @@ def test_prefill_matches_token_by_token(use_flash):
         np.testing.assert_allclose(
             np.asarray(lc_pre["v"][:, :7]),
             np.asarray(lc_step["v"][:, :7]), rtol=2e-4, atol=2e-4)
+
+
+def test_int8_weight_only_decode_close_to_fp():
+    """quantize_weights_int8: decode with int8 weights tracks the fp
+    path (weight-only quantization error), and generate accepts the
+    quantized tree end-to-end."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=23, d_model=32, n_heads=2,
+                               n_layers=2, d_ff=48, max_len=16)
+    params = tf.init_params(cfg, seed=13)
+    q_params = tf.quantize_weights_int8(params)
+    # at least the dense weights became int8 pairs
+    import jax
+    n_q8 = sum(1 for l in jax.tree.leaves(
+        q_params, is_leaf=tf._is_q8) if tf._is_q8(l))
+    assert n_q8 >= 2 + 6 * cfg.n_layers   # embed+pos + per-layer dense
+
+    rng = np.random.RandomState(14)
+    toks = jnp.asarray(rng.randint(0, 23, (2, 6)), jnp.int32)
+    cache_f = tf.init_cache(cfg, 2)
+    cache_q = tf.init_cache(cfg, 2)
+    for pos in range(6):
+        lf, cache_f = tf.decode_step(params, cache_f, toks[:, pos],
+                                     pos, cfg)
+        lq, cache_q = tf.decode_step(q_params, cache_q, toks[:, pos],
+                                     pos, cfg)
+    # weight-only int8: logits agree to quantization tolerance
+    denom = np.abs(np.asarray(lf)).max()
+    assert np.abs(np.asarray(lq) - np.asarray(lf)).max() / denom < 0.05
+
+    out = tf.generate(q_params, toks[:, :3], 4, cfg)
+    assert out.shape == (2, 7)
