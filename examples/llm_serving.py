@@ -52,6 +52,8 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="serve from weight-only int8 params "
                          "(quantize_weights_int8)")
+    ap.add_argument("--beam", type=int, default=0,
+                    help="also decode with beam search of this width")
     args = ap.parse_args()
 
     import jax
@@ -114,6 +116,17 @@ def main():
           % (tag, " int8-weights" if args.int8 else "", out.size, dt,
              match))
     print("sample:", out[0].tolist())
+    if args.beam:
+        seqs, scores = T.beam_search(params, prompt, args.gen, cfg,
+                                     beam=args.beam, mesh=mesh)
+        best = np.asarray(seqs)[:, 0]
+        print("beam-%d best: %s (score %.3f)"
+              % (args.beam, best[0].tolist(),
+                 float(np.asarray(scores)[0, 0])))
+        if not np.array_equal(best, expect):
+            print("FAILED: beam search diverged from the learned "
+                  "pattern")
+            return 1
     if match < 0.95:
         print("FAILED: generation diverged from the learned pattern")
         return 1

@@ -31,7 +31,7 @@ from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
            "make_decode_step", "generate", "shard_cache", "prefill",
-           "quantize_weights_int8"]
+           "quantize_weights_int8", "beam_search"]
 
 
 @dataclass
@@ -553,6 +553,77 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
             body, (buf, cache, key),
             jnp.arange(t_prompt, total - 1))
     return buf
+
+
+def beam_search(params, prompt, n_new, cfg, beam=4, length_penalty=0.0,
+                mesh=None):
+    """Beam-search decoding over the KV cache: prompt [B, Tp] ->
+    (sequences [B, beam, Tp+n_new], scores [B, beam]), beams sorted
+    best-first by total log-probability (optionally length-normalized
+    by (Tp+n_new)^length_penalty).
+
+    The cache rides at batch width B*beam; each step re-gathers the
+    cache rows of the surviving beams' parents (a batched take inside
+    the scan — static shapes, one compiled program for the loop).
+    beam=1 reduces exactly to greedy generate(). Quantized trees pass
+    through (dequant fuses inside the compiled steps); with `mesh`,
+    the expanded cache is laid out dp/tp-sharded like generate()'s."""
+    b, t_prompt = prompt.shape
+    total = t_prompt + n_new
+    if total > cfg.max_len:
+        raise ValueError("prompt+n_new %d exceeds max_len %d"
+                         % (total, cfg.max_len))
+    if n_new < 1:
+        raise ValueError("beam search needs n_new >= 1")
+    if not 1 <= beam <= cfg.vocab_size:
+        raise ValueError("beam width %d must be in [1, vocab_size=%d]"
+                         % (beam, cfg.vocab_size))
+    k = beam
+    vocab = cfg.vocab_size
+
+    cache = init_cache(cfg, b)
+    if mesh is not None:
+        cache = shard_cache(cache, cfg, mesh)
+    last_logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+
+    # first expansion: top-k tokens of the last prompt position seed
+    # the beams; the cache is replicated per beam (rows grouped as
+    # [b0*k beams..., b1*k beams, ...])
+    scores, tok0 = jax.lax.top_k(logp0, k)            # [B, k]
+    rep = lambda x: jnp.repeat(x, k, axis=0)
+    cache = jax.tree.map(rep, cache)
+    if mesh is not None:
+        cache = shard_cache(cache, cfg, mesh)
+    buf = jnp.zeros((b * k, total), jnp.int32)
+    buf = buf.at[:, :t_prompt].set(jnp.repeat(prompt, k, axis=0))
+    buf = buf.at[:, t_prompt].set(tok0.reshape(-1))
+
+    def body(carry, pos):
+        buf, cache, scores = carry
+        tok = jax.lax.dynamic_index_in_dim(buf, pos, 1, keepdims=False)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        cand = scores.reshape(b, k, 1) + logp.reshape(b, k, vocab)
+        scores, flat = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        parent = flat // vocab                         # [B, k]
+        token = (flat % vocab).astype(jnp.int32)
+        # re-gather the surviving parents' rows
+        row = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        cache = jax.tree.map(lambda x: jnp.take(x, row, axis=0), cache)
+        buf = jnp.take(buf, row, axis=0)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, token.reshape(-1, 1), pos + 1, axis=1)
+        return (buf, cache, scores), None
+
+    if n_new > 1:
+        (buf, _, scores), _ = jax.lax.scan(
+            body, (buf, cache, scores),
+            jnp.arange(t_prompt, total - 1))
+    if length_penalty:
+        scores = scores / (float(total) ** length_penalty)
+    # beams emerge sorted (top_k order is descending)
+    return buf.reshape(b, k, total), scores
 
 
 def make_train_step(cfg, mesh=None, lr=1e-2):

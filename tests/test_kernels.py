@@ -359,3 +359,45 @@ def test_int8_weight_only_decode_close_to_fp():
 
     out = tf.generate(q_params, toks[:, :3], 4, cfg)
     assert out.shape == (2, 7)
+
+
+def test_beam_search_beam1_equals_greedy_and_scores_sorted():
+    """beam=1 reduces to greedy generate(); wider beams return
+    descending scores whose best is >= the greedy path's logprob."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=17, d_model=24, n_heads=2,
+                               n_layers=1, d_ff=32, max_len=14)
+    params = tf.init_params(cfg, seed=15)
+    rng = np.random.RandomState(16)
+    prompt = jnp.asarray(rng.randint(0, 17, (2, 4)), jnp.int32)
+
+    greedy = np.asarray(tf.generate(params, prompt, 6, cfg))
+    seqs1, scores1 = tf.beam_search(params, prompt, 6, cfg, beam=1)
+    assert np.array_equal(np.asarray(seqs1)[:, 0], greedy)
+
+    seqs4, scores4 = tf.beam_search(params, prompt, 6, cfg, beam=4)
+    s4 = np.asarray(scores4)
+    assert (np.diff(s4, axis=1) <= 1e-6).all()      # sorted best-first
+    assert seqs4.shape == (2, 4, 10)
+    # the prompt is preserved on every beam
+    assert np.array_equal(
+        np.asarray(seqs4)[:, :, :4],
+        np.repeat(np.asarray(prompt)[:, None], 4, axis=1))
+
+    # real invariant: each returned score IS the sequence's total
+    # logprob under the model (recomputed with the full forward)
+    for bi in range(2):
+        for ki in range(4):
+            seq = np.asarray(seqs4)[bi, ki]
+            logits = np.asarray(tf.forward(
+                params, jnp.asarray(seq[None]), cfg))[0]
+            logp = logits - np.log(
+                np.exp(logits - logits.max(-1, keepdims=True)).sum(
+                    -1, keepdims=True)) - logits.max(-1, keepdims=True)
+            tot = sum(logp[t, seq[t + 1]] for t in range(3, 9))
+            np.testing.assert_allclose(s4[bi, ki], tot, rtol=1e-4,
+                                       atol=1e-4)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        tf.beam_search(params, prompt, 6, cfg, beam=18)  # > vocab
