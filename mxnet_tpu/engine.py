@@ -70,6 +70,9 @@ def sync_outputs(arrays):
             a.block_until_ready()
 
 
+_BACKEND_IS_CPU = None
+
+
 def needs_serial_dispatch(arrays):
     """True when an eager dispatch must block before the next one: CPU
     backend with an output sharded over more than one device. Concurrent
@@ -78,7 +81,13 @@ def needs_serial_dispatch(arrays):
     TPU per-device streams execute programs in enqueue order (identical
     across devices from the single dispatching thread), so the real
     hardware path never pays this sync."""
-    if jax.default_backend() != "cpu":
+    global _BACKEND_IS_CPU
+    if _BACKEND_IS_CPU is None:
+        # the backend is fixed once jax initializes (the library pins it
+        # before first touch, _discover.py); default_backend() re-resolves
+        # config every call — too slow for the dispatch path
+        _BACKEND_IS_CPU = jax.default_backend() == "cpu"
+    if not _BACKEND_IS_CPU:
         return False
     for a in arrays:
         s = getattr(a, "sharding", None)

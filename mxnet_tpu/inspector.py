@@ -33,6 +33,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import _fastenv as _fe
+
 __all__ = ["inspect", "TensorInspector", "guard_value", "set_nan_guard",
            "nan_guard_enabled", "set_sink"]
 
@@ -171,9 +173,12 @@ _guard_flag = None
 
 
 def nan_guard_enabled():
+    """Hot path (every CachedOp call keys its compiled-fn cache on
+    this) — reads through _fastenv, not os.environ."""
     if _guard_flag is not None:
         return _guard_flag
-    return os.environ.get("MXNET_NAN_GUARD", "0").lower() in ("1", "true")
+    return (_fe.get("MXNET_NAN_GUARD") or "0").lower() in (
+        "1", "true")
 
 
 def set_nan_guard(enabled):

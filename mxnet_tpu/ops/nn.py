@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import register
+from .. import _fastenv as _fe
 
 
 def _tuplize(v, n):
@@ -196,15 +197,40 @@ def _window_reduce(data, kernel, stride, pads, combine, init_val, use_np=False):
     return acc
 
 
+_KNOB_CACHE = (None, None)      # (raw strings, parsed bools) — one
+# tuple so readers always see a matching pair (atomic publish)
+
+
 def residual_knobs():
     """The trace-time residual-format flags as one tuple. Compiled-fn
     caches (CachedOp._get_fn, the eager record-vjp cache) include it in
     their keys so toggling an env knob in-process retraces instead of
     silently reusing a stale program (the MXNET_BACKWARD_DO_MIRROR
     cache-aliasing class). Executor latches them at bind time, like
-    mirror."""
-    return (_int8_residual_enabled(), _bn_bf16_residual(),
-            _relu_mask_enabled(), _pool_index_residual())
+    mirror.
+
+    Called on EVERY recorded eager dispatch, so the parse is memoized
+    against the raw env strings — ~0.5 us instead of ~4 (the dispatch
+    ladder budget is ~10 us/op, benchmark/opperf.py --dispatch)."""
+    global _KNOB_CACHE
+    raw = (_fe.get("MXNET_INT8_RESIDUAL"),
+           _fe.get("MXNET_BN_BF16_RESIDUAL"),
+           _fe.get("MXNET_RELU_MASK_RESIDUAL"),
+           _fe.get("MXNET_POOL_INDEX_RESIDUAL"))
+    cached = _KNOB_CACHE
+    if raw == cached[0]:
+        return cached[1]
+
+    def flag(v, default):
+        # parse the strings we ALREADY read: same rule as the
+        # _*_enabled() trace-site readers, without re-reading env
+        # (which would reopen the raw/parsed mismatch window)
+        return (v if v is not None else default).lower() in ("1", "true")
+
+    parsed = (flag(raw[0], "0"), flag(raw[1], "1"),
+              flag(raw[2], "1"), flag(raw[3], "1"))
+    _KNOB_CACHE = (raw, parsed)
+    return parsed
 
 
 def _pool_index_residual():
