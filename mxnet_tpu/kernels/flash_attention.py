@@ -424,8 +424,8 @@ _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
 # ------------------------------------------------------------- decode ---
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
-                   l_sc, *, scale, block_k, num_kb):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
+                   m_sc, l_sc, *, scale, block_k, num_kb):
     """T_q=1 step: one query row attends to the KV cache, streamed
     block by block. The valid cache length arrives per bh-row through
     SMEM; key positions at or past it are masked out of the online
@@ -466,12 +466,18 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
     def _flush():
         l = jnp.maximum(l_sc[...], 1e-30)
         o_ref[...] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+        # lse = m + log(l): log of the true sum of exp(scores) over this
+        # cache — the sufficient statistic for cross-shard combination
+        # (sequence-parallel flash decoding); rows with no valid keys
+        # flush to ~-inf and drop out of the combine
+        lse_ref[...] = m_sc[...] + jnp.log(l)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("block_k", "interpret"))
 def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
-    """q [BH, 1, D], k/v [BH, Tmax, D], lengths [BH] -> o [BH, 1, D]."""
+    """q [BH, 1, D], k/v [BH, Tmax, D], lengths [BH] ->
+    (o [BH, 1, D], lse [BH, 1])."""
     bh, t_max, head_dim = k.shape
     scale = 1.0 / (head_dim ** 0.5)
     num_kb = t_max // block_k
@@ -488,9 +494,14 @@ def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
             pl.BlockSpec((None, block_k, head_dim),
                          lambda b, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, 1, head_dim),
-                               lambda b, ki: (b, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, 1, head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, 1, head_dim), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, 1), lambda b, ki: (b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, 1, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((1, head_dim), jnp.float32),
             pltpu.VMEM((1,), jnp.float32),
@@ -514,6 +525,21 @@ def flash_decode(q, k_cache, v_cache, lengths, block_k=128,
     the query row resident and masks by the dynamic length, so the same
     compiled program serves every position. Inference-only (no vjp).
     """
+    o, _ = flash_decode_with_lse(q, k_cache, v_cache, lengths,
+                                 block_k=block_k, interpret=interpret)
+    return o
+
+
+def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=128,
+                          interpret=None):
+    """flash_decode returning (o [B, H, D], lse [B, H]) — the partial
+    result + its log-sum-exp, combinable across cache shards:
+
+        m = max_i(lse_i); w_i = exp(lse_i - m)
+        o = sum_i(w_i * o_i) / sum_i(w_i)
+
+    This is the flash-decoding decomposition for sequence-parallel
+    caches (each device holds a slice of the sequence)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, heads, head_dim = q.shape
@@ -525,12 +551,12 @@ def flash_decode(q, k_cache, v_cache, lengths, block_k=128,
     lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
         b * heads, x.shape[1], head_dim)
-    o = _flash_decode_bh(
+    o, lse = _flash_decode_bh(
         q.reshape(b, 1, heads, head_dim).transpose(0, 2, 1, 3).reshape(
             b * heads, 1, head_dim),
         to_bh(k_cache), to_bh(v_cache),
         jnp.repeat(lengths, heads), block_k, interpret)
-    return o.reshape(b, heads, head_dim)
+    return o.reshape(b, heads, head_dim), lse.reshape(b, heads)
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,

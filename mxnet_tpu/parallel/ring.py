@@ -22,7 +22,8 @@ from jax.sharding import PartitionSpec as P
 
 from . import ring_permute
 
-__all__ = ["ring_attention", "local_attention_block", "ring_attention_sharded"]
+__all__ = ["ring_attention", "local_attention_block",
+           "ring_attention_sharded", "sp_flash_decode"]
 
 _NEG_INF = -1e30
 
@@ -189,3 +190,69 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
         smapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                                 out_specs=spec, axis_names=set(manual))
     return smapped(q, k, v)
+
+
+def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
+                    batch_axis=None, block_k=128, interpret=None):
+    """Sequence-parallel flash DECODING: single-token attention against
+    a KV cache sharded over `axis_name` along its sequence dim.
+
+    q: [B, H, D] (replicated over sp); k_cache/v_cache: [B, Tmax, H, D]
+    with Tmax sharded over sp; lengths: [B] (or scalar) GLOBAL valid
+    lengths. Each device runs the flash-decode kernel over its cache
+    slice with the length clipped to the slice, then the partial
+    results combine with their log-sum-exp weights — one psum over sp
+    instead of gathering the cache (flash-decoding decomposition; the
+    long-context serving complement of ring_attention)."""
+    from ..kernels.flash_attention import flash_decode_with_lse
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local(q_l, k_l, v_l, len_l):
+        idx = jax.lax.axis_index(axis_name)
+        t_shard = k_l.shape[1]
+        local_len = jnp.clip(len_l - idx * t_shard, 0, t_shard)
+        if interpret:
+            # jnp fallback (interpret-mode pallas can't run under a
+            # partially-manual shard_map); mirrors the kernel exactly,
+            # including zero-valid-key shards: the explicit validity
+            # mask zeroes p so o=0 and lse~-1e30, which drop out of the
+            # combine (without it, an all-masked row degenerates to
+            # p=exp(0)=1 everywhere and returns mean(v))
+            valid = (jnp.arange(t_shard)[None, None, :]
+                     < local_len[:, None, None])
+            s = jnp.einsum("bhd,bthd->bht",
+                           q_l.astype(jnp.float32),
+                           k_l.astype(jnp.float32))
+            s = s / (q_l.shape[-1] ** 0.5)
+            s = jnp.where(valid, s, -1e30)
+            m_i = jnp.max(s, axis=-1)
+            p = jnp.where(valid, jnp.exp(s - m_i[..., None]), 0.0)
+            l_i = p.sum(-1)
+            o_i = jnp.einsum("bht,bthd->bhd", p,
+                             v_l.astype(jnp.float32))
+            o_i = o_i / jnp.maximum(l_i, 1e-30)[..., None]
+            lse_i = m_i + jnp.log(jnp.maximum(l_i, 1e-30))
+        else:
+            o_i, lse_i = flash_decode_with_lse(
+                q_l, k_l, v_l, local_len, block_k=block_k,
+                interpret=False)
+            o_i = o_i.astype(jnp.float32)
+        # combine partial softmaxes across the sp shards
+        m_g = jax.lax.pmax(lse_i, axis_name)
+        w = jnp.exp(lse_i - m_g)
+        num = jax.lax.psum(w[..., None] * o_i, axis_name)
+        den = jax.lax.psum(w, axis_name)
+        return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q_l.dtype)
+
+    qspec = P(batch_axis, None, None)
+    cspec = P(batch_axis, axis_name, None, None)
+    lspec = P(batch_axis)
+    manual = {axis_name} if batch_axis is None else {axis_name, batch_axis}
+    b = q.shape[0]
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    smapped = jax.shard_map(
+        local, mesh=mesh, in_specs=(qspec, cspec, cspec, lspec),
+        out_specs=qspec, axis_names=manual)
+    return smapped(q, k_cache, v_cache, lengths)

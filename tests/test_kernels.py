@@ -401,3 +401,32 @@ def test_beam_search_beam1_equals_greedy_and_scores_sorted():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         tf.beam_search(params, prompt, 6, cfg, beam=18)  # > vocab
+
+
+def test_flash_decode_lse_chunks_combine():
+    """flash_decode_with_lse: splitting the cache in two and combining
+    the partials with their lse weights reproduces the full-cache
+    result (the flash-decoding decomposition, kernel path)."""
+    from mxnet_tpu.kernels.flash_attention import (flash_decode,
+                                                   flash_decode_with_lse)
+    rng = np.random.RandomState(22)
+    b, t, h, d = 2, 64, 2, 16
+    q = jnp.asarray(rng.randn(b, h, d).astype(np.float32))
+    kc = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    vc = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    L = 50                                   # ends inside chunk 2
+
+    full = flash_decode(q, kc, vc, L, block_k=16)
+
+    o1, lse1 = flash_decode_with_lse(q, kc[:, :32], vc[:, :32],
+                                     min(L, 32), block_k=16)
+    o2, lse2 = flash_decode_with_lse(q, kc[:, 32:], vc[:, 32:],
+                                     max(L - 32, 0), block_k=16)
+    m = np.maximum(np.asarray(lse1), np.asarray(lse2))
+    w1 = np.exp(np.asarray(lse1) - m)
+    w2 = np.exp(np.asarray(lse2) - m)
+    o = (w1[..., None] * np.asarray(o1, np.float64)
+         + w2[..., None] * np.asarray(o2, np.float64)) / \
+        (w1 + w2)[..., None]
+    np.testing.assert_allclose(o, np.asarray(full), rtol=2e-4,
+                               atol=2e-4)
