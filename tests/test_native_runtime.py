@@ -149,3 +149,20 @@ def test_native_libsvm_parser_matches_python(tmp_path):
     it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(20,), batch_size=10)
     batch = next(iter(it))
     assert batch.data[0].shape == (10, 20)
+
+
+def test_fastenv_tracks_mutations():
+    """_fastenv.get matches os.environ.get across set/changed/deleted
+    keys (it reads the dict behind os.environ, which putenv mutates)."""
+    import os
+    from mxnet_tpu import _fastenv
+
+    key = "MXNET_FASTENV_TEST_%d" % os.getpid()
+    assert _fastenv.get(key) is None
+    assert _fastenv.get(key, "dflt") == "dflt"
+    os.environ[key] = "abc"
+    assert _fastenv.get(key) == "abc"
+    os.environ[key] = "xyz"
+    assert _fastenv.get(key) == "xyz"
+    del os.environ[key]
+    assert _fastenv.get(key) is None
