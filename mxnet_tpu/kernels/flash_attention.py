@@ -426,13 +426,17 @@ _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 # ------------------------------------------------------------- decode ---
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
                    m_sc, l_sc, *, scale, block_k, num_kb):
-    """T_q=1 step: one query row attends to the KV cache, streamed
-    block by block. The valid cache length arrives per bh-row through
-    SMEM; key positions at or past it are masked out of the online
-    softmax, so one compiled kernel serves every decode position."""
+    """T_q=1 step: the query rows of one KV head (1 for MHA, the G
+    grouped heads for GQA) attend to that head's cache, streamed block
+    by block. The valid cache length arrives per row through SMEM; key
+    positions at or past it are masked out of the online softmax, so
+    one compiled kernel serves every decode position. With GQA the
+    cache block is read ONCE for all G query rows — the HBM saving is
+    the point of grouping."""
     b = pl.program_id(0)
     ki = pl.program_id(1)
     length = len_ref[b]
+    g = q_ref.shape[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -444,13 +448,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
 
     @pl.when(k_start < length)
     def _step():
-        q = q_ref[...].astype(jnp.float32) * scale       # (1, D)
+        q = q_ref[...].astype(jnp.float32) * scale       # (G, D)
         k = k_ref[...].astype(jnp.float32)               # (block_k, D)
         v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
-                                                   (1, block_k), 1)
+                                                   (g, block_k), 1)
         s = jnp.where(k_pos < length, s, _NEG_INF)
         m_prev = m_sc[...]
         m_new = jnp.maximum(m_prev, s.max(axis=1))
@@ -476,36 +480,38 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
 @functools.partial(jax.jit,
                    static_argnames=("block_k", "interpret"))
 def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
-    """q [BH, 1, D], k/v [BH, Tmax, D], lengths [BH] ->
-    (o [BH, 1, D], lse [BH, 1])."""
-    bh, t_max, head_dim = k.shape
+    """q [BKV, G, D] (G query rows share each KV row — 1 for MHA, the
+    group size for GQA), k/v [BKV, Tmax, D], lengths [BKV] ->
+    (o [BKV, G, D], lse [BKV, G])."""
+    bkv, t_max, head_dim = k.shape
+    g = q.shape[1]
     scale = 1.0 / (head_dim ** 0.5)
     num_kb = t_max // block_k
     kernel = functools.partial(_decode_kernel, scale=scale,
                                block_k=block_k, num_kb=num_kb)
     return pl.pallas_call(
         kernel,
-        grid=(bh, num_kb),
+        grid=(bkv, num_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, 1, head_dim), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, g, head_dim), lambda b, ki: (b, 0, 0)),
             pl.BlockSpec((None, block_k, head_dim),
                          lambda b, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_k, head_dim),
                          lambda b, ki: (b, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, 1, head_dim), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((None, 1), lambda b, ki: (b, 0)),
+            pl.BlockSpec((None, g, head_dim), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, g), lambda b, ki: (b, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, 1, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bkv, g, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bkv, g), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((1, head_dim), jnp.float32),
-            pltpu.VMEM((1,), jnp.float32),
-            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((g, head_dim), jnp.float32),
+            pltpu.VMEM((g,), jnp.float32),
+            pltpu.VMEM((g,), jnp.float32),
         ],
         interpret=interpret,
     )(lengths, q, k, v)
@@ -516,8 +522,10 @@ def flash_decode(q, k_cache, v_cache, lengths, block_k=128,
     """Single-step (T_q=1) attention against a KV cache.
 
     q: [B, H, D] — the current token's queries.
-    k_cache/v_cache: [B, Tmax, H, D] — preallocated cache; only the
-    first `lengths` positions of each row are attended.
+    k_cache/v_cache: [B, Tmax, KVH, D] — preallocated cache (KVH = H
+    for MHA; any divisor of H for GQA, where each cache block is read
+    once per query GROUP); only the first `lengths` positions of each
+    row are attended.
     lengths: int32 [B] (or scalar, broadcast) valid cache lengths.
 
     Decode attention is HBM-bandwidth-bound (the whole cache is read
@@ -539,24 +547,34 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=128,
         o = sum_i(w_i * o_i) / sum_i(w_i)
 
     This is the flash-decoding decomposition for sequence-parallel
-    caches (each device holds a slice of the sequence)."""
+    caches (each device holds a slice of the sequence).
+
+    GQA: when the caches carry KVH < H heads (H divisible by KVH),
+    query heads [j*G:(j+1)*G] share cache head j (G = H // KVH) and
+    each cache block is read once per GROUP, not per query head — the
+    KV-cache bandwidth saving grouped-query attention exists for."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, heads, head_dim = q.shape
-    t_max = k_cache.shape[1]
+    t_max, kv_heads = k_cache.shape[1], k_cache.shape[2]
+    if heads % kv_heads:
+        raise ValueError("query heads %d must be a multiple of KV "
+                         "heads %d" % (heads, kv_heads))
+    g = heads // kv_heads
     block_k = min(block_k, t_max)
     if t_max % block_k:
         raise ValueError("block_k %d must divide the cache length %d"
                          % (block_k, t_max))
     lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
-        b * heads, x.shape[1], head_dim)
+        b * kv_heads, x.shape[1], head_dim)
     o, lse = _flash_decode_bh(
-        q.reshape(b, 1, heads, head_dim).transpose(0, 2, 1, 3).reshape(
-            b * heads, 1, head_dim),
+        q.reshape(b, kv_heads, g, head_dim).reshape(
+            b * kv_heads, g, head_dim),
         to_bh(k_cache), to_bh(v_cache),
-        jnp.repeat(lengths, heads), block_k, interpret)
-    return o.reshape(b, heads, head_dim), lse.reshape(b, heads)
+        jnp.repeat(lengths, kv_heads), block_k, interpret)
+    return (o.reshape(b, heads, head_dim),
+            lse.reshape(b, heads))
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,

@@ -39,6 +39,11 @@ class TransformerConfig:
     vocab_size: int = 256
     d_model: int = 64
     n_heads: int = 4
+    # grouped-query attention: KV heads (None = n_heads, i.e. MHA).
+    # Shrinks the KV cache by n_heads/n_kv_heads — the decode-bandwidth
+    # lever; the flash-decode kernel reads each cache block once per
+    # GROUP of query heads
+    n_kv_heads: int = None
     n_layers: int = 2
     d_ff: int = 128
     n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
@@ -66,6 +71,22 @@ class TransformerConfig:
 
 def _norm_shape(cfg):
     return (cfg.d_model,)
+
+
+def _kvh(cfg):
+    kvh = cfg.n_kv_heads or cfg.n_heads
+    if cfg.n_heads % kvh:
+        raise ValueError(
+            "n_heads=%d must be a multiple of n_kv_heads=%d"
+            % (cfg.n_heads, kvh))
+    return kvh
+
+
+def _repeat_kv(x, g):
+    """[.., T, KVH, D] -> [.., T, H, D] by repeating each KV head over
+    its query group (training/dense paths; the decode kernel maps
+    groups natively instead of materializing the repeat)."""
+    return x if g == 1 else jnp.repeat(x, g, axis=2)
 
 
 def param_specs(cfg):
@@ -105,8 +126,8 @@ def init_params(cfg, seed=0):
             "ln1": jnp.ones(_norm_shape(cfg), dt),
             "ln2": jnp.ones(_norm_shape(cfg), dt),
             "wq": dense(cfg.d_model, cfg.n_heads, hd),
-            "wk": dense(cfg.d_model, cfg.n_heads, hd),
-            "wv": dense(cfg.d_model, cfg.n_heads, hd),
+            "wk": dense(cfg.d_model, _kvh(cfg), hd),
+            "wv": dense(cfg.d_model, _kvh(cfg), hd),
             "wo": dense(cfg.n_heads, hd, cfg.d_model),
         }
         if cfg.n_experts:
@@ -137,6 +158,14 @@ def shard_params(params, cfg, mesh):
     weight's spec, its scale/dt sidecars replicate (scales are shared
     along the leading axis, which no spec here partitions alone)."""
     specs = param_specs(cfg)
+    if cfg.tp_axis and cfg.tp_axis in mesh.shape:
+        tp_size = mesh.shape[cfg.tp_axis]
+        if _kvh(cfg) % tp_size:
+            raise ValueError(
+                "tp axis of size %d cannot shard %d KV heads "
+                "(n_kv_heads must be a multiple of the tp width; "
+                "lower tp, raise n_kv_heads, or replicate KV by "
+                "setting tp_axis=None)" % (tp_size, _kvh(cfg)))
 
     def place(x, s):
         if _is_q8(x):
@@ -157,7 +186,7 @@ def _rms_norm(x, g):
 
 def _qkv(x, p):
     q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
-    k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
+    k = jnp.einsum("btd,dhk->bthk", x, p["wk"])   # KVH heads under GQA
     v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
     return q, k, v
 
@@ -192,6 +221,10 @@ def _causal_attention(q, k, v, cfg, out_dtype):
 
 def _attention(x, p, cfg, mesh, manual_sp=False):
     q, k, v = _qkv(x, p)
+    # training paths attend with the repeated view; the MXU cost is the
+    # same and every path below assumes matching head counts
+    g = cfg.n_heads // _kvh(cfg)
+    k, v = _repeat_kv(k, g), _repeat_kv(v, g)
     if manual_sp:
         # already inside a shard_map manual over sp (pipeline stage
         # body). The Pallas path only engages on real TPU: interpret-
@@ -297,7 +330,7 @@ def loss_fn(params, tokens, cfg, mesh=None):
 def init_cache(cfg, batch):
     """Zeroed per-layer K/V caches sized to cfg.max_len."""
     hd = cfg.d_model // cfg.n_heads
-    shape = (batch, cfg.max_len, cfg.n_heads, hd)
+    shape = (batch, cfg.max_len, _kvh(cfg), hd)
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
@@ -372,14 +405,20 @@ def _decode_attention(q, cache_k, cache_v, pos, cfg):
         block_k = math.gcd(cache_k.shape[1], 128)
         return flash_decode(q, cache_k, cache_v, pos + 1,
                             block_k=block_k)
-    s = jnp.einsum("bhd,bthd->bht", q, cache_k,
-                   preferred_element_type=jnp.float32) / np.sqrt(
-                       q.shape[-1])
+    b, h, d = q.shape
+    kvh = cache_k.shape[2]
+    g = h // kvh
+    # grouped contraction: the KVH-head cache is read once per GROUP —
+    # no materialized repeat in the bandwidth-bound decode loop
+    qg = q.reshape(b, kvh, g, d)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, cache_k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
     t_pos = jnp.arange(cache_k.shape[1])
-    s = jnp.where((t_pos <= pos)[None, None, :], s, -1e30)
+    s = jnp.where((t_pos <= pos)[None, None, None, :], s, -1e30)
     a = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bht,bthd->bhd", a.astype(cache_v.dtype), cache_v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    o = jnp.einsum("bkgt,btkd->bkgd", a.astype(cache_v.dtype), cache_v,
+                   preferred_element_type=jnp.float32)
+    return o.reshape(b, h, d).astype(q.dtype)
 
 
 def prefill(params, cache, tokens, cfg):
@@ -404,7 +443,9 @@ def prefill(params, cache, tokens, cfg):
             layer_cache["v"], v.astype(layer_cache["v"].dtype), 0,
             axis=1)
         new_cache.append({"k": ck, "v": cv})
-        o = _causal_attention(q, k, v, cfg, x.dtype)
+        g = cfg.n_heads // _kvh(cfg)
+        o = _causal_attention(q, _repeat_kv(k, g), _repeat_kv(v, g),
+                              cfg, x.dtype)
         x = x + jnp.einsum("bthk,hkd->btd", o, p["wo"])
         x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
     x = _rms_norm(x[:, -1], params["ln_f"])

@@ -220,6 +220,12 @@ def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
             # mask zeroes p so o=0 and lse~-1e30, which drop out of the
             # combine (without it, an all-masked row degenerates to
             # p=exp(0)=1 everywhere and returns mean(v))
+            if k_l.shape[2] != q_l.shape[1]:
+                # GQA cache: expand to the query heads (fallback
+                # fidelity; the kernel path maps groups natively)
+                rep = q_l.shape[1] // k_l.shape[2]
+                k_l = jnp.repeat(k_l, rep, axis=2)
+                v_l = jnp.repeat(v_l, rep, axis=2)
             valid = (jnp.arange(t_shard)[None, None, :]
                      < local_len[:, None, None])
             s = jnp.einsum("bhd,bthd->bht",

@@ -430,3 +430,72 @@ def test_flash_decode_lse_chunks_combine():
         (w1 + w2)[..., None]
     np.testing.assert_allclose(o, np.asarray(full), rtol=2e-4,
                                atol=2e-4)
+
+
+def test_flash_decode_gqa_matches_repeated_kv():
+    """GQA decode: a cache with KVH < H heads gives the same result as
+    MHA decode over the cache with each KV head repeated G times."""
+    from mxnet_tpu.kernels.flash_attention import flash_decode
+    rng = np.random.RandomState(24)
+    b, t, h, kvh, d = 2, 32, 8, 2, 16
+    g = h // kvh
+    q = jnp.asarray(rng.randn(b, h, d).astype(np.float32))
+    kc = jnp.asarray(rng.randn(b, t, kvh, d).astype(np.float32))
+    vc = jnp.asarray(rng.randn(b, t, kvh, d).astype(np.float32))
+    lengths = jnp.asarray([20, 32], jnp.int32)
+
+    gqa = flash_decode(q, kc, vc, lengths, block_k=8)
+    mha = flash_decode(q, jnp.repeat(kc, g, axis=2),
+                       jnp.repeat(vc, g, axis=2), lengths, block_k=8)
+    np.testing.assert_allclose(np.asarray(gqa), np.asarray(mha),
+                               rtol=2e-4, atol=2e-4)
+
+    bad_kc = jnp.asarray(rng.randn(b, t, 3, d).astype(np.float32))
+    with pytest.raises(ValueError):
+        flash_decode(q, bad_kc, bad_kc, lengths)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_transformer_gqa_decode_matches_forward(use_flash):
+    """GQA config (n_kv_heads < n_heads): the KV cache carries only the
+    KV heads, and token-by-token decode reproduces full-sequence
+    forward logits on both attention paths."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=29, d_model=32, n_heads=4,
+                               n_kv_heads=2, n_layers=2, d_ff=48,
+                               max_len=16, use_flash_kernel=use_flash)
+    params = tf.init_params(cfg, seed=17)
+    # cache really is smaller: KVH=2 of 4 heads
+    cache = tf.init_cache(cfg, 2)
+    assert cache[0]["k"].shape == (2, 16, 2, 8)
+
+    rng = np.random.RandomState(18)
+    toks = jnp.asarray(rng.randint(0, 29, (2, 10)), jnp.int32)
+    full = tf.forward(params, toks, cfg)
+    step = tf.make_decode_step(cfg)
+    for pos in range(10):
+        logits, cache = step(params, cache, toks[:, pos], pos)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, pos]),
+            rtol=2e-4, atol=2e-4)
+
+    out = tf.generate(params, toks[:, :3], 4, cfg)
+    assert out.shape == (2, 7)
+
+
+def test_gqa_config_validation():
+    from mxnet_tpu.models import transformer as tf
+    bad = tf.TransformerConfig(vocab_size=11, d_model=24, n_heads=4,
+                               n_kv_heads=3, n_layers=1, d_ff=32,
+                               max_len=8)
+    with pytest.raises(ValueError):
+        tf.init_params(bad, seed=0)
+
+    from mxnet_tpu.parallel import make_mesh
+    cfg = tf.TransformerConfig(vocab_size=11, d_model=32, n_heads=4,
+                               n_kv_heads=2, n_layers=1, d_ff=32,
+                               max_len=8)
+    params = tf.init_params(cfg, seed=0)
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    with pytest.raises(ValueError):
+        tf.shard_params(params, cfg, mesh)   # tp=4 > 2 KV heads
