@@ -27,12 +27,14 @@ def main():
     import jax.numpy as jnp
     from mxnet_tpu.models import transformer as tf
 
+    kvh = int(os.environ.get("MXNET_DECODE_KV_HEADS", "0"))
     shapes = ((1024, 512, 8, 8), (4096, 512, 8, 8))
     if os.environ.get("MXNET_DECODE_SMOKE"):   # CPU-sized correctness run
         shapes = ((64, 32, 2, 1),)
     for max_len, d_model, heads, layers in shapes:
         cfg = tf.TransformerConfig(
             vocab_size=32000, d_model=d_model, n_heads=heads,
+            n_kv_heads=kvh or None,
             n_layers=layers, d_ff=4 * d_model, max_len=max_len,
             dtype=jnp.bfloat16, use_flash_kernel=USE_FLASH)
         params = tf.init_params(cfg, seed=0)
@@ -49,8 +51,9 @@ def main():
         logits.block_until_ready()
         dt = time.time() - t0
         toks = BATCH * STEPS
-        print("decode %s max_len=%d bs=%d: %.1f tok/s (%.2f ms/step)"
-              % ("flash" if USE_FLASH else "dense", max_len, BATCH,
+        print("decode %s%s max_len=%d bs=%d: %.1f tok/s (%.2f ms/step)"
+              % ("flash" if USE_FLASH else "dense",
+                 (" kvh=%d" % kvh) if kvh else "", max_len, BATCH,
                  toks / dt, dt / STEPS * 1e3))
 
 

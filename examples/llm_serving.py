@@ -54,6 +54,9 @@ def main():
                          "(quantize_weights_int8)")
     ap.add_argument("--beam", type=int, default=0,
                     help="also decode with beam search of this width")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: use this many KV "
+                         "heads (< heads shrinks the cache)")
     args = ap.parse_args()
 
     import jax
@@ -64,6 +67,7 @@ def main():
     vocab = 16
     cfg = T.TransformerConfig(
         vocab_size=vocab, d_model=48, n_heads=4, n_layers=2, d_ff=96,
+        n_kv_heads=args.kv_heads or None,
         max_len=args.seq + args.gen, use_flash_kernel=args.flash,
         use_ring_attention=False)
     params = T.init_params(cfg, seed=0)
