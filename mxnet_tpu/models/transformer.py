@@ -56,6 +56,12 @@ class TransformerConfig:
     ep_axis: str = "ep"
     pp_axis: str = None         # set to 'pp' to pipeline the layer stack
     num_microbatches: int = 0   # 0 = one per pipeline stage
+    # positional encoding: learned absolute embeddings (the default) or
+    # rotary (RoPE) applied to q/k — position-extrapolating and the
+    # standard for long-context models; the learned `pos` table is
+    # simply unused when rope=True
+    rope: bool = False
+    rope_base: float = 10000.0
     use_ring_attention: bool = True
     # attention through the Pallas flash kernel (kernels/
     # flash_attention.py): single-device dense path AND the per-shard
@@ -82,6 +88,28 @@ def _kvh(cfg):
     return kvh
 
 
+def _rope(x, positions, base):
+    """Rotary position encoding on [..., T, H, Dh] (or [..., H, Dh]
+    with scalar/[B] positions at decode): rotate feature pairs
+    (half-split convention) by position-dependent angles."""
+    dh = x.shape[-1]
+    if dh % 2:
+        raise ValueError(
+            "rope needs an even head dim, got d_model/n_heads = %d" % dh)
+    half = dh // 2
+    freqs = (1.0 / base) ** (jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] * freqs
+    if jnp.ndim(positions) >= 1:
+        # positions carry a T (or batch) axis that aligns with x's -3
+        # axis; insert the broadcast head axis
+        ang = ang[..., None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return rot.astype(x.dtype)
+
+
 def _repeat_kv(x, g):
     """[.., T, KVH, D] -> [.., T, H, D] by repeating each KV head over
     its query group (training/dense paths; the decode kernel maps
@@ -104,12 +132,14 @@ def param_specs(cfg):
         })
     else:
         layer.update({"w1": P(None, tp), "w2": P(tp, None)})
-    return {
+    out = {
         "embed": P(None, None),
-        "pos": P(None, None),
         "ln_f": P(None),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
+    if not cfg.rope:
+        out["pos"] = P(None, None)
+    return out
 
 
 def init_params(cfg, seed=0):
@@ -143,13 +173,18 @@ def init_params(cfg, seed=0):
             p["w2"] = dense(cfg.d_ff, cfg.d_model)
         return p
 
-    return {
+    out = {
         "embed": jnp.asarray(rng.randn(cfg.vocab_size, cfg.d_model) * 0.02,
                              dt),
-        "pos": jnp.asarray(rng.randn(cfg.max_len, cfg.d_model) * 0.02, dt),
         "ln_f": jnp.ones(_norm_shape(cfg), dt),
         "layers": [layer() for _ in range(cfg.n_layers)],
     }
+    if not cfg.rope:
+        # rope models carry no learned position table — at long-context
+        # scale it would be dead HBM (+ momentum + checkpoint bloat)
+        out["pos"] = jnp.asarray(
+            rng.randn(cfg.max_len, cfg.d_model) * 0.02, dt)
+    return out
 
 
 def shard_params(params, cfg, mesh):
@@ -221,6 +256,17 @@ def _causal_attention(q, k, v, cfg, out_dtype):
 
 def _attention(x, p, cfg, mesh, manual_sp=False):
     q, k, v = _qkv(x, p)
+    if cfg.rope:
+        T = x.shape[1]
+        if manual_sp:
+            # local shard inside shard_map: global positions start at
+            # this device's sequence offset
+            start = jax.lax.axis_index(cfg.sp_axis) * T
+        else:
+            start = 0
+        positions = start + jnp.arange(T)
+        q = _rope(q, positions, cfg.rope_base)
+        k = _rope(k, positions, cfg.rope_base)
     # training paths attend with the repeated view; the MXU cost is the
     # same and every path below assumes matching head counts
     g = cfg.n_heads // _kvh(cfg)
@@ -264,7 +310,9 @@ def _pp_size(cfg, mesh):
 
 def forward(params, tokens, cfg, mesh=None):
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
-    x = params["embed"][tokens] + params["pos"][: tokens.shape[1]]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][: tokens.shape[1]]
     act = P(cfg.dp_axis, cfg.sp_axis, None)
     if mesh is not None:
         x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act))
@@ -431,11 +479,19 @@ def prefill(params, cache, tokens, cfg):
     engage. Returns (last_logits [B, vocab], cache)."""
     params = _maybe_dequantize(params)
     b, t_p = tokens.shape
-    x = params["embed"][tokens] + params["pos"][:t_p]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][:t_p]
     new_cache = []
     for p, layer_cache in zip(params["layers"], cache):
         h = _rms_norm(x, p["ln1"])
         q, k, v = _qkv(h, p)
+        if cfg.rope:
+            # keys are cached ROTATED: their rotation depends only on
+            # their own position, so decode never re-rotates the cache
+            positions = jnp.arange(t_p)
+            q = _rope(q, positions, cfg.rope_base)
+            k = _rope(k, positions, cfg.rope_base)
         ck = jax.lax.dynamic_update_slice_in_dim(
             layer_cache["k"], k.astype(layer_cache["k"].dtype), 0,
             axis=1)
@@ -485,14 +541,19 @@ def decode_step(params, cache, tokens, pos, cfg):
     trees: the dequantizing converts fuse into each weight's matmul.
     """
     params = _maybe_dequantize(params)
-    x = params["embed"][tokens] + jax.lax.dynamic_index_in_dim(
-        params["pos"], pos, 0, keepdims=False)
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + jax.lax.dynamic_index_in_dim(
+            params["pos"], pos, 0, keepdims=False)
     new_cache = []
     for p, layer_cache in zip(params["layers"], cache):
         h = _rms_norm(x, p["ln1"])
         q = jnp.einsum("bd,dhk->bhk", h, p["wq"])
         k_new = jnp.einsum("bd,dhk->bhk", h, p["wk"])
         v_new = jnp.einsum("bd,dhk->bhk", h, p["wv"])
+        if cfg.rope:
+            q = _rope(q, pos, cfg.rope_base)
+            k_new = _rope(k_new, pos, cfg.rope_base)
         ck = jax.lax.dynamic_update_slice_in_dim(
             layer_cache["k"], k_new[:, None], pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(

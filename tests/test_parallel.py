@@ -328,3 +328,44 @@ def test_sp_flash_decode_zero_length_row():
                           mesh)
     np.testing.assert_allclose(np.asarray(out[0]), 0.0, atol=1e-6)
     assert np.abs(np.asarray(out[1])).max() > 1e-3
+
+
+def test_rope_ring_matches_single_device():
+    """RoPE under sp-sharded ring attention: per-shard global position
+    offsets make the sharded forward equal the single-device one."""
+    cfg = T.TransformerConfig(vocab_size=31, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=48, max_len=32,
+                              rope=True)
+    params = T.init_params(cfg, seed=25)
+    toks = jnp.asarray(np.random.RandomState(26).randint(0, 31, (2, 32)),
+                       jnp.int32)
+    single = T.forward(params, toks, cfg)
+
+    mesh = make_mesh({"dp": 1, "tp": 1, "sp": 8, "ep": 1})
+    sp = T.shard_params(params, cfg, mesh)
+    stoks = jax.device_put(toks, NamedSharding(mesh, P(None, None)))
+    sharded = T.forward(sp, stoks, cfg, mesh)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rope_pipeline_matches_unsharded():
+    """RoPE inside the pipeline stage body (manual sp shard_map):
+    axis-offset rotation makes pp/sp/tp loss equal single-device."""
+    mesh = make_mesh({"pp": 2, "sp": 2, "tp": 2, "dp": 1, "ep": 1})
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=4, d_ff=64, max_len=32,
+                              pp_axis="pp", use_ring_attention=True,
+                              rope=True)
+    cfg_ref = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                  n_layers=4, d_ff=64, max_len=32,
+                                  use_ring_attention=False, rope=True)
+    params = T.init_params(cfg, seed=27)
+    tokens = jnp.asarray(
+        np.random.RandomState(28).randint(0, 64, (4, 32)), jnp.int32)
+    loss_ref = float(T.loss_fn(params, tokens, cfg_ref, mesh=None))
+    sharded = T.shard_params(params, cfg, mesh)
+    tok = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    loss_pp = float(jax.jit(
+        lambda p, t: T.loss_fn(p, t, cfg, mesh))(sharded, tok))
+    assert abs(loss_ref - loss_pp) < 1e-4, (loss_ref, loss_pp)
