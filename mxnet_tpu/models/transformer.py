@@ -31,7 +31,8 @@ from ..parallel.pipeline import stack_stage_params, spmd_pipeline
 __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
            "make_decode_step", "generate", "shard_cache", "prefill",
-           "quantize_weights_int8", "beam_search"]
+           "quantize_weights_int8", "beam_search", "prefill_chunk",
+           "speculative_generate"]
 
 
 @dataclass
@@ -514,21 +515,154 @@ def prefill(params, cache, tokens, cfg):
 # program) and fresh-but-equal configs share one entry; the LRU bound
 # keeps a long-lived server from accumulating dead compiles.
 _PREFILL_JIT_CACHE = {}
-_PREFILL_JIT_LIMIT = 16
+_PREFILL_JIT_LIMIT = 32
 
 
-def _jitted_prefill(cfg):
+def _serving_jit(kind, cfg, build):
     import dataclasses
-    key = dataclasses.astuple(cfg)
+    key = (kind,) + dataclasses.astuple(cfg)
     fn = _PREFILL_JIT_CACHE.pop(key, None)
     if fn is None:
         frozen = dataclasses.replace(cfg)   # defensive copy: later
         # mutations of the caller's cfg must not leak into the trace
-        fn = jax.jit(lambda p, c, t: prefill(p, c, t, frozen))
+        fn = build(frozen)
     _PREFILL_JIT_CACHE[key] = fn            # re-insert = move to back
     while len(_PREFILL_JIT_CACHE) > _PREFILL_JIT_LIMIT:
         _PREFILL_JIT_CACHE.pop(next(iter(_PREFILL_JIT_CACHE)))
     return fn
+
+
+def _jitted_prefill(cfg):
+    return _serving_jit("prefill", cfg, lambda fz: jax.jit(
+        lambda p, c, t: prefill(p, c, t, fz)))
+
+
+def _jitted_prefill_chunk(cfg):
+    # chunk width is a shape, so jax.jit re-specializes per width and
+    # caches each; `start` stays dynamic (dynamic_slice inside)
+    return _serving_jit("prefill_chunk", cfg, lambda fz: jax.jit(
+        lambda p, c, t, s: prefill_chunk(p, c, t, s, fz)))
+
+
+def _jitted_decode_step(cfg):
+    return _serving_jit("decode_step", cfg, lambda fz: jax.jit(
+        lambda p, c, t, pos: decode_step(p, c, t, pos, fz)))
+
+
+def prefill_chunk(params, cache, tokens, start, cfg):
+    """Process a CHUNK of C tokens beginning at dynamic position
+    `start`, writing their K/V into the cache and returning the logits
+    after every chunk position ([B, C, vocab]).
+
+    The chunked middle ground between prefill (whole prompt at 0) and
+    decode_step (one token): long prompts stream through in fixed-size
+    chunks, and speculative decoding verifies k draft tokens in one
+    pass. Row i of the chunk attends cache positions <= start+i, so
+    stale cache entries beyond the verified stream are never read (and
+    are overwritten when re-processed)."""
+    params = _maybe_dequantize(params)
+    b, c = tokens.shape
+    x = params["embed"][tokens]
+    if cfg.rope:
+        chunk_pos = start + jnp.arange(c)
+    else:
+        x = x + jax.lax.dynamic_slice_in_dim(params["pos"], start, c, 0)
+    new_cache = []
+    g = cfg.n_heads // _kvh(cfg)
+    for p, layer_cache in zip(params["layers"], cache):
+        h = _rms_norm(x, p["ln1"])
+        q, k, v = _qkv(h, p)
+        if cfg.rope:
+            q = _rope(q, chunk_pos, cfg.rope_base)
+            k = _rope(k, chunk_pos, cfg.rope_base)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["k"], k.astype(layer_cache["k"].dtype), start,
+            axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            layer_cache["v"], v.astype(layer_cache["v"].dtype), start,
+            axis=1)
+        new_cache.append({"k": ck, "v": cv})
+        # chunk row i sees cache positions <= start+i; grouped
+        # contraction reads the KVH-head cache once per GROUP (like
+        # _decode_attention — no materialized repeat on the hot path)
+        dh = q.shape[-1]
+        qg = q.reshape(b, c, _kvh(cfg), g, dh)
+        s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
+                       preferred_element_type=jnp.float32) / np.sqrt(dh)
+        t_pos = jnp.arange(ck.shape[1])
+        mask = t_pos[None, :] <= (start + jnp.arange(c))[:, None]
+        s = jnp.where(mask[None, :, None, None, :], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32
+                       ).astype(x.dtype).reshape(b, c, cfg.n_heads, dh)
+        x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bcd,vd->bcv", x, params["embed"]), new_cache
+
+
+def speculative_generate(params, draft_params, prompt, n_new, cfg,
+                         draft_cfg, k_draft=4):
+    """Greedy speculative decoding: a small DRAFT model proposes
+    k_draft tokens per round, the big model verifies them all in ONE
+    prefill_chunk pass, and the longest agreeing prefix is accepted
+    (plus the big model's corrected/bonus token). Every emitted token
+    is the big model's greedy argmax — identical to generate() up to
+    floating-point reduction-order ties between the chunked and
+    per-token attention paths (argmax gaps below kernel noise, ~1e-6,
+    can tip either way; any well-separated argmax matches exactly).
+    Batch size 1 (acceptance length is data-dependent per row).
+    Returns [1, Tp+n_new] int32.
+
+    Both configs must share vocab_size; caches self-heal across
+    rejected drafts because attention masks by verified position."""
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decoding serves batch=1")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share the vocab")
+    t_prompt = int(prompt.shape[1])
+    total = t_prompt + n_new
+    if total > min(cfg.max_len, draft_cfg.max_len):
+        raise ValueError("prompt+n_new exceeds a model's max_len")
+
+    cache = init_cache(cfg, 1)
+    dcache = init_cache(draft_cfg, 1)
+    logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
+    _, dcache = _jitted_prefill(draft_cfg)(draft_params, dcache, prompt)
+    dstep = _jitted_decode_step(draft_cfg)
+    vchunk = _jitted_prefill_chunk(cfg)
+    buf = [int(t) for t in np.asarray(prompt[0])]
+    buf.append(int(np.argmax(np.asarray(logits[0]))))
+
+    while len(buf) < total:
+        n = len(buf)                     # verified tokens
+        k = min(k_draft, total - n)
+        # draft proposes k tokens greedily from its (self-healing) cache
+        drafts = []
+        tok = jnp.asarray([buf[n - 1]], jnp.int32)
+        for i in range(k):
+            dlogits, dcache = dstep(draft_params, dcache, tok,
+                                    n - 1 + i)
+            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            drafts.append(int(tok[0]))
+        # one big-model pass verifies all k proposals: the k+1 chunk
+        # rows are the contexts ending at buf[n-1], d1, ..., d_k, so
+        # row i predicts position n+i (row k is the bonus after a full
+        # acceptance)
+        window = jnp.asarray([[buf[n - 1]] + drafts], jnp.int32)
+        vlogits, cache = vchunk(params, cache, window, n - 1)
+        target = np.argmax(np.asarray(vlogits[0]), axis=-1)
+        accepted = 0
+        while accepted < k and target[accepted] == drafts[accepted]:
+            accepted += 1
+        buf.extend(drafts[:accepted])
+        if len(buf) < total:
+            # the first disagreeing position (or the bonus row after a
+            # full acceptance) comes from the big model — exactness
+            # with greedy generate()
+            buf.append(int(target[accepted]))
+    return jnp.asarray([buf[:total]], jnp.int32)
 
 
 def decode_step(params, cache, tokens, pos, cfg):

@@ -528,3 +528,90 @@ def test_rope_decode_matches_forward(use_flash):
     shifted = tf.forward(params, toks2, cfg)
     assert np.abs(np.asarray(shifted[:, 2]) -
                   np.asarray(full[:, 1])).max() > 1e-4
+
+
+@pytest.mark.parametrize("rope", [False, True])
+def test_speculative_generate_exact_vs_greedy(rope):
+    """Speculative decoding returns EXACTLY the big model's greedy
+    continuation — with a trained-ish draft, an untrained draft, and
+    the degenerate draft == target (all drafts accepted)."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=21, d_model=32, n_heads=4,
+                               n_layers=2, d_ff=48, max_len=24,
+                               rope=rope)
+    dcfg = tf.TransformerConfig(vocab_size=21, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=24, max_len=24,
+                                rope=rope)
+    params = tf.init_params(cfg, seed=31)
+    draft = tf.init_params(dcfg, seed=32)
+    prompt = jnp.asarray(
+        np.random.RandomState(33).randint(0, 21, (1, 5)), jnp.int32)
+
+    ref = np.asarray(tf.generate(params, prompt, 9, cfg))
+    spec = np.asarray(tf.speculative_generate(
+        params, draft, prompt, 9, cfg, dcfg, k_draft=3))
+    assert np.array_equal(spec, ref)
+
+    # draft == target: every draft accepted, still exact
+    spec2 = np.asarray(tf.speculative_generate(
+        params, params, prompt, 9, cfg, cfg, k_draft=4))
+    assert np.array_equal(spec2, ref)
+
+
+def test_prefill_chunk_matches_decode_steps():
+    """Chunked prefill at an offset writes the same cache and logits as
+    stepping decode_step token by token."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=19, d_model=32, n_heads=4,
+                               n_kv_heads=2, n_layers=2, d_ff=48,
+                               max_len=16)
+    params = tf.init_params(cfg, seed=34)
+    toks = jnp.asarray(np.random.RandomState(35).randint(0, 19, (2, 9)),
+                       jnp.int32)
+
+    cache_a = tf.init_cache(cfg, 2)
+    logits_a = []
+    for pos in range(9):
+        la, cache_a = tf.decode_step(params, cache_a, toks[:, pos],
+                                     pos, cfg)
+        logits_a.append(np.asarray(la))
+
+    # prefill first 4 as a chunk at 0, the rest as a chunk at 4
+    cache_b = tf.init_cache(cfg, 2)
+    lb1, cache_b = tf.prefill_chunk(params, cache_b, toks[:, :4], 0,
+                                    cfg)
+    lb2, cache_b = tf.prefill_chunk(params, cache_b, toks[:, 4:], 4,
+                                    cfg)
+    chunked = np.concatenate([np.asarray(lb1), np.asarray(lb2)], axis=1)
+    np.testing.assert_allclose(chunked, np.stack(logits_a, axis=1),
+                               rtol=2e-4, atol=2e-4)
+    for la, lb in zip(cache_a, cache_b):
+        for key in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(lb[key][:, :9]),
+                                       np.asarray(la[key][:, :9]),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_chunk_consistent_with_prefill():
+    """prefill and prefill_chunk(start=0) write compatible caches and
+    agree on the last-row logits — the contract speculative decoding's
+    cache handoff relies on (the two keep separate attention layouts
+    on purpose: prefill attends within the chunk, prefill_chunk over
+    the cache)."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=23, d_model=32, n_heads=4,
+                               n_layers=2, d_ff=48, max_len=12,
+                               rope=True)
+    params = tf.init_params(cfg, seed=36)
+    toks = jnp.asarray(np.random.RandomState(37).randint(0, 23, (2, 7)),
+                       jnp.int32)
+    la, ca = tf.prefill(params, tf.init_cache(cfg, 2), toks, cfg)
+    lb, cb = tf.prefill_chunk(params, tf.init_cache(cfg, 2), toks, 0,
+                              cfg)
+    np.testing.assert_allclose(np.asarray(lb[:, -1]), np.asarray(la),
+                               rtol=2e-4, atol=2e-4)
+    for xa, xb in zip(ca, cb):
+        for key in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(xb[key][:, :7]),
+                                       np.asarray(xa[key][:, :7]),
+                                       rtol=2e-4, atol=2e-4)
