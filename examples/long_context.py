@@ -38,9 +38,12 @@ def main():
                     help="verify against single-device attention")
     args = ap.parse_args()
 
+    # wedge-proof backend selection: pins JAX_PLATFORMS through
+    # jax.config and probes accelerator tunnels first, falling back to
+    # CPU with a warning when wedged (mxnet_tpu/_discover.py)
+    from mxnet_tpu._discover import ensure_backend
+    ensure_backend()
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from mxnet_tpu.parallel.ring import ring_attention_sharded

@@ -42,18 +42,32 @@ def main():
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
-    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--lr", type=float, default=None,
+                    help="default 0.3 (0.1 with --rope: rotary logits "
+                         "diverge under this plain momentum-SGD at 0.3)")
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each layer (MXNET_BACKWARD_DO_MIRROR"
                          " analogue at transformer granularity)")
     ap.add_argument("--flash", action="store_true",
                     help="Pallas flash kernel for the per-shard ring "
                          "block compute (TPU)")
+    ap.add_argument("--rope", action="store_true",
+                    help="rotary positions instead of the learned table")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention KV heads (NOTE: this "
+                         "toy induction task is capacity-sensitive — "
+                         "halving KV heads can keep the loss above the "
+                         "example's halving check)")
     args = ap.parse_args()
+    if args.lr is None:
+        args.lr = 0.1 if args.rope else 0.3
 
+    # wedge-proof backend selection: pins JAX_PLATFORMS through
+    # jax.config and probes accelerator tunnels first, falling back to
+    # CPU with a warning when wedged (mxnet_tpu/_discover.py)
+    from mxnet_tpu._discover import ensure_backend
+    ensure_backend()
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
     from jax.sharding import Mesh
     from mxnet_tpu.models import transformer as T
@@ -71,6 +85,8 @@ def main():
     cfg = T.TransformerConfig(vocab_size=32, d_model=64, n_heads=4,
                               n_layers=2, d_ff=128, max_len=args.seq,
                               ep_axis=None,
+                              rope=args.rope,
+                              n_kv_heads=args.kv_heads or None,
                               remat_layers=args.remat,
                               use_flash_kernel=args.flash)
     with mesh:

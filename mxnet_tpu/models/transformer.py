@@ -562,6 +562,15 @@ def prefill_chunk(params, cache, tokens, start, cfg):
     are overwritten when re-processed)."""
     params = _maybe_dequantize(params)
     b, c = tokens.shape
+    try:
+        concrete_end = int(start) + c      # eager path only; traced
+    except Exception:                      # starts check inside jit is
+        concrete_end = None                # the caller's contract
+    if concrete_end is not None and concrete_end > cfg.max_len:
+        raise ValueError(
+            "chunk [%d, %d) overruns max_len %d (dynamic_update_slice "
+            "would clamp and corrupt earlier cache positions)"
+            % (concrete_end - c, concrete_end, cfg.max_len))
     x = params["embed"][tokens]
     if cfg.rope:
         chunk_pos = start + jnp.arange(c)
@@ -603,7 +612,7 @@ def prefill_chunk(params, cache, tokens, start, cfg):
 
 
 def speculative_generate(params, draft_params, prompt, n_new, cfg,
-                         draft_cfg, k_draft=4):
+                         draft_cfg, k_draft=4, return_stats=False):
     """Greedy speculative decoding: a small DRAFT model proposes
     k_draft tokens per round, the big model verifies them all in ONE
     prefill_chunk pass, and the longest agreeing prefix is accepted
@@ -613,7 +622,8 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
     per-token attention paths (argmax gaps below kernel noise, ~1e-6,
     can tip either way; any well-separated argmax matches exactly).
     Batch size 1 (acceptance length is data-dependent per row).
-    Returns [1, Tp+n_new] int32.
+    Returns [1, Tp+n_new] int32 (with return_stats=True, also a dict
+    of per-round acceptance counts and big-model launch count).
 
     Both configs must share vocab_size; caches self-heal across
     rejected drafts because attention masks by verified position."""
@@ -634,12 +644,21 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
     vchunk = _jitted_prefill_chunk(cfg)
     buf = [int(t) for t in np.asarray(prompt[0])]
     buf.append(int(np.argmax(np.asarray(logits[0]))))
+    d_done = t_prompt      # draft cache holds K/V for positions [0, d_done)
+    acceptances = []
 
     while len(buf) < total:
         n = len(buf)                     # verified tokens
         k = min(k_draft, total - n)
-        # draft proposes k tokens greedily from its (self-healing) cache
+        # catch the draft cache up to the verified stream (normally one
+        # token — the corrected/bonus token; this is what keeps the
+        # cache hole-free after a fully-accepted round), then draft k
+        # tokens greedily
         drafts = []
+        tok = None
+        for pos in range(d_done, n - 1):
+            _, dcache = dstep(draft_params, dcache,
+                              jnp.asarray([buf[pos]], jnp.int32), pos)
         tok = jnp.asarray([buf[n - 1]], jnp.int32)
         for i in range(k):
             dlogits, dcache = dstep(draft_params, dcache, tok,
@@ -657,12 +676,21 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
         while accepted < k and target[accepted] == drafts[accepted]:
             accepted += 1
         buf.extend(drafts[:accepted])
+        # draft cache is valid through the last ACCEPTED position:
+        # entries written from rejected drafts sit beyond it and are
+        # overwritten by the next catch-up/draft pass
+        d_done = n + accepted
+        acceptances.append(accepted)
         if len(buf) < total:
             # the first disagreeing position (or the bonus row after a
             # full acceptance) comes from the big model — exactness
             # with greedy generate()
             buf.append(int(target[accepted]))
-    return jnp.asarray([buf[:total]], jnp.int32)
+    out = jnp.asarray([buf[:total]], jnp.int32)
+    if return_stats:
+        return out, {"acceptances": acceptances,
+                     "big_model_launches": 1 + len(acceptances)}
+    return out
 
 
 def decode_step(params, cache, tokens, pos, cfg):
