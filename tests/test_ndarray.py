@@ -334,3 +334,20 @@ def test_fluent_methods_match_namespace():
     assert [p.shape for p in parts] == [(2, 1), (2, 2)]
     npview = x.as_np_ndarray()
     np.testing.assert_allclose(np.asarray(npview), x.asnumpy())
+
+
+def test_save_load_bfloat16_roundtrip(tmp_path):
+    """bf16 arrays round-trip through nd.save/nd.load (payload widened
+    to fp32 on disk, dtype restored on load)."""
+    import numpy as np
+    a = nd.array(np.random.RandomState(0).rand(3, 4).astype("float32"))
+    b = a.astype("bfloat16")
+    path = str(tmp_path / "bf16.params")
+    nd.save(path, {"w": b, "x": a})
+    loaded = nd.load(path)
+    assert str(loaded["w"].dtype) == "bfloat16"
+    assert str(loaded["x"].dtype) == "float32"
+    np.testing.assert_allclose(
+        loaded["w"].astype("float32").asnumpy(),
+        b.astype("float32").asnumpy())
+    np.testing.assert_allclose(loaded["x"].asnumpy(), a.asnumpy())
