@@ -9,10 +9,16 @@ import numpy as np
 
 from . import onnx_pb2 as _pb
 
+def _bf16():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
 _ONNX_TO_NP = {
     _pb.TensorProto.FLOAT: np.float32,
     _pb.TensorProto.DOUBLE: np.float64,
     _pb.TensorProto.FLOAT16: np.float16,
+    _pb.TensorProto.BFLOAT16: _bf16(),
     _pb.TensorProto.INT8: np.int8,
     _pb.TensorProto.UINT8: np.uint8,
     _pb.TensorProto.INT16: np.int16,

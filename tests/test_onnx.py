@@ -343,3 +343,34 @@ def test_interleaved_attention_roundtrip(tmp_path):
     with open(path, "rb") as f:
         model.ParseFromString(f.read())
     assert all(not n.domain for n in model.graph.node)
+
+
+def test_bfloat16_model_roundtrip(tmp_path):
+    """A bf16-cast gluon net exports bf16 initializers and re-imports
+    with matching outputs (BFLOAT16 in both dtype maps)."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1),
+            gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    x32 = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+    y_ref = net(nd.array(x32).astype("bfloat16")) \
+        .astype("float32").asnumpy()
+    net.export(str(tmp_path / "m"))
+
+    loaded = nd.load(str(tmp_path / "m-0000.params"))
+    s = sym.load(str(tmp_path / "m-symbol.json"))
+    path = str(tmp_path / "m.onnx")
+    onnx_mx.export_model(s, loaded, [(2, 3, 8, 8)], onnx_file_path=path)
+    onnx_mx.checker.check_model(path)
+    s2, a2, x2 = onnx_mx.import_model(path)
+    assert any(str(v.dtype) == "bfloat16" for v in a2.values())
+    ex = s2.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8))
+    ex.copy_params_from(a2, x2)
+    y2 = ex.forward(is_train=False,
+                    data=nd.array(x32))[0].asnumpy().astype("float32")
+    np.testing.assert_allclose(y2, y_ref, rtol=2e-2, atol=2e-2)
