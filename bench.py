@@ -9,6 +9,7 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -16,9 +17,11 @@ import numpy as np
 
 BASELINE_IMG_S = 363.69
 # Throughput is flat in batch (HBM-bound step, PERF.md: 1815 img/s at
-# bs=128 vs 1799 at bs=256 pre-BN-fix), so use the batch that compiles
-# fastest — the driver runs this cold on the chip each round.
-BATCH = 128
+# bs=128 vs 1799 at bs=256 pre-BN-fix), so default to the batch that
+# compiles fastest — the driver runs this cold on the chip each round.
+# MXNET_BENCH_BATCH overrides for the chip queue's bs=256 leg (post-
+# BN-fix the chip measured 2136 img/s there, PERF.md round 4).
+BATCH = int(os.environ.get("MXNET_BENCH_BATCH", "128"))
 
 
 def build_train_step(batch, image_size=224, classes=1000, lr=0.1):
@@ -93,13 +96,11 @@ def build_train_step(batch, image_size=224, classes=1000, lr=0.1):
 
 def _probe_backend_alive(timeout_s=150):
     """A wedged TPU tunnel hangs jax backend init forever (observed:
-    hours). Single implementation lives in mxnet_tpu._discover; the
-    bench wants fail-fast error JSON rather than the library's CPU
-    fallback, so it probes explicitly (cache disabled: the round-end
-    run must reflect the tunnel's state NOW)."""
-    import os
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        return True      # CPU never wedges
+    hours). Single implementation lives in mxnet_tpu._discover (which
+    also owns the cpu-pin short-circuit); the bench wants fail-fast
+    error JSON rather than the library's CPU fallback, so it probes
+    explicitly (cache disabled: the round-end run must reflect the
+    tunnel's state NOW)."""
     from mxnet_tpu._discover import probe_backend_alive
     return probe_backend_alive(timeout_s=timeout_s, use_cache=False)
 

@@ -14,8 +14,10 @@ is auditable without a timed run.
     python - handbuilt < benchmark/cost_compare.py  # hand-built only
     python - timed < benchmark/cost_compare.py      # + timed img/s legs
 
-Run from /root/repo via stdin so the repo root stays on sys.path (the
-axon plugin breaks under PYTHONPATH; see .claude/skills/verify).
+Run from /root/repo via stdin so the repo root stays on sys.path.
+Leave the environment's PYTHONPATH=/root/.axon_site untouched — the
+axon plugin registers through it; overriding OR popping it breaks
+registration (see .claude/skills/verify).
 """
 
 import os

@@ -7,7 +7,9 @@ dense masked einsum) at growing cache lengths — decode is HBM-bound
     python - < benchmark/decode_bench.py
     MXNET_DECODE_FLASH=0 python - < benchmark/decode_bench.py   # dense leg
 
-Run from /root/repo via stdin (axon plugin breaks under PYTHONPATH).
+Run from /root/repo via stdin so cwd lands on sys.path (leave the
+environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
+registers through it; overriding OR popping it breaks registration).
 """
 
 import os

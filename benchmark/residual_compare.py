@@ -10,7 +10,9 @@ the asymmetric entries — what one side saves that the other doesn't.
 
     JAX_PLATFORMS=cpu python - < benchmark/residual_compare.py
 
-Run from /root/repo via stdin (axon plugin breaks under PYTHONPATH).
+Run from /root/repo via stdin so cwd lands on sys.path (leave the
+environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
+registers through it; overriding OR popping it breaks registration).
 bs/size default 8/64 (structure is shape-proportional); override with
 MXNET_AB_BATCH / MXNET_AB_SIZE.
 """

@@ -125,6 +125,14 @@ def probe_backend_alive(timeout_s=None, probe_code=None, use_cache=True):
     simulated by probing a script that sleeps past the timeout)."""
     if timeout_s is None:
         timeout_s = float(os.environ.get("MXNET_BACKEND_PROBE_TIMEOUT", 90))
+    if probe_code is None and \
+            os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # a cpu pin never wedges — and the env-var pin would NOT reach
+        # the probe subprocess's backend init anyway (the axon plugin
+        # overrides JAX_PLATFORMS during jax import), so probing under
+        # a cpu pin would falsely report dead. Single home for this
+        # rule; bench.py and run_chip_queue call through it.
+        return True
     if use_cache and probe_code is None:
         cached = _cached_probe_result()
         if cached is not None:
