@@ -63,6 +63,8 @@ QUEUE = [
     ("decode_gqa",
      {"stdin": "benchmark/decode_bench.py",
       "env": {"MXNET_DECODE_KV_HEADS": "2"}}, 1500, False),
+    ("serving",
+     {"stdin": "benchmark/serving_bench.py"}, 1800, False),
     ("inference_fp32",
      {"argv": [sys.executable,
                "examples/image_classification/benchmark_score.py",

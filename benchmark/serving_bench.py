@@ -1,0 +1,144 @@
+"""End-to-end serving throughput: the levers, one number each.
+
+Measures models/transformer.py's serving stack at batch=1 (the latency-
+bound serving shape; decode_bench.py covers batched decode):
+
+  prefill         prompt tokens/s through the one-pass batched prefill
+  generate        greedy tokens/s (prefill + lax.scan decode)
+  generate_int8   same, with weight-only int8 params (dequant fused
+                  into the matmuls)
+  speculative     tokens/s with a small random-init draft proposing
+                  k=4 per round + measured acceptance (greedy-exact;
+                  random draft ~never agrees, so this is the
+                  all-overhead LOWER bound)
+  spec_selfdraft  same machinery with draft=target. With TRAINED
+                  weights this is the always-accepts upper bound; on
+                  the bench's random-init weights the near-tie logits
+                  make the chunked-verify and per-token argmax flip
+                  (documented fp tie noise), so read acceptance as
+                  what it measures: tie density, not a ceiling
+
+    python - < benchmark/serving_bench.py
+    MXNET_SERVING_SMOKE=1 JAX_PLATFORMS=cpu python - < benchmark/serving_bench.py
+
+Run from /root/repo via stdin so cwd lands on sys.path (leave the
+environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
+registers through it; overriding OR popping it breaks registration).
+"""
+
+import os
+import time
+
+import numpy as np
+
+SMOKE = bool(os.environ.get("MXNET_SERVING_SMOKE"))
+
+
+def _time_tokens(fn, n_tokens, warm_runs=1, timed_runs=3):
+    """Median wall-clock tokens/s over timed_runs calls of fn()."""
+    for _ in range(warm_runs):
+        fn()
+    rates = []
+    for _ in range(timed_runs):
+        t0 = time.time()
+        fn()
+        rates.append(n_tokens / (time.time() - t0))
+    return float(np.median(rates))
+
+
+def main():
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+
+    if SMOKE:
+        d_model, heads, layers, max_len = 32, 2, 1, 96
+        t_prompt, n_new, k_draft = 24, 16, 4
+        draft_layers, draft_d = 1, 16
+    else:
+        d_model, heads, layers, max_len = 512, 8, 8, 4096
+        t_prompt, n_new, k_draft = 512, 128, 4
+        draft_layers, draft_d = 2, 128
+
+    cfg = tf.TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=jnp.bfloat16)
+    draft_cfg = tf.TransformerConfig(
+        vocab_size=32000, d_model=draft_d, n_heads=2,
+        n_layers=draft_layers, d_ff=4 * draft_d, max_len=max_len,
+        dtype=jnp.bfloat16)
+    params = tf.init_params(cfg, seed=0)
+    # the draft is a trained-small stand-in; seeding it FROM the target
+    # seed keeps proposals non-degenerate enough to measure acceptance
+    draft_params = tf.init_params(draft_cfg, seed=0)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(1, 32000, (1, t_prompt)), jnp.int32)
+
+    backend = jax.default_backend()
+    print("serving bench: backend=%s d_model=%d layers=%d prompt=%d "
+          "n_new=%d" % (backend, d_model, layers, t_prompt, n_new),
+          flush=True)
+
+    # --- prefill: one batched MXU pass over the prompt ---
+    cache0 = tf.init_cache(cfg, 1)
+    pre = tf._jitted_prefill(cfg)
+
+    def run_prefill():
+        logits, _ = pre(params, cache0, prompt)
+        logits.block_until_ready()
+
+    rate = _time_tokens(run_prefill, t_prompt)
+    print('{"leg": "prefill", "tokens_per_s": %.1f}' % rate, flush=True)
+
+    # --- greedy generate ---
+    def run_generate():
+        out = tf.generate(params, prompt, n_new, cfg)
+        out.block_until_ready()
+        return out
+
+    rate = _time_tokens(run_generate, n_new)
+    print('{"leg": "generate", "tokens_per_s": %.1f}' % rate,
+          flush=True)
+
+    # --- weight-only int8 ---
+    q8 = tf.quantize_weights_int8(params)
+
+    def run_generate_int8():
+        out = tf.generate(q8, prompt, n_new, cfg)
+        out.block_until_ready()
+
+    rate = _time_tokens(run_generate_int8, n_new)
+    print('{"leg": "generate_int8", "tokens_per_s": %.1f}' % rate,
+          flush=True)
+
+    # --- speculative (greedy-exact; acceptance is data-dependent) ---
+    def spec_leg(name, dp, dc):
+        def run():
+            out, stats = tf.speculative_generate(
+                params, dp, prompt, n_new, cfg, dc,
+                k_draft=k_draft, return_stats=True)
+            np.asarray(out)      # host fetch = full barrier
+            return stats
+
+        run()                # warm (compiles draft + verify programs)
+        rates, accepts = [], []
+        for _ in range(3):
+            t0 = time.time()
+            stats = run()
+            rates.append(n_new / (time.time() - t0))
+            accepts.append(np.mean(stats["acceptances"])
+                           if stats["acceptances"] else 0.0)
+        print('{"leg": "%s", "tokens_per_s": %.1f, '
+              '"mean_accepted_per_round": %.2f, "k_draft": %d}'
+              % (name, float(np.median(rates)),
+                 float(np.mean(accepts)), k_draft), flush=True)
+
+    spec_leg("speculative", draft_params, draft_cfg)
+    spec_leg("spec_selfdraft", params, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -759,38 +759,12 @@ def _sample_logits(logits, key, temperature, top_k, top_p):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
-def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
-             temperature=1.0, top_k=None, top_p=None, mesh=None):
-    """Autoregressive generation: prompt [B, Tp] int32 -> [B, Tp+n_new].
-
-    Sampling: by default, passing any of `temperature` (!= 1.0),
-    `top_k`, or `top_p` samples with those controls; otherwise decoding
-    is greedy argmax. Passing greedy=True together with sampling
-    controls is a contradiction and raises. With `mesh`, the KV cache
-    is laid out dp/tp-sharded (shard_cache) to match TP-sharded params.
-    The prompt is prefilled in ONE batched forward (prefill), then the
-    generation steps run as one lax.scan — two compiled programs total.
-    """
-    sampling_requested = (temperature != 1.0 or top_k is not None
-                          or top_p is not None)
-    if greedy is None:
-        greedy = not sampling_requested
-    elif greedy and sampling_requested:
-        raise ValueError(
-            "greedy=True ignores temperature/top_k/top_p — pass "
-            "greedy=False (or omit greedy) to sample")
+def _generate_core(params, prompt, cache, key, n_new, cfg, greedy,
+                   temperature, top_k, top_p):
+    """prefill + decode scan, one traceable program (see generate)."""
     b, t_prompt = prompt.shape
     total = t_prompt + n_new
-    if total > cfg.max_len:
-        raise ValueError("prompt+n_new %d exceeds max_len %d"
-                         % (total, cfg.max_len))
-    if n_new == 0:
-        return prompt
     buf = jnp.zeros((b, total), jnp.int32).at[:, :t_prompt].set(prompt)
-    cache = init_cache(cfg, b)
-    if mesh is not None:
-        cache = shard_cache(cache, cfg, mesh)
-    key = jax.random.PRNGKey(seed)
 
     def choose(logits, key):
         if greedy:
@@ -799,7 +773,7 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
         return _sample_logits(logits, sub, temperature, top_k,
                               top_p), key
 
-    last_logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
+    last_logits, cache = prefill(params, cache, prompt, cfg)
     nxt, key = choose(last_logits, key)
     buf = buf.at[:, t_prompt].set(nxt)
 
@@ -817,6 +791,58 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
             body, (buf, cache, key),
             jnp.arange(t_prompt, total - 1))
     return buf
+
+
+def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
+             temperature=1.0, top_k=None, top_p=None, mesh=None):
+    """Autoregressive generation: prompt [B, Tp] int32 -> [B, Tp+n_new].
+
+    Sampling: by default, passing any of `temperature` (!= 1.0),
+    `top_k`, or `top_p` samples with those controls; otherwise decoding
+    is greedy argmax. Passing greedy=True together with sampling
+    controls is a contradiction and raises. With `mesh`, the KV cache
+    is laid out dp/tp-sharded (shard_cache) to match TP-sharded params.
+    The prompt is prefilled in ONE batched forward (prefill), then the
+    generation steps run as one lax.scan.
+
+    The mesh-less path runs as ONE cached jitted program (keyed on cfg
+    + the sampling controls; n_new/prompt-length re-specialize like any
+    shape) — repeated generate() calls pay zero re-trace, which is what
+    a serving loop needs (benchmark/serving_bench.py measures this
+    path).
+    """
+    sampling_requested = (temperature != 1.0 or top_k is not None
+                          or top_p is not None)
+    if greedy is None:
+        greedy = not sampling_requested
+    elif greedy and sampling_requested:
+        raise ValueError(
+            "greedy=True ignores temperature/top_k/top_p — pass "
+            "greedy=False (or omit greedy) to sample")
+    b, t_prompt = prompt.shape
+    total = t_prompt + n_new
+    if total > cfg.max_len:
+        raise ValueError("prompt+n_new %d exceeds max_len %d"
+                         % (total, cfg.max_len))
+    if n_new == 0:
+        return prompt
+    cache = init_cache(cfg, b)
+    if mesh is not None:
+        # jit specializes per input sharding, so the sharded and
+        # single-device calls share one cached wrapper
+        cache = shard_cache(cache, cfg, mesh)
+    key = jax.random.PRNGKey(seed)
+    # donating the fresh cache saves one HBM copy on device; the CPU
+    # backend can't donate and would warn on every call
+    donate = () if jax.default_backend() == "cpu" else (2,)
+    fn = _serving_jit(
+        ("generate", bool(greedy), float(temperature), top_k, top_p),
+        cfg,
+        lambda fz: jax.jit(
+            lambda p, t, c, k, n: _generate_core(
+                p, t, c, k, n, fz, greedy, temperature, top_k, top_p),
+            static_argnums=(4,), donate_argnums=donate))
+    return fn(params, prompt, cache, key, n_new)
 
 
 def beam_search(params, prompt, n_new, cfg, beam=4, length_penalty=0.0,
