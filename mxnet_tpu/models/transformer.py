@@ -532,6 +532,13 @@ def _serving_jit(kind, cfg, build):
     return fn
 
 
+def _serving_donate(argnum):
+    """Donation tuple for a serving entry point's fresh KV cache: saves
+    one HBM copy on accelerators; the CPU backend can't donate and
+    would warn on every call."""
+    return () if jax.default_backend() == "cpu" else (argnum,)
+
+
 def _jitted_prefill(cfg):
     return _serving_jit("prefill", cfg, lambda fz: jax.jit(
         lambda p, c, t: prefill(p, c, t, fz)))
@@ -805,11 +812,11 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
     The prompt is prefilled in ONE batched forward (prefill), then the
     generation steps run as one lax.scan.
 
-    The mesh-less path runs as ONE cached jitted program (keyed on cfg
-    + the sampling controls; n_new/prompt-length re-specialize like any
-    shape) — repeated generate() calls pay zero re-trace, which is what
-    a serving loop needs (benchmark/serving_bench.py measures this
-    path).
+    Both the mesh-sharded and single-device calls run as ONE cached
+    jitted program (keyed on cfg + the sampling controls;
+    n_new/prompt-length/input-sharding re-specialize like any shape) —
+    repeated generate() calls pay zero re-trace, which is what a
+    serving loop needs (benchmark/serving_bench.py measures this).
     """
     sampling_requested = (temperature != 1.0 or top_k is not None
                           or top_p is not None)
@@ -832,16 +839,13 @@ def generate(params, prompt, n_new, cfg, greedy=None, seed=0,
         # single-device calls share one cached wrapper
         cache = shard_cache(cache, cfg, mesh)
     key = jax.random.PRNGKey(seed)
-    # donating the fresh cache saves one HBM copy on device; the CPU
-    # backend can't donate and would warn on every call
-    donate = () if jax.default_backend() == "cpu" else (2,)
     fn = _serving_jit(
         ("generate", bool(greedy), float(temperature), top_k, top_p),
         cfg,
         lambda fz: jax.jit(
             lambda p, t, c, k, n: _generate_core(
                 p, t, c, k, n, fz, greedy, temperature, top_k, top_p),
-            static_argnums=(4,), donate_argnums=donate))
+            static_argnums=(4,), donate_argnums=_serving_donate(2)))
     return fn(params, prompt, cache, key, n_new)
 
 
@@ -869,12 +873,29 @@ def beam_search(params, prompt, n_new, cfg, beam=4, length_penalty=0.0,
         raise ValueError("beam width %d must be in [1, vocab_size=%d]"
                          % (beam, cfg.vocab_size))
     k = beam
-    vocab = cfg.vocab_size
 
     cache = init_cache(cfg, b)
     if mesh is not None:
         cache = shard_cache(cache, cfg, mesh)
-    last_logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
+    # one cached jitted program per (cfg, beam, penalty, mesh) — like
+    # generate(), repeated beam_search() calls pay zero re-trace
+    fn = _serving_jit(
+        ("beam", k, float(length_penalty), mesh), cfg,
+        lambda fz: jax.jit(
+            lambda p, t, c, n: _beam_core(p, t, c, n, k,
+                                          length_penalty, fz, mesh),
+            static_argnums=(3,), donate_argnums=_serving_donate(2)))
+    return fn(params, prompt, cache, n_new)
+
+
+def _beam_core(params, prompt, cache, n_new, k, length_penalty, cfg,
+               mesh):
+    """prefill + beam expansion + decode scan, one traceable program
+    (see beam_search)."""
+    b, t_prompt = prompt.shape
+    total = t_prompt + n_new
+    vocab = cfg.vocab_size
+    last_logits, cache = prefill(params, cache, prompt, cfg)
     logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
 
     # first expansion: top-k tokens of the last prompt position seed
@@ -884,7 +905,11 @@ def beam_search(params, prompt, n_new, cfg, beam=4, length_penalty=0.0,
     rep = lambda x: jnp.repeat(x, k, axis=0)
     cache = jax.tree.map(rep, cache)
     if mesh is not None:
-        cache = shard_cache(cache, cfg, mesh)
+        # traced equivalent of shard_cache for the beam-expanded rows
+        spec = P(cfg.dp_axis, None, cfg.tp_axis, None)
+        cache = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec)), cache)
     buf = jnp.zeros((b * k, total), jnp.int32)
     buf = buf.at[:, :t_prompt].set(jnp.repeat(prompt, k, axis=0))
     buf = buf.at[:, t_prompt].set(tok0.reshape(-1))
