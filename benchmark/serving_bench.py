@@ -17,6 +17,9 @@ bound serving shape; decode_bench.py covers batched decode):
                   make the chunked-verify and per-token argmax flip
                   (documented fp tie noise), so read acceptance as
                   what it measures: tie density, not a ceiling
+  continuous      aggregate tokens/s serving a mixed-length request
+                  queue through the ContinuousBatcher slot pool vs
+                  the same jobs sequentially through generate()
 
     python - < benchmark/serving_bench.py
     MXNET_SERVING_SMOKE=1 JAX_PLATFORMS=cpu python - < benchmark/serving_bench.py
@@ -138,6 +141,40 @@ def main():
 
     spec_leg("speculative", draft_params, draft_cfg)
     spec_leg("spec_selfdraft", params, cfg)
+
+    # --- continuous batching: mixed-length queue, slot pool vs
+    # sequential generate() ---
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    n_jobs = 4 if SMOKE else 16
+    slots = 2 if SMOKE else 8
+    jrng = np.random.RandomState(1)
+    jobs = [(list(jrng.randint(1, 32000, int(jrng.randint(
+        max(2, t_prompt // 2), t_prompt)))), n_new)
+            for _ in range(n_jobs)]
+    total_new = sum(n for _, n in jobs)
+
+    def run_pool():
+        srv = ContinuousBatcher(params, cfg, max_batch=slots)
+        return srv.run(jobs)
+
+    run_pool()                                   # warm compiles
+    t0 = time.time()
+    run_pool()
+    pool_rate = total_new / (time.time() - t0)
+
+    def run_sequential():
+        for prompt, n in jobs:
+            out = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                              n, cfg)
+            out.block_until_ready()
+
+    run_sequential()                             # warm compiles
+    t0 = time.time()
+    run_sequential()
+    seq_rate = total_new / (time.time() - t0)
+    print('{"leg": "continuous", "tokens_per_s": %.1f, '
+          '"sequential_tokens_per_s": %.1f, "slots": %d, "jobs": %d}'
+          % (pool_rate, seq_rate, slots, n_jobs), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,205 @@
+"""Continuous batching for autoregressive serving.
+
+A fixed pool of B cache slots decodes as ONE ragged batch (each row at
+its own position — `decode_step` with vector `pos`); requests are
+admitted into free slots mid-stream and leave when done, so the batch
+never drains to refill (the reference serves Module.predict batch-at-
+a-time: `/root/reference/python/mxnet/module/base_module.py:336-420`;
+continuous batching is the TPU-serving upgrade of that surface —
+static shapes, one compiled step program, no pipeline bubbles between
+requests).
+
+Design notes (all static-shape, XLA-friendly):
+
+* One compiled ragged decode step serves every mix of positions — pos
+  is data, not shape.
+* Admission prefills the prompt at a power-of-two BUCKET width (one
+  compiled prefill per bucket, not per prompt length) with the logits
+  row for the true last token selected out. Pad garbage in the cache
+  beyond the prompt is harmless: attention masks to `<= pos`, and
+  positions beyond the prompt are overwritten by decode writes before
+  they ever become attendable — the same self-healing argument the
+  speculative decoder relies on.
+* Idle slots keep lanes busy writing at position 0 of retired rows;
+  the next admission's prefill overwrites them. Throughput is
+  proportional to active lanes, latency to the slowest active row —
+  exactly the continuous-batching trade.
+
+Greedy decoding (the serving default); sampling per-row is a
+straightforward extension (thread a per-slot PRNG key through step()).
+Weight-only int8 trees (quantize_weights_int8) pass through unchanged.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import transformer as tf
+
+
+def _bucket(n, lo=8):
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _jitted_ragged_step(cfg):
+    return tf._serving_jit("decode_ragged", cfg, lambda fz: jax.jit(
+        lambda p, c, t, pos: tf.decode_step(p, c, t, pos, fz),
+        donate_argnums=tf._serving_donate(1)))
+
+
+def _jitted_slot_write(cfg):
+    """Write a 1-row prefilled cache into slot `i` of the pool cache."""
+    return tf._serving_jit("slot_write", cfg, lambda fz: jax.jit(
+        lambda full, row, i: jax.tree.map(
+            lambda f, r: jax.lax.dynamic_update_slice_in_dim(
+                f, r.astype(f.dtype), i, axis=0), full, row),
+        donate_argnums=tf._serving_donate(0)))
+
+
+class Request(object):
+    __slots__ = ("rid", "tokens", "n_new", "emitted")
+
+    def __init__(self, rid, prompt, n_new):
+        self.rid = rid
+        self.tokens = list(prompt)   # prompt + generated so far
+        self.n_new = n_new
+        self.emitted = 0             # generated count
+
+
+class ContinuousBatcher(object):
+    """Slot-based continuous batching over a shared ragged decode step.
+
+    >>> srv = ContinuousBatcher(params, cfg, max_batch=8)
+    >>> rid = srv.admit([1, 2, 3], n_new=16)      # None when full
+    >>> finished = srv.step()                     # {rid: [tokens...]}
+
+    Every emitted token is the greedy argmax of the target model —
+    per-request outputs are identical to tf.generate() (tested).
+    """
+
+    def __init__(self, params, cfg, max_batch=8):
+        if cfg.max_len < 8:
+            raise ValueError("max_len too small for the bucket floor")
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = int(max_batch)
+        self._cache = tf.init_cache(cfg, self.max_batch)
+        self._pos = np.zeros((self.max_batch,), np.int32)
+        self._tok = np.zeros((self.max_batch,), np.int32)
+        self._slots = [None] * self.max_batch   # Request or None
+        self._next_rid = 0
+
+    # ---- admission ----
+
+    @property
+    def active_count(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def has_capacity(self):
+        return self.active_count < self.max_batch
+
+    def admit(self, prompt, n_new):
+        """Prefill `prompt` into a free slot; returns the request id,
+        or None when every slot is busy. The first generated token is
+        produced here (from the prefill logits), so a request with
+        n_new=1 never occupies a decode lane."""
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1")
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        t_p = len(prompt)
+        if t_p < 1:
+            raise ValueError("empty prompt")
+        if t_p + n_new > self.cfg.max_len:
+            raise ValueError("prompt+n_new %d exceeds max_len %d"
+                             % (t_p + n_new, self.cfg.max_len))
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            return None
+        # clamp: the bucket can pass max_len (e.g. max_len=96, t_p=70
+        # -> bucket 128) and the cache axis is max_len wide; width >=
+        # t_p always holds since t_p + n_new <= max_len
+        width = min(_bucket(t_p), self.cfg.max_len)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :t_p] = prompt
+        row_cache = tf.init_cache(self.cfg, 1)
+        # one compiled prefill per bucket width (prefill_chunk already
+        # specializes per chunk shape); start=0 fills positions
+        # [0, width) — rows beyond t_p are pad garbage that decode
+        # overwrites before attention can reach them
+        logits, row_cache = tf._jitted_prefill_chunk(self.cfg)(
+            self.params, row_cache, jnp.asarray(padded),
+            jnp.int32(0))
+        first = int(np.argmax(np.asarray(logits[0, t_p - 1])))
+        self._cache = _jitted_slot_write(self.cfg)(
+            self._cache, row_cache, jnp.int32(slot))
+        req = Request(self._next_rid, prompt, n_new)
+        self._next_rid += 1
+        req.tokens.append(first)
+        req.emitted = 1
+        self._slots[slot] = req
+        self._pos[slot] = t_p          # next decode writes position t_p
+        self._tok[slot] = first
+        return req.rid
+
+    # ---- decode ----
+
+    def step(self):
+        """One ragged decode step over all slots. Appends a token to
+        every active request; returns {rid: full token list} for the
+        requests that finished this step (their slots are freed)."""
+        finished = {}
+        # retire requests that were already complete at admission
+        for i, req in enumerate(self._slots):
+            if req is not None and req.emitted >= req.n_new:
+                finished[req.rid] = list(req.tokens)
+                self._free(i)
+        if not any(s is not None for s in self._slots):
+            return finished
+        logits, self._cache = _jitted_ragged_step(self.cfg)(
+            self.params, self._cache, jnp.asarray(self._tok),
+            jnp.asarray(self._pos))
+        nxt = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            req.tokens.append(int(nxt[i]))
+            req.emitted += 1
+            self._pos[i] += 1
+            self._tok[i] = nxt[i]
+            if req.emitted >= req.n_new:
+                finished[req.rid] = list(req.tokens)
+                self._free(i)
+        return finished
+
+    def _free(self, i):
+        """Free slot i. Idle lanes keep decoding (static batch shape);
+        parking them at position 0 means their garbage K/V lands where
+        the next admission's prefill overwrites it — defense in depth
+        on top of the `attention <= pos` self-healing argument."""
+        self._slots[i] = None
+        self._pos[i] = 0
+        self._tok[i] = 0
+
+    def run(self, requests):
+        """Convenience driver: serve `requests` (an iterable of
+        (prompt, n_new)) through the slot pool, admitting as capacity
+        frees. Returns {rid: tokens} for all of them, plus the
+        admission order as a list of rids."""
+        queue = list(requests)
+        order, results = [], {}
+        while queue or self.active_count:
+            while queue and self.has_capacity:
+                prompt, n_new = queue[0]
+                rid = self.admit(prompt, n_new)
+                if rid is None:
+                    break
+                order.append(rid)
+                queue.pop(0)
+            results.update(self.step())
+        return results, order

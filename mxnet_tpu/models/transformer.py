@@ -463,7 +463,10 @@ def _decode_attention(q, cache_k, cache_v, pos, cfg):
     s = jnp.einsum("bkgd,btkd->bkgt", qg, cache_k,
                    preferred_element_type=jnp.float32) / np.sqrt(d)
     t_pos = jnp.arange(cache_k.shape[1])
-    s = jnp.where((t_pos <= pos)[None, None, None, :], s, -1e30)
+    # pos is a scalar (all rows at the same position) or [B] (ragged
+    # decode — continuous batching); [1] broadcasts the scalar case
+    mask = t_pos[None, :] <= jnp.atleast_1d(pos)[:, None]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
     a = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,btkd->bkgd", a.astype(cache_v.dtype), cache_v,
                    preferred_element_type=jnp.float32)
@@ -703,17 +706,24 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
 def decode_step(params, cache, tokens, pos, cfg):
     """One autoregressive step.
 
-    tokens [B] int32 (the token at position `pos`), pos scalar int32.
-    Returns (logits [B, vocab] for the NEXT token, updated cache).
+    tokens [B] int32 (the token at position `pos`), pos scalar int32 —
+    or int32 [B] for RAGGED decode (each row at its own position; what
+    continuous batching needs, see models/serving.py). Returns
+    (logits [B, vocab] for the NEXT token, updated cache).
     Static shapes throughout: `pos` is data, not shape, so one compiled
     program decodes every position. Accepts quantize_weights_int8
     trees: the dequantizing converts fuse into each weight's matmul.
     """
     params = _maybe_dequantize(params)
+    ragged = jnp.ndim(pos) == 1        # trace-time branch: [B] vs scalar
     x = params["embed"][tokens]
     if not cfg.rope:
-        x = x + jax.lax.dynamic_index_in_dim(
-            params["pos"], pos, 0, keepdims=False)
+        if ragged:
+            x = x + jnp.take(params["pos"], pos, axis=0)
+        else:
+            x = x + jax.lax.dynamic_index_in_dim(
+                params["pos"], pos, 0, keepdims=False)
+    b = x.shape[0]
     new_cache = []
     for p, layer_cache in zip(params["layers"], cache):
         h = _rms_norm(x, p["ln1"])
@@ -723,10 +733,17 @@ def decode_step(params, cache, tokens, pos, cfg):
         if cfg.rope:
             q = _rope(q, pos, cfg.rope_base)
             k_new = _rope(k_new, pos, cfg.rope_base)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["k"], k_new[:, None], pos, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["v"], v_new[:, None], pos, axis=1)
+        if ragged:
+            # per-row scatter: row i writes its K/V at its own pos[i]
+            ck = layer_cache["k"].at[jnp.arange(b), pos].set(
+                k_new.astype(layer_cache["k"].dtype))
+            cv = layer_cache["v"].at[jnp.arange(b), pos].set(
+                v_new.astype(layer_cache["v"].dtype))
+        else:
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                layer_cache["k"], k_new[:, None], pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                layer_cache["v"], v_new[:, None], pos, axis=1)
         new_cache.append({"k": ck, "v": cv})
         o = _decode_attention(q, ck, cv, pos, cfg)
         x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"])

@@ -1,0 +1,185 @@
+"""Continuous batching (models/serving.py): ragged decode + slot pool.
+
+Reference counterpart: batch-at-a-time Module.predict serving
+(/root/reference/python/mxnet/module/base_module.py:336-420); the
+oracle here is the framework's own generate() — every request served
+through the shared slot pool must emit exactly the tokens generate()
+emits for it alone.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.models import transformer as tf
+from mxnet_tpu.models.serving import ContinuousBatcher, _bucket
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=211, d_model=24, n_heads=4, n_layers=2,
+                d_ff=48, max_len=64, dtype=jnp.float32)
+    base.update(kw)
+    return tf.TransformerConfig(**base)
+
+
+def _prompts(rng, n, vocab=211):
+    return [list(rng.randint(1, vocab, rng.randint(3, 12)))
+            for _ in range(n)]
+
+
+def test_ragged_decode_matches_scalar():
+    """decode_step with an all-equal pos vector == scalar pos."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=1)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(1, 211, (3, 7)), jnp.int32)
+    cache = tf.init_cache(cfg, 3)
+    logits, cache = tf.prefill(params, cache, prompt, cfg)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    l_s, c_s = tf.decode_step(params, cache, tok, 7, cfg)
+    l_v, c_v = tf.decode_step(params, cache, tok,
+                              jnp.full((3,), 7, jnp.int32), cfg)
+    np.testing.assert_allclose(l_s, l_v, atol=1e-5)
+    for a, b in zip(c_s, c_v):
+        np.testing.assert_allclose(a["k"], b["k"], atol=1e-6)
+
+
+@pytest.mark.parametrize("rope,kvh,flash", [
+    (False, None, False), (True, 2, False), (True, 2, True)])
+def test_ragged_decode_mixed_positions(rope, kvh, flash):
+    """Rows at DIFFERENT positions decode exactly as if each ran in
+    its own batch — across rope, GQA, and the flash-decode kernel."""
+    cfg = _cfg(n_kv_heads=kvh, rope=rope, use_flash_kernel=flash,
+               d_model=16, max_len=32, vocab_size=97)
+    params = tf.init_params(cfg, seed=1)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(1, 97, (3, 8)), jnp.int32)
+    cache = tf.init_cache(cfg, 3)
+    logits, cache = tf.prefill(params, cache, prompt, cfg)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    rows = []
+    for i in range(3):                  # advance row i to position 8+i
+        ci = jax.tree.map(lambda x: x[i:i + 1], cache)
+        ti, p = tok[i:i + 1], 8
+        for _ in range(i):
+            li, ci = tf.decode_step(params, ci, ti, p, cfg)
+            ti = jnp.argmax(li, -1).astype(jnp.int32)
+            p += 1
+        rows.append((ci, ti, p))
+    rag_cache = jax.tree.map(lambda *r: jnp.concatenate(r),
+                             *[c for c, _, _ in rows])
+    rag_tok = jnp.concatenate([t for _, t, _ in rows])
+    rag_pos = jnp.asarray([p for _, _, p in rows], jnp.int32)
+    l_r, _ = tf.decode_step(params, rag_cache, rag_tok, rag_pos, cfg)
+    for i, (ci, ti, p) in enumerate(rows):
+        l_i, _ = tf.decode_step(params, ci, ti, p, cfg)
+        np.testing.assert_allclose(l_r[i], l_i[0], atol=1e-4)
+
+
+def test_bucket():
+    assert [_bucket(n) for n in (1, 8, 9, 16, 17)] == [8, 8, 16, 16, 32]
+
+
+def test_batcher_matches_generate():
+    """Mixed-length requests served through the shared pool emit
+    exactly generate()'s greedy tokens for each request alone."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    rng = np.random.RandomState(1)
+    jobs = [(p, int(rng.randint(1, 10)))
+            for p in _prompts(rng, 6)]
+    srv = ContinuousBatcher(params, cfg, max_batch=3)
+    results, order = srv.run(jobs)
+    assert len(results) == len(jobs) and len(order) == len(jobs)
+    # admission is FIFO, so rid i corresponds to jobs[i]
+    for rid, (prompt, n_new) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n_new, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(results[rid]), np.asarray(want[0]),
+            err_msg="request %d (len %d, n_new %d)"
+                    % (rid, len(prompt), n_new))
+
+
+def test_batcher_slot_reuse_no_contamination():
+    """A slot retired and re-admitted must not leak the previous
+    occupant's cache: serve two waves through ONE slot."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=5)
+    rng = np.random.RandomState(2)
+    srv = ContinuousBatcher(params, cfg, max_batch=1)
+    for prompt in _prompts(rng, 3):
+        rid = srv.admit(prompt, 6)
+        assert rid is not None
+        assert srv.admit([1, 2], 2) is None     # pool is full
+        out = {}
+        while rid not in out:
+            out.update(srv.step())
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           6, cfg)
+        np.testing.assert_array_equal(np.asarray(out[rid]),
+                                      np.asarray(want[0]))
+
+
+def test_batcher_mid_stream_admission():
+    """Admitting while another request is mid-decode leaves the running
+    request's stream untouched."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=7)
+    rng = np.random.RandomState(3)
+    p1, p2 = _prompts(rng, 2)
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    r1 = srv.admit(p1, 8)
+    done = {}
+    done.update(srv.step())
+    done.update(srv.step())             # r1 two tokens into decode
+    r2 = srv.admit(p2, 4)               # joins mid-stream
+    while r1 not in done or r2 not in done:
+        done.update(srv.step())
+    for rid, prompt, n in ((r1, p1, 8), (r2, p2, 4)):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(np.asarray(done[rid]),
+                                      np.asarray(want[0]))
+
+
+def test_batcher_int8_weights():
+    """Weight-only int8 trees serve through the pool unchanged."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=9)
+    q8 = tf.quantize_weights_int8(params)
+    rng = np.random.RandomState(4)
+    prompt = _prompts(rng, 1)[0]
+    srv = ContinuousBatcher(q8, cfg, max_batch=2)
+    results, order = srv.run([(prompt, 5)])
+    want = tf.generate(q8, jnp.asarray([prompt], jnp.int32), 5, cfg)
+    np.testing.assert_array_equal(np.asarray(results[order[0]]),
+                                  np.asarray(want[0]))
+
+
+def test_bucket_clamped_to_max_len():
+    """A prompt whose power-of-two bucket exceeds max_len must prefill
+    at max_len width, not crash the cache update (max_len=96, t_p=70
+    -> bucket 128 > 96)."""
+    cfg = _cfg(max_len=96)
+    params = tf.init_params(cfg, seed=13)
+    prompt = list(np.random.RandomState(0).randint(1, 211, 70))
+    srv = ContinuousBatcher(params, cfg, max_batch=1)
+    results, order = srv.run([(prompt, 3)])
+    want = tf.generate(params, jnp.asarray([prompt], jnp.int32), 3, cfg)
+    np.testing.assert_array_equal(np.asarray(results[order[0]]),
+                                  np.asarray(want[0]))
+
+
+def test_admit_validation():
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=11)
+    srv = ContinuousBatcher(params, cfg, max_batch=1)
+    with pytest.raises(ValueError):
+        srv.admit([], 4)
+    with pytest.raises(ValueError):
+        srv.admit([1, 2], 0)
+    with pytest.raises(ValueError):
+        srv.admit(list(range(1, 60)), 30)    # exceeds max_len
