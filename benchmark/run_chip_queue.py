@@ -65,6 +65,8 @@ QUEUE = [
       "env": {"MXNET_DECODE_KV_HEADS": "2"}}, 1500, False),
     ("serving",
      {"stdin": "benchmark/serving_bench.py"}, 1800, False),
+    ("train_lm",
+     {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("inference_fp32",
      {"argv": [sys.executable,
                "examples/image_classification/benchmark_score.py",

@@ -1,0 +1,96 @@
+"""Single-chip LM training throughput: tokens/s and MFU.
+
+Trains the transformer flagship (flash attention + per-layer remat,
+bf16) for timed windows and reports tokens/s plus model-FLOPs
+utilization (6*N*tokens / peak). This is a capability benchmark the
+reference cannot express (its transformer surface stops at helper
+ops); the matmul-dominated LM step is also the best single number for
+"how well does the stack feed the MXU".
+
+    python - < benchmark/train_lm_bench.py
+    MXNET_LM_SMOKE=1 JAX_PLATFORMS=cpu python - < benchmark/train_lm_bench.py
+
+Env knobs: MXNET_LM_DMODEL/LAYERS/SEQ/BATCH/STEPS override the model.
+Run from /root/repo via stdin so cwd lands on sys.path (leave the
+environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
+registers through it; overriding OR popping it breaks registration).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+SMOKE = bool(os.environ.get("MXNET_LM_SMOKE"))
+
+# v5e bf16 peak (dense): 197 TFLOPS. Other chips print MFU against
+# this constant — the tokens/s leg is the portable number.
+PEAK_FLOPS = float(os.environ.get("MXNET_LM_PEAK_FLOPS", 197e12))
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def main():
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+
+    if SMOKE:
+        d_model, layers, seq, batch, steps = 32, 1, 64, 2, 2
+    else:
+        d_model = _env_int("MXNET_LM_DMODEL", 1024)
+        layers = _env_int("MXNET_LM_LAYERS", 12)
+        seq = _env_int("MXNET_LM_SEQ", 2048)
+        batch = _env_int("MXNET_LM_BATCH", 8)
+        steps = _env_int("MXNET_LM_STEPS", 10)
+
+    cfg = tf.TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_heads=max(2, d_model // 128),
+        n_layers=layers, d_ff=4 * d_model, max_len=seq,
+        dtype=jnp.bfloat16, rope=True,
+        use_flash_kernel=jax.default_backend() == "tpu",
+        remat_layers=True)
+    params = tf.init_params(cfg, seed=0)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    step = tf.make_train_step(cfg)
+    mom = tf.init_momentum(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(1, 32000, (batch, seq)), jnp.int32)
+    tokens_per_step = batch * seq
+    # standard decoder-only accounting: ~6*N FLOPs per trained token
+    # (fwd 2N + bwd 4N); attention FLOPs excluded, so MFU is slightly
+    # conservative at long seq
+    flops_per_step = 6.0 * n_params * tokens_per_step
+
+    params, mom, loss = step(params, mom, tokens)    # compile + warm
+    float(loss)
+    params, mom, loss = step(params, mom, tokens)
+    float(loss)
+
+    rates = []
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(steps):
+            params, mom, loss = step(params, mom, tokens)
+        loss = float(loss)                           # full barrier
+        rates.append(tokens_per_step * steps / (time.time() - t0))
+    rate = float(np.median(rates))
+    mfu = flops_per_step * rate / tokens_per_step / PEAK_FLOPS
+    print(json.dumps({
+        "metric": "lm_train_tokens_per_s_%s" % jax.default_backend(),
+        "value": round(rate, 1), "unit": "tokens/s",
+        "params_m": round(n_params / 1e6, 1),
+        "d_model": d_model, "layers": layers, "seq": seq,
+        "batch": batch, "mfu": round(mfu, 4),
+        "loss_finite": bool(np.isfinite(loss)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,10 +45,30 @@ def _bucket(n, lo=8):
     return b
 
 
-def _jitted_ragged_step(cfg):
-    return tf._serving_jit("decode_ragged", cfg, lambda fz: jax.jit(
-        lambda p, c, t, pos: tf.decode_step(p, c, t, pos, fz),
-        donate_argnums=tf._serving_donate(1)))
+def _jitted_ragged_step(cfg, greedy, temperature, top_k, top_p):
+    """One compiled program: ragged decode + per-row token choice.
+
+    Sampling mirrors generate()'s key chain PER ROW (split the row's
+    key, sample with the sub-key), so a request's sampled stream is
+    identical to its solo generate(seed=...) run — slot placement and
+    pool mix cannot perturb it."""
+    def build(fz):
+        def step(params, cache, tok, pos, keys):
+            logits, cache = tf.decode_step(params, cache, tok, pos, fz)
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, keys, cache
+            split = jax.vmap(jax.random.split)(keys)   # [B, 2, 2]
+            keys, subs = split[:, 0], split[:, 1]
+            nxt = jax.vmap(
+                lambda l, k: tf._sample_logits(
+                    l[None], k, temperature, top_k, top_p)[0]
+            )(logits, subs)
+            return nxt, keys, cache
+        return jax.jit(step, donate_argnums=tf._serving_donate(1))
+    return tf._serving_jit(
+        ("decode_ragged", greedy, float(temperature), top_k, top_p),
+        cfg, build)
 
 
 def _jitted_slot_write(cfg):
@@ -77,19 +97,35 @@ class ContinuousBatcher(object):
     >>> rid = srv.admit([1, 2, 3], n_new=16)      # None when full
     >>> finished = srv.step()                     # {rid: [tokens...]}
 
-    Every emitted token is the greedy argmax of the target model —
-    per-request outputs are identical to tf.generate() (tested).
-    """
+    Decoding is greedy by default; pool-level temperature/top_k/top_p
+    sample instead (generate()'s rule), with a PER-REQUEST seed at
+    admit(). Either way a request's output is identical to its solo
+    tf.generate() run — greedy argmax, or the same per-row key chain
+    (tested)."""
 
-    def __init__(self, params, cfg, max_batch=8):
+    def __init__(self, params, cfg, max_batch=8, greedy=None,
+                 temperature=1.0, top_k=None, top_p=None):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         self.params = params
         self.cfg = cfg
         self.max_batch = int(max_batch)
+        # generate()'s rule, incl. greedy=False for pure ancestral
+        # sampling (temperature=1.0 alone would read as greedy)
+        sampling_requested = (temperature != 1.0 or top_k is not None
+                              or top_p is not None)
+        if greedy is None:
+            greedy = not sampling_requested
+        elif greedy and sampling_requested:
+            raise ValueError(
+                "greedy=True ignores temperature/top_k/top_p — pass "
+                "greedy=False (or omit greedy) to sample")
+        self.greedy = greedy
+        self._controls = (self.greedy, float(temperature), top_k, top_p)
         self._cache = tf.init_cache(cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._tok = np.zeros((self.max_batch,), np.int32)
+        self._keys = np.zeros((self.max_batch, 2), np.uint32)
         self._slots = [None] * self.max_batch   # Request or None
         self._next_rid = 0
 
@@ -103,11 +139,13 @@ class ContinuousBatcher(object):
     def has_capacity(self):
         return self.active_count < self.max_batch
 
-    def admit(self, prompt, n_new):
+    def admit(self, prompt, n_new, seed=0):
         """Prefill `prompt` into a free slot; returns the request id,
         or None when every slot is busy. The first generated token is
         produced here (from the prefill logits), so a request with
-        n_new=1 never occupies a decode lane."""
+        n_new=1 never occupies a decode lane. `seed` drives this
+        request's sampling chain (ignored under greedy), exactly as
+        generate(seed=...) would."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -132,10 +170,21 @@ class ContinuousBatcher(object):
         # specializes per chunk shape); start=0 fills positions
         # [0, width) — rows beyond t_p are pad garbage that decode
         # overwrites before attention can reach them
-        logits, row_cache = tf._jitted_prefill_chunk(self.cfg)(
+        logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
             self.params, row_cache, jnp.asarray(padded),
-            jnp.int32(0))
-        first = int(np.argmax(np.asarray(logits[0, t_p - 1])))
+            jnp.int32(0), jnp.int32(t_p - 1))
+        last = logits[0]
+        if self.greedy:
+            first = int(np.argmax(np.asarray(last)))
+        else:
+            # mirror generate()'s chain: key=PRNGKey(seed); split once
+            # for the prefill token, carry the key into the step loop
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            _, temperature, top_k, top_p = self._controls
+            first = int(tf._sample_logits(last[None], sub, temperature,
+                                          top_k, top_p)[0])
+            self._keys[slot] = np.asarray(key, np.uint32)
         self._cache = _jitted_slot_write(self.cfg)(
             self._cache, row_cache, jnp.int32(slot))
         req = Request(self._next_rid, prompt, n_new)
@@ -161,10 +210,14 @@ class ContinuousBatcher(object):
                 self._free(i)
         if not any(s is not None for s in self._slots):
             return finished
-        logits, self._cache = _jitted_ragged_step(self.cfg)(
+        nxt, keys, self._cache = _jitted_ragged_step(
+            self.cfg, *self._controls)(
             self.params, self._cache, jnp.asarray(self._tok),
-            jnp.asarray(self._pos))
-        nxt = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+            jnp.asarray(self._pos), jnp.asarray(self._keys))
+        nxt = np.asarray(nxt).astype(np.int32)
+        # np.array (copy): asarray would give a READ-ONLY view of the
+        # device buffer and the next admit()'s in-place key write fails
+        self._keys = np.array(keys, np.uint32)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -188,15 +241,16 @@ class ContinuousBatcher(object):
 
     def run(self, requests):
         """Convenience driver: serve `requests` (an iterable of
-        (prompt, n_new)) through the slot pool, admitting as capacity
-        frees. Returns {rid: tokens} for all of them, plus the
-        admission order as a list of rids."""
+        (prompt, n_new) or (prompt, n_new, seed)) through the slot
+        pool, admitting as capacity frees. Returns {rid: tokens} for
+        all of them, plus the admission order as a list of rids."""
         queue = list(requests)
         order, results = [], {}
         while queue or self.active_count:
             while queue and self.has_capacity:
-                prompt, n_new = queue[0]
-                rid = self.admit(prompt, n_new)
+                job = queue[0]
+                rid = self.admit(job[0], job[1],
+                                 seed=job[2] if len(job) > 2 else 0)
                 if rid is None:
                     break
                 order.append(rid)

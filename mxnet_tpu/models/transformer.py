@@ -554,15 +554,26 @@ def _jitted_prefill_chunk(cfg):
         lambda p, c, t, s: prefill_chunk(p, c, t, s, fz)))
 
 
+def _jitted_prefill_chunk_row(cfg):
+    # admission variant: logits for ONE chunk row — skips the
+    # O(width*vocab) head projection the caller would throw away
+    return _serving_jit("prefill_chunk_row", cfg, lambda fz: jax.jit(
+        lambda p, c, t, s, r: prefill_chunk(p, c, t, s, fz,
+                                            logits_row=r)))
+
+
 def _jitted_decode_step(cfg):
     return _serving_jit("decode_step", cfg, lambda fz: jax.jit(
         lambda p, c, t, pos: decode_step(p, c, t, pos, fz)))
 
 
-def prefill_chunk(params, cache, tokens, start, cfg):
+def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None):
     """Process a CHUNK of C tokens beginning at dynamic position
     `start`, writing their K/V into the cache and returning the logits
-    after every chunk position ([B, C, vocab]).
+    after every chunk position ([B, C, vocab]) — or, with
+    `logits_row` (dynamic scalar), only that row's logits [B, vocab]:
+    the admission path of continuous batching needs one row and skips
+    the O(C*vocab) head projection.
 
     The chunked middle ground between prefill (whole prompt at 0) and
     decode_step (one token): long prompts stream through in fixed-size
@@ -618,6 +629,10 @@ def prefill_chunk(params, cache, tokens, start, cfg):
         x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
         x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
     x = _rms_norm(x, params["ln_f"])
+    if logits_row is not None:
+        xr = jax.lax.dynamic_index_in_dim(x, logits_row, 1,
+                                          keepdims=False)
+        return jnp.einsum("bd,vd->bv", xr, params["embed"]), new_cache
     return jnp.einsum("bcd,vd->bcv", x, params["embed"]), new_cache
 
 

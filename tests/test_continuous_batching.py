@@ -159,6 +159,44 @@ def test_batcher_int8_weights():
                                   np.asarray(want[0]))
 
 
+def test_batcher_sampling_matches_generate():
+    """Pool-level temperature/top-k sampling with per-request seeds:
+    each request's stream equals its solo generate(seed=...) run —
+    slot placement and pool mix must not perturb the key chain."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=17)
+    rng = np.random.RandomState(6)
+    jobs = [(p, int(rng.randint(2, 8)), 100 + i)
+            for i, p in enumerate(_prompts(rng, 5))]
+    srv = ContinuousBatcher(params, cfg, max_batch=2,
+                            temperature=0.8, top_k=20)
+    results, order = srv.run(jobs)
+    for rid, (prompt, n_new, seed) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n_new, cfg, temperature=0.8, top_k=20,
+                           seed=seed)
+        np.testing.assert_array_equal(
+            np.asarray(results[rid]), np.asarray(want[0]),
+            err_msg="request %d seed %d" % (rid, seed))
+
+
+def test_batcher_pure_ancestral_sampling():
+    """greedy=False with default controls = unmodified softmax
+    sampling (temperature=1.0 alone would read as greedy), matching
+    generate(greedy=False, seed=...)."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=19)
+    prompt = [4, 11, 7]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, greedy=False)
+    results, order = srv.run([(prompt, 5, 42)])
+    want = tf.generate(params, jnp.asarray([prompt], jnp.int32), 5,
+                       cfg, greedy=False, seed=42)
+    np.testing.assert_array_equal(np.asarray(results[order[0]]),
+                                  np.asarray(want[0]))
+    with pytest.raises(ValueError):
+        ContinuousBatcher(params, cfg, greedy=True, top_k=5)
+
+
 def test_bucket_clamped_to_max_len():
     """A prompt whose power-of-two bucket exceeds max_len must prefill
     at max_len width, not crash the cache update (max_len=96, t_p=70
