@@ -10,6 +10,7 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -105,17 +106,66 @@ def _probe_backend_alive(timeout_s=150):
     return probe_backend_alive(timeout_s=timeout_s, use_cache=False)
 
 
+def _wait_budget_s():
+    try:
+        return float(os.environ.get("MXNET_BENCH_WAIT_S", "0"))
+    except ValueError:
+        print("bench: ignoring malformed MXNET_BENCH_WAIT_S=%r"
+              % os.environ.get("MXNET_BENCH_WAIT_S"), file=sys.stderr)
+        return 0.0
+
+
+def _wait_for_window(budget):
+    """Bounded wait-for-window: the axon tunnel alternates short alive
+    windows with multi-hour wedges, so a run that starts mid-wedge can
+    still land a number if it is allowed to wait.  The budget
+    (MXNET_BENCH_WAIT_S) caps the total wait; within it the liveness
+    probe re-runs every ~2 min.  Returns True the moment a probe
+    succeeds."""
+    if _probe_backend_alive():
+        return True
+    if budget <= 0:
+        return False
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        nap = min(120.0, max(5.0, deadline - time.time()))
+        print("bench: tunnel wedged; re-probing in %.0fs "
+              "(%.0fs of wait budget left)"
+              % (nap, deadline - time.time()), file=sys.stderr)
+        time.sleep(nap)
+        # keep each re-probe short so the budget buys many attempts
+        if _probe_backend_alive(timeout_s=90):
+            return True
+    return False
+
+
+def _vs_baseline(img_s, batch):
+    """The 363.69 img/s baseline row is bs=128; at any other effective
+    batch (env override, or the bs=8 CPU fallback) the ratio would
+    conflate batch-size effect with framework speedup, so it is
+    reported as None with a note instead."""
+    if batch == 128:
+        return round(img_s / BASELINE_IMG_S, 3), None
+    return None, ("baseline row is bs=128 (363.69 img/s); ratio "
+                  "suppressed at bs=%d to keep the comparison "
+                  "apples-to-apples" % batch)
+
+
 def main():
     import os
     import jax
     repeats = int(os.environ.get("MXNET_BENCH_REPEATS", "1"))
-    if not _probe_backend_alive():
+    wait_budget = _wait_budget_s()
+    if not _wait_for_window(wait_budget):
         record = {
             "metric": "resnet50_train_img_per_sec_bs%d_tpu" % BATCH,
             "value": None, "unit": "img/s", "vs_baseline": None,
             "error": "TPU backend unreachable (wedged tunnel): device "
                      "discovery hung past the probe timeout; rerun when "
                      "the chip is attached"}
+        if wait_budget > 0:
+            record["error"] += (" (waited %.0fs for a live window)"
+                                % wait_budget)
         # carry the most recent on-chip measurement (maintained in
         # BENCH_LAST_MEASURED.json whenever a chip session lands
         # numbers) so a wedged round-end run still reports the
@@ -125,8 +175,12 @@ def main():
                     os.path.dirname(os.path.abspath(__file__)),
                     "BENCH_LAST_MEASURED.json")) as f:
                 last = json.load(f)
-            last["vs_baseline"] = round(
-                last["value"] / BASELINE_IMG_S, 3)
+            m = re.search(r"_bs(\d+)_", last.get("metric", ""))
+            ratio, note = _vs_baseline(
+                last["value"], int(m.group(1)) if m else -1)
+            last["vs_baseline"] = ratio
+            if note:
+                last["baseline_note"] = note
             record["last_measured"] = last
         except Exception:
             pass
@@ -178,12 +232,15 @@ def main():
         rates.append(batch * steps / dt)
 
     img_s = rates[0] if repeats <= 1 else float(np.median(rates))
+    ratio, note = _vs_baseline(img_s, batch)
     result = {
         "metric": "resnet50_train_img_per_sec_bs%d_%s" % (batch, backend),
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "vs_baseline": ratio,
     }
+    if note:
+        result["baseline_note"] = note
     if repeats > 1:
         # repeatability data (MXNET_BENCH_REPEATS=N): median headline,
         # spread recorded so a single measurement session is auditable
