@@ -6,3 +6,4 @@ live in `mxnet_tpu.gluon.model_zoo.vision` behind the MXNet Gluon API.
 """
 
 from . import transformer
+from . import checkpoint

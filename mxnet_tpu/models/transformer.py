@@ -32,7 +32,8 @@ __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_train_step", "param_specs", "init_cache", "decode_step",
            "make_decode_step", "generate", "shard_cache", "prefill",
            "quantize_weights_int8", "beam_search", "prefill_chunk",
-           "speculative_generate"]
+           "speculative_generate", "save_checkpoint", "load_checkpoint",
+           "restore_train_state"]
 
 
 @dataclass
@@ -990,3 +991,10 @@ def make_train_step(cfg, mesh=None, lr=1e-2):
 
 def init_momentum(params):
     return jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+
+# sharded checkpoint/resume for this stack lives in models/checkpoint.py;
+# re-exported here so the flagship's whole train/serve/persist surface is
+# reachable from one module
+from .checkpoint import (save_checkpoint, load_checkpoint,  # noqa: E402
+                         restore_train_state)
