@@ -49,6 +49,12 @@ if ! python __graft_entry__.py; then
     FAILED=1
 fi
 
+stage "driver contract: dryrun_multichip(16) (ep AND dp both sharded)"
+if ! python __graft_entry__.py 16; then
+    echo "[ci] FAIL: __graft_entry__ 16-device contract"
+    FAILED=1
+fi
+
 stage "bench fail-fast"
 # on a wedged tunnel bench exits 3 with an error JSON — that is a PASS
 # for the gate (the guard worked); any other nonzero rc is a failure

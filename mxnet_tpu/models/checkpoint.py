@@ -47,7 +47,10 @@ _QSUF = "#"         # q8 sub-leaf suffix marker: "...wq#q8", "...wq#scale"
 
 
 def _is_q8(leaf):
-    return isinstance(leaf, dict) and "q8" in leaf
+    # single source of truth for the quantized-leaf shape is the module
+    # that produces it (lazy import: transformer re-exports this module)
+    from .transformer import _is_q8 as impl
+    return impl(leaf)
 
 
 def _flatten(tree, prefix, out):
@@ -150,36 +153,55 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
     host = {k: _gather_to_host(v) for k, v in flat.items()}
 
     import jax
-    if jax.process_index() != 0:
-        return path  # every process gathered; only one writes
-
-    os.makedirs(path, exist_ok=True)
-    manifest = {
-        "format": "mxnet_tpu.transformer.checkpoint/1",
-        "config": _cfg_to_json(cfg),
-        "step": int(step),
-        "has_momentum": momentum is not None,
-        # npz round-trips only native numpy dtypes; ml_dtypes arrays
-        # (bfloat16, float8_*) come back as raw void records, so the
-        # true dtype of every entry is recorded here and viewed back
-        # on load
-        "dtypes": {k: np.dtype(v.dtype).name for k, v in host.items()},
-        "arrays": sorted(host),
-        "metadata": metadata or {},
-    }
-    # serialize BEFORE touching the directory (a non-JSON metadata
-    # value must fail before any file is replaced), then install both
-    # files via tmp + os.replace so an overwritten checkpoint is never
-    # left half-new
-    manifest_text = json.dumps(manifest, indent=1, sort_keys=True)
-    tmp = os.path.join(path, ".arrays.npz.tmp")
-    with open(tmp, "wb") as f:
-        np.savez(f, **host)
-    os.replace(tmp, os.path.join(path, "arrays.npz"))
-    tmp = os.path.join(path, ".manifest.json.tmp")
-    with open(tmp, "w") as f:
-        f.write(manifest_text)
-    os.replace(tmp, os.path.join(path, "manifest.json"))
+    if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
+        # the data file gets a unique name and the manifest points at
+        # it: a crash at ANY point leaves the previous manifest (and
+        # the previous data file it references) fully intact — the
+        # manifest os.replace is the single commit point. Orphaned
+        # data files from crashed saves are swept after a successful
+        # commit.
+        arrays_file = "arrays-%d-%s.npz" % (
+            int(step), os.urandom(4).hex())
+        manifest = {
+            "format": "mxnet_tpu.transformer.checkpoint/1",
+            "config": _cfg_to_json(cfg),
+            "step": int(step),
+            "has_momentum": momentum is not None,
+            "arrays_file": arrays_file,
+            # npz round-trips only native numpy dtypes; ml_dtypes
+            # arrays (bfloat16, float8_*) come back as raw void
+            # records, so the true dtype of every entry is recorded
+            # here and viewed back on load
+            "dtypes": {k: np.dtype(v.dtype).name
+                       for k, v in host.items()},
+            "arrays": sorted(host),
+            "metadata": metadata or {},
+        }
+        # serialize BEFORE touching the directory: a non-JSON metadata
+        # value must fail before any file is written
+        manifest_text = json.dumps(manifest, indent=1, sort_keys=True)
+        tmp = os.path.join(path, "." + arrays_file + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **host)
+        os.replace(tmp, os.path.join(path, arrays_file))
+        tmp = os.path.join(path, ".manifest.json.tmp")
+        with open(tmp, "w") as f:
+            f.write(manifest_text)
+        os.replace(tmp, os.path.join(path, "manifest.json"))  # commit
+        for stale in os.listdir(path):
+            if (stale.startswith("arrays") and stale != arrays_file
+                    and not stale.startswith(".")):
+                try:
+                    os.remove(os.path.join(path, stale))
+                except OSError:
+                    pass
+    if jax.process_count() > 1:
+        # completion barrier: no process may proceed (verify, prune old
+        # checkpoints, exit) until the writer has committed
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            "mxnet_tpu.checkpoint.save:" + path)
     return path
 
 
@@ -202,7 +224,8 @@ def load_checkpoint(path, mesh=None):
 
     import jax.numpy as jnp
     dtypes = manifest.get("dtypes", {})
-    with np.load(os.path.join(path, "arrays.npz")) as npz:
+    arrays_file = manifest.get("arrays_file", "arrays.npz")
+    with np.load(os.path.join(path, arrays_file)) as npz:
         flat = {}
         for k in npz.files:
             arr = npz[k]

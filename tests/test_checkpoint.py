@@ -156,6 +156,24 @@ def test_resume_rejects_int8_serving_checkpoint(tmp_path):
         restore_train_state(str(tmp_path / "q8"), mesh)
 
 
+def test_overwrite_commits_atomically_and_sweeps_stale(tmp_path):
+    """Re-saving into the same directory: the manifest replace is the
+    commit point, the loader follows manifest['arrays_file'], and the
+    previous save's data file is swept after commit."""
+    import os
+    cfg = _cfg()
+    save_checkpoint(str(tmp_path / "ck"), cfg,
+                    T.init_params(cfg, seed=0), step=1)
+    p2 = T.init_params(cfg, seed=6)
+    save_checkpoint(str(tmp_path / "ck"), cfg, p2, step=2)
+    _, loaded, _, step, _ = load_checkpoint(str(tmp_path / "ck"))
+    assert step == 2
+    _tree_equal(p2, loaded)
+    data_files = [f for f in os.listdir(str(tmp_path / "ck"))
+                  if f.startswith("arrays")]
+    assert len(data_files) == 1
+
+
 def test_load_rejects_non_checkpoint(tmp_path):
     import json, os, pytest
     os.makedirs(str(tmp_path / "bad"), exist_ok=True)
