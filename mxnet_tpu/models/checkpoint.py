@@ -13,10 +13,12 @@ weight).
 
 Design (gather-to-host):
 
-* **save** gathers every leaf to host memory and writes ONE
-  `arrays.npz` plus a `manifest.json` (config, step, user metadata).
-  On a multi-controller run, non-addressable leaves are allgathered
-  first and only process 0 writes — one checkpoint, not N partials.
+* **save** gathers every leaf to host memory and writes ONE data file
+  (`arrays-<step>-<id>.npz`) plus a `manifest.json` (config, step,
+  user metadata, the data file's name) whose atomic replace is the
+  commit point. On a multi-controller run, non-addressable leaves are
+  allgathered first and only process 0 writes — one checkpoint, not N
+  partials — with a completion barrier before anyone proceeds.
 * **restore** rebuilds the pytree on host and, given a mesh, lays it
   back out via `shard_params` — PartitionSpecs name mesh AXES, not
   sizes, so the restoring mesh may be factored differently from the
@@ -135,7 +137,8 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
                     metadata=None):
     """Write a training (or serving) checkpoint directory.
 
-    path      directory (created); holds manifest.json + arrays.npz
+    path      directory (created); holds manifest.json + the data file
+              it references (arrays-<step>-<id>.npz)
     cfg       the TransformerConfig the params were built with — stored
               so a restore needs nothing but the path
     params    param pytree: fp leaves, int8-quantized leaves, or a mix;
@@ -153,56 +156,70 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
     host = {k: _gather_to_host(v) for k, v in flat.items()}
 
     import jax
-    if jax.process_index() == 0:
-        os.makedirs(path, exist_ok=True)
-        # the data file gets a unique name and the manifest points at
-        # it: a crash at ANY point leaves the previous manifest (and
-        # the previous data file it references) fully intact — the
-        # manifest os.replace is the single commit point. Orphaned
-        # data files from crashed saves are swept after a successful
-        # commit.
-        arrays_file = "arrays-%d-%s.npz" % (
-            int(step), os.urandom(4).hex())
-        manifest = {
-            "format": "mxnet_tpu.transformer.checkpoint/1",
-            "config": _cfg_to_json(cfg),
-            "step": int(step),
-            "has_momentum": momentum is not None,
-            "arrays_file": arrays_file,
-            # npz round-trips only native numpy dtypes; ml_dtypes
-            # arrays (bfloat16, float8_*) come back as raw void
-            # records, so the true dtype of every entry is recorded
-            # here and viewed back on load
-            "dtypes": {k: np.dtype(v.dtype).name
-                       for k, v in host.items()},
-            "arrays": sorted(host),
-            "metadata": metadata or {},
-        }
-        # serialize BEFORE touching the directory: a non-JSON metadata
-        # value must fail before any file is written
-        manifest_text = json.dumps(manifest, indent=1, sort_keys=True)
-        tmp = os.path.join(path, "." + arrays_file + ".tmp")
-        with open(tmp, "wb") as f:
-            np.savez(f, **host)
-        os.replace(tmp, os.path.join(path, arrays_file))
-        tmp = os.path.join(path, ".manifest.json.tmp")
-        with open(tmp, "w") as f:
-            f.write(manifest_text)
-        os.replace(tmp, os.path.join(path, "manifest.json"))  # commit
-        for stale in os.listdir(path):
-            if (stale.startswith("arrays") and stale != arrays_file
-                    and not stale.startswith(".")):
-                try:
-                    os.remove(os.path.join(path, stale))
-                except OSError:
-                    pass
+    write_error = None
+    try:
+        if jax.process_index() == 0:
+            _write_commit_sweep(path, cfg, host, momentum is not None,
+                                step, metadata)
+    except Exception as e:          # noqa: BLE001 — re-raised below
+        # the barrier must still be reached: a proc-0 failure that
+        # skipped it would leave every other process blocked in the
+        # collective instead of seeing the real error
+        write_error = e
     if jax.process_count() > 1:
         # completion barrier: no process may proceed (verify, prune old
-        # checkpoints, exit) until the writer has committed
+        # checkpoints, exit) until the writer has committed or failed
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(
             "mxnet_tpu.checkpoint.save:" + path)
+    if write_error is not None:
+        raise write_error
     return path
+
+
+def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata):
+    """Process-0 write path. The data file gets a unique name and the
+    manifest points at it: a crash at ANY point leaves the previous
+    manifest (and the previous data file it references) fully intact —
+    the manifest os.replace is the single commit point. Leftovers from
+    crashed saves (older committed data files, orphaned .tmp files) are
+    swept after a successful commit."""
+    os.makedirs(path, exist_ok=True)
+    arrays_file = "arrays-%d-%s.npz" % (int(step), os.urandom(4).hex())
+    manifest = {
+        "format": "mxnet_tpu.transformer.checkpoint/1",
+        "config": _cfg_to_json(cfg),
+        "step": int(step),
+        "has_momentum": has_momentum,
+        "arrays_file": arrays_file,
+        # npz round-trips only native numpy dtypes; ml_dtypes arrays
+        # (bfloat16, float8_*) come back as raw void records, so the
+        # true dtype of every entry is recorded here and viewed back
+        # on load
+        "dtypes": {k: np.dtype(v.dtype).name for k, v in host.items()},
+        "arrays": sorted(host),
+        "metadata": metadata or {},
+    }
+    # serialize BEFORE touching the directory: a non-JSON metadata
+    # value must fail before any file is written
+    manifest_text = json.dumps(manifest, indent=1, sort_keys=True)
+    tmp = os.path.join(path, "." + arrays_file + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **host)
+    os.replace(tmp, os.path.join(path, arrays_file))
+    tmp = os.path.join(path, ".manifest.json.tmp")
+    with open(tmp, "w") as f:
+        f.write(manifest_text)
+    os.replace(tmp, os.path.join(path, "manifest.json"))  # commit
+    for stale in os.listdir(path):
+        committed_stale = (stale.startswith("arrays")
+                           and stale != arrays_file)
+        orphaned_tmp = stale.startswith(".") and stale.endswith(".tmp")
+        if committed_stale or orphaned_tmp:
+            try:
+                os.remove(os.path.join(path, stale))
+            except OSError:
+                pass
 
 
 def load_checkpoint(path, mesh=None):

@@ -72,7 +72,14 @@ def _jitted_ragged_step(cfg, greedy, temperature, top_k, top_p):
 
 
 def _jitted_slot_write(cfg):
-    """Write a 1-row prefilled cache into slot `i` of the pool cache."""
+    """Write a 1-row prefilled cache into slot `i` of the pool cache.
+
+    The copy is deliberately FULL-ROW ([1, max_len] per layer, not the
+    prompt's bucket width): it clears the previous occupant's K/V
+    beyond the bucket, which is load-bearing for slot reuse — any
+    future narrowing to bucket width must add an explicit tail-clear
+    or retired requests' cache lines become attendable again once the
+    new request decodes past its own prompt."""
     return tf._serving_jit("slot_write", cfg, lambda fz: jax.jit(
         lambda full, row, i: jax.tree.map(
             lambda f, r: jax.lax.dynamic_update_slice_in_dim(
@@ -229,6 +236,19 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 self._free(i)
         return finished
+
+    def cancel(self, rid):
+        """Evict a request mid-decode (client disconnect, timeout):
+        frees its slot immediately for the next admission. Returns the
+        tokens emitted so far, or None when `rid` is not active (never
+        admitted, finished, or already canceled). The other lanes'
+        streams are untouched — eviction only parks the slot."""
+        for i, req in enumerate(self._slots):
+            if req is not None and req.rid == rid:
+                out = list(req.tokens)
+                self._free(i)
+                return out
+        return None
 
     def _free(self, i):
         """Free slot i. Idle lanes keep decoding (static batch shape);

@@ -524,7 +524,11 @@ _PREFILL_JIT_LIMIT = 32
 
 def _serving_jit(kind, cfg, build):
     import dataclasses
-    key = (kind,) + dataclasses.astuple(cfg)
+    # the backend is part of the key: builders bake backend-dependent
+    # choices (e.g. _serving_donate's donation tuple) into the wrapper,
+    # so a process that pins a different backend after warming must not
+    # reuse a stale wrapper
+    key = (kind, jax.default_backend()) + dataclasses.astuple(cfg)
     fn = _PREFILL_JIT_CACHE.pop(key, None)
     if fn is None:
         frozen = dataclasses.replace(cfg)   # defensive copy: later

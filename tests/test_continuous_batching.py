@@ -221,3 +221,122 @@ def test_admit_validation():
         srv.admit([1, 2], 0)
     with pytest.raises(ValueError):
         srv.admit(list(range(1, 60)), 30)    # exceeds max_len
+
+
+def test_cancel_mid_decode_frees_slot_without_perturbing_others():
+    """Evict one request mid-decode: its slot frees for the next
+    admission and the surviving lane's stream stays exactly
+    generate()'s."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=21)
+    rng = np.random.RandomState(7)
+    p1, p2, p3 = _prompts(rng, 3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    r1 = srv.admit(p1, 12)
+    r2 = srv.admit(p2, 12)
+    assert not srv.has_capacity
+    done = {}
+    done.update(srv.step())
+    done.update(srv.step())             # both two tokens into decode
+    partial = srv.cancel(r1)            # evict mid-decode
+    assert partial is not None and len(partial) == len(p1) + 3
+    assert srv.cancel(r1) is None       # double-cancel is a no-op
+    assert srv.has_capacity
+    r3 = srv.admit(p3, 5)               # reuses the evicted slot
+    assert r3 is not None
+    while r2 not in done or r3 not in done:
+        done.update(srv.step())
+    for rid, prompt, n in ((r2, p2, 12), (r3, p3, 5)):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(np.asarray(done[rid]),
+                                      np.asarray(want[0]))
+    # the canceled request's emitted prefix matches its solo run too
+    want1 = tf.generate(params, jnp.asarray([p1], jnp.int32), 12, cfg)
+    np.testing.assert_array_equal(np.asarray(partial),
+                                  np.asarray(want1[0][:len(partial)]))
+
+
+def test_ragged_lengths_at_bucket_boundaries():
+    """Prompt lengths straddling every bucket edge (7/8/9, 15/16/17,
+    31/32/33) served together in one pool — each must match its solo
+    generate() despite hitting different compiled prefill widths."""
+    cfg = _cfg(max_len=64)
+    params = tf.init_params(cfg, seed=23)
+    rng = np.random.RandomState(8)
+    lens = [7, 8, 9, 15, 16, 17, 31, 32, 33]
+    jobs = [(list(rng.randint(1, 211, L)), 4) for L in lens]
+    srv = ContinuousBatcher(params, cfg, max_batch=4)
+    results, order = srv.run(jobs)
+    assert len(results) == len(jobs)
+    for rid, (prompt, n) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(results[rid]), np.asarray(want[0]),
+            err_msg="prompt len %d" % len(prompt))
+
+
+def test_decode_to_max_len_boundary():
+    """A request sized to land its final token exactly at max_len
+    (t_p + n_new == max_len) next to a short request — the cache's
+    last position is written, never overrun."""
+    cfg = _cfg(max_len=32)
+    params = tf.init_params(cfg, seed=25)
+    rng = np.random.RandomState(9)
+    long_p = list(rng.randint(1, 211, 20))
+    short_p = list(rng.randint(1, 211, 4))
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    results, order = srv.run([(long_p, 12), (short_p, 3)])
+    for rid, (prompt, n) in zip(order, [(long_p, 12), (short_p, 3)]):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(np.asarray(results[rid]),
+                                      np.asarray(want[0]))
+
+
+def test_churn_fuzz_admit_cancel_step():
+    """Randomized churn: interleaved admits, cancels, and steps over a
+    seeded schedule. Every COMPLETED stream must equal its solo
+    generate() run; every canceled stream must be a prefix of its solo
+    run; the pool must end drained."""
+    cfg = _cfg(max_len=48)
+    params = tf.init_params(cfg, seed=27)
+    rng = np.random.RandomState(10)
+    srv = ContinuousBatcher(params, cfg, max_batch=3)
+    spec = {}              # rid -> (prompt, n_new)
+    done, canceled = {}, {}
+    pending = [(list(rng.randint(1, 211, rng.randint(3, 20))),
+                int(rng.randint(1, 12))) for _ in range(12)]
+    live = []
+    while pending or live:
+        action = rng.randint(0, 4)
+        if action == 0 and pending and srv.has_capacity:
+            prompt, n = pending.pop()
+            rid = srv.admit(prompt, n)
+            assert rid is not None
+            spec[rid] = (prompt, n)
+            live.append(rid)
+        elif action == 1 and live and rng.rand() < 0.3:
+            rid = live[rng.randint(len(live))]
+            out = srv.cancel(rid)
+            assert out is not None
+            canceled[rid] = out
+            live.remove(rid)
+        else:
+            finished = srv.step()
+            for rid in finished:
+                done[rid] = finished[rid]
+                live.remove(rid)
+    assert srv.active_count == 0
+    assert set(done) | set(canceled) == set(spec)
+    for rid, (prompt, n) in spec.items():
+        want = np.asarray(tf.generate(
+            params, jnp.asarray([prompt], jnp.int32), n, cfg)[0])
+        if rid in done:
+            np.testing.assert_array_equal(np.asarray(done[rid]), want,
+                                          err_msg="rid %d" % rid)
+        else:
+            got = np.asarray(canceled[rid])
+            np.testing.assert_array_equal(got, want[:len(got)],
+                                          err_msg="rid %d" % rid)
