@@ -11,7 +11,7 @@ static_alloc/static_shape fast path, with XLA doing memory planning and
 fusion instead of MXPlanMemory/bulking.
 """
 
-import copy
+import contextlib
 import re
 import threading
 
@@ -26,108 +26,117 @@ from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
-_naming = threading.local()
+
+class _NamingState(threading.local):
+    """Per-thread naming state: a stack of open ``name_scope`` frames
+    plus the top-level hint counters.
+
+    The auto-prefix CONTRACT is fixed by checkpoint parity with the
+    reference (gluon/block.py _BlockScope): a block constructed with no
+    explicit prefix is named ``<hint><index>_`` where the index counts
+    hint uses within the enclosing scope (or within the thread, at top
+    level), and children concatenate onto their parent's prefix. The
+    mechanism here is this repo's own: one thread-local frame stack
+    instead of a scope class threading save/restore pointers through
+    static state.
+    """
+
+    def __init__(self):
+        self.frames = []            # innermost-open-scope last
+        self.top_counts = {}        # hint -> next index, outside scopes
+
+    def sequence_number(self, hint):
+        """Next per-hint index at the current nesting level."""
+        counts = self.frames[-1].counts if self.frames \
+            else self.top_counts
+        idx = counts.get(hint, 0)
+        counts[hint] = idx + 1
+        return idx
+
+    def owner(self):
+        """The block whose ``name_scope`` is innermost, or None."""
+        return self.frames[-1].block if self.frames else None
 
 
-def _global_counter():
-    if not hasattr(_naming, "counter"):
-        _naming.counter = {}
-    return _naming.counter
+_NAMING = _NamingState()
 
 
-class _BlockScope(object):
-    """Name-manager scope for nested Blocks (gluon/block.py:35)."""
+class _Frame(object):
+    """One block's naming frame: its per-hint child counters. Pushed on
+    the thread's frame stack for the duration of ``name_scope``."""
 
-    _current = threading.local()
+    __slots__ = ("block", "counts")
 
     def __init__(self, block):
-        self._block = block
-        self._counter = {}
-        self._old_scopes = []       # stack: restore targets per entry
-        self._name_managers = []    # stack: one fresh Prefix per entry
+        self.block = block
+        self.counts = {}
 
-    @staticmethod
-    def create(prefix, params, hint):
-        """Creates prefix and params for new `Block`."""
-        current = getattr(_BlockScope._current, "value", None)
-        if current is None:
-            if prefix is None:
-                counter = _global_counter()
-                count = counter.get(hint, 0)
-                counter[hint] = count + 1
-                prefix = "%s%d_" % (hint, count)
-            if params is None:
-                params = ParameterDict(prefix)
-            else:
-                params = ParameterDict(params.prefix, params)
-            return prefix, params
 
-        if prefix is None:
-            count = current._counter.get(hint, 0)
-            current._counter[hint] = count + 1
-            prefix = "%s%d_" % (hint, count)
-        if params is None:
-            parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, parent._shared)
-        else:
-            params = ParameterDict(params.prefix, params)
-        return current._block.prefix + prefix, params
-
-    def __enter__(self):
-        if self._block._empty_prefix:
-            return self
-        self._old_scopes.append(getattr(_BlockScope._current, "value", None))
-        _BlockScope._current.value = self
-        # ops composed inside this scope — including explicitly-named ones
-        # like the layer-internal name='fwd' — get the block prefix, so
-        # node names stay unique across sibling blocks (the reference
-        # enters _name.Prefix(block.prefix) the same way). A fresh Prefix
-        # per entry keeps nested/concurrent entries reentrant: NameManager
-        # stores its restore pointer on the instance.
-        manager = _name.Prefix(self._block.prefix)
-        manager.__enter__()
-        self._name_managers.append(manager)
-        return self
-
-    def __exit__(self, ptype, value, trace):
-        if self._block._empty_prefix:
-            return
-        self._name_managers.pop().__exit__(ptype, value, trace)
-        _BlockScope._current.value = self._old_scopes.pop()
+def _derive_identity(prefix, params, hint):
+    """Resolve a new Block's (full_prefix, ParameterDict) from the
+    enclosing ``name_scope``, its constructor arguments, and the
+    auto-naming contract (see _NamingState)."""
+    # identity checks throughout: container blocks define __len__, so
+    # an empty Sequential is falsy yet very much an owner
+    owner = _NAMING.owner()
+    if prefix is None:
+        prefix = "%s%d_" % (hint, _NAMING.sequence_number(hint))
+    full_prefix = prefix if owner is None else owner.prefix + prefix
+    if params is not None:
+        # explicit sharing: reuse the donor dict's names verbatim
+        pdict = ParameterDict(params.prefix, params)
+    elif owner is not None:
+        # child dict: named under the parent, sharing the parent's pool
+        parent = owner.params
+        pdict = ParameterDict(parent.prefix + prefix, parent._shared)
+    else:
+        pdict = ParameterDict(full_prefix)
+    return full_prefix, pdict
 
 
 def _flatten(args, fmt_name):
-    """Flatten nested list/tuple structure of NDArrays/Symbols; returns
-    (flat_list, format_tree) (gluon/block.py:53)."""
-    if isinstance(args, (nd.NDArray, _symbol.Symbol)):
-        return [args], int(0)
-    if args is None:
-        return [None], int(-1)
-    if not isinstance(args, (list, tuple)):
+    """Flatten a nested list/tuple structure of NDArrays/Symbols into a
+    flat list plus a structure spec (0 = one array, -1 = a None slot,
+    list = nesting) that ``_regroup`` inverts (the reference's
+    _flatten/_regroup contract, gluon/block.py:53)."""
+    flat = []
+
+    def walk(node):
+        if isinstance(node, (nd.NDArray, _symbol.Symbol)):
+            flat.append(node)
+            return 0
+        if node is None:
+            flat.append(None)
+            return -1
+        if isinstance(node, (list, tuple)):
+            return [walk(item) for item in node]
         raise ValueError(
-            "When hybridized, the input of HybridBlock {} must be (nested) "
-            "list of Symbol or NDArray, but got {} of type {}"
-            .format(fmt_name, str(args), str(type(args))))
-    flat, fmts = [], []
-    for i in args:
-        arg, fmt = _flatten(i, fmt_name)
-        flat += arg
-        fmts.append(fmt)
-    return flat, fmts
+            "When hybridized, the input of HybridBlock %s must be "
+            "(nested) list of Symbol or NDArray, but got %s of type %s"
+            % (fmt_name, node, type(node)))
+
+    spec = walk(args)
+    return flat, spec
 
 
 def _regroup(args, fmt):
-    if isinstance(fmt, int):
-        if fmt == -1:
-            return None, args[1:]
-        if fmt == 0:
-            return args[0], args[1:]
-        return args[:fmt], args[fmt:]
-    ret = []
-    for i in fmt:
-        res, args = _regroup(args, i)
-        ret.append(res)
-    return ret, args
+    """Rebuild the nested structure described by ``fmt`` from the flat
+    ``args`` list; returns (structure, leftover_args)."""
+    def take(spec, pos):
+        if spec == -1:
+            return None, pos + 1
+        if spec == 0:
+            return args[pos], pos + 1
+        if isinstance(spec, int):
+            return args[pos:pos + spec], pos + spec
+        out = []
+        for sub in spec:
+            item, pos = take(sub, pos)
+            out.append(item)
+        return out, pos
+
+    structure, used = take(fmt, 0)
+    return structure, args[used:]
 
 
 class Block(object):
@@ -140,43 +149,43 @@ class Block(object):
 
     def __init__(self, prefix=None, params=None):
         self._empty_prefix = prefix == ""
-        self._prefix, self._params = _BlockScope.create(
+        self._prefix, self._params = _derive_identity(
             prefix, params, self._alias())
         self._name = self._prefix[:-1] if self._prefix.endswith("_") \
             else self._prefix
-        self._scope = _BlockScope(self)
+        self._frame = _Frame(self)
         self._children = {}
         self._reg_params = {}
         self._forward_hooks = []
         self._forward_pre_hooks = []
 
     def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(
-            "  ({key}): {block}".format(
-                key=key, block=re.sub("\n", "\n  ", repr(block)))
-            for key, block in self.__dict__.items()
-            if isinstance(block, Block))
-        return s.format(name=self.__class__.__name__, modstr=modstr)
+        children = [(attr, val) for attr, val in self.__dict__.items()
+                    if isinstance(val, Block)]
+        body = "\n".join("  (%s): %s" % (attr, repr(val).replace(
+            "\n", "\n  ")) for attr, val in children)
+        return "%s(\n%s\n)" % (type(self).__name__, body)
 
     def __setattr__(self, name, value):
-        if hasattr(self, name):
-            existing = getattr(self, name)
-            if isinstance(existing, (Parameter, Block)) and \
-                    not isinstance(value, type(existing)) and \
-                    not isinstance(existing, type(value)):
+        prev = getattr(self, name, None)
+        if isinstance(prev, (Parameter, Block)):
+            # re-binding a registered attribute must keep its kind:
+            # related types are fine (subclass either way), a kind
+            # switch is a user error
+            related = isinstance(value, type(prev)) \
+                or isinstance(prev, type(value))
+            if not related:
                 raise TypeError(
-                    "Changing attribute type for {name} from {type1} to "
-                    "{type2} is not allowed.".format(
-                        name=name, type1=type(existing), type2=type(value)))
+                    "Changing attribute type for %s from %s to %s is not "
+                    "allowed." % (name, type(prev), type(value)))
         if isinstance(value, Block):
             self.register_child(value, name)
         elif isinstance(value, Parameter):
-            assert name not in self._reg_params or \
-                self._reg_params[name] is value, \
+            taken = self._reg_params.get(name)
+            assert taken is None or taken is value, \
                 "Overriding Parameter attribute %s is not allowed. " \
-                "If you want to share parameters between blocks, please set " \
-                "'params' at Block construction instead." % name
+                "If you want to share parameters between blocks, please " \
+                "set 'params' at Block construction instead." % name
             self._reg_params[name] = value
         super(Block, self).__setattr__(name, value)
 
@@ -192,10 +201,23 @@ class Block(object):
     def name(self):
         return self._name
 
+    @contextlib.contextmanager
     def name_scope(self):
-        """Returns a name space object managing a child Block and parameter
-        names."""
-        return self._scope
+        """Context manager under which children and symbols are named
+        as descendants of this block. Each entry pushes this block's
+        naming frame (child indices persist across re-entries, so
+        ``with net.name_scope()`` twice keeps counting where it left
+        off) and routes op naming through a ``Prefix`` manager; an
+        empty-prefix block scopes nothing."""
+        if self._empty_prefix:
+            yield
+            return
+        _NAMING.frames.append(self._frame)
+        try:
+            with _name.Prefix(self._prefix):
+                yield
+        finally:
+            _NAMING.frames.pop()
 
     @property
     def params(self):
@@ -203,33 +225,42 @@ class Block(object):
         children's parameters)."""
         return self._params
 
+    def _subtree(self):
+        """Pre-order iterator over this block and every descendant."""
+        yield self
+        for child in self._children.values():
+            yield from child._subtree()
+
     def collect_params(self, select=None):
         """Returns a ParameterDict containing this Block's and all of its
         children's Parameters, optionally filtered by regex ``select``."""
+        keep = re.compile(select).match if select else None
         ret = ParameterDict(self._params.prefix)
-        if not select:
-            ret.update(self.params)
-        else:
-            pattern = re.compile(select)
-            ret.update({name: value for name, value in self.params.items()
-                        if pattern.match(name)})
-        for cld in self._children.values():
-            ret.update(cld.collect_params(select=select))
+        for blk in self._subtree():
+            chosen = blk.params.items() if keep is None else \
+                ((n, p) for n, p in blk.params.items() if keep(n))
+            ret.update(dict(chosen))
         return ret
 
     def _collect_params_with_prefix(self, prefix=""):
-        if prefix:
-            prefix += "."
-        ret = {prefix + key: val for key, val in self._reg_params.items()}
-        for name, child in self._children.items():
-            ret.update(child._collect_params_with_prefix(prefix + name))
-        return ret
+        """{structural dotted path: Parameter} over the subtree — the
+        naming scheme save_parameters/load_parameters share."""
+        found = {}
+        todo = [(prefix, self)]
+        while todo:
+            path, blk = todo.pop()
+            dot = path + "." if path else ""
+            found.update((dot + key, val)
+                         for key, val in blk._reg_params.items())
+            todo.extend(reversed([(dot + name, child)
+                                  for name, child in
+                                  blk._children.items()]))
+        return found
 
     # ---------------------------------------------------------- children --
     def register_child(self, block, name=None):
-        if name is None:
-            name = str(len(self._children))
-        self._children[name] = block
+        key = str(len(self._children)) if name is None else name
+        self._children[key] = block
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
@@ -240,7 +271,8 @@ class Block(object):
         return hook
 
     def apply(self, fn):
-        """Applies ``fn`` recursively to every child block as well as self."""
+        """Applies ``fn`` to every block in the subtree, children before
+        parents (post-order)."""
         for cld in self._children.values():
             cld.apply(fn)
         fn(self)
@@ -250,10 +282,11 @@ class Block(object):
     def save_parameters(self, filename, deduplicate=False):
         """Saves parameters to file using structural naming
         (gluon/block.py:319)."""
-        params = self._collect_params_with_prefix()
-        arg_dict = {key: val._reduce() if hasattr(val, "_reduce")
-                    else val.data() for key, val in params.items()}
-        nd.save(filename, arg_dict)
+        def fetch(param):
+            reduce_fn = getattr(param, "_reduce", None)
+            return reduce_fn() if reduce_fn is not None else param.data()
+        nd.save(filename, {key: fetch(val) for key, val in
+                           self._collect_params_with_prefix().items()})
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
@@ -265,25 +298,26 @@ class Block(object):
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
-        if not any("." in k for k in loaded.keys()):
-            # contains full parameter names — legacy collect_params().save
-            del loaded
+        structural = any("." in key for key in loaded)
+        if not structural:
+            # full parameter names — a legacy collect_params().save file
             self.collect_params().load(
                 filename, ctx, allow_missing, ignore_extra, self.prefix,
                 cast_dtype=cast_dtype, dtype_source=dtype_source)
             return
-        if not allow_missing:
-            for name in params.keys():
-                assert name in loaded, \
-                    "Parameter '%s' is missing in file '%s'" % (name, filename)
-        for name in loaded:
-            if name not in params:
+        missing = [n for n in params if n not in loaded]
+        assert allow_missing or not missing, \
+            "Parameter '%s' is missing in file '%s'" % \
+            (missing[0] if missing else "", filename)
+        for name, value in loaded.items():
+            target = params.get(name)
+            if target is None:
                 assert ignore_extra, \
-                    "Parameter '%s' loaded from file '%s' is not present in " \
-                    "this block" % (name, filename)
+                    "Parameter '%s' loaded from file '%s' is not present " \
+                    "in this block" % (name, filename)
                 continue
-            params[name]._load_init(loaded[name], ctx, cast_dtype=cast_dtype,
-                                    dtype_source=dtype_source)
+            target._load_init(value, ctx, cast_dtype=cast_dtype,
+                              dtype_source=dtype_source)
 
     save_params = save_parameters
     load_params = load_parameters
@@ -300,9 +334,11 @@ class Block(object):
             cld.hybridize(active, **kwargs)
 
     def cast(self, dtype):
+        """Cast every parameter in the subtree (post-order, matching
+        apply())."""
         for child in self._children.values():
             child.cast(dtype)
-        for _, param in self.params.items():
+        for param in self.params.values():
             param.cast(dtype)
 
     # ------------------------------------------------------------- call --
@@ -375,11 +411,9 @@ class HybridBlock(Block):
 
     def __init__(self, prefix=None, params=None):
         super(HybridBlock, self).__init__(prefix=prefix, params=params)
-        self._cached_graph = ()
-        self._cached_op = None
-        self._cached_op_args = []
         self._active = False
         self._flags = []
+        self._clear_cached_op()
 
     def __setattr__(self, name, value):
         super(HybridBlock, self).__setattr__(name, value)
@@ -408,7 +442,7 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._cached_graph = ()
         self._cached_op = None
-        self._cached_op_args = []
+        self._cached_op_args = []   # (is_data, slot-or-Parameter) pairs
 
     # ------------------------------------------------------------ trace --
     def _get_graph(self, *args):
@@ -477,76 +511,73 @@ class HybridBlock(Block):
     # ------------------------------------------------------------ cache --
     def _build_cache(self, *args):
         inputs, out = self._get_graph(*args)
-        input_names = out.list_inputs()
-        params = {p.name: p for p in self.collect_params().values()}
-        param_names = set(params.keys())
-        expected_names = set(input_names)
-        for name in expected_names:
-            assert name in param_names or name in [i.name for i in inputs], \
-                "Unknown input to HybridBlock: %s" % name
-
-        data_names = {i.name: idx for idx, i in enumerate(inputs)}
-        self._cached_op_args = []
-        for name in input_names:
-            if name in data_names:
-                self._cached_op_args.append((True, data_names[name]))
+        by_name = {p.name: p for p in self.collect_params().values()}
+        slot_of = {sym.name: idx for idx, sym in enumerate(inputs)}
+        plan = []
+        for name in out.list_inputs():
+            if name in slot_of:
+                plan.append((True, slot_of[name]))
+            elif name in by_name:
+                plan.append((False, by_name[name]))
             else:
-                self._cached_op_args.append((False, params[name]))
+                raise AssertionError(
+                    "Unknown input to HybridBlock: %s" % name)
+        self._cached_op_args = plan
         self._cached_op = CachedOp(out, self._flags)
 
     def _call_cached_op(self, *args):
         if self._cached_op is None:
             self._build_cache(*args)
-        flat_args, fmt = _flatten(args, "input")
-        real = [a for a in flat_args if a is not None]
+        real = [a for a in _flatten(args, "input")[0] if a is not None]
         # arg structure changed since the trace (e.g. an RNN layer called
         # with and without explicit begin_state) -> retrace
         n_traced = sum(1 for is_data, _ in self._cached_op_args if is_data)
         if n_traced != len(real):
             self._clear_cached_op()
             self._build_cache(*args)
-        cargs = []
-        for is_data, data in self._cached_op_args:
-            if is_data:
-                cargs.append(real[data])
-            else:
-                cargs.append(data.data())
-        out = self._cached_op(*cargs)
+        out = self._cached_op(*[
+            real[slot] if is_data else slot.data()
+            for is_data, slot in self._cached_op_args])
         if len(out) == 1 and self._out_format == 0:
             return out[0]
-        ret, _ = _regroup(list(out), self._out_format)
-        return ret
+        return _regroup(list(out), self._out_format)[0]
 
     # ---------------------------------------------------------- forward --
+    def _materialize_params(self, x, *args):
+        """Live param arrays for hybrid_forward; on a deferred init,
+        infer shapes from the inputs, finish initialization, retry."""
+        try:
+            return {name: p.data()
+                    for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, *args)
+            for p in self.collect_params().values():
+                p._finish_deferred_init()
+            return {name: p.data()
+                    for name, p in self._reg_params.items()}
+
     def forward(self, x, *args):
         """Defines the forward computation; dispatches to
         ``hybrid_forward`` with F=ndarray or F=symbol."""
-        if isinstance(x, nd.NDArray):
-            if self._active:
-                try:
-                    return self._call_cached_op(x, *args)
-                except DeferredInitializationError:
-                    self._deferred_infer_shape(x, *args)
-                    for p in self.collect_params().values():
-                        p._finish_deferred_init()
-                    return self._call_cached_op(x, *args)
+        if isinstance(x, _symbol.Symbol):
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            with self.name_scope():
+                return self.hybrid_forward(_symbol, x, *args, **params)
+        if not isinstance(x, nd.NDArray):
+            raise AssertionError(
+                "HybridBlock requires the first argument to forward be "
+                "either Symbol or NDArray, but got %s" % type(x))
+        if self._active:
             try:
-                params = {name: p.data()
-                          for name, p in self._reg_params.items()}
+                return self._call_cached_op(x, *args)
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
                 for p in self.collect_params().values():
                     p._finish_deferred_init()
-                params = {name: p.data()
-                          for name, p in self._reg_params.items()}
-            return self.hybrid_forward(nd, x, *args, **params)
-
-        assert isinstance(x, _symbol.Symbol), \
-            "HybridBlock requires the first argument to forward be either " \
-            "Symbol or NDArray, but got %s" % type(x)
-        params = {name: p.var() for name, p in self._reg_params.items()}
-        with self.name_scope():
-            return self.hybrid_forward(_symbol, x, *args, **params)
+                return self._call_cached_op(x, *args)
+        params = self._materialize_params(x, *args)
+        return self.hybrid_forward(nd, x, *args, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         """Overridden by users: computation over ``F`` (mx.nd or mx.sym)."""
@@ -581,16 +612,16 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        sym = _symbol.load(symbol_file)
-        if isinstance(input_names, str):
-            input_names = [input_names]
-        inputs = [_symbol.var(i) for i in input_names]
-        ret = SymbolBlock(sym, inputs)
+        names = [input_names] if isinstance(input_names, str) \
+            else input_names
+        block = SymbolBlock(_symbol.load(symbol_file),
+                            [_symbol.var(n) for n in names])
         if param_file is not None:
-            ret.collect_params().load(param_file, ctx=ctx, cast_dtype=True,
-                                      dtype_source="saved",
-                                      allow_missing=False, ignore_extra=False)
-        return ret
+            block.collect_params().load(
+                param_file, ctx=ctx, cast_dtype=True,
+                dtype_source="saved", allow_missing=False,
+                ignore_extra=False)
+        return block
 
     def __init__(self, outputs, inputs, params=None):
         super(SymbolBlock, self).__init__(prefix=None, params=None)
@@ -622,15 +653,11 @@ class SymbolBlock(HybridBlock):
 
     def _build_cache_from_graph(self):
         inputs, out = self._cached_graph
-        input_names = out.list_inputs()
-        params = {p.name: p for p in self._params.values()}
-        data_names = {i.name: idx for idx, i in enumerate(inputs)}
-        self._cached_op_args = []
-        for name in input_names:
-            if name in data_names:
-                self._cached_op_args.append((True, data_names[name]))
-            else:
-                self._cached_op_args.append((False, params[name]))
+        by_name = {p.name: p for p in self._params.values()}
+        slot_of = {sym.name: idx for idx, sym in enumerate(inputs)}
+        self._cached_op_args = [
+            (True, slot_of[name]) if name in slot_of
+            else (False, by_name[name]) for name in out.list_inputs()]
         self._cached_op = CachedOp(out, self._flags)
         self._out_format = _flatten(
             [out] if len(out.list_outputs()) == 1 else
@@ -639,31 +666,25 @@ class SymbolBlock(HybridBlock):
             self._out_format = 0
 
     def forward(self, x, *args):
-        if isinstance(x, nd.NDArray):
-            try:
-                return self._call_cached_op(x, *args)
-            except DeferredInitializationError:
-                self._deferred_infer_shape(x, *args)
-                for p in self._params.values():
-                    p._finish_deferred_init()
-                return self._call_cached_op(x, *args)
-        assert isinstance(x, _symbol.Symbol), \
-            "SymbolBlock requires Symbol or NDArray input"
-        return self._cached_graph[1]
+        if isinstance(x, _symbol.Symbol):
+            return self._cached_graph[1]
+        if not isinstance(x, nd.NDArray):
+            raise AssertionError(
+                "SymbolBlock requires Symbol or NDArray input")
+        try:
+            return self._call_cached_op(x, *args)
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, *args)
+            for p in self._params.values():
+                p._finish_deferred_init()
+            return self._call_cached_op(x, *args)
 
     def _call_cached_op(self, *args):
-        flat_args, _ = _flatten(args, "input")
-        real = [a for a in flat_args if a is not None]
-        cargs = []
-        for is_data, data in self._cached_op_args:
-            if is_data:
-                cargs.append(real[data])
-            else:
-                cargs.append(data.data())
-        out = self._cached_op(*cargs)
-        if len(out) == 1:
-            return out[0]
-        return list(out)
+        real = [a for a in _flatten(args, "input")[0] if a is not None]
+        out = self._cached_op(*[
+            real[slot] if is_data else slot.data()
+            for is_data, slot in self._cached_op_args])
+        return out[0] if len(out) == 1 else list(out)
 
     def _clear_cached_op(self):
         tmp = getattr(self, "_cached_graph", ())

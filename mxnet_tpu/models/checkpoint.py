@@ -167,11 +167,18 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
         # collective instead of seeing the real error
         write_error = e
     if jax.process_count() > 1:
-        # completion barrier: no process may proceed (verify, prune old
-        # checkpoints, exit) until the writer has committed or failed
+        # completion barrier doubling as a success broadcast: no process
+        # may proceed (verify, prune old checkpoints, exit) until the
+        # writer committed, and a writer failure must raise EVERYWHERE —
+        # returning success on hosts 1..N-1 while host 0 crashed would
+        # leave the cluster acting on a checkpoint that never landed
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(
-            "mxnet_tpu.checkpoint.save:" + path)
+        ok = multihost_utils.broadcast_one_to_all(
+            np.asarray(write_error is None))
+        if write_error is None and not bool(ok):
+            raise RuntimeError(
+                "checkpoint save failed on the writing process "
+                "(process 0); see its log for the original error")
     if write_error is not None:
         raise write_error
     return path

@@ -106,27 +106,20 @@ class Optimizer(object):
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
                  param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
-        self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
-        self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        self.multi_precision = multi_precision
-        self.aggregate_num = 0
-        if param_idx2name is None:
-            param_idx2name = {}
-        assert isinstance(param_idx2name, dict)
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
-            if sym is not None else ()
-        self.param_dict = param_dict if param_dict else {}
+            lr_scheduler.base_lr = learning_rate
+        assert param_idx2name is None or isinstance(param_idx2name, dict)
+        self.__dict__.update(
+            rescale_grad=rescale_grad, lr=learning_rate,
+            lr_scheduler=lr_scheduler, wd=wd,
+            begin_num_update=begin_num_update,
+            num_update=begin_num_update, _index_update_count={},
+            clip_gradient=clip_gradient,
+            multi_precision=multi_precision, aggregate_num=0,
+            idx2name=dict(param_idx2name or {}),
+            sym_info=(sym.attr_dict(), sym.list_arguments())
+            if sym is not None else (),
+            param_dict=param_dict or {})
         self.set_lr_mult({})
         self.set_wd_mult({})
 
@@ -137,19 +130,39 @@ class Optimizer(object):
     def register(klass):
         return register(klass)
 
+    def _take(self, **hyper):
+        """Bind rule hyperparameters as attributes in one shot."""
+        self.__dict__.update(hyper)
+
     # ------------------------------------------------------------ state --
     def create_state(self, index, weight):
         return None
 
+    def _zeros_like(self, weight, dtype=None):
+        """Fresh state buffer shaped/placed like the weight."""
+        return nd.zeros(weight.shape, weight.context,
+                        dtype=dtype or weight.dtype)
+
     def create_state_multi_precision(self, index, weight):
         """fp32 master copy for bf16 weights (optimizer.py:278)."""
-        weight_master_copy = None
         if self.multi_precision and weight.dtype == jnp.bfloat16:
-            weight_master_copy = weight.astype("float32")
-            return (weight_master_copy, self.create_state(index, weight_master_copy))
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
+        """Apply one step. The base implementation is a template: it
+        advances the per-index step count, resolves the scheduled/
+        multiplied hyperparameters, and hands off to the subclass's
+        ``_apply_rule`` — so rule implementations hold ONLY math.
+        Subclasses may still override update() wholesale (the
+        reference's extension contract, honored for external code)."""
+        self._update_count(index)
+        self._apply_rule(self._index_update_count[index],
+                         self._get_lr(index), self._get_wd(index),
+                         weight, grad, state)
+
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
@@ -172,76 +185,76 @@ class Optimizer(object):
     @property
     def learning_rate(self):
         """Current base lr (optimizer.py learning_rate property)."""
-        if self.lr_scheduler is not None:
-            return self.lr_scheduler(self.num_update)
-        return self.lr
+        return self.lr if self.lr_scheduler is None \
+            else self.lr_scheduler(self.num_update)
 
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning("LRScheduler of the optimizer has already been "
-                              "defined.")
+            raise UserWarning("LRScheduler of the optimizer has already "
+                              "been defined; setting lr directly would "
+                              "be overridden at the next update")
         self.lr = lr
 
+    def _sym_multipliers(self, attr_key):
+        """Per-name multipliers declared as symbol attributes
+        (``__lr_mult__`` / ``__wd_mult__``) when the optimizer was built
+        from a Symbol."""
+        if not self.sym_info:
+            return {}
+        attrs, arg_names = self.sym_info
+        found = ((name, attrs.get(name, {}).get(attr_key))
+                 for name in arg_names)
+        return {name: float(mult) for name, mult in found
+                if mult is not None}
+
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult = self._sym_multipliers("__lr_mult__")
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            # biases/betas get no decay; weights and BN gammas do
-            # (reference optimizer.py:378)
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        # decay applies to weights and BN gammas; every other named
+        # param (bias, beta, moving stats) defaults to no decay
+        self.wd_mult = {n: 0.0 for n in self.idx2name.values()
+                        if not n.endswith(("_weight", "_gamma"))}
+        self.wd_mult.update(self._sym_multipliers("__wd_mult__"))
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
-        if not isinstance(index, (list, tuple)):
-            index = [index]
-        for idx in index:
-            if idx not in self._index_update_count:
-                self._index_update_count[idx] = self.begin_num_update
-            self._index_update_count[idx] += 1
-            self.num_update = max(self._index_update_count[idx], self.num_update)
+        indices = index if isinstance(index, (list, tuple)) else (index,)
+        for idx in indices:
+            seen = self._index_update_count.get(idx,
+                                                self.begin_num_update) + 1
+            self._index_update_count[idx] = seen
+            if seen > self.num_update:
+                self.num_update = seen
+
+    def _scaled_hyper(self, indices, base, which):
+        """``base`` scaled by each param's multiplier. Precedence: the
+        Parameter object's own mult (param_dict, Gluon path), then an
+        explicit per-index entry, then the index's resolved name in the
+        mult table (Module path); absent everywhere = 1."""
+        table = getattr(self, which + "_mult")
+        out = []
+        for index in indices:
+            if index in self.param_dict:
+                mult = getattr(self.param_dict[index], which + "_mult")
+            elif index in table:
+                mult = table[index]
+            else:
+                mult = table.get(self.idx2name.get(index), 1.0)
+            out.append(base * mult)
+        return out
 
     def _get_lrs(self, indices):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        lrs = [lr for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                lrs[i] *= self.param_dict[index].lr_mult
-            elif index in self.lr_mult:
-                lrs[i] *= self.lr_mult[index]
-            elif index in self.idx2name:
-                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lrs
+        base = self.lr_scheduler(self.num_update) \
+            if self.lr_scheduler is not None else self.lr
+        return self._scaled_hyper(indices, base, "lr")
 
     def _get_lr(self, index):
         return self._get_lrs([index])[0]
 
     def _get_wds(self, indices):
-        wds = [self.wd for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                wds[i] *= self.param_dict[index].wd_mult
-            elif index in self.wd_mult:
-                wds[i] *= self.wd_mult[index]
-            elif index in self.idx2name:
-                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wds
+        return self._scaled_hyper(indices, self.wd, "wd")
 
     def _get_wd(self, index):
         return self._get_wds([index])[0]
@@ -263,14 +276,6 @@ class Optimizer(object):
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return grad._sp_indices, g
-
-    def __getstate__(self):
-        ret = self.__dict__.copy()
-        return ret
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-
 
 # --------------------------------------------------------------- rules ---
 # Pure jitted update kernels (analogues of src/operator/optimizer_op.cc).
@@ -309,17 +314,12 @@ class SGD(Optimizer):
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self._take(momentum=momentum, lazy_update=lazy_update)
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
-        return None
+        return self._zeros_like(weight) if self.momentum else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         sparse = self._sparse_rows(grad) if self.lazy_update else None
         if sparse is not None:
             rows, g = sparse
@@ -340,9 +340,6 @@ class SGD(Optimizer):
         else:
             weight._data = _sgd_update(weight._data, g, _flt(lr), _flt(wd))
 
-    def update_multi_precision(self, index, weight, grad, state):
-        super().update_multi_precision(index, weight, grad, state)
-
 
 @register
 class NAG(Optimizer):
@@ -350,16 +347,12 @@ class NAG(Optimizer):
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
+        self._take(momentum=momentum)
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
-        return None
+        return self._zeros_like(weight) if self.momentum else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         if state is not None:
             weight._data, state._data = _nag_mom_update(
@@ -373,19 +366,15 @@ class NAG(Optimizer):
 class Signum(Optimizer):
     """signSGD / Signum (optimizer.py:699): takes sign of (momentum) grad."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self._take(momentum=momentum, wd_lh=wd_lh)
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
-        return None
+        return self._zeros_like(weight) if self.momentum else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         if state is not None:
             mom = self.momentum * state._data - (1 - self.momentum) * (g + wd * weight._data)
@@ -402,20 +391,15 @@ class FTML(Optimizer):
 
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self._take(beta1=beta1, beta2=beta2, epsilon=epsilon)
 
     def create_state(self, index, weight):
-        z = (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-             nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-             nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        z = (self._zeros_like(weight),
+             self._zeros_like(weight),
+             self._zeros_like(weight))
         return z  # (prev_d, prev_v, prev_z)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad) + wd * weight._data
         prev_d, prev_v, prev_z = state
         v = self.beta2 * prev_v._data + (1 - self.beta2) * g * g
@@ -434,19 +418,15 @@ class DCASGD(Optimizer):
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.weight_previous = {}
-        self.lamda = lamda
+        self._take(momentum=momentum, lamda=lamda, weight_previous={})
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return (None, weight.copy())
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+        return (self._zeros_like(weight),
                 weight.copy())
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         mon, previous_weight = state
         comp = g + wd * weight._data + self.lamda * g * g * \
@@ -470,23 +450,17 @@ class LBSGD(Optimizer):
                  warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
                  updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
         super().__init__(multi_precision=multi_precision, **kwargs)
-        self.momentum = momentum
-        self.warmup_strategy = warmup_strategy
-        self.warmup_epochs = warmup_epochs
-        self.batch_scale = batch_scale
-        self.updates_per_epoch = updates_per_epoch
-        self.init_updates = begin_epoch * updates_per_epoch
-        self.num_epochs = num_epochs
-        self.adaptive = warmup_strategy.startswith("lars")
+        self._take(momentum=momentum, warmup_strategy=warmup_strategy,
+                   warmup_epochs=warmup_epochs, batch_scale=batch_scale,
+                   updates_per_epoch=updates_per_epoch,
+                   init_updates=begin_epoch * updates_per_epoch,
+                   num_epochs=num_epochs,
+                   adaptive=warmup_strategy.startswith("lars"))
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
-        return None
+        return self._zeros_like(weight) if self.momentum else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         if self.adaptive:
             wnorm = jnp.linalg.norm(weight._data)
@@ -505,9 +479,7 @@ class LBSGD(Optimizer):
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (optimizer.py:1599)."""
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
                                  dtype="float32")
@@ -522,19 +494,14 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-        self.lazy_update = lazy_update
+        self._take(beta1=beta1, beta2=beta2, epsilon=epsilon,
+                   lazy_update=lazy_update)
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (self._zeros_like(weight),
+                self._zeros_like(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
@@ -551,14 +518,12 @@ class AdaGrad(Optimizer):
 
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
-        self.float_stable_eps = eps
+        self._take(float_stable_eps=eps)
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return self._zeros_like(weight)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         sparse = self._sparse_rows(grad)
         if sparse is not None:
             # sparse adagrad (optimizer_op.cc:893): history/update only on
@@ -582,16 +547,13 @@ class AdaDelta(Optimizer):
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self._take(rho=rho, epsilon=epsilon)
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (self._zeros_like(weight),
+                self._zeros_like(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad) + wd * weight._data
         acc_g, acc_delta = state
         acc_g._data = self.rho * acc_g._data + (1. - self.rho) * g * g
@@ -607,24 +569,20 @@ class RMSProp(Optimizer):
     (optimizer.py:1270)."""
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
-                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+                 epsilon=1e-8, centered=False, clip_weights=None,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
-        self.clip_weights = clip_weights
+        self._take(gamma1=gamma1, gamma2=gamma2, centered=centered,
+                   epsilon=epsilon, clip_weights=clip_weights)
 
     def create_state(self, index, weight):
         if self.centered:
-            return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),)
+            return (self._zeros_like(weight),
+                    self._zeros_like(weight),
+                    self._zeros_like(weight))
+        return (self._zeros_like(weight),)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad) + wd * weight._data
         if self.centered:
             n, gmean, delta = state
@@ -648,16 +606,13 @@ class Ftrl(Optimizer):
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
+        self._take(lamda1=lamda1, beta=beta)
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),  # z
-                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+        return (self._zeros_like(weight),  # z
+                self._zeros_like(weight))  # n
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad)
         z, n = state
         sigma = (jnp.sqrt(n._data + g * g) - jnp.sqrt(n._data)) / lr
@@ -674,19 +629,16 @@ class Ftrl(Optimizer):
 class Adamax(Optimizer):
     """AdaMax (optimizer.py:1613)."""
 
-    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+        self._take(beta1=beta1, beta2=beta2)
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (self._zeros_like(weight),
+                self._zeros_like(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         lr /= (1. - self.beta1 ** t)
         g = self._preprocess_grad(grad) + wd * weight._data
         m_t, u_t = state
@@ -702,20 +654,14 @@ class Nadam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-        self.schedule_decay = schedule_decay
-        self.m_schedule = 1.
+        self._take(beta1=beta1, beta2=beta2, epsilon=epsilon,
+                   schedule_decay=schedule_decay, m_schedule=1.)
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
-                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (self._zeros_like(weight),
+                self._zeros_like(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+    def _apply_rule(self, t, lr, wd, weight, grad, state):
         g = self._preprocess_grad(grad) + wd * weight._data
         momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t * self.schedule_decay)))
         momentum_t_1 = self.beta1 * (1. - 0.5 *
@@ -761,27 +707,31 @@ class Updater(object):
         self.aggregate_updates = optimizer.aggregate_num > 0
 
     def __call__(self, index, grad, weight):
-        if not isinstance(index, (list, tuple)):
-            indices, grads, weights = [index], [grad], [weight]
-        else:
-            indices, grads, weights = index, grad, weight
-        for i, g, w in zip(indices, grads, weights):
+        batched = isinstance(index, (list, tuple))
+        triples = zip(index, grad, weight) if batched \
+            else ((index, grad, weight),)
+        for i, g, w in triples:
             if i not in self.states:
-                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
                 self.states_synced[i] = True
-            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+            self.optimizer.update_multi_precision(i, w, g,
+                                                  self.states[i])
 
     def get_states(self, dump_optimizer=False):
-        return pickle.dumps((self.states, self.optimizer)
-                            if dump_optimizer else self.states)
+        payload = (self.states, self.optimizer) if dump_optimizer \
+            else self.states
+        return pickle.dumps(payload)
 
     def set_states(self, states):
-        states = pickle.loads(states)
-        if isinstance(states, tuple) and len(states) == 2:
-            self.states, self.optimizer = states
+        loaded = pickle.loads(states)
+        # two wire formats: bare state dict, or (states, optimizer)
+        # when the sender dumped its optimizer too
+        if isinstance(loaded, tuple) and len(loaded) == 2:
+            self.states, self.optimizer = loaded
         else:
-            self.states = states
-        self.states_synced = dict.fromkeys(self.states.keys(), False)
+            self.states = loaded
+        self.states_synced = {i: False for i in self.states}
 
 
 def get_updater(optimizer):
