@@ -67,6 +67,10 @@ QUEUE = [
      {"stdin": "benchmark/serving_bench.py"}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
+    ("train_lm_d2048",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8"}},
+     1800, False),
     ("inference_fp32",
      {"argv": [sys.executable,
                "examples/image_classification/benchmark_score.py",

@@ -43,18 +43,23 @@ def main():
     if SMOKE:
         d_model, layers, seq, batch, steps = 32, 1, 64, 2, 2
     else:
+        # MXU-bound defaults (VERDICT r4 #3): d_model>=1024, seq 1024,
+        # flash attention on, remat OFF — remat trades FLOPs for HBM,
+        # which depresses measured MFU; it stays available as a knob
+        # for memory-limited shapes
         d_model = _env_int("MXNET_LM_DMODEL", 1024)
         layers = _env_int("MXNET_LM_LAYERS", 12)
-        seq = _env_int("MXNET_LM_SEQ", 2048)
+        seq = _env_int("MXNET_LM_SEQ", 1024)
         batch = _env_int("MXNET_LM_BATCH", 8)
         steps = _env_int("MXNET_LM_STEPS", 10)
+    remat = _env_int("MXNET_LM_REMAT", 1 if SMOKE else 0) == 1
 
     cfg = tf.TransformerConfig(
         vocab_size=32000, d_model=d_model, n_heads=max(2, d_model // 128),
         n_layers=layers, d_ff=4 * d_model, max_len=seq,
         dtype=jnp.bfloat16, rope=True,
         use_flash_kernel=jax.default_backend() == "tpu",
-        remat_layers=True)
+        remat_layers=remat)
     params = tf.init_params(cfg, seed=0)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
@@ -87,7 +92,8 @@ def main():
         "value": round(rate, 1), "unit": "tokens/s",
         "params_m": round(n_params / 1e6, 1),
         "d_model": d_model, "layers": layers, "seq": seq,
-        "batch": batch, "mfu": round(mfu, 4),
+        "batch": batch, "remat": remat, "mfu": round(mfu, 4),
+        "mfu_peak_flops": PEAK_FLOPS,
         "loss_finite": bool(np.isfinite(loss)),
     }))
 

@@ -27,12 +27,9 @@ stage() {
 }
 
 stage "pytest (8-device virtual CPU mesh)"
-# nightly-class large-tensor tests need ~6 GB free RAM; enable when the
-# host has it (reference keeps these in tests/nightly)
-MEM_KB=$(awk '/MemAvailable/{print $2}' /proc/meminfo 2>/dev/null || echo 0)
-if [ "${MEM_KB:-0}" -gt 8000000 ]; then
-    export MXNET_RUN_LARGE_TENSOR=1
-fi
+# nightly-class large-tensor tests self-enable when the host has the
+# RAM (the gate lives in tests/test_large_tensor.py — one source of
+# truth; MXNET_RUN_LARGE_TENSOR=1/0 forces either way)
 if ! python -m pytest tests/ -q -x --durations=10; then
     echo "[ci] FAIL: test suite"
     exit 1

@@ -140,9 +140,23 @@ class KVStore(object):
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (kvstore.py:377). Dense-backed:
-        gathers rows then scatters into out (SURVEY §7 sparse divergence)."""
+        """Pull only the rows in row_ids (kvstore.py:377).
+
+        Two destination modes (SURVEY §7 sparse divergence):
+
+        * ``out`` is a RowSparseNDArray — COMPACT pull: only the
+          gathered rows + their indices are stored, so memory and
+          traffic stay proportional to touched rows even on a
+          multi-million-row embedding table (the reference's
+          row_sparse benefit, preserved).
+        * ``out`` is dense (e.g. an executor arg slot, Module.prepare)
+          — the rows scatter into a full-width zeroed buffer, which
+          materializes the whole table: fine for model-sized tables,
+          O(vocab) HBM for giant ones. Pass a RowSparseNDArray out to
+          stay compact at that scale.
+        """
         assert out is not None and row_ids is not None
+        from .sparse import RowSparseNDArray
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         if len(rids) == 1 and len(outs) > 1:
@@ -153,8 +167,13 @@ class KVStore(object):
             for dst in olist:
                 idx = r._data.astype("int32").reshape(-1)
                 rows = src._data[idx]
-                dst._data = jnp.zeros_like(dst._data).at[idx].set(rows)
-                dst._stype = "row_sparse"
+                if isinstance(dst, RowSparseNDArray):
+                    dst._sp_data = rows
+                    dst._sp_indices = idx
+                    dst._dense_cache = None
+                else:
+                    dst._data = jnp.zeros_like(dst._data).at[idx].set(rows)
+                    dst._stype = "row_sparse"
 
     # -------------------------------------------------------- optimizer --
     def set_optimizer(self, optimizer):

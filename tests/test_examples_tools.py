@@ -237,6 +237,16 @@ def test_transformer_lm_example_learns():
     assert "LEARNED" in r.stdout
 
 
+def test_elastic_training_crash_resume():
+    """Failure recovery contract (SURVEY §5: recovery = restart from
+    checkpoint): the example crashes a sharded training run mid-flight,
+    relaunches the same command line, and the resumed trajectory must
+    reproduce the uninterrupted run exactly."""
+    r = _run([sys.executable, "examples/elastic_training.py", "--demo"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK: crash + relaunch reproduces" in r.stdout
+
+
 def test_im2rec_native_matches_python_packer(tmp_path):
     """src/io/im2rec_pack.cc writes byte-identical .rec/.idx to the
     Python packer (same list, same resize/quality)."""

@@ -15,12 +15,29 @@ import pytest
 
 import mxnet_tpu as mx
 
-# opt-IN like the reference's nightly suite: each test allocates
-# ~2.2 GB (with ~4.4 GB transients) — default pytest runs must not OOM
-# small hosts. ci/run.sh enables it on hosts with enough memory.
+def _large_tensor_enabled():
+    """Like the reference's nightly suite the tier is memory-gated —
+    each test allocates ~2.2 GB (with ~4.4 GB transients) — but it
+    self-enables when the host clearly has room (>10 GB available), so
+    a plain `pytest tests/` on a capable host exercises the INT64 path
+    instead of silently skipping it. MXNET_RUN_LARGE_TENSOR=1 forces
+    on, =0 forces off."""
+    forced = os.environ.get("MXNET_RUN_LARGE_TENSOR")
+    if forced is not None:
+        return forced == "1"
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable"):
+                    return int(line.split()[1]) > 10 * 1024 * 1024
+    except OSError:
+        pass
+    return False
+
+
 pytestmark = pytest.mark.skipif(
-    os.environ.get("MXNET_RUN_LARGE_TENSOR", "0") != "1",
-    reason="set MXNET_RUN_LARGE_TENSOR=1 (needs ~6 GB free RAM)")
+    not _large_tensor_enabled(),
+    reason="needs ~6 GB free RAM (force with MXNET_RUN_LARGE_TENSOR=1)")
 
 N = 2**31 + 16
 
