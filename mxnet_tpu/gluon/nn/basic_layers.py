@@ -22,90 +22,65 @@ __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
            "Lambda", "HybridLambda", "Concurrent", "HybridConcurrent", "Identity"]
 
 
-class Sequential(Block):
-    """Stacks Blocks sequentially (gluon/nn/basic_layers.py:34)."""
-
-    def __init__(self, prefix=None, params=None):
-        super(Sequential, self).__init__(prefix=prefix, params=params)
+class _ChainContainer(object):
+    """Shared container protocol for the two sequential stacks: add(),
+    chained application, indexing (slices clone into a same-prefix
+    container), len/iter, and the tree repr — written once instead of
+    twice."""
 
     def add(self, *blocks):
         for block in blocks:
             self.register_child(block)
 
-    def forward(self, x):
+    def _apply_chain(self, x):
         for block in self._children.values():
             x = block(x)
         return x
 
     def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(
-            "  ({key}): {block}".format(key=key, block=repr(block).replace("\n", "\n  "))
+        body = "\n".join(
+            "  (%s): %s" % (key, repr(block).replace("\n", "\n  "))
             for key, block in self._children.items())
-        return s.format(name=self.__class__.__name__, modstr=modstr)
+        return "%s(\n%s\n)" % (type(self).__name__, body)
 
     def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
+        picked = list(self._children.values())[key]
+        if not isinstance(picked, list):
+            return picked
+        clone = type(self)(prefix=self._prefix)
+        with clone.name_scope():
+            clone.add(*picked)
+        return clone
 
     def __len__(self):
         return len(self._children)
 
     def __iter__(self):
         return iter(self._children.values())
+
+
+class Sequential(_ChainContainer, Block):
+    """Stacks Blocks sequentially (gluon/nn/basic_layers.py:34)."""
+
+    def forward(self, x):
+        return self._apply_chain(x)
 
     def hybridize(self, active=True, **kwargs):
         if self._children and all(isinstance(c, HybridBlock)
                                   for c in self._children.values()):
             import warnings
             warnings.warn(
-                "All children of this Sequential layer '%s' are HybridBlocks. "
-                "Consider using HybridSequential for the best performance."
-                % self.prefix, stacklevel=2)
+                "All children of this Sequential layer '%s' are "
+                "HybridBlocks. Consider using HybridSequential for the "
+                "best performance." % self.prefix, stacklevel=2)
         super(Sequential, self).hybridize(active, **kwargs)
 
 
-class HybridSequential(HybridBlock):
+class HybridSequential(_ChainContainer, HybridBlock):
     """Stacks HybridBlocks sequentially (gluon/nn/basic_layers.py:117)."""
 
-    def __init__(self, prefix=None, params=None):
-        super(HybridSequential, self).__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
     def hybrid_forward(self, F, x):
-        for block in self._children.values():
-            x = block(x)
-        return x
-
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(
-            "  ({key}): {block}".format(key=key, block=repr(block).replace("\n", "\n  "))
-            for key, block in self._children.items())
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
-
-    def __len__(self):
-        return len(self._children)
-
-    def __iter__(self):
-        return iter(self._children.values())
+        return self._apply_chain(x)
 
 
 class Dense(HybridBlock):
@@ -172,6 +147,29 @@ class Dropout(HybridBlock):
         return s.format(name=self.__class__.__name__, **self.__dict__)
 
 
+def _affine_pair(layer, in_channels, gamma_init, beta_init,
+                 scale, center, track_grads=True):
+    """Declare the norm family's gamma/beta pair under the layer's
+    scope. scale/center toggle learnability (grad_req null keeps the
+    param present for checkpoint parity even when frozen)."""
+    def declare(name, init, learn):
+        kw = dict(grad_req="write" if learn else "null",
+                  shape=(in_channels,), init=init,
+                  allow_deferred_init=True)
+        if track_grads:
+            kw["differentiable"] = learn
+        return layer.params.get(name, **kw)
+    layer.gamma = declare("gamma", gamma_init, scale)
+    layer.beta = declare("beta", beta_init, center)
+
+
+def _norm_repr(layer):
+    inside = ", ".join("%s=%r" % kv for kv in layer._kwargs.items())
+    width = layer.gamma.shape[0]
+    return "%s(%s, in_channels=%s)" % (type(layer).__name__, inside,
+                                       width if width else None)
+
+
 class BatchNorm(HybridBlock):
     """Batch normalization (gluon/nn/basic_layers.py:291)."""
 
@@ -186,22 +184,15 @@ class BatchNorm(HybridBlock):
         if in_channels != 0:
             self.in_channels = in_channels
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True, differentiable=scale)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True, differentiable=center)
-            self.running_mean = self.params.get(
-                "running_mean", grad_req="null", shape=(in_channels,),
-                init=running_mean_initializer, allow_deferred_init=True,
-                differentiable=False)
-            self.running_var = self.params.get(
-                "running_var", grad_req="null", shape=(in_channels,),
-                init=running_variance_initializer, allow_deferred_init=True,
-                differentiable=False)
+            _affine_pair(self, in_channels, gamma_initializer,
+                         beta_initializer, scale, center)
+            for stat, init in (("running_mean", running_mean_initializer),
+                               ("running_var",
+                                running_variance_initializer)):
+                setattr(self, stat, self.params.get(
+                    stat, grad_req="null", shape=(in_channels,),
+                    init=init, allow_deferred_init=True,
+                    differentiable=False))
 
     def cast(self, dtype):
         if dtype in ("float16", "bfloat16"):
@@ -225,14 +216,7 @@ class BatchNorm(HybridBlock):
                            name="fwd", **self._kwargs)
 
     def __repr__(self):
-        s = "{name}({content}"
-        in_channels = self.gamma.shape[0]
-        s += ", in_channels={0}".format(in_channels if in_channels else None)
-        s += ")"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            "=".join([k, v.__repr__()])
-                            for k, v in self._kwargs.items()))
+        return _norm_repr(self)
 
 
 class Embedding(HybridBlock):
@@ -285,14 +269,9 @@ class InstanceNorm(HybridBlock):
         self._axis = axis
         self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _affine_pair(self, in_channels, gamma_initializer,
+                         beta_initializer, scale, center,
+                         track_grads=False)
 
     def hybrid_forward(self, F, x, gamma, beta):
         if self._axis == 1:
@@ -302,14 +281,7 @@ class InstanceNorm(HybridBlock):
                               eps=self._epsilon).swapaxes(1, self._axis)
 
     def __repr__(self):
-        s = "{name}({content}"
-        in_channels = self.gamma.shape[0]
-        s += ", in_channels={0}".format(in_channels)
-        s += ")"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            "=".join([k, v.__repr__()])
-                            for k, v in self._kwargs.items()))
+        return _norm_repr(self)
 
 
 class LayerNorm(HybridBlock):
@@ -326,28 +298,16 @@ class LayerNorm(HybridBlock):
         self._center = center
         self._scale = scale
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _affine_pair(self, in_channels, gamma_initializer,
+                         beta_initializer, scale, center,
+                         track_grads=False)
 
     def hybrid_forward(self, F, data, gamma, beta):
         return F.LayerNorm(data, gamma=gamma, beta=beta, axis=self._axis,
                            eps=self._epsilon)
 
     def __repr__(self):
-        s = "{name}({content}"
-        in_channels = self.gamma.shape[0]
-        s += ", in_channels={0}".format(in_channels)
-        s += ")"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            "=".join([k, v.__repr__()])
-                            for k, v in self._kwargs.items()))
+        return _norm_repr(self)
 
 
 class GroupNorm(HybridBlock):
@@ -363,25 +323,35 @@ class GroupNorm(HybridBlock):
         self._center = center
         self._scale = scale
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _affine_pair(self, in_channels, gamma_initializer,
+                         beta_initializer, scale, center,
+                         track_grads=False)
 
     def hybrid_forward(self, F, data, gamma, beta):
         return F.GroupNorm(data, gamma=gamma, beta=beta,
                            num_groups=self._num_groups, eps=self._epsilon)
 
     def __repr__(self):
-        s = "{name}({content})"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            "=".join([k, v.__repr__()])
-                            for k, v in self._kwargs.items()))
+        inside = ", ".join("%s=%r" % kv for kv in self._kwargs.items())
+        return "%s(%s)" % (type(self).__name__, inside)
+
+
+def _named_callable(function, namespaces):
+    """Resolve a Lambda layer's function argument: a name looked up in
+    the given op namespaces (returns a {namespace: fn} dispatch map), or
+    a callable used as-is. Returns (impl, display_name)."""
+    if callable(function):
+        return function, function.__name__
+    if isinstance(function, str):
+        table = {ns: getattr(ns, function, None) for ns in namespaces}
+        if any(fn is not None for fn in table.values()):
+            return table, function
+        raise AssertionError(
+            "Function name %s is not found in %s." % (
+                function, "/".join(ns.__name__.rsplit(".", 1)[-1]
+                                   for ns in namespaces)))
+    raise ValueError("Unrecognized function in lambda: {} of type {}"
+                     .format(function, type(function)))
 
 
 class Lambda(Block):
@@ -389,25 +359,14 @@ class Lambda(Block):
 
     def __init__(self, function, prefix=None):
         super(Lambda, self).__init__(prefix=prefix)
-        if isinstance(function, str):
-            assert hasattr(nd, function), \
-                "Function name %s is not found in ndarray." % function
-            self._func_impl = getattr(nd, function)
-            self._func_name = function
-        elif callable(function):
-            self._func_impl = function
-            self._func_name = function.__name__
-        else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}"
-                .format(function, type(function)))
+        impl, self._func_name = _named_callable(function, (nd,))
+        self._func_impl = impl[nd] if isinstance(impl, dict) else impl
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)
 
 
 class HybridLambda(HybridBlock):
@@ -416,20 +375,7 @@ class HybridLambda(HybridBlock):
     def __init__(self, function, prefix=None):
         super(HybridLambda, self).__init__(prefix=prefix)
         from ... import symbol as sym
-        if isinstance(function, str):
-            assert hasattr(nd, function) or hasattr(sym, function), \
-                "Function name %s is not found in symbol/ndarray." % function
-            func_dict = {sym: getattr(sym, function, None),
-                         nd: getattr(nd, function, None)}
-            self._func = func_dict
-            self._func_name = function
-        elif callable(function):
-            self._func = function
-            self._func_name = function.__name__
-        else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}"
-                .format(function, type(function)))
+        self._func, self._func_name = _named_callable(function, (sym, nd))
 
     def hybrid_forward(self, F, x, *args):
         if isinstance(self._func, dict):
@@ -437,8 +383,7 @@ class HybridLambda(HybridBlock):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)
 
 
 from .activations import Activation  # noqa: E402  (Dense uses it)

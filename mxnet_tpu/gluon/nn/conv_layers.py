@@ -26,6 +26,14 @@ def _to_tuple(v, n):
     return tuple(v)
 
 
+def _sized(value, ndim, label="kernel_size"):
+    """Broadcast an int to an ndim-tuple and validate the arity."""
+    out = _to_tuple(value, ndim)
+    assert len(out) == ndim, \
+        "%s must be a number or %d-tuple" % (label, ndim)
+    return out
+
+
 class _Conv(HybridBlock):
     """Base convolution (gluon/nn/conv_layers.py:47)."""
 
@@ -113,11 +121,8 @@ class Conv1D(_Conv):
                  groups=1, layout="NCW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,)
-        assert len(kernel_size) == 1, "kernel_size must be a number or 1-tuple"
         super(Conv1D, self).__init__(
-            channels, kernel_size, strides, padding, dilation, groups, layout,
+            channels, _sized(kernel_size, 1), strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
             bias_initializer, **kwargs)
 
@@ -127,11 +132,8 @@ class Conv2D(_Conv):
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 2
-        assert len(kernel_size) == 2, "kernel_size must be a number or 2-tuple"
         super(Conv2D, self).__init__(
-            channels, kernel_size, strides, padding, dilation, groups, layout,
+            channels, _sized(kernel_size, 2), strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
             bias_initializer, **kwargs)
 
@@ -142,11 +144,8 @@ class Conv3D(_Conv):
                  layout="NCDHW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 3
-        assert len(kernel_size) == 3, "kernel_size must be a number or 3-tuple"
         super(Conv3D, self).__init__(
-            channels, kernel_size, strides, padding, dilation, groups, layout,
+            channels, _sized(kernel_size, 3), strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
             bias_initializer, **kwargs)
 
@@ -156,12 +155,8 @@ class Conv1DTranspose(_Conv):
                  output_padding=0, dilation=1, groups=1, layout="NCW",
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,)
-        if isinstance(output_padding, int):
-            output_padding = (output_padding,)
-        assert len(kernel_size) == 1
-        assert len(output_padding) == 1
+        kernel_size = _sized(kernel_size, 1)
+        output_padding = _sized(output_padding, 1, "output_padding")
         super(Conv1DTranspose, self).__init__(
             channels, kernel_size, strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
@@ -176,12 +171,8 @@ class Conv2DTranspose(_Conv):
                  layout="NCHW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 2
-        if isinstance(output_padding, int):
-            output_padding = (output_padding,) * 2
-        assert len(kernel_size) == 2
-        assert len(output_padding) == 2
+        kernel_size = _sized(kernel_size, 2)
+        output_padding = _sized(output_padding, 2, "output_padding")
         super(Conv2DTranspose, self).__init__(
             channels, kernel_size, strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
@@ -196,12 +187,8 @@ class Conv3DTranspose(_Conv):
                  dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 3
-        if isinstance(output_padding, int):
-            output_padding = (output_padding,) * 3
-        assert len(kernel_size) == 3
-        assert len(output_padding) == 3
+        kernel_size = _sized(kernel_size, 3)
+        output_padding = _sized(output_padding, 3, "output_padding")
         super(Conv3DTranspose, self).__init__(
             channels, kernel_size, strides, padding, dilation, groups, layout,
             in_channels, activation, use_bias, weight_initializer,
@@ -248,41 +235,33 @@ class _Pooling(HybridBlock):
 class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,)
         assert layout == "NCW", "Only NCW layout is supported"
         super(MaxPool1D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "max", **kwargs)
+            _sized(pool_size, 1, "pool_size"), strides, padding, ceil_mode, False, "max", **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 2
         assert layout == "NCHW", "Only NCHW layout is supported"
         super(MaxPool2D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "max", **kwargs)
+            _sized(pool_size, 2, "pool_size"), strides, padding, ceil_mode, False, "max", **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 3
         assert layout == "NCDHW", "Only NCDHW layout is supported"
         super(MaxPool3D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "max", **kwargs)
+            _sized(pool_size, 3, "pool_size"), strides, padding, ceil_mode, False, "max", **kwargs)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,)
         assert layout == "NCW", "Only NCW layout is supported"
         super(AvgPool1D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "avg", layout,
+            _sized(pool_size, 1, "pool_size"), strides, padding, ceil_mode, False, "avg", layout,
             count_include_pad, **kwargs)
 
 
@@ -290,11 +269,9 @@ class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 2
         assert layout == "NCHW", "Only NCHW layout is supported"
         super(AvgPool2D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "avg", layout,
+            _sized(pool_size, 2, "pool_size"), strides, padding, ceil_mode, False, "avg", layout,
             count_include_pad, **kwargs)
 
 
@@ -302,54 +279,34 @@ class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 3
         assert layout == "NCDHW", "Only NCDHW layout is supported"
         super(AvgPool3D, self).__init__(
-            pool_size, strides, padding, ceil_mode, False, "avg", layout,
+            _sized(pool_size, 3, "pool_size"), strides, padding, ceil_mode, False, "avg", layout,
             count_include_pad, **kwargs)
 
 
-class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW", "Only NCW layout is supported"
-        super(GlobalMaxPool1D, self).__init__(
-            (1,), None, 0, True, True, "max", **kwargs)
+def _global_pool(name, ndim, pool_type, want_layout):
+    """Build a Global{Max,Avg}Pool{1,2,3}D class: full-spatial pooling
+    is one flag on _Pooling, so the six variants differ only in their
+    (ndim, type, layout) triple."""
+    def __init__(self, layout=want_layout, **kwargs):
+        assert layout == want_layout, \
+            "Only %s layout is supported" % want_layout
+        _Pooling.__init__(self, (1,) * ndim, None, 0, True, True,
+                          pool_type, **kwargs)
+    cls = type(name, (_Pooling,), {"__init__": __init__})
+    cls.__doc__ = "Global %s pooling over %dD spatial dims " \
+                  "(gluon/nn/conv_layers.py Global*Pool)." \
+                  % (pool_type, ndim)
+    return cls
 
 
-class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
-        super(GlobalMaxPool2D, self).__init__(
-            (1, 1), None, 0, True, True, "max", **kwargs)
-
-
-class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only NCDHW layout is supported"
-        super(GlobalMaxPool3D, self).__init__(
-            (1, 1, 1), None, 0, True, True, "max", **kwargs)
-
-
-class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW", "Only NCW layout is supported"
-        super(GlobalAvgPool1D, self).__init__(
-            (1,), None, 0, True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
-        super(GlobalAvgPool2D, self).__init__(
-            (1, 1), None, 0, True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only NCDHW layout is supported"
-        super(GlobalAvgPool3D, self).__init__(
-            (1, 1, 1), None, 0, True, True, "avg", **kwargs)
+GlobalMaxPool1D = _global_pool("GlobalMaxPool1D", 1, "max", "NCW")
+GlobalMaxPool2D = _global_pool("GlobalMaxPool2D", 2, "max", "NCHW")
+GlobalMaxPool3D = _global_pool("GlobalMaxPool3D", 3, "max", "NCDHW")
+GlobalAvgPool1D = _global_pool("GlobalAvgPool1D", 1, "avg", "NCW")
+GlobalAvgPool2D = _global_pool("GlobalAvgPool2D", 2, "avg", "NCHW")
+GlobalAvgPool3D = _global_pool("GlobalAvgPool3D", 3, "avg", "NCDHW")
 
 
 class ReflectionPad2D(HybridBlock):

@@ -84,13 +84,14 @@ class Parameter(object):
     @grad_req.setter
     def grad_req(self, req):
         if req not in ("write", "add", "null"):
-            raise ValueError("grad_req must be write/add/null, got %s" % req)
-        if not self._differentiable:
-            req = "null"
-        if self._grad_req == req:
+            raise ValueError(
+                "grad_req must be write/add/null, got %s" % req)
+        effective = req if self._differentiable else "null"
+        if effective == self._grad_req:
             return
-        self._grad_req = req
-        if req == "null":
+        self._grad_req = effective
+        # transitioning in/out of "null" (re)binds the grad buffer
+        if effective == "null":
             self._grad = None
         elif self._data is not None:
             self._init_grad()
@@ -109,17 +110,17 @@ class Parameter(object):
 
     @shape.setter
     def shape(self, new_shape):
-        if self._shape is None:
-            self._shape = tuple(new_shape)
-            return
-        unknown_ok = all(
-            s1 in (0, -1) or s1 == s2
-            for s1, s2 in zip(self._shape, new_shape)) \
-            and len(self._shape) == len(new_shape)
-        if not unknown_ok:
-            raise AssertionError(
-                "Expected shape %s is incompatible with given shape %s for "
-                "Parameter %s" % (str(new_shape), str(self._shape), self.name))
+        if self._shape is not None:
+            # every previously-declared dim must either be a wildcard
+            # (0/-1, deferred) or agree exactly
+            mismatch = len(self._shape) != len(new_shape) or any(
+                old not in (0, -1) and old != new
+                for old, new in zip(self._shape, new_shape))
+            if mismatch:
+                raise AssertionError(
+                    "Expected shape %s is incompatible with given shape "
+                    "%s for Parameter %s"
+                    % (str(new_shape), str(self._shape), self.name))
         self._shape = tuple(new_shape)
 
     @property
@@ -160,6 +161,15 @@ class Parameter(object):
         self._data.attach_grad(self._grad_req)
         self._data._grad = self._grad
 
+    def _init_spec_str(self, init):
+        """The per-param initializer override serialized the way
+        InitDesc attrs carry it (empty = use the default init)."""
+        import json
+        if init is None:
+            return ""
+        return json.dumps([init, {}]) if isinstance(init, str) \
+            else init.dumps()
+
     def _finish_deferred_init(self):
         if not self._deferred_init:
             return
@@ -171,17 +181,10 @@ class Parameter(object):
                 "shape: %s." % (self.name, str(self._shape)))
         with autograd.pause():
             if data is None:
-                import json as _json
                 data = nd.zeros(self._shape, dtype=self._dtype)
-                if init is None:
-                    init_str = ""
-                elif isinstance(init, str):
-                    init_str = _json.dumps([init, {}])
-                else:
-                    init_str = init.dumps()
-                initializer.create(default_init)(
-                    initializer.InitDesc(self.name, {"__init__": init_str}),
-                    data)
+                desc = initializer.InitDesc(
+                    self.name, {"__init__": self._init_spec_str(init)})
+                initializer.create(default_init)(desc, data)
             self._init_impl(data)
 
     # -------------------------------------------------------------- API --
@@ -189,26 +192,25 @@ class Parameter(object):
                    force_reinit=False):
         """Initialize parameter and gradient arrays
         (python/mxnet/gluon/parameter.py:337)."""
-        if default_init is None:
-            default_init = initializer.Uniform()
         if self._data is not None and not force_reinit:
             import warnings
-            warnings.warn("Parameter '%s' is already initialized, ignoring. "
-                          "Set force_reinit=True to re-initialize." % self.name)
+            warnings.warn(
+                "Parameter '%s' is already initialized, ignoring. "
+                "Set force_reinit=True to re-initialize." % self.name)
             return
         self._data = self._grad = None
-        if init is None:
-            init = self.init
-        if not self._shape_known():
-            if self._allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
+        pending = (init if init is not None else self.init, ctx,
+                   default_init or initializer.Uniform(), None)
+        if self._shape_known():
+            self._deferred_init = pending
+            self._finish_deferred_init()
+        elif self._allow_deferred_init:
+            self._deferred_init = pending
+        else:
             raise ValueError(
                 "Cannot initialize Parameter '%s' because it has invalid "
-                "shape: %s. Please specify in_units, in_channels, etc for "
-                "`Block`s." % (self.name, str(self._shape)))
-        self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
+                "shape: %s. Please specify in_units, in_channels, etc "
+                "for `Block`s." % (self.name, str(self._shape)))
 
     def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source="current"):
         """Initialize from loaded data (used by load_parameters)."""
@@ -230,15 +232,16 @@ class Parameter(object):
     def set_data(self, data):
         """Sets this parameter's value on all contexts."""
         self.shape = data.shape
-        if self._data is None:
-            if self._deferred_init:
-                init, ctx, default_init, _ = self._deferred_init
-                self._deferred_init = (init, ctx, default_init, data)
-                return
+        if self._data is not None:
+            self._data._data = data._data \
+                if isinstance(data, nd.NDArray) else np.asarray(data)
+            return
+        if not self._deferred_init:
             raise AssertionError(
                 "Parameter '%s' has not been initialized" % self.name)
-        self._data._data = data._data if isinstance(data, nd.NDArray) \
-            else np.asarray(data)
+        # stash the value into the pending init so the first forward
+        # lands it instead of drawing from the initializer
+        self._deferred_init = self._deferred_init[:3] + (data,)
 
     def data(self, ctx=None):
         """Returns a copy of this parameter on one context — here the single
@@ -374,12 +377,12 @@ class ParameterDict(object):
         return self._prefix
 
     def _get_impl(self, name):
-        if name in self._params:
-            return self._params[name]
-        if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._params[name]
-        return None
+        found = self._params.get(name)
+        if found is None and self._shared is not None:
+            found = self._shared._params.get(name)
+            if found is not None:
+                self._params[name] = found   # adopt the shared param
+        return found
 
     def get(self, name, **kwargs):
         """Retrieves or creates a ``Parameter`` named ``self.prefix+name``.
@@ -447,20 +450,17 @@ class ParameterDict(object):
     def update(self, other):
         """Copies all Parameters in ``other`` to self."""
         for k, v in other.items():
-            if k in self._params:
-                if self._params[k] is not v:
-                    raise ValueError(
-                        "Cannot update self with other because they have "
-                        "different Parameters with the same name '%s'" % k)
-            else:
-                self._params[k] = v
+            mine = self._params.setdefault(k, v)
+            if mine is not v:
+                raise ValueError(
+                    "Cannot update self with other because they have "
+                    "different Parameters with the same name '%s'" % k)
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
-        if init is None:
-            init = initializer.Uniform()
-        for _, v in self.items():
-            v.initialize(None, ctx, init, force_reinit=force_reinit)
+        default = init or initializer.Uniform()
+        for v in self.values():
+            v.initialize(None, ctx, default, force_reinit=force_reinit)
 
     def zero_grad(self):
         for v in self.values():
@@ -475,39 +475,40 @@ class ParameterDict(object):
             setattr(v, name, value)
 
     def save(self, filename, strip_prefix=""):
-        arg_dict = {}
-        for param in self.values():
-            weight = param.data()
-            if not param.name.startswith(strip_prefix):
-                raise ValueError(
-                    "Prefix '%s' is to be striped before saving, but "
-                    "Parameter's name '%s' does not start with it"
-                    % (strip_prefix, param.name))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd.save(filename, arg_dict)
+        misnamed = next((p.name for p in self.values()
+                         if not p.name.startswith(strip_prefix)), None)
+        if misnamed is not None:
+            raise ValueError(
+                "Prefix '%s' is to be striped before saving, but "
+                "Parameter's name '%s' does not start with it"
+                % (strip_prefix, misnamed))
+        nd.save(filename, {p.name[len(strip_prefix):]: p.data()
+                           for p in self.values()})
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix="", cast_dtype=False,
              dtype_source="current"):
-        if restore_prefix:
-            for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is '%s' but Parameter name '%s' does not " \
-                    "start with it" % (restore_prefix, name)
         lprefix = len(restore_prefix)
-        loaded = nd.load(filename)
+        if restore_prefix:
+            stray = next((n for n in self.keys()
+                          if not n.startswith(restore_prefix)), None)
+            assert stray is None, \
+                "restore_prefix is '%s' but Parameter name '%s' does " \
+                "not start with it" % (restore_prefix, stray)
         arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
-                    for k, v in loaded.items()}
+                    for k, v in nd.load(filename).items()}
         if not allow_missing:
-            for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter '%s' is missing in file '%s'" \
-                    % (name[lprefix:], filename)
-        for name in arg_dict:
-            if name not in self._params:
+            absent = next((n for n in self.keys()
+                           if n not in arg_dict), None)
+            assert absent is None, \
+                "Parameter '%s' is missing in file '%s'" \
+                % (absent and absent[lprefix:], filename)
+        for name, value in arg_dict.items():
+            target = self._params.get(name)
+            if target is None:
                 assert ignore_extra, \
-                    "Parameter '%s' loaded from file '%s' is not present in " \
-                    "ParameterDict" % (name[lprefix:], filename)
+                    "Parameter '%s' loaded from file '%s' is not " \
+                    "present in ParameterDict" % (name[lprefix:], filename)
                 continue
-            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
-                                  dtype_source=dtype_source)
+            target._load_init(value, ctx, cast_dtype=cast_dtype,
+                              dtype_source=dtype_source)

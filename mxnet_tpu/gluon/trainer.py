@@ -29,63 +29,69 @@ class Trainer(object):
     update_on_kvstore : bool, optional
     """
 
-    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
-        if isinstance(params, (dict,)) or hasattr(params, "values"):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        seq = list(params.values()) if hasattr(params, "values") \
+            else params
+        if not isinstance(seq, (list, tuple)):
             raise ValueError(
                 "First argument must be a list or dict of Parameters, "
                 "got %s." % (type(params)))
-        self._params = []
-        self._param2idx = {}
-        for i, param in enumerate(params):
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % (type(param)))
-            self._param2idx[param.name] = i
-            self._params.append(param)
-            param._trainer = self
+        outsider = next(
+            (p for p in seq if not isinstance(p, Parameter)), None)
+        if outsider is not None:
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got list of %s." % (type(outsider)))
+        self._params = list(seq)
+        self._param2idx = {p.name: i
+                           for i, p in enumerate(self._params)}
+        for p in self._params:
+            p._trainer = self
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
-        self._init_optimizer(optimizer, optimizer_params)
+        hyper = dict(optimizer_params or {})
+        self._scale = float(hyper.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, hyper)
         self._kvstore_type = kvstore
         self._kvstore = None
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._states = {}
 
-    def _init_optimizer(self, optimizer, optimizer_params):
-        if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an Optimizer " \
-                "instance"
-            self._optimizer = optimizer
-        else:
-            self._optimizer = opt.create(optimizer, **optimizer_params)
+    def _init_optimizer(self, optimizer, hyper):
+        ready_made = isinstance(optimizer, opt.Optimizer)
+        assert not (ready_made and hyper), \
+            "optimizer_params must be None if optimizer is an " \
+            "Optimizer instance"
+        self._optimizer = optimizer if ready_made \
+            else opt.create(optimizer, **hyper)
         self._updaters = [opt.get_updater(self._optimizer)]
 
+    def _resolve_store(self):
+        spec = self._kvstore_type
+        if spec is None or isinstance(spec, kvs.KVStore):
+            return spec
+        return kvs.create(spec)
+
     def _init_kvstore(self):
-        if isinstance(self._kvstore_type, kvs.KVStore):
-            kv = self._kvstore_type
-        elif self._kvstore_type is None:
-            kv = None
-        else:
-            kv = kvs.create(self._kvstore_type)
-        self._kvstore = kv
+        kv = self._kvstore = self._resolve_store()
         if self._update_on_kvstore is None:
             self._update_on_kvstore = False
         if kv is not None:
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
-            for i, param in enumerate(self._params):
+            for slot, param in enumerate(self._params):
                 if param._data is not None:
-                    kv.init(i, param.data())
+                    kv.init(slot, param.data())
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
         self._kv_initialized = True
+
+    def _ready(self):
+        """Lazy kvstore bring-up shared by every entry point."""
+        if not self._kv_initialized:
+            self._init_kvstore()
 
     @property
     def learning_rate(self):
@@ -107,8 +113,7 @@ class Trainer(object):
         """Makes one parameter update step: rescale grads by 1/batch_size,
         allreduce across data-parallel replicas, apply optimizer
         (gluon/trainer.py:305)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ready()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer): check
@@ -124,29 +129,29 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ready()
         self._allreduce_grads()
+
+    def _trainable(self):
+        """(kvstore slot, param) for every param that receives grads."""
+        return ((slot, p) for slot, p in enumerate(self._params)
+                if p.grad_req != "null")
 
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.grad(), priority=-i)
+        for slot, param in self._trainable():
+            self._kvstore.push(slot, param.grad(), priority=-slot)
+            if not self._update_on_kvstore:
+                self._kvstore.pull(slot, param.grad(), priority=-slot)
 
     def update(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ready()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
+        for i, param in self._trainable():
             if param._data is None:
                 if not ignore_stale_grad:
                     raise MXNetError(
@@ -174,14 +179,11 @@ class Trainer(object):
     # ------------------------------------------------------------ states --
     def save_states(self, fname):
         assert self._optimizer is not None
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ready()
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=False))
 
     def load_states(self, fname):
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ready()
         with open(fname, "rb") as f:
-            states = f.read()
-        self._updaters[0].set_states(states)
+            self._updaters[0].set_states(f.read())

@@ -166,13 +166,19 @@ class KVStore(object):
             olist = o if isinstance(o, (list, tuple)) else [o]
             for dst in olist:
                 idx = r._data.astype("int32").reshape(-1)
-                rows = src._data[idx]
                 if isinstance(dst, RowSparseNDArray):
-                    dst._sp_data = rows
+                    # row_sparse invariant: indices unique and sorted
+                    # (minibatch row_ids routinely repeat; duplicates
+                    # would double-count in sparse add/retain). The
+                    # dense path below needs no dedup — .at[].set is
+                    # last-write-wins
+                    idx = jnp.unique(idx)
+                    dst._sp_data = src._data[idx]
                     dst._sp_indices = idx
                     dst._dense_cache = None
                 else:
-                    dst._data = jnp.zeros_like(dst._data).at[idx].set(rows)
+                    dst._data = jnp.zeros_like(dst._data).at[idx].set(
+                        src._data[idx])
                     dst._stype = "row_sparse"
 
     # -------------------------------------------------------- optimizer --

@@ -198,7 +198,29 @@ def test_row_sparse_pull_compact_at_multi_million_rows():
         "compact pull materialized too much (%d bytes)" % sparse_bytes
     assert out._dense_cache is None, \
         "compact pull must not densify the destination"
-    # numerics: pulled rows match the stored table
-    want = np.asarray(table._data[jnp.asarray(ids)])
+    # numerics: pulled rows match the stored table (compact pull
+    # normalizes indices to unique+sorted order)
+    order = np.sort(ids)
+    want = np.asarray(table._data[jnp.asarray(order)])
     np.testing.assert_allclose(np.asarray(out._sp_data), want, atol=0)
-    np.testing.assert_array_equal(np.asarray(out._sp_indices), ids)
+    np.testing.assert_array_equal(np.asarray(out._sp_indices), order)
+
+
+def test_row_sparse_pull_compact_dedups_row_ids():
+    """Minibatch row_ids routinely repeat; the compact pull must emit
+    UNIQUE sorted indices or downstream sparse add/retain double-count
+    the repeated rows."""
+    kv = mx.kvstore.create("local")
+    table = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("t", mx.nd.array(table))
+    out = mx.nd.sparse.row_sparse_array(
+        (np.zeros((1, 4), np.float32), np.zeros(1, np.int64)),
+        shape=(5, 4))
+    kv.row_sparse_pull("t", out=out,
+                       row_ids=mx.nd.array([3, 1, 3, 1, 1], dtype="int64"))
+    np.testing.assert_array_equal(np.asarray(out._sp_indices), [1, 3])
+    np.testing.assert_allclose(np.asarray(out._sp_data),
+                               table[[1, 3]], atol=0)
+    dense = out.asnumpy()
+    np.testing.assert_allclose(dense[[1, 3]], table[[1, 3]], atol=0)
+    assert not dense[[0, 2, 4]].any()
