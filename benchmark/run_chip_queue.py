@@ -111,6 +111,10 @@ def _status(msg):
 
 def run_leg(name, spec, timeout):
     env = dict(os.environ)
+    # the queue only launches legs after a live probe, and the watcher
+    # owns waiting-out wedges — bench.py's own default wait-for-window
+    # (for the bare driver run) would just burn leg timeouts here
+    env.setdefault("MXNET_BENCH_WAIT_S", "0")
     env.update(spec.get("env", {}))
     # NOTE: do NOT pop PYTHONPATH — the axon TPU plugin now lives at
     # /root/.axon_site and registers only when that path is importable;
