@@ -88,13 +88,24 @@ def _jitted_slot_write(cfg):
 
 
 class Request(object):
-    __slots__ = ("rid", "tokens", "n_new", "emitted")
+    __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token")
 
-    def __init__(self, rid, prompt, n_new):
+    def __init__(self, rid, prompt, n_new, stop_token=None):
         self.rid = rid
         self.tokens = list(prompt)   # prompt + generated so far
         self.n_new = n_new
         self.emitted = 0             # generated count
+        self.stop_token = stop_token
+
+    @property
+    def done(self):
+        """Budget exhausted, or the stop token was emitted (the stop
+        token itself is part of the stream, like an EOS the client
+        sees)."""
+        if self.emitted >= self.n_new:
+            return True
+        return (self.stop_token is not None and self.emitted > 0
+                and self.tokens[-1] == self.stop_token)
 
 
 class ContinuousBatcher(object):
@@ -146,13 +157,15 @@ class ContinuousBatcher(object):
     def has_capacity(self):
         return self.active_count < self.max_batch
 
-    def admit(self, prompt, n_new, seed=0):
+    def admit(self, prompt, n_new, seed=0, stop_token=None):
         """Prefill `prompt` into a free slot; returns the request id,
         or None when every slot is busy. The first generated token is
         produced here (from the prefill logits), so a request with
         n_new=1 never occupies a decode lane. `seed` drives this
         request's sampling chain (ignored under greedy), exactly as
-        generate(seed=...) would."""
+        generate(seed=...) would. `stop_token` ends the request early
+        when emitted (EOS semantics; the stop token is included in the
+        returned stream)."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -194,7 +207,7 @@ class ContinuousBatcher(object):
             self._keys[slot] = np.asarray(key, np.uint32)
         self._cache = _jitted_slot_write(self.cfg)(
             self._cache, row_cache, jnp.int32(slot))
-        req = Request(self._next_rid, prompt, n_new)
+        req = Request(self._next_rid, prompt, n_new, stop_token)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
@@ -210,9 +223,10 @@ class ContinuousBatcher(object):
         every active request; returns {rid: full token list} for the
         requests that finished this step (their slots are freed)."""
         finished = {}
-        # retire requests that were already complete at admission
+        # retire requests already complete at admission (n_new=1, or a
+        # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
-            if req is not None and req.emitted >= req.n_new:
+            if req is not None and req.done:
                 finished[req.rid] = list(req.tokens)
                 self._free(i)
         if not any(s is not None for s in self._slots):
@@ -232,7 +246,7 @@ class ContinuousBatcher(object):
             req.emitted += 1
             self._pos[i] += 1
             self._tok[i] = nxt[i]
-            if req.emitted >= req.n_new:
+            if req.done:
                 finished[req.rid] = list(req.tokens)
                 self._free(i)
         return finished
@@ -259,21 +273,64 @@ class ContinuousBatcher(object):
         self._pos[i] = 0
         self._tok[i] = 0
 
+    def _admit_job(self, job):
+        """(prompt, n_new[, seed[, stop_token]]) -> rid or None."""
+        return self.admit(job[0], job[1],
+                          seed=job[2] if len(job) > 2 else 0,
+                          stop_token=job[3] if len(job) > 3 else None)
+
     def run(self, requests):
         """Convenience driver: serve `requests` (an iterable of
-        (prompt, n_new) or (prompt, n_new, seed)) through the slot
-        pool, admitting as capacity frees. Returns {rid: tokens} for
-        all of them, plus the admission order as a list of rids."""
+        (prompt, n_new[, seed[, stop_token]])) through the slot pool,
+        admitting as capacity frees. Returns {rid: tokens} for all of
+        them, plus the admission order as a list of rids."""
         queue = list(requests)
         order, results = [], {}
         while queue or self.active_count:
             while queue and self.has_capacity:
-                job = queue[0]
-                rid = self.admit(job[0], job[1],
-                                 seed=job[2] if len(job) > 2 else 0)
+                rid = self._admit_job(queue[0])
                 if rid is None:
                     break
                 order.append(rid)
                 queue.pop(0)
             results.update(self.step())
         return results, order
+
+    def stream(self, requests):
+        """Streaming driver: yields ``(rid, token, done)`` the moment
+        each token is produced — the first token right at admission
+        (it comes from the prefill logits), then one per decode step
+        per active lane; ``done`` marks a request's final token. Same
+        admission policy and token streams as run() (the per-request
+        generated tokens, concatenated, are identical — tested), but a
+        caller can forward tokens to clients with no per-request
+        buffering. A request cancel()ed between yields gets one
+        terminal ``(rid, None, True)`` event — token None, since
+        eviction produces no new token — so consumers keying cleanup
+        off ``done`` always see it."""
+        queue = list(requests)
+        live = {}                    # rid -> Request (for delta tracking)
+        while queue or self.active_count:
+            while queue and self.has_capacity:
+                rid = self._admit_job(queue[0])
+                if rid is None:
+                    break
+                queue.pop(0)
+                req = next(r for r in self._slots
+                           if r is not None and r.rid == rid)
+                live[rid] = req
+                yield rid, req.tokens[-1], req.done
+            already = {rid: req.emitted for rid, req in live.items()}
+            finished = self.step()
+            for rid, req in list(live.items()):
+                grew = req.emitted - already[rid]
+                if grew:             # ragged decode appends at most 1
+                    yield rid, req.tokens[-1], rid in finished
+                if rid in finished:
+                    del live[rid]
+                elif req not in self._slots:
+                    # cancel()ed between yields: slot already freed, so
+                    # step() will never report it finished — emit the
+                    # terminal event ourselves
+                    yield rid, None, True
+                    del live[rid]

@@ -340,3 +340,78 @@ def test_churn_fuzz_admit_cancel_step():
             got = np.asarray(canceled[rid])
             np.testing.assert_array_equal(got, want[:len(got)],
                                           err_msg="rid %d" % rid)
+
+
+def test_stream_yields_run_streams_incrementally():
+    """stream() must emit exactly run()'s per-request token streams,
+    one (rid, token, done) at a time, with done marking the final
+    token of each request."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=29)
+    rng = np.random.RandomState(11)
+    jobs = [(p, int(rng.randint(2, 9))) for p in _prompts(rng, 5)]
+    want, order = ContinuousBatcher(params, cfg, max_batch=2).run(jobs)
+
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    got, done_marks = {}, {}
+    for rid, token, done in srv.stream(jobs):
+        got.setdefault(rid, []).append(token)
+        assert rid not in done_marks, "token after done for rid %d" % rid
+        if done:
+            done_marks[rid] = True
+    assert set(got) == set(want)
+    for rid, (prompt, n) in zip(order, jobs):
+        assert rid in done_marks
+        # run() returns prompt + generated; stream yields generated only
+        np.testing.assert_array_equal(got[rid], want[rid][len(prompt):])
+
+
+def test_stop_token_ends_request_early():
+    """A request whose stream hits its stop token finishes early (stop
+    token included), freeing the slot; its output equals the solo
+    generate() prefix through the stop token."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=31)
+    rng = np.random.RandomState(12)
+    prompt = _prompts(rng, 1)[0]
+    solo = np.asarray(tf.generate(
+        params, jnp.asarray([prompt], jnp.int32), 10, cfg)[0])
+    generated = solo[len(prompt):]
+    stop = int(generated[4])                 # stop mid-stream
+    if any(int(t) == stop for t in generated[:4]):
+        stop = int(generated[2])             # pick an earlier unique one
+    cut = next(i for i, t in enumerate(generated) if int(t) == stop)
+
+    srv = ContinuousBatcher(params, cfg, max_batch=1)
+    results, order = srv.run([(prompt, 10, 0, stop)])
+    out = results[order[0]]
+    np.testing.assert_array_equal(out, solo[:len(prompt) + cut + 1])
+    assert out[-1] == stop
+    assert srv.active_count == 0             # slot freed for reuse
+    # and a stop token that never fires changes nothing
+    results2, order2 = srv.run([(prompt, 10, 0, -1)])
+    np.testing.assert_array_equal(results2[order2[0]], solo)
+
+
+def test_stream_emits_terminal_event_for_cancel():
+    """cancel() between stream() yields must still produce a terminal
+    (rid, None, True) event so consumers keyed on `done` clean up."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=33)
+    rng = np.random.RandomState(13)
+    p1, p2 = _prompts(rng, 2)
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    seen, canceled_rid = {}, None
+    stream = srv.stream([(p1, 10), (p2, 4)])
+    for rid, token, done in stream:
+        seen.setdefault(rid, []).append((token, done))
+        if canceled_rid is None and len(seen.get(rid, [])) == 2:
+            canceled_rid = rid
+            assert srv.cancel(rid) is not None
+    assert canceled_rid is not None
+    tokens, dones = zip(*seen[canceled_rid])
+    assert tokens[-1] is None and dones[-1] is True
+    assert all(t is not None for t in tokens[:-1])
+    other = next(r for r in seen if r != canceled_rid)
+    assert seen[other][-1][1] is True and seen[other][-1][0] is not None
+    assert srv.active_count == 0
