@@ -43,6 +43,12 @@ class Loss(HybridBlock):
             loss = loss * self._weight
         return loss
 
+    def _finish(self, F, loss, sample_weight):
+        """Shared tail of every loss: weighting, then the mean over all
+        non-batch axes."""
+        weighted = self._scale(F, loss, sample_weight)
+        return F.mean(weighted, axis=self._batch_axis, exclude=True)
+
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
@@ -57,8 +63,8 @@ class _ElementwiseLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         label = F.reshape_like(label, pred)
-        loss = self._scale(F, self.residual(F, pred, label), sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, self.residual(F, pred, label),
+                            sample_weight)
 
     def residual(self, F, pred, label):
         raise NotImplementedError
@@ -110,8 +116,7 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
             if pos_weight is not None:
                 pos_term = F.broadcast_mul(pos_term, pos_weight)
             loss = -(pos_term + F.log(1.0 - pred + eps) * (1.0 - label))
-        loss = self._scale(F, loss, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, loss, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
@@ -136,8 +141,7 @@ class SoftmaxCrossEntropyLoss(Loss):
         else:
             label = F.reshape_like(label, logp)
             loss = -F.sum(logp * label, axis=self._axis, keepdims=True)
-        loss = self._scale(F, loss, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, loss, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss

@@ -19,12 +19,24 @@ __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "BidirectionalCell"]
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+def _gathered_state_info(cells, batch_size):
+    return [info for c in cells for info in c.state_info(batch_size)]
 
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+def _gathered_begin_state(cells, **kwargs):
+    return [s for c in cells for s in c.begin_state(**kwargs)]
+
+
+def _step_through(cells, inputs, states):
+    """Feed one step through a stack of cells, threading the state
+    window each cell owns; returns (output, flat next states)."""
+    cursor, collected = 0, []
+    for cell in cells:
+        width = len(cell.state_info())
+        inputs, nxt = cell(inputs, states[cursor:cursor + width])
+        cursor += width
+        collected.extend(nxt)
+    return inputs, collected
 
 
 def _format_sequence(length, inputs, layout, merge, in_layout=None):
@@ -107,14 +119,10 @@ class RecurrentCell(Block):
         states = []
         for info in self.state_info(batch_size):
             self._init_counter += 1
-            if info is not None:
-                info.update(kwargs)
-            else:
-                info = kwargs
-            state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                    self._init_counter),
-                         **info)
-            states.append(state)
+            spec = dict(kwargs) if info is None else {**info, **kwargs}
+            states.append(func(
+                name="%sbegin_state_%d" % (self._prefix,
+                                           self._init_counter), **spec))
         return states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
@@ -126,20 +134,23 @@ class RecurrentCell(Block):
         begin_state = self._get_begin_state(F, begin_state, inputs,
                                             batch_size)
         states = begin_state
-        outputs = []
-        all_states = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-            if valid_length is not None:
-                all_states.append(states)
-        if valid_length is not None:
-            states = [F.SequenceLast(F.stack(*ele_list, axis=0),
+        outputs, state_history = [], []
+        track = valid_length is not None
+        for step_in in inputs[:length]:
+            step_out, states = self(step_in, states)
+            outputs.append(step_out)
+            if track:
+                state_history.append(states)
+        if track:
+            # per-row final state = the state at that row's last VALID
+            # step, not the last unrolled one
+            states = [F.SequenceLast(F.stack(*per_state, axis=0),
                                      sequence_length=valid_length,
                                      use_sequence_length=True, axis=0)
-                      for ele_list in zip(*all_states)]
+                      for per_state in zip(*state_history)]
             outputs = _mask_sequence_variable_length(
-                F, outputs, length, valid_length, axis, bool(merge_outputs))
+                F, outputs, length, valid_length, axis,
+                bool(merge_outputs))
         elif merge_outputs:
             outputs = F.stack(*outputs, axis=axis)
         return outputs, states
@@ -181,6 +192,34 @@ class HybridRecurrentCell(RecurrentCell, HybridBlock):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError()
 
+    def _declare_gate_params(self, hidden_size, input_size, n_gates,
+                             inits):
+        """The i2h/h2h weight+bias quartet, gate-fused along the
+        leading axis (n_gates * hidden rows — one MXU matmul covers
+        every gate)."""
+        rows = n_gates * hidden_size
+        i2h_w, h2h_w, i2h_b, h2h_b = inits
+        for attr, shape, init in (
+                ("i2h_weight", (rows, input_size), i2h_w),
+                ("h2h_weight", (rows, hidden_size), h2h_w),
+                ("i2h_bias", (rows,), i2h_b),
+                ("h2h_bias", (rows,), h2h_b)):
+            setattr(self, attr, self.params.get(
+                attr, shape=shape, init=init, allow_deferred_init=True))
+
+    def _nc_state_info(self, batch_size, count):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"} for _ in range(count)]
+
+    @staticmethod
+    def _fc_pair(F, prefix, inputs, prev, weights, width):
+        i2h_weight, h2h_weight, i2h_bias, h2h_bias = weights
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=width, name=prefix + "i2h")
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias,
+                               num_hidden=width, name=prefix + "h2h")
+        return i2h, h2h
+
 
 class RNNCell(HybridRecurrentCell):
     """Elman cell: h' = act(W_i x + b_i + W_h h + b_h)."""
@@ -193,22 +232,13 @@ class RNNCell(HybridRecurrentCell):
         self._hidden_size = hidden_size
         self._activation = activation
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(
+            hidden_size, input_size, 1,
+            (i2h_weight_initializer, h2h_weight_initializer,
+             i2h_bias_initializer, h2h_bias_initializer))
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        return self._nc_state_info(batch_size, 1)
 
     def _alias(self):
         return "rnn"
@@ -216,12 +246,10 @@ class RNNCell(HybridRecurrentCell):
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         prefix = "t%d_" % self._counter
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size,
-                               name=prefix + "h2h")
+        i2h, h2h = self._fc_pair(
+            F, prefix, inputs, states[0],
+            (i2h_weight, h2h_weight, i2h_bias, h2h_bias),
+            self._hidden_size)
         output = F.Activation(i2h + h2h, act_type=self._activation,
                               name=prefix + "out")
         return output, [output]
@@ -239,26 +267,15 @@ class LSTMCell(HybridRecurrentCell):
         super(LSTMCell, self).__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(
+            hidden_size, input_size, 4,
+            (i2h_weight_initializer, h2h_weight_initializer,
+             i2h_bias_initializer, h2h_bias_initializer))
         self._activation = activation
         self._recurrent_activation = recurrent_activation
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"},
-                {"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        return self._nc_state_info(batch_size, 2)
 
     def _alias(self):
         return "lstm"
@@ -266,25 +283,19 @@ class LSTMCell(HybridRecurrentCell):
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         prefix = "t%d_" % self._counter
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=4 * self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=4 * self._hidden_size,
-                               name=prefix + "h2h")
-        gates = i2h + h2h
-        slice_gates = F.SliceChannel(gates, num_outputs=4,
-                                     name=prefix + "slice")
-        in_gate = F.Activation(slice_gates[0],
-                               act_type=self._recurrent_activation)
-        forget_gate = F.Activation(slice_gates[1],
-                                   act_type=self._recurrent_activation)
-        in_transform = F.Activation(slice_gates[2],
-                                    act_type=self._activation)
-        out_gate = F.Activation(slice_gates[3],
-                                act_type=self._recurrent_activation)
+        i2h, h2h = self._fc_pair(
+            F, prefix, inputs, states[0],
+            (i2h_weight, h2h_weight, i2h_bias, h2h_bias),
+            4 * self._hidden_size)
+        gate = F.SliceChannel(i2h + h2h, num_outputs=4,
+                              name=prefix + "slice")
+        act, ract = self._activation, self._recurrent_activation
+        in_gate = F.Activation(gate[0], act_type=ract)
+        forget_gate = F.Activation(gate[1], act_type=ract)
+        in_transform = F.Activation(gate[2], act_type=act)
+        out_gate = F.Activation(gate[3], act_type=ract)
         next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * F.Activation(next_c, act_type=self._activation)
+        next_h = out_gate * F.Activation(next_c, act_type=act)
         return next_h, [next_h, next_c]
 
 
@@ -298,22 +309,13 @@ class GRUCell(HybridRecurrentCell):
         super(GRUCell, self).__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._declare_gate_params(
+            hidden_size, input_size, 3,
+            (i2h_weight_initializer, h2h_weight_initializer,
+             i2h_bias_initializer, h2h_bias_initializer))
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        return self._nc_state_info(batch_size, 1)
 
     def _alias(self):
         return "gru"
@@ -321,22 +323,19 @@ class GRUCell(HybridRecurrentCell):
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
         prefix = "t%d_" % self._counter
-        prev_state_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=3 * self._hidden_size,
-                               name=prefix + "i2h")
-        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
-                               num_hidden=3 * self._hidden_size,
-                               name=prefix + "h2h")
+        prev = states[0]
+        i2h, h2h = self._fc_pair(
+            F, prefix, inputs, prev,
+            (i2h_weight, h2h_weight, i2h_bias, h2h_bias),
+            3 * self._hidden_size)
         i2h_r, i2h_z, i2h_n = F.SliceChannel(i2h, num_outputs=3,
                                              name=prefix + "i2h_slice")
         h2h_r, h2h_z, h2h_n = F.SliceChannel(h2h, num_outputs=3,
                                              name=prefix + "h2h_slice")
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
-        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
-        next_h_tmp = F.Activation(i2h_n + reset_gate * h2h_n,
-                                  act_type="tanh")
-        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_state_h
+        reset = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        cand = F.Activation(i2h_n + reset * h2h_n, act_type="tanh")
+        next_h = (1. - update) * cand + update * prev
         return next_h, [next_h]
 
 
@@ -350,43 +349,37 @@ class SequentialRNNCell(RecurrentCell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return _gathered_state_info(self._children.values(), batch_size)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return _gathered_begin_state(self._children.values(), **kwargs)
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        assert all(not isinstance(cell, BidirectionalCell)
-                   for cell in self._children.values())
-        for cell in self._children.values():
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        cells = list(self._children.values())
+        assert not any(isinstance(c, BidirectionalCell) for c in cells)
+        return _step_through(cells, inputs, states)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         self.reset()
-        num_cells = len(self._children)
-        _, _, F, batch_size = _format_sequence(length, inputs, layout, None)
+        cells = list(self._children.values())
+        _, _, F, batch_size = _format_sequence(length, inputs, layout,
+                                               None)
         begin_state = self._get_begin_state(F, begin_state, inputs,
                                             batch_size)
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._children.values()):
-            n = len(cell.state_info())
-            states = begin_state[p:p + n]
-            p += n
+        cursor, next_states = 0, []
+        for i, cell in enumerate(cells):
+            width = len(cell.state_info())
             inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                length, inputs=inputs,
+                begin_state=begin_state[cursor:cursor + width],
+                layout=layout,
+                merge_outputs=merge_outputs if i == len(cells) - 1
+                else None,
                 valid_length=valid_length)
+            cursor += width
             next_states.extend(states)
         return inputs, next_states
 
@@ -411,26 +404,19 @@ class HybridSequentialRNNCell(HybridRecurrentCell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return _gathered_state_info(self._children.values(), batch_size)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return _gathered_begin_state(self._children.values(), **kwargs)
 
     def __call__(self, inputs, states):
         self._counter += 1
         return self.forward(inputs, states)
 
     def forward(self, inputs, states):
-        next_states = []
-        p = 0
-        for cell in self._children.values():
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        return _step_through(list(self._children.values()), inputs,
+                             states)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
@@ -585,11 +571,11 @@ class BidirectionalCell(HybridRecurrentCell):
             "Bidirectional cannot be stepped. Please use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return _gathered_state_info(self._children.values(), batch_size)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return _gathered_begin_state(self._children.values(), **kwargs)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):

@@ -16,8 +16,8 @@ import csv as _csv
 import gzip
 import os
 import struct
+import queue
 import threading
-import queue as _queue
 from collections import namedtuple
 
 import numpy as np
@@ -305,96 +305,130 @@ class PrefetchingIter(DataIter):
     """Background-thread prefetcher over one or more iterators (io.py:410)
     — the Python analogue of dmlc::ThreadedIter in iter_prefetcher.h."""
 
+    class _Fetcher(threading.Thread):
+        """One background fetcher per inner iterator: each order placed
+        on the depth-1 `orders` queue produces one batch (or None at
+        end-of-epoch) on `results` — queue backpressure replaces the
+        reference's event-pair handshake."""
+
+        _STOP = object()
+
+        def __init__(self, it):
+            super().__init__(daemon=True)
+            self.it = it
+            self.orders = queue.Queue(1)
+            self.results = queue.Queue(1)
+            self.pending = False
+            self.start()
+
+        def run(self):
+            while True:
+                order = self.orders.get()
+                if order is self._STOP:
+                    return
+                try:
+                    self.results.put(self.it.next())
+                except StopIteration:
+                    self.results.put(None)
+                except Exception as exc:        # surfaced at take()
+                    self.results.put(exc)
+
+        def request(self):
+            self.orders.put("fetch")
+            self.pending = True
+
+        def take(self):
+            out = self.results.get()
+            self.pending = False
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+        def stop(self):
+            if self.pending:
+                self.results.get()
+            self.orders.put(self._STOP)
+
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = iters if isinstance(iters, list) else [iters]
+        assert self.iters
+        self.n_iter = len(self.iters)
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+        self.current_batch = None
+        self._drained = False
+        self._fetchers = [self._Fetcher(it) for it in self.iters]
+        for f in self._fetchers:
+            f.request()
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        for f in getattr(self, "_fetchers", ()):
+            f.stop()
+
+    def _renamed_descs(self, which, renames):
+        descs = []
+        for i, it in enumerate(self.iters):
+            for x in getattr(it, which):
+                if isinstance(x, DataDesc):
+                    # only full descs participate in renaming (tuple
+                    # descs pass through untouched — reference parity)
+                    name = x.name if renames is None \
+                        else renames[i][x.name]
+                    descs.append(DataDesc(name, x.shape, x.dtype))
+                else:
+                    descs.append(DataDesc(*x))
+        return descs
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._renamed_descs("provide_data", self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._renamed_descs("provide_label", self.rename_label)
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        # drain any in-flight fetch before touching the inner iterators
+        for f in self._fetchers:
+            if f.pending:
+                try:
+                    f.take()
+                except Exception:       # noqa: BLE001 — already seen
+                    pass                # by the caller via iter_next
+        for it in self.iters:
+            it.reset()
+        self._drained = False
+        for f in self._fetchers:
+            f.request()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._drained:
+            # end-of-epoch (or a failed fetch) with no orders
+            # outstanding: repeated calls stay False until reset()
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Different pad size between iterators"
+        try:
+            batches = [f.take() for f in self._fetchers]
+        except Exception:
+            self._drained = True        # reset() recovers the others
+            raise
+        ended = [b is None for b in batches]
+        if any(ended):
+            assert all(ended), \
+                "Number of entry mismatches between iterators"
+            self._drained = True
+            return False
+        assert len({b.pad for b in batches}) == 1, \
+            "Different pad size between iterators"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
+            [d for b in batches for d in b.data],
+            [l for b in batches for l in b.label],
+            batches[0].pad, batches[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        for f in self._fetchers:
+            f.request()
         return True
 
     def next(self):

@@ -162,32 +162,31 @@ class Module(BaseModule):
 
         attrs = self._symbol.attr_dict()
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        if cache_arr.shape != arr.shape:
-                            raise RuntimeError(
-                                "Parameter %s cannot be initialized from "
-                                "loading. Shape mismatch, target %s vs loaded %s"
-                                % (name, str(arr.shape), str(cache_arr.shape)))
-                        arr[:] = cache_arr._data
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(InitDesc(name, attrs.get(name)), arr)
-            else:
-                if initializer is not None:
-                    initializer(InitDesc(name, attrs.get(name)), arr)
+        def fill(name, arr, supplied):
+            """One param: prefer the caller-supplied value; otherwise
+            draw from the initializer (if the caller supplied a dict at
+            all, a missing name is an error unless allow_missing)."""
+            provided = None if supplied is None else supplied.get(name)
+            if provided is not None:
+                if provided is arr:
+                    return
+                if provided.shape != arr.shape:
+                    raise RuntimeError(
+                        "Parameter %s cannot be initialized from "
+                        "loading. Shape mismatch, target %s vs loaded "
+                        "%s" % (name, str(arr.shape),
+                                str(provided.shape)))
+                arr[:] = provided._data
+                return
+            if supplied is not None and not allow_missing:
+                raise RuntimeError("%s is not presented" % name)
+            if initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), arr)
 
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, aux_params)
+        for pool, supplied in ((self._arg_params, arg_params),
+                               (self._aux_params, aux_params)):
+            for name in sorted(pool):
+                fill(name, pool[name], supplied)
 
         self.params_initialized = True
         self._params_dirty = False

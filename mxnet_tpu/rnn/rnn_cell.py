@@ -16,48 +16,57 @@ zeroed input column) — same graphs, no unknown dimensions.
 from .. import symbol
 
 
-def _cells_state_info(cells):
-    return sum([c.state_info for c in cells], [])
+class _MultiCell(object):
+    """Delegation shared by compound cells (Sequential, Bidirectional):
+    state metadata and weight pack/unpack distribute over the member
+    cells in order."""
 
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
+    def _default_begin_state(self, step_input):
+        return [s for c in self._cells
+                for s in c._default_begin_state(step_input)]
 
-def _cells_unpack_weights(cells, args):
-    for cell in cells:
-        args = cell.unpack_weights(args)
-    return args
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
 
-
-def _cells_pack_weights(cells, args):
-    for cell in cells:
-        args = cell.pack_weights(args)
-    return args
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
 
 
 def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
-    """Split a merged (N,T,C)/(T,N,C) symbol into per-step symbols, or
-    merge a step list back, per `merge`."""
+    """Convert between a merged (N,T,C)/(T,N,C) symbol and a per-step
+    symbol list, per `merge` (True = want merged, False = want a list,
+    None = leave as-is); returns (inputs, time_axis)."""
     assert inputs is not None
     axis = layout.find("T")
-    in_axis = in_layout.find("T") if in_layout is not None else axis
-    if isinstance(inputs, symbol.Symbol):
-        if merge is False:
-            if len(inputs.list_outputs()) != 1:
-                raise ValueError("unroll doesn't allow grouped symbol as "
-                                 "input. Please convert to list first or "
-                                 "let unroll handle splitting.")
-            inputs = list(symbol.SliceChannel(inputs, axis=in_axis,
-                                              num_outputs=length,
-                                              squeeze_axis=1))
-    else:
+    in_axis = axis if in_layout is None else in_layout.find("T")
+    merged_in = isinstance(inputs, symbol.Symbol)
+    if merged_in and merge is False:
+        if len(inputs.list_outputs()) != 1:
+            raise ValueError(
+                "unroll doesn't allow grouped symbol as input. Please "
+                "convert to list first or let unroll handle splitting.")
+        return list(symbol.SliceChannel(
+            inputs, axis=in_axis, num_outputs=length,
+            squeeze_axis=1)), axis
+    if not merged_in:
         assert length is None or len(inputs) == length
-        if merge is True:
-            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=axis)
-            in_axis = axis
-    if isinstance(inputs, symbol.Symbol) and axis != in_axis:
+        if merge is not True:
+            return inputs, axis
+        steps = [symbol.expand_dims(i, axis=axis) for i in inputs]
+        return symbol.Concat(*steps, dim=axis), axis
+    if axis != in_axis:
         inputs = symbol.swapaxes(inputs, dim1=axis, dim2=in_axis)
     return inputs, axis
 
@@ -70,10 +79,10 @@ class RNNParams(object):
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = symbol.Variable(full, **kwargs)
+        return self._params[full]
 
 
 class BaseRNNCell(object):
@@ -123,23 +132,26 @@ class BaseRNNCell(object):
         assert not self._modified, \
             "After applying modifier cells (e.g. ZoneoutCell) the base " \
             "cell cannot be called directly. Call the modifier cell instead."
+        def concrete(shape):
+            # a 0 marks the batch axis (index 0 for NC states, index 1
+            # for the fused cells' LNC states) — fill it or fail loudly
+            if 0 not in shape:
+                return shape
+            if not batch_size:
+                raise ValueError(
+                    "begin_state with unknown batch needs batch_size= "
+                    "(static shapes) — or pass begin_state=None to "
+                    "unroll, which infers it from the inputs")
+            return tuple(batch_size if s == 0 else s for s in shape)
+
         states = []
         for info in self.state_info:
             self._init_counter += 1
-            shape = tuple(info["shape"])
-            if 0 in shape:
-                # the 0 marks the batch axis (index 0 for NC states,
-                # index 1 for the fused cells' LNC states)
-                if not batch_size:
-                    raise ValueError(
-                        "begin_state with unknown batch needs batch_size= "
-                        "(static shapes) — or pass begin_state=None to "
-                        "unroll, which infers it from the inputs")
-                shape = tuple(batch_size if s == 0 else s for s in shape)
-            kw = dict(kwargs)
             states.append(func(
-                shape, name="%sbegin_state_%d" % (self._prefix,
-                                                  self._init_counter), **kw))
+                concrete(tuple(info["shape"])),
+                name="%sbegin_state_%d" % (self._prefix,
+                                           self._init_counter),
+                **dict(kwargs)))
         return states
 
     def _zeros_like_state(self, step_input, n):
@@ -194,13 +206,12 @@ class BaseRNNCell(object):
         """Unroll `length` steps; returns (outputs, states)."""
         self.reset()
         inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self._default_begin_state(inputs[0])
-        states = begin_state
+        states = begin_state if begin_state is not None \
+            else self._default_begin_state(inputs[0])
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for step_in in inputs[:length]:
+            step_out, states = self(step_in, states)
+            outputs.append(step_out)
         outputs, _ = _normalize_sequence(length, outputs, layout,
                                          merge_outputs)
         return outputs, states
@@ -209,6 +220,35 @@ class BaseRNNCell(object):
         if isinstance(activation, str):
             return symbol.Activation(inputs, act_type=activation, **kwargs)
         return activation(inputs, **kwargs)
+
+    # -- shared machinery for the three unfused gate cells ------------
+    def _declare_fc_params(self, i2h_bias_init=None):
+        """The i2h/h2h weight+bias quartet every unfused cell owns."""
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias", init=i2h_bias_init) \
+            if i2h_bias_init is not None else self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def _nc_states(self, count):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}
+                for _ in range(count)]
+
+    def _step_name(self):
+        self._counter += 1
+        return "%st%d_" % (self._prefix, self._counter)
+
+    def _fc_pair(self, name, inputs, prev, gate_mult):
+        """The fused input/hidden projections one step consumes: both
+        land on the MXU as single matmuls over all gates at once."""
+        width = self._num_hidden * gate_mult
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=width, name="%si2h" % name)
+        h2h = symbol.FullyConnected(
+            data=prev, weight=self._hW, bias=self._hB,
+            num_hidden=width, name="%sh2h" % name)
+        return i2h, h2h
 
 
 class RNNCell(BaseRNNCell):
@@ -219,30 +259,19 @@ class RNNCell(BaseRNNCell):
         super(RNNCell, self).__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._declare_fc_params()
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return self._nc_states(1)
 
     @property
     def _gate_names(self):
         return ("",)
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name="%sh2h" % name)
+        name = self._step_name()
+        i2h, h2h = self._fc_pair(name, inputs, states[0], 1)
         output = self._get_activation(i2h + h2h, self._activation,
                                       name="%sout" % name)
         return output, [output]
@@ -255,42 +284,31 @@ class LSTMCell(BaseRNNCell):
                  forget_bias=1.0):
         super(LSTMCell, self).__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
         # forget_bias lands in the bias initializer (the LSTMBias init
         # sets the forget-gate quarter, initializer.py)
         from .. import initializer
-        self._iB = self.params.get(
-            "i2h_bias",
-            init=initializer.LSTMBias(forget_bias) if forget_bias else None)
-        self._hB = self.params.get("h2h_bias")
+        self._declare_fc_params(
+            initializer.LSTMBias(forget_bias) if forget_bias else None)
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
-                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return self._nc_states(2)
 
     @property
     def _gate_names(self):
         return ("_i", "_f", "_c", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%sh2h" % name)
+        name = self._step_name()
+        i2h, h2h = self._fc_pair(name, inputs, states[0], 4)
         gates = symbol.SliceChannel(i2h + h2h, num_outputs=4,
                                     name="%sslice" % name)
-        i = symbol.Activation(gates[0], act_type="sigmoid", name="%si" % name)
-        f = symbol.Activation(gates[1], act_type="sigmoid", name="%sf" % name)
-        c = symbol.Activation(gates[2], act_type="tanh", name="%sc" % name)
-        o = symbol.Activation(gates[3], act_type="sigmoid", name="%so" % name)
+        squash = {"i": "sigmoid", "f": "sigmoid", "c": "tanh",
+                  "o": "sigmoid"}
+        i, f, c, o = (
+            symbol.Activation(g, act_type=squash[tag],
+                              name="%s%s" % (name, tag))
+            for g, tag in zip(gates, "ifco"))
         next_c = f * states[1] + i * c
         next_h = o * symbol.Activation(next_c, act_type="tanh")
         return next_h, [next_h, next_c]
@@ -302,31 +320,20 @@ class GRUCell(BaseRNNCell):
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super(GRUCell, self).__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._declare_fc_params()
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        return self._nc_states(1)
 
     @property
     def _gate_names(self):
         return ("_r", "_z", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
+        name = self._step_name()
         prev = states[0]
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=prev, weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%sh2h" % name)
+        i2h, h2h = self._fc_pair(name, inputs, prev, 3)
         ir, iz, io = symbol.SliceChannel(i2h, num_outputs=3,
                                          name="%si2h_slice" % name)
         hr, hz, ho = symbol.SliceChannel(h2h, num_outputs=3,
@@ -522,7 +529,7 @@ class FusedRNNCell(BaseRNNCell):
         return stack
 
 
-class SequentialRNNCell(BaseRNNCell):
+class SequentialRNNCell(_MultiCell, BaseRNNCell):
     """Stack of cells applied layer by layer each step."""
 
     def __init__(self, params=None):
@@ -531,26 +538,6 @@ class SequentialRNNCell(BaseRNNCell):
 
     def add(self, cell):
         self._cells.append(cell)
-
-    @property
-    def state_info(self):
-        return _cells_state_info(self._cells)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
-
-    def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
-
-    def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
-
-    def _default_begin_state(self, step_input):
-        states = []
-        for cell in self._cells:
-            states.extend(cell._default_begin_state(step_input))
-        return states
 
     def __call__(self, inputs, states):
         self._counter += 1
@@ -620,6 +607,15 @@ class ModifierCell(BaseRNNCell):
         base_cell._modified = True
         self.base_cell = base_cell
 
+    def _borrow_base(self, method, *args, **kwargs):
+        """Temporarily lift the wrapped cell's modified flag to call
+        one of its methods on the modifier's behalf."""
+        self.base_cell._modified = False
+        try:
+            return method(*args, **kwargs)
+        finally:
+            self.base_cell._modified = True
+
     @property
     def params(self):
         self._own_params = False
@@ -631,16 +627,12 @@ class ModifierCell(BaseRNNCell):
 
     def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified
-        self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        return self._borrow_base(self.base_cell.begin_state,
+                                 func=func, **kwargs)
 
     def _default_begin_state(self, step_input):
-        self.base_cell._modified = False
-        begin = self.base_cell._default_begin_state(step_input)
-        self.base_cell._modified = True
-        return begin
+        return self._borrow_base(self.base_cell._default_begin_state,
+                                 step_input)
 
     def unpack_weights(self, args):
         return self.base_cell.unpack_weights(args)
@@ -701,11 +693,9 @@ class ResidualCell(ModifierCell):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=False)
-        self.base_cell._modified = True
+        outputs, states = self._borrow_base(
+            self.base_cell.unroll, length, inputs=inputs,
+            begin_state=begin_state, layout=layout, merge_outputs=False)
         inputs, _ = _normalize_sequence(length, inputs, layout, False)
         outputs = [o + i for o, i in zip(outputs, inputs)]
         outputs, _ = _normalize_sequence(length, outputs, layout,
@@ -713,33 +703,13 @@ class ResidualCell(ModifierCell):
         return outputs, states
 
 
-class BidirectionalCell(BaseRNNCell):
+class BidirectionalCell(_MultiCell, BaseRNNCell):
     """Runs l_cell forward and r_cell on the reversed sequence, concats."""
 
     def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
         super(BidirectionalCell, self).__init__(prefix="", params=params)
         self._output_prefix = output_prefix
         self._cells = [l_cell, r_cell]
-
-    @property
-    def state_info(self):
-        return _cells_state_info(self._cells)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
-
-    def _default_begin_state(self, step_input):
-        states = []
-        for cell in self._cells:
-            states.extend(cell._default_begin_state(step_input))
-        return states
-
-    def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
-
-    def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
 
     def __call__(self, inputs, states):
         raise NotImplementedError(

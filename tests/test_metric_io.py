@@ -476,3 +476,25 @@ def test_image_det_iter_threaded_decode_matches_sync(tmp_path):
     for (d0, l0), (d1, l1) in zip(sync_batches, thr_batches):
         np.testing.assert_array_equal(d0, d1)
         np.testing.assert_array_equal(l0, l1)
+
+
+def test_prefetching_iter_end_of_epoch_repeat_calls():
+    """iter_next() after end-of-epoch must keep returning False (no
+    hang: the queue-based fetchers have no order outstanding then),
+    and reset() must restart a full epoch."""
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    it = mx_io.PrefetchingIter(
+        mx_io.NDArrayIter(data, np.zeros(10, "float32"), batch_size=4))
+    first_epoch = 0
+    while it.iter_next():
+        first_epoch += 1
+    assert first_epoch == 3
+    assert it.iter_next() is False
+    assert it.iter_next() is False      # repeated calls stay cheap
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    second_epoch = 0
+    while it.iter_next():
+        second_epoch += 1
+    assert second_epoch == 3
