@@ -498,3 +498,71 @@ def test_prefetching_iter_end_of_epoch_repeat_calls():
     while it.iter_next():
         second_epoch += 1
     assert second_epoch == 3
+
+
+def test_prefetching_iter_propagates_fetch_errors_and_recovers():
+    """An inner-iterator exception must surface at iter_next (not hang
+    a queue), repeated calls must stay cheap, and reset() must bring
+    the pool back to a working epoch."""
+    class Flaky(mx_io.DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.inner = mx_io.NDArrayIter(
+                np.arange(32).reshape(8, 4).astype("float32"),
+                np.zeros(8, "float32"), batch_size=4)
+            self.fail_next = False
+        @property
+        def provide_data(self):
+            return self.inner.provide_data
+        @property
+        def provide_label(self):
+            return self.inner.provide_label
+        def reset(self):
+            self.fail_next = False
+            self.inner.reset()
+        def next(self):
+            if self.fail_next:
+                raise RuntimeError("decode failed")
+            return self.inner.next()
+
+    flaky = Flaky()
+    it = mx_io.PrefetchingIter(flaky)
+    assert it.iter_next()               # batch 1 (prefetched pre-failure)
+    flaky.fail_next = True              # poison the NEXT fetch
+    with pytest.raises(RuntimeError, match="decode failed"):
+        it.iter_next()                  # batch 2 fetch errors
+        it.iter_next()                  # (second call reaches the error)
+    assert it.iter_next() is False      # drained after error, no hang
+    it.reset()
+    n = 0
+    while it.iter_next():
+        n += 1
+    assert n == 2
+
+
+def test_prefetching_iter_tuple_descs_stay_unrenamed():
+    """rename maps apply to DataDesc entries only; plain (name, shape)
+    tuple descs pass through untouched (reference parity) even when
+    the rename map does not know their name."""
+    class TupleDescIter(mx_io.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.inner = mx_io.NDArrayIter(
+                np.zeros((4, 3), "float32"), np.zeros(4, "float32"),
+                batch_size=2)
+        @property
+        def provide_data(self):
+            return [("plain_data", (2, 3))]     # tuple form, no dtype
+        @property
+        def provide_label(self):
+            return [("plain_label", (2,))]
+        def reset(self):
+            self.inner.reset()
+        def next(self):
+            return self.inner.next()
+
+    it = mx_io.PrefetchingIter(TupleDescIter(),
+                               rename_data=[{"other": "renamed"}],
+                               rename_label=[{}])
+    assert it.provide_data[0].name == "plain_data"
+    assert it.provide_label[0].name == "plain_label"
