@@ -16,24 +16,23 @@ class AlexNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                # architecture spec as data: (channels, kernel, stride,
+                # pad, pool-after?) per conv stage
+                for ch, k, s, pad, pool in ((64, 11, 4, 2, True),
+                                            (192, 5, 1, 2, True),
+                                            (384, 3, 1, 1, False),
+                                            (256, 3, 1, 1, False),
+                                            (256, 3, 1, 1, True)):
+                    self.features.add(nn.Conv2D(
+                        ch, kernel_size=k, strides=s, padding=pad,
+                        activation="relu"))
+                    if pool:
+                        self.features.add(
+                            nn.MaxPool2D(pool_size=3, strides=2))
                 self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.features.add(nn.Dense(4096, activation="relu"),
+                                      nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):

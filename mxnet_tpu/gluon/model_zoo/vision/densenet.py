@@ -6,6 +6,7 @@ densenet121/161/169/201.
 
 from ....context import cpu
 from ...block import HybridBlock
+from ._factory import entry_point
 from ... import nn
 from ...nn import HybridConcurrent, Identity
 
@@ -21,30 +22,28 @@ def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
     return out
 
 
+def _bn_relu_conv(seq, channels, kernel, padding=0):
+    """The BN -> ReLU -> conv triplet every DenseNet component repeats."""
+    seq.add(nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, kernel_size=kernel, padding=padding,
+                      use_bias=False))
+
+
 def _make_dense_layer(growth_rate, bn_size, dropout):
     new_features = nn.HybridSequential(prefix="")
-    new_features.add(nn.BatchNorm())
-    new_features.add(nn.Activation("relu"))
-    new_features.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                               use_bias=False))
-    new_features.add(nn.BatchNorm())
-    new_features.add(nn.Activation("relu"))
-    new_features.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                               use_bias=False))
+    _bn_relu_conv(new_features, bn_size * growth_rate, 1)
+    _bn_relu_conv(new_features, growth_rate, 3, padding=1)
     if dropout:
         new_features.add(nn.Dropout(dropout))
-
+    # dense connectivity: the layer's output rides alongside its input
     out = HybridConcurrent(axis=1, prefix="")
-    out.add(Identity())
-    out.add(new_features)
+    out.add(Identity(), new_features)
     return out
 
 
 def _make_transition(num_output_features):
     out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
+    _bn_relu_conv(out, num_output_features, 1)
     out.add(nn.AvgPool2D(pool_size=2, strides=2))
     return out
 
@@ -55,24 +54,22 @@ class DenseNet(HybridBlock):
         super(DenseNet, self).__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
+            self.features.add(
+                nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                          padding=3, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
+            last = len(block_config) - 1
             for i, num_layers in enumerate(block_config):
                 self.features.add(_make_dense_block(
                     num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.AvgPool2D(pool_size=7))
-            self.features.add(nn.Flatten())
-
+                width += num_layers * growth_rate
+                if i != last:
+                    width //= 2
+                    self.features.add(_make_transition(width))
+            self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                              nn.AvgPool2D(pool_size=7), nn.Flatten())
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
@@ -98,17 +95,13 @@ def get_densenet(num_layers, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+def _densenet_entry(depth):
+    return entry_point("densenet%d" % depth,
+                       "DenseNet-%d model." % depth,
+                       get_densenet, depth)
 
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+densenet121 = _densenet_entry(121)
+densenet161 = _densenet_entry(161)
+densenet169 = _densenet_entry(169)
+densenet201 = _densenet_entry(201)

@@ -11,6 +11,7 @@ VPU with the pointwise 1x1 convs on the MXU.
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
+from ._factory import entry_point
 
 __all__ = ["MobileNet", "MobileNetV2",
            "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
@@ -142,33 +143,22 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _mobilenet_entry(name, getter, multiplier):
+    return entry_point(
+        name, "MobileNet%s with width multiplier %s." % (
+            " V2" if getter is get_mobilenet_v2 else "", multiplier),
+        getter, multiplier)
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return get_mobilenet_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return get_mobilenet_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return get_mobilenet_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return get_mobilenet_v2(0.25, **kwargs)
+mobilenet1_0 = _mobilenet_entry("mobilenet1_0", get_mobilenet, 1.0)
+mobilenet0_75 = _mobilenet_entry("mobilenet0_75", get_mobilenet, 0.75)
+mobilenet0_5 = _mobilenet_entry("mobilenet0_5", get_mobilenet, 0.5)
+mobilenet0_25 = _mobilenet_entry("mobilenet0_25", get_mobilenet, 0.25)
+mobilenet_v2_1_0 = _mobilenet_entry(
+    "mobilenet_v2_1_0", get_mobilenet_v2, 1.0)
+mobilenet_v2_0_75 = _mobilenet_entry(
+    "mobilenet_v2_0_75", get_mobilenet_v2, 0.75)
+mobilenet_v2_0_5 = _mobilenet_entry(
+    "mobilenet_v2_0_5", get_mobilenet_v2, 0.5)
+mobilenet_v2_0_25 = _mobilenet_entry(
+    "mobilenet_v2_0_25", get_mobilenet_v2, 0.25)

@@ -13,6 +13,7 @@ computation, so block structure here is purely about parameter layout.
 
 from ....context import cpu
 from ...block import HybridBlock
+from ._factory import entry_point
 from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2",
@@ -280,41 +281,20 @@ def get_resnet(version, num_layers, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _resnet_entry(version, depth):
+    return entry_point(
+        "resnet%d_v%d" % (depth, version),
+        "ResNet-%d V%d model (He et al.)." % (depth, version),
+        get_resnet, version, depth)
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _resnet_entry(1, 18)
+resnet34_v1 = _resnet_entry(1, 34)
+resnet50_v1 = _resnet_entry(1, 50)
+resnet101_v1 = _resnet_entry(1, 101)
+resnet152_v1 = _resnet_entry(1, 152)
+resnet18_v2 = _resnet_entry(2, 18)
+resnet34_v2 = _resnet_entry(2, 34)
+resnet50_v2 = _resnet_entry(2, 50)
+resnet101_v2 = _resnet_entry(2, 101)
+resnet152_v2 = _resnet_entry(2, 152)

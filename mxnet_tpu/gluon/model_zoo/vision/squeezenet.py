@@ -34,41 +34,30 @@ class SqueezeNet(HybridBlock):
         assert version in ("1.0", "1.1"), \
             "Unsupported SqueezeNet version %s: 1.0 or 1.1 expected" % version
         with self.name_scope():
+            # stem conv spec + fire-module schedule ("pool" rows are
+            # the 3x3/2 ceil-mode max pools) — the two versions differ
+            # only in this data
+            stem, schedule = {
+                "1.0": ((96, 7), ["pool", (16, 64), (16, 64), (32, 128),
+                                  "pool", (32, 128), (48, 192),
+                                  (48, 192), (64, 256), "pool",
+                                  (64, 256)]),
+                "1.1": ((64, 3), ["pool", (16, 64), (16, 64), "pool",
+                                  (32, 128), (32, 128), "pool",
+                                  (48, 192), (48, 192), (64, 256),
+                                  (64, 256)]),
+            }[version]
             self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.Conv2D(stem[0], kernel_size=stem[1],
+                                        strides=2),
+                              nn.Activation("relu"))
+            for step in schedule:
+                if step == "pool":
+                    self.features.add(nn.MaxPool2D(
+                        pool_size=3, strides=2, ceil_mode=True))
+                else:
+                    squeeze, expand = step
+                    self.features.add(_make_fire(squeeze, expand, expand))
             self.features.add(nn.Dropout(0.5))
 
             self.output = nn.HybridSequential(prefix="")

@@ -7,6 +7,7 @@ plus the batch-norm variants.
 from ....context import cpu
 from ....initializer import Xavier
 from ...block import HybridBlock
+from ._factory import entry_point
 from ... import nn
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
@@ -71,37 +72,21 @@ def get_vgg(num_layers, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _vgg_entry(depth, batch_norm):
+    suffix = "_bn" if batch_norm else ""
+    fixed = {"batch_norm": True} if batch_norm else {}
+    return entry_point(
+        "vgg%d%s" % (depth, suffix),
+        "VGG-%d model%s." % (depth, " with batch normalization"
+                             if batch_norm else ""),
+        get_vgg, depth, **fixed)
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+vgg11 = _vgg_entry(11, False)
+vgg13 = _vgg_entry(13, False)
+vgg16 = _vgg_entry(16, False)
+vgg19 = _vgg_entry(19, False)
+vgg11_bn = _vgg_entry(11, True)
+vgg13_bn = _vgg_entry(13, True)
+vgg16_bn = _vgg_entry(16, True)
+vgg19_bn = _vgg_entry(19, True)
