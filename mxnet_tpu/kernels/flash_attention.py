@@ -15,7 +15,8 @@ Backward: the standard flash decomposition with recompute —
   dQ kernel: grid (bh, q_blocks, k_blocks), accumulates over k
   dK/dV kernel: grid (bh, k_blocks, q_blocks), accumulates over q
 using the saved per-row logsumexp instead of the (m, l) pair, so only
-[T]-sized statistics are saved — activation memory is O(T), not O(T^2).
+per-row statistics are saved — activation memory is O(T * _STAT_LANES)
+(the lane-padded stat layout below), not O(T^2).
 
 This is the dense per-device block compute under parallel/ring.py's
 sequence-parallel ring; reference counterpart: the fused attention in
@@ -31,6 +32,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+# Row statistics (m, l, lse, delta) ride through HBM/VMEM with a
+# trailing lane dimension, every lane holding the same value. Mosaic
+# requires the last two dims of any block to be (8, 128)-divisible or
+# equal to the array dims; a [rows]-shaped stat with the batch dim
+# squeezed out of the block violates that, so [rows, 128] is the
+# lowerable layout (same choice as jax's reference TPU kernels). The
+# rule's "equal to the array dim" clause would also admit [rows, 1]
+# blocks at 1/128th the stat HBM traffic — a candidate on-chip A/B;
+# this constant is the only line to change.
+_STAT_LANES = 128
 
 
 def _causal_mask(s, q_start, k_start, block_q, block_k):
@@ -69,20 +81,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
-        m_prev = m_sc[...]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        m_prev = m_sc[...]                       # [bq, LANES], lanes equal
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
+        p = jnp.exp(s - m_new[:, :1])
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1, keepdims=True)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        acc_sc[...] = alpha[:, :1] * acc_sc[...] + pv
         m_sc[...] = m_new
 
     @pl.when(ki == num_kb - 1)
     def _flush():
         l = jnp.maximum(l_sc[...], 1e-30)
-        o_ref[...] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+        o_ref[...] = (acc_sc[...] / l[:, :1]).astype(o_ref.dtype)
         lse_ref[...] = m_sc[...] + jnp.log(l)
 
 
@@ -90,7 +102,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    """q: [BH, Tq, D], k/v: [BH, Tk, D] -> (o [BH, Tq, D], lse [BH, Tq])."""
+    """q: [BH, Tq, D], k/v: [BH, Tk, D] ->
+    (o [BH, Tq, D], lse [BH, Tq, _STAT_LANES] — lanes all equal)."""
     bh, seq_q, head_dim = q.shape
     seq_k = k.shape[1]
     scale = 1.0 / (head_dim ** 0.5)
@@ -111,18 +124,19 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((None, block_q, head_dim),
                          lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, _STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             # (o, m, l) online-softmax carry, persistent across the
             # sequential k axis
             pltpu.VMEM((block_q, head_dim), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -163,14 +177,14 @@ def _carry_kernel(off_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref, li_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
-        m_prev = m_sc[...]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        m_prev = m_sc[...]                       # [bq, LANES], lanes equal
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
+        p = jnp.exp(s - m_new[:, :1])
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1, keepdims=True)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        acc_sc[...] = alpha[:, :1] * acc_sc[...] + pv
         m_sc[...] = m_new
 
     @pl.when(ki == num_kb - 1)
@@ -224,8 +238,10 @@ def flash_carry_block(q, k, v, o, m, l, q_offset, kv_offset, causal,
                          lambda b, qi, ki: (b, qi, 0))
     kspec = pl.BlockSpec((None, block_k, head_dim),
                          lambda b, qi, ki: (b, ki, 0))
-    rspec = pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi))
-    return pl.pallas_call(
+    rspec = pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, qi, ki: (b, qi, 0))
+    stat3 = (bh, seq_q, _STAT_LANES)
+    o, m3, l3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -233,14 +249,17 @@ def flash_carry_block(q, k, v, o, m, l, q_offset, kv_offset, causal,
             qspec, kspec, kspec, qspec, rspec, rspec,
         ],
         out_specs=[qspec, rspec, rspec],
-        out_shape=[_struct(o.shape), _struct(m.shape), _struct(l.shape)],
+        out_shape=[_struct(o.shape), _struct(stat3), _struct(stat3)],
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(offsets, q, k, v, o, m, l)
+    )(offsets, q, k, v, o,
+      jnp.broadcast_to(m[:, :, None], stat3),
+      jnp.broadcast_to(l[:, :, None], stat3))
+    return o, m3[..., 0], l3[..., 0]
 
 
 # ------------------------------------------------------------ backward --
@@ -269,10 +288,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
-        p = jnp.exp(s - lse_ref[...][:, None])
+        p = jnp.exp(s - lse_ref[...][:, :1])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[...][:, None])
+        ds = p * (dp - delta_ref[...][:, :1])
         dq_sc[...] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -309,13 +328,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
-        p = jnp.exp(s - lse_ref[...][:, None])         # [bq, bk]
+        p = jnp.exp(s - lse_ref[...][:, :1])           # [bq, bk]
         dv_sc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[...][:, None])        # [bq, bk]
+        ds = p * (dp - delta_ref[...][:, :1])          # [bq, bk]
         # q is already scaled by 1/sqrt(D) above, which supplies the
         # single scale factor of dK = scale * dS^T Q
         dk_sc[...] += jax.lax.dot_general(
@@ -337,10 +356,15 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     scale = 1.0 / (head_dim ** 0.5)
     num_qb = seq_q // block_q
     num_kb = seq_k // block_k
-    # delta_i = sum_d dO_i O_i — tiny elementwise+reduce, XLA fuses it
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)
+    # delta_i = sum_d dO_i O_i — tiny elementwise+reduce, XLA fuses it;
+    # broadcast into the stat-lane layout the kernels stream (lse
+    # already arrives in it from the forward)
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), lse.shape)
 
+    sspec_q = pl.BlockSpec((None, block_q, _STAT_LANES),
+                           lambda b, qi, ki: (b, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale,
                           num_kb=num_kb),
@@ -354,8 +378,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
                          lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_q, head_dim),
                          lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+            sspec_q, sspec_q,
         ],
         out_specs=pl.BlockSpec((None, block_q, head_dim),
                                lambda b, qi, ki: (b, qi, 0)),
@@ -379,8 +402,10 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
                          lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_q, head_dim),
                          lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
-            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, _STAT_LANES),
+                         lambda b, ki, qi: (b, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, head_dim),
@@ -456,20 +481,20 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
                                                    (g, block_k), 1)
         s = jnp.where(k_pos < length, s, _NEG_INF)
-        m_prev = m_sc[...]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        m_prev = m_sc[...]                       # [g, LANES], lanes equal
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1)
+        p = jnp.exp(s - m_new[:, :1])
+        l_sc[...] = alpha * l_sc[...] + p.sum(axis=1, keepdims=True)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_sc[...] = alpha[:, None] * acc_sc[...] + pv
+        acc_sc[...] = alpha[:, :1] * acc_sc[...] + pv
         m_sc[...] = m_new
 
     @pl.when(ki == num_kb - 1)
     def _flush():
         l = jnp.maximum(l_sc[...], 1e-30)
-        o_ref[...] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+        o_ref[...] = (acc_sc[...] / l[:, :1]).astype(o_ref.dtype)
         # lse = m + log(l): log of the true sum of exp(scores) over this
         # cache — the sufficient statistic for cross-shard combination
         # (sequence-parallel flash decoding); rows with no valid keys
@@ -482,7 +507,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc,
 def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
     """q [BKV, G, D] (G query rows share each KV row — 1 for MHA, the
     group size for GQA), k/v [BKV, Tmax, D], lengths [BKV] ->
-    (o [BKV, G, D], lse [BKV, G])."""
+    (o [BKV, G, D], lse [BKV, G, _STAT_LANES] — lanes all equal)."""
     bkv, t_max, head_dim = k.shape
     g = q.shape[1]
     scale = 1.0 / (head_dim ** 0.5)
@@ -502,16 +527,16 @@ def _flash_decode_bh(q, k, v, lengths, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, g, head_dim), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((None, g), lambda b, ki: (b, 0)),
+            pl.BlockSpec((None, g, _STAT_LANES), lambda b, ki: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bkv, g, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((bkv, g), jnp.float32),
+            jax.ShapeDtypeStruct((bkv, g, _STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((g, head_dim), jnp.float32),
-            pltpu.VMEM((g,), jnp.float32),
-            pltpu.VMEM((g,), jnp.float32),
+            pltpu.VMEM((g, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((g, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(lengths, q, k, v)
@@ -574,7 +599,7 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=128,
         to_bh(k_cache), to_bh(v_cache),
         jnp.repeat(lengths, kv_heads), block_k, interpret)
     return (o.reshape(b, heads, head_dim),
-            lse.reshape(b, heads))
+            lse[..., 0].reshape(b, heads))
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
