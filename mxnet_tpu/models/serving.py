@@ -71,6 +71,41 @@ def _jitted_ragged_step(cfg, greedy, temperature, top_k, top_p):
         cfg, build)
 
 
+def _jitted_ragged_chunk(cfg, greedy, temperature, top_k, top_p, k):
+    """`k` ragged decode steps as ONE compiled program (lax.scan) —
+    multi-step scheduling. Each host round trip costs a dispatch plus
+    a result sync; when the chip sits behind a network tunnel that
+    latency (~tens of ms) dwarfs a decode step, so stepping once per
+    token caps the pool at ~1/RTT tokens per lane. Scanning k steps
+    on device amortizes the round trip k-fold; the host applies the
+    [k, B] token block afterwards, discarding any tail a request
+    emitted past its stop token or budget (bounded waste, the
+    standard continuous-batching trade for chunked scheduling)."""
+    def build(fz):
+        def chunk(params, cache, tok, pos, keys):
+            def body(carry, _):
+                cache, tok, pos, keys = carry
+                logits, cache = tf.decode_step(params, cache, tok,
+                                               pos, fz)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda l, kk: tf._sample_logits(
+                            l[None], kk, temperature, top_k, top_p)[0]
+                    )(logits, subs)
+                return (cache, nxt, pos + 1, keys), nxt
+            (cache, _, _, keys), toks = jax.lax.scan(
+                body, (cache, tok, pos, keys), None, length=k)
+            return toks, keys, cache           # toks [k, B]
+        return jax.jit(chunk, donate_argnums=tf._serving_donate(1))
+    return tf._serving_jit(
+        ("decode_ragged_chunk", greedy, float(temperature), top_k,
+         top_p, k), cfg, build)
+
+
 def _jitted_slot_write(cfg):
     """Write a 1-row prefilled cache into slot `i` of the pool cache.
 
@@ -119,12 +154,22 @@ class ContinuousBatcher(object):
     sample instead (generate()'s rule), with a PER-REQUEST seed at
     admit(). Either way a request's output is identical to its solo
     tf.generate() run — greedy argmax, or the same per-row key chain
-    (tested)."""
+    (tested).
+
+    `chunk_size=k` runs k decode steps per step() in one device
+    dispatch (_jitted_ragged_chunk) — multi-step scheduling for
+    high-dispatch-latency links. Token streams are unchanged (tested
+    chunked == unchunked == solo); what changes is granularity:
+    admission and eviction happen at chunk boundaries, and a lane
+    whose request ends mid-chunk idles for the remainder."""
 
     def __init__(self, params, cfg, max_batch=8, greedy=None,
-                 temperature=1.0, top_k=None, top_p=None):
+                 temperature=1.0, top_k=None, top_p=None,
+                 chunk_size=1):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
         self.params = params
         self.cfg = cfg
         self.max_batch = int(max_batch)
@@ -139,6 +184,7 @@ class ContinuousBatcher(object):
                 "greedy=True ignores temperature/top_k/top_p — pass "
                 "greedy=False (or omit greedy) to sample")
         self.greedy = greedy
+        self.chunk_size = int(chunk_size)
         self._controls = (self.greedy, float(temperature), top_k, top_p)
         self._cache = tf.init_cache(cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
@@ -219,9 +265,14 @@ class ContinuousBatcher(object):
     # ---- decode ----
 
     def step(self):
-        """One ragged decode step over all slots. Appends a token to
-        every active request; returns {rid: full token list} for the
-        requests that finished this step (their slots are freed)."""
+        """One scheduling step over all slots: `chunk_size` ragged
+        decode steps in one device dispatch (one for the default
+        chunk_size=1). Appends up to chunk_size tokens to every active
+        request; returns {rid: full token list} for the requests that
+        finished this step (their slots are freed). A request hitting
+        its stop token or budget mid-chunk ends there — the lane's
+        remaining in-chunk tokens are discarded and its slot frees at
+        the chunk boundary."""
         finished = {}
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
@@ -231,21 +282,36 @@ class ContinuousBatcher(object):
                 self._free(i)
         if not any(s is not None for s in self._slots):
             return finished
-        nxt, keys, self._cache = _jitted_ragged_step(
-            self.cfg, *self._controls)(
-            self.params, self._cache, jnp.asarray(self._tok),
-            jnp.asarray(self._pos), jnp.asarray(self._keys))
-        nxt = np.asarray(nxt).astype(np.int32)
+        k = self.chunk_size
+        if k == 1:
+            nxt, keys, self._cache = _jitted_ragged_step(
+                self.cfg, *self._controls)(
+                self.params, self._cache, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._keys))
+            toks = np.asarray(nxt).astype(np.int32)[None]   # [1, B]
+        else:
+            toks, keys, self._cache = _jitted_ragged_chunk(
+                self.cfg, *self._controls, k)(
+                self.params, self._cache, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._keys))
+            toks = np.asarray(toks).astype(np.int32)        # [k, B]
         # np.array (copy): asarray would give a READ-ONLY view of the
         # device buffer and the next admit()'s in-place key write fails
         self._keys = np.array(keys, np.uint32)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
-            req.tokens.append(int(nxt[i]))
-            req.emitted += 1
-            self._pos[i] += 1
-            self._tok[i] = nxt[i]
+            for j in range(k):
+                req.tokens.append(int(toks[j, i]))
+                req.emitted += 1
+                if req.done:
+                    break
+            # the device advanced every lane k steps regardless of
+            # where its request ended; mirror that here so a
+            # CONTINUING lane's next chunk starts from the device's
+            # true rolling state (freed lanes reset below)
+            self._pos[i] += k
+            self._tok[i] = toks[k - 1, i]
             if req.done:
                 finished[req.rid] = list(req.tokens)
                 self._free(i)
@@ -323,9 +389,11 @@ class ContinuousBatcher(object):
             already = {rid: req.emitted for rid, req in live.items()}
             finished = self.step()
             for rid, req in list(live.items()):
-                grew = req.emitted - already[rid]
-                if grew:             # ragged decode appends at most 1
-                    yield rid, req.tokens[-1], rid in finished
+                grew = req.emitted - already[rid]   # up to chunk_size
+                for off in range(grew):
+                    last = off == grew - 1
+                    yield (rid, req.tokens[-grew + off],
+                           last and rid in finished)
                 if rid in finished:
                     del live[rid]
                 elif req not in self._slots:

@@ -641,6 +641,77 @@ def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None):
     return jnp.einsum("bcd,vd->bcv", x, params["embed"]), new_cache
 
 
+def _spec_core(params, draft_params, prompt, cfg, dcfg, k, n_new):
+    """The whole speculative generation as ONE traceable program:
+    prefill both models, then a lax.while_loop of rounds — draft scan
+    (k small-model steps), one big-model verify chunk, device-side
+    acceptance and a masked window write into the token buffer. The
+    loop runs entirely on device; the host syncs once, on the result.
+
+    Acceptance math: drafts agree with the big model's argmax `target`
+    on a leading prefix; since drafts[i] == target[i] inside it, the
+    round's emissions are simply target[:accepted+1] (the +1 being the
+    corrected/bonus token), clamped to the remaining budget.
+
+    `n_new` is TRACED (the loop bound is data): one compiled program
+    serves every budget at a given prompt length — buffers size by
+    cfg.max_len, the caller slices. Varying n_new costs nothing;
+    only a new prompt length (or a k re-clamp near max_len)
+    re-specializes, like any jit shape."""
+    t_prompt = prompt.shape[1]
+    total = t_prompt + n_new
+    cache = init_cache(cfg, 1)
+    dcache = init_cache(dcfg, 1)
+    logits, cache = prefill(params, cache, prompt, cfg)
+    _, dcache = prefill(draft_params, dcache, prompt, dcfg)
+    # pad the buffer so the fixed-width (k+1) window write near the
+    # budget edge stays in bounds; emissions beyond `total` are masked
+    buf = jnp.zeros((cfg.max_len + k + 1,), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt[0], (0,))
+    buf = buf.at[t_prompt].set(
+        jnp.argmax(logits[0]).astype(jnp.int32))
+    acc_log = jnp.zeros((cfg.max_len,), jnp.int32)  # >= 1 token/round
+
+    def cond(state):
+        return state[0] < total
+
+    def body(state):
+        n, buf, cache, dcache, acc_log, rounds = state
+        tok0 = jax.lax.dynamic_slice(buf, (n - 1,), (1,))
+
+        def dbody(carry, i):
+            tok, dc = carry
+            dlogits, dc = decode_step(draft_params, dc, tok,
+                                      n - 1 + i, dcfg)
+            nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            return (nxt, dc), nxt[0]
+
+        (_, dcache), drafts = jax.lax.scan(
+            dbody, (tok0, dcache), jnp.arange(k))
+        # one big-model pass verifies all k proposals: the k+1 chunk
+        # rows are the contexts ending at buf[n-1], d1, ..., d_k, so
+        # row i predicts position n+i (row k is the bonus after a full
+        # acceptance)
+        window = jnp.concatenate([tok0, drafts])[None]
+        vlogits, cache2 = prefill_chunk(params, cache, window,
+                                        n - 1, cfg)
+        target = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)
+        accepted = jnp.cumprod(
+            (drafts == target[:k]).astype(jnp.int32)).sum()
+        emit = jnp.minimum(accepted + 1, total - n)
+        old = jax.lax.dynamic_slice(buf, (n,), (k + 1,))
+        new = jnp.where(jnp.arange(k + 1) < emit, target, old)
+        buf = jax.lax.dynamic_update_slice(buf, new, (n,))
+        acc_log = acc_log.at[rounds].set(accepted)
+        return (n + emit, buf, cache2, dcache, acc_log, rounds + 1)
+
+    state = (jnp.int32(t_prompt + 1), buf, cache, dcache, acc_log,
+             jnp.int32(0))
+    n, buf, _, _, acc_log, rounds = jax.lax.while_loop(cond, body,
+                                                       state)
+    return buf[None], acc_log, rounds
+
+
 def speculative_generate(params, draft_params, prompt, n_new, cfg,
                          draft_cfg, k_draft=4, return_stats=False):
     """Greedy speculative decoding: a small DRAFT model proposes
@@ -655,8 +726,20 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
     Returns [1, Tp+n_new] int32 (with return_stats=True, also a dict
     of per-round acceptance counts and big-model launch count).
 
-    Both configs must share vocab_size; caches self-heal across
-    rejected drafts because attention masks by verified position."""
+    The whole generation — both prefills and every draft/verify
+    round — compiles to ONE device program (_spec_core), dispatched
+    once: rounds advance in a lax.while_loop with the acceptance test
+    on device, so tokens/s is bounded by model compute, not by
+    host-loop round trips (which dominate when the accelerator sits
+    behind a network tunnel). The round count and per-round window
+    width k are fixed at trace time; near the budget edge extra
+    emissions are masked rather than re-shaped, and k is clamped so
+    the fixed-width draft/verify writes stay inside both caches
+    (cache writes beyond the verified stream self-heal: attention
+    masks by position, and rejected-draft entries are overwritten by
+    the next round before they become attendable).
+
+    Both configs must share vocab_size."""
     if prompt.shape[0] != 1:
         raise ValueError("speculative decoding serves batch=1")
     if cfg.vocab_size != draft_cfg.vocab_size:
@@ -665,61 +748,28 @@ def speculative_generate(params, draft_params, prompt, n_new, cfg,
     total = t_prompt + n_new
     if total > min(cfg.max_len, draft_cfg.max_len):
         raise ValueError("prompt+n_new exceeds a model's max_len")
-
-    cache = init_cache(cfg, 1)
-    dcache = init_cache(draft_cfg, 1)
-    logits, cache = _jitted_prefill(cfg)(params, cache, prompt)
-    _, dcache = _jitted_prefill(draft_cfg)(draft_params, dcache, prompt)
-    dstep = _jitted_decode_step(draft_cfg)
-    vchunk = _jitted_prefill_chunk(cfg)
-    buf = [int(t) for t in np.asarray(prompt[0])]
-    buf.append(int(np.argmax(np.asarray(logits[0]))))
-    d_done = t_prompt      # draft cache holds K/V for positions [0, d_done)
-    acceptances = []
-
-    while len(buf) < total:
-        n = len(buf)                     # verified tokens
-        k = min(k_draft, total - n)
-        # catch the draft cache up to the verified stream (normally one
-        # token — the corrected/bonus token; this is what keeps the
-        # cache hole-free after a fully-accepted round), then draft k
-        # tokens greedily
-        drafts = []
-        tok = None
-        for pos in range(d_done, n - 1):
-            _, dcache = dstep(draft_params, dcache,
-                              jnp.asarray([buf[pos]], jnp.int32), pos)
-        tok = jnp.asarray([buf[n - 1]], jnp.int32)
-        for i in range(k):
-            dlogits, dcache = dstep(draft_params, dcache, tok,
-                                    n - 1 + i)
-            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
-            drafts.append(int(tok[0]))
-        # one big-model pass verifies all k proposals: the k+1 chunk
-        # rows are the contexts ending at buf[n-1], d1, ..., d_k, so
-        # row i predicts position n+i (row k is the bonus after a full
-        # acceptance)
-        window = jnp.asarray([[buf[n - 1]] + drafts], jnp.int32)
-        vlogits, cache = vchunk(params, cache, window, n - 1)
-        target = np.argmax(np.asarray(vlogits[0]), axis=-1)
-        accepted = 0
-        while accepted < k and target[accepted] == drafts[accepted]:
-            accepted += 1
-        buf.extend(drafts[:accepted])
-        # draft cache is valid through the last ACCEPTED position:
-        # entries written from rejected drafts sit beyond it and are
-        # overwritten by the next catch-up/draft pass
-        d_done = n + accepted
-        acceptances.append(accepted)
-        if len(buf) < total:
-            # the first disagreeing position (or the bonus row after a
-            # full acceptance) comes from the big model — exactness
-            # with greedy generate()
-            buf.append(int(target[accepted]))
-    out = jnp.asarray([buf[:total]], jnp.int32)
+    if n_new < 1:
+        raise ValueError("n_new must be >= 1")
+    # deepest in-round write is position n-1+k with n <= total-1; keep
+    # it inside BOTH caches (k_draft degrades gracefully near max_len)
+    k = max(1, min(int(k_draft),
+                   cfg.max_len - total + 1,
+                   draft_cfg.max_len - total + 1))
+    import dataclasses
+    dfrozen = dataclasses.replace(draft_cfg)   # freeze like _serving_jit
+    fn = _serving_jit(
+        ("speculative", k, dataclasses.astuple(draft_cfg)), cfg,
+        lambda fz: jax.jit(
+            lambda p, dp, t, n: _spec_core(p, dp, t, fz, dfrozen,
+                                           k, n)))
+    out, acc_log, rounds = fn(params, draft_params, prompt,
+                              jnp.int32(n_new))
+    out = out[:, :total]          # host-side: n_new is data in-program
     if return_stats:
-        return out, {"acceptances": acceptances,
-                     "big_model_launches": 1 + len(acceptances)}
+        rounds = int(rounds)
+        return out, {"acceptances": [int(a) for a in
+                                     np.asarray(acc_log)[:rounds]],
+                     "big_model_launches": 1 + rounds}
     return out
 
 

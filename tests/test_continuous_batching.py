@@ -415,3 +415,100 @@ def test_stream_emits_terminal_event_for_cancel():
     other = next(r for r in seen if r != canceled_rid)
     assert seen[other][-1][1] is True and seen[other][-1][0] is not None
     assert srv.active_count == 0
+
+
+@pytest.mark.parametrize("chunk", [2, 4, 7])
+def test_chunked_pool_matches_generate(chunk):
+    """Multi-step scheduling (chunk_size=k) emits exactly the same
+    per-request greedy streams as chunk_size=1 and as solo generate(),
+    including requests whose budget or stop token lands mid-chunk."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    rng = np.random.RandomState(7)
+    jobs = [(p, int(rng.randint(1, 12))) for p in _prompts(rng, 6)]
+    srv = ContinuousBatcher(params, cfg, max_batch=3, chunk_size=chunk)
+    results, order = srv.run(jobs)
+    assert len(results) == len(jobs)
+    for rid, (prompt, n_new) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n_new, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(results[rid]), np.asarray(want[0]),
+            err_msg="chunk %d request %d" % (chunk, rid))
+
+
+def test_chunked_pool_sampling_matches_unchunked():
+    """The per-row key chain is chunk-invariant: a sampled request's
+    stream is identical at chunk_size 1 and 4 (and therefore to its
+    solo generate(seed) run, which chunk_size=1 is tested against)."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=5)
+    rng = np.random.RandomState(11)
+    jobs = [(p, int(rng.randint(2, 10)), int(rng.randint(0, 99)))
+            for p in _prompts(rng, 5)]
+    out = {}
+    for chunk in (1, 4):
+        srv = ContinuousBatcher(params, cfg, max_batch=2,
+                                temperature=0.7, top_k=13,
+                                chunk_size=chunk)
+        results, order = srv.run(jobs)
+        out[chunk] = [results[rid] for rid in order]
+    for a, b in zip(out[1], out[4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chunked_stop_token_and_stream_events():
+    """stop_token ends a request mid-chunk (tail discarded); stream()
+    yields every chunk token individually with done on the last."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    prompt = [5, 9, 2]
+    ref = [int(t) for t in np.asarray(
+        tf.generate(params, jnp.asarray([prompt], jnp.int32), 12,
+                    cfg)[0])][len(prompt):]
+    stop = ref[5]          # force an early stop mid-stream
+    want = ref[:ref.index(stop) + 1]       # up to and incl. the stop
+    srv = ContinuousBatcher(params, cfg, max_batch=2, chunk_size=4)
+    events = list(srv.stream([(prompt, 12, 0, stop)]))
+    toks = [t for _, t, _ in events]
+    dones = [d for _, _, d in events]
+    assert toks == want
+    assert dones == [False] * (len(want) - 1) + [True]
+    # same through run()
+    srv2 = ContinuousBatcher(params, cfg, max_batch=2, chunk_size=4)
+    results, order = srv2.run([(prompt, 12, 0, stop)])
+    assert results[order[0]][len(prompt):] == want
+
+
+def test_chunked_churn_matches_oracle():
+    """Randomized admit/cancel/step churn on a chunked pool: every
+    completed request still equals its solo generate() prefix."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=13)
+    rng = np.random.RandomState(23)
+    srv = ContinuousBatcher(params, cfg, max_batch=3, chunk_size=3)
+    jobs = {}
+    done = {}
+    rid_job = {}
+    pending = [(p, int(rng.randint(1, 14))) for p in _prompts(rng, 8)]
+    while pending or srv.active_count:
+        act = rng.randint(0, 3)
+        if act == 0 and pending and srv.has_capacity:
+            job = pending.pop()
+            rid = srv.admit(job[0], job[1])
+            rid_job[rid] = job
+        elif act == 1 and srv.active_count and rng.rand() < 0.3:
+            live = [r.rid for r in srv._slots if r is not None]
+            rid = live[rng.randint(len(live))]
+            srv.cancel(rid)
+            rid_job.pop(rid, None)      # canceled: no oracle check
+        else:
+            done.update(srv.step())
+    for rid, toks in done.items():
+        if rid not in rid_job:
+            continue
+        prompt, n_new = rid_job[rid]
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n_new, cfg)
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.asarray(want[0]))

@@ -622,3 +622,36 @@ def test_prefill_chunk_consistent_with_prefill():
             np.testing.assert_allclose(np.asarray(xb[key][:, :7]),
                                        np.asarray(xa[key][:, :7]),
                                        rtol=2e-4, atol=2e-4)
+
+
+def test_speculative_generate_budget_does_not_retrace():
+    """n_new is data in the one-dispatch speculative program: varying
+    the budget at a fixed prompt length reuses the compiled program
+    (tracing counted via a side-effecting probe), and every budget
+    still matches greedy generate() exactly."""
+    from mxnet_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=17, d_model=24, n_heads=4,
+                               n_layers=1, d_ff=32, max_len=32)
+    dcfg = tf.TransformerConfig(vocab_size=17, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=16, max_len=32)
+    params = tf.init_params(cfg, seed=41)
+    draft = tf.init_params(dcfg, seed=42)
+    prompt = jnp.asarray(
+        np.random.RandomState(43).randint(0, 17, (1, 4)), jnp.int32)
+    traces = []
+    orig = tf._spec_core
+
+    def probed(*a, **kw):
+        traces.append(1)
+        return orig(*a, **kw)
+
+    tf._spec_core = probed
+    try:
+        for n_new in (5, 9, 12):
+            spec = np.asarray(tf.speculative_generate(
+                params, draft, prompt, n_new, cfg, dcfg, k_draft=3))
+            ref = np.asarray(tf.generate(params, prompt, n_new, cfg))
+            assert np.array_equal(spec, ref), n_new
+    finally:
+        tf._spec_core = orig
+    assert sum(traces) == 1, "expected one trace, got %d" % sum(traces)
