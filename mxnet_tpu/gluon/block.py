@@ -491,10 +491,18 @@ class HybridBlock(Block):
                 param.cast(dtype)
 
     def _deferred_infer_shape(self, *args):
+        import numpy as _np
         try:
             inputs, out = self._get_graph(*args)
             flat_args, _ = _flatten(args, "input")
             real = [a for a in flat_args if a is not None]
+            # stamp the REAL input dtypes onto the data vars: the
+            # graph walk evaluates ops dtype-aware, and a cast()
+            # network (bf16 weights) fed by a default-fp32 data var
+            # hits mixed-dtype eval errors mid-graph, silently
+            # stranding every later parameter shape as unknown
+            for i, a in zip(inputs, real):
+                i._set_attr(__dtype__=str(_np.dtype(a.dtype)))
             kwargs = {i.name: a.shape for i, a in zip(inputs, real)}
             arg_shapes, _, aux_shapes = out.infer_shape_partial(**kwargs)
             sdict = dict(zip(out.list_arguments(), arg_shapes))

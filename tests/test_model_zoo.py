@@ -118,3 +118,25 @@ def test_pooling_kernel_exceeding_input_is_actionable():
     with pytest.raises(Exception) as exc:
         p(mx.nd.array(np.ones((1, 3, 2, 2), np.float32)))
     assert "kernel" in str(exc.value).lower()
+
+
+def test_cast_bf16_deferred_init_and_forward():
+    """net.cast('bfloat16') BEFORE the first forward: deferred shape
+    inference must run with the real input dtype (a default-fp32 data
+    var against bf16-cast weights used to fail mixed-dtype op eval
+    mid-graph, stranding every later BatchNorm parameter shape), and
+    the output dtype must follow the cast. BatchNorm params stay fp32
+    by design; the op computes fp32 stats and returns the input dtype."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0)
+                 .uniform(-1, 1, (2, 3, 32, 32)).astype("bfloat16"))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert str(out.dtype) == "bfloat16"
+    assert np.isfinite(out.asnumpy().astype(np.float32)).all()
