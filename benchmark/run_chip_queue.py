@@ -48,9 +48,13 @@ QUEUE = [
     ("bench_int8_residual",
      {"argv": [sys.executable, "bench.py"],
       "env": {"MXNET_INT8_RESIDUAL": "1"}}, 1200, True),
-    ("bench_fold_cast",
+    # fold-cast defaulted ON after its round-5 win; this leg measures
+    # the OFF side so the A/B pair stays in the table (renamed from
+    # bench_fold_cast, whose checkpointed rows measured the ON side —
+    # a resumed table must not satisfy the inverted leg)
+    ("bench_fold_cast_off",
      {"argv": [sys.executable, "bench.py"],
-      "env": {"MXNET_FOLD_CAST": "1"}}, 1200, True),
+      "env": {"MXNET_FOLD_CAST": "0"}}, 1200, True),
     ("bench_bs256",
      {"argv": [sys.executable, "bench.py"],
       "env": {"MXNET_BENCH_BATCH": "256",
@@ -115,6 +119,16 @@ def run_leg(name, spec, timeout):
     # owns waiting-out wedges — bench.py's own default wait-for-window
     # (for the bare driver run) would just burn leg timeouts here
     env.setdefault("MXNET_BENCH_WAIT_S", "0")
+    # a chip measurement must NEVER silently fall back to the host CPU
+    # and record plausible-looking garbage as "ok" (it happened: the
+    # chip claim of a just-exited leg lingers long enough that the next
+    # leg's probe times out, caches "dead", and pins CPU — the r05
+    # inference table came out at 1-core-CPU speeds). Erroring turns
+    # that into a wedge-shaped failure the watcher already knows how to
+    # sleep out and retry; disabling the probe cache keeps one timed-out
+    # probe from poisoning the following legs.
+    env.setdefault("MXNET_ON_WEDGED_BACKEND", "error")
+    env.setdefault("MXNET_BACKEND_PROBE_CACHE", "0")
     env.update(spec.get("env", {}))
     # NOTE: do NOT pop PYTHONPATH — the axon TPU plugin now lives at
     # /root/.axon_site and registers only when that path is importable;
@@ -210,7 +224,21 @@ def _refresh_last_measured(res):
 
 
 _WEDGE_MARKS = ("UNAVAILABLE", "wedged tunnel", "DEADLINE_EXCEEDED",
-                "timeout after")
+                "timeout after", "wedged TPU tunnel",
+                "MXNET_ON_WEDGED_BACKEND")
+
+
+def _wait_claim_release(probe, tries=4, gap=20.0):
+    """The tunnel releases a just-exited process's chip claim lazily;
+    a probe (or a leg's first device touch) in that window blocks and
+    reads as dead. Probe with patience before calling it a wedge."""
+    for i in range(tries):
+        if probe(use_cache=False):
+            return True
+        _status("probe blocked (claim-release lag or wedge), "
+                "retry %d/%d" % (i + 1, tries))
+        time.sleep(gap)
+    return False
 
 
 def _looks_wedged(res):
@@ -238,6 +266,9 @@ def run_pending(args, table, probe):
         if prior and (prior["ok"] or _exhausted(args, prior)):
             continue
         print("==== %s ====" % name, flush=True)
+        if not _wait_claim_release(probe):
+            _status("tunnel unreachable before %s" % name)
+            return "wedged"
         _status("RUNNING %s (timeout %ds) — keep the host quiet"
                 % (name, timeout))
         res = run_leg(name, spec, timeout)

@@ -66,6 +66,13 @@ def main():
                              "(contrib.fold_bn deployment path)")
     args = parser.parse_args()
 
+    # the backend is part of the record: a silent CPU fallback must be
+    # visible in the captured stdout, not discovered from the timings
+    import jax
+    from mxnet_tpu._discover import ensure_backend
+    ensure_backend()
+    print("backend: %s" % jax.default_backend(), flush=True)
+
     shape = tuple(int(d) for d in args.image_shape.split(","))
     for network in args.networks.split(","):
         for bs in (int(b) for b in args.batch_sizes.split(",")):

@@ -328,7 +328,10 @@ def test_bench_fold_cast_variant_matches():
         "    losses.append(float(loss))\n"
         "print('LOSSES', losses)\n" % ROOT)
     outs = {}
-    for name, env in (("base", {}), ("fold", {"MXNET_FOLD_CAST": "1"})):
+    # pin both sides explicitly: the default is fold-cast ON since the
+    # round-5 chip A/B, so an empty env would compare fold vs itself
+    for name, env in (("base", {"MXNET_FOLD_CAST": "0"}),
+                      ("fold", {"MXNET_FOLD_CAST": "1"})):
         r = _run([sys.executable, "-c", script], **env)
         assert r.returncode == 0, r.stderr[-2000:]
         line = [ln for ln in r.stdout.splitlines()
