@@ -95,6 +95,15 @@ QUEUE = [
     ("flash_attention",
      {"argv": [sys.executable, "benchmark/flash_attention_bench.py"]},
      1500, False),
+    # stat-lane A/B: [rows, 1] stat blocks are also Mosaic-legal and
+    # carry 1/128th the bwd stat traffic — does it lower, and does it
+    # move the flash bwd / LM-training numbers?
+    ("flash_stat_lanes1",
+     {"argv": [sys.executable, "benchmark/flash_attention_bench.py"],
+      "env": {"MXNET_FLASH_STAT_LANES": "1"}}, 1500, False),
+    ("train_lm_lanes1",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "env": {"MXNET_FLASH_STAT_LANES": "1"}}, 1500, False),
     ("bandwidth",
      {"argv": [sys.executable, "tools/bandwidth.py",
                "--num-batches", "10"]}, 900, False),
@@ -127,8 +136,12 @@ def run_leg(name, spec, timeout):
     # that into a wedge-shaped failure the watcher already knows how to
     # sleep out and retry; disabling the probe cache keeps one timed-out
     # probe from poisoning the following legs.
-    env.setdefault("MXNET_ON_WEDGED_BACKEND", "error")
-    env.setdefault("MXNET_BACKEND_PROBE_CACHE", "0")
+    # forced, not setdefault: an operator's exported fallback mode
+    # (e.g. MXNET_ON_WEDGED_BACKEND=cpu) must not re-enable the silent
+    # degradation; a leg's own spec env (applied below) can still
+    # override deliberately
+    env["MXNET_ON_WEDGED_BACKEND"] = "error"
+    env["MXNET_BACKEND_PROBE_CACHE"] = "0"
     env.update(spec.get("env", {}))
     # NOTE: do NOT pop PYTHONPATH — the axon TPU plugin now lives at
     # /root/.axon_site and registers only when that path is importable;
@@ -235,9 +248,10 @@ def _wait_claim_release(probe, tries=4, gap=20.0):
     for i in range(tries):
         if probe(use_cache=False):
             return True
-        _status("probe blocked (claim-release lag or wedge), "
-                "retry %d/%d" % (i + 1, tries))
-        time.sleep(gap)
+        if i + 1 < tries:
+            _status("probe blocked (claim-release lag or wedge), "
+                    "retry %d/%d" % (i + 1, tries))
+            time.sleep(gap)
     return False
 
 

@@ -25,6 +25,7 @@ ops), re-thought for the MXU/VMEM hierarchy instead of warp shuffles.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,10 +40,11 @@ _NEG_INF = -1e30
 # equal to the array dims; a [rows]-shaped stat with the batch dim
 # squeezed out of the block violates that, so [rows, 128] is the
 # lowerable layout (same choice as jax's reference TPU kernels). The
-# rule's "equal to the array dim" clause would also admit [rows, 1]
-# blocks at 1/128th the stat HBM traffic — a candidate on-chip A/B;
-# this constant is the only line to change.
-_STAT_LANES = 128
+# rule's "equal to the array dim" clause also admits [rows, 1] blocks
+# at 1/128th the stat HBM traffic (the dk/dv kernel re-streams lse and
+# delta once per q block) — env-overridable for the on-chip A/B
+# (benchmark/run_chip_queue.py flash_stat_lanes1 / train_lm_lanes1).
+_STAT_LANES = int(os.environ.get("MXNET_FLASH_STAT_LANES", "128"))
 
 
 def _causal_mask(s, q_start, k_start, block_q, block_k):

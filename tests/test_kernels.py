@@ -655,3 +655,35 @@ def test_speculative_generate_budget_does_not_retrace():
     finally:
         tf._spec_core = orig
     assert sum(traces) == 1, "expected one trace, got %d" % sum(traces)
+
+
+def test_flash_stat_lanes_env_value_equivalence():
+    """MXNET_FLASH_STAT_LANES=1 (the low-traffic stat layout queued
+    for the on-chip A/B) computes the same flash forward and backward
+    as the default 128-lane layout — checked on CPU so a value-level
+    layout bug never burns a scarce tunnel-alive window."""
+    import subprocess, sys, os
+    script = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from mxnet_tpu.kernels.flash_attention import flash_attention\n"
+        "rng = np.random.RandomState(0)\n"
+        "q, k, v = (jnp.asarray(rng.randn(2, 64, 2, 32), jnp.float32)\n"
+        "           for _ in range(3))\n"
+        "g = jax.grad(lambda q, k, v: jnp.sum(\n"
+        "    flash_attention(q, k, v, causal=True, block_q=32,\n"
+        "                    block_k=32) ** 2), argnums=(0, 1, 2))\n"
+        "outs = [flash_attention(q, k, v, causal=True, block_q=32,\n"
+        "                        block_k=32)] + list(g(q, k, v))\n"
+        "print('SUM', [float(jnp.sum(o)) for o in outs])\n")
+    sums = {}
+    for lanes in ("128", "1"):
+        env = dict(os.environ, MXNET_FLASH_STAT_LANES=lanes,
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("SUM")][0]
+        sums[lanes] = eval(line[4:])
+    np.testing.assert_allclose(sums["1"], sums["128"], rtol=1e-6)
