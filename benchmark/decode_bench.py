@@ -38,7 +38,9 @@ def main():
             vocab_size=32000, d_model=d_model, n_heads=heads,
             n_kv_heads=kvh or None,
             n_layers=layers, d_ff=4 * d_model, max_len=max_len,
-            dtype=jnp.bfloat16, use_flash_kernel=USE_FLASH)
+            dtype=jnp.bfloat16, use_flash_kernel=USE_FLASH,
+            kv_cache_int8=os.environ.get("MXNET_DECODE_KV_INT8", "0")
+            .lower() not in ("0", "false", ""))
         params = tf.init_params(cfg, seed=0)
         cache = tf.init_cache(cfg, BATCH)
         step = tf.make_decode_step(cfg)
@@ -53,10 +55,11 @@ def main():
         logits.block_until_ready()
         dt = time.time() - t0
         toks = BATCH * STEPS
+        mode = ("int8kv" if cfg.kv_cache_int8
+                else ("flash" if USE_FLASH else "dense"))
         print("decode %s%s max_len=%d bs=%d: %.1f tok/s (%.2f ms/step)"
-              % ("flash" if USE_FLASH else "dense",
-                 (" kvh=%d" % kvh) if kvh else "", max_len, BATCH,
-                 toks / dt, dt / STEPS * 1e3))
+              % (mode, (" kvh=%d" % kvh) if kvh else "", max_len,
+                 BATCH, toks / dt, dt / STEPS * 1e3))
 
 
 if __name__ == "__main__":

@@ -67,6 +67,12 @@ QUEUE = [
     ("decode_gqa",
      {"stdin": "benchmark/decode_bench.py",
       "env": {"MXNET_DECODE_KV_HEADS": "2"}}, 1500, False),
+    # int8 KV cache: half the cache bytes per token — decode is cache-
+    # read-bound, so this is the next bandwidth lever after GQA
+    ("decode_int8kv",
+     {"stdin": "benchmark/decode_bench.py",
+      "env": {"MXNET_DECODE_KV_INT8": "1",
+              "MXNET_DECODE_FLASH": "0"}}, 1500, False),
     ("serving",
      {"stdin": "benchmark/serving_bench.py"}, 1800, False),
     ("train_lm",
@@ -74,6 +80,13 @@ QUEUE = [
     ("train_lm_d2048",
      {"stdin": "benchmark/train_lm_bench.py",
       "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8"}},
+     1800, False),
+    # d1024 sits below the MFU target at bs=8 (cost model: 43 FLOP/B
+    # intensity vs the ~241 ridge); batch is the intensity lever for
+    # the activation-traffic share — measure it
+    ("train_lm_b32",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "env": {"MXNET_LM_BATCH": "32", "MXNET_LM_STEPS": "5"}},
      1800, False),
     ("inference_fp32",
      {"argv": [sys.executable,

@@ -14,6 +14,14 @@ Env knobs: MXNET_LM_DMODEL/LAYERS/SEQ/BATCH/STEPS override the model.
 Run from /root/repo via stdin so cwd lands on sys.path (leave the
 environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
 registers through it; overriding OR popping it breaks registration).
+
+MXNET_LM_COST=1 skips timing and instead prints XLA's own cost model
+for the compiled step (FLOPs + bytes accessed) and the roofline MFU
+it predicts — the attribution tool for a measured-MFU gap: if the
+measured number matches the bytes-predicted ceiling, the shape is
+bandwidth-bound and the fix is arithmetic intensity (layout/fusion),
+not scheduling. Runs on any backend (CPU fusion differs slightly from
+TPU's; treat bytes as an estimate).
 """
 
 import json
@@ -72,6 +80,41 @@ def main():
     # (fwd 2N + bwd 4N); attention FLOPs excluded, so MFU is slightly
     # conservative at long seq
     flops_per_step = 6.0 * n_params * tokens_per_step
+
+    if os.environ.get("MXNET_LM_COST"):
+        # roofline attribution from the compiler's own cost model
+        lowered = jax.jit(lambda p, m, t: step(p, m, t)).lower(
+            params, mom, tokens)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        xla_flops = float((ca or {}).get("flops", 0.0))
+        bytes_acc = float((ca or {}).get("bytes accessed", 0.0))
+        if not xla_flops and not bytes_acc:
+            print(json.dumps({"metric": "lm_train_cost_model",
+                              "error": "cost analysis unavailable on "
+                                       "backend %s"
+                                       % jax.default_backend()}))
+            return
+        hbm_bw = float(os.environ.get("MXNET_LM_HBM_GBS", 819)) * 1e9
+        t_flops = xla_flops / PEAK_FLOPS
+        t_bytes = bytes_acc / hbm_bw
+        bound = "compute" if t_flops >= t_bytes else "bandwidth"
+        pred = flops_per_step / (max(t_flops, t_bytes) * PEAK_FLOPS)
+        print(json.dumps({
+            "metric": "lm_train_cost_model", "d_model": d_model,
+            "layers": layers, "seq": seq, "batch": batch,
+            "remat": remat, "params_m": round(n_params / 1e6, 1),
+            "xla_flops_g": round(xla_flops / 1e9, 1),
+            "model_flops_6n_g": round(flops_per_step / 1e9, 1),
+            "bytes_accessed_gb": round(bytes_acc / 1e9, 3),
+            "intensity_flop_per_byte": round(xla_flops
+                                             / max(bytes_acc, 1), 1),
+            "bound": bound,
+            "roofline_mfu": round(min(pred, 1.0), 4),
+            "assumed_hbm_gbs": hbm_bw / 1e9,
+        }))
+        return
 
     params, mom, loss = step(params, mom, tokens)    # compile + warm
     float(loss)

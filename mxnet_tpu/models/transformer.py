@@ -75,6 +75,15 @@ class TransformerConfig:
     # reference's MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc:285,
     # applied at the idiomatic per-layer granularity)
     remat_layers: bool = False
+    # serving: int8 KV cache with per-(batch, position, head) scales —
+    # halves cache HBM, doubling the slot count or context a chip can
+    # hold, and the decode attention stays int8 end to end on the MXU
+    # (scales applied outside the contractions; v-scales fold into the
+    # softmax probabilities). Decode takes the dense grouped path —
+    # the flash kernel reads full-precision caches. ~0.5-1% relative
+    # error on attention outputs (tested); weight-only int8
+    # (quantize_weights_int8) composes independently.
+    kv_cache_int8: bool = False
 
 
 def _norm_shape(cfg):
@@ -378,12 +387,64 @@ def loss_fn(params, tokens, cfg, mesh=None):
 # dense masked einsum elsewhere — identical numerics.
 
 def init_cache(cfg, batch):
-    """Zeroed per-layer K/V caches sized to cfg.max_len."""
+    """Zeroed per-layer K/V caches sized to cfg.max_len. With
+    cfg.kv_cache_int8, each layer holds int8 codes plus per-(batch,
+    position, head) fp32 scales ("ks"/"vs") — ~half the HBM of a bf16
+    cache (the scale planes are 1/head_dim the size of the codes)."""
     hd = cfg.d_model // cfg.n_heads
     shape = (batch, cfg.max_len, _kvh(cfg), hd)
+    if cfg.kv_cache_int8:
+        sshape = shape[:3]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros(sshape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "vs": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
+
+
+def _kv_quant(x):
+    """Symmetric int8 over the last axis: x [..., D] ->
+    (codes int8 [..., D], scale fp32 [...])."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _kv_dequant(q8, scale, dtype):
+    return (q8.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def _cache_write_rows(layer_cache, k, v, start, cfg):
+    """Write fresh k/v [B, C, KVH, D] into cache positions
+    [start, start+C) — quantizing on the way in under kv_cache_int8."""
+    def upd(name, arr):
+        return jax.lax.dynamic_update_slice_in_dim(
+            layer_cache[name], arr.astype(layer_cache[name].dtype),
+            start, axis=1)
+    if cfg.kv_cache_int8:
+        kq, ks = _kv_quant(k)
+        vq, vs = _kv_quant(v)
+        return {"k": upd("k", kq), "ks": upd("ks", ks),
+                "v": upd("v", vq), "vs": upd("vs", vs)}
+    return {"k": upd("k", k), "v": upd("v", v)}
+
+
+def _cache_write_ragged(layer_cache, k_new, v_new, pos, cfg):
+    """Per-row scatter: row i writes its k/v [B, KVH, D] at pos[i]."""
+    rows = jnp.arange(k_new.shape[0])
+    def st(name, arr):
+        return layer_cache[name].at[rows, pos].set(
+            arr.astype(layer_cache[name].dtype))
+    if cfg.kv_cache_int8:
+        kq, ks = _kv_quant(k_new)
+        vq, vs = _kv_quant(v_new)
+        return {"k": st("k", kq), "ks": st("ks", ks),
+                "v": st("v", vq), "vs": st("vs", vs)}
+    return {"k": st("k", k_new), "v": st("v", v_new)}
 
 
 def quantize_weights_int8(params):
@@ -441,13 +502,18 @@ def shard_cache(cache, cfg, mesh):
     replicated — each device holds its heads' full cache and the
     attention needs no cross-device traffic; only wo's output
     contraction all-reduces over tp (GSPMD inserts it)."""
-    spec = P(cfg.dp_axis, None, cfg.tp_axis, None)
-    return jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), cache)
+    def _put(x):
+        # code planes are [B, T, KVH, D]; int8 scale planes [B, T, KVH]
+        spec = P(cfg.dp_axis, None, cfg.tp_axis, None)[: x.ndim]
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree.map(_put, cache)
 
 
-def _decode_attention(q, cache_k, cache_v, pos, cfg):
-    """q [B,H,D] vs cache [B,Tmax,H,D], attending positions <= pos."""
+def _decode_attention(q, layer_cache, pos, cfg):
+    """q [B,H,D] vs cache [B,Tmax,KVH,D], attending positions <= pos."""
+    cache_k, cache_v = layer_cache["k"], layer_cache["v"]
+    if cfg.kv_cache_int8:
+        return _decode_attention_int8(q, layer_cache, pos, cfg)
     if cfg.use_flash_kernel:
         import math
         from ..kernels import flash_decode
@@ -474,6 +540,36 @@ def _decode_attention(q, cache_k, cache_v, pos, cfg):
     return o.reshape(b, h, d).astype(q.dtype)
 
 
+def _decode_attention_int8(q, layer_cache, pos, cfg):
+    """Decode attention reading the int8 cache AS int8: both
+    contractions run int8 x int8 -> int32 on the MXU, with the scales
+    applied OUTSIDE the contraction dims — k-scales multiply the
+    scores per key position, v-scales fold into the softmax
+    probabilities before the a*v product (they vary along the
+    contraction axis, so they must ride inside the left operand).
+    Nothing dequantized is ever materialized in HBM: the cache is
+    streamed at int8 width, which is the point."""
+    kq, ks = layer_cache["k"], layer_cache["ks"]
+    vq, vs = layer_cache["v"], layer_cache["vs"]
+    b, h, d = q.shape
+    kvh = kq.shape[2]
+    g = h // kvh
+    q8, qs = _kv_quant(q.reshape(b, kvh, g, d))     # [B,KVH,G,D]/[B,KVH,G]
+    s = jnp.einsum("bkgd,btkd->bkgt", q8, kq,
+                   preferred_element_type=jnp.int32).astype(jnp.float32)
+    s = s * qs[..., None] * ks.transpose(0, 2, 1)[:, :, None, :] \
+        / np.sqrt(d)
+    t_pos = jnp.arange(kq.shape[1])
+    mask = t_pos[None, :] <= jnp.atleast_1d(pos)[:, None]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1)                  # [B,KVH,G,T]
+    a8, as_ = _kv_quant(a * vs.transpose(0, 2, 1)[:, :, None, :])
+    o = jnp.einsum("bkgt,btkd->bkgd", a8, vq,
+                   preferred_element_type=jnp.int32).astype(jnp.float32)
+    o = o * as_[..., None]
+    return o.reshape(b, h, d).astype(q.dtype)
+
+
 def prefill(params, cache, tokens, cfg):
     """Process the whole prompt in ONE forward pass, filling the KV
     cache for positions [0, Tp) — the serving-side complement of the
@@ -482,6 +578,13 @@ def prefill(params, cache, tokens, cfg):
     block with the training forward (_qkv/_causal_attention); ring
     (sp-sharded) attention is a training-path feature prefill does not
     engage. Returns (last_logits [B, vocab], cache)."""
+    if cfg.kv_cache_int8:
+        # delegate to the chunked path: its attention reads the prompt
+        # rows THROUGH the quantizer, exactly as decode later will —
+        # keeping solo generate() and the continuous batcher's
+        # admission (which prefills via prefill_chunk) bit-identical
+        return prefill_chunk(params, cache, tokens, jnp.int32(0), cfg,
+                             logits_row=jnp.int32(tokens.shape[1] - 1))
     params = _maybe_dequantize(params)
     b, t_p = tokens.shape
     x = params["embed"][tokens]
@@ -497,13 +600,7 @@ def prefill(params, cache, tokens, cfg):
             positions = jnp.arange(t_p)
             q = _rope(q, positions, cfg.rope_base)
             k = _rope(k, positions, cfg.rope_base)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["k"], k.astype(layer_cache["k"].dtype), 0,
-            axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["v"], v.astype(layer_cache["v"].dtype), 0,
-            axis=1)
-        new_cache.append({"k": ck, "v": cv})
+        new_cache.append(_cache_write_rows(layer_cache, k, v, 0, cfg))
         g = cfg.n_heads // _kvh(cfg)
         o = _causal_attention(q, _repeat_kv(k, g), _repeat_kv(v, g),
                               cfg, x.dtype)
@@ -610,27 +707,49 @@ def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None):
         if cfg.rope:
             q = _rope(q, chunk_pos, cfg.rope_base)
             k = _rope(k, chunk_pos, cfg.rope_base)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["k"], k.astype(layer_cache["k"].dtype), start,
-            axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            layer_cache["v"], v.astype(layer_cache["v"].dtype), start,
-            axis=1)
-        new_cache.append({"k": ck, "v": cv})
+        nlayer = _cache_write_rows(layer_cache, k, v, start, cfg)
+        new_cache.append(nlayer)
         # chunk row i sees cache positions <= start+i; grouped
         # contraction reads the KVH-head cache once per GROUP (like
         # _decode_attention — no materialized repeat on the hot path)
         dh = q.shape[-1]
         qg = q.reshape(b, c, _kvh(cfg), g, dh)
-        s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
-                       preferred_element_type=jnp.float32) / np.sqrt(dh)
-        t_pos = jnp.arange(ck.shape[1])
+        t_pos = jnp.arange(nlayer["k"].shape[1])
         mask = t_pos[None, :] <= (start + jnp.arange(c))[:, None]
-        s = jnp.where(mask[None, :, None, None, :], s, -1e30)
-        a = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
-                       preferred_element_type=jnp.float32
-                       ).astype(x.dtype).reshape(b, c, cfg.n_heads, dh)
+        if cfg.kv_cache_int8:
+            # the SAME quantized contraction as _decode_attention_int8
+            # (quantized q, k-scales on the scores, v-scales folded
+            # into quantized probabilities): chunked verification and
+            # stepped decode must read the cache identically, or
+            # speculative decoding's verify==decode contract drifts
+            kq, ks = nlayer["k"], nlayer["ks"]
+            vq, vs = nlayer["v"], nlayer["vs"]
+            q8, qs = _kv_quant(qg)
+            s = jnp.einsum("bckgd,btkd->bckgt", q8, kq,
+                           preferred_element_type=jnp.int32
+                           ).astype(jnp.float32)
+            s = s * qs[..., None] \
+                * ks.transpose(0, 2, 1)[:, None, :, None, :] \
+                / np.sqrt(dh)
+            s = jnp.where(mask[None, :, None, None, :], s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            a8, as_ = _kv_quant(
+                a * vs.transpose(0, 2, 1)[:, None, :, None, :])
+            o = jnp.einsum("bckgt,btkd->bckgd", a8, vq,
+                           preferred_element_type=jnp.int32
+                           ).astype(jnp.float32) * as_[..., None]
+            o = o.astype(x.dtype).reshape(b, c, cfg.n_heads, dh)
+        else:
+            ck, cv = nlayer["k"], nlayer["v"]
+            s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
+                           preferred_element_type=jnp.float32
+                           ) / np.sqrt(dh)
+            s = jnp.where(mask[None, :, None, None, :], s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32
+                           ).astype(x.dtype).reshape(b, c,
+                                                     cfg.n_heads, dh)
         x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
         x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
     x = _rms_norm(x, params["ln_f"])
@@ -805,17 +924,13 @@ def decode_step(params, cache, tokens, pos, cfg):
             k_new = _rope(k_new, pos, cfg.rope_base)
         if ragged:
             # per-row scatter: row i writes its K/V at its own pos[i]
-            ck = layer_cache["k"].at[jnp.arange(b), pos].set(
-                k_new.astype(layer_cache["k"].dtype))
-            cv = layer_cache["v"].at[jnp.arange(b), pos].set(
-                v_new.astype(layer_cache["v"].dtype))
+            nlayer = _cache_write_ragged(layer_cache, k_new, v_new,
+                                         pos, cfg)
         else:
-            ck = jax.lax.dynamic_update_slice_in_dim(
-                layer_cache["k"], k_new[:, None], pos, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                layer_cache["v"], v_new[:, None], pos, axis=1)
-        new_cache.append({"k": ck, "v": cv})
-        o = _decode_attention(q, ck, cv, pos, cfg)
+            nlayer = _cache_write_rows(layer_cache, k_new[:, None],
+                                       v_new[:, None], pos, cfg)
+        new_cache.append(nlayer)
+        o = _decode_attention(q, nlayer, pos, cfg)
         x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"])
         x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
     x = _rms_norm(x, params["ln_f"])
@@ -993,10 +1108,12 @@ def _beam_core(params, prompt, cache, n_new, k, length_penalty, cfg,
     cache = jax.tree.map(rep, cache)
     if mesh is not None:
         # traced equivalent of shard_cache for the beam-expanded rows
-        spec = P(cfg.dp_axis, None, cfg.tp_axis, None)
-        cache = jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec)), cache)
+        # (rank-sliced like shard_cache: int8 scale planes are rank 3)
+        def _constrain(x):
+            spec = P(cfg.dp_axis, None, cfg.tp_axis, None)[: x.ndim]
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+        cache = jax.tree.map(_constrain, cache)
     buf = jnp.zeros((b * k, total), jnp.int32)
     buf = buf.at[:, :t_prompt].set(jnp.repeat(prompt, k, axis=0))
     buf = buf.at[:, t_prompt].set(tok0.reshape(-1))
