@@ -390,7 +390,9 @@ def init_cache(cfg, batch):
     """Zeroed per-layer K/V caches sized to cfg.max_len. With
     cfg.kv_cache_int8, each layer holds int8 codes plus per-(batch,
     position, head) fp32 scales ("ks"/"vs") — ~half the HBM of a bf16
-    cache (the scale planes are 1/head_dim the size of the codes)."""
+    cache (the fp32 scale planes add 4/head_dim of the code bytes:
+    ~3% at head_dim 128, but 25% at head_dim 16 — small-head configs
+    keep less than the headline half)."""
     hd = cfg.d_model // cfg.n_heads
     shape = (batch, cfg.max_len, _kvh(cfg), hd)
     if cfg.kv_cache_int8:
@@ -431,6 +433,41 @@ def _cache_write_rows(layer_cache, k, v, start, cfg):
         return {"k": upd("k", kq), "ks": upd("ks", ks),
                 "v": upd("v", vq), "vs": upd("vs", vs)}
     return {"k": upd("k", k), "v": upd("v", v)}
+
+
+def _int8_cache_attention(qg, layer_cache, mask, out_dtype):
+    """The one int8 cache-read contraction (decode is its C=1 case):
+    qg [B, C, KVH, G, D] fp against cache codes [B, T, KVH, D] int8.
+    mask [B|1, C, T] marks attendable positions. Both products run
+    int8 x int8 -> int32 on the MXU; q quantizes per call, k-scales
+    multiply the scores per key position, v-scales fold into the
+    re-quantized probabilities (they vary along the contraction axis,
+    so they must ride the left operand). Every reader — stepped
+    decode, chunked prefill, speculative verification — goes through
+    THIS function, which is what keeps pool==solo and verify==decode
+    bit-identical: the contract is structural, not disciplinary."""
+    kq, ks = layer_cache["k"], layer_cache["ks"]
+    vq, vs = layer_cache["v"], layer_cache["vs"]
+    dh = qg.shape[-1]
+    q8, qs = _kv_quant(qg)
+    s = jnp.einsum("bckgd,btkd->bckgt", q8, kq,
+                   preferred_element_type=jnp.int32).astype(jnp.float32)
+    s = s * qs[..., None] * ks.transpose(0, 2, 1)[:, None, :, None, :] \
+        / np.sqrt(dh)
+    s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1)
+    a8, as_ = _kv_quant(a * vs.transpose(0, 2, 1)[:, None, :, None, :])
+    o = jnp.einsum("bckgt,btkd->bckgd", a8, vq,
+                   preferred_element_type=jnp.int32).astype(jnp.float32)
+    return (o * as_[..., None]).astype(out_dtype)
+
+
+def _cache_pspec(cfg, x):
+    """Serving-cache layout rule in one place (shard_cache and beam's
+    traced constraint must agree): batch over dp, heads over tp,
+    sequence replicated — truncated to the leaf's rank, because int8
+    scale planes are [B, T, KVH] while code planes are rank 4."""
+    return P(*P(cfg.dp_axis, None, cfg.tp_axis, None)[: x.ndim])
 
 
 def _cache_write_ragged(layer_cache, k_new, v_new, pos, cfg):
@@ -502,11 +539,9 @@ def shard_cache(cache, cfg, mesh):
     replicated — each device holds its heads' full cache and the
     attention needs no cross-device traffic; only wo's output
     contraction all-reduces over tp (GSPMD inserts it)."""
-    def _put(x):
-        # code planes are [B, T, KVH, D]; int8 scale planes [B, T, KVH]
-        spec = P(cfg.dp_axis, None, cfg.tp_axis, None)[: x.ndim]
-        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
-    return jax.tree.map(_put, cache)
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, _cache_pspec(cfg, x))), cache)
 
 
 def _decode_attention(q, layer_cache, pos, cfg):
@@ -541,33 +576,16 @@ def _decode_attention(q, layer_cache, pos, cfg):
 
 
 def _decode_attention_int8(q, layer_cache, pos, cfg):
-    """Decode attention reading the int8 cache AS int8: both
-    contractions run int8 x int8 -> int32 on the MXU, with the scales
-    applied OUTSIDE the contraction dims — k-scales multiply the
-    scores per key position, v-scales fold into the softmax
-    probabilities before the a*v product (they vary along the
-    contraction axis, so they must ride inside the left operand).
-    Nothing dequantized is ever materialized in HBM: the cache is
-    streamed at int8 width, which is the point."""
-    kq, ks = layer_cache["k"], layer_cache["ks"]
-    vq, vs = layer_cache["v"], layer_cache["vs"]
+    """Decode = the C=1 case of _int8_cache_attention (nothing
+    dequantized is ever materialized in HBM: the cache streams at
+    int8 width, which is the point)."""
     b, h, d = q.shape
-    kvh = kq.shape[2]
-    g = h // kvh
-    q8, qs = _kv_quant(q.reshape(b, kvh, g, d))     # [B,KVH,G,D]/[B,KVH,G]
-    s = jnp.einsum("bkgd,btkd->bkgt", q8, kq,
-                   preferred_element_type=jnp.int32).astype(jnp.float32)
-    s = s * qs[..., None] * ks.transpose(0, 2, 1)[:, :, None, :] \
-        / np.sqrt(d)
-    t_pos = jnp.arange(kq.shape[1])
-    mask = t_pos[None, :] <= jnp.atleast_1d(pos)[:, None]
-    s = jnp.where(mask[:, None, None, :], s, -1e30)
-    a = jax.nn.softmax(s, axis=-1)                  # [B,KVH,G,T]
-    a8, as_ = _kv_quant(a * vs.transpose(0, 2, 1)[:, :, None, :])
-    o = jnp.einsum("bkgt,btkd->bkgd", a8, vq,
-                   preferred_element_type=jnp.int32).astype(jnp.float32)
-    o = o * as_[..., None]
-    return o.reshape(b, h, d).astype(q.dtype)
+    kvh = layer_cache["k"].shape[2]
+    t_pos = jnp.arange(layer_cache["k"].shape[1])
+    mask = (t_pos[None, :] <= jnp.atleast_1d(pos)[:, None])[:, None, :]
+    o = _int8_cache_attention(
+        q.reshape(b, 1, kvh, h // kvh, d), layer_cache, mask, q.dtype)
+    return o.reshape(b, h, d)
 
 
 def prefill(params, cache, tokens, cfg):
@@ -584,7 +602,8 @@ def prefill(params, cache, tokens, cfg):
         # keeping solo generate() and the continuous batcher's
         # admission (which prefills via prefill_chunk) bit-identical
         return prefill_chunk(params, cache, tokens, jnp.int32(0), cfg,
-                             logits_row=jnp.int32(tokens.shape[1] - 1))
+                             logits_row=jnp.int32(tokens.shape[1] - 1),
+                             attend_limit=int(tokens.shape[1]))
     params = _maybe_dequantize(params)
     b, t_p = tokens.shape
     x = params["embed"][tokens]
@@ -669,13 +688,20 @@ def _jitted_decode_step(cfg):
         lambda p, c, t, pos: decode_step(p, c, t, pos, fz)))
 
 
-def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None):
+def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None,
+                  attend_limit=None):
     """Process a CHUNK of C tokens beginning at dynamic position
     `start`, writing their K/V into the cache and returning the logits
     after every chunk position ([B, C, vocab]) — or, with
     `logits_row` (dynamic scalar), only that row's logits [B, vocab]:
     the admission path of continuous batching needs one row and skips
     the O(C*vocab) head projection.
+
+    `attend_limit` (STATIC int) restricts the attention contraction to
+    the first `attend_limit` cache positions — exact (the mask zeroes
+    the tail anyway) whenever the caller knows start+C <= limit, e.g.
+    the whole-prompt prefill at start=0, which otherwise pays a
+    max_len-wide score matrix for a prompt-wide prompt.
 
     The chunked middle ground between prefill (whole prompt at 0) and
     decode_step (one token): long prompts stream through in fixed-size
@@ -714,37 +740,20 @@ def prefill_chunk(params, cache, tokens, start, cfg, logits_row=None):
         # _decode_attention — no materialized repeat on the hot path)
         dh = q.shape[-1]
         qg = q.reshape(b, c, _kvh(cfg), g, dh)
-        t_pos = jnp.arange(nlayer["k"].shape[1])
-        mask = t_pos[None, :] <= (start + jnp.arange(c))[:, None]
+        att = nlayer if attend_limit is None else \
+            {name: arr[:, :attend_limit] for name, arr in nlayer.items()}
+        t_pos = jnp.arange(att["k"].shape[1])
+        mask = (t_pos[None, :]
+                <= (start + jnp.arange(c))[:, None])[None]   # [1,C,T]
         if cfg.kv_cache_int8:
-            # the SAME quantized contraction as _decode_attention_int8
-            # (quantized q, k-scales on the scores, v-scales folded
-            # into quantized probabilities): chunked verification and
-            # stepped decode must read the cache identically, or
-            # speculative decoding's verify==decode contract drifts
-            kq, ks = nlayer["k"], nlayer["ks"]
-            vq, vs = nlayer["v"], nlayer["vs"]
-            q8, qs = _kv_quant(qg)
-            s = jnp.einsum("bckgd,btkd->bckgt", q8, kq,
-                           preferred_element_type=jnp.int32
-                           ).astype(jnp.float32)
-            s = s * qs[..., None] \
-                * ks.transpose(0, 2, 1)[:, None, :, None, :] \
-                / np.sqrt(dh)
-            s = jnp.where(mask[None, :, None, None, :], s, -1e30)
-            a = jax.nn.softmax(s, axis=-1)
-            a8, as_ = _kv_quant(
-                a * vs.transpose(0, 2, 1)[:, None, :, None, :])
-            o = jnp.einsum("bckgt,btkd->bckgd", a8, vq,
-                           preferred_element_type=jnp.int32
-                           ).astype(jnp.float32) * as_[..., None]
-            o = o.astype(x.dtype).reshape(b, c, cfg.n_heads, dh)
+            o = _int8_cache_attention(qg, att, mask, x.dtype) \
+                .reshape(b, c, cfg.n_heads, dh)
         else:
-            ck, cv = nlayer["k"], nlayer["v"]
+            ck, cv = att["k"], att["v"]
             s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
                            preferred_element_type=jnp.float32
                            ) / np.sqrt(dh)
-            s = jnp.where(mask[None, :, None, None, :], s, -1e30)
+            s = jnp.where(mask[:, :, None, None, :], s, -1e30)
             a = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32
@@ -1108,12 +1117,9 @@ def _beam_core(params, prompt, cache, n_new, k, length_penalty, cfg,
     cache = jax.tree.map(rep, cache)
     if mesh is not None:
         # traced equivalent of shard_cache for the beam-expanded rows
-        # (rank-sliced like shard_cache: int8 scale planes are rank 3)
-        def _constrain(x):
-            spec = P(cfg.dp_axis, None, cfg.tp_axis, None)[: x.ndim]
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(*spec)))
-        cache = jax.tree.map(_constrain, cache)
+        cache = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _cache_pspec(cfg, x))), cache)
     buf = jnp.zeros((b * k, total), jnp.int32)
     buf = buf.at[:, :t_prompt].set(jnp.repeat(prompt, k, axis=0))
     buf = buf.at[:, t_prompt].set(tok0.reshape(-1))

@@ -58,3 +58,19 @@ def test_serving_feature_composition():
         return_stats=True)
     assert np.array_equal(np.asarray(spec), expect[:1])
     assert stats["big_model_launches"] <= 8
+
+    # int8 KV cache on top of int8 weights + GQA + rope: the memorized
+    # continuation survives cache quantization (confident logits ->
+    # argmax robust to the ~1% attention error), and the continuous-
+    # batching pool over the int8 cache streams the same tokens
+    import dataclasses
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    cfg8 = dataclasses.replace(cfg, kv_cache_int8=True)
+    qp_local = T.quantize_weights_int8(params)
+    out8 = np.asarray(T.generate(qp_local, prompt, 8, cfg8))
+    assert np.array_equal(out8, expect), out8
+    srv = ContinuousBatcher(qp_local, cfg8, max_batch=2, chunk_size=3)
+    results, order = srv.run([(list(np.asarray(prompt[0])), 8),
+                              (list(np.asarray(prompt[1])), 8)])
+    got = np.stack([np.asarray(results[r]) for r in order])
+    assert np.array_equal(got, expect), got
