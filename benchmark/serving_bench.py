@@ -7,6 +7,8 @@ bound serving shape; decode_bench.py covers batched decode):
   generate        greedy tokens/s (prefill + lax.scan decode)
   generate_int8   same, with weight-only int8 params (dequant fused
                   into the matmuls)
+  generate_int8kv int8 weights AND int8 KV cache (kv_cache_int8):
+                  the decode loop streams the cache at int8 width
   speculative     tokens/s with a small random-init draft proposing
                   k=4 per round + measured acceptance (greedy-exact;
                   random draft ~never agrees, so this is the
@@ -115,6 +117,19 @@ def main():
 
     rate = _time_tokens(run_generate_int8, n_new)
     print('{"leg": "generate_int8", "tokens_per_s": %.1f}' % rate,
+          flush=True)
+
+    # --- fully-quantized serving: int8 weights + int8 KV cache (the
+    # decode loop reads the cache at int8 width, MXU int8 both dots) ---
+    import dataclasses
+    cfg_kv8 = dataclasses.replace(cfg, kv_cache_int8=True)
+
+    def run_generate_int8kv():
+        out = tf.generate(q8, prompt, n_new, cfg_kv8)
+        out.block_until_ready()
+
+    rate = _time_tokens(run_generate_int8kv, n_new)
+    print('{"leg": "generate_int8kv", "tokens_per_s": %.1f}' % rate,
           flush=True)
 
     # --- speculative (greedy-exact; acceptance is data-dependent) ---

@@ -432,3 +432,25 @@ def test_rope_pipeline_matches_unsharded():
     loss_pp = float(jax.jit(
         lambda p, t: T.loss_fn(p, t, cfg, mesh))(sharded, tok))
     assert abs(loss_ref - loss_pp) < 1e-4, (loss_ref, loss_pp)
+
+
+def test_sp_flash_decode_gqa_matches_repeated_kv():
+    """GQA through the sequence-parallel decode: a KVH-head cache
+    sharded over sp equals the same computation with the cache
+    repeated to MHA width (group mapping is per-shard, combine is
+    head-wise — both paths must agree including mid-shard lengths)."""
+    from mxnet_tpu.parallel.ring import sp_flash_decode
+
+    B, T, H, KVH, D = 2, 64, 4, 2, 16
+    rng = np.random.RandomState(29)
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    kc = jnp.asarray(rng.randn(B, T, KVH, D).astype(np.float32))
+    vc = jnp.asarray(rng.randn(B, T, KVH, D).astype(np.float32))
+    lengths = jnp.asarray([64, 23], np.int32)
+    mesh = make_mesh({"sp": 8})
+    gqa = sp_flash_decode(q, kc, vc, lengths, mesh)
+    g = H // KVH
+    mha = sp_flash_decode(q, jnp.repeat(kc, g, axis=2),
+                          jnp.repeat(vc, g, axis=2), lengths, mesh)
+    np.testing.assert_allclose(np.asarray(gqa), np.asarray(mha),
+                               rtol=1e-5, atol=1e-5)
