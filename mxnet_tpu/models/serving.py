@@ -161,11 +161,16 @@ class ContinuousBatcher(object):
     high-dispatch-latency links. Token streams are unchanged (tested
     chunked == unchunked == solo); what changes is granularity:
     admission and eviction happen at chunk boundaries, and a lane
-    whose request ends mid-chunk idles for the remainder."""
+    whose request ends mid-chunk idles for the remainder.
+
+    `cache_prefix(tokens)` prefills a shared prefix once (system
+    prompt, few-shot preamble); admissions whose prompt starts with a
+    cached prefix prefill only the suffix. LRU-bounded
+    (prefix_cache_slots row caches on device)."""
 
     def __init__(self, params, cfg, max_batch=8, greedy=None,
                  temperature=1.0, top_k=None, top_p=None,
-                 chunk_size=1):
+                 chunk_size=1, prefix_cache_slots=4):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
@@ -192,6 +197,11 @@ class ContinuousBatcher(object):
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
         self._slots = [None] * self.max_batch   # Request or None
         self._next_rid = 0
+        # prefix cache: tuple(tokens) -> (row_cache, last_row_logits),
+        # LRU-bounded. Each entry holds one [1, max_len] row cache on
+        # device — prefix_cache_slots bounds that memory
+        self._prefix_cache = {}
+        self._prefix_slots = int(prefix_cache_slots)
 
     # ---- admission ----
 
@@ -202,6 +212,56 @@ class ContinuousBatcher(object):
     @property
     def has_capacity(self):
         return self.active_count < self.max_batch
+
+    def cache_prefix(self, tokens):
+        """Prefill `tokens` once and keep the row cache + last-row
+        logits for reuse: a later admit() whose prompt starts with
+        these tokens prefills only the suffix (system prompts,
+        few-shot preambles — the shared-prefix serving pattern).
+        The prefix is processed at its exact length (no bucket pad),
+        so the cached row holds zeros beyond it and nothing stale is
+        ever attendable. Entries are LRU-bounded by
+        prefix_cache_slots; each holds one full-width row cache on
+        device. Returns the prefix length."""
+        if self._prefix_slots < 1:
+            raise ValueError("prefix caching disabled "
+                             "(prefix_cache_slots=0)")
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        if not toks:
+            raise ValueError("empty prefix")
+        if len(toks) >= self.cfg.max_len:
+            raise ValueError("prefix %d must leave room under "
+                             "max_len %d" % (len(toks),
+                                             self.cfg.max_len))
+        key = tuple(toks)
+        hit = self._prefix_cache.pop(key, None)
+        if hit is None:
+            logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
+                self.params, tf.init_cache(self.cfg, 1),
+                jnp.asarray([toks], jnp.int32),
+                jnp.int32(0), jnp.int32(len(toks) - 1))
+            hit = (row_cache, logits)
+        self._prefix_cache[key] = hit                # insert/refresh
+        while len(self._prefix_cache) > self._prefix_slots:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        return len(toks)
+
+    def _lookup_prefix(self, prompt):
+        """Longest cached prefix of `prompt` -> (p_len, row_cache,
+        last_row_logits-or-None). The cached trees are never mutated
+        (prefill returns new arrays; the chunk-row wrapper does not
+        donate), so one prefix serves any number of admissions."""
+        best = None
+        for key in self._prefix_cache:
+            if len(key) <= len(prompt) \
+                    and tuple(prompt[:len(key)]) == key:
+                if best is None or len(key) > len(best):
+                    best = key
+        if best is None:
+            return 0, tf.init_cache(self.cfg, 1), None
+        hit = self._prefix_cache.pop(best)
+        self._prefix_cache[best] = hit               # LRU refresh
+        return len(best), hit[0], hit[1]
 
     def admit(self, prompt, n_new, seed=0, stop_token=None):
         """Prefill `prompt` into a free slot; returns the request id,
@@ -225,21 +285,28 @@ class ContinuousBatcher(object):
                     None)
         if slot is None:
             return None
-        # clamp: the bucket can pass max_len (e.g. max_len=96, t_p=70
-        # -> bucket 128) and the cache axis is max_len wide; width >=
-        # t_p always holds since t_p + n_new <= max_len
-        width = min(_bucket(t_p), self.cfg.max_len)
-        padded = np.zeros((1, width), np.int32)
-        padded[0, :t_p] = prompt
-        row_cache = tf.init_cache(self.cfg, 1)
-        # one compiled prefill per bucket width (prefill_chunk already
-        # specializes per chunk shape); start=0 fills positions
-        # [0, width) — rows beyond t_p are pad garbage that decode
-        # overwrites before attention can reach them
-        logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
-            self.params, row_cache, jnp.asarray(padded),
-            jnp.int32(0), jnp.int32(t_p - 1))
-        last = logits[0]
+        # longest cached prefix (0 + a fresh row cache when none):
+        # only the suffix prefills
+        p_len, row_cache, pfx_logits = self._lookup_prefix(prompt)
+        if p_len == t_p:
+            last = pfx_logits[0]       # whole prompt is the prefix
+        else:
+            # clamp: the bucket can pass max_len (e.g. max_len=96,
+            # suffix 70 -> bucket 128) and the cache axis is max_len
+            # wide; width >= suffix always holds since t_p + n_new <=
+            # max_len
+            width = min(_bucket(t_p - p_len),
+                        self.cfg.max_len - p_len)
+            padded = np.zeros((1, width), np.int32)
+            padded[0, : t_p - p_len] = prompt[p_len:]
+            # one compiled prefill per bucket width (prefill_chunk
+            # already specializes per chunk shape); fills positions
+            # [p_len, p_len+width) — rows beyond t_p are pad garbage
+            # that decode overwrites before attention can reach them
+            logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
+                self.params, row_cache, jnp.asarray(padded),
+                jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
+            last = logits[0]
         if self.greedy:
             first = int(np.argmax(np.asarray(last)))
         else:

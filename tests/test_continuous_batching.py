@@ -512,3 +512,73 @@ def test_chunked_churn_matches_oracle():
                            n_new, cfg)
         np.testing.assert_array_equal(np.asarray(toks),
                                       np.asarray(want[0]))
+
+
+def test_prefix_cache_streams_equal_no_prefix():
+    """Shared-prefix admission (suffix-only prefill) emits the same
+    streams as the pool without prefix caching and as solo
+    generate() — greedy, mixed prefix/non-prefix prompts, slot
+    reuse after the prefix entries."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    system = [7, 3, 9, 1, 4]                     # the shared preamble
+    jobs = [(system + [11, 22], 8), ([5, 6], 6),
+            (system + [33], 9), (system, 5)]     # incl. exact match
+    srv = ContinuousBatcher(params, cfg, max_batch=2)
+    assert srv.cache_prefix(system) == len(system)
+    results, order = srv.run(jobs)
+    for rid, (p, n) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([p], jnp.int32), n, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(results[rid]), np.asarray(want[0]),
+            err_msg="prefix-cached request %d" % rid)
+
+
+def test_prefix_cache_lru_and_validation():
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2,
+                            prefix_cache_slots=2)
+    srv.cache_prefix([1, 2])
+    srv.cache_prefix([3, 4])
+    srv.cache_prefix([1, 2])        # refresh: [3,4] is now oldest
+    srv.cache_prefix([5, 6])        # evicts [3,4]
+    assert set(srv._prefix_cache) == {(1, 2), (5, 6)}
+    with pytest.raises(ValueError):
+        srv.cache_prefix([])
+    with pytest.raises(ValueError):
+        srv.cache_prefix(list(range(cfg.max_len)))
+    off = ContinuousBatcher(params, cfg, max_batch=2,
+                            prefix_cache_slots=0)
+    with pytest.raises(ValueError):
+        off.cache_prefix([1])
+
+
+def test_prefix_cache_longest_match_and_sampling():
+    """Two nested cached prefixes: admission uses the longest; the
+    sampled per-request chain is unchanged by prefix reuse."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=5)
+    srv = ContinuousBatcher(params, cfg, max_batch=2,
+                            temperature=0.7, top_k=13)
+    srv.cache_prefix([2, 4])
+    srv.cache_prefix([2, 4, 6, 8])
+    prompt = [2, 4, 6, 8, 10]
+    p_len, _, _ = srv._lookup_prefix(prompt)
+    assert p_len == 4
+    rid = srv.admit(prompt, 7, seed=42)
+    # exact-match admission under sampling too: the whole prompt IS a
+    # cached prefix, so the first token comes from the stored logits —
+    # the key chain must be identical to solo generate(seed=...)
+    rid2 = srv.admit([2, 4, 6, 8], 5, seed=9)
+    out = {}
+    while srv.active_count:
+        out.update(srv.step())
+    want = tf.generate(params, jnp.asarray([prompt], jnp.int32), 7,
+                       cfg, temperature=0.7, top_k=13, seed=42)
+    np.testing.assert_array_equal(np.asarray(out[rid]),
+                                  np.asarray(want[0]))
+    want2 = tf.generate(params, jnp.asarray([[2, 4, 6, 8]], jnp.int32),
+                        5, cfg, temperature=0.7, top_k=13, seed=9)
+    np.testing.assert_array_equal(np.asarray(out[rid2]),
+                                  np.asarray(want2[0]))
