@@ -1,0 +1,173 @@
+"""Attribute the framework-vs-hand-built byte gap instruction by
+instruction.
+
+cost_compare's timed chip A/B (BENCH_TABLE cost_compare_timed) shows
+the shipped framework ResNet-50 step moving ~10 GB/step more than the
+hand-built jax step at the same shapes — bytes, not flops. XLA's
+cost_analysis() only gives totals, so this script compiles BOTH steps
+for the attached backend, parses the optimized HLO text, and estimates
+per-instruction HBM traffic as (output bytes + sum of operand output
+bytes). That is the same accounting "bytes accessed" uses, minus
+fusion-internal elision — good enough to rank instructions and diff
+programs. Each row carries the op_name metadata XLA preserves from
+jaxpr, which names the originating layer/transform (e.g.
+"transpose(jvp(...))/conv..." or a custom-vjp residual), so the gap
+maps back to source structure.
+
+    python - < benchmark/hlo_diff.py                 # both legs, diff
+    python - framework < benchmark/hlo_diff.py
+    python - handbuilt < benchmark/hlo_diff.py
+
+Run from /root/repo via stdin so the repo root stays on sys.path.
+"""
+
+import os
+import re
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+BATCH = int(os.environ.get("MXNET_COST_BATCH", "128"))
+SIZE = int(os.environ.get("MXNET_COST_SIZE", "224"))
+TOP = int(os.environ.get("MXNET_HLO_TOP", "25"))
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16, "token": 0, "opaque": 0,
+}
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%?[\w.-]+) = (\([^)]*\)|\S+) ([\w-]+)\((.*)$")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def shape_bytes(spec):
+    """Total bytes of an HLO shape spec (handles tuples)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(spec):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def parse_hlo(text):
+    """-> list of dict(name, opcode, out_bytes, operands, op_name)."""
+    rows = []
+    sizes = {}
+    for line in text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, shape, opcode, rest = m.groups()
+        name = name.lstrip("%")
+        out = shape_bytes(shape)
+        sizes[name] = out
+        ops = []
+        # operand list: %name or name refs before any ), attrs follow
+        depth = 1
+        arglist = []
+        for ch in rest:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            arglist.append(ch)
+        for ref in re.findall(r"%?([\w.-]+)", "".join(arglist)):
+            if ref in sizes:
+                ops.append(ref)
+        meta = _METADATA_RE.search(rest)
+        rows.append({
+            "name": name, "opcode": opcode, "out": out,
+            "operands": ops,
+            "op_name": meta.group(1) if meta else "",
+        })
+    by_name = {r["name"]: r for r in rows}
+    for r in rows:
+        r["accessed"] = r["out"] + sum(
+            by_name[o]["out"] for o in r["operands"] if o in by_name)
+    return rows
+
+
+_SKIP = ("parameter", "constant", "tuple", "get-tuple-element",
+         "bitcast")
+
+
+def summarize(tag, rows):
+    agg = defaultdict(lambda: [0, 0])
+    total = 0
+    for r in rows:
+        if r["opcode"] in _SKIP:
+            continue
+        agg[r["opcode"]][0] += r["accessed"]
+        agg[r["opcode"]][1] += 1
+        total += r["accessed"]
+    print("\n== %s: %.1f GB estimated accessed ==" % (tag, total / 1e9))
+    for op, (b, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        if b < 5e7:
+            continue
+        print("  %-24s %8.2f GB  x%d" % (op, b / 1e9, n))
+    print("  -- top instructions --")
+    top = sorted((r for r in rows if r["opcode"] not in _SKIP),
+                 key=lambda r: -r["accessed"])[:TOP]
+    for r in top:
+        print("  %7.1f MB  %-12s %s" % (
+            r["accessed"] / 1e6, r["opcode"], r["op_name"][-90:]))
+    return agg, total
+
+
+def main():
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import importlib.util
+    import jax
+    import jax.numpy as jnp
+
+    spec = importlib.util.spec_from_file_location(
+        "cost_compare", os.path.join("benchmark", "cost_compare.py"))
+    cc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cc)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(BATCH, 3, SIZE, SIZE).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (BATCH,)), jnp.int32)
+    which = [a for a in sys.argv[1:] if a in ("framework", "handbuilt")]
+
+    results = {}
+    if not which or "framework" in which:
+        import bench
+        step, args, mom, aux = bench.build_train_step(BATCH, SIZE)
+        c = step.lower(args, mom, aux, x, y).compile()
+        results["framework"] = summarize(
+            "framework", parse_hlo(c.as_text()))
+    if not which or "handbuilt" in which:
+        step, params, mom = cc.hb_build(BATCH, SIZE)
+        c = step.lower(params, mom, x, y).compile()
+        results["handbuilt"] = summarize(
+            "handbuilt", parse_hlo(c.as_text()))
+
+    if len(results) == 2:
+        fa, ft = results["framework"]
+        ha, ht = results["handbuilt"]
+        print("\n== diff (framework - handbuilt) ==")
+        print("  total: %+.1f GB" % ((ft - ht) / 1e9))
+        ops = set(fa) | set(ha)
+        for op in sorted(ops, key=lambda o: -(fa[o][0] - ha[o][0])):
+            d = fa[op][0] - ha[op][0]
+            if abs(d) < 5e7:
+                continue
+            print("  %-24s %+8.2f GB  (x%d vs x%d)" % (
+                op, d / 1e9, fa[op][1], ha[op][1]))
+
+
+if __name__ == "__main__":
+    main()

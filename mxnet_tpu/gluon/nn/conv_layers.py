@@ -4,8 +4,9 @@ Reference: python/mxnet/gluon/nn/conv_layers.py:47-1202 (Conv1D/2D/3D,
 Conv*DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling, ReflectionPad2D).
 
 TPU notes: convs lower to lax.conv_general_dilated on the MXU (NC[DHW]
-layout kept for API parity; XLA re-layouts internally), pooling to a
-fused strided-slice window reduction (ops/nn.py:_window_reduce).
+layout kept for API parity; XLA re-layouts internally); max pooling
+lowers to native lax.reduce_window (ops/nn.py:pooling), avg/sum/lp to
+a fused strided-slice window accumulation (ops/nn.py:_window_reduce).
 """
 
 from ..block import HybridBlock

@@ -6,11 +6,11 @@ Upsampling), src/operator/rnn.cc (fused RNN), src/operator/leaky_relu.cc,
 src/operator/softmax_output.cc, src/operator/instance_norm.cc.
 
 TPU-native mapping: convs/matmuls are lax.conv_general_dilated/dot_general on
-the MXU (bf16-friendly); pooling is a strided-slice window reduction (XLA's
-own reduce_window decomposition, chosen because it linearizes under
-vjp-of-jit); the fused RNN is a lax.scan over time steps (XLA pipelines the
-per-step matmuls); there are no cuDNN/MKLDNN forks — one implementation,
-every backend.
+the MXU (bf16-friendly); max pooling is native lax.reduce_window with XLA's
+select-and-scatter backward (first-max ties, the reference convention);
+avg/sum/lp pooling is a strided-slice window accumulation; the fused RNN is a
+lax.scan over time steps (XLA pipelines the per-step matmuls); there are no
+cuDNN/MKLDNN forks — one implementation, every backend.
 """
 
 import functools
@@ -235,13 +235,22 @@ def residual_knobs():
 
 def _pool_index_residual():
     import os
-    # default ON: first-max tie semantics match the reference's pooling
-    # backward (mshadow assigns the gradient to the FIRST max position;
-    # jnp.maximum tie-splits 0.5/0.5 — materially different after relu,
-    # where windows are full of equal zeros), AND the saved residual is
-    # a 1-byte window index per OUTPUT element instead of the bf16
-    # max-tree intermediates. MXNET_POOL_INDEX_RESIDUAL=0 reverts.
-    return os.environ.get("MXNET_POOL_INDEX_RESIDUAL", "1").lower() in (
+    # default OFF since the round-5 HLO diff (benchmark/hlo_diff.py):
+    # the index path's stacked-window forward materializes a K-times
+    # activation buffer and its backward runs K sequential full-buffer
+    # scatter-adds — on chip that was most of the 10 GB/step gap
+    # between the shipped ResNet step (56.2 GB, 2187 img/s) and the
+    # hand-built step (45.8 GB, 2461 img/s) in the same session
+    # (BENCH_TABLE cost_compare_timed). The native lax.reduce_window
+    # path lowers to one fused window reduce + select-and-scatter and
+    # carries the SAME first-max tie convention the reference uses
+    # (mshadow pooling; verified: gradient of an all-equal window lands
+    # entirely on the first position), so the semantic argument that
+    # originally motivated the index path holds natively.
+    # MXNET_POOL_INDEX_RESIDUAL=1 re-enables the 1-byte-index variant
+    # (its residual is smaller; useful when memory capacity, not
+    # bandwidth, binds).
+    return os.environ.get("MXNET_POOL_INDEX_RESIDUAL", "0").lower() in (
         "1", "true")
 
 
@@ -366,18 +375,33 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
                 hi += stride[i] - rem
         pads.append((lo, hi))
 
-    # Window reduce as a max/add over kernel-offset strided slices. This is
-    # the decomposition XLA itself applies, it fuses cleanly, and — unlike
-    # lax.reduce_window — it linearizes, so jax.vjp over a jitted CachedOp
-    # graph works (reduce_window has no linearization rule as of jax 0.9).
     if pool_type == "max":
+        # Opt-in 1-byte-index residual variant (capacity lever; see
+        # _pool_index_residual for the chip evidence that retired it
+        # as the default).
         if _pool_index_residual():
             return _maxpool_index(data, tuple(kernel), tuple(stride),
                                   tuple(tuple(p) for p in pads),
                                   tuple(data.shape), str(data.dtype))
+        # Native windowed max: one fused reduce-window forward, XLA
+        # select-and-scatter backward that assigns each window's
+        # gradient to its FIRST max (the reference's mshadow tie
+        # convention — all-equal windows, common after relu, send the
+        # whole cotangent to position 0, not a 1/K split). It also
+        # linearizes (jax.linearize / double-grad verified), so vjp
+        # over jitted CachedOp graphs works. The init value must be a
+        # PYTHON literal: jax only dispatches to the differentiable
+        # reduce_window_max primitive when it recognizes the monoid
+        # identity; a concrete device array falls back to the generic
+        # reduce_window primitive, which has no autodiff rule.
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
-            else jnp.iinfo(data.dtype).min
-        return _window_reduce(data, kernel, stride, pads, jnp.maximum, init)
+            else int(jnp.iinfo(data.dtype).min)
+        nbatch = data.ndim - nd
+        return lax.reduce_window(
+            data, init, lax.max,
+            (1,) * nbatch + tuple(kernel),
+            (1,) * nbatch + tuple(stride),
+            [(0, 0)] * nbatch + [tuple(p) for p in pads])
     if pool_type == "lp":
         s = _window_reduce(jnp.power(jnp.abs(data), p_value), kernel, stride,
                            pads, jnp.add, 0)

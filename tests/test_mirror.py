@@ -220,10 +220,10 @@ np.save(sys.argv[1], np.concatenate(
 
 
 def test_maxpool_index_residual_first_max_ties_and_grads():
-    """Index-residual max pooling (default): gradients match the
-    maximum-tree path on tie-free data, and ties follow the reference's
-    FIRST-max convention (mshadow pooling backward) instead of
-    jnp.maximum's 0.5/0.5 split."""
+    """Native reduce_window max pooling (default) and the opt-in
+    index-residual path agree on tie-free data, and ties follow the
+    reference's FIRST-max convention (mshadow pooling backward) instead
+    of jnp.maximum's 0.5/0.5 split."""
     import os
     import subprocess
     import sys
@@ -243,7 +243,9 @@ def test_maxpool_index_residual_first_max_ties_and_grads():
     g_index = x.grad.asnumpy().copy()
 
     env = dict(os.environ)
-    env["MXNET_POOL_INDEX_RESIDUAL"] = "0"
+    # opt-in index path in the subprocess (default is the native
+    # reduce_window path the in-process leg above just used)
+    env["MXNET_POOL_INDEX_RESIDUAL"] = "1"
     code = (
         "import sys; sys.path.insert(0, %r)\n"
         "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
@@ -284,17 +286,24 @@ def test_maxpool_index_residual_first_max_ties_and_grads():
 
 def test_maxpool_index_residual_large_kernel():
     """Window index must not wrap for kernels with > 256 offsets
-    (uint8 would route gradients to wrong positions)."""
+    (uint8 would route gradients to wrong positions). Forces the
+    opt-in index path — the native reduce_window default keeps no
+    index at all."""
+    import os
     import mxnet_tpu as mx
     from mxnet_tpu import autograd
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(1, 1, 20, 20))
     x.attach_grad()
-    with autograd.record():
-        # 17x17 kernel = 289 offsets > 256
-        y = mx.nd.Pooling(x, kernel=(17, 17), stride=(1, 1),
-                          pool_type="max")
-        y.sum().backward()
+    os.environ["MXNET_POOL_INDEX_RESIDUAL"] = "1"
+    try:
+        with autograd.record():
+            # 17x17 kernel = 289 offsets > 256
+            y = mx.nd.Pooling(x, kernel=(17, 17), stride=(1, 1),
+                              pool_type="max")
+            y.sum().backward()
+    finally:
+        del os.environ["MXNET_POOL_INDEX_RESIDUAL"]
     g = x.grad.asnumpy()[0, 0]
     xa = x.asnumpy()[0, 0]
     # each 17x17 window contributes 1.0 at its (first) argmax; verify
