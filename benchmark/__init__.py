@@ -1,0 +1,2 @@
+"""Benchmark scripts (run standalone via stdin from the repo root, or
+imported as a package for the shared helpers in common.py)."""

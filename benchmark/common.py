@@ -1,0 +1,18 @@
+"""Shared helpers for the benchmark scripts."""
+
+
+def fetch_barrier(out):
+    """A REAL device barrier: fetch a scalar computed from ``out``.
+
+    The axon tunnel's ``block_until_ready`` can return before remote
+    completion (bench.py's lesson; the first flash-attention chip sweep
+    recorded 0.03 ms "backward" times and five-digit "TFLOP/s" through
+    it). A host ``float()`` of a value data-dependent on the result
+    cannot return early, and fetching a single element keeps the
+    barrier itself cheap. Works for any pytree of arrays: syncing one
+    leaf is enough because a single device executes its queue in
+    order.
+    """
+    import jax
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf[(0,) * leaf.ndim])

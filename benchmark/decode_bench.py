@@ -23,6 +23,7 @@ USE_FLASH = os.environ.get("MXNET_DECODE_FLASH", "1") not in ("0", "false")
 
 
 def main():
+    from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
     pin_platform_from_env()
     import jax
@@ -47,12 +48,12 @@ def main():
         tok = jnp.zeros((BATCH,), jnp.int32)
         # warm at the tail position (worst case: full cache read)
         logits, cache = step(params, cache, tok, max_len - STEPS - 1)
-        logits.block_until_ready()
+        fetch_barrier(logits)
         t0 = time.time()
         for i in range(STEPS):
             logits, cache = step(params, cache, tok,
                                  max_len - STEPS + i)
-        logits.block_until_ready()
+        fetch_barrier(logits)
         dt = time.time() - t0
         toks = BATCH * STEPS
         mode = ("int8kv" if cfg.kv_cache_int8

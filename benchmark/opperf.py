@@ -159,10 +159,11 @@ def dispatch_latency(iters=3000):
         sync()
         return (time.time() - t0) / iters * 1e6
 
+    from benchmark.common import fetch_barrier
     results = {}
     jadd = jax.jit(lambda x, y: x + y)
     results["raw_jnp_jit_add"] = timeit(
-        lambda: jadd(a_j, b_j), lambda: jadd(a_j, b_j).block_until_ready())
+        lambda: jadd(a_j, b_j), lambda: fetch_barrier(jadd(a_j, b_j)))
     results["nd_eager_add"] = timeit(
         lambda: a + b, lambda: (a + b).wait_to_read())
 

@@ -52,6 +52,7 @@ def _time_tokens(fn, n_tokens, warm_runs=1, timed_runs=3):
 
 
 def main():
+    from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
     pin_platform_from_env()
     import jax
@@ -93,7 +94,7 @@ def main():
 
     def run_prefill():
         logits, _ = pre(params, cache0, prompt)
-        logits.block_until_ready()
+        fetch_barrier(logits)
 
     rate = _time_tokens(run_prefill, t_prompt)
     print('{"leg": "prefill", "tokens_per_s": %.1f}' % rate, flush=True)
@@ -101,7 +102,7 @@ def main():
     # --- greedy generate ---
     def run_generate():
         out = tf.generate(params, prompt, n_new, cfg)
-        out.block_until_ready()
+        fetch_barrier(out)
         return out
 
     rate = _time_tokens(run_generate, n_new)
@@ -113,7 +114,7 @@ def main():
 
     def run_generate_int8():
         out = tf.generate(q8, prompt, n_new, cfg)
-        out.block_until_ready()
+        fetch_barrier(out)
 
     rate = _time_tokens(run_generate_int8, n_new)
     print('{"leg": "generate_int8", "tokens_per_s": %.1f}' % rate,
@@ -126,7 +127,7 @@ def main():
 
     def run_generate_int8kv():
         out = tf.generate(q8, prompt, n_new, cfg_kv8)
-        out.block_until_ready()
+        fetch_barrier(out)
 
     rate = _time_tokens(run_generate_int8kv, n_new)
     print('{"leg": "generate_int8kv", "tokens_per_s": %.1f}' % rate,
@@ -183,7 +184,7 @@ def main():
         for prompt, n in jobs:
             out = tf.generate(params, jnp.asarray([prompt], jnp.int32),
                               n, cfg)
-            out.block_until_ready()
+            fetch_barrier(out)
 
     # same warm/median-of-3 protocol as every other leg: the pool-vs-
     # sequential comparison is the headline, so it gets the least-noisy
