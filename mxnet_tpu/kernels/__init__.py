@@ -7,6 +7,8 @@ package holds the few deliberate exceptions, written with Pallas
 interpret mode elsewhere, so their tests execute on any backend.
 """
 
-from .flash_attention import flash_attention, flash_decode
+from .flash_attention import (flash_attention, flash_decode,
+                              dense_decode_with_lse)
 
-__all__ = ["flash_attention", "flash_decode"]
+__all__ = ["flash_attention", "flash_decode",
+           "dense_decode_with_lse"]

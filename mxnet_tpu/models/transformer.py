@@ -560,7 +560,13 @@ def _decode_attention(q, layer_cache, pos, cfg):
     kvh = cache_k.shape[2]
     g = h // kvh
     # grouped contraction: the KVH-head cache is read once per GROUP —
-    # no materialized repeat in the bandwidth-bound decode loop
+    # no materialized repeat in the bandwidth-bound decode loop.
+    # kernels.dense_decode_with_lse is the same contraction with a
+    # deliberately different numeric profile: it accumulates PV in
+    # fp32 and emits the lse the sequence-parallel shard combine
+    # needs; this serving hot loop contracts PV at cache dtype (bf16
+    # MXU pass) and needs no lse. A masking/scaling fix here likely
+    # applies there too.
     qg = q.reshape(b, kvh, g, d)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, cache_k,
                    preferred_element_type=jnp.float32) / np.sqrt(d)

@@ -193,58 +193,51 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
 
 
 def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
-                    batch_axis=None, block_k=128, interpret=None):
-    """Sequence-parallel flash DECODING: single-token attention against
-    a KV cache sharded over `axis_name` along its sequence dim.
+                    batch_axis=None, block_k=None, interpret=None,
+                    use_pallas=None):
+    """Sequence-parallel DECODING: single-token attention against a KV
+    cache sharded over `axis_name` along its sequence dim.
 
     q: [B, H, D] (replicated over sp); k_cache/v_cache: [B, Tmax, H, D]
     with Tmax sharded over sp; lengths: [B] (or scalar) GLOBAL valid
-    lengths. Each device runs the flash-decode kernel over its cache
-    slice with the length clipped to the slice, then the partial
-    results combine with their log-sum-exp weights — one psum over sp
-    instead of gathering the cache (flash-decoding decomposition; the
-    long-context serving complement of ring_attention)."""
-    from ..kernels.flash_attention import flash_decode_with_lse
+    lengths. Each device computes (o, lse) over its cache slice with
+    the length clipped to the slice, then the partial results combine
+    with their log-sum-exp weights — one psum over sp instead of
+    gathering the cache (flash-decoding decomposition; the
+    long-context serving complement of ring_attention).
 
+    The per-shard compute defaults to dense_decode_with_lse (plain
+    XLA): decode reads [1, T] scores, so there is nothing for a flash
+    schedule to tile away, and the chip A/B measured the Pallas decode
+    kernel ~5x slower at serving shapes (BENCH_TABLE decode_dense vs
+    decode_flash). `use_pallas=True` (or MXNET_SP_DECODE_PALLAS=1)
+    restores the kernel path."""
+    from ..kernels.flash_attention import (dense_decode_with_lse,
+                                           flash_decode_with_lse)
+
+    if use_pallas is None:
+        import os
+        use_pallas = os.environ.get(
+            "MXNET_SP_DECODE_PALLAS", "0").lower() in ("1", "true")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if interpret:
+        use_pallas = False   # interpret-mode pallas can't run under a
+        #                      partially-manual shard_map
 
     def local(q_l, k_l, v_l, len_l):
         idx = jax.lax.axis_index(axis_name)
         t_shard = k_l.shape[1]
         local_len = jnp.clip(len_l - idx * t_shard, 0, t_shard)
-        if interpret:
-            # jnp fallback (interpret-mode pallas can't run under a
-            # partially-manual shard_map); mirrors the kernel exactly,
-            # including zero-valid-key shards: the explicit validity
-            # mask zeroes p so o=0 and lse~-1e30, which drop out of the
-            # combine (without it, an all-masked row degenerates to
-            # p=exp(0)=1 everywhere and returns mean(v))
-            if k_l.shape[2] != q_l.shape[1]:
-                # GQA cache: expand to the query heads (fallback
-                # fidelity; the kernel path maps groups natively)
-                rep = q_l.shape[1] // k_l.shape[2]
-                k_l = jnp.repeat(k_l, rep, axis=2)
-                v_l = jnp.repeat(v_l, rep, axis=2)
-            valid = (jnp.arange(t_shard)[None, None, :]
-                     < local_len[:, None, None])
-            s = jnp.einsum("bhd,bthd->bht",
-                           q_l.astype(jnp.float32),
-                           k_l.astype(jnp.float32))
-            s = s / (q_l.shape[-1] ** 0.5)
-            s = jnp.where(valid, s, -1e30)
-            m_i = jnp.max(s, axis=-1)
-            p = jnp.where(valid, jnp.exp(s - m_i[..., None]), 0.0)
-            l_i = p.sum(-1)
-            o_i = jnp.einsum("bht,bthd->bhd", p,
-                             v_l.astype(jnp.float32))
-            o_i = o_i / jnp.maximum(l_i, 1e-30)[..., None]
-            lse_i = m_i + jnp.log(jnp.maximum(l_i, 1e-30))
-        else:
+        if use_pallas:
             o_i, lse_i = flash_decode_with_lse(
                 q_l, k_l, v_l, local_len, block_k=block_k,
                 interpret=False)
             o_i = o_i.astype(jnp.float32)
+        else:
+            # zero-valid-key shards come back o=0, lse~-1e30 and drop
+            # out of the combine below
+            o_i, lse_i = dense_decode_with_lse(q_l, k_l, v_l, local_len)
         # combine partial softmaxes across the sp shards
         m_g = jax.lax.pmax(lse_i, axis_name)
         w = jnp.exp(lse_i - m_g)
