@@ -4,8 +4,8 @@ Measures models/transformer.py decode_step (flash_decode kernel vs the
 dense masked einsum) at growing cache lengths — decode is HBM-bound
 (cache bytes read per token), so tokens/s should track 1/length.
 
-    python - < benchmark/decode_bench.py
-    MXNET_DECODE_FLASH=0 python - < benchmark/decode_bench.py   # dense leg
+    python - < benchmark/decode_bench.py                 # dense (default)
+    MXNET_DECODE_FLASH=1 python - < benchmark/decode_bench.py   # Pallas leg
 
 Run from /root/repo via stdin so cwd lands on sys.path (leave the
 environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
@@ -19,7 +19,9 @@ import numpy as np
 
 BATCH = int(os.environ.get("MXNET_DECODE_BATCH", "8"))
 STEPS = int(os.environ.get("MXNET_DECODE_STEPS", "64"))
-USE_FLASH = os.environ.get("MXNET_DECODE_FLASH", "1") not in ("0", "false")
+# default matches the shipped TransformerConfig default (dense decode
+# attention); MXNET_DECODE_FLASH=1 opts in to the Pallas kernel leg
+USE_FLASH = os.environ.get("MXNET_DECODE_FLASH", "0") not in ("0", "false")
 
 
 def main():

@@ -60,13 +60,20 @@ QUEUE = [
       "env": {"MXNET_BENCH_BATCH": "256",
               "MXNET_BENCH_REPEATS": "3"}}, 1500, False),
     ("decode_flash",
-     {"stdin": "benchmark/decode_bench.py"}, 1500, False),
+     {"stdin": "benchmark/decode_bench.py",
+      "env": {"MXNET_DECODE_FLASH": "1"}}, 1500, False),
     ("decode_dense",
      {"stdin": "benchmark/decode_bench.py",
       "env": {"MXNET_DECODE_FLASH": "0"}}, 1500, False),
     ("decode_gqa",
      {"stdin": "benchmark/decode_bench.py",
-      "env": {"MXNET_DECODE_KV_HEADS": "2"}}, 1500, False),
+      "env": {"MXNET_DECODE_KV_HEADS": "2",
+              "MXNET_DECODE_FLASH": "1"}}, 1500, False),
+    # the shipped default for GQA serving: dense grouped contraction
+    ("decode_gqa_dense",
+     {"stdin": "benchmark/decode_bench.py",
+      "env": {"MXNET_DECODE_KV_HEADS": "2",
+              "MXNET_DECODE_FLASH": "0"}}, 1500, False),
     # int8 KV cache: half the cache bytes per token — decode is cache-
     # read-bound, so this is the next bandwidth lever after GQA
     ("decode_int8kv",
