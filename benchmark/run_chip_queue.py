@@ -88,6 +88,14 @@ QUEUE = [
      {"stdin": "benchmark/train_lm_bench.py",
       "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8"}},
      1800, False),
+    # dense attention at T=1024 fits comfortably ([B,H,T,T] scores
+    # ~0.5 GB); the decode audit showed XLA can beat the Pallas
+    # schedule at moderate T — measure whether that also lifts
+    # training MFU at the flagship shape
+    ("train_lm_d2048_dense",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8",
+              "MXNET_LM_FLASH": "0"}}, 1800, False),
     # d1024 sits below the MFU target at bs=8 (cost model: 43 FLOP/B
     # intensity vs the ~241 ridge); batch is the intensity lever for
     # the activation-traffic share — measure it

@@ -61,12 +61,17 @@ def main():
         batch = _env_int("MXNET_LM_BATCH", 8)
         steps = _env_int("MXNET_LM_STEPS", 10)
     remat = _env_int("MXNET_LM_REMAT", 1 if SMOKE else 0) == 1
+    # unset -> the backend default (flash on real TPU); set -> same
+    # string convention as MXNET_DECODE_FLASH ('0'/'false' disable)
+    flash_env = os.environ.get("MXNET_LM_FLASH")
+    use_flash = (jax.default_backend() == "tpu" if flash_env is None
+                 else flash_env.lower() not in ("0", "false", ""))
 
     cfg = tf.TransformerConfig(
         vocab_size=32000, d_model=d_model, n_heads=max(2, d_model // 128),
         n_layers=layers, d_ff=4 * d_model, max_len=seq,
         dtype=jnp.bfloat16, rope=True,
-        use_flash_kernel=jax.default_backend() == "tpu",
+        use_flash_kernel=use_flash,
         remat_layers=remat)
     params = tf.init_params(cfg, seed=0)
     n_params = sum(int(np.prod(p.shape))
@@ -104,7 +109,8 @@ def main():
         print(json.dumps({
             "metric": "lm_train_cost_model", "d_model": d_model,
             "layers": layers, "seq": seq, "batch": batch,
-            "remat": remat, "params_m": round(n_params / 1e6, 1),
+            "remat": remat, "flash": use_flash,
+            "params_m": round(n_params / 1e6, 1),
             "xla_flops_g": round(xla_flops / 1e9, 1),
             "model_flops_6n_g": round(flops_per_step / 1e9, 1),
             "bytes_accessed_gb": round(bytes_acc / 1e9, 3),
@@ -135,7 +141,8 @@ def main():
         "value": round(rate, 1), "unit": "tokens/s",
         "params_m": round(n_params / 1e6, 1),
         "d_model": d_model, "layers": layers, "seq": seq,
-        "batch": batch, "remat": remat, "mfu": round(mfu, 4),
+        "batch": batch, "remat": remat, "flash": use_flash,
+        "mfu": round(mfu, 4),
         "mfu_peak_flops": PEAK_FLOPS,
         "loss_finite": bool(np.isfinite(loss)),
     }))
