@@ -123,6 +123,17 @@ QUEUE = [
     ("flash_attention",
      {"argv": [sys.executable, "benchmark/flash_attention_bench.py"]},
      1500, False),
+    # bigger flash tiles: fewer, fatter sequential grid steps — the
+    # training-kernel analog of the decode block_k finding
+    ("flash_block256",
+     {"argv": [sys.executable, "benchmark/flash_attention_bench.py"],
+      "env": {"MXNET_FLASH_BLOCK_Q": "256",
+              "MXNET_FLASH_BLOCK_K": "256"}}, 1500, False),
+    ("train_lm_d2048_block256",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8",
+              "MXNET_FLASH_BLOCK_Q": "256",
+              "MXNET_FLASH_BLOCK_K": "256"}}, 1800, False),
     # stat-lane A/B: [rows, 1] stat blocks are also Mosaic-legal and
     # carry 1/128th the bwd stat traffic — does it lower, and does it
     # move the flash bwd / LM-training numbers?

@@ -655,7 +655,7 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=None,
             lse[..., 0].reshape(b, heads))
 
 
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
                     interpret=None):
     """Multi-head attention over [B, T, H, D] tensors.
 
@@ -665,7 +665,17 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
     Block sizes clamp to the sequence lengths; sequences must be
     divisible by the (clamped) blocks. `interpret` defaults to True off
     TPU so the same code runs everywhere.
-    """
+
+    block_q/block_k default to 128 (overridable per-process via
+    MXNET_FLASH_BLOCK_Q / MXNET_FLASH_BLOCK_K): the grid runs
+    (B*H) x (Tq/block_q) x (Tk/block_k) sequential steps, so small
+    batch*heads with long T pays per-step overhead that bigger tiles
+    amortize — a measurable A/B knob, same class as the decode
+    kernel's block_k finding."""
+    if block_q is None:
+        block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", "128"))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, seq_q, heads, head_dim = q.shape

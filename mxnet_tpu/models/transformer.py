@@ -244,16 +244,22 @@ def _causal_attention(q, k, v, cfg, out_dtype):
     by training forward and prefill."""
     if cfg.use_flash_kernel:
         import math
+        import os
         from ..kernels import flash_attention
-        # one block when the sequence fits (or divides) 128; otherwise
-        # the largest common block — never a raise, never a 1-wide
-        # degenerate grid for short odd sequences
+        # default block: fits (or divides) 128; otherwise the largest
+        # common block — never a raise, never a 1-wide degenerate grid
+        # for short odd sequences. The MXNET_FLASH_BLOCK_Q/K override
+        # reaches this call too (the train_lm block-size A/B leg),
+        # gcd-adjusted the same way so smoke shapes keep working.
         T = q.shape[1]
-        blk = min(T, 128)
-        if T % blk:
-            blk = math.gcd(T, 128)
-        return flash_attention(q, k, v, causal=True, block_q=blk,
-                               block_k=blk).astype(out_dtype)
+
+        def blk_of(env):
+            b = min(T, int(os.environ.get(env, "128")))
+            return b if T % b == 0 else math.gcd(T, b)
+
+        return flash_attention(
+            q, k, v, causal=True, block_q=blk_of("MXNET_FLASH_BLOCK_Q"),
+            block_k=blk_of("MXNET_FLASH_BLOCK_K")).astype(out_dtype)
     T = q.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32)
