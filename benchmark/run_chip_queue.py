@@ -128,7 +128,8 @@ QUEUE = [
     ("flash_block256",
      {"argv": [sys.executable, "benchmark/flash_attention_bench.py"],
       "env": {"MXNET_FLASH_BLOCK_Q": "256",
-              "MXNET_FLASH_BLOCK_K": "256"}}, 1500, False),
+              "MXNET_FLASH_BLOCK_K": "256",
+              "MXNET_FLASH_BENCH_SKIP_DENSE": "1"}}, 1500, False),
     ("train_lm_d2048_block256",
      {"stdin": "benchmark/train_lm_bench.py",
       "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8",
@@ -139,7 +140,8 @@ QUEUE = [
     # move the flash bwd / LM-training numbers?
     ("flash_stat_lanes1",
      {"argv": [sys.executable, "benchmark/flash_attention_bench.py"],
-      "env": {"MXNET_FLASH_STAT_LANES": "1"}}, 1500, False),
+      "env": {"MXNET_FLASH_STAT_LANES": "1",
+              "MXNET_FLASH_BENCH_SKIP_DENSE": "1"}}, 1500, False),
     ("train_lm_lanes1",
      {"stdin": "benchmark/train_lm_bench.py",
       "env": {"MXNET_FLASH_STAT_LANES": "1"}}, 1500, False),

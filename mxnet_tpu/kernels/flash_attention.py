@@ -680,12 +680,17 @@ def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
         interpret = jax.default_backend() != "tpu"
     b, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
+    # clamp to the sequence, then gcd-adjust a non-dividing block —
+    # one deterministic rule for explicit args, env overrides, and
+    # short/odd smoke shapes alike (callers need no block math of
+    # their own)
+    import math
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
-    if seq_q % block_q or seq_k % block_k:
-        raise ValueError(
-            "sequence lengths (%d, %d) must divide by blocks (%d, %d)"
-            % (seq_q, seq_k, block_q, block_k))
+    if seq_q % block_q:
+        block_q = math.gcd(seq_q, block_q)
+    if seq_k % block_k:
+        block_k = math.gcd(seq_k, block_k)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
         b * heads, x.shape[1], head_dim)
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal,

@@ -243,23 +243,12 @@ def _causal_attention(q, k, v, cfg, out_dtype):
     so ANY sequence length works) or the dense masked softmax. Shared
     by training forward and prefill."""
     if cfg.use_flash_kernel:
-        import math
-        import os
         from ..kernels import flash_attention
-        # default block: fits (or divides) 128; otherwise the largest
-        # common block — never a raise, never a 1-wide degenerate grid
-        # for short odd sequences. The MXNET_FLASH_BLOCK_Q/K override
-        # reaches this call too (the train_lm block-size A/B leg),
-        # gcd-adjusted the same way so smoke shapes keep working.
-        T = q.shape[1]
-
-        def blk_of(env):
-            b = min(T, int(os.environ.get(env, "128")))
-            return b if T % b == 0 else math.gcd(T, b)
-
-        return flash_attention(
-            q, k, v, causal=True, block_q=blk_of("MXNET_FLASH_BLOCK_Q"),
-            block_k=blk_of("MXNET_FLASH_BLOCK_K")).astype(out_dtype)
+        # block sizing (128 default, MXNET_FLASH_BLOCK_Q/K override,
+        # clamp + gcd for short/odd sequences) lives in
+        # flash_attention itself — one source of truth
+        return flash_attention(q, k, v,
+                               causal=True).astype(out_dtype)
     T = q.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32)

@@ -49,10 +49,18 @@ def test_flash_attention_cross_lengths():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
-def test_flash_attention_rejects_ragged_blocks():
-    x = jnp.zeros((1, 30, 1, 8))
-    with pytest.raises(ValueError):
-        flash_attention(x, x, x, block_q=16, block_k=16)
+def test_flash_attention_gcd_adjusts_ragged_blocks():
+    """A block that does not divide the sequence is gcd-adjusted (one
+    deterministic rule shared by explicit args, env overrides, and
+    the transformer call site) — same numerics as a dividing block."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 30, 1, 8), jnp.float32)
+    ragged = flash_attention(x, x, x, causal=True, block_q=16,
+                             block_k=16)      # 30 % 16 -> gcd 2
+    clean = flash_attention(x, x, x, causal=True, block_q=15,
+                            block_k=15)
+    np.testing.assert_allclose(np.asarray(ragged), np.asarray(clean),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_transformer_flash_kernel_matches_dense_path():
@@ -687,3 +695,36 @@ def test_flash_stat_lanes_env_value_equivalence():
                 if l.startswith("SUM")][0]
         sums[lanes] = eval(line[4:])
     np.testing.assert_allclose(sums["1"], sums["128"], rtol=1e-6)
+
+
+def test_dense_decode_with_lse_matches_flash_contract():
+    """dense_decode_with_lse (the sp-decode default since the chip A/B
+    retired the Pallas kernel there) honors the exact
+    flash_decode_with_lse contract: same (o, lse) for MHA and GQA,
+    per-row lengths, and the zero-valid-keys sentinel that drops a
+    shard out of the cross-shard combine."""
+    from mxnet_tpu.kernels.flash_attention import (
+        dense_decode_with_lse, flash_decode_with_lse)
+
+    rng = np.random.RandomState(7)
+    b, h, d, t = 3, 8, 16, 64
+    q = jnp.asarray(rng.randn(b, h, d), jnp.float32)
+    for kvh in (h, 2):                       # MHA and GQA
+        kc = jnp.asarray(rng.randn(b, t, kvh, d), jnp.float32)
+        vc = jnp.asarray(rng.randn(b, t, kvh, d), jnp.float32)
+        lengths = jnp.asarray([t, 17, 0], jnp.int32)
+        o_d, lse_d = dense_decode_with_lse(q, kc, vc, lengths)
+        o_f, lse_f = flash_decode_with_lse(q, kc, vc, lengths,
+                                           block_k=32, interpret=True)
+        # rows with valid keys agree in value and in the combine
+        # statistic
+        np.testing.assert_allclose(np.asarray(o_d[:2]),
+                                   np.asarray(o_f[:2]),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse_d[:2]),
+                                   np.asarray(lse_f[:2]),
+                                   rtol=2e-5, atol=2e-5)
+        # the empty row is the drop-out sentinel in both
+        assert np.abs(np.asarray(o_d[2])).max() == 0.0
+        assert (np.asarray(lse_d[2]) < -1e29).all()
+        assert (np.asarray(lse_f[2]) < -1e29).all()
