@@ -4,7 +4,9 @@ KV-cache path — single-device or TP/DP-sharded over a mesh.
 The inference-side counterpart of examples/transformer_lm.py: the same
 SPMD transformer (models/transformer.py) serves token-by-token through
 init_cache/decode_step/generate; on TPU the per-step attention streams
-the cache through the Pallas flash-decode kernel. The reference has no
+the cache through one fused XLA contraction (--flash opts in to the
+Pallas decode kernel; the chip A/B measured dense ~5x faster at
+serving shapes, docs/SERVING.md). The reference has no
 decode/serving path (its transformer surface stops at the
 interleaved-matmul ops, src/operator/contrib/transformer.cc) — this is
 the capability extension the long-context stack implies.
@@ -48,7 +50,9 @@ def main():
     ap.add_argument("--gen", type=int, default=8)
     ap.add_argument("--no-mesh", action="store_true")
     ap.add_argument("--flash", action="store_true",
-                    help="decode through the Pallas flash kernel")
+                    help="decode through the Pallas flash kernel "
+                         "(A/B lever; dense is the measured-faster "
+                         "default)")
     ap.add_argument("--int8", action="store_true",
                     help="serve from weight-only int8 params "
                          "(quantize_weights_int8)")
