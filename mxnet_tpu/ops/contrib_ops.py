@@ -263,28 +263,47 @@ def bilinear_resize(data, height=1, width=1, scale_height=None, scale_width=None
 
 @register(name="_contrib_hawkesll", num_outputs=2)
 def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
-    """src/operator/contrib/hawkes_ll.cc — simplified log-likelihood of a
-    marked self-exciting process."""
+    """src/operator/contrib/hawkes_ll.cc — log-likelihood of a marked
+    self-exciting (Hawkes) process on [0, max_time].
+
+    Matches the reference kernel exactly (hawkesll_forward /
+    hawkesll_forward_compensator in hawkes_ll-inl.h): per event j with
+    mark m at cumulative time t, intensity
+    lam = mu[m] + alpha[m] * beta[m] * state_m(t); the compensator
+    integral splits into the background part sum_k mu_k * max_time
+    (per-mark inter-event gaps tile [0, T]) and the excitation part,
+    which telescopes: state only decays between events and jumps +1 at
+    its mark's events, so
+    integral(excitation_k) = alpha_k * (state0_k + N_k - state_k(T))
+    with N_k the mark's event count and state_k(T) the returned state,
+    decayed through to max_time (the reference decays it the same way
+    so windows chain across minibatch calls)."""
     # lda: (N,K) background; alpha,beta: (K,); lags,marks: (N,T)
     N, T = lags.shape
-    K = lda.shape[1]
 
     def one(lda_i, state_i, lags_i, marks_i, vl_i, mt_i):
         def step(carry, t):
-            ll, rem = carry
+            ll, rem, elapsed, counts = carry
             m = marks_i[t].astype("int32")
-            dt = lags_i[t]
-            decay = jnp.exp(-beta * dt)
-            rem = rem * decay
+            valid = (t < vl_i).astype(lda_i.dtype)
+            dt = lags_i[t] * valid        # padded steps advance nothing
+            rem = rem * jnp.exp(-beta * dt)
             lam = lda_i[m] + alpha[m] * beta[m] * rem[m]
-            valid = (t < vl_i).astype(lam.dtype)
             ll = ll + valid * jnp.log(jnp.maximum(lam, 1e-20))
             rem = rem.at[m].add(valid)
-            return (ll, rem), None
-        (ll, rem), _ = lax.scan(step, (jnp.asarray(0.0, lda.dtype), state_i),
-                                jnp.arange(T))
-        compens = jnp.sum(lda_i * mt_i) + jnp.sum(alpha * (1 - jnp.exp(-beta * mt_i)) * rem * 0)
-        return ll - compens, rem
+            counts = counts.at[m].add(valid)
+            return (ll, rem, elapsed + dt, counts), None
+
+        zero = jnp.asarray(0.0, lda.dtype)
+        (ll, rem, elapsed, counts), _ = lax.scan(
+            step,
+            (zero, state_i, zero, jnp.zeros_like(state_i)),
+            jnp.arange(T))
+        # decay the state through the tail [t_last, max_time]
+        rem_T = rem * jnp.exp(-beta * (mt_i - elapsed))
+        compens = (jnp.sum(lda_i) * mt_i
+                   + jnp.sum(alpha * (state_i + counts - rem_T)))
+        return ll - compens, rem_T
 
     ll, states = jax.vmap(one)(lda, state, lags, marks, valid_length,
                                jnp.broadcast_to(max_time, (N,)))

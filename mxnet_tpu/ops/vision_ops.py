@@ -578,9 +578,13 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
                              part_size=0, sample_per_part=1, trans_std=0.0,
                              no_trans=False):
     """R-FCN position-sensitive ROI pooling with optional learned part
-    offsets. data channels = output_dim * group_size^2; each pooled bin
-    (ph, pw) averages sample_per_part^2 bilinear samples from its
-    position-sensitive channel slice."""
+    offsets. data channels = output_dim * group_size^2 (ctop-major, the
+    reference layout); each pooled bin (ph, pw) averages
+    sample_per_part^2 bilinear samples from its position-sensitive
+    channel slice. Divergences (documented): the reference's
+    class-dependent part offsets (trans channel pairs per
+    ctop/channels_each_class) are collapsed to the first class — all
+    output channels share one (dx, dy) per bin."""
     part_size = part_size or pooled_size
     b, c, h, w = data.shape
     ps = pooled_size
@@ -617,8 +621,12 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
                 ysg, xsg = jnp.meshgrid(ys, xs, indexing="ij")
                 gy = min(phi * g // ps, g - 1)
                 gx = min(pwi * g // ps, g - 1)
-                chan0 = (gy * g + gx) * output_dim
-                slice_ = lax.dynamic_slice_in_dim(img, chan0, output_dim, 0)
+                # reference channel layout (psroi_pooling.cc:98,
+                # deformable_psroi_pooling.cc:136): input channel
+                # (ctop*G + gh)*G + gw — ctop-major, so ported R-FCN
+                # weights keep their meaning
+                slice_ = img.reshape(output_dim, g * g, h, w)[
+                    :, gy * g + gx]
                 vals = _bilinear_gather(slice_, ysg, xsg)
                 out = out.at[:, phi, pwi].set(vals.mean(axis=(1, 2)))
         return out

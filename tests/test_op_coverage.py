@@ -1018,14 +1018,9 @@ ALLOWLIST = {
     # test_contrib_extras.py dgl tests via their public aliases
     "_contrib_dgl_csr_neighbor_uniform_sample",
     "_contrib_dgl_subgraph",
-    # likelihood of a marked point process: reference implementation is
-    # itself the only oracle; smoke-tested via finiteness in
-    # test_contrib_extras.py
-    "_contrib_hawkesll",
     # region-proposal pipelines whose outputs interact with RNG-ordered
     # partial sort; covered end-to-end by the SSD example test
     "_contrib_MultiProposal",
-    "_contrib_PSROIPooling",
 }
 
 

@@ -207,6 +207,66 @@ def test_deformable_psroi_pooling_no_trans_uniform():
     assert out.shape == (1, c_out, 2, 2)
     for phi in range(2):
         for pwi in range(2):
-            chan0 = (phi * g + pwi) * c_out
-            np.testing.assert_allclose(out[0, :, phi, pwi],
-                                       [chan0, chan0 + 1], atol=1e-4)
+            # reference ctop-major layout: bin (phi, pwi) of output
+            # channel ctop reads input channel (ctop*G + phi)*G + pwi
+            want = [(ctop * g + phi) * g + pwi for ctop in range(c_out)]
+            np.testing.assert_allclose(out[0, :, phi, pwi], want,
+                                       atol=1e-4)
+
+
+def test_psroi_pooling_matches_numpy_oracle():
+    """PSROIPooling against an independent numpy transcription of its
+    contract: ROI scaled by spatial_scale (deformable -0.5 centering),
+    each (ph, pw) bin averages a 2x2 bilinear sample grid from input
+    channel (ctop*G + gh)*G + gw — the reference's ctop-major
+    position-sensitive layout (psroi_pooling.cc:98)."""
+    rng = np.random.RandomState(11)
+    od, g, ps = 3, 2, 2
+    c = od * g * g
+    h = w = 9
+    data = rng.randn(2, c, h, w).astype(np.float32)
+    rois = np.array([[0, 1.0, 2.0, 6.0, 7.0],
+                     [1, 0.0, 0.0, 8.0, 8.0]], np.float32)
+    scale = 0.5
+
+    def bilin(img2d, y, x):
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        out = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                wgt = ((1 - abs(y - yy)) * (1 - abs(x - xx)))
+                if 0 <= yy < h and 0 <= xx < w:
+                    out += img2d[yy, xx] * wgt
+        return out
+
+    def oracle(roi):
+        bidx = int(roi[0])
+        x1 = roi[1] * scale - 0.5
+        y1 = roi[2] * scale - 0.5
+        x2 = (roi[3] + 1.0) * scale - 0.5
+        y2 = (roi[4] + 1.0) * scale - 0.5
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bw, bh = rw / ps, rh / ps
+        out = np.zeros((od, ps, ps), np.float32)
+        for phi in range(ps):
+            for pwi in range(ps):
+                gy = min(phi * g // ps, g - 1)
+                gx = min(pwi * g // ps, g - 1)
+                ys = [y1 + phi * bh + (s + 0.5) * (bh / 2)
+                      for s in range(2)]
+                xs = [x1 + pwi * bw + (s + 0.5) * (bw / 2)
+                      for s in range(2)]
+                for ctop in range(od):
+                    chan = (ctop * g + gy) * g + gx
+                    vals = [bilin(data[bidx, chan], yv, xv)
+                            for yv in ys for xv in xs]
+                    out[ctop, phi, pwi] = np.mean(vals)
+        return out
+
+    got = mx.nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=scale,
+        output_dim=od, pooled_size=ps, group_size=g).asnumpy()
+    want = np.stack([oracle(r) for r in rois])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
