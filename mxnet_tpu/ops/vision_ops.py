@@ -70,13 +70,21 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
 
 
 # --------------------------------------------------------------- multibox --
-def _corner_iou(a, b):
-    """IoU between (N,4) and (M,4) corner boxes -> (N, M)."""
+def _corner_iou(a, b, plus_one=False):
+    """IoU between (N,4) and (M,4) corner boxes -> (N, M).
+
+    plus_one=True uses the integer-pixel convention (+1 on every
+    extent, proposal.cc NonMaximumSuppression) — RPN boxes are pixel
+    corners. The SSD family works on normalized [0,1] corners where
+    the reference omits the +1."""
+    add = 1.0 if plus_one else 0.0
     tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
     br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    inter = jnp.prod(jnp.clip(br - tl, 0.0, None), axis=-1)
-    area_a = jnp.prod(jnp.clip(a[:, 2:] - a[:, :2], 0.0, None), axis=-1)
-    area_b = jnp.prod(jnp.clip(b[:, 2:] - b[:, :2], 0.0, None), axis=-1)
+    inter = jnp.prod(jnp.clip(br - tl + add, 0.0, None), axis=-1)
+    area_a = jnp.prod(jnp.clip(a[:, 2:] - a[:, :2] + add, 0.0, None),
+                      axis=-1)
+    area_b = jnp.prod(jnp.clip(b[:, 2:] - b[:, :2] + add, 0.0, None),
+                      axis=-1)
     return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
                                1e-12)
 
@@ -233,12 +241,13 @@ def _decode_locs(anchors, deltas, variances):
                      axis=-1)
 
 
-def _greedy_nms_mask(boxes, scores, threshold, topk):
+def _greedy_nms_mask(boxes, scores, threshold, topk, plus_one=False):
     """Suppressed-flag vector via a fixed-trip greedy pass over the topk
-    highest-scoring boxes."""
+    highest-scoring boxes. plus_one selects the pixel (+1) overlap
+    convention (see _corner_iou)."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
-    iou = _corner_iou(boxes[order], boxes[order])
+    iou = _corner_iou(boxes[order], boxes[order], plus_one=plus_one)
     alive = scores[order] > -jnp.inf
 
     def body(i, alive):
@@ -357,7 +366,9 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         sc = jnp.where(keep, fg, -jnp.inf)
         top_sc, top_idx = lax.top_k(sc, pre)
         top_boxes = boxes[top_idx]
-        alive = _greedy_nms_mask(top_boxes, top_sc, threshold, -1)
+        # proposal.cc NMS overlaps use the integer-pixel +1 convention
+        alive = _greedy_nms_mask(top_boxes, top_sc, threshold, -1,
+                                 plus_one=True)
         final = jnp.where(alive, top_sc, -jnp.inf)
         sel_sc, sel = lax.top_k(final, min(post, pre))
         rois = top_boxes[sel]
@@ -627,8 +638,20 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
                 # weights keep their meaning
                 slice_ = img.reshape(output_dim, g * g, h, w)[
                     :, gy * g + gx]
-                vals = _bilinear_gather(slice_, ysg, xsg)
-                out = out.at[:, phi, pwi].set(vals.mean(axis=(1, 2)))
+                # reference border rule (deformable_psroi_pooling.cc):
+                # samples beyond half a pixel outside the map are
+                # SKIPPED (bin average divides by the in-bounds count,
+                # 0 when none); the rest are clamped to the map before
+                # bilinear sampling — without this, border-ROI outputs
+                # are attenuated by the fixed divisor
+                inb = ((ysg >= -0.5) & (ysg <= h - 0.5)
+                       & (xsg >= -0.5) & (xsg <= w - 0.5))
+                ysc = jnp.clip(ysg, 0.0, h - 1.0)
+                xsc = jnp.clip(xsg, 0.0, w - 1.0)
+                vals = _bilinear_gather(slice_, ysc, xsc) * inb[None]
+                cnt = jnp.maximum(inb.sum(), 1)
+                out = out.at[:, phi, pwi].set(
+                    vals.sum(axis=(1, 2)) / cnt)
         return out
 
     if trans is None or no_trans:

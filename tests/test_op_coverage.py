@@ -1018,9 +1018,6 @@ ALLOWLIST = {
     # test_contrib_extras.py dgl tests via their public aliases
     "_contrib_dgl_csr_neighbor_uniform_sample",
     "_contrib_dgl_subgraph",
-    # region-proposal pipelines whose outputs interact with RNG-ordered
-    # partial sort; covered end-to-end by the SSD example test
-    "_contrib_MultiProposal",
 }
 
 

@@ -226,7 +226,8 @@ def test_psroi_pooling_matches_numpy_oracle():
     h = w = 9
     data = rng.randn(2, c, h, w).astype(np.float32)
     rois = np.array([[0, 1.0, 2.0, 6.0, 7.0],
-                     [1, 0.0, 0.0, 8.0, 8.0]], np.float32)
+                     [1, 0.0, 0.0, 8.0, 8.0],
+                     [1, -6.0, -5.0, 4.0, 5.0]], np.float32)
     scale = 0.5
 
     def bilin(img2d, y, x):
@@ -258,11 +259,15 @@ def test_psroi_pooling_matches_numpy_oracle():
                       for s in range(2)]
                 xs = [x1 + pwi * bw + (s + 0.5) * (bw / 2)
                       for s in range(2)]
+                pts = [(yv, xv) for yv in ys for xv in xs
+                       if -0.5 <= yv <= h - 0.5 and -0.5 <= xv <= w - 0.5]
                 for ctop in range(od):
                     chan = (ctop * g + gy) * g + gx
-                    vals = [bilin(data[bidx, chan], yv, xv)
-                            for yv in ys for xv in xs]
-                    out[ctop, phi, pwi] = np.mean(vals)
+                    vals = [bilin(data[bidx, chan],
+                                  min(max(yv, 0.0), h - 1.0),
+                                  min(max(xv, 0.0), w - 1.0))
+                            for yv, xv in pts]
+                    out[ctop, phi, pwi] = np.mean(vals) if pts else 0.0
         return out
 
     got = mx.nd.contrib.PSROIPooling(
@@ -270,3 +275,100 @@ def test_psroi_pooling_matches_numpy_oracle():
         output_dim=od, pooled_size=ps, group_size=g).asnumpy()
     want = np.stack([oracle(r) for r in rois])
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_proposal_matches_numpy_oracle():
+    """Proposal / MultiProposal against an independent numpy
+    transcription of the RPN pipeline: ratio-major base anchors
+    (rounded sqrt sizing), delta decode with the +1 width convention
+    and clipped log-sizes, image clipping, min-size filtering, top-K
+    by score, greedy IoU NMS in score order, post-NMS top-K with
+    zero-padding. Random scores make every ordering tie-free, so the
+    oracle is exact; MultiProposal must equal per-sample Proposal."""
+    rng = np.random.RandomState(5)
+    h = w = 6
+    scales, ratios, stride = (4.0, 8.0), (0.5, 1.0, 2.0), 8
+    A = len(scales) * len(ratios)
+    pre, post, thr, min_sz = 20, 8, 0.6, 4
+    B = 2
+    cls_prob = rng.rand(B, 2 * A, h, w).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, h, w) * 0.3).astype(np.float32)
+    im_info = np.array([[40.0, 44.0, 1.0]] * B, np.float32)
+
+    def oracle(probs, deltas, info):
+        base = float(stride)
+        anchors = []
+        for ratio in ratios:
+            ws = np.round(np.sqrt(base * base / ratio))
+            hs = np.round(ws * ratio)
+            for scale in scales:
+                wsc, hsc = ws * scale, hs * scale
+                cx = cy = (base - 1) / 2.0
+                anchors.append([cx - (wsc - 1) / 2, cy - (hsc - 1) / 2,
+                                cx + (wsc - 1) / 2, cy + (hsc - 1) / 2])
+        anchors = np.asarray(anchors)
+        shifts = np.stack(np.meshgrid(np.arange(w) * stride,
+                                      np.arange(h) * stride,
+                                      indexing="xy"), -1)  # (h, w, 2)
+        all_a = (np.concatenate([shifts, shifts], -1)[:, :, None, :]
+                 + anchors[None, None]).reshape(-1, 4)
+        fg = probs[A:].transpose(1, 2, 0).reshape(-1)
+        dl = deltas.reshape(A, 4, h, w).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        widths = all_a[:, 2] - all_a[:, 0] + 1
+        heights = all_a[:, 3] - all_a[:, 1] + 1
+        cx = dl[:, 0] * widths + all_a[:, 0] + (widths - 1) / 2
+        cy = dl[:, 1] * heights + all_a[:, 1] + (heights - 1) / 2
+        bw = np.exp(np.clip(dl[:, 2], -10, 10)) * widths
+        bh = np.exp(np.clip(dl[:, 3], -10, 10)) * heights
+        boxes = np.stack([cx - (bw - 1) / 2, cy - (bh - 1) / 2,
+                          cx + (bw - 1) / 2, cy + (bh - 1) / 2], -1)
+        boxes[:, 0] = boxes[:, 0].clip(0, info[1] - 1)
+        boxes[:, 1] = boxes[:, 1].clip(0, info[0] - 1)
+        boxes[:, 2] = boxes[:, 2].clip(0, info[1] - 1)
+        boxes[:, 3] = boxes[:, 3].clip(0, info[0] - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_sz * info[2]) &
+                (boxes[:, 3] - boxes[:, 1] + 1 >= min_sz * info[2]))
+        sc = np.where(keep, fg, -np.inf)
+        order = np.argsort(-sc, kind="stable")[:pre]
+        tb, ts = boxes[order], sc[order]
+
+        def iou(a, b):
+            # proposal.cc NMS: integer-pixel +1 convention
+            tl = np.maximum(a[:2], b[:2])
+            br = np.minimum(a[2:], b[2:])
+            inter = np.prod(np.clip(br - tl + 1, 0, None))
+            aa = np.prod(np.clip(a[2:] - a[:2] + 1, 0, None))
+            ab = np.prod(np.clip(b[2:] - b[:2] + 1, 0, None))
+            return inter / max(aa + ab - inter, 1e-12)
+
+        alive = ts > -np.inf
+        for i in range(len(tb)):
+            if not alive[i]:
+                continue
+            for j in range(i + 1, len(tb)):
+                if alive[j] and iou(tb[i], tb[j]) > thr:
+                    alive[j] = False
+        fs = np.where(alive, ts, -np.inf)
+        sel = np.argsort(-fs, kind="stable")[:post]
+        rois = np.where((fs[sel] > -np.inf)[:, None], tb[sel], 0.0)
+        return rois
+
+    kw = dict(rpn_pre_nms_top_n=pre, rpn_post_nms_top_n=post,
+              threshold=thr, rpn_min_size=min_sz, scales=scales,
+              ratios=ratios, feature_stride=stride)
+    multi = mx.nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        **kw).asnumpy()
+    assert multi.shape == (B * post, 5)
+    for bi in range(B):
+        want = oracle(cls_prob[bi], bbox_pred[bi], im_info[bi])
+        got = multi[bi * post:(bi + 1) * post]
+        np.testing.assert_array_equal(got[:, 0], bi)
+        np.testing.assert_allclose(got[:, 1:], want, rtol=1e-4,
+                                   atol=1e-4)
+        single = mx.nd.Proposal(
+            nd.array(cls_prob[bi:bi + 1]), nd.array(bbox_pred[bi:bi + 1]),
+            nd.array(im_info[bi:bi + 1]), **kw).asnumpy()
+        np.testing.assert_allclose(single[:, 1:], want, rtol=1e-4,
+                                   atol=1e-4)
