@@ -127,7 +127,13 @@ def div_sqrt_dim(data):
 @register(name="_contrib_ROIAlign")
 def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
               sample_ratio=-1, position_sensitive=False, aligned=False):
-    """src/operator/contrib/roi_align.cc — bilinear-sampled average pool."""
+    """src/operator/contrib/roi_align.cc — bilinear-sampled average pool.
+
+    Divergence (documented): sample_ratio<=0 means an ADAPTIVE
+    ceil(roi/pool) grid in the reference — data-dependent shape, so
+    under jit we fix it to 2x2 (same estimator). Border rule matches
+    the reference: samples beyond one pixel outside the map contribute
+    zero; nearer ones clamp to the edge before bilinear weighting."""
     n, c, h, w = data.shape
     ph, pw = pooled_size
     sr = 2 if sample_ratio <= 0 else sample_ratio
@@ -152,6 +158,11 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         yg = yy.reshape(-1)  # ph*sr
         xg = xx.reshape(-1)  # pw*sr
 
+        # reference border rule (roi_align.cc bilinear_interpolate):
+        # a sample more than ONE pixel outside the map contributes 0;
+        # within that margin it clamps to the edge
+        vy = (yg >= -1.0) & (yg <= h)
+        vx = (xg >= -1.0) & (xg <= w)
         y0 = jnp.clip(jnp.floor(yg), 0, h - 1)
         x0 = jnp.clip(jnp.floor(xg), 0, w - 1)
         y1i = jnp.clip(y0 + 1, 0, h - 1).astype("int32")
@@ -163,9 +174,24 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
              + img[:, y0i][:, :, x1i] * (wy0[:, None] * wx1[None, :])
              + img[:, y1i][:, :, x0i] * (wy1[:, None] * wx0[None, :])
              + img[:, y1i][:, :, x1i] * (wy1[:, None] * wx1[None, :]))
+        g = g * (vy[:, None] & vx[None, :])
         g = g.reshape(c, ph, sr, pw, sr)
-        return jnp.mean(g, axis=(2, 4))
+        pooled = jnp.mean(g, axis=(2, 4))                # (c, ph, pw)
+        if not position_sensitive:
+            return pooled
+        # R-FCN variant (roi_align.cc: c_in = ctop*ph*pw + py*pw + px):
+        # bin (py, px) of output channel ctop reads its own channel
+        # group — a per-bin channel gather after the uniform pooling
+        c_out = c // (ph * pw)
+        r = pooled.reshape(c_out, ph, pw, ph, pw)
+        iy, ix = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                              indexing="ij")
+        return r[:, iy, ix, iy, ix]
 
+    if position_sensitive and c % (ph * pw):
+        raise ValueError(
+            "position_sensitive ROIAlign needs channels (%d) divisible "
+            "by pooled_h*pooled_w (%d)" % (c, ph * pw))
     return jax.vmap(one)(rois)
 
 
