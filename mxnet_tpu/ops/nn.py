@@ -140,6 +140,27 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     adj = _tuplize(adj if adj != () else 0, nd)
     dn = _conv_dnums(nd)
     kshape = weight.shape[2:]
+    if target_shape not in ((), None) and any(target_shape):
+        # reference semantics (deconvolution-inl.h InferPad): a given
+        # target_shape DISCARDS user pad/adj and derives both — the
+        # zero-pad natural output stride*(in-1)+k_dilated must be >=
+        # target ("too big target shape" otherwise); the excess splits
+        # into pad = ceil(excess/2), adj = excess % 2, which lands the
+        # output exactly on target.
+        target_shape = _tuplize(target_shape, nd)
+        pad, adj = [], []
+        for i in range(nd):
+            k = (kshape[i] - 1) * dilate[i] + 1
+            natural = (data.shape[2 + i] - 1) * stride[i] + k
+            if int(target_shape[i]) > natural:
+                raise ValueError(
+                    "too big target shape: target_shape[%d]=%d exceeds "
+                    "the zero-pad output %d (= stride*(in-1) + "
+                    "dilated_kernel)" % (i, target_shape[i], natural))
+            excess = natural - int(target_shape[i])
+            adj.append(excess % 2)
+            pad.append((excess + 1) // 2)
+        pad, adj = tuple(pad), tuple(adj)
     # transposed conv = lhs-dilated conv with flipped kernel, swapped I/O
     pads = []
     for i in range(nd):

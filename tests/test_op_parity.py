@@ -579,3 +579,34 @@ def test_roi_align_position_sensitive():
         for py in range(ph):
             for px in range(pw):
                 assert out[0, ct, py, px] == ct * ph * pw + py * pw + px
+
+
+def test_deconvolution_target_shape():
+    """target_shape derives pad and adj per the reference InferPad
+    (deconvolution-inl.h:121-144): user pad/adj are discarded, the
+    zero-pad natural output must be >= target, excess splits into
+    pad=ceil(excess/2), adj=excess%2 — previously target_shape was
+    silently ignored."""
+    rng = np.random.RandomState(6)
+    x = nd.array(rng.randn(1, 3, 5, 5).astype(np.float32))
+    w = nd.array(rng.randn(3, 4, 3, 3).astype(np.float32))
+    # stride 2: natural zero-pad out = (5-1)*2 + 3 = 11
+    for target, want_pad, want_adj in ((9, 1, 0), (10, 1, 1), (11, 0, 0)):
+        out_t = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                                 num_filter=4,
+                                 target_shape=(target, target))
+        assert out_t.shape == (1, 4, target, target), out_t.shape
+        out_e = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                                 num_filter=4,
+                                 pad=(want_pad, want_pad),
+                                 adj=(want_adj, want_adj))
+        np.testing.assert_allclose(out_t.asnumpy(), out_e.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    # user pad is DISCARDED when target_shape is set (reference rule)
+    out_p = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                             num_filter=4, pad=(2, 2),
+                             target_shape=(11, 11))
+    assert out_p.shape == (1, 4, 11, 11)
+    with pytest.raises(ValueError):
+        nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                         num_filter=4, target_shape=(12, 12))
