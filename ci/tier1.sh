@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the ROADMAP verify command + the dispatch-overhead smoke,
+# run STRICTLY SERIALLY. The build host has ONE core (PERF.md
+# operational note): any concurrent pytest/bench process starves the
+# backend-liveness probe into a false CPU fallback and multi-device
+# CPU collective rendezvous into 40 s-timeout aborts — so this script
+# never backgrounds a stage, and it FAILS LOUDLY on any stage rather
+# than degrading.
+#
+#   ./ci/tier1.sh            # tier-1 suite + dispatch smoke
+#
+# (The full matrix — examples smoke, driver contract, bench — stays in
+# ci/run.sh; this is the cheap gate every PR must keep green.)
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "==== [tier1] pytest tests/ -m 'not slow' (870 s budget) ===="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ $rc -ne 0 ]; then
+    echo "[tier1] FAIL: test suite rc=$rc"
+    exit $rc
+fi
+
+echo "==== [tier1] dispatch-overhead smoke (benchmark/opperf.py --dispatch) ===="
+# serial, after the suite has fully exited; a wedged/slow ladder is a
+# real regression signal, not something to skip
+if ! env JAX_PLATFORMS=cpu python benchmark/opperf.py --dispatch; then
+    echo "[tier1] FAIL: dispatch smoke"
+    exit 1
+fi
+
+echo "[tier1] gate PASSED"

@@ -4,13 +4,24 @@ Reference: python/mxnet/gluon/trainer.py:27 (step:305,
 _allreduce_grads:356, _update:399). Applies an Optimizer to a set of
 Parameters; gradient aggregation across data-parallel devices goes through
 the KVStore layer, which on this build is XLA collectives over the active
-device mesh (the reference's engine-priority comm/compute overlap is
-subsumed by XLA's async scheduling of collectives).
+device mesh.
+
+Comm path: by default gradients travel BUCKETED (parallel/fusion.py) —
+keys pack into ~25 MB buckets in reverse-registration order (the last
+layers' grads, ready first in backward, reduce first — the reference's
+priority push, trainer.py:356 priority=-idx) and each bucket is one
+fused collective dispatch; XLA's async dispatch overlaps a bucket's
+all-reduce with the packing of the next. MXNET_KVSTORE_FUSION=0
+restores the per-key path. MXNET_KVSTORE_SHARD_UPDATE=1 additionally
+moves the optimizer into the store as a reduce-scatter -> sharded
+update -> all-gather per bucket (PAPERS.md cross-replica sharding),
+which cuts per-replica optimizer state by (N-1)/N.
 """
 
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
+from ..parallel import fusion
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -77,7 +88,17 @@ class Trainer(object):
     def _init_kvstore(self):
         kv = self._kvstore = self._resolve_store()
         if self._update_on_kvstore is None:
-            self._update_on_kvstore = False
+            # the sharded weight update runs INSIDE the store (its
+            # reduce-scatter -> sharded-update -> all-gather program
+            # owns the optimizer state), so requesting it flips the
+            # update onto the kvstore; every other config updates
+            # locally as before
+            self._update_on_kvstore = bool(
+                kv is not None
+                and fusion.shard_update_enabled()
+                and kv.supports_shard_update()
+                and fusion.FlatOptimizer.supports(self._optimizer)
+                is not None)
         if kv is not None:
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
@@ -140,6 +161,21 @@ class Trainer(object):
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if fusion.fusion_enabled():
+            items = [(slot, p) for slot, p in self._trainable()
+                     if p._data is not None]
+            if not items:
+                return
+            # reverse-registration (priority) order: backward produces
+            # the LAST layers' gradients first, so their bucket's
+            # collective dispatches first and overlaps the rest
+            items.reverse()
+            keys = [slot for slot, _ in items]
+            grads = [p.grad() for _, p in items]
+            self._kvstore.pushpull_fused(
+                keys, grads,
+                out=None if self._update_on_kvstore else grads)
+            return
         for slot, param in self._trainable():
             self._kvstore.push(slot, param.grad(), priority=-slot)
             if not self._update_on_kvstore:
@@ -180,10 +216,17 @@ class Trainer(object):
     def save_states(self, fname):
         assert self._optimizer is not None
         self._ready()
+        if self._update_on_kvstore and self._kvstore is not None:
+            # the store owns the states (including sharded flat slots)
+            self._kvstore.save_optimizer_states(fname)
+            return
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=False))
 
     def load_states(self, fname):
         self._ready()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())

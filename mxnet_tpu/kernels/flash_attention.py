@@ -655,6 +655,34 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=None,
             lse[..., 0].reshape(b, heads))
 
 
+_MIN_BLOCK = 8          # below this the grid is degenerate, not tiled
+
+
+def _adjust_block(block, seq, name):
+    """Clamp ``block`` to ``seq`` and make it divide; refuse to let the
+    gcd collapse toward 1 (prime/odd T with a non-dividing block) —
+    that is a correct but pathologically fine grid of near-one-element
+    steps. Fall back to ONE full-sequence block and warn so an explicit
+    or env block choice that does not divide T is visible (ADVICE r5:
+    previously a silent degenerate grid)."""
+    import math
+    import warnings
+    adjusted = min(block, seq)
+    if seq % adjusted:
+        adjusted = math.gcd(seq, adjusted)
+    if adjusted < min(seq, _MIN_BLOCK):
+        warnings.warn(
+            "flash_attention: %s=%d does not divide sequence length %d "
+            "and the gcd adjustment collapses to %d (a degenerate "
+            "%d-step grid); falling back to a single full-sequence "
+            "block of %d. Pick a %s that divides the sequence to tile "
+            "properly." % (name, block, seq, adjusted,
+                           seq // max(adjusted, 1), seq, name),
+            stacklevel=3)
+        return seq
+    return adjusted
+
+
 def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
                     interpret=None):
     """Multi-head attention over [B, T, H, D] tensors.
@@ -683,14 +711,12 @@ def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
     # clamp to the sequence, then gcd-adjust a non-dividing block —
     # one deterministic rule for explicit args, env overrides, and
     # short/odd smoke shapes alike (callers need no block math of
-    # their own)
-    import math
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    if seq_q % block_q:
-        block_q = math.gcd(seq_q, block_q)
-    if seq_k % block_k:
-        block_k = math.gcd(seq_k, block_k)
+    # their own). A collapsing gcd (e.g. prime T) would silently build
+    # a pathologically fine (B*H) x T x T grid, so blocks that fall
+    # below _MIN_BLOCK fall back to ONE full-sequence block with a
+    # warning instead (ADVICE r5).
+    block_q = _adjust_block(block_q, seq_q, "block_q")
+    block_k = _adjust_block(block_k, seq_k, "block_k")
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(
         b * heads, x.shape[1], head_dim)
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal,

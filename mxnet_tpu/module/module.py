@@ -391,11 +391,30 @@ class Module(BaseModule):
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
-        """module.py:646 — kvstore push/pull + optimizer step."""
+        """module.py:646 — kvstore push/pull + optimizer step. Gradient
+        traffic goes bucketed by default (parallel/fusion.py): keys in
+        reverse-registration order, one fused dispatch per ~25 MB
+        bucket instead of one per key; MXNET_KVSTORE_FUSION=0 restores
+        the per-key loop."""
         self._assert_binded()
         assert self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        from ..parallel import fusion
+        fused = self._kvstore is not None and fusion.fusion_enabled()
+        if fused:
+            # reverse-registration (priority) order — the backward
+            # pass produced these gradients last-layer-first
+            pairs = [(i, name)
+                     for i, name in enumerate(self._param_names)
+                     if name in self._exec.grad_dict][::-1]
         if self._update_on_kvstore:
+            if fused:
+                if pairs:
+                    self._kvstore.pushpull_fused(
+                        [i for i, _ in pairs],
+                        [self._exec.grad_dict[n] for _, n in pairs],
+                        out=[self._exec.arg_dict[n] for _, n in pairs])
+                return
             for i, name in enumerate(self._param_names):
                 if name not in self._exec.grad_dict:
                     continue
@@ -405,12 +424,18 @@ class Module(BaseModule):
                 self._kvstore.pull(i, out=w)
         else:
             if self._kvstore:
-                for i, name in enumerate(self._param_names):
-                    if name not in self._exec.grad_dict:
-                        continue
-                    g = self._exec.grad_dict[name]
-                    self._kvstore.push(i, g)
-                    self._kvstore.pull(i, out=g)
+                if fused:
+                    if pairs:
+                        grads = [self._exec.grad_dict[n] for _, n in pairs]
+                        self._kvstore.pushpull_fused(
+                            [i for i, _ in pairs], grads, out=grads)
+                else:
+                    for i, name in enumerate(self._param_names):
+                        if name not in self._exec.grad_dict:
+                            continue
+                        g = self._exec.grad_dict[name]
+                        self._kvstore.push(i, g)
+                        self._kvstore.pull(i, out=g)
             for i, name in enumerate(self._param_names):
                 if name not in self._exec.grad_dict:
                     continue

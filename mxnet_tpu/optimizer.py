@@ -69,6 +69,11 @@ def _align_update_devices(weight, grad, state):
     except AttributeError:
         return grad
     if gdev == wdev:
+        # weight/grad agree, but state buffers created lazily by the
+        # Updater land on the default device — align them to the
+        # weight's (possibly mesh-replicated) sharding or the fused
+        # update kernel refuses the device mix
+        _align_state_tree(state, ws)
         return grad
     if len(gdev) > len(wdev):
         weight._data = jax.device_put(wdata, gs)

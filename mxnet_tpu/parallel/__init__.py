@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "current_mesh",
            "use_mesh", "set_mesh", "shard", "replicate", "all_reduce",
            "all_gather", "reduce_scatter", "ring_permute", "device_count",
-           "init_distributed"]
+           "init_distributed", "fusion", "bucketed_all_reduce"]
 
 _CURRENT_MESH = None
 
@@ -55,6 +55,15 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
     # and the local launcher depends on its cpu pin sticking
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        # cross-process collectives on the CPU backend need the gloo
+        # implementation (XLA:CPU's default rejects multiprocess
+        # computations); must be set before the backend initializes
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:       # jaxlib built without gloo: leave as-is
+            pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -148,3 +157,10 @@ def ring_permute(x, axis_name, shift=1):
     n = jax.lax.psum(1, axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+# bucketed gradient fusion (one psum per ~25 MB bucket instead of one
+# per array) — importable as mxnet_tpu.parallel.fusion; the in-jit
+# entry point re-exported here for train-step authors
+from . import fusion                                    # noqa: E402
+from .fusion import bucketed_all_reduce                 # noqa: E402,F401

@@ -20,6 +20,44 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+try:                                    # jax >= 0.5 top-level alias
+    _shard_map_impl = jax.shard_map
+    _SMAP_NEW_API = True
+except AttributeError:                  # 0.4.x experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SMAP_NEW_API = False
+
+
+def _shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+               check_vma=None):
+    """shard_map across jax API generations: new jax spells partial
+    manual as ``axis_names={...}`` and the checker ``check_vma``; 0.4.x
+    spells them ``auto=<complement>`` and ``check_rep``."""
+    if _SMAP_NEW_API:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        try:
+            return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
+        except TypeError:               # pre-check_vma new API
+            kw.pop("check_vma", None)
+            return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
+    kw = {}
+    # 0.4.x partial-auto shard_map lowers axis_index to a PartitionId
+    # instruction SPMD partitioning rejects; since the non-manual axes
+    # never appear in these call sites' specs (data is replicated over
+    # them), running fully manual is equivalent — collectives still
+    # only reference the named axes.
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
+
+
 from . import ring_permute
 
 __all__ = ["ring_attention", "local_attention_block",
@@ -182,14 +220,11 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal,
                            use_flash_kernel=use_flash_kernel)
-    try:
-        smapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                                out_specs=spec, axis_names=set(manual),
-                                **kw)
-    except TypeError:  # older jax without check_vma
-        smapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                                out_specs=spec, axis_names=set(manual))
-    return smapped(q, k, v)
+    smapped = _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=set(manual), **kw)
+    # jit the mapped program: eager shard_map lacks rules for the ring
+    # loop on older jax, and compiled is what a train step wants anyway
+    return jax.jit(smapped)(q, k, v)
 
 
 def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
@@ -215,6 +250,7 @@ def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
     from ..kernels.flash_attention import (dense_decode_with_lse,
                                            flash_decode_with_lse)
 
+    explicit_pallas = use_pallas is True
     if use_pallas is None:
         import os
         use_pallas = os.environ.get(
@@ -222,6 +258,17 @@ def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if interpret:
+        if explicit_pallas:
+            # deliberate fallback must be distinguishable from
+            # misconfiguration (ADVICE r5): the caller asked for the
+            # kernel by argument and is getting plain XLA instead
+            import warnings
+            warnings.warn(
+                "sp_flash_decode: use_pallas=True ignored — interpret "
+                "mode is active (backend %r is not TPU), and "
+                "interpret-mode pallas cannot run under a partially-"
+                "manual shard_map; computing with dense_decode_with_lse "
+                "instead" % jax.default_backend(), stacklevel=2)
         use_pallas = False   # interpret-mode pallas can't run under a
         #                      partially-manual shard_map
 
@@ -251,7 +298,7 @@ def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
     manual = {axis_name} if batch_axis is None else {axis_name, batch_axis}
     b = q.shape[0]
     lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
-    smapped = jax.shard_map(
+    smapped = _shard_map(
         local, mesh=mesh, in_specs=(qspec, cspec, cspec, lspec),
         out_specs=qspec, axis_names=manual)
-    return smapped(q, k_cache, v_cache, lengths)
+    return jax.jit(smapped)(q, k_cache, v_cache, lengths)

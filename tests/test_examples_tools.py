@@ -120,6 +120,7 @@ def test_diagnose_runs():
     assert "mxnet_tpu" in r.stdout and "Devices" in r.stdout
 
 
+@pytest.mark.slow
 def test_train_imagenet_benchmark_tiny():
     r = _run([sys.executable,
               "examples/image_classification/train_imagenet.py",
@@ -197,6 +198,7 @@ def test_sparse_wide_deep_learns():
     assert acc > 0.75
 
 
+@pytest.mark.slow
 def test_ssd_detection_learns():
     """End-to-end SSD loop: ImageDetIter -> MultiBoxPrior/Target under
     autograd -> MultiBoxDetection eval (example/ssd parity)."""
@@ -210,8 +212,11 @@ def test_ssd_detection_learns():
 def test_dcgan_learns_distribution():
     """Adversarial loop: generated samples concentrate mass centrally
     like the real blobs (uniform noise would score ~0.25)."""
+    # 10 epochs: at 6 the discriminator still dominates on this jax
+    # version (lossG ~5, generated energy ~ uniform); by 10 the
+    # adversarial balance recovers and generated mass concentrates
     r = _run([sys.executable, "examples/dcgan.py",
-              "--num-epochs", "6", "--batches-per-epoch", "12"])
+              "--num-epochs", "10", "--batches-per-epoch", "12"])
     assert r.returncode == 0, r.stderr[-2000:]
     line = [l for l in r.stdout.splitlines() if "center-energy" in l][-1]
     gen = float(line.rsplit("generated=", 1)[1])
@@ -308,6 +313,7 @@ def test_kill_mxnet_local(tmp_path):
             victim.kill()
 
 
+@pytest.mark.slow
 def test_bench_fold_cast_variant_matches():
     """MXNET_FOLD_CAST=1 (persistent bf16 weights, cast folded into the
     optimizer update — the reference's mp_sgd layout) must follow the
@@ -351,6 +357,7 @@ def test_llm_serving_example():
     assert "mesh dp=2 tp=2" in r.stdout
 
 
+@pytest.mark.slow
 def test_bandwidth_tool_cross_process():
     """tools/bandwidth.py --num-workers 2: the all-reduce crosses the
     multi-process wire path and the pulled aggregate is the exact

@@ -52,15 +52,35 @@ def test_flash_attention_cross_lengths():
 def test_flash_attention_gcd_adjusts_ragged_blocks():
     """A block that does not divide the sequence is gcd-adjusted (one
     deterministic rule shared by explicit args, env overrides, and
-    the transformer call site) — same numerics as a dividing block."""
+    the transformer call site) — same numerics as a dividing block.
+    When the gcd COLLAPSES (30 % 16 -> gcd 2, a degenerate 15-step
+    grid) the kernel warns and falls back to one full-sequence block
+    instead of silently building the fine grid (ADVICE r5)."""
+    import warnings
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(1, 30, 1, 8), jnp.float32)
-    ragged = flash_attention(x, x, x, causal=True, block_q=16,
-                             block_k=16)      # 30 % 16 -> gcd 2
+    with pytest.warns(UserWarning, match="degenerate"):
+        ragged = flash_attention(x, x, x, causal=True, block_q=16,
+                                 block_k=16)  # 30 % 16 -> gcd 2 -> T
     clean = flash_attention(x, x, x, causal=True, block_q=15,
                             block_k=15)
     np.testing.assert_allclose(np.asarray(ragged), np.asarray(clean),
                                rtol=1e-5, atol=1e-5)
+    # a benign gcd adjustment (48 % 32 -> 16, a real tile) stays silent
+    y = jnp.asarray(rng.randn(1, 48, 1, 8), jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        benign = flash_attention(y, y, y, causal=True, block_q=32,
+                                 block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(benign),
+        np.asarray(flash_attention(y, y, y, causal=True, block_q=16,
+                                   block_k=16)),
+        rtol=1e-5, atol=1e-5)
+    # prime T: gcd collapses all the way to 1 -> same fallback
+    z = jnp.asarray(rng.randn(1, 29, 1, 8), jnp.float32)
+    with pytest.warns(UserWarning, match="degenerate"):
+        flash_attention(z, z, z, block_q=16, block_k=16)
 
 
 def test_transformer_flash_kernel_matches_dense_path():

@@ -62,6 +62,7 @@ def test_vgg_and_alexnet_forward():
     assert net(x).shape == (1, 4)
 
 
+@pytest.mark.slow
 def test_densenet_forward():
     net = vision.densenet121(classes=3)
     net.initialize()
@@ -69,6 +70,7 @@ def test_densenet_forward():
     assert net(x).shape == (1, 3)
 
 
+@pytest.mark.slow
 def test_model_zoo_train_step_decreases_loss():
     """A few SGD steps on random data should reduce loss (sanity that
     gradients flow through residual blocks + BN)."""
@@ -96,7 +98,8 @@ def test_model_zoo_train_step_decreases_loss():
 @pytest.mark.parametrize("factory,size", [
     ("squeezenet1_1", 64),
     ("mobilenet_v2_0_25", 64),
-    ("densenet121", 224),     # fixed AvgPool2D(7) tail needs 224 input
+    # fixed AvgPool2D(7) tail needs 224 input — ~25 s, tier-1 skips it
+    pytest.param("densenet121", 224, marks=pytest.mark.slow),
 ])
 def test_more_zoo_hybridized_matches_eager(factory, size):
     import numpy as np
