@@ -256,7 +256,12 @@ def main():
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--shard-update", action="store_true",
                    help="also run the sharded-weight-update leg")
+    p.add_argument("--obs", action="store_true",
+                   help="run with MXNET_OBS=1 and print the aggregate-"
+                        "stats phase table after the legs")
     args = p.parse_args()
+    if args.obs:
+        os.environ["MXNET_OBS"] = "1"
     _pre_jax_setup(args.devices)
 
     import jax
@@ -268,6 +273,8 @@ def main():
     for name in args.dist:
         bench_dist(name, DISTRIBUTIONS[name](), n, args.iters,
                    args.shard_update)
+    from benchmark.common import print_obs_table
+    print_obs_table()
 
 
 if __name__ == "__main__":

@@ -16,3 +16,15 @@ def fetch_barrier(out):
     import jax
     leaf = jax.tree_util.tree_leaves(out)[0]
     float(leaf[(0,) * leaf.ndim])
+
+
+def print_obs_table():
+    """Print the observability aggregate-stats table when telemetry is
+    on (MXNET_OBS=1 / --obs flags): bench numbers then come with the
+    phase breakdown behind them (docs/OBSERVABILITY.md), so PERF.md
+    rows can cite where the wall time went."""
+    from mxnet_tpu.observability import core, export
+    if not core.enabled():
+        return
+    print()
+    print(export.aggregate_table())

@@ -176,6 +176,8 @@ def main():
     if not args.keep:
         import shutil
         shutil.rmtree(tmp, ignore_errors=True)
+    from benchmark.common import print_obs_table
+    print_obs_table()
 
 
 if __name__ == "__main__":

@@ -146,6 +146,8 @@ def main():
         "mfu_peak_flops": PEAK_FLOPS,
         "loss_finite": bool(np.isfinite(loss)),
     }))
+    from benchmark.common import print_obs_table
+    print_obs_table()
 
 
 if __name__ == "__main__":

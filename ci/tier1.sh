@@ -8,6 +8,7 @@
 # than degrading.
 #
 #   ./ci/tier1.sh            # tier-1 suite + dispatch smoke
+#   TIER1_OBS=1 ./ci/tier1.sh  # + MXNET_OBS=1 telemetry smoke lane
 #
 # (The full matrix — examples smoke, driver contract, bench — stays in
 # ci/run.sh; this is the cheap gate every PR must keep green.)
@@ -35,6 +36,17 @@ echo "==== [tier1] dispatch-overhead smoke (benchmark/opperf.py --dispatch) ====
 if ! env JAX_PLATFORMS=cpu python benchmark/opperf.py --dispatch; then
     echo "[tier1] FAIL: dispatch smoke"
     exit 1
+fi
+
+if [ "${TIER1_OBS:-0}" = "1" ]; then
+    echo "==== [tier1] observability smoke (MXNET_OBS=1 train step + trace validation) ===="
+    # opt-in lane: one instrumented Trainer.step; the emitted chrome
+    # trace JSON must parse and carry the step-phase spans + collective
+    # counters (tools/obs_smoke.py exits non-zero otherwise)
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py; then
+        echo "[tier1] FAIL: observability smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -49,6 +49,7 @@ from . import inspector
 from .inspector import TensorInspector
 from . import monitor
 from .monitor import Monitor
+from . import observability
 from . import profiler
 from . import runtime
 from . import test_utils

@@ -20,6 +20,7 @@ import numpy as _np
 
 from .base import MXNetError
 from . import engine
+from .observability import core as _obs
 
 _state = threading.local()
 
@@ -141,6 +142,14 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
     """Reverse pass (analogue of Imperative::Backward,
     src/imperative/imperative.cc:280): reverse-iterate the tape, feed each
     node its accumulated output cotangents, pull back to inputs."""
+    with _obs.span("backward", cat="step", heads=len(outputs)
+                   if isinstance(outputs, (list, tuple)) else 1):
+        return _backward_impl(outputs, head_grads, retain_graph,
+                              train_mode)
+
+
+def _backward_impl(outputs, head_grads=None, retain_graph=False,
+                   train_mode=True):
     from .ndarray import NDArray
 
     if isinstance(outputs, NDArray):

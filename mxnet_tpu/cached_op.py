@@ -22,6 +22,8 @@ from . import engine as _engine
 from . import random as _random
 from .base import MXNetError
 from .executor import apply_mirror, build_graph_fn, mirror_enabled
+from .observability import core as _obs
+from .observability import recompile as _obs_recompile
 
 # fixed key fed to RNG-free graphs (never consumed; avoids a per-call
 # host-side split)
@@ -73,6 +75,10 @@ class CachedOp:
     def symbol(self):
         return self._sym
 
+    def _obs_name(self):
+        outs = self._sym.list_outputs()
+        return outs[0] if outs else "cached_op"
+
     # ------------------------------------------------------------------
     def _get_fn(self, is_train, diff_names):
         from . import inspector as _inspector
@@ -87,6 +93,14 @@ class CachedOp:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        if _obs.enabled() and self._fns:
+            # a second+ python-level variant of this op — legitimate
+            # when a toggle (train/diff-set/guard) flipped, but the
+            # detector records it so a variant storm is visible
+            _obs_recompile.record_retrace(
+                "CachedOp[%s]" % self._obs_name(),
+                "train=%s diff=%d guard=%s mirror=%s"
+                % (key[0], len(key[1]), key[2], key[3]))
         graph_fn = build_graph_fn(self._sym, is_train=is_train)
 
         if diff_names:
@@ -136,6 +150,14 @@ class CachedOp:
         diff_names = tuple(
             n for n in self._arg_names
             if recording and by_name[n]._requires_tape())
+
+        if _obs.enabled():
+            # jit-boundary breadcrumb: if XLA re-traces inside the call
+            # below, the detector attributes it to this signature
+            _obs_recompile.note_call(
+                "CachedOp[%s]" % self._obs_name(),
+                _obs_recompile.signature_of(
+                    inputs, train=is_train, diff=len(diff_names)))
 
         ctx = inputs[0]._ctx if inputs else None
 

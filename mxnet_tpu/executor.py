@@ -20,6 +20,8 @@ from . import ops
 from . import engine as _engine
 from . import inspector as _inspector
 from .base import MXNetError
+from .observability import core as _obs
+from .observability import recompile as _obs_recompile
 from .symbol import OP_AUX
 
 _META_ATTRS = ("__input_names__", "__shape__", "__dtype__", "__lr_mult__",
@@ -343,6 +345,13 @@ class Executor:
             if self._zero_key is None:
                 self._zero_key = jax.random.PRNGKey(0)
             key = self._zero_key
+        if _obs.enabled():
+            _obs_recompile.note_call(
+                "Executor[%s]" % self._symbol.list_outputs()[0],
+                _obs_recompile.signature_of(
+                    arg_arrays.values(), train=is_train))
+        fwd_span = _obs.span("forward", cat="step", executor=True,
+                             train=is_train).start()
         if is_train:
             diff = [arg_arrays[n] for n in self._diff_args]
             rest = {k: v for k, v in arg_arrays.items()}
@@ -355,6 +364,7 @@ class Executor:
             self._saved_vjp = None
             outs = self._infer_fn(arg_arrays, aux_arrays, key)
         _engine.sync_if_needed(outs)
+        fwd_span.stop()
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         return self.outputs
 
@@ -362,6 +372,8 @@ class Executor:
         from . import ndarray as nd
         if self._saved_vjp is None:
             raise MXNetError("backward called before forward(is_train=True)")
+        bwd_span = _obs.span("backward", cat="step",
+                             executor=True).start()
         vjp, outs = self._saved_vjp
         if out_grads is None:
             heads = [jnp.ones_like(o) for o in outs]
@@ -381,6 +393,7 @@ class Executor:
                 tgt._data = tgt._data + g
             else:
                 tgt._data = g
+        bwd_span.stop()
 
     # ------------------------------------------------------- utilities --
     @property

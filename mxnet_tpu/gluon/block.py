@@ -20,11 +20,16 @@ from .. import name as _name
 from .. import ndarray as nd
 from .. import symbol as _symbol
 from ..base import MXNetError
+from ..observability import core as _obs
 from ..cached_op import CachedOp
 from ..context import current_context
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+# per-thread nesting depth of Block.__call__ — the outermost call owns
+# the step-phase "forward" telemetry span
+_CALL_DEPTH = threading.local()
 
 
 class _NamingState(threading.local):
@@ -343,9 +348,24 @@ class Block(object):
 
     # ------------------------------------------------------------- call --
     def __call__(self, *args):
-        for hook in self._forward_pre_hooks:
-            hook(self, args)
-        out = self.forward(*args)
+        # step-phase telemetry: ONE "forward" span per outermost block
+        # call (children nest inside it, per-layer spans would drown
+        # the ring); depth tracked per thread
+        depth = getattr(_CALL_DEPTH, "v", 0)
+        fwd_span = None
+        if depth == 0 and _obs.enabled():
+            fwd_span = _obs.span("forward", cat="step",
+                                 block=self._name or
+                                 type(self).__name__).start()
+        _CALL_DEPTH.v = depth + 1
+        try:
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self.forward(*args)
+        finally:
+            _CALL_DEPTH.v = depth
+            if fwd_span is not None:
+                fwd_span.stop()
         for hook in self._forward_hooks:
             hook(self, args, out)
         from ..util import is_np_array

@@ -21,6 +21,8 @@ which cuts per-replica optimizer state by (N-1)/N.
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
+from ..observability import core as _obs
+from ..observability import recompile as _obs_recompile
 from ..parallel import fusion
 from .parameter import Parameter
 
@@ -135,19 +137,23 @@ class Trainer(object):
         allreduce across data-parallel replicas, apply optimizer
         (gluon/trainer.py:305)."""
         self._ready()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer): check
-        # overflow, fold 1/scale into the update, skip the step when any
-        # grad is non-finite
-        scaler = getattr(self, "_amp_loss_scaler", None)
-        if scaler is not None:
-            skip = scaler.has_overflow(self._params)
-            scaler.update_scale(skip)
-            if skip:
-                return
-            self._optimizer.rescale_grad /= scaler.loss_scale
-        self._update(ignore_stale_grad)
+        with _obs.span("trainer.step", cat="step"):
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
+            # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer):
+            # check overflow, fold 1/scale into the update, skip the
+            # step when any grad is non-finite
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                skip = scaler.has_overflow(self._params)
+                scaler.update_scale(skip)
+                if skip:
+                    return
+                self._optimizer.rescale_grad /= scaler.loss_scale
+            self._update(ignore_stale_grad)
+        if _obs.enabled():
+            # arm the recompile detector once the step's graphs exist
+            _obs_recompile.step_boundary()
 
     def allreduce_grads(self):
         self._ready()
@@ -161,6 +167,11 @@ class Trainer(object):
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        with _obs.span("allreduce", cat="step",
+                       fused=fusion.fusion_enabled()):
+            self._allreduce_grads_impl()
+
+    def _allreduce_grads_impl(self):
         if fusion.fusion_enabled():
             items = [(slot, p) for slot, p in self._trainable()
                      if p._data is not None]
@@ -187,6 +198,11 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        with _obs.span("update", cat="step",
+                       on_kvstore=bool(self._update_on_kvstore)):
+            self._update_impl(ignore_stale_grad)
+
+    def _update_impl(self, ignore_stale_grad=False):
         for i, param in self._trainable():
             if param._data is None:
                 if not ignore_stale_grad:

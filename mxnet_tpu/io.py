@@ -25,6 +25,21 @@ import numpy as np
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import recordio
+from .observability import core as _obs
+
+
+def _obs_batch(iter_obj, batch):
+    """Per-batch telemetry: one counter bump + payload bytes. Called
+    only when recording is on (the data path must stay free otherwise)."""
+    _obs.counter("io.batches").add(1)
+    total = 0
+    for arr in (batch.data or []) + (batch.label or []):
+        data = getattr(arr, "_data", None)
+        nbytes = getattr(data, "nbytes", None)
+        if nbytes:
+            total += int(nbytes)
+    if total:
+        _obs.counter("io.bytes", "bytes").add(total)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
@@ -98,9 +113,14 @@ class DataIter(object):
         pass
 
     def next(self):
-        if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+        with _obs.span("io.next", cat="io", iter=type(self).__name__):
+            if self.iter_next():
+                batch = DataBatch(data=self.getdata(),
+                                  label=self.getlabel(),
+                                  pad=self.getpad(), index=self.getindex())
+                if _obs.enabled():
+                    _obs_batch(self, batch)
+                return batch
         raise StopIteration
 
     def __next__(self):
@@ -201,23 +221,29 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
-        if not self.iter_next():
-            raise StopIteration
-        data = self.getdata()
-        label = self.getlabel()
-        if self.cursor < 0:  # cached tail consumed
-            self._cache_data = None
-            self._cache_label = None
-        if data[0].shape[0] != self.batch_size:
-            if self.last_batch_handle == "roll_over":
-                # cache the tail for the next epoch (reference io.py next())
-                self._cache_data = [d.asnumpy() for d in data]
-                self._cache_label = [l.asnumpy() for l in label]
+        with _obs.span("io.next", cat="io", iter=type(self).__name__):
+            if not self.iter_next():
                 raise StopIteration
-            # 'pad': wrap around with samples from the epoch start
-            data = self._pad_batch(data, self.data)
-            label = self._pad_batch(label, self.label)
-        return DataBatch(data=data, label=label, pad=self.getpad(), index=None)
+            data = self.getdata()
+            label = self.getlabel()
+            if self.cursor < 0:  # cached tail consumed
+                self._cache_data = None
+                self._cache_label = None
+            if data[0].shape[0] != self.batch_size:
+                if self.last_batch_handle == "roll_over":
+                    # cache the tail for the next epoch (reference
+                    # io.py next())
+                    self._cache_data = [d.asnumpy() for d in data]
+                    self._cache_label = [l.asnumpy() for l in label]
+                    raise StopIteration
+                # 'pad': wrap around with samples from the epoch start
+                data = self._pad_batch(data, self.data)
+                label = self._pad_batch(label, self.label)
+            batch = DataBatch(data=data, label=label, pad=self.getpad(),
+                              index=None)
+            if _obs.enabled():
+                _obs_batch(self, batch)
+            return batch
 
     def _pad_batch(self, arrays, source):
         out = []
@@ -409,7 +435,11 @@ class PrefetchingIter(DataIter):
             # outstanding: repeated calls stay False until reset()
             return False
         try:
-            batches = [f.take() for f in self._fetchers]
+            # the wait on the fetcher queue IS the input-pipeline stall
+            # a training loop feels; surface it as its own phase
+            with _obs.span("io.prefetch_wait", cat="io",
+                           iters=self.n_iter):
+                batches = [f.take() for f in self._fetchers]
         except Exception:
             self._drained = True        # reset() recovers the others
             raise
@@ -634,21 +664,25 @@ class ImageRecordIter(DataIter):
         n = len(self._records)
         if self.cursor >= n:
             raise StopIteration
-        idxs = [self._order[(self.cursor + i) % n]
-                for i in range(self.batch_size)]
-        pad = max(0, self.cursor + self.batch_size - n)
-        self.cursor += self.batch_size
-        datas, labels = [], []
-        for i in idxs:
-            header, payload = self._records[i]
-            d, l = self._decode_one(header, payload)
-            datas.append(d)
-            labels.append(l)
-        data = nd.array(np.stack(datas))
-        label = nd.array(np.asarray(labels, dtype=np.float32))
-        return DataBatch(data=[data], label=[label], pad=pad,
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+        with _obs.span("io.next", cat="io", iter=type(self).__name__):
+            idxs = [self._order[(self.cursor + i) % n]
+                    for i in range(self.batch_size)]
+            pad = max(0, self.cursor + self.batch_size - n)
+            self.cursor += self.batch_size
+            datas, labels = [], []
+            for i in idxs:
+                header, payload = self._records[i]
+                d, l = self._decode_one(header, payload)
+                datas.append(d)
+                labels.append(l)
+            data = nd.array(np.stack(datas))
+            label = nd.array(np.asarray(labels, dtype=np.float32))
+            batch = DataBatch(data=[data], label=[label], pad=pad,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)
+            if _obs.enabled():
+                _obs_batch(self, batch)
+            return batch
 
 
 def _resize_hwc(img, short):

@@ -49,6 +49,7 @@ from . import ndarray as nd
 from . import optimizer as opt
 from .gradient_compression import GradientCompression
 from .ndarray import NDArray
+from .observability import core as _obs
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
 
@@ -84,6 +85,15 @@ class KVStore(object):
     def reset_dispatch_stats(self):
         for k in self.dispatch_stats:
             self.dispatch_stats[k] = 0
+
+    def _count(self, name, delta=1):
+        """dispatch_stats is the always-on cheap view; the same
+        increments feed the observability counter registry when
+        MXNET_OBS is on, so traces/aggregates/prometheus see the
+        collective traffic without a second bookkeeping path."""
+        self.dispatch_stats[name] += delta
+        if _obs.enabled():
+            _obs.counter("kvstore." + name).add(delta)
 
     # ------------------------------------------------------------- init --
     def init(self, key, value):
@@ -131,20 +141,26 @@ class KVStore(object):
         """Aggregate values (kvstore.py:234). priority is accepted for API
         parity; XLA schedules collectives so ordering hints are moot."""
         keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
-            vlist = v if isinstance(v, (list, tuple)) else [v]
-            datas = self._maybe_compress(k, [x._data for x in vlist])
-            self.dispatch_stats["collectives"] += 1
-            self.dispatch_stats["keys"] += 1
-            agg = NDArray(self._aggregate(k, datas), vlist[0]._ctx)
-            if self._updater is not None:
-                if k not in self._store:
-                    raise ValueError("Please initialize key %s first" % k)
-                # ApplyUpdates path (kvstore_dist_server.h:346)
-                self._updater(int(k) if k.isdigit() else k, agg,
-                              self._store[k])
-            else:
-                self._store[k] = agg
+        with _obs.span("kvstore.push", cat="collective", keys=len(keys)):
+            for k, v in zip(keys, values):
+                vlist = v if isinstance(v, (list, tuple)) else [v]
+                datas = self._maybe_compress(k, [x._data for x in vlist])
+                self._count("collectives")
+                self._count("keys")
+                if _obs.enabled():
+                    _obs.counter("kvstore.bytes_reduced", "bytes").add(
+                        vlist[0].size
+                        * np.dtype(vlist[0].dtype).itemsize)
+                agg = NDArray(self._aggregate(k, datas), vlist[0]._ctx)
+                if self._updater is not None:
+                    if k not in self._store:
+                        raise ValueError(
+                            "Please initialize key %s first" % k)
+                    # ApplyUpdates path (kvstore_dist_server.h:346)
+                    self._updater(int(k) if k.isdigit() else k, agg,
+                                  self._store[k])
+                else:
+                    self._store[k] = agg
 
     @staticmethod
     def _pull_into(src, dst):
@@ -168,13 +184,14 @@ class KVStore(object):
         """Broadcast current value into out (kvstore.py:318)."""
         assert out is not None
         keys, outs = self._normalize(key, out)
-        for k, o in zip(keys, outs):
-            if k not in self._store:
-                raise ValueError("Please initialize key %s first" % k)
-            olist = o if isinstance(o, (list, tuple)) else [o]
-            src = self._store[k]
-            for dst in olist:
-                self._pull_into(src, dst)
+        with _obs.span("kvstore.pull", cat="collective", keys=len(keys)):
+            for k, o in zip(keys, outs):
+                if k not in self._store:
+                    raise ValueError("Please initialize key %s first" % k)
+                olist = o if isinstance(o, (list, tuple)) else [o]
+                src = self._store[k]
+                for dst in olist:
+                    self._pull_into(src, dst)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -238,18 +255,27 @@ class KVStore(object):
         if self._updater is not None and fusion.shard_update_enabled() \
                 and self.supports_shard_update():
             flat_opt = fusion.FlatOptimizer.supports(self._optimizer)
-        self.dispatch_stats["keys"] += len(keys)
-        for bucket in plan:
-            self.dispatch_stats["buckets"] += 1
-            for lane in bucket.lanes:
-                self._fused_lane(bucket, lane, datas, ctxs, outs,
-                                 flat_opt, nw)
+        self._count("keys", len(keys))
+        with _obs.span("kvstore.pushpull_fused", cat="collective",
+                       keys=len(keys), buckets=len(plan), workers=nw):
+            for bucket in plan:
+                self._count("buckets")
+                for lane in bucket.lanes:
+                    self._fused_lane(bucket, lane, datas, ctxs, outs,
+                                     flat_opt, nw)
 
     def _fused_lane(self, bucket, lane, datas, ctxs, outs, flat_opt, nw):
         from .parallel import fusion
         slot = None
         if flat_opt is not None:
             slot = self._shard_slot(bucket, lane, flat_opt)
+        lane_span = _obs.span(
+            "kvstore.bucket", cat="collective", bucket=bucket.index,
+            lane=lane.dtype, bytes=lane.nbytes, keys=len(lane.segments),
+            shard=slot is not None, workers=nw)
+        lane_span.start()
+        if _obs.enabled():
+            _obs.counter("kvstore.bucket_bytes", "bytes").add(lane.nbytes)
         pad = slot.l_pad if slot is not None else None
         per_worker = [
             fusion.pack_lane(lane,
@@ -262,13 +288,13 @@ class KVStore(object):
             for seg in lane.segments:
                 self._optimizer._update_count(self._opt_index(seg.key))
             flat_new = slot.step(per_worker)
-            self.dispatch_stats["collectives"] += 2
-            self.dispatch_stats["shard_updates"] += 1
+            self._count("collectives", 2)
+            self._count("shard_updates")
             news = fusion.unpack_lane(flat_new, lane)
             for seg in lane.segments:
                 self._store[seg.key]._data = news[seg.key]
         else:
-            self.dispatch_stats["collectives"] += 1
+            self._count("collectives")
             agg_flat = self._aggregate("__fused_b%d" % bucket.index,
                                        per_worker)
             news = fusion.unpack_lane(agg_flat, lane)
@@ -287,6 +313,7 @@ class KVStore(object):
                 src = self._store[seg.key]
                 for dst in outs[seg.key]:
                     self._pull_into(src, dst)
+        lane_span.stop()
 
     @staticmethod
     def _opt_index(k):

@@ -21,6 +21,8 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
+from ..observability import core as _obs
+from ..observability import recompile as _obs_recompile
 from ..model import save_checkpoint, load_checkpoint
 from .base_module import BaseModule, _check_input_names
 
@@ -398,6 +400,13 @@ class Module(BaseModule):
         the per-key loop."""
         self._assert_binded()
         assert self.params_initialized and self.optimizer_initialized
+        with _obs.span("update", cat="step",
+                       on_kvstore=bool(self._update_on_kvstore)):
+            self._update_impl()
+        if _obs.enabled():
+            _obs_recompile.step_boundary()
+
+    def _update_impl(self):
         self._params_dirty = True
         from ..parallel import fusion
         fused = self._kvstore is not None and fusion.fusion_enabled()

@@ -3,10 +3,20 @@
 Reference: python/mxnet/monitor.py (Monitor installs a callback on
 executor outputs; C++ hook graph_executor.cc:185 SetMonitorCallback).
 
-TPU note: under whole-graph jit there is no per-op callback point; the
-monitor inspects bound arrays (args/aux/outputs) at step boundaries,
-which covers the reference's main use (norm/NaN watching) without
-de-fusing the compiled program.
+TPU note: under whole-graph jit there is no per-op callback point, so
+the Monitor hooks the two boundaries that DO exist:
+
+* Module executors (``install``) — bound args/aux/outputs are inspected
+  at step boundaries (tic/toc), covering the reference's main use
+  (norm/NaN watching) without de-fusing the compiled program;
+* Gluon blocks (``install_block``) — a forward hook records every
+  block's output NDArrays as they are produced, the analogue of the
+  reference's per-executor monitor callback.
+
+Collected stats additionally route through the observability gauge API
+(``mxnet_tpu/observability``) when telemetry is on: scalar stats land
+as ``monitor.<name>`` gauges, so chrome traces / aggregate tables /
+Prometheus scrapes carry the watched values next to the step phases.
 """
 
 import logging
@@ -17,6 +27,7 @@ import numpy as np
 
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import core as _obs
 
 __all__ = ["Monitor"]
 
@@ -36,17 +47,52 @@ class Monitor(object):
         self.queue = []
         self.step = 0
         self.exes = []
+        self.blocks = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
     def stat_helper(self, name, array):
         if not self.activated or not self.re_prog.match(name):
             return
-        self.queue.append((self.step, name, self.stat_func(array)))
+        stat = self.stat_func(array)
+        self.queue.append((self.step, name, stat))
+        if _obs.enabled() and isinstance(stat, NDArray) \
+                and stat.size == 1:
+            _obs.gauge("monitor.%s" % name).set(float(stat.asscalar()))
 
     def install(self, exe):
         """Hook an executor (monitor.py:87)."""
         self.exes.append(exe)
+
+    def install_block(self, block):
+        """Hook a Gluon block (and every child): a forward hook records
+        each block's output arrays through stat_helper, named
+        ``<block>_output<i>`` — the per-op monitor callback the
+        reference installs on executors, at the block granularity that
+        exists under whole-graph jit."""
+
+        def hook(blk, _inputs, outputs):
+            if not self.activated:
+                return
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            for i, out in enumerate(outs):
+                if isinstance(out, NDArray):
+                    self.stat_helper(
+                        "%s_output%d" % (blk._name or
+                                         type(blk).__name__, i), out)
+
+        handles = []
+        for b in self._walk(block):
+            handles.append(b.register_forward_hook(hook))
+        self.blocks.append(block)
+        return handles
+
+    @staticmethod
+    def _walk(block):
+        yield block
+        for child in getattr(block, "_children", {}).values():
+            yield from Monitor._walk(child)
 
     def tic(self):
         """Start collecting for this step (monitor.py:96)."""
@@ -69,6 +115,11 @@ class Monitor(object):
                 self.stat_helper(name, array)
             for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
                 self.stat_helper(name, array)
+        for block in self.blocks:
+            # parameters of hooked blocks (hook already caught outputs)
+            for pname, param in block.collect_params().items():
+                if param._data is not None:
+                    self.stat_helper(pname, param.data())
         self.activated = False
         res = []
         if self.sort:

@@ -1,0 +1,38 @@
+"""mxnet_tpu.observability — unified runtime telemetry.
+
+One low-overhead, thread-safe core (ring-buffer span recorder + named
+counters/gauges, ``core.py``) feeds three exporters (``export.py``):
+chrome://tracing JSON (merged into ``profiler.dump()``), an MXNet-style
+aggregate percentile table (``profiler.dumps(aggregate=True)``), and a
+Prometheus textfile for scraping long runs. ``recompile.py`` watches
+jax.monitoring compile events and flags silent retraces with the
+argument signature that caused them.
+
+Enable with ``MXNET_OBS=1`` (or ``mx.profiler.set_state('run')``).
+With the knob unset every instrumentation site reduces to one guarded
+branch — the hot paths (kvstore dispatch, trainer step, io.next) stay
+within noise (<2%, benchmark/allreduce_overlap_bench.py).
+
+Instrumented out of the box: Trainer/Module step phases (forward /
+backward / allreduce / update), KVStore push/pull/pushpull_fused
+(per-bucket bytes, dtype lane, dispatch counts, wall time), the io.py
+iterators (batch latency, prefetch wait), and the CachedOp/Executor
+jit boundaries (compile spans + retrace attribution).
+"""
+
+from . import core
+from . import export
+from . import recompile
+from .core import (enabled, set_enabled, span, counter, gauge,
+                   record_span, record_instant, records, counters,
+                   dropped, reset)
+from .export import (chrome_trace, dump_chrome_trace, aggregate,
+                     aggregate_table, prometheus_text, write_prometheus)
+from .recompile import get_detector, note_call, record_retrace
+
+__all__ = ["core", "export", "recompile", "enabled", "set_enabled",
+           "span", "counter", "gauge", "record_span", "record_instant",
+           "records", "counters", "dropped", "reset", "chrome_trace",
+           "dump_chrome_trace", "aggregate", "aggregate_table",
+           "prometheus_text", "write_prometheus", "get_detector",
+           "note_call", "record_retrace"]

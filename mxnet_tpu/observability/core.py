@@ -1,0 +1,251 @@
+"""Telemetry core — ring-buffer span recorder + named counters/gauges.
+
+Reference analogue: src/profiler/profiler.h:251 keeps a per-thread
+profile record ring that DumpProfile() serializes to chrome://tracing
+and AggregateStats reduces to a percentile table. Here the same role is
+played by one process-wide ring of host-side records, because device-op
+timing already belongs to XLA's profiler (jax.profiler / XPlane) — what
+the runtime needs to observe for itself is the HOST orchestration:
+step phases, collective dispatch, input pipeline, jit boundaries.
+
+Design constraints (ISSUE 2 tentpole):
+
+* near-zero cost when off — every instrumentation site guards on
+  ``enabled()``, a module override check + one `_fastenv` dict read
+  (~0.1 us); a disabled ``span`` allocates one slotted object and does
+  nothing else. No locks, no time syscalls, no string formatting.
+* thread-safe when on — the prefetch threads (io.py), the main step
+  loop and jax.monitoring callbacks all record concurrently; one lock
+  guards the ring head and the counter registry, and record payloads
+  are built before taking it.
+* bounded memory — a fixed-capacity ring (``MXNET_OBS_RING``, default
+  65536 records) overwrites the oldest records; ``dropped`` reports how
+  many fell off so exporters can say the trace is a suffix.
+
+Knobs: ``MXNET_OBS=1`` enables recording; ``MXNET_OBS_RING`` sets ring
+capacity (read when the ring is (re)built). ``set_enabled()`` overrides
+the env for the profiler state machine (profiler.set_state/pause).
+"""
+
+import threading
+import time
+
+from .. import _fastenv
+
+__all__ = ["enabled", "set_enabled", "span", "counter", "gauge",
+           "record_span", "record_instant", "records", "counters",
+           "dropped", "reset", "ring_capacity", "Counter", "Gauge"]
+
+DEFAULT_RING = 65536
+
+# perf_counter epoch shared by every record so spans from different
+# threads land on one consistent trace timeline
+_EPOCH_NS = time.perf_counter_ns()
+
+# None -> follow MXNET_OBS; True/False -> profiler state machine override
+_override = None
+
+_lock = threading.Lock()
+_ring = [None] * 0
+_head = 0
+_total = 0
+_counters = {}
+
+
+def enabled():
+    """Is recording on? Module override (profiler.set_state) beats the
+    MXNET_OBS env knob. This is THE hot-path guard — keep it cheap."""
+    if _override is not None:
+        return _override
+    v = _fastenv.get("MXNET_OBS")
+    return v is not None and v not in ("", "0", "false", "False")
+
+
+def set_enabled(value):
+    """Override the env gate: True/False force, None reverts to env."""
+    global _override
+    _override = value
+
+
+def ring_capacity():
+    return int(_fastenv.get("MXNET_OBS_RING", DEFAULT_RING))
+
+
+def _ensure_ring():
+    global _ring
+    if not _ring:
+        _ring = [None] * max(ring_capacity(), 1)
+    return _ring
+
+
+def _now_us():
+    return (time.perf_counter_ns() - _EPOCH_NS) // 1000
+
+
+def _append(rec):
+    global _head, _total
+    with _lock:
+        ring = _ensure_ring()
+        ring[_head] = rec
+        _head = (_head + 1) % len(ring)
+        _total += 1
+
+
+def record_span(name, cat, t0_ns, t1_ns, args=None):
+    """Record one completed span. Timestamps are perf_counter_ns values
+    (callers capture them outside the lock)."""
+    _append(("X", name, cat, (t0_ns - _EPOCH_NS) // 1000,
+             max((t1_ns - t0_ns) // 1000, 0),
+             threading.get_ident(), args or {}))
+
+
+def record_instant(name, cat="event", args=None):
+    """Record a zero-duration marker."""
+    _append(("i", name, cat, _now_us(), 0, threading.get_ident(),
+             args or {}))
+
+
+class span(object):
+    """``with span("allreduce", cat="step", bytes=n):`` — records one
+    "X" (complete) event when recording is on; a cheap no-op otherwise.
+    Usable as a context manager or via explicit start()/stop()."""
+
+    __slots__ = ("name", "cat", "args", "_t0")
+
+    def __init__(self, name, cat="phase", **args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = None
+
+    def start(self):
+        if enabled():
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def stop(self):
+        if self._t0 is not None:
+            record_span(self.name, self.cat, self._t0,
+                        time.perf_counter_ns(), self.args)
+            self._t0 = None
+
+    __enter__ = start
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Counter(object):
+    """Monotonic-by-convention named counter. ``add`` keeps running
+    count/total/min/max of the deltas and drops a "C" sample in the
+    ring so exporters can plot the series and compute percentiles."""
+
+    __slots__ = ("name", "unit", "count", "total", "min", "max", "value")
+
+    def __init__(self, name, unit=""):
+        self.name = name
+        self.unit = unit
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.value = 0.0
+
+    def add(self, delta=1):
+        delta = float(delta)
+        with _lock:
+            self.count += 1
+            self.total += delta
+            self.value += delta
+            self.min = delta if self.min is None else min(self.min, delta)
+            self.max = delta if self.max is None else max(self.max, delta)
+        _append(("C", self.name, "counter", _now_us(), self.value,
+                 threading.get_ident(), {"delta": delta}))
+
+    def set(self, value):
+        with _lock:
+            delta = float(value) - self.value
+            self.count += 1
+            self.total += delta
+            self.value = float(value)
+            self.min = float(value) if self.min is None \
+                else min(self.min, float(value))
+            self.max = float(value) if self.max is None \
+                else max(self.max, float(value))
+        _append(("C", self.name, "counter", _now_us(), self.value,
+                 threading.get_ident(), {}))
+
+
+class Gauge(Counter):
+    """A counter whose ``set`` is the primary verb (last value wins);
+    min/max/count still aggregate the observed values."""
+
+    __slots__ = ()
+
+    def set(self, value):
+        value = float(value)
+        with _lock:
+            self.count += 1
+            self.total += value
+            self.value = value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+        _append(("C", self.name, "gauge", _now_us(), value,
+                 threading.get_ident(), {}))
+
+
+def counter(name, unit=""):
+    """Get-or-create the named counter (registry is process-global)."""
+    c = _counters.get(name)
+    if c is None:
+        with _lock:
+            c = _counters.get(name)
+            if c is None:
+                c = _counters[name] = Counter(name, unit)
+    return c
+
+
+def gauge(name, unit=""):
+    g = _counters.get(name)
+    if g is None:
+        with _lock:
+            g = _counters.get(name)
+            if g is None:
+                g = _counters[name] = Gauge(name, unit)
+    return g
+
+
+def records():
+    """Snapshot of ring contents, oldest first."""
+    with _lock:
+        if not _ring:
+            return []
+        if _total <= len(_ring):
+            out = [r for r in _ring[:_head] if r is not None]
+        else:
+            out = [r for r in _ring[_head:] + _ring[:_head]
+                   if r is not None]
+    return out
+
+
+def counters():
+    """Snapshot of the counter registry (name -> Counter)."""
+    with _lock:
+        return dict(_counters)
+
+
+def dropped():
+    """Records that fell off the ring (trace is a suffix when > 0)."""
+    with _lock:
+        return max(_total - len(_ring), 0) if _ring else 0
+
+
+def reset():
+    """Clear the ring and the counter registry (tests, new profile
+    sessions). The ring is rebuilt at the current MXNET_OBS_RING."""
+    global _ring, _head, _total
+    with _lock:
+        _ring = [None] * 0
+        _head = 0
+        _total = 0
+        _counters.clear()

@@ -1,0 +1,194 @@
+"""Exporters over the telemetry ring: chrome://tracing JSON, an
+MXNet-style aggregate-stats percentile table, and a Prometheus textfile.
+
+Reference analogues: profiler.h DumpProfile() emits chrome tracing;
+AggregateStats::DumpTable() the text table. The Prometheus writer is the
+long-run addition (TF's system paper argues production operation needs
+scrapeable metrics, not just post-hoc traces): point a node_exporter
+textfile collector at MXNET_OBS_PROM and scrape counters per step.
+"""
+
+import json
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["chrome_trace", "dump_chrome_trace", "aggregate",
+           "aggregate_table", "prometheus_text", "write_prometheus"]
+
+
+# ------------------------------------------------------ chrome trace --
+
+def chrome_trace(extra_events=None):
+    """The ring as a chrome://tracing (catapult) JSON object. Spans are
+    "X" complete events, counter samples "C" events; load the file at
+    chrome://tracing or ui.perfetto.dev."""
+    events = []
+    for rec in core.records():
+        ph, name, cat, ts, val, tid, args = rec
+        if ph == "X":
+            events.append({"name": name, "cat": cat, "ph": "X",
+                           "ts": ts, "dur": val, "pid": 0, "tid": tid,
+                           "args": args})
+        elif ph == "C":
+            events.append({"name": name, "cat": cat, "ph": "C",
+                           "ts": ts, "pid": 0,
+                           "args": {name.rsplit(".", 1)[-1]: val}})
+        else:
+            events.append({"name": name, "cat": cat, "ph": "i",
+                           "ts": ts, "pid": 0, "tid": tid, "s": "t",
+                           "args": args})
+    if extra_events:
+        events.extend(extra_events)
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": {"recorder": "mxnet_tpu.observability",
+                           "dropped_records": core.dropped()}}
+    return trace
+
+
+def dump_chrome_trace(filename, extra_events=None):
+    with open(filename, "w") as f:
+        json.dump(chrome_trace(extra_events), f)
+    return filename
+
+
+# -------------------------------------------------- aggregate stats --
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def aggregate():
+    """Reduce the ring + counter registry to per-name stats.
+
+    Returns {"spans": {name: stats}, "counters": {name: stats}} where
+    span stats are over durations (ms) and counter stats over the added
+    deltas (gauges: observed values); p50/p99 come from the ring samples
+    (a suffix when the ring wrapped — count/total stay exact for
+    counters because the registry accumulates independently).
+    """
+    span_samples = {}
+    counter_samples = {}
+    for rec in core.records():
+        ph, name, _cat, _ts, val, _tid, args = rec
+        if ph == "X":
+            span_samples.setdefault(name, []).append(val / 1000.0)
+        elif ph == "C":
+            counter_samples.setdefault(name, []).append(
+                args.get("delta", val))
+    spans = {}
+    for name, vals in sorted(span_samples.items()):
+        vals.sort()
+        spans[name] = {
+            "count": len(vals), "total_ms": sum(vals),
+            "min_ms": vals[0], "max_ms": vals[-1],
+            "p50_ms": _percentile(vals, 0.50),
+            "p99_ms": _percentile(vals, 0.99)}
+    counters = {}
+    for name, c in sorted(core.counters().items()):
+        vals = sorted(counter_samples.get(name, []))
+        counters[name] = {
+            "count": c.count, "total": c.total,
+            "min": c.min if c.min is not None else 0.0,
+            "max": c.max if c.max is not None else 0.0,
+            "p50": _percentile(vals, 0.50),
+            "p99": _percentile(vals, 0.99),
+            "value": c.value}
+    return {"spans": spans, "counters": counters}
+
+
+def aggregate_table():
+    """The stats as a text table (reference AggregateStats::DumpTable):
+    one section for span phases (ms), one for counters (raw values)."""
+    agg = aggregate()
+    lines = ["Profile Statistics (mxnet_tpu.observability)",
+             "  Note: span times in ms; counter rows aggregate the "
+             "added deltas, Value is the running total."]
+    fmt = "%-36s %8s %12s %10s %10s %10s %10s"
+    lines.append("")
+    lines.append("Spans (phases)")
+    lines.append("=" * 14)
+    lines.append(fmt % ("Name", "Count", "Total(ms)", "Min", "Max",
+                        "P50", "P99"))
+    for name, s in agg["spans"].items():
+        lines.append(fmt % (name, s["count"], "%.3f" % s["total_ms"],
+                            "%.3f" % s["min_ms"], "%.3f" % s["max_ms"],
+                            "%.3f" % s["p50_ms"], "%.3f" % s["p99_ms"]))
+    fmtc = "%-36s %8s %12s %10s %10s %10s %10s %12s"
+    lines.append("")
+    lines.append("Counters")
+    lines.append("=" * 8)
+    lines.append(fmtc % ("Name", "Count", "Total", "Min", "Max",
+                         "P50", "P99", "Value"))
+    for name, s in agg["counters"].items():
+        lines.append(fmtc % (name, s["count"], "%g" % s["total"],
+                             "%g" % s["min"], "%g" % s["max"],
+                             "%g" % s["p50"], "%g" % s["p99"],
+                             "%g" % s["value"]))
+    if core.dropped():
+        lines.append("")
+        lines.append("(%d oldest records dropped from the ring; "
+                     "percentiles cover the retained suffix)"
+                     % core.dropped())
+    return "\n".join(lines)
+
+
+# ------------------------------------------------- prometheus --------
+
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    return "".join(out)
+
+
+def prometheus_text():
+    """Prometheus exposition format: spans as summary-style series
+    (count/sum + p50/p99 quantile samples), counters as *_total plus a
+    last-value gauge. Suitable for a node_exporter textfile collector
+    on long runs."""
+    agg = aggregate()
+    lines = [
+        "# HELP mxnet_obs_span_ms host-side phase spans "
+        "(mxnet_tpu.observability)",
+        "# TYPE mxnet_obs_span_ms summary"]
+    for name, s in agg["spans"].items():
+        lab = 'phase="%s"' % name
+        lines.append('mxnet_obs_span_ms_count{%s} %d' % (lab, s["count"]))
+        lines.append('mxnet_obs_span_ms_sum{%s} %.6f'
+                     % (lab, s["total_ms"]))
+        for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+            lines.append('mxnet_obs_span_ms{%s,quantile="%s"} %.6f'
+                         % (lab, q, s[key]))
+    lines.append("# HELP mxnet_obs_counter_total accumulated counter "
+                 "deltas")
+    lines.append("# TYPE mxnet_obs_counter_total counter")
+    for name, s in agg["counters"].items():
+        lines.append('mxnet_obs_counter_total{name="%s"} %g'
+                     % (_prom_name(name), s["total"]))
+    lines.append("# HELP mxnet_obs_value last recorded value per "
+                 "counter/gauge")
+    lines.append("# TYPE mxnet_obs_value gauge")
+    for name, s in agg["counters"].items():
+        lines.append('mxnet_obs_value{name="%s"} %g'
+                     % (_prom_name(name), s["value"]))
+    lines.append('mxnet_obs_dropped_records %d' % core.dropped())
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path=None):
+    """Write the textfile; ``path`` defaults to MXNET_OBS_PROM. The
+    write goes through a .tmp rename so a concurrent scrape never sees
+    a torn file. Returns the path, or None when no target configured."""
+    import os
+    path = path or _fastenv.get("MXNET_OBS_PROM")
+    if not path:
+        return None
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(prometheus_text())
+    os.replace(tmp, path)
+    return path

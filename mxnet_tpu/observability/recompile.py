@@ -1,0 +1,210 @@
+"""Recompile detector — catch silent XLA retraces with the signature
+that caused them.
+
+On this stack a "recompile" is jax re-tracing a jitted computation
+because an argument signature changed (new shapes/dtypes, a flipped
+static flag, a weakly-typed scalar). The reference framework never had
+this failure mode — its graphs were explicit — but here a
+shape-polymorphic input silently multiplies step latency by the
+compile time, and nothing in the training loop says so.
+
+Mechanism: jax.monitoring publishes per-compile duration events
+(``/jax/core/compile/jaxpr_trace_duration`` on every trace,
+``backend_compile_duration`` on every executable build). One listener,
+registered lazily, forwards them to the active detector. The events
+carry no function identity, so instrumented call sites (CachedOp,
+Executor) drop a breadcrumb first — ``note_call(origin, signature)``
+into a thread-local — and the detector attributes a compile event to
+the innermost breadcrumb live on that thread when it fires. Python-
+level variant builds (a new CachedOp fn cache entry) report through
+``record_retrace`` with an exact signature.
+
+Steady-state budget: first-time compiles are legitimate, so misses only
+count against the budget after ``mark_steady()`` — Trainer.step /
+Module.update arm it automatically once a step past
+``MXNET_OBS_WARMUP_STEPS`` (default 1) completes with NO compiles, i.e.
+stability is observed, not assumed. Past
+``MXNET_OBS_RECOMPILE_BUDGET`` steady misses (default 2) the detector
+warns once with the attributed signatures.
+"""
+
+import collections
+import threading
+import warnings
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["JAXPR_TRACE_EVENT", "BACKEND_COMPILE_EVENT",
+           "RecompileDetector", "get_detector", "note_call",
+           "record_retrace", "step_boundary"]
+
+JAXPR_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_tls = threading.local()
+_detector = None
+_listener_installed = False
+_lock = threading.Lock()
+
+
+def default_budget():
+    return int(_fastenv.get("MXNET_OBS_RECOMPILE_BUDGET", 2))
+
+
+def warmup_steps():
+    return int(_fastenv.get("MXNET_OBS_WARMUP_STEPS", 1))
+
+
+class RecompileDetector(object):
+    """Per-process retrace ledger. ``events`` holds the most recent
+    4096 compile records: dicts with kind ('trace'|'backend_compile'|
+    'variant'), origin, signature, duration_s and steady flag."""
+
+    def __init__(self, budget=None):
+        self.budget = default_budget() if budget is None else int(budget)
+        self.events = collections.deque(maxlen=4096)
+        self.steady = False
+        self.misses = 0          # trace events seen while recording
+        self.steady_misses = 0   # trace events after mark_steady()
+        self.flagged = False
+        self._steps = 0
+        self._step_start_misses = 0
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------- lifecycle --
+    def reset(self, budget=None):
+        with self._lock:
+            if budget is not None:
+                self.budget = int(budget)
+            self.events.clear()
+            self.steady = False
+            self.misses = 0
+            self.steady_misses = 0
+            self.flagged = False
+            self._steps = 0
+            self._step_start_misses = 0
+
+    def mark_steady(self):
+        """Arm the budget: every later trace is a silent retrace."""
+        self.steady = True
+
+    def step_boundary(self):
+        """One train step completed. Arm once a post-warmup step runs
+        with NO compiles at all — "the graphs stabilized" observed
+        rather than assumed (a fixed step count would misfire on
+        programs that legitimately compile new jits for a few steps:
+        metrics, logging ops, the optimizer's first update)."""
+        with self._lock:
+            self._steps += 1
+            if not self.steady and self._steps > warmup_steps() \
+                    and self.misses == self._step_start_misses:
+                self.steady = True
+            self._step_start_misses = self.misses
+
+    # ------------------------------------------------------- ingest --
+    def _push(self, kind, origin, signature, duration):
+        rec = {"kind": kind, "origin": origin, "signature": signature,
+               "duration_s": duration, "steady": self.steady}
+        over = False
+        with self._lock:
+            self.events.append(rec)
+            if kind == "trace":
+                self.misses += 1
+                if self.steady:
+                    self.steady_misses += 1
+                    if self.steady_misses >= self.budget \
+                            and not self.flagged:
+                        self.flagged = True
+                        over = True
+        core.record_instant(
+            "recompile." + kind, cat="recompile",
+            args={"origin": origin, "signature": signature,
+                  "steady": rec["steady"]})
+        core.counter("recompile." + kind).add(1)
+        if over:
+            self._warn()
+
+    def _warn(self):
+        recent = [e for e in list(self.events)[-16:]
+                  if e["steady"] and e["kind"] == "trace"]
+        culprits = "; ".join(
+            "%s%s" % (e["origin"] or "<jit>",
+                      " " + e["signature"] if e["signature"] else "")
+            for e in recent[-4:]) or "<unattributed jit>"
+        warnings.warn(
+            "mxnet_tpu.observability: %d XLA retraces after steady "
+            "state (budget %d) — a jit is being re-traced per call, "
+            "likely shape/dtype-polymorphic inputs. Recent: %s"
+            % (self.steady_misses, self.budget, culprits),
+            RuntimeWarning, stacklevel=3)
+
+    def on_event(self, event, duration):
+        origin, signature = getattr(_tls, "call", (None, None))
+        if event == JAXPR_TRACE_EVENT:
+            self._push("trace", origin, signature, duration)
+        elif event == BACKEND_COMPILE_EVENT:
+            self._push("backend_compile", origin, signature, duration)
+
+
+# -------------------------------------------------- module-level API --
+
+def _listener(event, duration, **kwargs):
+    det = _detector
+    if det is None or not core.enabled():
+        return
+    if event is JAXPR_TRACE_EVENT or event is BACKEND_COMPILE_EVENT \
+            or event in (JAXPR_TRACE_EVENT, BACKEND_COMPILE_EVENT):
+        det.on_event(event, duration)
+
+
+def get_detector():
+    """The process detector; installs the jax.monitoring listener on
+    first use (once per process — the listener itself gates on
+    ``core.enabled()`` so an idle registration costs nothing except on
+    compile events, which are rare by definition)."""
+    global _detector, _listener_installed
+    with _lock:
+        if _detector is None:
+            _detector = RecompileDetector()
+        if not _listener_installed:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(
+                _listener)
+            _listener_installed = True
+    return _detector
+
+
+def note_call(origin, signature):
+    """Breadcrumb: the jit boundary about to run on this thread. Any
+    compile event firing before the next note is attributed to it.
+    Call only when ``core.enabled()`` (signature formatting costs)."""
+    get_detector()
+    _tls.call = (origin, signature)
+
+
+def record_retrace(origin, signature, duration=0.0):
+    """Explicit retrace report for python-level variant builds (a new
+    CachedOp fn-cache entry after the first, a new executor program)."""
+    get_detector()._push("variant", origin, signature, duration)
+
+
+def step_boundary():
+    """Trainer hook: a full train step completed."""
+    if _detector is not None or core.enabled():
+        get_detector().step_boundary()
+
+
+def signature_of(arrays, **flags):
+    """Compact signature string for note_call: 'f32[2,3],f32[3] k=v'."""
+    parts = []
+    for a in arrays:
+        dt = getattr(a, "dtype", None)
+        sh = getattr(a, "shape", ())
+        parts.append("%s[%s]" % (
+            getattr(dt, "name", dt), ",".join(str(d) for d in sh)))
+    sig = ",".join(parts)
+    if flags:
+        sig += " " + " ".join(
+            "%s=%s" % (k, v) for k, v in sorted(flags.items()))
+    return sig

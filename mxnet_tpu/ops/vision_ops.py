@@ -592,14 +592,38 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
     offsets. data channels = output_dim * group_size^2 (ctop-major, the
     reference layout); each pooled bin (ph, pw) averages
     sample_per_part^2 bilinear samples from its position-sensitive
-    channel slice. Divergences (documented): the reference's
-    class-dependent part offsets (trans channel pairs per
-    ctop/channels_each_class) are collapsed to the first class — all
-    output channels share one (dx, dy) per bin."""
+    channel slice. Part offsets are class-dependent exactly as in the
+    reference (deformable_psroi_pooling.cc:117): trans carries
+    num_classes = trans_channels/2 offset pairs, and output channel
+    ctop uses pair ctop // channels_each_class — per-class (dx, dy)
+    per bin, not one shared offset."""
     part_size = part_size or pooled_size
     b, c, h, w = data.shape
     ps = pooled_size
     g = group_size
+
+    if trans is None or no_trans:
+        num_classes = 1
+        trans2 = jnp.zeros((rois.shape[0], 2, part_size, part_size),
+                           data.dtype)
+    else:
+        tch = 1
+        for d in trans.shape[1:]:
+            tch *= int(d)
+        tch //= part_size * part_size
+        if tch < 2 or tch % 2:
+            raise ValueError(
+                "deformable_psroi_pooling: trans must carry an even "
+                "number of offset channels (got %d)" % tch)
+        num_classes = tch // 2
+        if output_dim % num_classes:
+            raise ValueError(
+                "deformable_psroi_pooling: output_dim (%d) must be a "
+                "multiple of the trans class count (%d)"
+                % (output_dim, num_classes))
+        trans2 = trans.reshape(
+            rois.shape[0], num_classes * 2, part_size, part_size)
+    cec = output_dim // num_classes          # channels_each_class
 
     def one_roi(roi, tr):
         bidx = roi[0].astype(jnp.int32)
@@ -616,50 +640,54 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         out = jnp.zeros((output_dim, ps, ps), data.dtype)
         for phi in range(ps):
             for pwi in range(ps):
-                if no_trans:
-                    off_x = off_y = 0.0
-                else:
-                    pidx_y = phi * part_size // ps
-                    pidx_x = pwi * part_size // ps
-                    off_x = tr[0, pidx_y, pidx_x] * trans_std * rw
-                    off_y = tr[1, pidx_y, pidx_x] * trans_std * rh
-                ys = y1 + phi * bin_h + off_y + \
-                    (jnp.arange(sample_per_part) + 0.5) * \
-                    (bin_h / sample_per_part)
-                xs = x1 + pwi * bin_w + off_x + \
-                    (jnp.arange(sample_per_part) + 0.5) * \
-                    (bin_w / sample_per_part)
-                ysg, xsg = jnp.meshgrid(ys, xs, indexing="ij")
                 gy = min(phi * g // ps, g - 1)
                 gx = min(pwi * g // ps, g - 1)
-                # reference channel layout (psroi_pooling.cc:98,
-                # deformable_psroi_pooling.cc:136): input channel
-                # (ctop*G + gh)*G + gw — ctop-major, so ported R-FCN
-                # weights keep their meaning
-                slice_ = img.reshape(output_dim, g * g, h, w)[
-                    :, gy * g + gx]
-                # reference border rule (deformable_psroi_pooling.cc):
-                # samples beyond half a pixel outside the map are
-                # SKIPPED (bin average divides by the in-bounds count,
-                # 0 when none); the rest are clamped to the map before
-                # bilinear sampling — without this, border-ROI outputs
-                # are attenuated by the fixed divisor
-                inb = ((ysg >= -0.5) & (ysg <= h - 0.5)
-                       & (xsg >= -0.5) & (xsg <= w - 0.5))
-                ysc = jnp.clip(ysg, 0.0, h - 1.0)
-                xsc = jnp.clip(xsg, 0.0, w - 1.0)
-                vals = _bilinear_gather(slice_, ysc, xsc) * inb[None]
-                cnt = jnp.maximum(inb.sum(), 1)
-                out = out.at[:, phi, pwi].set(
-                    vals.sum(axis=(1, 2)) / cnt)
+                for cls in range(num_classes):
+                    if no_trans:
+                        off_x = off_y = 0.0
+                    else:
+                        pidx_y = phi * part_size // ps
+                        pidx_x = pwi * part_size // ps
+                        # reference class selection
+                        # (deformable_psroi_pooling.cc:117): offset
+                        # pair = ctop // channels_each_class, x channel
+                        # first then y
+                        off_x = tr[2 * cls, pidx_y, pidx_x] \
+                            * trans_std * rw
+                        off_y = tr[2 * cls + 1, pidx_y, pidx_x] \
+                            * trans_std * rh
+                    ys = y1 + phi * bin_h + off_y + \
+                        (jnp.arange(sample_per_part) + 0.5) * \
+                        (bin_h / sample_per_part)
+                    xs = x1 + pwi * bin_w + off_x + \
+                        (jnp.arange(sample_per_part) + 0.5) * \
+                        (bin_w / sample_per_part)
+                    ysg, xsg = jnp.meshgrid(ys, xs, indexing="ij")
+                    # reference channel layout (psroi_pooling.cc:98,
+                    # deformable_psroi_pooling.cc:136): input channel
+                    # (ctop*G + gh)*G + gw — ctop-major, so ported
+                    # R-FCN weights keep their meaning
+                    slice_ = img.reshape(output_dim, g * g, h, w)[
+                        cls * cec:(cls + 1) * cec, gy * g + gx]
+                    # reference border rule
+                    # (deformable_psroi_pooling.cc): samples beyond
+                    # half a pixel outside the map are SKIPPED (bin
+                    # average divides by the in-bounds count, 0 when
+                    # none); the rest are clamped to the map before
+                    # bilinear sampling — without this, border-ROI
+                    # outputs are attenuated by the fixed divisor
+                    inb = ((ysg >= -0.5) & (ysg <= h - 0.5)
+                           & (xsg >= -0.5) & (xsg <= w - 0.5))
+                    ysc = jnp.clip(ysg, 0.0, h - 1.0)
+                    xsc = jnp.clip(xsg, 0.0, w - 1.0)
+                    vals = _bilinear_gather(slice_, ysc, xsc) \
+                        * inb[None]
+                    cnt = jnp.maximum(inb.sum(), 1)
+                    out = out.at[cls * cec:(cls + 1) * cec,
+                                 phi, pwi].set(
+                        vals.sum(axis=(1, 2)) / cnt)
         return out
 
-    if trans is None or no_trans:
-        trans2 = jnp.zeros((rois.shape[0], 2, part_size, part_size),
-                           data.dtype)
-    else:
-        trans2 = trans.reshape(
-            rois.shape[0], -1, part_size, part_size)[:, :2]
     return jax.vmap(one_roi)(rois, trans2)
 
 

@@ -1,4 +1,5 @@
-"""mx.profiler — profiling API over jax.profiler/XPlane.
+"""mx.profiler — profiling API over jax.profiler/XPlane + the
+observability telemetry core.
 
 Reference: python/mxnet/profiler.py:33-474 (set_config/set_state/dump +
 Domain/Task/Frame/Event/Counter/Marker) backed by the native
@@ -6,10 +7,22 @@ chrome://tracing profiler (src/profiler/profiler.h:251, DumpProfile:299).
 
 TPU-native design: device-side op timing comes from XLA's profiler
 (jax.profiler.start_trace -> TensorBoard/XPlane, the TPU analogue of the
-reference's chrome tracing); the user-facing Domain/Task/Event/Counter
-objects emit jax.profiler.TraceAnnotation spans on the host timeline and
-also record into a python-side ring so `dumps()` works without a trace
-viewer."""
+reference's chrome tracing); host-side runtime phases (step phases,
+collective dispatch, input pipeline, jit boundaries — see
+mxnet_tpu/observability/) record into the telemetry ring, which this
+module exports the reference's two ways:
+
+* ``dump()`` writes a chrome://tracing JSON (the ring's spans/counters,
+  plus any user Domain/Task/Frame spans) to ``filename`` — load it at
+  chrome://tracing / ui.perfetto.dev, alongside the XPlane trace dir.
+* ``dumps(aggregate=True)`` returns the aggregate-stats percentile
+  table (count/total/min/max/p50/p99 per phase and per counter), the
+  analogue of the reference's AggregateStats::DumpTable.
+
+``set_state('run')`` force-enables telemetry recording even without
+``MXNET_OBS=1``; pause/resume gate it. ``set_config(xla_trace=False)``
+skips the XLA trace (host-side telemetry only — cheap enough for unit
+tests and always-on dashboards)."""
 
 import threading
 import time
@@ -17,6 +30,9 @@ import time
 import jax
 
 from .base import MXNetError
+from .observability import core as _obs_core
+from .observability import export as _obs_export
+from . import _fastenv
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "dump", "dumps", "pause", "resume",
@@ -25,15 +41,16 @@ __all__ = ["set_config", "profiler_set_config", "set_state",
 _config = {"filename": "profile.json", "profile_all": False,
            "profile_symbolic": True, "profile_imperative": True,
            "profile_memory": True, "profile_api": True,
-           "aggregate_stats": False}
-_state = {"running": False, "dir": None}
+           "aggregate_stats": False, "xla_trace": True}
+_state = {"running": False, "dir": None, "obs_prev": None}
 _records = []
 _lock = threading.Lock()
 
 
 def set_config(**kwargs):
     """Configure the profiler (reference profiler.set_config). The
-    `filename` stem names the trace directory for the XLA trace dump."""
+    `filename` stem names the trace directory for the XLA trace dump;
+    ``xla_trace=False`` restricts 'run' to host-side telemetry."""
     for k, v in kwargs.items():
         _config[k] = v
 
@@ -42,17 +59,24 @@ profiler_set_config = set_config
 
 
 def set_state(state="stop", profile_process="worker"):
-    """'run' starts a jax profiler trace; 'stop' ends it and writes the
-    XPlane trace next to `filename`."""
+    """'run' starts host telemetry (and a jax profiler trace unless
+    xla_trace=False); 'stop' ends both — the XPlane trace lands next to
+    `filename`."""
     if state not in ("run", "stop"):
         raise MXNetError("profiler state must be 'run' or 'stop'")
     if state == "run" and not _state["running"]:
-        trace_dir = str(_config["filename"]) + ".tracedir"
-        _state["dir"] = trace_dir
-        jax.profiler.start_trace(trace_dir)
+        _state["obs_prev"] = _obs_core._override
+        _obs_core.set_enabled(True)
+        if _config.get("xla_trace", True):
+            trace_dir = str(_config["filename"]) + ".tracedir"
+            _state["dir"] = trace_dir
+            jax.profiler.start_trace(trace_dir)
         _state["running"] = True
     elif state == "stop" and _state["running"]:
-        jax.profiler.stop_trace()
+        if _state["dir"] is not None:
+            jax.profiler.stop_trace()
+            _state["dir"] = None
+        _obs_core.set_enabled(_state["obs_prev"])
         _state["running"] = False
 
 
@@ -60,25 +84,54 @@ profiler_set_state = set_state
 
 
 def pause(profile_process="worker"):
+    """Keep the session open but stop recording (reference
+    profiler_pause): spans/counters hit the ring again after resume()."""
     if _state["running"]:
-        jax.profiler.stop_trace()
-        _state["running"] = False
+        if _state["dir"] is not None:
+            jax.profiler.stop_trace()
+            _state["dir"] = None
+        _obs_core.set_enabled(False)
 
 
 def resume(profile_process="worker"):
-    if not _state["running"]:
+    if _state["running"]:
+        _obs_core.set_enabled(True)
+        if _config.get("xla_trace", True) and _state["dir"] is None:
+            trace_dir = str(_config["filename"]) + ".tracedir"
+            _state["dir"] = trace_dir
+            jax.profiler.start_trace(trace_dir)
+    else:
         set_state("run")
 
 
 def dump(finished=True, profile_process="worker"):
-    """Stop any running trace so the files hit disk."""
+    """Write the chrome://tracing JSON of everything recorded (telemetry
+    ring + user profiler objects) to `filename`; stop any running XLA
+    trace so its files hit disk too. Also refreshes the Prometheus
+    textfile when MXNET_OBS_PROM is set."""
     if _state["running"] and finished:
         set_state("stop")
+    elif _state["dir"] is not None and finished:
+        jax.profiler.stop_trace()
+        _state["dir"] = None
+    path = str(_config["filename"])
+    _obs_export.dump_chrome_trace(path)
+    _obs_export.write_prometheus()
+    return path
 
 
-def dumps(reset=False):
-    """Text dump of python-side recorded events (reference returns the
-    aggregate stats table)."""
+def dumps(reset=False, aggregate=False):
+    """Text dump. ``aggregate=True`` (or set_config(aggregate_stats=
+    True)) returns the aggregate-stats percentile table over the
+    telemetry ring — the reference's AggregateStats table. Otherwise
+    the legacy flat listing of user profiler objects."""
+    if aggregate or _config.get("aggregate_stats"):
+        table = _obs_export.aggregate_table()
+        if reset:
+            _obs_core.reset()
+            with _lock:
+                del _records[:]
+        return table
     with _lock:
         lines = ["Profile Statistics:",
                  "%-32s %-16s %-12s" % ("Name", "Kind", "Duration/Value")]
@@ -118,7 +171,8 @@ class Domain(object):
 
 class _Span(object):
     """start()/stop() span; emits a TraceAnnotation on the host
-    timeline."""
+    timeline and a ring record for the chrome-trace/aggregate
+    exporters."""
 
     kind = "span"
 
@@ -129,7 +183,7 @@ class _Span(object):
         self._ann = None
 
     def start(self):
-        self._t0 = time.time()
+        self._t0 = time.perf_counter_ns()
         self._ann = jax.profiler.TraceAnnotation(
             "%s::%s" % (self.domain, self.name))
         self._ann.__enter__()
@@ -139,7 +193,14 @@ class _Span(object):
             self._ann.__exit__(None, None, None)
             self._ann = None
         if self._t0 is not None:
-            _record(self.name, self.kind, "%.6fs" % (time.time() - self._t0))
+            t1 = time.perf_counter_ns()
+            _record(self.name, self.kind,
+                    "%.6fs" % ((t1 - self._t0) / 1e9))
+            if _obs_core.enabled():
+                # paused sessions keep the legacy listing but stay out
+                # of the trace/aggregate ring
+                _obs_core.record_span(self.name, self.kind, self._t0,
+                                      t1, {"domain": str(self.domain)})
             self._t0 = None
 
     def __enter__(self):
@@ -179,6 +240,8 @@ class Counter(object):
     def set_value(self, value):
         self._value = value
         _record(self.name, "counter", str(value))
+        if _obs_core.enabled():
+            _obs_core.gauge("profiler.%s" % self.name).set(value)
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -205,6 +268,10 @@ class Marker(object):
 
     def mark(self, scope="process"):
         _record(self.name, "marker", scope)
+        if _obs_core.enabled():
+            _obs_core.record_instant(self.name, cat="marker",
+                                     args={"scope": scope,
+                                           "domain": str(self.domain)})
 
 
 def dump_profile():
@@ -220,3 +287,10 @@ def set_kvstore_handle(handle):
     over the kvstore channel to ps-lite servers). dist_tpu_sync has no
     server role, so there is nothing to forward; accepted as a no-op
     for source compatibility."""
+
+
+# MXNET_PROFILER_AUTOSTART (reference initialize.cc): begin profiling at
+# import so short scripts need no explicit set_state. Host telemetry
+# only would surprise nobody; the XLA trace obeys set_config as usual.
+if _fastenv.get("MXNET_PROFILER_AUTOSTART", "0") not in ("0", "", "false"):
+    set_state("run")

@@ -6,6 +6,7 @@ contrib/deformable_psroi_pooling.cc.
 """
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -212,6 +213,58 @@ def test_deformable_psroi_pooling_no_trans_uniform():
             want = [(ctop * g + phi) * g + pwi for ctop in range(c_out)]
             np.testing.assert_allclose(out[0, :, phi, pwi], want,
                                        atol=1e-4)
+
+
+def test_deformable_psroi_pooling_per_class_offsets():
+    """Class-dependent part offsets (deformable_psroi_pooling.cc:117):
+    output channel ctop uses trans pair ctop // channels_each_class.
+    Equivalence check: the full multi-class op must match running the
+    op separately per class on that class's channel slice with its own
+    offset pair — impossible if all classes share class 0's offsets."""
+    rng = np.random.RandomState(3)
+    od, g, ps = 4, 2, 2
+    ncls, cec = 2, 2                       # od == ncls * cec
+    h = w = 12
+    data = rng.randn(1, od * g * g, h, w).astype(np.float32)
+    rois = np.array([[0, 2.0, 2.0, 9.0, 9.0]], np.float32)
+    trans = rng.uniform(-1, 1, (1, ncls * 2, ps, ps)).astype(np.float32)
+
+    full = mx.nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=od, group_size=g, pooled_size=ps,
+        sample_per_part=2, trans_std=0.5).asnumpy()
+    assert full.shape == (1, od, ps, ps)
+
+    per_cls = []
+    for cls in range(ncls):
+        d_c = data[:, cls * cec * g * g:(cls + 1) * cec * g * g]
+        t_c = trans[:, 2 * cls:2 * cls + 2]
+        per_cls.append(mx.nd.contrib.DeformablePSROIPooling(
+            nd.array(d_c), nd.array(rois), nd.array(t_c),
+            spatial_scale=1.0, output_dim=cec, group_size=g,
+            pooled_size=ps, sample_per_part=2,
+            trans_std=0.5).asnumpy())
+    np.testing.assert_allclose(full, np.concatenate(per_cls, axis=1),
+                               rtol=1e-5, atol=1e-5)
+    # and the classes genuinely use DIFFERENT offsets: recomputing
+    # class 1 with class 0's pair must NOT reproduce the full output
+    wrong = mx.nd.contrib.DeformablePSROIPooling(
+        nd.array(data[:, cec * g * g:2 * cec * g * g]),
+        nd.array(rois), nd.array(trans[:, 0:2]), spatial_scale=1.0,
+        output_dim=cec, group_size=g, pooled_size=ps,
+        sample_per_part=2, trans_std=0.5).asnumpy()
+    assert not np.allclose(full[:, cec:2 * cec], wrong, atol=1e-5)
+
+
+def test_deformable_psroi_pooling_rejects_bad_class_split():
+    data = np.zeros((1, 3 * 4, 4, 4), np.float32)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    trans = np.zeros((1, 4, 2, 2), np.float32)   # 2 classes, od=3
+    with pytest.raises(ValueError, match="multiple of"):
+        mx.nd.contrib.DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), nd.array(trans),
+            spatial_scale=1.0, output_dim=3, group_size=2,
+            pooled_size=2, sample_per_part=1, trans_std=0.1)
 
 
 def test_psroi_pooling_matches_numpy_oracle():

@@ -1,0 +1,67 @@
+"""Observability smoke: one instrumented train step, validated trace.
+
+Run by the opt-in tier-1 lane (``TIER1_OBS=1 ci/tier1.sh``) and usable
+standalone. With MXNET_OBS=1 it trains a 2-layer model for a couple of
+steps, dumps the chrome-trace JSON through ``profiler.dump()``,
+validates that the JSON parses and carries the four step-phase spans +
+per-bucket collective counters, and prints the aggregate-stats table —
+the ISSUE 2 acceptance path, exercised as a console one-liner:
+
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("MXNET_OBS", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.random.uniform(shape=(8, 10))
+    y = mx.nd.random.uniform(shape=(8, 4))
+    for _ in range(2):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+
+    fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_"),
+                         "trace.json")
+    mx.profiler.set_config(filename=fname, xla_trace=False)
+    path = mx.profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)           # must PARSE — the lane's gate
+    names = {e["name"] for e in trace["traceEvents"]}
+    required = {"forward", "backward", "allreduce", "update",
+                "kvstore.bucket", "kvstore.collectives"}
+    missing = required - names
+    if missing:
+        print("[obs_smoke] FAIL: trace missing spans/counters: %s"
+              % sorted(missing))
+        return 1
+    print("[obs_smoke] trace OK: %d events, %d distinct names -> %s"
+          % (len(trace["traceEvents"]), len(names), path))
+    print(mx.profiler.dumps(aggregate=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
