@@ -26,6 +26,13 @@ per-replica optimizer-state bytes cut ((N-1)/N, PAPERS.md).
 Usage:
     python benchmark/allreduce_overlap_bench.py [--devices 8]
         [--dist lm resnet50] [--iters 5] [--shard-update]
+        [--inject-straggler RANK:MS]
+
+``--inject-straggler 1:50`` feeds the measured bucketed all-reduce
+time, with rank 1 slowed by 50 ms, through the cross-rank straggler
+detector (observability/dist.py) and prints the skew table + warning —
+a reproducible demo of what a real multi-host straggler report looks
+like.
 """
 
 import argparse
@@ -259,6 +266,12 @@ def main():
     p.add_argument("--obs", action="store_true",
                    help="run with MXNET_OBS=1 and print the aggregate-"
                         "stats phase table after the legs")
+    p.add_argument("--inject-straggler", metavar="RANK:MS", default=None,
+                   help="demo the cross-rank straggler detector: build "
+                        "a per-rank phase table from the measured "
+                        "bucketed all-reduce time, slow RANK down by "
+                        "MS ms, and print the skew table + warning "
+                        "(docs/OBSERVABILITY.md)")
     args = p.parse_args()
     if args.obs:
         os.environ["MXNET_OBS"] = "1"
@@ -270,11 +283,66 @@ def main():
     n = jax.device_count()
     print(json.dumps({"metric": "allreduce_bench_mesh", "devices": n,
                       "backend": jax.default_backend()}))
+    rows = []
     for name in args.dist:
-        bench_dist(name, DISTRIBUTIONS[name](), n, args.iters,
-                   args.shard_update)
+        rows += bench_dist(name, DISTRIBUTIONS[name](), n, args.iters,
+                           args.shard_update)
+    if args.inject_straggler:
+        straggler_demo(args.inject_straggler, n, rows)
     from benchmark.common import print_obs_table
     print_obs_table()
+
+
+def straggler_demo(spec, n_workers, rows):
+    """Reproducible straggler-detector demo: a NOMINAL per-rank phase
+    table (fixed millisecond baselines, so the verdict is the same on
+    any host) with the injected rank slowed by +MS on allreduce, run
+    through the same detect/format path the cross-rank skew exchange
+    uses — the table and warning here look exactly like a real
+    multi-host straggler report. The measured bucketed time rides
+    along in the JSON row for context."""
+    import warnings
+    from mxnet_tpu.observability import dist as obs_dist
+
+    try:
+        rank_s, ms_s = spec.split(":")
+        rank, ms = int(rank_s), float(ms_s)
+    except ValueError:
+        raise SystemExit("--inject-straggler expects RANK:MS, got %r"
+                         % spec)
+    if not 0 <= rank < n_workers:
+        raise SystemExit("--inject-straggler rank %d outside 0..%d"
+                         % (rank, n_workers - 1))
+    bucketed = [r for r in rows if r["metric"].endswith("_bucketed")]
+    measured_ms = bucketed[-1]["sec_per_iter"] * 1000.0 if bucketed \
+        else None
+    base_ms = 5.0                       # nominal allreduce baseline
+    table = {"forward": [2.0 * base_ms] * n_workers,
+             "backward": [4.0 * base_ms] * n_workers,
+             "allreduce": [base_ms] * n_workers,
+             "update": [0.5 * base_ms] * n_workers}
+    table["allreduce"][rank] += ms
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        summary = obs_dist.detect_stragglers(table)
+        for s in summary["stragglers"]:
+            warnings.warn(
+                "mxnet_tpu.observability: cross-rank straggler — rank "
+                "%d %s %.2f ms vs across-rank median %.2f ms (x%.1f)"
+                % (s["rank"], s["phase"], s["ms"], s["median_ms"],
+                   s["ratio"]), RuntimeWarning)
+    print("\n".join(obs_dist.format_skew_table(summary)))
+    for w in caught:
+        print("WARNING: %s" % w.message)
+    print(json.dumps({
+        "metric": "straggler_demo", "injected_rank": rank,
+        "injected_ms": ms, "base_allreduce_ms": base_ms,
+        "measured_bucketed_ms": None if measured_ms is None
+        else round(measured_ms, 3),
+        "flagged": [dict(s, ms=round(s["ms"], 3),
+                         median_ms=round(s["median_ms"], 3),
+                         ratio=round(s["ratio"], 2))
+                    for s in summary["stragglers"]]}))
 
 
 if __name__ == "__main__":

@@ -47,6 +47,17 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         echo "[tier1] FAIL: observability smoke"
         exit 1
     fi
+
+    echo "==== [tier1] distributed observability smoke (2-process gloo merge) ===="
+    # two gloo workers train against dist_tpu_sync (clock-anchor
+    # handshake at kvstore creation), dump rank-local traces, and the
+    # parent merges them — the merged chrome trace must carry BOTH
+    # rank lanes on the aligned timebase (obs_smoke exits non-zero
+    # otherwise). Serial like everything else on the 1-core host.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --nproc 2; then
+        echo "[tier1] FAIL: distributed observability smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -22,6 +22,7 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
 from ..observability import core as _obs
+from ..observability import dist as _obs_dist
 from ..observability import recompile as _obs_recompile
 from ..parallel import fusion
 from .parameter import Parameter
@@ -152,8 +153,11 @@ class Trainer(object):
                 self._optimizer.rescale_grad /= scaler.loss_scale
             self._update(ignore_stale_grad)
         if _obs.enabled():
-            # arm the recompile detector once the step's graphs exist
+            # arm the recompile detector once the step's graphs exist,
+            # and (multi-worker, every MXNET_OBS_SKEW_EVERY steps) run
+            # the cross-rank straggler exchange
             _obs_recompile.step_boundary()
+            _obs_dist.step_boundary(self._kvstore)
 
     def allreduce_grads(self):
         self._ready()

@@ -50,6 +50,7 @@ from . import optimizer as opt
 from .gradient_compression import GradientCompression
 from .ndarray import NDArray
 from .observability import core as _obs
+from .observability import watchdog as _wd
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
 
@@ -141,7 +142,8 @@ class KVStore(object):
         """Aggregate values (kvstore.py:234). priority is accepted for API
         parity; XLA schedules collectives so ordering hints are moot."""
         keys, values = self._normalize(key, value)
-        with _obs.span("kvstore.push", cat="collective", keys=len(keys)):
+        with _obs.span("kvstore.push", cat="collective", keys=len(keys)), \
+                _wd.watch("kvstore.push", keys=len(keys)):
             for k, v in zip(keys, values):
                 vlist = v if isinstance(v, (list, tuple)) else [v]
                 datas = self._maybe_compress(k, [x._data for x in vlist])
@@ -184,7 +186,8 @@ class KVStore(object):
         """Broadcast current value into out (kvstore.py:318)."""
         assert out is not None
         keys, outs = self._normalize(key, out)
-        with _obs.span("kvstore.pull", cat="collective", keys=len(keys)):
+        with _obs.span("kvstore.pull", cat="collective", keys=len(keys)), \
+                _wd.watch("kvstore.pull", keys=len(keys)):
             for k, o in zip(keys, outs):
                 if k not in self._store:
                     raise ValueError("Please initialize key %s first" % k)
@@ -274,6 +277,12 @@ class KVStore(object):
             lane=lane.dtype, bytes=lane.nbytes, keys=len(lane.segments),
             shard=slot is not None, workers=nw)
         lane_span.start()
+        # hang watchdog armed per collective dispatch: the post-mortem
+        # names the bucket/dtype lane that never completed
+        lane_wd = _wd.watch(
+            "kvstore.pushpull_fused", bucket=bucket.index,
+            lane=lane.dtype, bytes=lane.nbytes, keys=len(lane.segments),
+            shard=slot is not None).start()
         if _obs.enabled():
             _obs.counter("kvstore.bucket_bytes", "bytes").add(lane.nbytes)
         pad = slot.l_pad if slot is not None else None
@@ -313,6 +322,7 @@ class KVStore(object):
                 src = self._store[seg.key]
                 for dst in outs[seg.key]:
                     self._pull_into(src, dst)
+        lane_wd.stop()
         lane_span.stop()
 
     @staticmethod
@@ -513,6 +523,16 @@ class KVStoreTPUSync(KVStore):
             Mesh(np.asarray(self._flat_devices), ("worker",)), P())
         self._per_proc = None
         self._proc_sharding = None
+        if _obs.enabled() and jax.process_count() > 1:
+            # barrier-handshake clock calibration at store creation:
+            # every rank exits the same tiny collective within its
+            # completion skew, so the anchors mark one global instant —
+            # merge_traces aligns the per-rank trace timelines with it
+            from .observability import dist as _obs_dist
+            _obs_dist.record_clock_anchor(barrier_fn=self._clock_barrier)
+
+    def _clock_barrier(self):
+        self._cross_process_allreduce([jnp.ones((1,), jnp.float32)])
 
     def init(self, key, value):
         """Stored values live replicated over the whole mesh so the
@@ -561,16 +581,27 @@ class KVStoreTPUSync(KVStore):
         stays eager-friendly."""
         local = jnp.asarray(_sum_n(*datas) if len(datas) > 1 else datas[0])
         per_proc, sharding = self._process_topology()
-        mine = jax.device_put(local[None],
-                              per_proc[jax.process_index()])
-        global_arr = jax.make_array_from_single_device_arrays(
-            (len(per_proc),) + tuple(local.shape), sharding, [mine])
-        reduce_fn = _allreduce_jit(per_proc,
-                                   (len(per_proc),) + tuple(local.shape),
-                                   str(local.dtype))
-        out = reduce_fn(global_arr)
-        # fully-replicated: the local shard IS the global sum
-        return out.addressable_data(0)
+        # this is THE blocking rendezvous of a multi-host step: a rank
+        # that never dispatches leaves every peer stuck fetching the
+        # reduced shard, so the hang watchdog brackets dispatch + fetch
+        with _wd.watch("kvstore.allreduce", nprocs=len(per_proc),
+                       shape=str(tuple(local.shape)),
+                       dtype=str(local.dtype)):
+            mine = jax.device_put(local[None],
+                                  per_proc[jax.process_index()])
+            global_arr = jax.make_array_from_single_device_arrays(
+                (len(per_proc),) + tuple(local.shape), sharding, [mine])
+            reduce_fn = _allreduce_jit(
+                per_proc, (len(per_proc),) + tuple(local.shape),
+                str(local.dtype))
+            out = reduce_fn(global_arr)
+            # fully-replicated: the local shard IS the global sum
+            out = out.addressable_data(0)
+            if _wd.enabled():
+                # completion must land inside the armed window; the
+                # unwatched path keeps XLA's async dispatch
+                jax.block_until_ready(out)
+        return out
 
     def _process_topology(self):
         """One representative device per process + the worker sharding —

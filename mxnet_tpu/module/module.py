@@ -22,6 +22,7 @@ from .. import kvstore as kvs
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
 from ..observability import core as _obs
+from ..observability import dist as _obs_dist
 from ..observability import recompile as _obs_recompile
 from ..model import save_checkpoint, load_checkpoint
 from .base_module import BaseModule, _check_input_names
@@ -405,6 +406,7 @@ class Module(BaseModule):
             self._update_impl()
         if _obs.enabled():
             _obs_recompile.step_boundary()
+            _obs_dist.step_boundary(self._kvstore)
 
     def _update_impl(self):
         self._params_dirty = True

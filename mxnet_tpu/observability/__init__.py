@@ -18,21 +18,37 @@ backward / allreduce / update), KVStore push/pull/pushpull_fused
 (per-bucket bytes, dtype lane, dispatch counts, wall time), the io.py
 iterators (batch latency, prefetch wait), and the CachedOp/Executor
 jit boundaries (compile spans + retrace attribution).
+
+Multi-process jobs get the distributed half (``dist.py``,
+``watchdog.py``): rank-tagged events, rank-suffixed dumps merged into
+one per-rank-lane trace on a barrier-aligned timebase
+(``merge_traces`` / ``tools/obs_merge.py``), cross-rank step-phase
+straggler detection (``MXNET_OBS_SKEW_EVERY`` /
+``MXNET_OBS_STRAGGLER_FACTOR``), and a collective hang watchdog that
+dumps a post-mortem after ``MXNET_OBS_COLLECTIVE_TIMEOUT`` seconds
+instead of hanging silently.
 """
 
 from . import core
+from . import dist
 from . import export
 from . import recompile
+from . import watchdog
 from .core import (enabled, set_enabled, span, counter, gauge,
                    record_span, record_instant, records, counters,
                    dropped, reset)
+from .dist import (merge_traces, detect_stragglers, skew_summary,
+                   exchange_phase_stats)
 from .export import (chrome_trace, dump_chrome_trace, aggregate,
                      aggregate_table, prometheus_text, write_prometheus)
 from .recompile import get_detector, note_call, record_retrace
+from .watchdog import get_watchdog
 
-__all__ = ["core", "export", "recompile", "enabled", "set_enabled",
-           "span", "counter", "gauge", "record_span", "record_instant",
-           "records", "counters", "dropped", "reset", "chrome_trace",
-           "dump_chrome_trace", "aggregate", "aggregate_table",
-           "prometheus_text", "write_prometheus", "get_detector",
-           "note_call", "record_retrace"]
+__all__ = ["core", "dist", "export", "recompile", "watchdog", "enabled",
+           "set_enabled", "span", "counter", "gauge", "record_span",
+           "record_instant", "records", "counters", "dropped", "reset",
+           "chrome_trace", "dump_chrome_trace", "aggregate",
+           "aggregate_table", "prometheus_text", "write_prometheus",
+           "get_detector", "note_call", "record_retrace", "merge_traces",
+           "detect_stragglers", "skew_summary", "exchange_phase_stats",
+           "get_watchdog"]

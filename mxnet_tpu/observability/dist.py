@@ -1,0 +1,412 @@
+"""Distributed observability — rank identity, cross-rank trace merging,
+and step-phase straggler detection.
+
+The PR 2 telemetry core is deliberately per-process: one ring, one
+counter registry, no notion of a peer. That mirrors the reference
+engine's blindness — a rank blocked in Engine::WaitForVar or inside a
+ps-lite push looks identical to one doing useful work
+(include/mxnet/engine.h, SURVEY layer 2). Once training is multi-host
+the dominant failure modes are exactly the ones a per-process view
+cannot show (TF system paper, PAPERS.md): stragglers and silently hung
+collectives. This module adds the cross-rank half:
+
+* **rank identity** — every exported event carries the jax
+  ``process_index`` as its chrome-trace ``pid``, so each rank is one
+  lane; rank-local dumps are rank-suffixed (``trace.rank1.json``)
+  instead of N processes clobbering one file.
+* **clock alignment** — host ``perf_counter`` epochs differ per
+  process, so rank-local timestamps share no timebase.
+  ``record_clock_anchor`` runs a barrier handshake (a tiny collective,
+  taken at kvstore creation) and records the local mono/wall time at
+  barrier exit; all ranks exit a synchronous collective within its
+  completion skew, so the anchor instants are simultaneous to within
+  the collective's latency — good enough to line up millisecond-scale
+  step phases. ``merge_traces`` subtracts per-rank anchor offsets and
+  emits ONE chrome://tracing file with per-rank lanes.
+* **straggler detection** — every ``MXNET_OBS_SKEW_EVERY`` steps the
+  Trainer/Module hook all-gathers each rank's mean per-phase durations
+  (forward/backward/allreduce/update) and warns when one rank exceeds
+  the across-rank median by ``MXNET_OBS_STRAGGLER_FACTOR``. The last
+  window's skew table is appended to ``profiler.dumps(aggregate=True)``
+  as min/median/max-rank columns.
+
+Everything here is either off the hot path (merge, exchange) or behind
+the same ``core.enabled()`` gate as the rest of the telemetry.
+"""
+
+import glob
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["PHASES", "process_index", "process_count", "rank_trace_path",
+           "record_clock_anchor", "ensure_clock_anchor", "clock_anchor",
+           "find_rank_traces", "merge_traces", "skew_every",
+           "straggler_factor", "collect_phase_ms", "detect_stragglers",
+           "exchange_phase_stats", "skew_summary", "format_skew_table",
+           "step_boundary"]
+
+PHASES = ("forward", "backward", "allreduce", "update")
+
+DEFAULT_SKEW_EVERY = 32
+DEFAULT_STRAGGLER_FACTOR = 2.0
+# phases shorter than this never flag: at sub-ms scale the across-rank
+# ratio is host-scheduler noise, not a straggler
+MIN_STRAGGLER_MS = 0.25
+
+_anchor = None          # barrier-handshake clock anchor (this rank)
+_skew = None            # last cross-rank skew summary
+_steps = 0              # step_boundary() count
+_since_us = 0           # ring timestamp of the last exchange window end
+
+
+def process_index():
+    """This process's rank (0 when jax is absent/uninitialized)."""
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def process_count():
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def skew_every():
+    return int(_fastenv.get("MXNET_OBS_SKEW_EVERY", DEFAULT_SKEW_EVERY))
+
+
+def straggler_factor():
+    return float(_fastenv.get("MXNET_OBS_STRAGGLER_FACTOR",
+                              DEFAULT_STRAGGLER_FACTOR))
+
+
+# ------------------------------------------------------ rank-local IO --
+
+def rank_trace_path(path, rank=None):
+    """Rank-suffixed dump target: rank 0 keeps the bare name, rank r
+    writes ``<stem>.rank<r><ext>`` — N processes sharing one configured
+    filename no longer clobber a single JSON."""
+    rank = process_index() if rank is None else int(rank)
+    if rank == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return "%s.rank%d%s" % (root, rank, ext or ".json")
+
+
+def find_rank_traces(base):
+    """All rank-local traces for a configured filename: the bare file
+    (rank 0) plus every ``<stem>.rank*<ext>`` sibling, sorted by rank."""
+    root, ext = os.path.splitext(base)
+    paths = [base] if os.path.exists(base) else []
+    ranked = glob.glob("%s.rank*%s" % (root, ext or ".json"))
+
+    def _rank_of(p):
+        stem = os.path.splitext(p)[0]
+        try:
+            return int(stem.rsplit(".rank", 1)[1])
+        except (IndexError, ValueError):
+            return 1 << 30
+    return paths + sorted(ranked, key=_rank_of)
+
+
+# ------------------------------------------------------ clock anchor --
+
+def record_clock_anchor(barrier_fn=None, rounds=4, rank=None, nprocs=None,
+                        _mono_us=None, _wall_us=None):
+    """Barrier-handshake clock calibration (taken at kvstore creation).
+
+    ``barrier_fn`` runs one synchronous cross-rank collective; it is
+    called ``rounds`` times (the first calls absorb compile/rendezvous
+    cost) and the local clock is read immediately after the last —
+    every rank reads within the final collective's completion skew, so
+    the anchors mark (approximately) one global instant.
+    ``_mono_us``/``_wall_us`` inject fake clocks for tests."""
+    global _anchor
+    if barrier_fn is not None:
+        for _ in range(max(int(rounds), 1)):
+            barrier_fn()
+    mono = core._now_us() if _mono_us is None else int(_mono_us)
+    wall = int(time.time() * 1e6) if _wall_us is None else int(_wall_us)
+    _anchor = {"rank": process_index() if rank is None else int(rank),
+               "nprocs": process_count() if nprocs is None else int(nprocs),
+               "mono_us": mono, "wall_us": wall,
+               "barrier": barrier_fn is not None}
+    return _anchor
+
+
+def ensure_clock_anchor():
+    """Anchor for dump time: keeps any barrier-calibrated anchor, else
+    records a local (offset-0) one so single-process merges work."""
+    if _anchor is None:
+        record_clock_anchor()
+    return _anchor
+
+
+def clock_anchor():
+    return _anchor
+
+
+# ------------------------------------------------------ trace merging --
+
+def merge_traces(paths, out=None):
+    """Combine rank-local chrome traces into one file with per-rank
+    lanes on a common timebase.
+
+    ``paths``: a list of trace files, or one configured filename whose
+    rank-suffixed siblings are discovered (``find_rank_traces``). Each
+    rank's events shift by its clock-anchor offset against the lowest
+    anchored rank (traces without an anchor merge unshifted and are
+    listed in ``otherData.unaligned_ranks``), land on ``pid = rank``,
+    and get a ``process_name`` metadata row. Returns the merged trace
+    dict; writes it to ``out`` when given."""
+    if isinstance(paths, str):
+        paths = find_rank_traces(paths)
+    if not paths:
+        raise ValueError("merge_traces: no input traces")
+    loaded = []
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            trace = json.load(f)
+        other = trace.get("otherData", {}) or {}
+        rank = other.get("rank")
+        if rank is None:
+            rank = i
+        loaded.append((int(rank), other.get("clock_anchor"), trace, p))
+    loaded.sort(key=lambda t: t[0])
+
+    ref = next((a for _, a, _, _ in loaded if a), None)
+    events, offsets, unaligned, dropped = [], {}, [], 0
+    for rank, anchor, trace, _p in loaded:
+        if anchor and ref:
+            off = int(anchor["mono_us"]) - int(ref["mono_us"])
+        else:
+            off = 0
+            unaligned.append(rank)
+        offsets[rank] = off
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": "rank %d" % rank}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue            # re-emitted above, per merged rank
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] - off
+            events.append(ev)
+        dropped += int((trace.get("otherData") or {})
+                       .get("dropped_records", 0) or 0)
+
+    # chrome://tracing renders negative timestamps poorly: rebase the
+    # merged timeline so the earliest event sits at 0
+    t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
+    if t0:
+        for ev in events:
+            if "ts" in ev:
+                ev["ts"] -= t0
+    merged = {
+        "traceEvents": events, "displayTimeUnit": "ms",
+        "otherData": {
+            "recorder": "mxnet_tpu.observability.merge_traces",
+            "merged_ranks": [r for r, _, _, _ in loaded],
+            "clock_offsets_us": {str(r): o for r, o in offsets.items()},
+            "unaligned_ranks": unaligned,
+            "dropped_records": dropped}}
+    if out:
+        with open(out, "w") as f:
+            json.dump(merged, f)
+    return merged
+
+
+# ----------------------------------------------- straggler detection --
+
+def collect_phase_ms(since_us=0, phases=PHASES):
+    """Mean duration (ms) per step phase from the local ring, over
+    records at/after ``since_us`` — the per-rank sample one skew
+    exchange contributes."""
+    sums = {p: 0.0 for p in phases}
+    counts = {p: 0 for p in phases}
+    for rec in core.records():
+        ph, name, _cat, ts, dur, _tid, _args = rec
+        if ph == "X" and name in sums and ts >= since_us:
+            sums[name] += dur / 1000.0
+            counts[name] += 1
+    return {p: (sums[p] / counts[p] if counts[p] else 0.0)
+            for p in phases}
+
+
+def detect_stragglers(phase_table, factor=None, min_ms=MIN_STRAGGLER_MS):
+    """Reduce per-rank phase durations to a skew summary + straggler
+    verdicts.
+
+    ``phase_table``: {phase: [per-rank ms]}. A rank straggles on a
+    phase when its duration exceeds the across-rank median by
+    ``factor`` (``MXNET_OBS_STRAGGLER_FACTOR``) and the duration
+    clears the ``min_ms`` noise floor. The flagging median is taken
+    LEAVE-ONE-OUT (the other ranks' median): at small world sizes the
+    straggler's own sample drags the plain median toward itself — with
+    2 ranks a 5x-slow rank would otherwise never exceed 2x "median"."""
+    factor = straggler_factor() if factor is None else float(factor)
+    summary = {"phases": {}, "stragglers": [], "factor": factor,
+               "nprocs": 0}
+    for phase, vals in phase_table.items():
+        vals = [float(v) for v in vals]
+        if not vals:
+            continue
+        summary["nprocs"] = max(summary["nprocs"], len(vals))
+        mn, mx = min(vals), max(vals)
+        max_rank = vals.index(mx)
+        others = vals[:max_rank] + vals[max_rank + 1:]
+        med = float(np.median(others)) if others else mx
+        entry = {
+            "ms": vals, "min_ms": mn, "min_rank": vals.index(mn),
+            "median_ms": med, "max_ms": mx, "max_rank": max_rank,
+            "ratio": (mx / med) if med > 0
+            else (float("inf") if mx > 0 else 1.0)}
+        summary["phases"][phase] = entry
+        if mx >= min_ms and len(vals) > 1 and med > 0 \
+                and mx > med * factor:
+            summary["stragglers"].append({
+                "phase": phase, "rank": max_rank, "ms": mx,
+                "median_ms": med, "ratio": entry["ratio"]})
+    return summary
+
+
+def _allgather_vec(vec):
+    """All-gather one small float32 vector across ranks -> [nprocs, d]
+    host array. Collective: every rank must call in (the exchange runs
+    at deterministic step counts). Single-process: identity."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    vec = np.asarray(vec, np.float32).reshape(-1)
+    n = jax.process_count()
+    if n <= 1:
+        return vec[None]
+    per_proc = tuple(
+        next(d for d in jax.devices() if d.process_index == p)
+        for p in range(n))
+    mesh = Mesh(np.asarray(per_proc), ("worker",))
+    mine = jax.device_put(jnp.asarray(vec)[None],
+                          per_proc[jax.process_index()])
+    garr = jax.make_array_from_single_device_arrays(
+        (n, vec.shape[0]), NamedSharding(mesh, P("worker")), [mine])
+    gathered = jax.jit(
+        lambda x: x,
+        out_shardings=NamedSharding(mesh, P()))(garr)
+    return np.asarray(gathered.addressable_data(0))
+
+
+def exchange_phase_stats(phase_ms=None, allgather=None, rank=None,
+                         warn=True):
+    """One cross-rank skew exchange: all-gather this rank's per-phase
+    means, update the skew summary, publish skew gauges, and warn on
+    stragglers. ``phase_ms``/``allgather``/``rank`` are injectable for
+    tests (fake clocks, no real multi-host needed)."""
+    global _skew, _since_us
+    local = collect_phase_ms(_since_us) if phase_ms is None \
+        else dict(phase_ms)
+    _since_us = core._now_us()
+    vec = np.asarray([local.get(p, 0.0) for p in PHASES], np.float32)
+    gathered = (_allgather_vec if allgather is None else allgather)(vec)
+    gathered = np.asarray(gathered, np.float32)
+    table = {p: list(gathered[:, i]) for i, p in enumerate(PHASES)}
+    summary = detect_stragglers(table)
+    summary["rank"] = process_index() if rank is None else int(rank)
+    summary["step"] = _steps
+    _skew = summary
+    for phase, e in summary["phases"].items():
+        core.gauge("skew.%s.max_over_median" % phase).set(
+            e["ratio"] if np.isfinite(e["ratio"]) else 0.0)
+    try:
+        from .. import storage
+        storage.publish_device_memory_gauges()
+    except Exception:
+        pass
+    if warn:
+        for s in summary["stragglers"]:
+            warnings.warn(
+                "mxnet_tpu.observability: cross-rank straggler — rank "
+                "%d %s %.2f ms vs across-rank median %.2f ms (x%.1f, "
+                "factor %.1f)" % (s["rank"], s["phase"], s["ms"],
+                                  s["median_ms"], s["ratio"],
+                                  summary["factor"]),
+                RuntimeWarning, stacklevel=2)
+    return summary
+
+
+def skew_summary():
+    """The last exchange's cross-rank skew summary (None before one)."""
+    return _skew
+
+
+def format_skew_table(summary=None):
+    """The skew summary as table lines — appended to
+    ``profiler.dumps(aggregate=True)`` after the counter section."""
+    summary = _skew if summary is None else summary
+    if not summary or not summary["phases"]:
+        return []
+    fmt = "%-12s %14s %10s %14s %12s  %s"
+    lines = ["",
+             "Cross-rank step-phase skew (%d ranks, straggler factor "
+             "%.1fx)" % (summary.get("nprocs", 0),
+                         summary.get("factor", 0.0)),
+             "=" * 28,
+             fmt % ("Phase", "Min(rank)", "Med(rest)", "Max(rank)",
+                    "Max/Median", "")]
+    flagged = {(s["phase"], s["rank"]) for s in summary["stragglers"]}
+    for phase in PHASES:
+        e = summary["phases"].get(phase)
+        if e is None:
+            continue
+        mark = "<< STRAGGLER r%d" % e["max_rank"] \
+            if (phase, e["max_rank"]) in flagged else ""
+        ratio = "%.2f" % e["ratio"] if np.isfinite(e["ratio"]) else "inf"
+        lines.append(fmt % (
+            phase, "%.3f (r%d)" % (e["min_ms"], e["min_rank"]),
+            "%.3f" % e["median_ms"],
+            "%.3f (r%d)" % (e["max_ms"], e["max_rank"]), ratio, mark))
+    return lines
+
+
+def step_boundary(kv=None):
+    """Trainer/Module hook (call only when ``core.enabled()``): counts
+    steps and, every ``MXNET_OBS_SKEW_EVERY`` steps of a multi-worker
+    job, runs one skew exchange. Telemetry must never break training:
+    exchange failures degrade to a single warning."""
+    global _steps
+    _steps += 1
+    every = skew_every()
+    if every <= 0:
+        return
+    n = kv.num_workers if kv is not None else process_count()
+    if n <= 1 or _steps % every:
+        return
+    try:
+        exchange_phase_stats()
+    except Exception as exc:          # pragma: no cover - defensive
+        warnings.warn("mxnet_tpu.observability: skew exchange failed "
+                      "(%s); continuing without cross-rank stats"
+                      % (exc,), RuntimeWarning, stacklevel=2)
+
+
+def _reset_for_tests():
+    """Clear module state (anchor, skew window, step count)."""
+    global _anchor, _skew, _steps, _since_us
+    _anchor = None
+    _skew = None
+    _steps = 0
+    _since_us = 0

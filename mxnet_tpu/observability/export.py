@@ -22,26 +22,35 @@ __all__ = ["chrome_trace", "dump_chrome_trace", "aggregate",
 def chrome_trace(extra_events=None):
     """The ring as a chrome://tracing (catapult) JSON object. Spans are
     "X" complete events, counter samples "C" events; load the file at
-    chrome://tracing or ui.perfetto.dev."""
-    events = []
+    chrome://tracing or ui.perfetto.dev. Every event carries this
+    process's rank as its ``pid`` so rank-local traces merge into
+    per-rank lanes (``dist.merge_traces``); ``otherData`` carries the
+    rank + barrier clock anchor the merge aligns timelines with."""
+    from . import dist
+    rank = dist.process_index()
+    events = [{"name": "process_name", "ph": "M", "pid": rank,
+               "args": {"name": "rank %d" % rank}}]
     for rec in core.records():
         ph, name, cat, ts, val, tid, args = rec
         if ph == "X":
             events.append({"name": name, "cat": cat, "ph": "X",
-                           "ts": ts, "dur": val, "pid": 0, "tid": tid,
+                           "ts": ts, "dur": val, "pid": rank, "tid": tid,
                            "args": args})
         elif ph == "C":
             events.append({"name": name, "cat": cat, "ph": "C",
-                           "ts": ts, "pid": 0,
+                           "ts": ts, "pid": rank,
                            "args": {name.rsplit(".", 1)[-1]: val}})
         else:
             events.append({"name": name, "cat": cat, "ph": "i",
-                           "ts": ts, "pid": 0, "tid": tid, "s": "t",
+                           "ts": ts, "pid": rank, "tid": tid, "s": "t",
                            "args": args})
     if extra_events:
         events.extend(extra_events)
     trace = {"traceEvents": events, "displayTimeUnit": "ms",
              "otherData": {"recorder": "mxnet_tpu.observability",
+                           "rank": rank,
+                           "num_processes": dist.process_count(),
+                           "clock_anchor": dist.clock_anchor(),
                            "dropped_records": core.dropped()}}
     return trace
 
@@ -128,6 +137,8 @@ def aggregate_table():
                              "%g" % s["min"], "%g" % s["max"],
                              "%g" % s["p50"], "%g" % s["p99"],
                              "%g" % s["value"]))
+    from . import dist
+    lines.extend(dist.format_skew_table())
     if core.dropped():
         lines.append("")
         lines.append("(%d oldest records dropped from the ring; "
@@ -175,6 +186,11 @@ def prometheus_text():
     for name, s in agg["counters"].items():
         lines.append('mxnet_obs_value{name="%s"} %g'
                      % (_prom_name(name), s["value"]))
+    from . import dist
+    lines.append("# HELP mxnet_obs_rank this process's rank (label the "
+                 "scrape per worker in multi-host jobs)")
+    lines.append("# TYPE mxnet_obs_rank gauge")
+    lines.append('mxnet_obs_rank %d' % dist.process_index())
     lines.append('mxnet_obs_dropped_records %d' % core.dropped())
     return "\n".join(lines) + "\n"
 
@@ -182,11 +198,15 @@ def prometheus_text():
 def write_prometheus(path=None):
     """Write the textfile; ``path`` defaults to MXNET_OBS_PROM. The
     write goes through a .tmp rename so a concurrent scrape never sees
-    a torn file. Returns the path, or None when no target configured."""
+    a torn file. Returns the path, or None when no target configured.
+    Multi-process runs rank-suffix the file (rank 0 keeps the bare
+    name) — one textfile per worker, no clobbering."""
     import os
     path = path or _fastenv.get("MXNET_OBS_PROM")
     if not path:
         return None
+    from . import dist
+    path = dist.rank_trace_path(path)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(prometheus_text())

@@ -1,0 +1,371 @@
+"""Collective hang watchdog — a post-mortem instead of a silent hang.
+
+A synchronous collective that one rank never reaches blocks every other
+rank forever, and from the outside the job just stops making progress
+— the reference's WaitForVar blindness at cluster scale. The watchdog
+arms before every collective dispatch (KVStore push/pull/
+pushpull_fused, the cross-process all-reduce, the sharded-update
+program, ring attention) and, if the operation has not completed after
+``MXNET_OBS_COLLECTIVE_TIMEOUT`` seconds, dumps a post-mortem: which
+collective, its bucket/dtype lane, how long it has been armed, this
+rank's last completed span, and — when ``MXNET_OBS_WATCHDOG_DIR``
+points at a shared directory — which ranks checked in to the same
+dispatch and what each rank last finished.
+
+Cost model: with ``MXNET_OBS`` unset or no timeout configured, a
+``watch`` is one slotted object whose ``__enter__`` takes a single
+guarded branch (the same budget as a disabled ``core.span``). Armed, it
+is one lock + dict insert per collective; the monitor thread wakes a
+few times per second only while operations are in flight. The sideband
+check-in (two small file writes per collective) happens only when the
+directory knob is set.
+
+The watchdog never kills the process: training may still complete if
+the missing rank eventually arrives (the post-mortem then gets a
+"completed after post-mortem" follow-up), and on a real hang the
+operator gets the report while attaching a debugger.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import warnings
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["timeout_s", "enabled", "sideband_dir", "CollectiveWatchdog",
+           "get_watchdog", "watch", "read_sideband"]
+
+DEFAULT_POLL_S = 0.25
+
+
+def timeout_s():
+    """MXNET_OBS_COLLECTIVE_TIMEOUT in seconds; 0 (default) disarms."""
+    try:
+        return float(_fastenv.get("MXNET_OBS_COLLECTIVE_TIMEOUT", "0")
+                     or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def enabled():
+    """THE site guard: telemetry on AND a timeout configured (checked
+    in that order — core.enabled() is the cheap common-case False)."""
+    return core.enabled() and timeout_s() > 0
+
+
+def sideband_dir():
+    """Shared directory for cross-rank check-in files (optional)."""
+    return _fastenv.get("MXNET_OBS_WATCHDOG_DIR")
+
+
+def _rank():
+    from . import dist
+    return dist.process_index()
+
+
+def _nprocs():
+    from . import dist
+    return dist.process_count()
+
+
+class CollectiveWatchdog(object):
+    """Deadline monitor for in-flight collectives.
+
+    ``clock`` and ``timeout`` are injectable so tests drive expiry with
+    fake clocks; ``thread=False`` disables the background monitor (call
+    ``check()`` manually). The module singleton uses real time and a
+    daemon thread."""
+
+    def __init__(self, timeout=None, clock=time.monotonic, rank=None,
+                 nprocs=None, thread=True, emit=None):
+        self._timeout = timeout
+        self.clock = clock
+        self._rank = rank
+        self._nprocs = nprocs
+        self._use_thread = thread
+        self._emit = emit
+        self._cv = threading.Condition()
+        self._active = {}            # token -> op dict
+        self._seq = 0
+        self._thread = None
+        self.last_completed = None   # (name, info, wall_s, mono_s)
+        self.reports = []            # post-mortem texts (newest last)
+
+    # ------------------------------------------------------ identity --
+    @property
+    def timeout(self):
+        return timeout_s() if self._timeout is None else float(self._timeout)
+
+    @property
+    def rank(self):
+        return _rank() if self._rank is None else self._rank
+
+    @property
+    def nprocs(self):
+        return _nprocs() if self._nprocs is None else self._nprocs
+
+    # ------------------------------------------------------ arm/disarm --
+    def arm(self, name, info=None):
+        now = self.clock()
+        with self._cv:
+            self._seq += 1
+            token = self._seq
+            self._active[token] = {
+                "token": token, "name": name, "info": dict(info or {}),
+                "t0": now, "deadline": now + self.timeout,
+                "wall0": time.time(), "fired": False}
+            self._cv.notify()
+        self._write_sideband()
+        if self._use_thread:
+            self._ensure_thread()
+        return token
+
+    def disarm(self, token):
+        with self._cv:
+            op = self._active.pop(token, None)
+        if op is None:
+            return
+        self.last_completed = (op["name"], op["info"], time.time(),
+                               self.clock())
+        if op["fired"]:
+            dur = self.clock() - op["t0"]
+            self._report("[watchdog] rank %d: collective %s completed "
+                         "after post-mortem (%.1fs total)"
+                         % (self.rank, op["name"], dur))
+        self._write_sideband()
+
+    # -------------------------------------------------------- checking --
+    def check(self, now=None):
+        """Fire post-mortems for every expired, unreported operation.
+        Returns the reports (also appended to ``self.reports``)."""
+        now = self.clock() if now is None else now
+        with self._cv:
+            expired = [op for op in self._active.values()
+                       if not op["fired"] and now >= op["deadline"]]
+            for op in expired:
+                op["fired"] = True
+        out = []
+        for op in expired:
+            rep = self.post_mortem(op, now)
+            out.append(rep)
+            self.reports.append(rep)
+            self._fire(op, rep)
+        return out
+
+    def _fire(self, op, report):
+        self._report(report)
+        if core.enabled():
+            core.record_instant(
+                "watchdog.postmortem", cat="watchdog",
+                args={"collective": op["name"], "rank": self.rank,
+                      "armed_s": round(self.clock() - op["t0"], 3)})
+            core.counter("watchdog.postmortems").add(1)
+        d = sideband_dir()
+        if d:
+            try:
+                path = os.path.join(
+                    d, "postmortem.rank%d.txt" % self.rank)
+                with open(path, "a") as f:
+                    f.write(report + "\n")
+            except OSError:
+                pass
+        warnings.warn(
+            "mxnet_tpu.observability: collective %s exceeded the %.1fs "
+            "watchdog timeout on rank %d — post-mortem dumped"
+            % (op["name"], self.timeout, self.rank),
+            RuntimeWarning, stacklevel=2)
+
+    def _report(self, text):
+        if self._emit is not None:
+            self._emit(text)
+        else:
+            print(text, file=sys.stderr, flush=True)
+
+    # ------------------------------------------------------ post-mortem --
+    def post_mortem(self, op, now=None):
+        """The report for one hung operation."""
+        now = self.clock() if now is None else now
+        bar = "=" * 74
+        lines = [bar,
+                 "MXNET_OBS collective watchdog post-mortem",
+                 "rank %d/%d | collective %s | armed %.1fs ago "
+                 "(timeout %.1fs)"
+                 % (self.rank, self.nprocs, op["name"],
+                    now - op["t0"], self.timeout)]
+        if op["info"]:
+            lines.append("  dispatch: " + " ".join(
+                "%s=%s" % (k, v) for k, v in sorted(op["info"].items())))
+        if self.last_completed is not None:
+            name, _info, _wall, mono = self.last_completed
+            lines.append("  local last completed span: %s "
+                         "(finished %.1fs ago)" % (name, now - mono))
+        else:
+            lines.append("  local last completed span: <none recorded>")
+        others = [o for o in self._snapshot_active()
+                  if o["token"] != op["token"]]
+        if others:
+            lines.append("  also in flight locally: " + ", ".join(
+                "%s (%.1fs)" % (o["name"], now - o["t0"])
+                for o in others))
+        d = sideband_dir()
+        if d:
+            lines.append("  rank check-in (sideband %s):" % d)
+            entries = read_sideband(d)
+            seen = set()
+            for e in sorted(entries, key=lambda e: e.get("rank", -1)):
+                r = e.get("rank")
+                seen.add(r)
+                me = " (this rank)" if r == self.rank else ""
+                if e.get("status") == "armed":
+                    lines.append(
+                        "    rank %s: ARMED %s seq=%s since %s%s"
+                        % (r, e.get("collective"), e.get("seq"),
+                           _fmt_wall(e.get("since_wall")), me))
+                else:
+                    last = e.get("last_completed") or {}
+                    lines.append(
+                        "    rank %s: idle — last completed %s @ %s "
+                        "(NOT checked in)%s"
+                        % (r, last.get("name", "<none>"),
+                           _fmt_wall(last.get("wall")), me))
+            for r in range(self.nprocs):
+                if r not in seen:
+                    lines.append("    rank %d: <no sideband entry> "
+                                 "(NOT checked in)" % r)
+        else:
+            lines.append("  rank check-in: unavailable — set "
+                         "MXNET_OBS_WATCHDOG_DIR to a shared directory "
+                         "for cross-rank state")
+        lines.append(bar)
+        return "\n".join(lines)
+
+    def _snapshot_active(self):
+        with self._cv:
+            return [dict(op) for op in self._active.values()]
+
+    # --------------------------------------------------------- sideband --
+    def _write_sideband(self):
+        d = sideband_dir()
+        if not d:
+            return
+        armed = None
+        with self._cv:
+            if self._active:
+                armed = max(self._active.values(),
+                            key=lambda op: op["token"])
+        entry = {"rank": self.rank}
+        if armed is not None:
+            entry.update({"status": "armed",
+                          "collective": armed["name"],
+                          "seq": armed["token"],
+                          "info": {k: str(v)
+                                   for k, v in armed["info"].items()},
+                          "since_wall": armed["wall0"]})
+        else:
+            entry["status"] = "idle"
+        if self.last_completed is not None:
+            name, _info, wall, _mono = self.last_completed
+            entry["last_completed"] = {"name": name, "wall": wall}
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, ".wd.rank%d.tmp" % self.rank)
+            with open(tmp, "w") as f:
+                json.dump(entry, f)
+            os.replace(tmp, os.path.join(d, "wd.rank%d.json" % self.rank))
+        except OSError:                  # sideband is best-effort
+            pass
+
+    # ----------------------------------------------------------- thread --
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        t = threading.Thread(target=self._loop,
+                             name="mxnet-obs-watchdog", daemon=True)
+        self._thread = t
+        t.start()
+
+    def _loop(self):                     # pragma: no cover - timing
+        while True:
+            with self._cv:
+                if not self._active:
+                    self._cv.wait()
+                    continue
+                tmo = self.timeout
+            poll = max(0.05, min(DEFAULT_POLL_S, tmo / 5 if tmo else
+                                 DEFAULT_POLL_S))
+            time.sleep(poll)
+            try:
+                self.check()
+            except Exception:            # never take the process down
+                pass
+
+
+def _fmt_wall(wall):
+    if not wall:
+        return "<unknown>"
+    return time.strftime("%H:%M:%S", time.localtime(wall)) \
+        + ".%03d" % (int(wall * 1000) % 1000)
+
+
+def read_sideband(d):
+    """Parse every rank's check-in file under the sideband dir."""
+    out = []
+    for path in sorted(glob_rank_files(d)):
+        try:
+            with open(path) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def glob_rank_files(d):
+    import glob
+    return glob.glob(os.path.join(d, "wd.rank*.json"))
+
+
+_WD = None
+_wd_lock = threading.Lock()
+
+
+def get_watchdog():
+    """The process singleton (real clock + monitor thread)."""
+    global _WD
+    if _WD is None:
+        with _wd_lock:
+            if _WD is None:
+                _WD = CollectiveWatchdog()
+    return _WD
+
+
+class watch(object):
+    """``with watch("kvstore.pushpull_fused", bucket=0, lane="f32"):``
+    — arms the watchdog around one collective dispatch; a single
+    guarded branch when the watchdog is off (core.span's cost model).
+    Also usable via explicit start()/stop()."""
+
+    __slots__ = ("name", "info", "_token")
+
+    def __init__(self, name, **info):
+        self.name = name
+        self.info = info
+        self._token = None
+
+    def start(self):
+        if enabled():
+            self._token = get_watchdog().arm(self.name, self.info)
+        return self
+
+    def stop(self):
+        if self._token is not None:
+            get_watchdog().disarm(self._token)
+            self._token = None
+
+    __enter__ = start
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -43,6 +43,7 @@ except AttributeError:                  # 0.4.x experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .. import _fastenv
+from ..observability import watchdog as _wd
 
 __all__ = ["DEFAULT_BUCKET_BYTES", "bucket_bytes", "fusion_enabled",
            "shard_update_enabled", "Segment", "Lane", "Bucket",
@@ -499,10 +500,15 @@ class ShardSlot(object):
                          for x in self.flat_opt.extra_scalars()))
         mults = self._mults if self._mults is not None \
             else (jnp.float32(1.0), jnp.float32(1.0))
-        self.flat_w, self.states = self._fns[scatter](
-            g, self.flat_w, self.states, scalars, mults)
-        gathered = _gather_fn(self.devices, self.l_pad,
-                              str(self.mdtype))(self.flat_w)
+        # reduce-scatter -> update -> all-gather is two collective
+        # dispatches; a post-mortem should name the lane that hung
+        with _wd.watch("fusion.shard_update", lane=str(self.lane.dtype),
+                       bytes=self.l_pad * self.mdtype.itemsize,
+                       keys=len(self.lane.segments)):
+            self.flat_w, self.states = self._fns[scatter](
+                g, self.flat_w, self.states, scalars, mults)
+            gathered = _gather_fn(self.devices, self.l_pad,
+                                  str(self.mdtype))(self.flat_w)
         if self.master_fp32:
             gathered = gathered.astype(np.dtype(self.lane.dtype))
         return gathered

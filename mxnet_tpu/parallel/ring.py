@@ -59,9 +59,24 @@ def _shard_map(f, mesh, in_specs, out_specs, axis_names=None,
 
 
 from . import ring_permute
+from ..observability import watchdog as _wd
 
 __all__ = ["ring_attention", "local_attention_block",
            "ring_attention_sharded", "sp_flash_decode"]
+
+
+def _watched_dispatch(name, fn, *args, **info):
+    """Run one collective program under the hang watchdog. With the
+    watchdog off (the default) this is a single guarded branch around a
+    plain call; armed, completion is awaited inside the watched window
+    so a rank stuck in the ring's ppermute/psum rendezvous produces a
+    post-mortem instead of a silent stall."""
+    if not _wd.enabled():
+        return fn(*args)
+    with _wd.watch(name, **info):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return out
 
 _NEG_INF = -1e30
 
@@ -224,7 +239,9 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
                          out_specs=spec, axis_names=set(manual), **kw)
     # jit the mapped program: eager shard_map lacks rules for the ring
     # loop on older jax, and compiled is what a train step wants anyway
-    return jax.jit(smapped)(q, k, v)
+    return _watched_dispatch(
+        "ring.attention", jax.jit(smapped), q, k, v,
+        axis=axis_name, shape=str(tuple(q.shape)))
 
 
 def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
@@ -301,4 +318,7 @@ def sp_flash_decode(q, k_cache, v_cache, lengths, mesh, axis_name="sp",
     smapped = _shard_map(
         local, mesh=mesh, in_specs=(qspec, cspec, cspec, lspec),
         out_specs=qspec, axis_names=manual)
-    return jax.jit(smapped)(q, k_cache, v_cache, lengths)
+    return _watched_dispatch(
+        "ring.sp_flash_decode", jax.jit(smapped),
+        q, k_cache, v_cache, lengths,
+        axis=axis_name, batch=q.shape[0])

@@ -108,13 +108,23 @@ def dump(finished=True, profile_process="worker"):
     """Write the chrome://tracing JSON of everything recorded (telemetry
     ring + user profiler objects) to `filename`; stop any running XLA
     trace so its files hit disk too. Also refreshes the Prometheus
-    textfile when MXNET_OBS_PROM is set."""
+    textfile when MXNET_OBS_PROM is set.
+
+    Multi-process runs write RANK-LOCAL files: rank 0 keeps the bare
+    `filename`, rank r writes `<stem>.rank<r>.json` (no N-way clobber);
+    `mxnet_tpu.observability.merge_traces(filename)` — or the
+    `tools/obs_merge.py` CLI — combines them into one trace with
+    per-rank lanes on the barrier-aligned timebase."""
     if _state["running"] and finished:
         set_state("stop")
     elif _state["dir"] is not None and finished:
         jax.profiler.stop_trace()
         _state["dir"] = None
-    path = str(_config["filename"])
+    from .observability import dist as _obs_dist
+    from . import storage as _storage
+    _obs_dist.ensure_clock_anchor()
+    _storage.publish_device_memory_gauges()
+    path = _obs_dist.rank_trace_path(str(_config["filename"]))
     _obs_export.dump_chrome_trace(path)
     _obs_export.write_prometheus()
     return path

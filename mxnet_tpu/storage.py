@@ -17,8 +17,10 @@ keeps is the OBSERVABILITY the reference pool provided:
 import threading
 import weakref
 
+from .observability import core as _obs
+
 __all__ = ["device_memory_stats", "start_tracking", "stop_tracking",
-           "reset_stats", "summary"]
+           "reset_stats", "summary", "publish_device_memory_gauges"]
 
 _TRACKING = False
 _LOCK = threading.Lock()
@@ -41,7 +43,13 @@ def _note_alloc(arr):
         _PEAK[key] = max(_PEAK.get(key, 0), live[1])
         _TOTAL[key] = _TOTAL.get(key, 0) + 1
         epoch = _EPOCH
+        live_bytes, peak_bytes = live[1], _PEAK[key]
     weakref.finalize(arr, _note_free, key, nbytes, epoch)
+    if _obs.enabled():
+        # tracked footprint as obs gauges: per-phase memory shows up in
+        # the aggregate table / Prometheus next to the span timings
+        _obs.gauge("mem.live_bytes.%s" % key, "bytes").set(live_bytes)
+        _obs.gauge("mem.peak_bytes.%s" % key, "bytes").set(peak_bytes)
 
 
 def _note_free(key, nbytes, epoch):
@@ -52,6 +60,11 @@ def _note_free(key, nbytes, epoch):
         if live:
             live[0] -= 1
             live[1] -= nbytes
+            live_bytes = live[1]
+        else:
+            return
+    if _obs.enabled():
+        _obs.gauge("mem.live_bytes.%s" % key, "bytes").set(live_bytes)
 
 
 def start_tracking():
@@ -98,3 +111,20 @@ def device_memory_stats(device=None):
             stats = None
         out[str(dev)] = stats or {}
     return out
+
+
+def publish_device_memory_gauges():
+    """Route the PJRT per-device byte counters into obs gauges
+    (``mem.device.<stat>.<device>``). One guarded branch with telemetry
+    off; refreshed by ``profiler.dump()`` and the cross-rank skew
+    exchange so long-run dashboards see live/peak HBM per device.
+    Returns the stats it published (empty when disabled)."""
+    if not _obs.enabled():
+        return {}
+    stats = device_memory_stats()
+    for dev, st in stats.items():
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in st:
+                _obs.gauge("mem.device.%s.%s" % (key, dev),
+                           "bytes").set(st[key])
+    return stats

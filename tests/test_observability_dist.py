@@ -1,0 +1,543 @@
+"""Distributed observability (ISSUE 3): cross-rank trace merging with
+clock-offset alignment, straggler detection thresholds, the collective
+hang watchdog's post-mortem, rank-suffixed dumps, and memory gauges —
+all with fake clocks / injected state (no real multi-host needed),
+plus a 2-process gloo end-to-end merge test marked ``slow``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.observability import core, dist, export, watchdog
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_on(monkeypatch):
+    monkeypatch.setenv("MXNET_OBS", "1")
+    core.set_enabled(None)
+    core.reset()
+    dist._reset_for_tests()
+    yield core
+    core.set_enabled(None)
+    core.reset()
+    dist._reset_for_tests()
+
+
+# ---------------------------------------------------- rank-local IO --
+
+def test_rank_trace_path_suffix():
+    assert dist.rank_trace_path("t/trace.json", rank=0) == "t/trace.json"
+    assert dist.rank_trace_path("t/trace.json", rank=2) == \
+        "t/trace.rank2.json"
+    # extensionless filenames still get a parseable suffix
+    assert dist.rank_trace_path("trace", rank=1) == "trace.rank1.json"
+
+
+def test_find_rank_traces_sorted(tmp_path):
+    base = str(tmp_path / "trace.json")
+    for p in ("trace.json", "trace.rank10.json", "trace.rank2.json"):
+        (tmp_path / p).write_text("{}")
+    found = dist.find_rank_traces(base)
+    assert [os.path.basename(p) for p in found] == \
+        ["trace.json", "trace.rank2.json", "trace.rank10.json"]
+
+
+def test_profiler_dump_rank_suffixed(obs_on, tmp_path, monkeypatch):
+    """N processes sharing one configured filename must not clobber:
+    a non-zero rank's dump lands on the rank-suffixed path."""
+    monkeypatch.setattr(dist, "process_index", lambda: 1)
+    with core.span("forward", cat="step"):
+        pass
+    fname = str(tmp_path / "trace.json")
+    mx.profiler.set_config(filename=fname, xla_trace=False)
+    try:
+        path = mx.profiler.dump()
+    finally:
+        mx.profiler.set_config(filename="profile.json", xla_trace=True)
+    assert path == str(tmp_path / "trace.rank1.json")
+    assert os.path.exists(path) and not os.path.exists(fname)
+    trace = json.load(open(path))
+    assert trace["otherData"]["rank"] == 1
+    # every event rides the rank lane
+    assert {e["pid"] for e in trace["traceEvents"]} == {1}
+
+
+# ------------------------------------------------ clock + merging ----
+
+def _write_trace(path, rank, anchor_mono_us, events):
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": {"rank": rank,
+                           "clock_anchor": {
+                               "rank": rank, "nprocs": 2,
+                               "mono_us": anchor_mono_us,
+                               "wall_us": 0, "barrier": True}}}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+def test_merge_traces_aligns_clock_offsets(tmp_path):
+    """Two ranks whose mono clocks differ by 4000 us: events recorded
+    500 us after each rank's barrier exit must land at the SAME merged
+    timestamp, one per pid lane."""
+    p0 = _write_trace(
+        str(tmp_path / "t.json"), 0, 1000,
+        [{"name": "step", "cat": "step", "ph": "X", "ts": 1500,
+          "dur": 100, "pid": 0, "tid": 1, "args": {}}])
+    p1 = _write_trace(
+        str(tmp_path / "t.rank1.json"), 1, 5000,
+        [{"name": "step", "cat": "step", "ph": "X", "ts": 5500,
+          "dur": 100, "pid": 1, "tid": 1, "args": {}}])
+    merged = dist.merge_traces([p0, p1],
+                               out=str(tmp_path / "merged.json"))
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    ts = {e["pid"]: e["ts"] for e in xs}
+    assert ts[0] == ts[1]                      # aligned instant
+    assert merged["otherData"]["clock_offsets_us"] == \
+        {"0": 0, "1": 4000}
+    assert merged["otherData"]["unaligned_ranks"] == []
+    # per-rank lane names present
+    names = [(e.get("pid"), e["args"]["name"])
+             for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert (0, "rank 0") in names and (1, "rank 1") in names
+    # the written file parses back to the same thing
+    on_disk = json.load(open(str(tmp_path / "merged.json")))
+    assert on_disk["otherData"]["merged_ranks"] == [0, 1]
+
+
+def test_merge_discovers_rank_siblings_and_rebases(tmp_path):
+    base = str(tmp_path / "t.json")
+    _write_trace(base, 0, 0,
+                 [{"name": "a", "cat": "c", "ph": "X", "ts": 700,
+                   "dur": 1, "tid": 1, "args": {}}])
+    _write_trace(str(tmp_path / "t.rank1.json"), 1, 300,
+                 [{"name": "b", "cat": "c", "ph": "X", "ts": 400,
+                   "dur": 1, "tid": 1, "args": {}}])
+    merged = dist.merge_traces(base)
+    xs = {e["name"]: e["ts"] for e in merged["traceEvents"]
+          if e["ph"] == "X"}
+    # rank1's event at 400 shifts by -300 to 100; rebase puts the
+    # earliest event at 0: rank1 -> 0, rank0's 700 -> 600
+    assert xs == {"a": 600, "b": 0}
+
+
+def test_merge_without_anchor_flags_unaligned(tmp_path):
+    p0 = str(tmp_path / "a.json")
+    with open(p0, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "x", "cat": "c", "ph": "X", "ts": 10, "dur": 1,
+             "tid": 1, "args": {}}], "otherData": {"rank": 0}}, f)
+    merged = dist.merge_traces([p0])
+    assert merged["otherData"]["unaligned_ranks"] == [0]
+
+
+def test_obs_merge_cli(tmp_path):
+    _write_trace(str(tmp_path / "t.json"), 0, 0,
+                 [{"name": "a", "cat": "c", "ph": "X", "ts": 5,
+                   "dur": 1, "tid": 1, "args": {}}])
+    _write_trace(str(tmp_path / "t.rank1.json"), 1, 0,
+                 [{"name": "b", "cat": "c", "ph": "X", "ts": 6,
+                   "dur": 1, "tid": 1, "args": {}}])
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_merge", os.path.join(ROOT, "tools", "obs_merge.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "merged.json")
+    assert mod.main([str(tmp_path / "t.json"), "-o", out]) == 0
+    merged = json.load(open(out))
+    assert merged["otherData"]["merged_ranks"] == [0, 1]
+
+
+def test_record_clock_anchor_runs_barrier_rounds(obs_on):
+    calls = []
+    dist.record_clock_anchor(barrier_fn=lambda: calls.append(1),
+                             rounds=4, rank=3, nprocs=8,
+                             _mono_us=123, _wall_us=456)
+    assert len(calls) == 4
+    a = dist.clock_anchor()
+    assert a == {"rank": 3, "nprocs": 8, "mono_us": 123, "wall_us": 456,
+                 "barrier": True}
+    # ensure_clock_anchor keeps the calibrated anchor
+    assert dist.ensure_clock_anchor() is a
+
+
+def test_chrome_trace_carries_rank_and_anchor(obs_on, monkeypatch):
+    monkeypatch.setattr(dist, "process_index", lambda: 2)
+    dist.record_clock_anchor(rank=2, nprocs=4, _mono_us=9, _wall_us=9)
+    with core.span("forward", cat="step"):
+        pass
+    tr = export.chrome_trace()
+    assert tr["otherData"]["rank"] == 2
+    assert tr["otherData"]["clock_anchor"]["mono_us"] == 9
+    assert all(e["pid"] == 2 for e in tr["traceEvents"])
+
+
+# ------------------------------------------- straggler detection ----
+
+def test_detect_stragglers_leave_one_out_median():
+    # 2 ranks, 5x apart: the plain median (3.0) would hide it; the
+    # leave-one-out baseline flags rank 1
+    s = dist.detect_stragglers({"forward": [1.0, 5.0]}, factor=2.0)
+    assert s["stragglers"] == [{"phase": "forward", "rank": 1,
+                                "ms": 5.0, "median_ms": 1.0,
+                                "ratio": 5.0}]
+    e = s["phases"]["forward"]
+    assert (e["min_rank"], e["max_rank"]) == (0, 1)
+
+
+def test_detect_stragglers_threshold_and_floor():
+    # below the factor: clean
+    s = dist.detect_stragglers({"f": [1.0, 1.0, 1.8]}, factor=2.0)
+    assert s["stragglers"] == []
+    # above the factor: flagged with the right rank
+    s = dist.detect_stragglers({"f": [1.0, 2.3, 1.0]}, factor=2.0)
+    assert [(x["phase"], x["rank"]) for x in s["stragglers"]] == \
+        [("f", 1)]
+    # sub-floor values never flag (host-scheduler noise)
+    s = dist.detect_stragglers({"f": [0.01, 0.2]}, factor=2.0)
+    assert s["stragglers"] == []
+    # single rank: nothing to compare
+    s = dist.detect_stragglers({"f": [9.0]}, factor=2.0)
+    assert s["stragglers"] == []
+
+
+def test_detect_stragglers_env_factor(monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_STRAGGLER_FACTOR", "4.0")
+    s = dist.detect_stragglers({"f": [1.0, 3.0]})
+    assert s["factor"] == 4.0 and s["stragglers"] == []
+    s = dist.detect_stragglers({"f": [1.0, 4.5]})
+    assert [x["rank"] for x in s["stragglers"]] == [1]
+
+
+def test_collect_phase_ms_window(obs_on):
+    t0 = core._EPOCH_NS
+    core.record_span("forward", "step", t0, t0 + 2_000_000)     # 2 ms
+    core.record_span("forward", "step", t0, t0 + 4_000_000)     # 4 ms
+    core.record_span("allreduce", "step", t0, t0 + 1_000_000)
+    core.record_span("not_a_phase", "x", t0, t0 + 9_000_000)
+    got = dist.collect_phase_ms()
+    assert got["forward"] == pytest.approx(3.0)
+    assert got["allreduce"] == pytest.approx(1.0)
+    assert got["backward"] == 0.0 and got["update"] == 0.0
+
+
+def test_exchange_phase_stats_warns_and_surfaces_in_table(obs_on):
+    """A fake 2-rank all-gather where rank 1 is 10x slower: the
+    exchange warns naming rank 1, and the skew table lands in
+    profiler.dumps(aggregate=True)."""
+    fake = lambda vec: np.stack([vec, vec * 10.0])
+    with pytest.warns(RuntimeWarning, match="straggler — rank 1"):
+        s = dist.exchange_phase_stats(
+            phase_ms={"forward": 3.0, "backward": 6.0,
+                      "allreduce": 2.0, "update": 1.0},
+            allgather=fake, rank=0)
+    assert {x["phase"] for x in s["stragglers"]} == \
+        {"forward", "backward", "allreduce", "update"}
+    assert dist.skew_summary() is s
+    # skew gauges published
+    assert core.counters()["skew.forward.max_over_median"].value == \
+        pytest.approx(10.0)
+    table = mx.profiler.dumps(aggregate=True)
+    assert "Cross-rank step-phase skew" in table
+    assert "STRAGGLER r1" in table
+
+
+def test_step_boundary_exchange_interval(obs_on, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_SKEW_EVERY", "2")
+    calls = []
+    monkeypatch.setattr(
+        dist, "_allgather_vec",
+        lambda vec: (calls.append(1), np.stack([vec, vec]))[1])
+
+    class FakeKV(object):
+        num_workers = 2
+    for _ in range(5):
+        dist.step_boundary(FakeKV())
+    assert len(calls) == 2                 # steps 2 and 4
+    # single-worker jobs never exchange
+    dist._reset_for_tests()
+    calls[:] = []
+
+    class SoloKV(object):
+        num_workers = 1
+    for _ in range(4):
+        dist.step_boundary(SoloKV())
+    assert calls == []
+
+
+# ------------------------------------------------------- watchdog ----
+
+def _fake_wd(clk, timeout=10, **kw):
+    reports = []
+    wd = watchdog.CollectiveWatchdog(
+        timeout=timeout, clock=lambda: clk[0], rank=0, nprocs=2,
+        thread=False, emit=reports.append, **kw)
+    return wd, reports
+
+
+def test_watchdog_fires_postmortem_after_timeout(obs_on):
+    clk = [0.0]
+    wd, reports = _fake_wd(clk)
+    with pytest.warns(RuntimeWarning, match="watchdog timeout"):
+        wd.arm("kvstore.pushpull_fused",
+               {"bucket": 0, "lane": "float32", "bytes": 4096,
+                "keys": 3})
+        clk[0] = 9.0
+        assert wd.check() == []            # before the deadline: quiet
+        clk[0] = 11.0
+        fired = wd.check()
+    assert len(fired) == 1
+    rep = fired[0]
+    assert "post-mortem" in rep
+    assert "collective kvstore.pushpull_fused" in rep
+    assert "bucket=0" in rep and "lane=float32" in rep
+    assert "rank 0/2" in rep and "timeout 10.0s" in rep
+    # ring + counter breadcrumbs for the trace/aggregate exporters
+    assert core.counters()["watchdog.postmortems"].total == 1
+    # each op fires once
+    assert wd.check(now=20.0) == []
+
+
+def test_watchdog_disarm_before_deadline_is_quiet(obs_on):
+    clk = [0.0]
+    wd, reports = _fake_wd(clk)
+    tok = wd.arm("kvstore.allreduce", {})
+    clk[0] = 5.0
+    wd.disarm(tok)
+    clk[0] = 50.0
+    assert wd.check() == [] and reports == []
+    assert wd.last_completed[0] == "kvstore.allreduce"
+
+
+def test_watchdog_postmortem_names_last_completed_span(obs_on):
+    clk = [0.0]
+    wd, _ = _fake_wd(clk)
+    tok = wd.arm("forward", {})
+    clk[0] = 1.0
+    wd.disarm(tok)
+    wd.arm("kvstore.allreduce", {"nprocs": 2})
+    clk[0] = 12.0
+    with pytest.warns(RuntimeWarning):
+        (rep,) = wd.check()
+    assert "local last completed span: forward" in rep
+    assert "finished 11.0s ago" in rep
+
+
+def test_watchdog_completion_after_postmortem_reported(obs_on):
+    clk = [0.0]
+    wd, reports = _fake_wd(clk)
+    tok = wd.arm("kvstore.allreduce", {})
+    clk[0] = 15.0
+    with pytest.warns(RuntimeWarning):
+        wd.check()
+    wd.disarm(tok)
+    assert any("completed after post-mortem" in r for r in reports)
+
+
+def test_watchdog_sideband_checkin_table(obs_on, tmp_path, monkeypatch):
+    """Rank 0 armed, rank 1 idle: the post-mortem says which ranks
+    checked in to the dispatch and what the absent rank last finished."""
+    monkeypatch.setenv("MXNET_OBS_WATCHDOG_DIR", str(tmp_path))
+    clk1 = [0.0]
+    wd1, _ = _fake_wd(clk1)
+    wd1._rank = 1
+    t = wd1.arm("forward", {})
+    clk1[0] = 1.0
+    wd1.disarm(t)                          # rank 1 idle, last=forward
+
+    clk0 = [0.0]
+    wd0, _ = _fake_wd(clk0)
+    wd0.arm("kvstore.pushpull_fused", {"bucket": 0})
+    clk0[0] = 30.0
+    with pytest.warns(RuntimeWarning):
+        (rep,) = wd0.check()
+    assert "rank 0: ARMED kvstore.pushpull_fused" in rep
+    assert "(this rank)" in rep
+    assert "rank 1: idle — last completed forward" in rep
+    assert "NOT checked in" in rep
+    # post-mortem also persisted for offline triage
+    assert (tmp_path / "postmortem.rank0.txt").exists()
+
+
+def test_watch_context_is_noop_when_off(monkeypatch):
+    monkeypatch.delenv("MXNET_OBS", raising=False)
+    monkeypatch.setenv("MXNET_OBS_COLLECTIVE_TIMEOUT", "5")
+    core.set_enabled(None)
+    assert not watchdog.enabled()          # telemetry off -> off
+    with watchdog.watch("kvstore.push", keys=1) as w:
+        assert w._token is None
+    monkeypatch.setenv("MXNET_OBS", "1")
+    monkeypatch.setenv("MXNET_OBS_COLLECTIVE_TIMEOUT", "0")
+    core.set_enabled(None)
+    assert not watchdog.enabled()          # no timeout -> off
+    core.set_enabled(None)
+
+
+def test_watch_arms_singleton_when_enabled(obs_on, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_COLLECTIVE_TIMEOUT", "30")
+    with watchdog.watch("kvstore.push", keys=2) as w:
+        assert w._token is not None
+        wd = watchdog.get_watchdog()
+        assert any(op["name"] == "kvstore.push"
+                   for op in wd._snapshot_active())
+    assert all(op["name"] != "kvstore.push"
+               for op in watchdog.get_watchdog()._snapshot_active())
+
+
+# ---------------------------------------------------- memory gauges --
+
+def test_allocation_tracker_feeds_mem_gauges(obs_on):
+    mx.storage.reset_stats()
+    mx.storage.start_tracking()
+    try:
+        arrs = [mx.nd.zeros((64, 64)) for _ in range(3)]
+        ctx = str(arrs[0]._ctx)
+        g = core.counters().get("mem.live_bytes.%s" % ctx)
+        assert g is not None
+        assert g.value >= 3 * 64 * 64 * 4
+        peak = core.counters()["mem.peak_bytes.%s" % ctx]
+        assert peak.value >= g.value
+    finally:
+        mx.storage.stop_tracking()
+        mx.storage.reset_stats()
+
+
+def test_device_memory_gauges_published(obs_on):
+    stats = mx.storage.publish_device_memory_gauges()
+    names = [k for k in core.counters() if k.startswith("mem.device.")]
+    # CPU PJRT may not report memory_stats; the call must still be a
+    # clean no-op in that case
+    has_stats = any(v for v in stats.values())
+    assert (len(names) > 0) == has_stats
+    # disabled -> no publish, no error
+    core.set_enabled(False)
+    assert mx.storage.publish_device_memory_gauges() == {}
+    core.set_enabled(None)
+
+
+# ----------------------------------------------- 2-process e2e (slow) --
+
+E2E_WORKER = r'''
+import os, sys, time
+sys.path.insert(0, %(root)r)
+OUT = %(out)r
+os.environ["MXNET_OBS"] = "1"
+os.environ["MXNET_OBS_SKEW_EVERY"] = "1"
+os.environ["MXNET_OBS_STRAGGLER_FACTOR"] = "1.5"
+os.environ["MXNET_OBS_COLLECTIVE_TIMEOUT"] = "2"
+os.environ["MXNET_OBS_WATCHDOG_DIR"] = OUT
+import warnings
+warnings.simplefilter("always")
+from mxnet_tpu import parallel
+parallel.init_distributed()
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+rank = jax.process_index()
+assert jax.process_count() == 2
+
+class DelayBlock(gluon.Block):
+    # sleep INSIDE the forward span on rank 1: its forward phase is
+    # genuinely slower, so the skew exchange names rank 1 (the rank
+    # blocked waiting in allreduce is the FAST one)
+    def __init__(self, delay, **kw):
+        super(DelayBlock, self).__init__(**kw)
+        self.delay = delay
+    def forward(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return x
+
+net = gluon.nn.Sequential()
+with net.name_scope():
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(DelayBlock(0.4 if rank == 1 else 0.0))
+    net.add(nn.Dense(4))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05},
+                        kvstore="dist_tpu_sync")
+loss_fn = gluon.loss.L2Loss()
+import numpy as np
+rng = np.random.RandomState(0)           # same data on every rank
+x = mx.nd.array(rng.uniform(size=(8, 10)).astype(np.float32))
+y = mx.nd.array(rng.uniform(size=(8, 4)).astype(np.float32))
+
+for step in range(3):
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(8)
+
+# one hang beyond the 2 s collective timeout: rank 1 arrives 3.5 s
+# late, rank 0's watchdog fires the post-mortem while it waits
+if rank == 1:
+    time.sleep(3.5)
+with autograd.record():
+    loss = loss_fn(net(x), y)
+loss.backward()
+trainer.step(8)
+
+mx.profiler.set_config(filename=os.path.join(OUT, "trace.json"),
+                       xla_trace=False)
+path = mx.profiler.dump()
+print("E2E-RANK-OK", rank, path)
+'''
+
+
+@pytest.mark.slow
+def test_two_process_merge_straggler_watchdog(tmp_path):
+    """The ISSUE 3 acceptance path: a 2-process gloo run with rank 1
+    delay-injected produces (a) one merged chrome trace with two rank
+    lanes on a common timebase, (b) a straggler warning naming rank 1,
+    and (c) a watchdog post-mortem when the delay exceeds the
+    collective timeout."""
+    outdir = str(tmp_path / "out")
+    os.makedirs(outdir)
+    script = tmp_path / "worker.py"
+    script.write_text(E2E_WORKER % {"root": ROOT, "out": outdir})
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    env.pop("MXNET_OBS_COLLECTIVE_TIMEOUT", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools/launch.py"), "-n",
+         "2", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-3000:])
+    assert r.stdout.count("E2E-RANK-OK") == 2
+
+    # (b) straggler warning naming the slow rank
+    assert "straggler — rank 1 forward" in r.stderr
+
+    # (c) watchdog post-mortem for the hung collective
+    assert "watchdog post-mortem" in r.stderr
+    assert "kvstore" in r.stderr
+    pm_files = [f for f in os.listdir(outdir)
+                if f.startswith("postmortem.rank")]
+    assert pm_files, "no persisted post-mortem in %s" % outdir
+
+    # (a) merged trace: two rank lanes, aligned timebase
+    merged = dist.merge_traces(os.path.join(outdir, "trace.json"))
+    lanes = {e["pid"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert lanes == {0, 1}
+    assert merged["otherData"]["unaligned_ranks"] == []
+    offs = merged["otherData"]["clock_offsets_us"]
+    assert set(offs) == {"0", "1"} and offs["0"] == 0
+    # both lanes carry the step phases
+    for pid in (0, 1):
+        names = {e["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "X" and e["pid"] == pid}
+        assert {"forward", "backward", "allreduce", "update"} <= names

@@ -1,0 +1,57 @@
+"""Merge rank-local observability traces into one chrome://tracing file.
+
+Multi-process runs of ``profiler.dump()`` write one trace per rank
+(rank 0 keeps the configured filename, rank r writes
+``<stem>.rank<r>.json``). This CLI combines them into a single trace
+with one lane per rank, shifting each rank's timestamps by its
+barrier-handshake clock-anchor offset so the lanes share a timebase
+(docs/OBSERVABILITY.md, "Distributed observability"):
+
+    python tools/obs_merge.py trace.json -o merged.json
+    python tools/obs_merge.py trace.json trace.rank1.json -o merged.json
+
+With one input argument, rank-suffixed siblings are discovered
+automatically. Load the output at chrome://tracing or ui.perfetto.dev.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("traces", nargs="+",
+                   help="rank-local trace file(s); a single argument "
+                        "also picks up its .rank<N> siblings")
+    p.add_argument("-o", "--out", default="merged_trace.json",
+                   help="merged output path (default merged_trace.json)")
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.observability import dist
+
+    inputs = args.traces[0] if len(args.traces) == 1 else args.traces
+    if isinstance(inputs, str):
+        found = dist.find_rank_traces(inputs)
+        if not found:
+            print("[obs_merge] no traces found for %r" % inputs)
+            return 1
+        print("[obs_merge] inputs: %s" % ", ".join(found))
+    merged = dist.merge_traces(inputs, out=args.out)
+    other = merged["otherData"]
+    print("[obs_merge] merged ranks %s -> %s (%d events)"
+          % (other["merged_ranks"], args.out,
+             len(merged["traceEvents"])))
+    print("[obs_merge] clock offsets (us): %s"
+          % other["clock_offsets_us"])
+    if other["unaligned_ranks"]:
+        print("[obs_merge] WARNING: no clock anchor for ranks %s — "
+              "their lanes are unshifted" % other["unaligned_ranks"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

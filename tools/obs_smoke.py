@@ -8,21 +8,31 @@ per-bucket collective counters, and prints the aggregate-stats table —
 the ISSUE 2 acceptance path, exercised as a console one-liner:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+``--nproc 2`` adds the distributed half (ISSUE 3): two gloo processes
+each train against a ``dist_tpu_sync`` kvstore (which takes the
+barrier-handshake clock anchor at creation), dump rank-local traces,
+and the parent merges them with ``observability.merge_traces`` and
+validates that the merged chrome trace carries BOTH rank lanes:
+
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --nproc 2
 """
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import tempfile
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), ".."))
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
 
 os.environ.setdefault("MXNET_OBS", "1")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def main():
+def _train_steps(kvstore, steps=2):
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon import nn
@@ -33,16 +43,20 @@ def main():
         net.add(nn.Dense(4))
     net.initialize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1})
+                            {"learning_rate": 0.1}, kvstore=kvstore)
     loss_fn = gluon.loss.L2Loss()
     x = mx.nd.random.uniform(shape=(8, 10))
     y = mx.nd.random.uniform(shape=(8, 4))
-    for _ in range(2):
+    for _ in range(steps):
         with autograd.record():
             loss = loss_fn(net(x), y)
         loss.backward()
         trainer.step(8)
+    return mx
 
+
+def single_process():
+    mx = _train_steps(kvstore="device")
     fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_"),
                          "trace.json")
     mx.profiler.set_config(filename=fname, xla_trace=False)
@@ -61,6 +75,82 @@ def main():
           % (len(trace["traceEvents"]), len(names), path))
     print(mx.profiler.dumps(aggregate=True))
     return 0
+
+
+def worker():
+    """One rank of the --nproc job (re-entered via tools/launch.py)."""
+    from mxnet_tpu import parallel
+    parallel.init_distributed()
+    import jax
+    mx = _train_steps(kvstore="dist_tpu_sync")
+    out = os.path.join(os.environ["OBS_SMOKE_DIR"], "trace.json")
+    mx.profiler.set_config(filename=out, xla_trace=False)
+    path = mx.profiler.dump()
+    print("OBS-SMOKE-RANK-OK", jax.process_index(), path)
+    return 0
+
+
+def orchestrate(nproc):
+    """Launch the gloo workers, then merge + validate the rank lanes."""
+    outdir = tempfile.mkdtemp(prefix="obs_smoke_mp_")
+    env = dict(os.environ)
+    env.update({"OBS_SMOKE_WORKER": "1", "OBS_SMOKE_DIR": outdir,
+                "MXNET_OBS": "1", "MXNET_OBS_SKEW_EVERY": "1",
+                "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(nproc), "--launcher", "local",
+         sys.executable, os.path.abspath(__file__)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    if r.returncode != 0:
+        print("[obs_smoke] FAIL: worker launch rc=%d" % r.returncode)
+        return 1
+    if r.stdout.count("OBS-SMOKE-RANK-OK") != nproc:
+        print("[obs_smoke] FAIL: expected %d rank markers" % nproc)
+        return 1
+
+    from mxnet_tpu.observability import dist
+    base = os.path.join(outdir, "trace.json")
+    inputs = dist.find_rank_traces(base)
+    if len(inputs) != nproc:
+        print("[obs_smoke] FAIL: expected %d rank-local traces, found "
+              "%s" % (nproc, inputs))
+        return 1
+    merged = dist.merge_traces(base, out=os.path.join(outdir,
+                                                      "merged.json"))
+    lanes = {e.get("pid") for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    if lanes != set(range(nproc)):
+        print("[obs_smoke] FAIL: merged trace lanes %s != ranks 0..%d"
+              % (sorted(lanes), nproc - 1))
+        return 1
+    unaligned = merged["otherData"]["unaligned_ranks"]
+    if unaligned:
+        print("[obs_smoke] FAIL: ranks %s merged without a clock "
+              "anchor" % unaligned)
+        return 1
+    print("[obs_smoke] merged trace OK: %d ranks, %d events, clock "
+          "offsets %s -> %s"
+          % (nproc, len(merged["traceEvents"]),
+             merged["otherData"]["clock_offsets_us"],
+             os.path.join(outdir, "merged.json")))
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--nproc", type=int, default=1,
+                   help="launch N gloo processes and validate the "
+                        "merged per-rank trace (default: single "
+                        "process)")
+    args = p.parse_args()
+    if os.environ.get("OBS_SMOKE_WORKER"):
+        return worker()
+    if args.nproc > 1:
+        return orchestrate(args.nproc)
+    return single_process()
 
 
 if __name__ == "__main__":
