@@ -266,6 +266,10 @@ def main():
     p.add_argument("--obs", action="store_true",
                    help="run with MXNET_OBS=1 and print the aggregate-"
                         "stats phase table after the legs")
+    p.add_argument("--obs-ops", action="store_true",
+                   help="also print the per-operator attribution table "
+                        "(per-scope flops/bytes of the registered "
+                        "bucketed-reduce programs)")
     p.add_argument("--inject-straggler", metavar="RANK:MS", default=None,
                    help="demo the cross-rank straggler detector: build "
                         "a per-rank phase table from the measured "
@@ -273,7 +277,7 @@ def main():
                         "MS ms, and print the skew table + warning "
                         "(docs/OBSERVABILITY.md)")
     args = p.parse_args()
-    if args.obs:
+    if args.obs or args.obs_ops:
         os.environ["MXNET_OBS"] = "1"
     _pre_jax_setup(args.devices)
 
@@ -289,6 +293,8 @@ def main():
                            args.shard_update)
     if args.inject_straggler:
         straggler_demo(args.inject_straggler, n, rows)
+    # --obs-ops enables MXNET_OBS, and the aggregate table appends the
+    # per-operator attribution section itself — one print covers both
     from benchmark.common import print_obs_table
     print_obs_table()
 

@@ -72,7 +72,8 @@ def step(a, mom, ax):
 mom = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), args)
 jitted = jax.jit(step, donate_argnums=(0,1,2))
 c = jitted.lower(args,mom,aux).compile()
-ca = c.cost_analysis(); ca = ca[0] if isinstance(ca,(list,tuple)) else ca
+from mxnet_tpu.observability.hlo import compiled_cost
+ca = compiled_cost(c)
 print("cost: %.2f TFLOP  %.1f GB" % (ca.get('flops',0)/1e12, ca.get('bytes accessed',0)/1e9))
 if "cost-only" in sys.argv:
     sys.exit(0)

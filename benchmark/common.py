@@ -28,3 +28,49 @@ def print_obs_table():
         return
     print()
     print(export.aggregate_table())
+
+
+def print_ops_table(compiled=None):
+    """--obs-ops: print the per-scope top-K attribution table
+    (docs/OBSERVABILITY.md "Per-operator attribution").
+
+    With ``compiled`` (a jax compiled executable, e.g. the leg a bench
+    just lowered) the table comes from that program's optimized HLO
+    directly; without it, from whatever jit boundaries the attribution
+    layer registered during the run (CachedOp/Executor/KVStore).
+    Heuristic op_name attribution applies when no Gluon scopes were
+    stamped — hand-built jax legs still get a source-structure split.
+    """
+    from mxnet_tpu.observability import attribution, core, hlo
+    if not core.enabled() or not attribution.ops_enabled():
+        return
+    if compiled is None:
+        lines = attribution.format_ops_table()
+    else:
+        rows = hlo.attribute_rows(hlo.parse_hlo(compiled.as_text()),
+                                  attribution.known_scopes() or None)
+        scopes, totals = hlo.group_by_scope(rows)
+        peak, _peak_scopes = hlo.peak_watermark(rows)
+        totals["peak_bytes"] = peak
+        totals["programs"] = 1
+        lines = attribution.format_ops_table(
+            {"totals": totals, "scopes": scopes})
+    if lines:
+        print("\n".join(lines))
+    else:
+        print("[obs-ops] no compiled program registered (nothing "
+              "crossed an instrumented jit boundary)")
+
+
+def obs_ops_requested(argv=None):
+    """Shared --obs-ops detection for the stdin-run benches (their
+    argv is free-form words, not argparse): present -> turn telemetry
+    on NOW so the programs traced later carry named scopes."""
+    import os
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    if not any(a in ("--obs-ops", "obs-ops") for a in argv):
+        return False
+    os.environ.setdefault("MXNET_OBS", "1")
+    os.environ.setdefault("MXNET_OBS_OPS", "1")
+    return True

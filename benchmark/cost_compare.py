@@ -163,8 +163,8 @@ def hb_build(batch, size):
 
 
 def report(tag, compiled):
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    from mxnet_tpu.observability.hlo import compiled_cost
+    ca = compiled_cost(compiled)
     flops = ca.get("flops", 0.0)
     gb = ca.get("bytes accessed", 0.0) / 1e9
     print("%-10s  %.2f TFLOP  %.1f GB/step  (%.1f FLOP/byte)"
@@ -183,12 +183,16 @@ def main():
     y = jnp.asarray(rng.randint(0, 1000, (BATCH,)), jnp.int32)
     which = [a for a in sys.argv[1:] if a in ("framework", "handbuilt")]
     timed = "timed" in sys.argv
+    from benchmark.common import obs_ops_requested, print_ops_table
+    obs_ops = obs_ops_requested()
 
     if not which or "framework" in which:
         import bench
         step, args, mom, aux = bench.build_train_step(BATCH, SIZE)
         c = step.lower(args, mom, aux, x, y).compile()
         report("framework", c)
+        if obs_ops:
+            print_ops_table(c)
         if timed:
             args, mom, aux, loss = c(args, mom, aux, x, y)
             float(loss)
@@ -202,6 +206,8 @@ def main():
         step, params, mom = hb_build(BATCH, SIZE)
         c = step.lower(params, mom, x, y).compile()
         report("handbuilt", c)
+        if obs_ops:
+            print_ops_table(c)
         if timed:
             params, mom, loss = c(params, mom, x, y)
             float(loss)

@@ -5,14 +5,17 @@ cost_compare's timed chip A/B (BENCH_TABLE cost_compare_timed) shows
 the shipped framework ResNet-50 step moving ~10 GB/step more than the
 hand-built jax step at the same shapes — bytes, not flops. XLA's
 cost_analysis() only gives totals, so this script compiles BOTH steps
-for the attached backend, parses the optimized HLO text, and estimates
-per-instruction HBM traffic as (output bytes + sum of operand output
-bytes). That is the same accounting "bytes accessed" uses, minus
-fusion-internal elision — good enough to rank instructions and diff
-programs. Each row carries the op_name metadata XLA preserves from
-jaxpr, which names the originating layer/transform (e.g.
-"transpose(jvp(...))/conv..." or a custom-vjp residual), so the gap
-maps back to source structure.
+for the attached backend and breaks the optimized HLO down per
+instruction and per source scope.
+
+This is now a THIN WRAPPER over ``mxnet_tpu.observability.hlo`` — the
+parser/accounting that used to live here was promoted into the
+observability attribution layer (ISSUE 4), so this script, the
+per-operator attribution tables (``tools/obs_ops.py``) and the
+perf-regression sentinel all read the same numbers and cannot drift.
+The accounting model (HBM bytes = output + operand outputs at fusion
+boundaries; shape-derived flops) is documented in that module's
+docstring.
 
     python - < benchmark/hlo_diff.py                 # both legs, diff
     python - framework < benchmark/hlo_diff.py
@@ -22,7 +25,6 @@ Run from /root/repo via stdin so the repo root stays on sys.path.
 """
 
 import os
-import re
 import sys
 from collections import defaultdict
 
@@ -32,81 +34,18 @@ BATCH = int(os.environ.get("MXNET_COST_BATCH", "128"))
 SIZE = int(os.environ.get("MXNET_COST_SIZE", "224"))
 TOP = int(os.environ.get("MXNET_HLO_TOP", "25"))
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-    "f64": 8, "c64": 8, "c128": 16, "token": 0, "opaque": 0,
-}
-
-_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?(%?[\w.-]+) = (\([^)]*\)|\S+) ([\w-]+)\((.*)$")
-_METADATA_RE = re.compile(r'op_name="([^"]*)"')
-
-
-def shape_bytes(spec):
-    """Total bytes of an HLO shape spec (handles tuples)."""
-    total = 0
-    for dtype, dims in _SHAPE_RE.findall(spec):
-        if dtype not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
-
-
-def parse_hlo(text):
-    """-> list of dict(name, opcode, out_bytes, operands, op_name)."""
-    rows = []
-    sizes = {}
-    for line in text.splitlines():
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        name, shape, opcode, rest = m.groups()
-        name = name.lstrip("%")
-        out = shape_bytes(shape)
-        sizes[name] = out
-        ops = []
-        # operand list: %name or name refs before any ), attrs follow
-        depth = 1
-        arglist = []
-        for ch in rest:
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    break
-            arglist.append(ch)
-        for ref in re.findall(r"%?([\w.-]+)", "".join(arglist)):
-            if ref in sizes:
-                ops.append(ref)
-        meta = _METADATA_RE.search(rest)
-        rows.append({
-            "name": name, "opcode": opcode, "out": out,
-            "operands": ops,
-            "op_name": meta.group(1) if meta else "",
-        })
-    by_name = {r["name"]: r for r in rows}
-    for r in rows:
-        r["accessed"] = r["out"] + sum(
-            by_name[o]["out"] for o in r["operands"] if o in by_name)
-    return rows
-
-
-_SKIP = ("parameter", "constant", "tuple", "get-tuple-element",
-         "bitcast")
-
 
 def summarize(tag, rows):
+    """Per-opcode byte totals + the top individual instructions + a
+    per-scope rollup (scope names from the op_name metadata XLA
+    preserves; the framework leg gets block names when MXNET_OBS was
+    on at trace time, both legs get the heuristic path split)."""
+    from mxnet_tpu.observability import hlo
+
     agg = defaultdict(lambda: [0, 0])
     total = 0
     for r in rows:
-        if r["opcode"] in _SKIP:
+        if r["opcode"] in hlo.SKIP_OPCODES:
             continue
         agg[r["opcode"]][0] += r["accessed"]
         agg[r["opcode"]][1] += 1
@@ -117,11 +56,17 @@ def summarize(tag, rows):
             continue
         print("  %-24s %8.2f GB  x%d" % (op, b / 1e9, n))
     print("  -- top instructions --")
-    top = sorted((r for r in rows if r["opcode"] not in _SKIP),
+    top = sorted((r for r in rows if r["opcode"] not in hlo.SKIP_OPCODES),
                  key=lambda r: -r["accessed"])[:TOP]
     for r in top:
         print("  %7.1f MB  %-12s %s" % (
             r["accessed"] / 1e6, r["opcode"], r["op_name"][-90:]))
+    scopes, totals = hlo.group_by_scope(rows)
+    print("  -- per-scope (top 10 by bytes) --")
+    for scope, ent in sorted(scopes.items(),
+                             key=lambda kv: -kv[1]["hbm_bytes"])[:10]:
+        print("  %7.1f MB  %8.2f GFLOP  %s" % (
+            ent["hbm_bytes"] / 1e6, ent["flops"] / 1e9, scope[-70:]))
     return agg, total
 
 
@@ -131,6 +76,7 @@ def main():
     import importlib.util
     import jax
     import jax.numpy as jnp
+    from mxnet_tpu.observability import hlo
 
     spec = importlib.util.spec_from_file_location(
         "cost_compare", os.path.join("benchmark", "cost_compare.py"))
@@ -148,12 +94,12 @@ def main():
         step, args, mom, aux = bench.build_train_step(BATCH, SIZE)
         c = step.lower(args, mom, aux, x, y).compile()
         results["framework"] = summarize(
-            "framework", parse_hlo(c.as_text()))
+            "framework", hlo.parse_hlo(c.as_text()))
     if not which or "handbuilt" in which:
         step, params, mom = cc.hb_build(BATCH, SIZE)
         c = step.lower(params, mom, x, y).compile()
         results["handbuilt"] = summarize(
-            "handbuilt", parse_hlo(c.as_text()))
+            "handbuilt", hlo.parse_hlo(c.as_text()))
 
     if len(results) == 2:
         fa, ft = results["framework"]

@@ -44,6 +44,10 @@ def _env_int(name, default):
 def main():
     from mxnet_tpu._discover import pin_platform_from_env
     pin_platform_from_env()
+    # --obs-ops (docs/OBSERVABILITY.md): sets MXNET_OBS before anything
+    # traces, so the step program lands in the attribution registry
+    from benchmark.common import obs_ops_requested, print_ops_table
+    obs_ops = obs_ops_requested()
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.models import transformer as tf
@@ -78,6 +82,15 @@ def main():
                    for p in jax.tree.leaves(params))
     step = tf.make_train_step(cfg)
     mom = tf.init_momentum(params)
+    if obs_ops:
+        # the LM step is a raw jitted fn (no CachedOp/Executor in the
+        # path) — register it by hand so --obs-ops can break it down
+        from mxnet_tpu.observability import attribution, recompile
+        attribution.register_program(
+            "train_lm.step",
+            recompile.signature_of(jax.tree.leaves((params, mom))),
+            step, (params, mom,
+                   jnp.zeros((batch, seq), jnp.int32)))
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(1, 32000, (batch, seq)), jnp.int32)
     tokens_per_step = batch * seq
@@ -90,11 +103,11 @@ def main():
         # roofline attribution from the compiler's own cost model
         lowered = jax.jit(lambda p, m, t: step(p, m, t)).lower(
             params, mom, tokens)
-        ca = lowered.compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else None
-        xla_flops = float((ca or {}).get("flops", 0.0))
-        bytes_acc = float((ca or {}).get("bytes accessed", 0.0))
+        from mxnet_tpu.observability.hlo import compiled_cost
+        compiled = lowered.compile()
+        ca = compiled_cost(compiled)
+        xla_flops = float(ca.get("flops", 0.0))
+        bytes_acc = float(ca.get("bytes accessed", 0.0))
         if not xla_flops and not bytes_acc:
             print(json.dumps({"metric": "lm_train_cost_model",
                               "error": "cost analysis unavailable on "
@@ -120,6 +133,8 @@ def main():
             "roofline_mfu": round(min(pred, 1.0), 4),
             "assumed_hbm_gbs": hbm_bw / 1e9,
         }))
+        if obs_ops:
+            print_ops_table(compiled)
         return
 
     params, mom, loss = step(params, mom, tokens)    # compile + warm
@@ -146,6 +161,8 @@ def main():
         "mfu_peak_flops": PEAK_FLOPS,
         "loss_finite": bool(np.isfinite(loss)),
     }))
+    # the aggregate table below already appends the per-operator
+    # attribution section when --obs-ops registered the step program
     from benchmark.common import print_obs_table
     print_obs_table()
 

@@ -48,6 +48,28 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         exit 1
     fi
 
+    echo "==== [tier1] per-operator attribution smoke (block scopes in trace) ===="
+    # the two-block conv+dense workload must emit ops.* per-scope
+    # gauges naming both blocks, with >=90% of the compiled step's
+    # flops and HBM bytes attributed (docs/OBSERVABILITY.md
+    # "Per-operator attribution")
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --ops; then
+        echo "[tier1] FAIL: per-operator attribution smoke"
+        exit 1
+    fi
+
+    echo "==== [tier1] perf-regression sentinel (obs_regression vs committed baseline) ===="
+    # same workload, diffed against ci/obs_baseline.json with
+    # per-metric tolerances; a PR that grows the bytes a block moves
+    # past tolerance fails HERE with the scope named, not weeks later
+    # as a slow BENCH row. Intentional change? re-commit the baseline:
+    #   python tools/obs_regression.py --baseline ci/obs_baseline.json --update
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_regression.py \
+            --baseline ci/obs_baseline.json; then
+        echo "[tier1] FAIL: perf-regression sentinel"
+        exit 1
+    fi
+
     echo "==== [tier1] distributed observability smoke (2-process gloo merge) ===="
     # two gloo workers train against dist_tpu_sync (clock-anchor
     # handshake at kvstore creation), dump rank-local traces, and the

@@ -22,6 +22,7 @@ from . import engine as _engine
 from . import random as _random
 from .base import MXNetError
 from .executor import apply_mirror, build_graph_fn, mirror_enabled
+from .observability import attribution as _obs_attr
 from .observability import core as _obs
 from .observability import recompile as _obs_recompile
 
@@ -151,13 +152,14 @@ class CachedOp:
             n for n in self._arg_names
             if recording and by_name[n]._requires_tape())
 
+        sig = None
         if _obs.enabled():
             # jit-boundary breadcrumb: if XLA re-traces inside the call
             # below, the detector attributes it to this signature
+            sig = _obs_recompile.signature_of(
+                inputs, train=is_train, diff=len(diff_names))
             _obs_recompile.note_call(
-                "CachedOp[%s]" % self._obs_name(),
-                _obs_recompile.signature_of(
-                    inputs, train=is_train, diff=len(diff_names)))
+                "CachedOp[%s]" % self._obs_name(), sig)
 
         ctx = inputs[0]._ctx if inputs else None
 
@@ -179,6 +181,23 @@ class CachedOp:
                 # the resulting flock of in-flight collective launches
                 # deadlocks (engine.py). Executor.bwd_fn does the same.
                 aux_ct = jax.tree.map(jnp.zeros_like, aux_up)
+                origin = "CachedOp[%s].step" % self._obs_name()
+                if sig is not None and _obs_attr.ops_enabled() \
+                        and _obs_attr.needs_program(origin, sig):
+                    # per-operator attribution: register a combined
+                    # fwd+vjp analysis program. The runtime executes
+                    # fn and _apply_vjp as two programs, but replaying
+                    # the stored vjp closure in a separate jit drops
+                    # the op_name name-stack metadata — re-deriving the
+                    # vjp inside ONE traced program keeps every
+                    # backward instruction attributed to its block.
+                    def _step(diff, rest, aux_a, key, ct):
+                        _o, v = fn(diff, rest, aux_a, key)
+                        return _o, _apply_vjp(v, ct)
+                    _obs_attr.register_program(
+                        origin, sig, jax.jit(_step),
+                        (diff_list, args, aux, rng_key,
+                         (cts_t, aux_ct)))
                 grads = _apply_vjp(vjp_fn, (cts_t, aux_ct))
                 return grads
 
@@ -194,6 +213,10 @@ class CachedOp:
                 results.append(r)
         else:
             fn = self._get_fn(is_train, ())
+            if sig is not None and _obs_attr.ops_enabled():
+                _obs_attr.register_program(
+                    "CachedOp[%s].fwd" % self._obs_name(), sig, fn,
+                    (args, aux, rng_key))
             outs, aux_up = fn(args, aux, rng_key)
             results = [nd.NDArray(o, ctx) for o in outs]
 

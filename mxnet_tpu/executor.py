@@ -20,6 +20,7 @@ from . import ops
 from . import engine as _engine
 from . import inspector as _inspector
 from .base import MXNetError
+from .observability import attribution as _obs_attr
 from .observability import core as _obs
 from .observability import recompile as _obs_recompile
 from .symbol import OP_AUX
@@ -131,6 +132,12 @@ def build_graph_fn(symbol, is_train, node_device=None):
         return arr if dev is None else jax.device_put(arr, dev)
 
     def graph_fn(arg_arrays, aux_arrays, rng_key):
+        # per-operator attribution (observability/attribution.py): when
+        # telemetry is on at TRACE time, every node's primitives are
+        # emitted under jax.named_scope(node.name) so the optimized
+        # HLO's op_name metadata names the originating block/op even
+        # after fusion. One guarded branch per trace when off.
+        use_scopes = _obs_attr.ops_enabled()
         vals = {}
         aux_updates = {}
         key = rng_key
@@ -157,16 +164,26 @@ def build_graph_fn(symbol, is_train, node_device=None):
                 src = s._nodes[s._outputs[0][0]]
                 ins.append(_place(node, vals[(id(src), oi)]))
             in_names = node.attrs.get("__input_names__")
-            if has_varargs:
-                out = op.fn(*ins, **attrs)
-            else:
+
+            def _eval_node(op=op, attrs=attrs, ins=ins,
+                           has_varargs=has_varargs,
+                           param_names=param_names, in_names=in_names):
+                if has_varargs:
+                    return op.fn(*ins, **attrs)
                 call = dict(attrs)
                 if in_names:
                     call.update({n: a for n, a in zip(in_names, ins)})
                 else:
                     pnames = [p for p in param_names if p not in attrs]
                     call.update({n: a for n, a in zip(pnames, ins)})
-                out = op.fn(**call)
+                return op.fn(**call)
+
+            if use_scopes:
+                _obs_attr.note_scope(node.name)
+                with jax.named_scope(node.name):
+                    out = _eval_node()
+            else:
+                out = _eval_node()
 
             if _inspector.nan_guard_enabled():
                 # MXNET_NAN_GUARD: host-side finite-ness check on every
@@ -310,6 +327,7 @@ class Executor:
             (grads,) = vjp(heads)
             return grads
 
+        self._jitted = node_device is None
         if node_device is None:
             # single-placement graphs compile whole-program; placed
             # (group2ctx) graphs run op-by-op so each segment can live on
@@ -320,6 +338,7 @@ class Executor:
         self._infer_fn = infer_fn
         self._fwd_res_fn = fwd_res_fn
         self._bwd_fn = bwd_fn
+        self._obs_sig = None
 
     # ------------------------------------------------------------ run ---
     def forward(self, is_train=False, **kwargs):
@@ -345,16 +364,23 @@ class Executor:
             if self._zero_key is None:
                 self._zero_key = jax.random.PRNGKey(0)
             key = self._zero_key
+        sig = None
         if _obs.enabled():
+            sig = _obs_recompile.signature_of(
+                arg_arrays.values(), train=is_train)
             _obs_recompile.note_call(
-                "Executor[%s]" % self._symbol.list_outputs()[0],
-                _obs_recompile.signature_of(
-                    arg_arrays.values(), train=is_train))
+                "Executor[%s]" % self._symbol.list_outputs()[0], sig)
+            self._obs_sig = sig
         fwd_span = _obs.span("forward", cat="step", executor=True,
                              train=is_train).start()
         if is_train:
             diff = [arg_arrays[n] for n in self._diff_args]
             rest = {k: v for k, v in arg_arrays.items()}
+            if sig is not None and self._jitted \
+                    and _obs_attr.ops_enabled():
+                _obs_attr.register_program(
+                    "Executor[%s].fwd" % self._symbol.list_outputs()[0],
+                    sig, self._fwd_res_fn, (diff, rest, aux_arrays, key))
             outs, aux_up, vjp = self._fwd_res_fn(diff, rest, aux_arrays,
                                                  key)
             self._saved_vjp = (vjp, outs)
@@ -362,6 +388,12 @@ class Executor:
                 self.aux_dict[name]._data = val
         else:
             self._saved_vjp = None
+            if sig is not None and self._jitted \
+                    and _obs_attr.ops_enabled():
+                _obs_attr.register_program(
+                    "Executor[%s].infer"
+                    % self._symbol.list_outputs()[0],
+                    sig, self._infer_fn, (arg_arrays, aux_arrays, key))
             outs = self._infer_fn(arg_arrays, aux_arrays, key)
         _engine.sync_if_needed(outs)
         fwd_span.stop()
@@ -384,6 +416,11 @@ class Executor:
                      for g in out_grads]
         cotangent = type(outs)(heads) if isinstance(outs, (tuple, list)) \
             else heads[0]
+        if self._obs_sig is not None and self._jitted \
+                and _obs_attr.ops_enabled():
+            _obs_attr.register_program(
+                "Executor[%s].bwd" % self._symbol.list_outputs()[0],
+                self._obs_sig, self._bwd_fn, (vjp, cotangent))
         grads = self._bwd_fn(vjp, cotangent)
         _engine.sync_if_needed(grads)
         for name, g in zip(self._diff_args, grads):

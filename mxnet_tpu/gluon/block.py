@@ -20,6 +20,7 @@ from .. import name as _name
 from .. import ndarray as nd
 from .. import symbol as _symbol
 from ..base import MXNetError
+from ..observability import attribution as _obs_attr
 from ..observability import core as _obs
 from ..cached_op import CachedOp
 from ..context import current_context
@@ -361,7 +362,17 @@ class Block(object):
         try:
             for hook in self._forward_pre_hooks:
                 hook(self, args)
-            out = self.forward(*args)
+            if self._name and _obs_attr.ops_enabled():
+                # per-operator attribution: any jax trace happening
+                # inside forward (a hybridized child compiling, an
+                # eager op jitting) carries this block's name as an
+                # op_name scope component. One guarded branch when off.
+                import jax
+                _obs_attr.note_scope(self._name)
+                with jax.named_scope(self._name):
+                    out = self.forward(*args)
+            else:
+                out = self.forward(*args)
         finally:
             _CALL_DEPTH.v = depth
             if fwd_span is not None:

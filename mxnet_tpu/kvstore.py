@@ -570,6 +570,18 @@ class KVStoreTPUSync(KVStore):
             (n,) + shape, NamedSharding(mesh, P("worker")), shards)
         reduce_fn = _allreduce_jit(devs, (n,) + shape,
                                    str(datas[0].dtype))
+        if _obs.enabled():
+            # per-operator attribution: the bucketed-reduce program is
+            # a jit boundary like CachedOp/Executor — register it so
+            # --obs-ops / tools/obs_ops.py break its HBM traffic down
+            # next to the model step's (one dict probe when warm)
+            from .observability import attribution as _obs_attr
+            if _obs_attr.ops_enabled():
+                _obs_attr.register_program(
+                    "KVStore.allreduce",
+                    "%s[%s]x%d" % (datas[0].dtype, ",".join(
+                        str(d) for d in shape), n),
+                    reduce_fn, (global_arr,))
         return reduce_fn(global_arr)
 
     def _cross_process_allreduce(self, datas):

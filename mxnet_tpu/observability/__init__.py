@@ -32,8 +32,13 @@ instead of hanging silently.
 from . import core
 from . import dist
 from . import export
+from . import hlo
+from . import attribution
 from . import recompile
 from . import watchdog
+from .attribution import (ops_enabled, format_ops_table,
+                          compare_summaries)
+from .attribution import summary as ops_summary
 from .core import (enabled, set_enabled, span, counter, gauge,
                    record_span, record_instant, records, counters,
                    dropped, reset)
@@ -44,7 +49,9 @@ from .export import (chrome_trace, dump_chrome_trace, aggregate,
 from .recompile import get_detector, note_call, record_retrace
 from .watchdog import get_watchdog
 
-__all__ = ["core", "dist", "export", "recompile", "watchdog", "enabled",
+__all__ = ["core", "dist", "export", "hlo", "attribution", "recompile",
+           "watchdog", "ops_enabled", "format_ops_table",
+           "compare_summaries", "ops_summary", "enabled",
            "set_enabled", "span", "counter", "gauge", "record_span",
            "record_instant", "records", "counters", "dropped", "reset",
            "chrome_trace", "dump_chrome_trace", "aggregate",

@@ -139,6 +139,8 @@ def aggregate_table():
                              "%g" % s["value"]))
     from . import dist
     lines.extend(dist.format_skew_table())
+    from . import attribution
+    lines.extend(attribution.format_ops_table())
     if core.dropped():
         lines.append("")
         lines.append("(%d oldest records dropped from the ring; "

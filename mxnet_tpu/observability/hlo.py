@@ -1,0 +1,415 @@
+"""Optimized-HLO parsing and cost attribution primitives.
+
+Promoted out of ``benchmark/hlo_diff.py`` (which is now a thin wrapper
+over this module) so per-instruction cost accounting has exactly ONE
+implementation: the observability attribution layer, the benchmarks and
+the regression sentinel all read the same numbers.
+
+What lives here:
+
+* ``parse_hlo(text)`` — the optimized-HLO text of a compiled executable
+  (``compiled.as_text()``) as a list of per-instruction rows carrying
+  output bytes, estimated HBM bytes accessed, estimated flops, the
+  ``op_name`` metadata XLA preserved from the jaxpr, and the owning
+  computation (entry vs fused).
+* ``scope_of(op_name, known)`` — map an instruction's ``op_name`` path
+  back to the originating named scope (the Gluon block prefix / symbol
+  node name that ``jax.named_scope`` stamped at trace time), unwrapping
+  the transform decorations jax adds (``jvp(...)``,
+  ``transpose(jvp(...))``, ``remat(...)``, ...).
+* ``group_by_scope(rows, known)`` — per-scope flops / HBM bytes /
+  output bytes / instruction counts, plus totals.
+* ``peak_watermark(rows)`` — a def-to-last-use liveness sweep over the
+  entry computation: the peak live-byte watermark and, at the peak
+  instant, the live bytes attributed per scope.
+* ``normalize_cost_analysis(ca)`` / ``compiled_cost(compiled)`` — the
+  ``ca[0] if isinstance(ca, (list, tuple))`` dance that was copy-pasted
+  across three benchmarks, in one place.
+
+Accounting model (same as hlo_diff always used): HBM bytes accessed of
+a top-level (entry) instruction = its output bytes + the output bytes
+of its operands — "bytes accessed" minus fusion-internal elision, which
+is exactly what fusion boundaries make true on the device. Instructions
+inside fused computations therefore contribute flops but no HBM bytes;
+the enclosing fusion instruction carries the traffic. Flops are
+shape-derived estimates (2*M*N*K matmuls, 2*out*kernel convs, one per
+output element for elementwise/reduce lanes) — deterministic, platform
+independent, and precise enough to rank scopes and to diff runs; use
+``compiled_cost`` when you want XLA's own totals next to them.
+"""
+
+import re
+from collections import defaultdict
+
+__all__ = ["DTYPE_BYTES", "shape_bytes", "parse_hlo", "scope_of",
+           "attribute_rows", "group_by_scope", "peak_watermark",
+           "normalize_cost_analysis", "compiled_cost",
+           "instruction_flops", "SKIP_OPCODES"]
+
+DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1, "s32": 4, "u32": 4,
+    "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "token": 0, "opaque": 0,
+}
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%?[\w.-]+) = (\([^)]*\)|\S+) ([\w-]+)\((.*)$")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+_COMPUTATION_RE = re.compile(r"^(ENTRY\s+)?(%?[\w.-]+)\s*(?:\(.*)?\{\s*$")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
+_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_CALLS_RE = re.compile(r"calls=%?([\w.-]+)")
+
+# wrappers jax's name stack adds around user named_scope components
+_TRANSFORMS = frozenset([
+    "jit", "pjit", "jvp", "vjp", "transpose", "vmap", "pmap", "remat",
+    "checkpoint", "custom_jvp", "custom_vjp", "while", "body", "cond",
+    "scan", "shard_map", "named", "rematted_computation",
+])
+
+# data movement / bookkeeping: no flops, and no HBM accounting of their
+# own (parameters and constants are charged to their consumers)
+SKIP_OPCODES = ("parameter", "constant", "tuple", "get-tuple-element",
+                "bitcast")
+
+# one flop per output element
+_ELEMENTWISE = frozenset([
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "power", "remainder", "atan2", "exponential", "exponential-minus-one",
+    "log", "log-plus-one", "logistic", "tanh", "sqrt", "rsqrt", "cbrt",
+    "sine", "cosine", "tan", "negate", "abs", "sign", "floor", "ceil",
+    "round-nearest-afz", "round-nearest-even", "compare", "select",
+    "clamp", "and", "or", "xor", "not", "shift-left",
+    "shift-right-arithmetic", "shift-right-logical", "convert",
+    "is-finite", "rng", "rng-bit-generator", "map", "iota",
+])
+
+
+def shape_bytes(spec):
+    """Total bytes of an HLO shape spec (handles tuples)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(spec):
+        if dtype not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES[dtype]
+    return total
+
+
+def _shape_dims(spec):
+    """(elements, dims-of-first-array) of a shape spec; tuples report
+    the element count of the first component (enough for ranking)."""
+    m = _SHAPE_RE.search(spec)
+    if not m:
+        return 0, ()
+    dims = tuple(int(d) for d in m.group(2).split(",") if d)
+    n = 1
+    for d in dims:
+        n *= d
+    return n, dims
+
+
+def instruction_flops(opcode, out_elems, rest, operands):
+    """Shape-derived flop estimate for one parsed instruction.
+
+    ``operands`` is the list of resolved operand rows (dicts with
+    ``elems``/``dims``) in reference order; missing operands degrade
+    gracefully to coarser estimates.
+    """
+    if opcode == "dot":
+        contract = 1
+        m = _LHS_CONTRACT_RE.search(rest)
+        lhs = operands[0] if operands else None
+        if m and lhs is not None and lhs.get("dims"):
+            for d in m.group(1).split(","):
+                if d and int(d) < len(lhs["dims"]):
+                    contract *= lhs["dims"][int(d)]
+        return 2.0 * out_elems * contract
+    if opcode == "convolution":
+        kern = operands[1] if len(operands) > 1 else None
+        if kern is not None and kern.get("elems"):
+            out_ch = 1
+            m = _DIM_LABELS_RE.search(rest)
+            if m and "o" in m.group(2) and kern.get("dims"):
+                pos = m.group(2).index("o")
+                if pos < len(kern["dims"]):
+                    out_ch = max(kern["dims"][pos], 1)
+            return 2.0 * out_elems * kern["elems"] / out_ch
+        return 2.0 * out_elems
+    if opcode in ("reduce", "reduce-window"):
+        src = operands[0] if operands else None
+        return float(src["elems"]) if src and src.get("elems") \
+            else float(out_elems)
+    if opcode in _ELEMENTWISE:
+        return float(out_elems)
+    return 0.0
+
+
+def parse_hlo(text):
+    """Parse optimized-HLO text into per-instruction rows.
+
+    Returns a list of dicts: ``name``, ``opcode``, ``computation``,
+    ``entry`` (bool), ``out`` (output bytes), ``elems``, ``dims``,
+    ``operands`` (names), ``accessed`` (HBM byte estimate; 0 for
+    instructions inside non-entry computations), ``flops``,
+    ``op_name``. Rows appear in program order per computation,
+    computations in file order.
+    """
+    rows = []
+    comp = ""
+    entry = False
+    local = {}          # name -> row, per computation
+    per_comp = {}       # computation -> {name: row}
+    for line in text.splitlines():
+        if not line.startswith(" ") and line.rstrip().endswith("{"):
+            m = _COMPUTATION_RE.match(line.strip())
+            if m:
+                comp = m.group(2).lstrip("%")
+                entry = bool(m.group(1)) or "ENTRY" in line.split("{")[0]
+                local = per_comp.setdefault(comp, {})
+                continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, shape, opcode, rest = m.groups()
+        name = name.lstrip("%")
+        out = shape_bytes(shape)
+        elems, dims = _shape_dims(shape)
+        # operand refs live before the closing paren of the arg list
+        depth = 1
+        arglist = []
+        for ch in rest:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            arglist.append(ch)
+        ops = [ref for ref in re.findall(r"%?([\w.-]+)", "".join(arglist))
+               if ref in local]
+        meta = _METADATA_RE.search(rest)
+        calls = _CALLS_RE.search(rest) if opcode == "fusion" else None
+        row = {
+            "name": name, "opcode": opcode, "computation": comp,
+            "entry": entry, "out": out, "elems": elems, "dims": dims,
+            "operands": ops,
+            "calls": calls.group(1) if calls else None,
+            "op_name": meta.group(1) if meta else "",
+        }
+        row["flops"] = 0.0 if opcode in SKIP_OPCODES else \
+            instruction_flops(opcode, elems,
+                              rest, [local[o] for o in ops])
+        local[name] = row
+        rows.append(row)
+    for row in rows:
+        if row["entry"] and row["opcode"] not in SKIP_OPCODES:
+            local = per_comp.get(row["computation"], {})
+            row["accessed"] = row["out"] + sum(
+                local[o]["out"] for o in row["operands"] if o in local)
+        else:
+            row["accessed"] = 0
+    return rows
+
+
+def _unwrap(component):
+    """Strip nested transform wrappers: 'transpose(jvp(scope))' ->
+    'scope'; 'jit(relu)' -> 'relu'. Returns the innermost token."""
+    token = component
+    while True:
+        i = token.find("(")
+        if i <= 0 or not token.endswith(")"):
+            return token
+        head = token[:i]
+        if head not in _TRANSFORMS:
+            return token
+        token = token[i + 1:-1]
+
+
+def scope_of(op_name, known=None):
+    """The named scope an instruction's ``op_name`` path belongs to.
+
+    With ``known`` (a set of scope names the runtime registered at
+    trace time) the RIGHTMOST path component that unwraps to a known
+    scope wins — the finest enclosing block. Without ``known`` a
+    heuristic keeps any unwrapped component that is not a transform
+    and not the final (primitive) component.
+    """
+    if not op_name:
+        return None
+    parts = op_name.split("/")
+    best = None
+    for i, part in enumerate(parts):
+        token = _unwrap(part)
+        if not token or token in _TRANSFORMS:
+            continue
+        if known is not None:
+            if token in known:
+                best = token
+        elif i < len(parts) - 1 and "(" not in token:
+            best = token
+    return best
+
+
+def attribute_rows(rows, known=None):
+    """Annotate every row with its source ``scope`` (None when truly
+    unattributable). Three passes:
+
+    1. the row's own ``op_name`` metadata (``scope_of``);
+    2. ``fusion`` instructions whose metadata names no scope inherit
+       the DOMINANT scope of their fused computation (weighted by
+       flops, then output bytes) — XLA occasionally drops the fusion
+       root's metadata while the fused instructions keep theirs;
+    3. metadata-less data movement (layout copies/transposes XLA
+       inserts with no op_name) inherits its first attributed
+       operand's scope — the traffic exists to feed that scope.
+    """
+    comps = {}
+    for r in rows:
+        comps.setdefault(r["computation"], {})[r["name"]] = r
+    for r in rows:
+        r["scope"] = scope_of(r["op_name"], known)
+    for r in rows:
+        if r["scope"] is None and r.get("calls"):
+            weights = {}
+            for ir in comps.get(r["calls"], {}).values():
+                s = ir["scope"]
+                if s:
+                    weights[s] = weights.get(s, 0.0) + max(
+                        ir["flops"], float(ir["out"]), 1.0)
+            if weights:
+                r["scope"] = max(weights.items(),
+                                 key=lambda kv: kv[1])[0]
+    for _ in range(2):          # chains: copy-of-copy resolves pass 2
+        unresolved = False
+        for r in rows:
+            if r["scope"] is not None \
+                    or r["opcode"] in ("parameter", "constant"):
+                continue
+            local = comps[r["computation"]]
+            for o in r["operands"]:
+                src = local.get(o)
+                if src is not None and src["scope"]:
+                    r["scope"] = src["scope"]
+                    break
+            unresolved = unresolved or r["scope"] is None
+        if not unresolved:
+            break
+    return rows
+
+
+def group_by_scope(rows, known=None, unattributed="(unattributed)"):
+    """Aggregate parsed rows per source scope (rows are run through
+    ``attribute_rows`` unless already annotated).
+
+    Returns ``(scopes, totals)`` where ``scopes`` maps scope name ->
+    {count, flops, hbm_bytes, out_bytes} and ``totals`` carries the
+    same fields plus ``attributed_flops`` / ``attributed_hbm_bytes``
+    (everything not under the ``unattributed`` key).
+    """
+    if rows and "scope" not in rows[0]:
+        attribute_rows(rows, known)
+    scopes = defaultdict(lambda: {"count": 0, "flops": 0.0,
+                                  "hbm_bytes": 0, "out_bytes": 0})
+    totals = {"count": 0, "flops": 0.0, "hbm_bytes": 0, "out_bytes": 0,
+              "attributed_flops": 0.0, "attributed_hbm_bytes": 0}
+    for row in rows:
+        if row["opcode"] in SKIP_OPCODES:
+            continue
+        scope = row["scope"] or unattributed
+        ent = scopes[scope]
+        ent["count"] += 1
+        ent["flops"] += row["flops"]
+        ent["hbm_bytes"] += row["accessed"]
+        if row["entry"]:
+            ent["out_bytes"] += row["out"]
+            totals["out_bytes"] += row["out"]
+        totals["count"] += 1
+        totals["flops"] += row["flops"]
+        totals["hbm_bytes"] += row["accessed"]
+        if scope != unattributed:
+            totals["attributed_flops"] += row["flops"]
+            totals["attributed_hbm_bytes"] += row["accessed"]
+    return dict(scopes), totals
+
+
+def peak_watermark(rows, known=None, unattributed="(unattributed)"):
+    """Liveness sweep over the ENTRY computation: each buffer lives
+    from its defining instruction to its last top-level use (the root
+    stays live to the end). Returns ``(peak_bytes, by_scope)`` where
+    ``by_scope`` attributes the bytes live at the peak instant to the
+    scope of each buffer's producer (parameters land under
+    ``(parameters)``).
+    """
+    if rows and "scope" not in rows[0]:
+        attribute_rows(rows, known)
+    entry = [r for r in rows if r["entry"]]
+    if not entry:
+        return 0, {}
+    index = {r["name"]: i for i, r in enumerate(entry)}
+    last_use = {}
+    for i, r in enumerate(entry):
+        for op in r["operands"]:
+            if op in index:
+                last_use[op] = i
+    n = len(entry)
+    for r in entry:
+        # outputs (and anything never consumed at top level) stay live
+        last_use.setdefault(r["name"], n - 1)
+    births = defaultdict(list)
+    deaths = defaultdict(list)
+    for r in entry:
+        if r["opcode"] in ("tuple", "get-tuple-element", "bitcast"):
+            continue    # aliases, not allocations
+        i = 0 if r["opcode"] == "parameter" else index[r["name"]]
+        births[i].append(r)
+        deaths[last_use[r["name"]]].append(r)
+    live = 0
+    live_set = set()
+    peak = 0
+    peak_set = ()
+    for i in range(n):
+        for r in births.get(i, ()):
+            live += r["out"]
+            live_set.add(r["name"])
+        if live > peak:
+            peak = live
+            peak_set = tuple(live_set)
+        for r in deaths.get(i, ()):
+            live -= r["out"]
+            live_set.discard(r["name"])
+    by_name = {r["name"]: r for r in entry}
+    by_scope = defaultdict(int)
+    for name in peak_set:
+        r = by_name[name]
+        if r["opcode"] == "parameter":
+            by_scope["(parameters)"] += r["out"]
+        else:
+            by_scope[r["scope"] or unattributed] += r["out"]
+    return peak, dict(by_scope)
+
+
+# ------------------------------------------------- cost_analysis glue --
+
+def normalize_cost_analysis(ca):
+    """XLA's ``compiled.cost_analysis()`` has returned a dict, a list of
+    dicts (one per partition), or None across jax versions. Normalize to
+    ONE plain dict ({} when unavailable) — the helper the benchmarks
+    used to each reimplement inline."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else {}
+
+
+def compiled_cost(compiled):
+    """``normalize_cost_analysis`` over a compiled executable, tolerating
+    backends that raise instead of returning None."""
+    try:
+        return normalize_cost_analysis(compiled.cost_analysis())
+    except Exception:
+        return {}

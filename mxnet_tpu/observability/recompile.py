@@ -122,6 +122,12 @@ class RecompileDetector(object):
             args={"origin": origin, "signature": signature,
                   "steady": rec["steady"]})
         core.counter("recompile." + kind).add(1)
+        if kind == "backend_compile":
+            # a fresh executable exists — per-operator attribution must
+            # re-analyze the origin's program (attribution.py caches
+            # the HLO breakdown per executable)
+            from . import attribution
+            attribution.on_compile(origin, kind)
         if over:
             self._warn()
 
@@ -140,6 +146,10 @@ class RecompileDetector(object):
             RuntimeWarning, stacklevel=3)
 
     def on_event(self, event, duration):
+        if getattr(_tls, "suppress", 0):
+            # report-time re-lowering (attribution._analyze) compiles on
+            # purpose; counting it would flag the profiler as the leak
+            return
         origin, signature = getattr(_tls, "call", (None, None))
         if event == JAXPR_TRACE_EVENT:
             self._push("trace", origin, signature, duration)
@@ -181,6 +191,18 @@ def note_call(origin, signature):
     Call only when ``core.enabled()`` (signature formatting costs)."""
     get_detector()
     _tls.call = (origin, signature)
+
+
+class suppress_events(object):
+    """Context manager: compile/trace events fired on this thread are
+    NOT counted by the detector (deliberate report-time lowering)."""
+
+    def __enter__(self):
+        _tls.suppress = getattr(_tls, "suppress", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.suppress -= 1
 
 
 def record_retrace(origin, signature, duration=0.0):

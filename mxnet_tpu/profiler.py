@@ -120,10 +120,14 @@ def dump(finished=True, profile_process="worker"):
     elif _state["dir"] is not None and finished:
         jax.profiler.stop_trace()
         _state["dir"] = None
+    from .observability import attribution as _obs_attr
     from .observability import dist as _obs_dist
     from . import storage as _storage
     _obs_dist.ensure_clock_anchor()
     _storage.publish_device_memory_gauges()
+    # per-operator attribution: per-scope flops/bytes gauges ride the
+    # ring into the chrome trace + Prometheus textfile
+    _obs_attr.publish_counters()
     path = _obs_dist.rank_trace_path(str(_config["filename"]))
     _obs_export.dump_chrome_trace(path)
     _obs_export.write_prometheus()

@@ -881,11 +881,23 @@ def _infer_graph(nodes, known_shapes, known_dtypes, partial=False):
     """Walk the graph computing per-node output ShapeDtype via
     jax.eval_shape; fill missing variable shapes from PARAM_SHAPE_RULES."""
     from .executor import node_eval_fn
+    from .observability import recompile as _obs_recompile
 
     shapes = dict(known_shapes)
     dtypes = dict(known_dtypes)
     results = {}  # node name -> list of ShapeDtypeStruct
 
+    # eval_shape fires per-node jaxpr-trace events; they are shape
+    # inference, not executable re-traces — keep them off the recompile
+    # detector's steady-state budget (they'd be blamed on whatever jit
+    # boundary ran last)
+    with _obs_recompile.suppress_events():
+        return _infer_graph_impl(nodes, node_eval_fn, shapes, dtypes,
+                                 results, partial)
+
+
+def _infer_graph_impl(nodes, node_eval_fn, shapes, dtypes, results,
+                      partial):
     for node in nodes:
         if node.is_var():
             shp = shapes.get(node.name) or node.attrs.get("__shape__")

@@ -14,15 +14,72 @@ def _src_name(input_sym):
 __all__ = ["print_summary", "plot_network"]
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=None):
-    """Print a layer-by-layer summary table of a Symbol graph."""
+def _elems(shp):
+    n = 1
+    for d in shp:
+        n *= d
+    return n
+
+
+def _node_flops(node, shapes):
+    """Shape-based per-node FLOP estimate (the fallback when no
+    compiled executable is registered with the attribution layer):
+    2*out*K for matmul/conv from the weight shape, one per output
+    element for the elementwise-ish lanes, 0 where we can't say."""
+    out_shape = shapes.get("%s#0" % node.name)
+    if out_shape is None:
+        return None
+    out = _elems(out_shape)
+    w_shape = None
+    for inp, _ in node.inputs:
+        name = _src_name(inp)
+        if name.endswith("weight") and name in shapes:
+            w_shape = shapes[name]
+            break
+    if node.op in ("FullyConnected", "dot", "linalg_gemm2"):
+        if w_shape is not None and len(w_shape) >= 2:
+            return 2.0 * out * w_shape[-1]
+        return None
+    if node.op in ("Convolution", "Deconvolution"):
+        if w_shape is not None and w_shape:
+            return 2.0 * out * _elems(w_shape) / max(w_shape[0], 1)
+        return None
+    if node.op in ("Activation", "relu", "sigmoid", "tanh", "softmax",
+                   "SoftmaxOutput", "LeakyReLU", "elemwise_add",
+                   "elemwise_mul", "broadcast_add", "broadcast_mul",
+                   "_plus", "_mul", "Dropout"):
+        return float(out)
+    if node.op == "BatchNorm":
+        return 2.0 * out        # scale + shift per element
+    if node.op == "Pooling":
+        return float(out)       # one accumulate per output element
+    return 0.0
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None,
+                  flops=False):
+    """Print a layer-by-layer summary table of a Symbol graph.
+
+    ``flops=True`` adds a per-layer FLOPs column. When the attribution
+    layer holds a compiled executable whose scopes match this graph's
+    node names (MXNET_OBS=1 and the program already ran —
+    docs/OBSERVABILITY.md "Per-operator attribution"), the column shows
+    the measured per-scope totals from the optimized HLO (which include
+    backward for `.step` programs); otherwise it falls back to
+    shape-based per-node estimates (forward only, ``shape`` required
+    for anything beyond matmul/conv with deferred shapes).
+    """
     if not isinstance(symbol, Symbol):
         raise MXNetError("symbol must be a Symbol")
-    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = positions or (
+        [0.38, 0.55, 0.64, 0.76, 1.0] if flops
+        else [0.44, 0.64, 0.74, 1.0])
     if positions[-1] <= 1:
         positions = [int(line_length * p) for p in positions]
     to_display = ["Layer (type)", "Output Shape", "Param #",
                   "Previous Layer"]
+    if flops:
+        to_display.insert(3, "FLOPs")
 
     def print_row(fields, pos):
         line = ""
@@ -37,14 +94,35 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     print("=" * line_length)
 
     shape_dict = {}
+    node_shapes = {}
     if shape is not None:
         arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
         shape_dict = dict(zip(symbol.list_arguments(), arg_shapes))
 
-    total_params = 0
     nodes = symbol._active_nodes()
+    scope_flops = {}
+    analyzed = False
+    if flops:
+        from .observability import attribution
+        if attribution._programs:
+            summ = attribution.summary()
+            scope_flops = {name: ent["flops"]
+                           for name, ent in summ["scopes"].items()}
+            # the registered programs must actually cover THIS graph —
+            # an unrelated executable's scopes fall back to estimates
+            analyzed = any(n.name in scope_flops for n in nodes
+                           if not n.is_var())
+        if not analyzed and shape is not None:
+            from .symbol import _infer_graph
+            known = {k: tuple(v) for k, v in shape.items()}
+            node_shapes, _ = _infer_graph(nodes, known, {},
+                                          partial=True)
+
+    total_params = 0
+    total_flops = 0.0
     for node in nodes:
         name = node.name
+        n_flops = None
         if node.is_var():
             op = "Variable"
             out_shape = shape_dict.get(name, "")
@@ -60,11 +138,26 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
             out_shape = ""
             params = 0
             prev = ",".join(_src_name(inp) for inp, _ in node.inputs[:3])
+            if flops:
+                n_flops = scope_flops.get(name) if analyzed \
+                    else _node_flops(node, node_shapes)
         total_params += params
-        print_row(["%s (%s)" % (name, op), str(out_shape), params, prev],
-                  positions)
+        row = ["%s (%s)" % (name, op), str(out_shape), params, prev]
+        if flops:
+            if n_flops:
+                total_flops += n_flops
+                shown = "%.0f" % n_flops
+            else:
+                shown = "" if n_flops is None else "0"
+            row.insert(3, shown)
+        print_row(row, positions)
         print("_" * line_length)
     print("Total params: %d" % total_params)
+    if flops:
+        print("Total FLOPs: %.3e (%s)"
+              % (total_flops,
+                 "per-scope HLO analysis" if analyzed
+                 else "shape-based estimate"))
     print("=" * line_length)
     return total_params
 

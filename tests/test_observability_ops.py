@@ -1,0 +1,404 @@
+"""Per-operator attribution (observability/attribution.py + hlo.py,
+ISSUE 4): named-scope propagation into HLO metadata, per-scope
+flops/bytes grouping, peak-watermark attribution, the perf-regression
+sentinel, and the zero-overhead-when-off contract."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import attribution, core, hlo, recompile
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+BASELINE = os.path.join(ROOT, "ci", "obs_baseline.json")
+
+
+def _load_obs_ops():
+    spec = importlib.util.spec_from_file_location(
+        "obs_ops_for_test", os.path.join(ROOT, "tools", "obs_ops.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def ops_on(monkeypatch):
+    """Enabled telemetry + clean attribution registry for one test."""
+    monkeypatch.setenv("MXNET_OBS", "1")
+    core.set_enabled(None)
+    core.reset()
+    attribution.reset()
+    recompile.get_detector().reset()
+    yield
+    core.set_enabled(None)
+    core.reset()
+    attribution.reset()
+    recompile.get_detector().reset()
+
+
+# A hand-written optimized-HLO module with hand-computable costs: a
+# conv scope (27648 flops) feeding a dense scope (4096 flops) through
+# an unattributed reshape, plus a fusion whose own metadata names no
+# scope but whose fused computation belongs to the conv block.
+KNOWN_HLO = """\
+HloModule step
+
+%fused_relu (param_0.1: f32[2,4,8,8]) -> f32[2,4,8,8] {
+  %param_0.1 = f32[2,4,8,8] parameter(0)
+  %const.0 = f32[] constant(0)
+  %bcast.0 = f32[2,4,8,8] broadcast(%const.0), dimensions={}
+  ROOT %max.0 = f32[2,4,8,8] maximum(%param_0.1, %bcast.0), metadata={op_name="jit(step)/convblock/relu/max"}
+}
+
+ENTRY %main.42 (p0: f32[2,3,8,8], p1: f32[4,3,3,3], p2: f32[256,4]) -> f32[2,4] {
+  %p0 = f32[2,3,8,8] parameter(0)
+  %p1 = f32[4,3,3,3] parameter(1)
+  %p2 = f32[256,4] parameter(2)
+  %conv.0 = f32[2,4,8,8] convolution(%p0, %p1), window={size=3x3 pad=1_1x1_1}, dim_labels=bf01_oi01->bf01, metadata={op_name="jit(step)/convblock/conv_general_dilated"}
+  %relu.0 = f32[2,4,8,8] fusion(%conv.0), kind=kLoop, calls=%fused_relu
+  %reshape.0 = f32[2,256] reshape(%relu.0)
+  ROOT %dot.0 = f32[2,4] dot(%reshape.0, %p2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/denseblock/dot_general"}
+}
+"""
+
+KNOWN_SCOPES = {"convblock", "denseblock"}
+
+
+# ------------------------------------------------------ hlo parsing --
+
+def test_shape_bytes_and_tuple():
+    assert hlo.shape_bytes("f32[2,3]") == 24
+    assert hlo.shape_bytes("bf16[8]") == 16
+    assert hlo.shape_bytes("(f32[2,2], s32[3])") == 28
+    assert hlo.shape_bytes("token[]") == 0
+
+
+def test_parse_known_program_costs():
+    rows = hlo.parse_hlo(KNOWN_HLO)
+    by = {r["name"]: r for r in rows}
+    # conv: 2 * out_elems(512) * kernel_elems(108) / out_ch(4) = 27648
+    assert by["conv.0"]["flops"] == 27648.0
+    # dot: 2 * out_elems(8) * contraction(256) = 4096
+    assert by["dot.0"]["flops"] == 4096.0
+    # entry HBM accounting: output + operand outputs
+    assert by["conv.0"]["accessed"] == (2 * 4 * 8 * 8 * 4      # own out
+                                        + 2 * 3 * 8 * 8 * 4   # p0
+                                        + 4 * 3 * 3 * 3 * 4)  # p1
+    # fused-internal instructions carry flops but no HBM bytes
+    assert by["max.0"]["flops"] == 2 * 4 * 8 * 8
+    assert by["max.0"]["accessed"] == 0
+    assert by["relu.0"]["accessed"] == 2 * (2 * 4 * 8 * 8 * 4)
+    assert by["p0"]["entry"] and not by["max.0"]["entry"]
+
+
+def test_scope_of_unwraps_transforms():
+    known = {"convblock", "stage1"}
+    assert hlo.scope_of("jit(step)/convblock/conv", known) == "convblock"
+    assert hlo.scope_of(
+        "jit(step)/transpose(jvp(convblock))/conv", known) == "convblock"
+    assert hlo.scope_of(
+        "jit(step)/remat(stage1)/convblock/dot", known) == "convblock"
+    assert hlo.scope_of("jit(step)/unknown/conv", known) is None
+    assert hlo.scope_of("", known) is None
+    # heuristic mode (no known set): inner path component wins
+    assert hlo.scope_of("jit(step)/mlp/dot_general") == "mlp"
+
+
+def test_group_by_scope_known_program():
+    rows = hlo.attribute_rows(hlo.parse_hlo(KNOWN_HLO), KNOWN_SCOPES)
+    scopes, totals = hlo.group_by_scope(rows)
+    # the metadata-less fusion inherits its fused computation's scope;
+    # the metadata-less reshape inherits its operand's scope
+    by = {r["name"]: r for r in rows}
+    assert by["relu.0"]["scope"] == "convblock"
+    assert by["reshape.0"]["scope"] == "convblock"
+    assert scopes["convblock"]["flops"] == 27648.0 + 2 * 4 * 8 * 8
+    assert scopes["denseblock"]["flops"] == 4096.0
+    # the only unattributable row is the fused constant broadcast,
+    # which carries no flops and no HBM bytes — every real cost lands
+    # on a named scope
+    extra = set(scopes) - {"convblock", "denseblock"}
+    for s in extra:
+        assert scopes[s]["flops"] == 0 and scopes[s]["hbm_bytes"] == 0
+    assert totals["attributed_flops"] == totals["flops"]
+    assert totals["attributed_hbm_bytes"] == totals["hbm_bytes"]
+
+
+def test_peak_watermark_known_program():
+    rows = hlo.attribute_rows(hlo.parse_hlo(KNOWN_HLO), KNOWN_SCOPES)
+    peak, by_scope = hlo.peak_watermark(rows)
+    # def-to-last-use: p0/p1 die when conv.0 executes, so the peak
+    # instant is relu.0's birth — p2 (still waiting for the dot) plus
+    # conv.0 (dies right after) plus relu.0 itself are live
+    p2_bytes = 256 * 4 * 4
+    conv_out = 2 * 4 * 8 * 8 * 4
+    assert peak == p2_bytes + 2 * conv_out
+    assert by_scope["(parameters)"] == p2_bytes
+    assert by_scope["convblock"] == 2 * conv_out
+
+
+def test_normalize_cost_analysis_forms():
+    assert hlo.normalize_cost_analysis(None) == {}
+    assert hlo.normalize_cost_analysis([]) == {}
+    assert hlo.normalize_cost_analysis({"flops": 2.0}) == {"flops": 2.0}
+    assert hlo.normalize_cost_analysis(
+        [{"flops": 3.0}, {"flops": 9.0}]) == {"flops": 3.0}
+
+    class _Raises:
+        def cost_analysis(self):
+            raise RuntimeError("unsupported backend")
+    assert hlo.compiled_cost(_Raises()) == {}
+
+
+# ------------------------------------- end-to-end scope propagation --
+
+def test_two_block_gluon_model_attribution(ops_on):
+    """The acceptance path: a two-block (conv+dense) Gluon model under
+    MXNET_OBS=1 — scope names survive jit into the optimized HLO, >=90%
+    of compiled-step flops and HBM bytes land on named block scopes,
+    and the conv block ranks first by flops."""
+    obs_ops = _load_obs_ops()
+    summ = obs_ops.run_workload()
+
+    assert summ["totals"]["programs"] >= 1
+    t = summ["totals"]
+    assert t["flops"] > 0 and t["hbm_bytes"] > 0
+    assert t["attributed_flops"] >= 0.9 * t["flops"]
+    assert t["attributed_hbm_bytes"] >= 0.9 * t["hbm_bytes"]
+
+    # block scopes from the explicit prefixes reached the HLO metadata
+    named = [s for s in summ["scopes"] if s != attribution.UNATTRIBUTED]
+    assert any("conv" in s for s in named)
+    assert any("dense" in s for s in named)
+
+    # conv first by flops (it is the flop-heavy block)
+    by_flops = sorted(summ["scopes"].items(),
+                      key=lambda kv: -kv[1]["flops"])
+    assert "conv" in by_flops[0][0]
+
+    # peak-watermark attribution names scopes too
+    assert summ["totals"]["peak_bytes"] > 0
+    assert summ["peak_scopes"]
+
+    # the report table renders and carries the block scopes
+    table = "\n".join(attribution.format_ops_table(summ))
+    assert "Per-operator attribution" in table
+    assert any(s[-44:] in table for s in named if "conv" in s)
+
+    # per-scope gauges ride the existing counter/export path
+    attribution.publish_counters(summ)
+    names = set(core.counters())
+    assert any(n.startswith("ops.") and n.endswith(".flops")
+               for n in names)
+    assert "ops.peak_bytes" in names
+
+
+def test_scope_registry_and_invalidation(ops_on):
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: jnp.sin(x) * 2.0)
+    x = jnp.ones((4,))
+    attribution.register_program("Test.fwd", "f32[4]", fn, (x,))
+    assert not attribution.needs_program("Test.fwd", "f32[4]")
+    (analysis,) = attribution.analyses()
+    assert analysis["totals"]["flops"] > 0
+    # a backend compile for the origin invalidates the cached analysis
+    attribution.on_compile("Test.fwd", "backend_compile")
+    assert attribution._programs[("Test.fwd", "f32[4]")]["analysis"] \
+        is None
+    # ...and tracing-only events do not
+    (_,) = attribution.analyses()
+    attribution.on_compile("Test.fwd", "tracing")
+    assert attribution._programs[("Test.fwd", "f32[4]")]["analysis"] \
+        is not None
+
+
+# ------------------------------------------------------- sentinel --
+
+def _synthetic_summary(scale_bytes=1.0):
+    return {
+        "totals": {"flops": 1e9, "hbm_bytes": 4e8 * scale_bytes,
+                   "out_bytes": 1e8, "count": 100,
+                   "peak_bytes": 2e8 * scale_bytes},
+        "scopes": {
+            "convblock": {"count": 60, "flops": 8e8,
+                          "hbm_bytes": 3e8 * scale_bytes,
+                          "out_bytes": 6e7},
+            "denseblock": {"count": 40, "flops": 2e8,
+                           "hbm_bytes": 1e8 * scale_bytes,
+                           "out_bytes": 4e7}},
+    }
+
+
+def test_sentinel_passes_identical_and_within_tolerance():
+    base = _synthetic_summary()
+    report = attribution.compare_summaries(base, _synthetic_summary())
+    assert report["regressions"] == [] and report["notes"] == []
+    # +10% bytes is inside the default 15% tolerance
+    report = attribution.compare_summaries(
+        base, _synthetic_summary(scale_bytes=1.10))
+    assert report["regressions"] == []
+
+
+def test_sentinel_catches_byte_regression():
+    report = attribution.compare_summaries(
+        _synthetic_summary(), _synthetic_summary(scale_bytes=2.0))
+    where = {(r["where"], r["metric"]) for r in report["regressions"]}
+    assert ("totals", "hbm_bytes") in where
+    assert ("scope:convblock", "hbm_bytes") in where
+    assert all(abs(r["ratio"] - 2.0) < 1e-9
+               for r in report["regressions"])
+
+
+def test_sentinel_rename_is_note_not_failure():
+    base = _synthetic_summary()
+    cur = _synthetic_summary()
+    cur["scopes"]["convblock_v2"] = cur["scopes"].pop("convblock")
+    report = attribution.compare_summaries(base, cur)
+    assert report["regressions"] == []
+    assert len(report["notes"]) == 2       # one gone, one new
+
+
+def test_sentinel_improvement_reported():
+    report = attribution.compare_summaries(
+        _synthetic_summary(), _synthetic_summary(scale_bytes=0.5))
+    assert report["regressions"] == []
+    assert any(r["metric"] == "hbm_bytes"
+               for r in report["improvements"])
+
+
+def test_sentinel_tolerance_override():
+    report = attribution.compare_summaries(
+        _synthetic_summary(), _synthetic_summary(scale_bytes=1.3),
+        tolerances={"hbm_bytes": 0.5, "peak_bytes": 0.5})
+    assert report["regressions"] == []
+
+
+def test_committed_baseline_catches_injected_2x_bytes(tmp_path):
+    """The CI contract: doubling every HBM byte against the committed
+    ci/obs_baseline.json must fail tools/obs_regression.py."""
+    assert os.path.exists(BASELINE), \
+        "ci/obs_baseline.json must be committed (obs_regression --update)"
+    with open(BASELINE) as f:
+        doc = json.load(f)
+    base = doc["summary"]
+
+    # in-process: the comparison itself
+    cur = json.loads(json.dumps(base))
+    cur["totals"]["hbm_bytes"] *= 2
+    for ent in cur["scopes"].values():
+        ent["hbm_bytes"] *= 2
+    report = attribution.compare_summaries(
+        base, cur, tolerances=doc.get("tolerances"))
+    assert any(r["metric"] == "hbm_bytes"
+               for r in report["regressions"])
+
+    # CLI: exit codes 0 (identical) and 1 (regressed)
+    ok = tmp_path / "same.json"
+    bad = tmp_path / "regressed.json"
+    ok.write_text(json.dumps({"summary": base}))
+    bad.write_text(json.dumps({"summary": cur}))
+    tool = os.path.join(ROOT, "tools", "obs_regression.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, tool, "--baseline", BASELINE,
+                        "--current", str(ok)], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, tool, "--baseline", BASELINE,
+                        "--current", str(bad)], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "hbm_bytes" in r.stdout
+
+
+# ------------------------------------------- print_summary FLOPs --
+
+def _fc_symbol():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = sym.Activation(net, name="relu1", act_type="relu")
+    return sym.FullyConnected(net, name="fc2", num_hidden=4)
+
+
+def test_print_summary_flops_shape_fallback(capsys):
+    attribution.reset()      # no registered program -> estimates
+    net = _fc_symbol()
+    mx.visualization.print_summary(net, shape={"data": (2, 8)},
+                                   flops=True)
+    out = capsys.readouterr().out
+    assert "FLOPs" in out
+    assert "shape-based estimate" in out
+    # fc1: 2 * (2*16) * 8 = 512
+    assert "512" in out
+
+
+def test_print_summary_flops_from_attribution(ops_on, capsys):
+    net = _fc_symbol()
+    ex = net.simple_bind(mx.cpu(), data=(2, 8))
+    ex.forward(is_train=False)
+    assert attribution._programs     # executor registered its program
+    mx.visualization.print_summary(net, shape={"data": (2, 8)},
+                                   flops=True)
+    out = capsys.readouterr().out
+    assert "per-scope HLO analysis" in out
+    # the fc1 row carries measured flops (512 matmul + 32 bias adds)
+    fc1_row = next(l for l in out.splitlines() if l.startswith("fc1 ("))
+    assert "544" in fc1_row
+
+
+# ---------------------------------------------------- zero overhead --
+
+def test_no_named_scope_frames_when_off(monkeypatch):
+    """MXNET_OBS unset -> the trace binds NO jax.named_scope frames and
+    nothing registers with the attribution layer (the one-guarded-
+    branch contract)."""
+    import jax
+
+    monkeypatch.delenv("MXNET_OBS", raising=False)
+    core.set_enabled(None)
+    attribution.reset()
+    assert not attribution.ops_enabled()
+
+    calls = []
+    real = jax.named_scope
+
+    def counting(name, *a, **kw):
+        calls.append(name)
+        return real(name, *a, **kw)
+
+    monkeypatch.setattr(jax, "named_scope", counting)
+
+    net = nn.HybridSequential(prefix="obsoff_")
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", prefix="d1_"))
+        net.add(nn.Dense(4, prefix="d2_"))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(2, 6))
+    with autograd.record():
+        out = net(x)
+    out.backward()
+
+    assert calls == []
+    assert attribution.known_scopes() == set()
+    assert attribution._programs == {}
+
+
+def test_ops_gate_follows_obs_and_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_OBS", "1")
+    core.set_enabled(None)
+    assert attribution.ops_enabled()
+    monkeypatch.setenv("MXNET_OBS_OPS", "0")
+    assert not attribution.ops_enabled()
+    monkeypatch.delenv("MXNET_OBS_OPS", raising=False)
+    monkeypatch.delenv("MXNET_OBS", raising=False)
+    assert not attribution.ops_enabled()

@@ -1,0 +1,121 @@
+"""Per-operator attribution CLI: the "where did the bytes go" command.
+
+Prints the per-scope top-K table (instruction count / GFLOP / HBM MB /
+arithmetic intensity / roofline bound / time share / MFU share) for
+every compiled executable the attribution layer has registered
+(docs/OBSERVABILITY.md "Per-operator attribution"), and can persist the
+underlying summary as JSON — the artifact ``tools/obs_regression.py``
+diffs against a committed baseline.
+
+Three ways to get a summary in front of it:
+
+    # 1. built-in deterministic workload (the CI smoke: a two-block
+    #    conv+dense Gluon model trained for 2 steps on the attached
+    #    backend; explicit prefixes, so scope names never depend on
+    #    process-global naming counters)
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_ops.py
+    python tools/obs_ops.py --json /tmp/ops.json     # + write summary
+
+    # 2. a summary JSON some other run saved (--json above, or any
+    #    caller of observability.ops_summary())
+    python tools/obs_ops.py --summary /tmp/ops.json
+
+    # 3. from inside a training script: run your steps with MXNET_OBS=1
+    #    and call observability.format_ops_table() / ops_summary() —
+    #    profiler.dumps(aggregate=True) appends the same table.
+
+The flops/bytes columns are shape-derived estimates from the optimized
+HLO (observability/hlo.py docstring spells out the accounting model);
+``--topk`` / MXNET_OBS_OPS_TOPK controls table depth and
+MXNET_OBS_OPS_PEAK_FLOPS / MXNET_OBS_OPS_HBM_GBS set the roofline.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("MXNET_OBS", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the smoke workload's shapes: conv dominates flops (acceptance: the
+# top-K table must rank the conv block first), dense dominates params
+BATCH, CHANNELS, IMG, CONV_FILTERS, DENSE_UNITS = 4, 3, 32, 16, 8
+
+
+def build_workload_net():
+    """The two-block conv+dense model with DETERMINISTIC scope names
+    (explicit prefixes bypass the process-global naming counters, so
+    baseline scope keys survive test ordering and reruns)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential(prefix="obsops_")
+    with net.name_scope():
+        net.add(nn.Conv2D(CONV_FILTERS, kernel_size=3, padding=1,
+                          activation="relu", prefix="conv_"))
+        net.add(nn.Flatten(prefix="flatten_"))
+        net.add(nn.Dense(DENSE_UNITS, prefix="dense_"))
+    return net
+
+
+def run_workload(steps=2):
+    """Train the smoke model for ``steps`` and return the attribution
+    summary. Requires telemetry on (MXNET_OBS=1) at call time — scope
+    names only reach the HLO if the program is traced with it on."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.observability import attribution
+
+    net = build_workload_net()
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss(prefix="obsops_loss_")
+    x = mx.nd.random.uniform(shape=(BATCH, CHANNELS, IMG, IMG))
+    y = mx.nd.random.uniform(shape=(BATCH, DENSE_UNITS))
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(BATCH)
+    return attribution.summary()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--summary", metavar="JSON", default=None,
+                   help="print the table from a saved summary instead "
+                        "of running the built-in workload")
+    p.add_argument("--json", metavar="OUT", default=None,
+                   help="write the summary JSON (the obs_regression "
+                        "artifact) after printing the table")
+    p.add_argument("--topk", type=int, default=None,
+                   help="table depth (default MXNET_OBS_OPS_TOPK=10)")
+    args = p.parse_args(argv)
+
+    if args.summary:
+        with open(args.summary) as f:
+            doc = json.load(f)
+        summ = doc.get("summary", doc)   # bare or baseline-wrapped
+    else:
+        summ = run_workload()
+
+    from mxnet_tpu.observability import attribution
+    lines = attribution.format_ops_table(summ, k=args.topk)
+    if not lines:
+        print("[obs_ops] no compiled program registered — is MXNET_OBS "
+              "set, and did the workload trace a jit?")
+        return 1
+    print("\n".join(lines).lstrip("\n"))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"summary": summ}, f, indent=1, sort_keys=True)
+        print("\n[obs_ops] summary -> %s" % args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

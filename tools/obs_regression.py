@@ -1,0 +1,137 @@
+"""Perf-regression sentinel over the per-operator attribution summary.
+
+Diffs a run's aggregate totals + per-scope flops/HBM-bytes table
+against a committed baseline JSON with per-metric tolerances and exits
+nonzero on regression — the TIER1_OBS lane runs it on the obs_ops
+smoke workload against ``ci/obs_baseline.json``, so a PR that silently
+doubles the bytes a block moves fails CI with the offending scope and
+ratio in the output instead of surfacing weeks later as a slower
+BENCH row.
+
+    # CI form: run the deterministic smoke workload, diff vs baseline
+    python tools/obs_regression.py --baseline ci/obs_baseline.json
+
+    # diff two saved summaries (any obs_ops --json artifacts)
+    python tools/obs_regression.py --baseline base.json --current run.json
+
+    # intentional change? refresh the committed numbers
+    python tools/obs_regression.py --baseline ci/obs_baseline.json --update
+
+Tolerances: ``--tol metric=frac`` (repeatable) overrides, then the
+baseline file's ``tolerances`` map, then attribution.DEFAULT_TOLERANCES
+(flops/hbm_bytes 15%, out_bytes/peak_bytes 25%, count 50%). A metric
+regresses when ``current > baseline * (1 + tol)``; scopes appearing or
+disappearing are reported as notes, not failures (renames happen — the
+aggregate totals still catch growth hiding behind one), and
+improvements past the same tolerance are listed so an intentional
+optimization reminds you to --update.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("MXNET_OBS", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_summary(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc.get("summary", doc), doc
+
+
+def _fmt(rows):
+    out = []
+    for r in rows:
+        out.append("  %-28s %-10s %12.4g -> %12.4g  (%.2fx, tol %.0f%%)"
+                   % (r["where"], r["metric"], r["baseline"],
+                      r["current"], r["ratio"],
+                      100.0 * r.get("tolerance", 0.0)))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--baseline", required=True,
+                   help="committed baseline JSON (ci/obs_baseline.json)")
+    p.add_argument("--current", default=None,
+                   help="summary JSON to check; default: run the "
+                        "tools/obs_ops.py smoke workload")
+    p.add_argument("--tol", action="append", default=[],
+                   metavar="METRIC=FRAC",
+                   help="tolerance override, e.g. --tol hbm_bytes=0.1")
+    p.add_argument("--update", action="store_true",
+                   help="write the current summary over --baseline "
+                        "(keeps the file's tolerances block)")
+    args = p.parse_args(argv)
+
+    cli_tol = {}
+    for spec in args.tol:
+        metric, _, frac = spec.partition("=")
+        if not frac:
+            p.error("--tol wants METRIC=FRAC, got %r" % spec)
+        cli_tol[metric] = float(frac)
+
+    if args.current:
+        current, _ = _load_summary(args.current)
+    else:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "obs_ops", os.path.join(ROOT, "tools", "obs_ops.py"))
+        obs_ops = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(obs_ops)
+        current = obs_ops.run_workload()
+        if not current["totals"].get("programs"):
+            print("[obs_regression] FAIL: workload registered no "
+                  "compiled program (MXNET_OBS off at trace time?)")
+            return 2
+
+    baseline_doc = {}
+    if os.path.exists(args.baseline):
+        baseline, baseline_doc = _load_summary(args.baseline)
+    elif args.update:
+        baseline = None
+    else:
+        print("[obs_regression] FAIL: baseline %s not found (generate "
+              "with --update)" % args.baseline)
+        return 2
+
+    if args.update:
+        doc = {"workload": "tools/obs_ops.py smoke (two-block "
+                           "conv+dense Gluon model, 2 train steps)",
+               "tolerances": baseline_doc.get("tolerances", {}),
+               "summary": current}
+        with open(args.baseline, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print("[obs_regression] baseline updated -> %s" % args.baseline)
+        return 0
+
+    from mxnet_tpu.observability import attribution
+    tol = dict(baseline_doc.get("tolerances", {}))
+    tol.update(cli_tol)
+    report = attribution.compare_summaries(baseline, current,
+                                           tolerances=tol)
+    for note in report["notes"]:
+        print("[obs_regression] note: %s" % note)
+    if report["improvements"]:
+        print("[obs_regression] improvements past tolerance (baseline "
+              "stale? --update):")
+        print("\n".join(_fmt(report["improvements"])))
+    if report["regressions"]:
+        print("[obs_regression] FAIL: %d metric(s) regressed past "
+              "tolerance:" % len(report["regressions"]))
+        print("\n".join(_fmt(report["regressions"])))
+        return 1
+    print("[obs_regression] OK: totals + %d scope(s) within tolerance "
+          "of %s" % (len(baseline.get("scopes", {})), args.baseline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

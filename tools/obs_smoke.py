@@ -9,6 +9,14 @@ the ISSUE 2 acceptance path, exercised as a console one-liner:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
+``--ops`` runs the per-operator attribution half (ISSUE 4) instead:
+the two-block conv+dense workload from ``tools/obs_ops.py`` trains a
+couple of steps, and the emitted chrome trace must carry ``ops.*``
+per-scope gauges naming the conv AND dense block scopes, with >=90% of
+flops and HBM bytes attributed:
+
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --ops
+
 ``--nproc 2`` adds the distributed half (ISSUE 3): two gloo processes
 each train against a ``dist_tpu_sync`` kvstore (which takes the
 barrier-handshake clock anchor at creation), dump rank-local traces,
@@ -74,6 +82,55 @@ def single_process():
     print("[obs_smoke] trace OK: %d events, %d distinct names -> %s"
           % (len(trace["traceEvents"]), len(names), path))
     print(mx.profiler.dumps(aggregate=True))
+    return 0
+
+
+def ops_smoke():
+    """--ops: block-level scopes must survive jit into the emitted
+    trace (ops.* per-scope gauges) and attribution must cover >=90%
+    of the compiled step's flops and HBM bytes."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_ops", os.path.join(ROOT, "tools", "obs_ops.py"))
+    obs_ops = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_ops)
+
+    summ = obs_ops.run_workload()
+    t = summ["totals"]
+    if not t.get("programs"):
+        print("[obs_smoke] FAIL: no compiled program registered")
+        return 1
+    for metric, attr in (("flops", "attributed_flops"),
+                         ("hbm_bytes", "attributed_hbm_bytes")):
+        if t[attr] < 0.9 * t[metric]:
+            print("[obs_smoke] FAIL: only %.1f%% of %s attributed"
+                  % (100.0 * t[attr] / max(t[metric], 1e-9), metric))
+            return 1
+
+    import mxnet_tpu as mx
+    fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_ops_"),
+                         "trace.json")
+    mx.profiler.set_config(filename=fname, xla_trace=False)
+    path = mx.profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    ops_names = {e["name"] for e in trace["traceEvents"]
+                 if e["name"].startswith("ops.")}
+    for block in ("conv", "dense"):
+        if not any(block in n for n in ops_names):
+            print("[obs_smoke] FAIL: no ops.* gauge names the %s "
+                  "block; ops names: %s" % (block, sorted(ops_names)))
+            return 1
+    table = mx.profiler.dumps(aggregate=True)
+    if "Per-operator attribution" not in table:
+        print("[obs_smoke] FAIL: aggregate table lacks the "
+              "attribution section")
+        return 1
+    print("[obs_smoke] ops OK: %d ops.* gauges, %.1f%% flops / %.1f%% "
+          "bytes attributed -> %s"
+          % (len(ops_names), 100.0 * t["attributed_flops"] / t["flops"],
+             100.0 * t["attributed_hbm_bytes"] / t["hbm_bytes"], path))
+    print(table)
     return 0
 
 
@@ -145,9 +202,15 @@ def main():
                    help="launch N gloo processes and validate the "
                         "merged per-rank trace (default: single "
                         "process)")
+    p.add_argument("--ops", action="store_true",
+                   help="run the per-operator attribution smoke "
+                        "instead: block scopes must appear in the "
+                        "emitted trace with >=90%% cost attribution")
     args = p.parse_args()
     if os.environ.get("OBS_SMOKE_WORKER"):
         return worker()
+    if args.ops:
+        return ops_smoke()
     if args.nproc > 1:
         return orchestrate(args.nproc)
     return single_process()
