@@ -59,6 +59,16 @@ QUEUE = [
      {"argv": [sys.executable, "bench.py"],
       "env": {"MXNET_BENCH_BATCH": "256",
               "MXNET_BENCH_REPEATS": "3"}}, 1500, False),
+    # end-to-end through the REAL input pipeline (VERDICT r5 item 6):
+    # the headline is step time on resident synthetic tensors (the
+    # reference's benchmark_score methodology); this leg trains fed by
+    # ImageRecordIter and reports the host-feed-bound gap explicitly —
+    # on the 1-core build host the feed binds, and the row quantifies
+    # by how much (a multi-core chip host closes it with
+    # preprocess_threads)
+    ("bench_real_data",
+     {"argv": [sys.executable, "bench.py", "--real-data"]}, 1800,
+     False),
     ("decode_flash",
      {"stdin": "benchmark/decode_bench.py",
       "env": {"MXNET_DECODE_FLASH": "1"}}, 1500, False),
@@ -82,6 +92,14 @@ QUEUE = [
               "MXNET_DECODE_FLASH": "0"}}, 1500, False),
     ("serving",
      {"stdin": "benchmark/serving_bench.py"}, 1800, False),
+    # chunk pipelining A/B: the round-5 serving leg was dispatch-bound
+    # at 252 tok/s on the tunnel's ~15 ms synchronous RTT; depth-2
+    # pipelining dispatches chunk k+1 against the device-resident
+    # carry before syncing chunk k, so the RTT amortizes over depth
+    # chunks (CPU-smoke A/B measured 1.87x; docs/SERVING.md)
+    ("serving_pipeline",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--pipeline-depth", "2"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",
@@ -96,6 +114,16 @@ QUEUE = [
      {"stdin": "benchmark/train_lm_bench.py",
       "env": {"MXNET_LM_DMODEL": "2048", "MXNET_LM_LAYERS": "8",
               "MXNET_LM_FLASH": "0"}}, 1800, False),
+    # per-operator attribution of the d1024 step ON CHIP (VERDICT r5
+    # item 3): the off-chip HLO attribution (PERF.md "Where the d1024
+    # LM step's bytes go") names the dense-attention score chain as
+    # the byte bill — this leg re-runs the default d1024 config with
+    # --obs-ops so the same per-scope roofline table lands with TPU
+    # fusion (the CPU lowering over-counts elementwise traffic)
+    ("train_lm_obs_ops",
+     {"stdin": "benchmark/train_lm_bench.py",
+      "args": ["--obs-ops"],
+      "env": {"MXNET_OBS": "1", "MXNET_OBS_OPS": "1"}}, 1800, False),
     # d1024 sits below the MFU target at bs=8 (cost model: 43 FLOP/B
     # intensity vs the ~241 ridge); batch is the intensity lever for
     # the activation-traffic share — measure it

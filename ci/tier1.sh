@@ -80,6 +80,15 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         echo "[tier1] FAIL: distributed observability smoke"
         exit 1
     fi
+
+    echo "==== [tier1] serving observability smoke (pipelined batcher spans) ===="
+    # a pipelined ContinuousBatcher run must land dispatch/sync/patch
+    # spans + in-flight-depth / lane-occupancy / admit-latency gauges
+    # in the emitted trace (docs/SERVING.md chunk pipelining)
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --serving; then
+        echo "[tier1] FAIL: serving observability smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -24,11 +24,23 @@ Design notes (all static-shape, XLA-friendly):
   the next admission's prefill overwrites them. Throughput is
   proportional to active lanes, latency to the slowest active row —
   exactly the continuous-batching trade.
+* Chunk PIPELINING (pipeline_depth >= 2): the decode carry — cache,
+  per-lane tokens/positions, sample keys — stays device-resident, so
+  chunk k+1 dispatches against chunk k's output buffers before anyone
+  syncs chunk k's emissions, and the host round trip (the ~15 ms
+  tunnel RTT that capped the round-5 serving leg at 252 tok/s)
+  amortizes over `depth` chunks. Admission/eviction are jitted lane
+  patches sequenced after the in-flight chunks; emissions are credited
+  by dispatch-time lane identity, which is what keeps every stream
+  bit-identical to the synchronous pool and to solo generate().
 
 Greedy decoding (the serving default); sampling per-row is a
 straightforward extension (thread a per-slot PRNG key through step()).
 Weight-only int8 trees (quantize_weights_int8) pass through unchanged.
 """
+
+import time
+from collections import deque
 
 import numpy as np
 
@@ -36,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from . import transformer as tf
+from ..observability import core as _obs
 
 
 def _bucket(n, lo=8):
@@ -106,6 +119,80 @@ def _jitted_ragged_chunk(cfg, greedy, temperature, top_k, top_p, k):
          top_p, k), cfg, build)
 
 
+def _jitted_pipeline_chunk(cfg, greedy, temperature, top_k, top_p, k):
+    """`k` ragged decode steps that return the WHOLE rolling carry
+    (cache, last token, advanced positions, key chain) alongside the
+    [k, B] emissions — the dispatch unit of the PIPELINED batcher.
+
+    The sync-mode chunk (_jitted_ragged_chunk) hands its carry back to
+    the host, which re-uploads it next step; here the carry never
+    leaves the device, so chunk k+1 can be dispatched against chunk
+    k's output buffers BEFORE anyone syncs chunk k's tokens. The
+    emissions are the only output the host ever fetches. The carry is
+    donated on accelerators (tok/pos/keys included — they are dead the
+    moment the next chunk is built from them)."""
+    def build(fz):
+        def chunk(params, cache, tok, pos, keys):
+            def body(carry, _):
+                cache, tok, pos, keys = carry
+                logits, cache = tf.decode_step(params, cache, tok,
+                                               pos, fz)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda l, kk: tf._sample_logits(
+                            l[None], kk, temperature, top_k, top_p)[0]
+                    )(logits, subs)
+                return (cache, nxt, pos + 1, keys), nxt
+            (cache, tok, pos, keys), toks = jax.lax.scan(
+                body, (cache, tok, pos, keys), None, length=k)
+            return toks, cache, tok, pos, keys   # toks [k, B]
+        return jax.jit(chunk,
+                       donate_argnums=tf._serving_donate(1, 2, 3, 4))
+    return tf._serving_jit(
+        ("decode_pipeline", greedy, float(temperature), top_k, top_p,
+         k), cfg, build)
+
+
+def _jitted_lane_patch(cfg):
+    """Patch ONE lane of the device-resident (tok, pos, keys) carry —
+    the admission / lane-clear primitive of the pipelined batcher.
+    Runs as a tiny device program sequenced after whatever chunks are
+    in flight (it consumes the last dispatch's output buffers), so a
+    freed or freshly-admitted lane takes effect exactly at the next
+    dispatch boundary, with no host round trip."""
+    return tf._serving_jit("lane_patch", cfg, lambda fz: jax.jit(
+        lambda tok, pos, keys, i, t, p, key: (
+            tok.at[i].set(t), pos.at[i].set(p), keys.at[i].set(key)),
+        donate_argnums=tf._serving_donate(0, 1, 2)))
+
+
+def _jitted_admit_token(cfg, greedy, temperature, top_k, top_p):
+    """First generated token from the prefill logits, chosen ON
+    DEVICE: argmax under greedy, else generate()'s exact key chain
+    (key = PRNGKey(seed); split once; sample with the sub-key; carry
+    the key). The pipelined admit() pulls only this SCALAR to the
+    host — not the [vocab] logits row — and the returned key patches
+    straight into the key-chain carry."""
+    def build(fz):
+        def pick(last, seed):
+            if greedy:
+                return (jnp.argmax(last).astype(jnp.int32),
+                        jnp.zeros((2,), jnp.uint32))
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            first = tf._sample_logits(last[None], sub, temperature,
+                                      top_k, top_p)[0]
+            return first, jnp.asarray(key, jnp.uint32)
+        return jax.jit(pick)
+    return tf._serving_jit(
+        ("admit_token", greedy, float(temperature), top_k, top_p),
+        cfg, build)
+
+
 def _jitted_slot_write(cfg):
     """Write a 1-row prefilled cache into slot `i` of the pool cache.
 
@@ -166,15 +253,31 @@ class ContinuousBatcher(object):
     `cache_prefix(tokens)` prefills a shared prefix once (system
     prompt, few-shot preamble); admissions whose prompt starts with a
     cached prefix prefill only the suffix. LRU-bounded
-    (prefix_cache_slots row caches on device)."""
+    (prefix_cache_slots row caches on device).
+
+    `pipeline_depth=d` (d >= 2) turns on CHUNK PIPELINING: up to d
+    chunk dispatches ride in flight against the device-resident carry
+    (cache, lane tokens/positions, sample keys), and each step() syncs
+    only the OLDEST chunk's emissions — so the per-step host round
+    trip amortizes over d chunks instead of gating every one.
+    Admissions and evictions become tiny jitted lane patches applied
+    to the carry between dispatches (bounded staleness: a request
+    admitted while chunks are in flight enters at the NEXT dispatch
+    boundary; chunks already in flight keep advancing its lane's
+    previous occupant, whose emissions are discarded by request
+    identity at sync). Token streams are bit-identical to
+    pipeline_depth=1 and to solo generate() (tested). depth=1 is the
+    synchronous batcher, unchanged."""
 
     def __init__(self, params, cfg, max_batch=8, greedy=None,
                  temperature=1.0, top_k=None, top_p=None,
-                 chunk_size=1, prefix_cache_slots=4):
+                 chunk_size=1, prefix_cache_slots=4, pipeline_depth=1):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self.params = params
         self.cfg = cfg
         self.max_batch = int(max_batch)
@@ -190,12 +293,29 @@ class ContinuousBatcher(object):
                 "greedy=False (or omit greedy) to sample")
         self.greedy = greedy
         self.chunk_size = int(chunk_size)
+        self.pipeline_depth = int(pipeline_depth)
         self._controls = (self.greedy, float(temperature), top_k, top_p)
         self._cache = tf.init_cache(cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._tok = np.zeros((self.max_batch,), np.int32)
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
         self._slots = [None] * self.max_batch   # Request or None
+        if self.pipeline_depth > 1:
+            # device-resident lane carry (the host-side mirrors above
+            # go unused): tok/pos/keys live on device between
+            # dispatches, so a chunk dispatch uploads nothing and a
+            # chunk sync downloads only the [k, B] emissions
+            self._dev_tok = jnp.zeros((self.max_batch,), jnp.int32)
+            self._dev_pos = jnp.zeros((self.max_batch,), jnp.int32)
+            self._dev_keys = jnp.zeros((self.max_batch, 2), jnp.uint32)
+            # in-flight dispatches, oldest first: (emissions [k, B],
+            # per-lane rid snapshot at dispatch time)
+            self._inflight = deque()
+            # resolved once — a pipelined dispatch must not pay the
+            # _serving_jit registry lookup per chunk
+            self._pipe_fn = _jitted_pipeline_chunk(
+                cfg, *self._controls, self.chunk_size)
+            self._patch_fn = _jitted_lane_patch(cfg)
         self._next_rid = 0
         # prefix cache: tuple(tokens) -> (row_cache, last_row_logits),
         # LRU-bounded. Each entry holds one [1, max_len] row cache on
@@ -274,6 +394,7 @@ class ContinuousBatcher(object):
         returned stream)."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
+        t_admit = time.perf_counter() if _obs.enabled() else None
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         t_p = len(prompt)
         if t_p < 1:
@@ -307,26 +428,53 @@ class ContinuousBatcher(object):
                 self.params, row_cache, jnp.asarray(padded),
                 jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
             last = logits[0]
-        if self.greedy:
-            first = int(np.argmax(np.asarray(last)))
+        if self.pipeline_depth > 1:
+            # prefill-into-lane, all device-side: pick the first token
+            # on device (generate()'s exact chain), patch the row
+            # cache and the lane's (tok, pos, key) into the carry —
+            # the patches consume the LAST dispatch's output buffers,
+            # so they take effect at the next dispatch boundary while
+            # the chunks already in flight keep reading their own
+            # (older) buffers. The one host pull here is the first
+            # token SCALAR, not the [vocab] logits row.
+            first_dev, key = _jitted_admit_token(
+                self.cfg, *self._controls)(last, jnp.int32(seed))
+            with _obs.span("serving.patch", cat="serving", kind="admit",
+                           lane=slot):
+                self._cache = _jitted_slot_write(self.cfg)(
+                    self._cache, row_cache, jnp.int32(slot))
+                self._dev_tok, self._dev_pos, self._dev_keys = \
+                    self._patch_fn(self._dev_tok, self._dev_pos,
+                                   self._dev_keys, jnp.int32(slot),
+                                   first_dev, jnp.int32(t_p), key)
+            first = int(first_dev)
         else:
-            # mirror generate()'s chain: key=PRNGKey(seed); split once
-            # for the prefill token, carry the key into the step loop
-            key = jax.random.PRNGKey(seed)
-            key, sub = jax.random.split(key)
-            _, temperature, top_k, top_p = self._controls
-            first = int(tf._sample_logits(last[None], sub, temperature,
-                                          top_k, top_p)[0])
-            self._keys[slot] = np.asarray(key, np.uint32)
-        self._cache = _jitted_slot_write(self.cfg)(
-            self._cache, row_cache, jnp.int32(slot))
+            if self.greedy:
+                first = int(np.argmax(np.asarray(last)))
+            else:
+                # mirror generate()'s chain: key=PRNGKey(seed); split
+                # once for the prefill token, carry the key into the
+                # step loop
+                key = jax.random.PRNGKey(seed)
+                key, sub = jax.random.split(key)
+                _, temperature, top_k, top_p = self._controls
+                first = int(tf._sample_logits(last[None], sub,
+                                              temperature, top_k,
+                                              top_p)[0])
+                self._keys[slot] = np.asarray(key, np.uint32)
+            self._cache = _jitted_slot_write(self.cfg)(
+                self._cache, row_cache, jnp.int32(slot))
+            self._pos[slot] = t_p      # next decode writes position t_p
+            self._tok[slot] = first
         req = Request(self._next_rid, prompt, n_new, stop_token)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
         self._slots[slot] = req
-        self._pos[slot] = t_p          # next decode writes position t_p
-        self._tok[slot] = first
+        if t_admit is not None:
+            _obs.gauge("serving.admit_to_first_token_ms").set(
+                (time.perf_counter() - t_admit) * 1e3)
+            _obs.gauge("serving.lane_occupancy").set(self.active_count)
         return req.rid
 
     # ---- decode ----
@@ -339,7 +487,14 @@ class ContinuousBatcher(object):
         finished this step (their slots are freed). A request hitting
         its stop token or budget mid-chunk ends there — the lane's
         remaining in-chunk tokens are discarded and its slot frees at
-        the chunk boundary."""
+        the chunk boundary.
+
+        With pipeline_depth > 1 each step() keeps up to depth chunk
+        dispatches in flight and syncs only the oldest one — same
+        return contract, tokens arrive one dispatch later (bounded
+        staleness; see the class docstring)."""
+        if self.pipeline_depth > 1:
+            return self._step_pipelined()
         finished = {}
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
@@ -384,12 +539,93 @@ class ContinuousBatcher(object):
                 self._free(i)
         return finished
 
+    # ---- pipelined scheduling (pipeline_depth > 1) ----
+
+    def _step_pipelined(self):
+        """One pipelined scheduling step: top the dispatch window up
+        to `pipeline_depth` chunks (each issued against the previous
+        dispatch's device-resident carry — no host sync between
+        them), then sync ONLY the oldest chunk's emissions. The
+        synchronous round trip that gates every chunk at depth 1 thus
+        amortizes over `depth` chunks, which is the whole lever when
+        the chip sits behind a network tunnel (docs/SERVING.md)."""
+        finished = {}
+        # retire requests already complete at admission (n_new=1, or a
+        # stop token straight out of the prefill logits)
+        for i, req in enumerate(self._slots):
+            if req is not None and req.done:
+                finished[req.rid] = list(req.tokens)
+                self._free(i)
+        while (len(self._inflight) < self.pipeline_depth
+               and any(s is not None for s in self._slots)):
+            self._dispatch_chunk()
+        if self._inflight:
+            finished.update(self._sync_oldest())
+        if not any(s is not None for s in self._slots):
+            # nothing live: the remaining in-flight chunks only advance
+            # parked lanes, so their emissions belong to no request —
+            # drop the records (the device work itself is already
+            # queued and harmless)
+            self._inflight.clear()
+        return finished
+
+    def _dispatch_chunk(self):
+        """Issue one chunk against the device-resident carry and
+        snapshot which request owned each lane at dispatch time — the
+        identity that decides, at sync, whose stream each lane's
+        emissions belong to (a lane re-admitted mid-flight discards
+        the old occupant's in-flight tokens by rid mismatch)."""
+        with _obs.span("serving.dispatch", cat="serving",
+                       depth=len(self._inflight) + 1):
+            toks, cache, tok, pos, keys = self._pipe_fn(
+                self.params, self._cache, self._dev_tok,
+                self._dev_pos, self._dev_keys)
+        self._cache = cache
+        self._dev_tok, self._dev_pos, self._dev_keys = tok, pos, keys
+        self._inflight.append(
+            (toks, [r.rid if r is not None else None
+                    for r in self._slots]))
+        if _obs.enabled():
+            _obs.gauge("serving.inflight_depth").set(
+                len(self._inflight))
+            _obs.gauge("serving.lane_occupancy").set(self.active_count)
+
+    def _sync_oldest(self):
+        """Fetch the oldest in-flight chunk's emissions and credit
+        them to the requests that owned each lane when it was
+        DISPATCHED (and still do): evicted or re-admitted lanes are
+        discarded, a request ending mid-chunk keeps only its prefix.
+        This is the only host-blocking point of the pipelined loop."""
+        toks_dev, lanes = self._inflight.popleft()
+        with _obs.span("serving.sync", cat="serving",
+                       behind=len(self._inflight)):
+            toks = np.asarray(toks_dev).astype(np.int32)     # [k, B]
+        finished = {}
+        for i, rid in enumerate(lanes):
+            if rid is None:
+                continue
+            req = self._slots[i]
+            if req is None or req.rid != rid or req.done:
+                continue               # canceled / replaced mid-flight
+            for j in range(toks.shape[0]):
+                req.tokens.append(int(toks[j, i]))
+                req.emitted += 1
+                if req.done:
+                    break
+            if req.done:
+                finished[req.rid] = list(req.tokens)
+                self._free(i)
+        return finished
+
     def cancel(self, rid):
         """Evict a request mid-decode (client disconnect, timeout):
         frees its slot immediately for the next admission. Returns the
         tokens emitted so far, or None when `rid` is not active (never
         admitted, finished, or already canceled). The other lanes'
-        streams are untouched — eviction only parks the slot."""
+        streams are untouched — eviction only parks the slot. Under
+        pipelining "so far" means synced so far: tokens the lane
+        emitted in still-in-flight chunks are discarded at their sync
+        (rid mismatch), like any mid-flight identity change."""
         for i, req in enumerate(self._slots):
             if req is not None and req.rid == rid:
                 out = list(req.tokens)
@@ -401,10 +637,22 @@ class ContinuousBatcher(object):
         """Free slot i. Idle lanes keep decoding (static batch shape);
         parking them at position 0 means their garbage K/V lands where
         the next admission's prefill overwrites it — defense in depth
-        on top of the `attention <= pos` self-healing argument."""
+        on top of the `attention <= pos` self-healing argument. Under
+        pipelining the park is a device-side lane patch sequenced
+        after the in-flight chunks (whose writes to this lane are the
+        already-harmless idle-lane garbage)."""
         self._slots[i] = None
-        self._pos[i] = 0
-        self._tok[i] = 0
+        if self.pipeline_depth > 1:
+            with _obs.span("serving.patch", cat="serving", kind="park",
+                           lane=i):
+                self._dev_tok, self._dev_pos, self._dev_keys = \
+                    self._patch_fn(self._dev_tok, self._dev_pos,
+                                   self._dev_keys, jnp.int32(i),
+                                   jnp.int32(0), jnp.int32(0),
+                                   jnp.zeros((2,), jnp.uint32))
+        else:
+            self._pos[i] = 0
+            self._tok[i] = 0
 
     def _admit_job(self, job):
         """(prompt, n_new[, seed[, stop_token]]) -> rid or None."""

@@ -237,12 +237,34 @@ def _qkv(x, p):
     return q, k, v
 
 
+def _flash_min_seq():
+    """Sequence-length crossover for the flash-vs-dense dispatch below.
+
+    The only flash-vs-dense chip A/B so far has DENSE winning at
+    T=4096 (BENCH_TABLE `flash_attention`: fwd 16.51 ms dense vs 21.92
+    flash; fwd+bwd 37.17 vs 44.15), so a config that requests the
+    flash kernel still routes short sequences to the dense softmax and
+    engages the streamed kernel only where the [T, T] score matrix
+    stops fitting the bandwidth budget. 8192 is the first unmeasured
+    length above that datapoint ("dense dies past 4k" is a claim, not
+    a number — the T>=8192 sweep legs in run_chip_queue.py decide);
+    MXNET_FLASH_MIN_SEQ re-pins the crossover when they land."""
+    from .. import _fastenv
+    try:
+        return int(_fastenv.get("MXNET_FLASH_MIN_SEQ", "8192"))
+    except (TypeError, ValueError):
+        return 8192
+
+
 def _causal_attention(q, k, v, cfg, out_dtype):
     """Single-device causal attention over [B, T, H, D] — flash kernel
     (one block when T fits/divides 128, else gcd(T, 128)-sized blocks,
     so ANY sequence length works) or the dense masked softmax. Shared
-    by training forward and prefill."""
-    if cfg.use_flash_kernel:
+    by training forward and prefill. use_flash_kernel is a REQUEST,
+    not a route: sequences below the measured crossover
+    (MXNET_FLASH_MIN_SEQ, _flash_min_seq above) still take the dense
+    path, which the chip A/B has winning there."""
+    if cfg.use_flash_kernel and q.shape[1] >= _flash_min_seq():
         from ..kernels import flash_attention
         # block sizing (128 default, MXNET_FLASH_BLOCK_Q/K override,
         # clamp + gcd for short/odd sequences) lives in
@@ -657,11 +679,12 @@ def _serving_jit(kind, cfg, build):
     return fn
 
 
-def _serving_donate(argnum):
-    """Donation tuple for a serving entry point's fresh KV cache: saves
-    one HBM copy on accelerators; the CPU backend can't donate and
-    would warn on every call."""
-    return () if jax.default_backend() == "cpu" else (argnum,)
+def _serving_donate(*argnums):
+    """Donation tuple for a serving entry point's device-resident state
+    (KV cache, and the pipelined batcher's tok/pos/keys carry): saves
+    one HBM copy per donated arg on accelerators; the CPU backend can't
+    donate and would warn on every call."""
+    return () if jax.default_backend() == "cpu" else argnums
 
 
 def _jitted_prefill(cfg):

@@ -1,8 +1,16 @@
 """BaseModule — the training-loop contract.
 
-Reference: python/mxnet/module/base_module.py (BaseModule.fit:409,
-score:176, predict:305, forward_backward, bind/init_params/init_optimizer
-abstract surface).
+Reference counterpart: python/mxnet/module/base_module.py (fit:409,
+score:176, predict:305, and the bind/init_params/init_optimizer
+abstract surface). The SURFACE is the parity contract — every method
+name, argument and return shape below matches the reference so Module
+consumers port unchanged — but the loop internals are this repo's:
+epochs drive a pull-one-ahead batch walk (iterators may reuse their
+internal buffers per the MXNet contract, so the NEXT batch is fetched
+only after the current one is consumed), metrics/callbacks ride the
+shared BatchEndParam plumbing from model.py, and subclass hooks
+(_prepare_epoch — SVRG's full-gradient refresh rides it) are explicit
+rather than inlined special cases.
 """
 
 import logging
@@ -16,29 +24,47 @@ from .. import ndarray as nd
 from ..base import MXNetError
 from ..model import BatchEndParam
 
+# what a parameter (as opposed to a data/label input) looks like by
+# name — used only to shrink the did-you-mean candidate list below
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
 
 def _check_input_names(symbol, names, typename, throw):
-    """Verify every declared input name exists among the symbol's args."""
-    args = symbol.list_arguments()
-    param_suffixes = ("_weight", "_bias", "_gamma", "_beta")
+    """Every declared data/label/state name must be an argument of the
+    bound symbol; an unknown name is almost always a typo, so the
+    report lists the symbol's non-parameter arguments as candidates."""
+    known = symbol.list_arguments()
     for name in names:
-        if name in args:
+        if name in known:
             continue
-        candidates = [a for a in args if not a.endswith(param_suffixes)]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
+        inputs = [a for a in known if not a.endswith(_PARAM_SUFFIXES)]
+        msg = ("\033[91m%s_names=%s names '%s', which the symbol does "
+               "not take as an argument. Symbol inputs that exist: "
+               "%s\033[0m" % (typename, list(names), name,
+                              ", ".join(inputs) or "<none>"))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
-_END = object()   # sentinel: the data iterator is exhausted
+def _callbacks(cbs):
+    """Normalize a callback argument (None | callable | list) to a
+    flat list."""
+    if cbs is None:
+        return []
+    if isinstance(cbs, (list, tuple)):
+        return list(cbs)
+    return [cbs]
+
+
+_DRAINED = object()   # the data iterator has no batch left
 
 
 class BaseModule(object):
-    """base_module.py:64."""
+    """The abstract train/eval/predict surface (reference
+    base_module.py:64): subclasses supply bind/forward/backward/update
+    and the parameter plumbing; this class owns the loops that drive
+    them."""
 
     def __init__(self, logger=logging):
         self.logger = logger
@@ -63,7 +89,8 @@ class BaseModule(object):
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
-        """Run inference over eval_data and accumulate eval_metric."""
+        """Inference over ``eval_data``, accumulated into
+        ``eval_metric``; returns the metric's name/value pairs."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
@@ -71,62 +98,66 @@ class BaseModule(object):
             eval_metric = mx_metric.create(eval_metric)
         eval_metric.reset()
         nbatch = 0
-        for eval_batch in eval_data:
+        for batch in eval_data:
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            for callback in _as_list(batch_end_callback or []):
-                callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals()))
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            for cb in _callbacks(batch_end_callback):
+                cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=eval_metric,
+                                 locals=locals()))
             nbatch += 1
-        for callback in _as_list(score_end_callback or []):
-            callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                   eval_metric=eval_metric, locals=locals()))
+        for cb in _callbacks(score_end_callback):
+            cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """base_module.py:262."""
+        """Generator over ``(outputs_without_pad, nbatch, batch)``
+        (reference base_module.py:262): each batch's outputs are
+        sliced down to the real rows before they are yielded, so pad
+        rows never leak into downstream accumulation."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield outputs, nbatch, eval_batch
+            self.forward(batch, is_train=False)
+            yield ([out[0:out.shape[0] - batch.pad]
+                    for out in self.get_outputs()], nbatch, batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False, sparse_row_id_fn=None):
-        """Forward over the data and collect (optionally merged) outputs."""
+        """Forward over ``eval_data`` and collect outputs. A bare
+        array runs as one batch; an iterator accumulates per-batch
+        output lists, concatenated along batch when ``merge_batches``
+        (a single merged output unwraps from its list unless
+        ``always_output_list``)."""
         assert self.binded and self.params_initialized
         if isinstance(eval_data, (nd.NDArray, np.ndarray)):
-            if isinstance(eval_data, np.ndarray):
-                eval_data = nd.array(eval_data)
-            self.forward(mx_io.DataBatch([eval_data]), is_train=False)
+            one = nd.array(eval_data) if isinstance(eval_data, np.ndarray) \
+                else eval_data
+            self.forward(mx_io.DataBatch([one]), is_train=False)
             return self.get_outputs()[0]
         if not isinstance(eval_data, mx_io.DataIter):
             raise ValueError("eval_data must be of type NDArray or DataIter")
-        per_batch = [
-            [out.copy() for out in outputs]
-            for outputs, _, _ in self.iter_predict(eval_data,
-                                                   num_batch=num_batch,
-                                                   reset=reset)]
-        if not per_batch or not merge_batches:
-            return per_batch
-        num_outputs = len(per_batch[0])
-        if any(len(outs) != num_outputs for outs in per_batch):
+        collected = [
+            [out.copy() for out in outs]
+            for outs, _, _ in self.iter_predict(eval_data,
+                                                num_batch=num_batch,
+                                                reset=reset)]
+        if not collected or not merge_batches:
+            return collected
+        width = len(collected[0])
+        if any(len(outs) != width for outs in collected):
             raise AssertionError(
                 "Cannot merge batches, as num of outputs is not the same "
                 "in mini-batches. Maybe bucketing is used?")
-        merged = [nd.concatenate([outs[i] for outs in per_batch])
-                  for i in range(num_outputs)]
-        if num_outputs == 1 and not always_output_list:
-            return merged[0]
-        return merged
+        merged = [nd.concatenate([outs[i] for outs in collected])
+                  for i in range(width)]
+        return merged if width > 1 or always_output_list else merged[0]
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -136,7 +167,9 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """The canonical training loop."""
+        """The canonical training loop: bind, initialize, then
+        ``num_epoch`` passes of step/metric/callback with optional
+        per-epoch validation."""
         from .. import initializer as init_mod
         assert num_epoch is not None, "please specify number of epochs"
 
@@ -156,7 +189,7 @@ class BaseModule(object):
         validation_metric = validation_metric or eval_metric
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            started = time.time()
             eval_metric.reset()
             self._prepare_epoch(epoch - begin_epoch, train_data)
             self._run_epoch(train_data, eval_metric, epoch, monitor,
@@ -164,20 +197,21 @@ class BaseModule(object):
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
+                             time.time() - started)
 
-            # sync a consistent host-side snapshot of the params
+            # one consistent host-side parameter snapshot per epoch:
+            # checkpoint callbacks and the device state must agree
             arg_snap, aux_snap = self.get_params()
             self.set_params(arg_snap, aux_snap)
-            for callback in _as_list(epoch_end_callback or []):
-                callback(epoch, self.symbol, arg_snap, aux_snap)
+            for cb in _callbacks(epoch_end_callback):
+                cb(epoch, self.symbol, arg_snap, aux_snap)
 
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
 
@@ -191,36 +225,37 @@ class BaseModule(object):
                    batch_end_callback, sparse_row_id_fn):
         """One pass over train_data: step, metric, callbacks per batch.
 
-        The next batch is pulled only AFTER the current one is consumed —
-        iterators following the MXNet contract may reuse their internal
-        buffers on every next() call.
+        Walks the iterator one batch AHEAD of consumption — prepare()
+        sees the upcoming batch (sparse row-id hints) while the
+        current one is still the module's live input — but never pulls
+        batch n+1 before batch n is fully consumed: MXNet-contract
+        iterators may recycle their internal buffers on every next().
         """
-        data_iter = iter(train_data)
-        batch = next(data_iter, _END)
+        feed = iter(train_data)
+        current = next(feed, _DRAINED)
         nbatch = 0
-        while batch is not _END:
+        while current is not _DRAINED:
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(batch)
+            self.forward_backward(current)
             self.update()
-            if isinstance(batch, list):
-                self.update_metric(eval_metric, [b.label for b in batch],
+            if isinstance(current, list):
+                self.update_metric(eval_metric,
+                                   [b.label for b in current],
                                    pre_sliced=True)
             else:
-                self.update_metric(eval_metric, batch.label)
-            upcoming = next(data_iter, _END)
-            if upcoming is not _END:
+                self.update_metric(eval_metric, current.label)
+            upcoming = next(feed, _DRAINED)
+            if upcoming is not _DRAINED:
                 self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
             if monitor is not None:
                 monitor.toc_print()
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
+            for cb in _callbacks(batch_end_callback):
+                cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=eval_metric,
+                                 locals=locals()))
             nbatch += 1
-            batch = upcoming
+            current = upcoming
 
     # ------------------------------------------------- symbol/params API --
     @property
@@ -261,24 +296,28 @@ class BaseModule(object):
                          force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
+        """One flat file of ``arg:<name>`` / ``aux:<name>`` entries —
+        the reference's checkpoint key convention, which load_params
+        (and the reference's own loader) round-trips."""
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        blob = {"arg:" + name: value for name, value in arg_params.items()}
+        blob.update({"aux:" + name: value
+                     for name, value in aux_params.items()})
+        nd.save(fname, blob)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
+        args, auxs = {}, {}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                args[name] = value
+            elif kind == "aux":
+                auxs[name] = value
             else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
+                raise ValueError(
+                    "Invalid param file %s: key %r is neither arg: "
+                    "nor aux:" % (fname, key))
+        self.set_params(args, auxs)
 
     def get_states(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -325,6 +364,7 @@ class BaseModule(object):
 
 
 def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return obj
-    return [obj]
+    """Kept under the reference helper's name for external callers:
+    anything not already a list/tuple is wrapped (None included —
+    unlike _callbacks, which treats None as 'no callbacks')."""
+    return obj if isinstance(obj, (list, tuple)) else [obj]

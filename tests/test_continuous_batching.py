@@ -514,6 +514,222 @@ def test_chunked_churn_matches_oracle():
                                       np.asarray(want[0]))
 
 
+@pytest.mark.parametrize("depth,chunk", [(2, 1), (3, 1), (2, 3)])
+def test_pipelined_matches_sync_and_generate(depth, chunk):
+    """Chunk pipelining (pipeline_depth>1) emits BIT-IDENTICAL greedy
+    streams to the synchronous pool and to solo generate(), across
+    depths and chunk sizes — the depth>1 vs depth=1 identity
+    contract."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    rng = np.random.RandomState(1)
+    jobs = [(p, int(rng.randint(1, 10))) for p in _prompts(rng, 6)]
+    sync, order_s = ContinuousBatcher(
+        params, cfg, max_batch=3, chunk_size=chunk).run(jobs)
+    pipe, order_p = ContinuousBatcher(
+        params, cfg, max_batch=3, chunk_size=chunk,
+        pipeline_depth=depth).run(jobs)
+    assert len(pipe) == len(jobs)
+    for rs, rp, (prompt, n_new) in zip(order_s, order_p, jobs):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n_new, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(pipe[rp]), np.asarray(want[0]),
+            err_msg="depth %d chunk %d" % (depth, chunk))
+        assert sync[rs] == pipe[rp]
+
+
+def test_pipelined_sampling_bit_identical():
+    """The per-row key chain survives pipelining: sampled streams are
+    identical at depth 1 and depth 2 (and therefore to solo
+    generate(seed), which depth 1 is tested against)."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=17)
+    rng = np.random.RandomState(6)
+    jobs = [(p, int(rng.randint(2, 8)), 100 + i)
+            for i, p in enumerate(_prompts(rng, 5))]
+    out = {}
+    for depth in (1, 2):
+        srv = ContinuousBatcher(params, cfg, max_batch=2,
+                                temperature=0.8, top_k=20,
+                                pipeline_depth=depth)
+        results, order = srv.run(jobs)
+        out[depth] = [results[rid] for rid in order]
+    for a, b in zip(out[1], out[2]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipelined_admission_staleness():
+    """A request admitted while chunks are in flight enters at the
+    NEXT dispatch boundary — the in-flight chunks keep decoding the
+    lane's previous occupant and none of their emissions leak into the
+    new stream, which stays bit-exact vs solo generate()."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=7)
+    rng = np.random.RandomState(3)
+    p1, p2 = _prompts(rng, 2)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=3)
+    r1 = srv.admit(p1, 10)
+    done = {}
+    done.update(srv.step())             # window fills to depth 3
+    assert len(srv._inflight) > 0
+    r2 = srv.admit(p2, 5)               # admitted MID-FLIGHT
+    # the staleness rule, observable: no chunk already in flight may
+    # carry the new request's lane identity
+    assert all(r2 not in lanes for _, lanes in srv._inflight)
+    while r1 not in done or r2 not in done:
+        done.update(srv.step())
+    for rid, prompt, n in ((r1, p1, 10), (r2, p2, 5)):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(np.asarray(done[rid]),
+                                      np.asarray(want[0]))
+
+
+def test_pipelined_mid_flight_eviction():
+    """cancel() with chunks in flight: the canceled stream is a prefix
+    of its solo run (in-flight emissions discarded by rid identity),
+    the slot frees for a new admission whose stream is exact, and the
+    surviving lane is untouched."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=21)
+    rng = np.random.RandomState(7)
+    p1, p2, p3 = _prompts(rng, 3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    r1 = srv.admit(p1, 12)
+    r2 = srv.admit(p2, 12)
+    done = {}
+    done.update(srv.step())
+    done.update(srv.step())
+    assert len(srv._inflight) > 0       # eviction happens mid-flight
+    partial = srv.cancel(r1)
+    assert partial is not None
+    assert srv.cancel(r1) is None       # double-cancel is a no-op
+    r3 = srv.admit(p3, 5)               # reuses the evicted slot
+    assert r3 is not None
+    while r2 not in done or r3 not in done:
+        done.update(srv.step())
+    for rid, prompt, n in ((r2, p2, 12), (r3, p3, 5)):
+        want = tf.generate(params, jnp.asarray([prompt], jnp.int32),
+                           n, cfg)
+        np.testing.assert_array_equal(np.asarray(done[rid]),
+                                      np.asarray(want[0]))
+    want1 = np.asarray(tf.generate(
+        params, jnp.asarray([p1], jnp.int32), 12, cfg)[0])
+    np.testing.assert_array_equal(np.asarray(partial),
+                                  want1[:len(partial)])
+
+
+def test_pipelined_stream_stop_token_and_churn():
+    """stream() + stop tokens + randomized churn on a pipelined pool:
+    completed streams equal the solo oracle, canceled streams are
+    prefixes, stop tokens end requests with in-chunk tails discarded,
+    and the pool drains."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    prompt = [5, 9, 2]
+    ref = [int(t) for t in np.asarray(
+        tf.generate(params, jnp.asarray([prompt], jnp.int32), 12,
+                    cfg)[0])][len(prompt):]
+    stop = ref[5]
+    want = ref[:ref.index(stop) + 1]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, chunk_size=4,
+                            pipeline_depth=2)
+    events = list(srv.stream([(prompt, 12, 0, stop)]))
+    assert [t for _, t, _ in events] == want
+    assert [d for _, _, d in events] == \
+        [False] * (len(want) - 1) + [True]
+    # churn: admit/cancel/step interleaved on a deeper pipeline
+    rng = np.random.RandomState(10)
+    srv = ContinuousBatcher(params, cfg, max_batch=3, pipeline_depth=3)
+    spec, done, canceled, live = {}, {}, {}, []
+    pending = [(list(rng.randint(1, 211, rng.randint(3, 20))),
+                int(rng.randint(1, 12))) for _ in range(10)]
+    while pending or live:
+        action = rng.randint(0, 4)
+        if action == 0 and pending and srv.has_capacity:
+            prompt, n = pending.pop()
+            rid = srv.admit(prompt, n)
+            spec[rid] = (prompt, n)
+            live.append(rid)
+        elif action == 1 and live and rng.rand() < 0.3:
+            rid = live[rng.randint(len(live))]
+            canceled[rid] = srv.cancel(rid)
+            live.remove(rid)
+        else:
+            for rid, toks in srv.step().items():
+                done[rid] = toks
+                live.remove(rid)
+    assert srv.active_count == 0
+    assert set(done) | set(canceled) == set(spec)
+    for rid, (prompt, n) in spec.items():
+        want = np.asarray(tf.generate(
+            params, jnp.asarray([prompt], jnp.int32), n, cfg)[0])
+        got = np.asarray(done.get(rid, canceled.get(rid)))
+        np.testing.assert_array_equal(got, want[:len(got)],
+                                      err_msg="rid %d" % rid)
+        if rid in done:
+            assert len(got) == len(want)
+
+
+def test_pipelined_prefix_cache_streams_exact():
+    """Prefix-cached admissions (suffix-only prefill, incl. the
+    exact-match fast path) compose with pipelining: streams equal solo
+    generate() under greedy and sampled chains."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    system = [7, 3, 9, 1, 4]
+    jobs = [(system + [11, 22], 8), ([5, 6], 6), (system, 5)]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    srv.cache_prefix(system)
+    results, order = srv.run(jobs)
+    for rid, (p, n) in zip(order, jobs):
+        want = tf.generate(params, jnp.asarray([p], jnp.int32), n, cfg)
+        np.testing.assert_array_equal(np.asarray(results[rid]),
+                                      np.asarray(want[0]))
+    srv2 = ContinuousBatcher(params, cfg, max_batch=2, temperature=0.7,
+                             top_k=13, pipeline_depth=2)
+    srv2.cache_prefix([2, 4, 6, 8])
+    rid = srv2.admit([2, 4, 6, 8], 5, seed=9)   # exact-match admission
+    out = {}
+    while srv2.active_count:
+        out.update(srv2.step())
+    want = tf.generate(params, jnp.asarray([[2, 4, 6, 8]], jnp.int32),
+                       5, cfg, temperature=0.7, top_k=13, seed=9)
+    np.testing.assert_array_equal(np.asarray(out[rid]),
+                                  np.asarray(want[0]))
+
+
+def test_pipelined_obs_spans_and_zero_when_off():
+    """With telemetry on, the pipelined pool records dispatch/sync/
+    patch spans and depth/occupancy gauges; with it off, a serving run
+    leaves the ring untouched (the one-guarded-branch contract)."""
+    from mxnet_tpu.observability import core as obs
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    jobs = [([4, 7, 2], 4), ([9, 1], 3)]
+    obs.reset()
+    obs.set_enabled(False)
+    try:
+        ContinuousBatcher(params, cfg, max_batch=2,
+                          pipeline_depth=2).run(jobs)
+        assert obs.records() == [] and obs.counters() == {}
+        obs.set_enabled(True)
+        ContinuousBatcher(params, cfg, max_batch=2,
+                          pipeline_depth=2).run(jobs)
+        names = {r[1] for r in obs.records()}
+        for needed in ("serving.dispatch", "serving.sync",
+                       "serving.patch", "serving.inflight_depth",
+                       "serving.lane_occupancy",
+                       "serving.admit_to_first_token_ms"):
+            assert needed in names, needed
+    finally:
+        obs.set_enabled(None)
+        obs.reset()
+    with pytest.raises(ValueError):
+        ContinuousBatcher(params, cfg, pipeline_depth=0)
+
+
 def test_prefix_cache_streams_equal_no_prefix():
     """Shared-prefix admission (suffix-only prefill) emits the same
     streams as the pool without prefix caching and as solo

@@ -83,7 +83,11 @@ def test_flash_attention_gcd_adjusts_ragged_blocks():
         flash_attention(z, z, z, block_q=16, block_k=16)
 
 
-def test_transformer_flash_kernel_matches_dense_path():
+def test_transformer_flash_kernel_matches_dense_path(monkeypatch):
+    # pin the crossover to 0 so T=32 actually exercises the kernel
+    # (the shipped default routes short sequences dense — see
+    # test_flash_crossover_dispatch)
+    monkeypatch.setenv("MXNET_FLASH_MIN_SEQ", "0")
     from mxnet_tpu.models import transformer as T
     cfg_dense = T.TransformerConfig(
         vocab_size=50, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_len=32,
@@ -100,6 +104,50 @@ def test_transformer_flash_kernel_matches_dense_path():
     flash = T.forward(params, toks, cfg_flash)
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_crossover_dispatch(monkeypatch):
+    """use_flash_kernel is a request, not a route: sequences below
+    MXNET_FLASH_MIN_SEQ take the dense softmax (the chip A/B has dense
+    winning at T=4096), sequences at/above it take the kernel — and
+    BOTH route choices produce the same numbers as the dense config."""
+    import mxnet_tpu.kernels as kernels
+    from mxnet_tpu.models import transformer as T
+    calls = []
+    real = kernels.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(kernels, "flash_attention", spy)
+    kw = dict(vocab_size=50, d_model=32, n_heads=2, n_layers=1,
+              d_ff=64, max_len=32, dp_axis=None, tp_axis=None,
+              sp_axis=None, ep_axis=None, use_ring_attention=False)
+    cfg_dense = T.TransformerConfig(use_flash_kernel=False, **kw)
+    cfg_flash = T.TransformerConfig(use_flash_kernel=True, **kw)
+    params = T.init_params(cfg_dense, seed=3)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 50, (2, 32)))
+    dense = np.asarray(T.forward(params, toks, cfg_dense))
+
+    # default crossover (8192): T=32 must route DENSE despite the
+    # flash request — no kernel call, identical numbers
+    assert T._flash_min_seq() == 8192
+    below = T.forward(params, toks, cfg_flash)
+    assert not calls
+    np.testing.assert_allclose(np.asarray(below), dense, rtol=2e-4,
+                               atol=2e-4)
+
+    # crossover at/below T: the kernel engages, numerics still match
+    monkeypatch.setenv("MXNET_FLASH_MIN_SEQ", "32")
+    above = T.forward(params, toks, cfg_flash)
+    assert calls
+    np.testing.assert_allclose(np.asarray(above), dense, rtol=2e-4,
+                               atol=2e-4)
+
+    # malformed env falls back to the default rather than crashing
+    monkeypatch.setenv("MXNET_FLASH_MIN_SEQ", "not-a-number")
+    assert T._flash_min_seq() == 8192
 
 
 def test_pallas_module_consumer():

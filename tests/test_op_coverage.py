@@ -1010,15 +1010,13 @@ CASES["_image_random_flip_top_bottom"] = _flip_case(
 
 # ----------------------------------------------------------------------
 # Genuinely-hard waivers (each with a one-line reason). Gate fails if
-# this list grows past 30.
+# this list grows past 30. EMPTY since the last two — the stochastic
+# dgl graph-sampling ops — got seeded distributional/exact oracles
+# (test_op_parity.py: test_dgl_neighbor_sample_uniform_chi_square,
+# test_dgl_subgraph_exact_induced_oracle). Every registered op now has
+# a numeric test; a new op cannot land without one.
 # ----------------------------------------------------------------------
-ALLOWLIST = {
-    # stochastic sampling over graph structure: output is a random
-    # subgraph, no closed-form oracle; exercised for shape/validity in
-    # test_contrib_extras.py dgl tests via their public aliases
-    "_contrib_dgl_csr_neighbor_uniform_sample",
-    "_contrib_dgl_subgraph",
-}
+ALLOWLIST = set()
 
 
 def _scanned_covered():

@@ -512,6 +512,88 @@ def test_samplers_pass_chi_square():
         assert p > 1e-4, "%s sampler failed chi-square (p=%g)" % (name, p)
 
 
+def test_dgl_neighbor_sample_uniform_chi_square():
+    """Seeded distributional oracle for the stochastic dgl neighbor
+    sampler (the last op-coverage waiver class, closed here): with
+    num_neighbor=2 drawn from a degree-8 vertex, every neighbor must
+    be selected with equal probability — chi-square over the selection
+    counts, the test_samplers_pass_chi_square pattern applied to
+    sampling over graph structure. The without-replacement draws are
+    negatively correlated within a call, which only SHRINKS the
+    statistic under true uniformity — the test stays conservative
+    while still catching any biased neighbor choice."""
+    from scipy import stats
+    deg, pick, trials = 8, 2, 400
+    # star graph: vertex 0 -> {1..8}; leaves have no out-edges
+    indptr = nd.array(np.array([0, deg] + [deg] * deg, np.float32))
+    indices = nd.array(np.arange(1, deg + 1).astype(np.float32))
+    seeds = nd.array(np.array([0], np.float32))
+    mx.random.seed(1234)
+    counts = np.zeros(deg)
+    for _ in range(trials):
+        (out,) = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            indptr, indices, seeds, num_args=3, num_hops=1,
+            num_neighbor=pick, max_num_vertices=16)
+        vec = out.asnumpy()
+        n = int(vec[-1])              # layout: count rides the tail
+        assert n == 1 + pick
+        assert vec[0] == 0            # the seed vertex leads the list
+        chosen = vec[1:n]
+        assert len(set(chosen.tolist())) == pick    # no replacement
+        for v in chosen:
+            assert 1 <= v <= deg
+            counts[int(v) - 1] += 1
+    exp = np.full(deg, trials * pick / deg)
+    _, p = stats.chisquare(counts, exp)
+    assert p > 1e-4, "neighbor sampling not uniform (p=%g, %s)" \
+        % (p, counts.tolist())
+    # the chain is seed-deterministic: reseeding replays the draws
+    mx.random.seed(77)
+    a = [nd.contrib.dgl_csr_neighbor_uniform_sample(
+        indptr, indices, seeds, num_args=3, num_hops=1,
+        num_neighbor=pick, max_num_vertices=16)[0].asnumpy()
+        for _ in range(3)]
+    mx.random.seed(77)
+    b = [nd.contrib.dgl_csr_neighbor_uniform_sample(
+        indptr, indices, seeds, num_args=3, num_hops=1,
+        num_neighbor=pick, max_num_vertices=16)[0].asnumpy()
+        for _ in range(3)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_dgl_subgraph_exact_induced_oracle():
+    """dgl_subgraph against a numpy recomputation of the vertex-
+    induced subgraph (the op is deterministic — its former waiver was
+    guilt by association with the sampler): edges survive iff both
+    endpoints sit in the vertex set, renumbered by set position."""
+    rng = np.random.RandomState(3)
+    n = 12
+    adj = (rng.rand(n, n) < 0.3).astype(np.int64)
+    np.fill_diagonal(adj, 0)
+    indptr_np = np.zeros(n + 1, np.int64)
+    indices_np = []
+    for v in range(n):
+        nbrs = np.nonzero(adj[v])[0]
+        indices_np.extend(nbrs.tolist())
+        indptr_np[v + 1] = len(indices_np)
+    indptr = nd.array(indptr_np.astype(np.float32))
+    indices = nd.array(np.array(indices_np, np.float32))
+    for vset in ([0, 3, 4, 7], [2, 5], list(range(n))):
+        got = nd.contrib.dgl_subgraph(
+            indptr, indices, nd.array(np.array(vset, np.float32)))
+        sub_indptr, sub_indices = (g.asnumpy() for g in got)
+        remap = {v: i for i, v in enumerate(vset)}
+        want_ptr, want_idx = [0], []
+        for v in vset:
+            for u in indices_np[indptr_np[v]:indptr_np[v + 1]]:
+                if int(u) in remap:
+                    want_idx.append(remap[int(u)])
+            want_ptr.append(len(want_idx))
+        np.testing.assert_array_equal(sub_indptr, want_ptr)
+        np.testing.assert_array_equal(sub_indices, want_idx)
+
+
 def test_roi_align_border_rule_and_oracle():
     """ROIAlign vs a numpy transcription of its contract (fixed 2x2
     sample grid per bin, reference border rule: zero beyond one pixel

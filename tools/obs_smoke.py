@@ -24,6 +24,13 @@ and the parent merges them with ``observability.merge_traces`` and
 validates that the merged chrome trace carries BOTH rank lanes:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --nproc 2
+
+``--serving`` runs the serving half (ISSUE 5): a pipelined
+ContinuousBatcher serves a couple of requests and the emitted trace
+must carry the dispatch/sync/patch spans plus the in-flight-depth /
+lane-occupancy / admit-latency gauges:
+
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --serving
 """
 
 import argparse
@@ -134,6 +141,48 @@ def ops_smoke():
     return 0
 
 
+def serving_smoke():
+    """--serving: one pipelined serving step must land its spans and
+    gauges in the emitted chrome trace (the ISSUE 5 obs acceptance
+    path: dispatch/sync/patch + depth/occupancy/admit-latency)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+
+    cfg = tf.TransformerConfig(vocab_size=97, d_model=16, n_heads=2,
+                               n_layers=1, d_ff=32, max_len=48,
+                               dtype=jnp.float32)
+    params = tf.init_params(cfg, seed=0)
+    rng = np.random.RandomState(0)
+    jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(3)]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    results, order = srv.run(jobs)
+    if len(results) != len(jobs):
+        print("[obs_smoke] FAIL: serving pool lost requests")
+        return 1
+
+    fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_srv_"),
+                         "trace.json")
+    mx.profiler.set_config(filename=fname, xla_trace=False)
+    path = mx.profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    required = {"serving.dispatch", "serving.sync", "serving.patch",
+                "serving.inflight_depth", "serving.lane_occupancy",
+                "serving.admit_to_first_token_ms"}
+    missing = required - names
+    if missing:
+        print("[obs_smoke] FAIL: serving trace missing: %s"
+              % sorted(missing))
+        return 1
+    print("[obs_smoke] serving trace OK: %d events -> %s"
+          % (len(trace["traceEvents"]), path))
+    return 0
+
+
 def worker():
     """One rank of the --nproc job (re-entered via tools/launch.py)."""
     from mxnet_tpu import parallel
@@ -206,9 +255,16 @@ def main():
                    help="run the per-operator attribution smoke "
                         "instead: block scopes must appear in the "
                         "emitted trace with >=90%% cost attribution")
+    p.add_argument("--serving", action="store_true",
+                   help="run the serving smoke instead: a pipelined "
+                        "ContinuousBatcher step's dispatch/sync/patch "
+                        "spans and depth/occupancy gauges must reach "
+                        "the emitted trace")
     args = p.parse_args()
     if os.environ.get("OBS_SMOKE_WORKER"):
         return worker()
+    if args.serving:
+        return serving_smoke()
     if args.ops:
         return ops_smoke()
     if args.nproc > 1:
