@@ -9,6 +9,7 @@
 #
 #   ./ci/tier1.sh            # tier-1 suite + dispatch smoke
 #   TIER1_OBS=1 ./ci/tier1.sh  # + MXNET_OBS=1 telemetry smoke lane
+#   TIER1_CHAOS=1 ./ci/tier1.sh  # + fault-injection recovery smoke lane
 #
 # (The full matrix — examples smoke, driver contract, bench — stays in
 # ci/run.sh; this is the cheap gate every PR must keep green.)
@@ -87,6 +88,21 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
     # in the emitted trace (docs/SERVING.md chunk pipelining)
     if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --serving; then
         echo "[tier1] FAIL: serving observability smoke"
+        exit 1
+    fi
+fi
+
+if [ "${TIER1_CHAOS:-0}" = "1" ]; then
+    echo "==== [tier1] chaos smoke (one injected fault per class, recovery asserted) ===="
+    # docs/ROBUSTNESS.md recovery matrix, exercised end to end: NaN
+    # grad -> step guard skip (weights bit-identical), io read error ->
+    # retry, serving dispatch failure -> lane free + requeue
+    # (bit-exact streams), collective hang -> watchdog post-mortem +
+    # emergency checkpoint + abort(43), SIGTERM -> emergency save
+    # (exit 143), hard crash -> resume-from-latest with a bit-exact
+    # loss trajectory. Serial like everything else on the 1-core host.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py; then
+        echo "[tier1] FAIL: chaos smoke"
         exit 1
     fi
 fi

@@ -48,29 +48,42 @@ def build(args):
 
 
 def worker(args):
-    """One (re)startable training run: resume if a checkpoint exists,
-    train to --steps, checkpoint every --ckpt-every, optionally crash
-    hard after the step --crash-after."""
+    """One (re)startable training run: resume from the newest loadable
+    checkpoint (corrupt ones fall back — docs/ROBUSTNESS.md), train to
+    --steps, checkpoint every --ckpt-every retaining the previous one,
+    optionally crash hard after the step --crash-after. A SIGTERM
+    (preemption notice) commits a best-effort emergency checkpoint of
+    the CURRENT step before exiting."""
     import jax
     from mxnet_tpu.models import transformer as T
-    from mxnet_tpu.models.checkpoint import (save_checkpoint,
-                                             restore_train_state)
+    from mxnet_tpu.models.checkpoint import (
+        save_checkpoint, resume_from_latest,
+        install_emergency_checkpoint)
 
     mesh, cfg, tokens = build(args)
-    if os.path.exists(os.path.join(args.ckpt_dir, "manifest.json")):
-        cfg, params, mom, start = restore_train_state(args.ckpt_dir, mesh)
+
+    def fresh():
+        p = T.shard_params(T.init_params(cfg, seed=0), cfg, mesh)
+        return cfg, p, T.shard_params(T.init_momentum(p), cfg, mesh), 0
+
+    cfg, params, mom, start = resume_from_latest(args.ckpt_dir, mesh,
+                                                 init=fresh)
+    if start:
         print("resumed from step %d" % start, flush=True)
-    else:
-        params = T.shard_params(T.init_params(cfg, seed=0), cfg, mesh)
-        mom = T.shard_params(T.init_momentum(params), cfg, mesh)
-        start = 0
+
+    live = {"params": params, "mom": mom, "step": start}
+    install_emergency_checkpoint(
+        args.ckpt_dir,
+        lambda: {"cfg": cfg, "params": live["params"],
+                 "momentum": live["mom"], "step": live["step"]})
 
     step_fn = T.make_train_step(cfg, mesh, lr=0.1)
     for step in range(start + 1, args.steps + 1):
         params, mom, loss = step_fn(params, mom, tokens)
+        live.update(params=params, mom=mom, step=step)
         if step % args.ckpt_every == 0 or step == args.steps:
             save_checkpoint(args.ckpt_dir, cfg, params, momentum=mom,
-                            step=step)
+                            step=step, keep=2)
         print("step %d loss %.5f" % (step, float(loss)), flush=True)
         if args.crash_after is not None and step >= args.crash_after:
             print("simulating crash (SIGKILL semantics)", flush=True)

@@ -21,6 +21,7 @@ which cuts per-replica optimizer state by (N-1)/N.
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
+from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
 from ..observability import recompile as _obs_recompile
@@ -140,6 +141,21 @@ class Trainer(object):
         self._ready()
         with _obs.span("trainer.step", cat="step"):
             self._optimizer.rescale_grad = self._scale / batch_size
+            if _chaos.enabled():
+                # chaos site: a "nan" rule poisons this step's local
+                # gradients — the fault the step guard below exists for
+                _chaos.poison_ndarrays(
+                    "trainer.grads",
+                    [p.grad() for _, p in self._trainable()
+                     if p._data is not None])
+            if _chaos.step_guard_enabled() and not self._grads_finite():
+                # non-finite loss/grads: skip allreduce AND update (the
+                # update may live inside the store), back off the AMP
+                # loss scale when one rides the trainer, and count the
+                # skip — one bad batch must never poison the weights
+                _chaos.count_skipped_step(
+                    "trainer", getattr(self, "_amp_loss_scaler", None))
+                return
             self._allreduce_grads()
             # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer):
             # check overflow, fold 1/scale into the update, skip the
@@ -162,6 +178,13 @@ class Trainer(object):
     def allreduce_grads(self):
         self._ready()
         self._allreduce_grads()
+
+    def _grads_finite(self):
+        """Device-side finiteness verdict over this step's gradients
+        (one scalar sync). Only consulted when MXNET_STEP_GUARD=1."""
+        return _chaos.all_finite(
+            [p.grad()._data for _, p in self._trainable()
+             if p._data is not None])
 
     def _trainable(self):
         """(kvstore slot, param) for every param that receives grads."""

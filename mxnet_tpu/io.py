@@ -18,14 +18,66 @@ import os
 import struct
 import queue
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
+from . import _fastenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import recordio
+from .observability import chaos as _chaos
 from .observability import core as _obs
+
+
+DEFAULT_IO_RETRIES = 3
+DEFAULT_IO_BACKOFF_MS = 50.0
+_IO_BACKOFF_CAP_S = 1.0
+
+
+def _io_retries():
+    """MXNET_IO_RETRIES: transient-read retries per operation
+    (default 3; 0 disables retrying but keeps the enriched error)."""
+    try:
+        return max(int(_fastenv.get("MXNET_IO_RETRIES",
+                                    DEFAULT_IO_RETRIES)), 0)
+    except (TypeError, ValueError):
+        return DEFAULT_IO_RETRIES
+
+
+def _retry_read(fn, what, path=None, index=None):
+    """Run one read, retrying transient failures (OSError — which
+    includes injected ChaosError) with capped exponential backoff:
+    MXNET_IO_RETRIES attempts after the first, MXNET_IO_BACKOFF_MS
+    initial delay doubling up to 1 s. After exhaustion the error is
+    re-raised naming the operation, path, and batch index — a dying
+    pipeline must say WHERE it died. ``fn`` must be idempotent."""
+    retries = _io_retries()
+    try:
+        delay = float(_fastenv.get("MXNET_IO_BACKOFF_MS",
+                                   DEFAULT_IO_BACKOFF_MS)) / 1e3
+    except (TypeError, ValueError):
+        delay = DEFAULT_IO_BACKOFF_MS / 1e3
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except OSError as exc:
+            if attempt >= retries:
+                raise IOError(
+                    "%s failed after %d attempt(s) (path=%s, "
+                    "batch=%s): %s: %s"
+                    % (what, retries + 1, path, index,
+                       type(exc).__name__, exc)) from exc
+            if _obs.enabled():
+                _obs.counter("io.retries").add(1)
+                _obs.record_instant(
+                    "io.retry", cat="io",
+                    args={"what": what, "path": str(path),
+                          "batch": index, "attempt": attempt + 1,
+                          "error": str(exc)})
+            time.sleep(min(delay, _IO_BACKOFF_CAP_S))
+            delay *= 2
 
 
 def _obs_batch(iter_obj, batch):
@@ -485,12 +537,18 @@ class CSVIter(NDArrayIter):
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
-        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        def load(path):
+            if _chaos.enabled():
+                _chaos.fire("io.read", path=path)
+            return np.loadtxt(path, delimiter=",", dtype=np.float32,
+                              ndmin=2)
+        data = _retry_read(lambda: load(data_csv), "csv read",
+                           path=data_csv)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
-                               ndmin=2)
+            label = _retry_read(lambda: load(label_csv), "csv read",
+                                path=label_csv)
             label = label.reshape((-1,) + tuple(label_shape))
             if label_shape == (1,):
                 label = label.reshape(-1)
@@ -554,8 +612,10 @@ class MNISTIter(NDArrayIter):
 
     def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
                  silent=False, seed=0, **kwargs):
-        img = self._read_idx(image)
-        lbl = self._read_idx(label)
+        img = _retry_read(lambda: self._read_idx(image), "idx read",
+                          path=image)
+        lbl = _retry_read(lambda: self._read_idx(label), "idx read",
+                          path=label)
         img = img.astype(np.float32) / 255.0
         if flat:
             img = img.reshape(img.shape[0], -1)
@@ -588,6 +648,7 @@ class ImageRecordIter(DataIter):
                  label_width=1, preprocess_threads=4, round_batch=True,
                  data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
+        self.path_imgrec = path_imgrec
         self.record = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r") \
             if path_imgidx else recordio.MXRecordIO(path_imgrec, "r")
         self.data_shape = tuple(data_shape)
@@ -610,7 +671,15 @@ class ImageRecordIter(DataIter):
     def _load_all(self):
         out = []
         while True:
-            rec = self.record.read()
+            def fetch():
+                if _chaos.enabled():
+                    _chaos.fire("io.read", path=self.path_imgrec,
+                                record=len(out))
+                return self.record.read()
+            # a record read that hiccups (NFS blip, injected fault)
+            # retries with backoff instead of killing the epoch
+            rec = _retry_read(fetch, "record read",
+                              path=self.path_imgrec, index=len(out))
             if rec is None:
                 break
             header, payload = recordio.unpack(rec)
@@ -668,13 +737,26 @@ class ImageRecordIter(DataIter):
             idxs = [self._order[(self.cursor + i) % n]
                     for i in range(self.batch_size)]
             pad = max(0, self.cursor + self.batch_size - n)
+            batch_index = self.cursor // self.batch_size
             self.cursor += self.batch_size
-            datas, labels = [], []
-            for i in idxs:
-                header, payload = self._records[i]
-                d, l = self._decode_one(header, payload)
-                datas.append(d)
-                labels.append(l)
+
+            def assemble():
+                # idempotent by construction (cursor advanced above):
+                # a retried batch decodes the same records again
+                if _chaos.enabled():
+                    _chaos.fire("io.read", path=self.path_imgrec,
+                                batch=batch_index)
+                datas, labels = [], []
+                for i in idxs:
+                    header, payload = self._records[i]
+                    d, l = self._decode_one(header, payload)
+                    datas.append(d)
+                    labels.append(l)
+                return datas, labels
+
+            datas, labels = _retry_read(
+                assemble, "record batch decode",
+                path=self.path_imgrec, index=batch_index)
             data = nd.array(np.stack(datas))
             label = nd.array(np.asarray(labels, dtype=np.float32))
             batch = DataBatch(data=[data], label=[label], pad=pad,

@@ -49,6 +49,7 @@ from . import ndarray as nd
 from . import optimizer as opt
 from .gradient_compression import GradientCompression
 from .ndarray import NDArray
+from .observability import chaos as _chaos
 from .observability import core as _obs
 from .observability import watchdog as _wd
 
@@ -144,6 +145,10 @@ class KVStore(object):
         keys, values = self._normalize(key, value)
         with _obs.span("kvstore.push", cat="collective", keys=len(keys)), \
                 _wd.watch("kvstore.push", keys=len(keys)):
+            if _chaos.enabled():
+                # chaos site: delay/hang here models a rank stalling in
+                # its collective dispatch (armed under the watchdog)
+                _chaos.fire("kvstore.push", keys=len(keys))
             for k, v in zip(keys, values):
                 vlist = v if isinstance(v, (list, tuple)) else [v]
                 datas = self._maybe_compress(k, [x._data for x in vlist])
@@ -188,6 +193,8 @@ class KVStore(object):
         keys, outs = self._normalize(key, out)
         with _obs.span("kvstore.pull", cat="collective", keys=len(keys)), \
                 _wd.watch("kvstore.pull", keys=len(keys)):
+            if _chaos.enabled():
+                _chaos.fire("kvstore.pull", keys=len(keys))
             for k, o in zip(keys, outs):
                 if k not in self._store:
                     raise ValueError("Please initialize key %s first" % k)
@@ -283,47 +290,62 @@ class KVStore(object):
             "kvstore.pushpull_fused", bucket=bucket.index,
             lane=lane.dtype, bytes=lane.nbytes, keys=len(lane.segments),
             shard=slot is not None).start()
-        if _obs.enabled():
-            _obs.counter("kvstore.bucket_bytes", "bytes").add(lane.nbytes)
-        pad = slot.l_pad if slot is not None else None
-        per_worker = [
-            fusion.pack_lane(lane,
-                             {s.key: datas[s.key][w]
-                              for s in lane.segments}, pad_to=pad)
-            for w in range(nw)]
-        if slot is not None:
-            # reduce-scatter -> sharded update -> all-gather (2 fused
-            # collective dispatches however many keys ride the bucket)
-            for seg in lane.segments:
-                self._optimizer._update_count(self._opt_index(seg.key))
-            flat_new = slot.step(per_worker)
-            self._count("collectives", 2)
-            self._count("shard_updates")
-            news = fusion.unpack_lane(flat_new, lane)
-            for seg in lane.segments:
-                self._store[seg.key]._data = news[seg.key]
-        else:
-            self._count("collectives")
-            agg_flat = self._aggregate("__fused_b%d" % bucket.index,
-                                       per_worker)
-            news = fusion.unpack_lane(agg_flat, lane)
-            for seg in lane.segments:
-                k = seg.key
-                agg = NDArray(news[k], ctxs[k])
-                if self._updater is not None:
-                    if k not in self._store:
-                        raise ValueError(
-                            "Please initialize key %s first" % k)
-                    self._updater(self._opt_index(k), agg, self._store[k])
-                else:
-                    self._store[k] = agg
-        if outs is not None:
-            for seg in lane.segments:
-                src = self._store[seg.key]
-                for dst in outs[seg.key]:
-                    self._pull_into(src, dst)
-        lane_wd.stop()
-        lane_span.stop()
+        try:
+            if _chaos.enabled():
+                # per-lane chaos site, armed under the lane watchdog:
+                # the post-mortem for an injected hang names this
+                # bucket/dtype lane
+                _chaos.fire("kvstore.pushpull_fused",
+                            bucket=bucket.index, lane=lane.dtype)
+            if _obs.enabled():
+                _obs.counter("kvstore.bucket_bytes",
+                             "bytes").add(lane.nbytes)
+            pad = slot.l_pad if slot is not None else None
+            per_worker = [
+                fusion.pack_lane(lane,
+                                 {s.key: datas[s.key][w]
+                                  for s in lane.segments}, pad_to=pad)
+                for w in range(nw)]
+            if slot is not None:
+                # reduce-scatter -> sharded update -> all-gather (2
+                # fused collective dispatches however many keys ride
+                # the bucket)
+                for seg in lane.segments:
+                    self._optimizer._update_count(
+                        self._opt_index(seg.key))
+                flat_new = slot.step(per_worker)
+                self._count("collectives", 2)
+                self._count("shard_updates")
+                news = fusion.unpack_lane(flat_new, lane)
+                for seg in lane.segments:
+                    self._store[seg.key]._data = news[seg.key]
+            else:
+                self._count("collectives")
+                agg_flat = self._aggregate("__fused_b%d" % bucket.index,
+                                           per_worker)
+                news = fusion.unpack_lane(agg_flat, lane)
+                for seg in lane.segments:
+                    k = seg.key
+                    agg = NDArray(news[k], ctxs[k])
+                    if self._updater is not None:
+                        if k not in self._store:
+                            raise ValueError(
+                                "Please initialize key %s first" % k)
+                        self._updater(self._opt_index(k), agg,
+                                      self._store[k])
+                    else:
+                        self._store[k] = agg
+            if outs is not None:
+                for seg in lane.segments:
+                    src = self._store[seg.key]
+                    for dst in outs[seg.key]:
+                        self._pull_into(src, dst)
+        finally:
+            # an injected (or real) dispatch failure must not leave the
+            # lane's watchdog token armed — that would fire a spurious
+            # hang post-mortem for a collective that already raised
+            lane_wd.stop()
+            lane_span.stop()
 
     @staticmethod
     def _opt_index(k):
@@ -599,6 +621,11 @@ class KVStoreTPUSync(KVStore):
         with _wd.watch("kvstore.allreduce", nprocs=len(per_proc),
                        shape=str(tuple(local.shape)),
                        dtype=str(local.dtype)):
+            if _chaos.enabled():
+                # chaos site: a delay/hang HERE is one rank arriving
+                # late at the multi-host rendezvous — the exact failure
+                # the watchdog + straggler detector exist for
+                _chaos.fire("kvstore.allreduce", nprocs=len(per_proc))
             mine = jax.device_put(local[None],
                                   per_proc[jax.process_index()])
             global_arr = jax.make_array_from_single_device_arrays(

@@ -14,11 +14,12 @@ weight).
 Design (gather-to-host):
 
 * **save** gathers every leaf to host memory and writes ONE data file
-  (`arrays-<step>-<id>.npz`) plus a `manifest.json` (config, step,
-  user metadata, the data file's name) whose atomic replace is the
-  commit point. On a multi-controller run, non-addressable leaves are
-  allgathered first and only process 0 writes — one checkpoint, not N
-  partials — with a completion barrier before anyone proceeds.
+  (`arrays-<step>-<id>.npz`) plus manifests: a retained per-save
+  `manifest-<step>-<id>.json` and the `manifest.json` latest pointer,
+  whose atomic replace is the commit point. On a multi-controller run,
+  non-addressable leaves are allgathered first and only process 0
+  writes — one checkpoint, not N partials — with a completion barrier
+  before anyone proceeds.
 * **restore** rebuilds the pytree on host and, given a mesh, lays it
   back out via `shard_params` — PartitionSpecs name mesh AXES, not
   sizes, so the restoring mesh may be factored differently from the
@@ -26,6 +27,30 @@ Design (gather-to-host):
 * int8-quantized trees round-trip exactly: the `q8` payload, its
   `scale` sidecar, and the zero-size `dt` dtype carrier are each saved
   as their own array.
+
+Fault tolerance (the robustness contract this module anchors):
+
+* every manifest carries a **per-array crc32** of the exact bytes on
+  disk; `load_checkpoint` verifies before reconstructing and raises
+  `CheckpointCorrupt` (named file, expected vs actual digest) on a
+  torn, truncated, or missing data file instead of a cryptic
+  npz/KeyError — and **falls back** to the newest older retained
+  checkpoint when one exists.
+* `save_checkpoint(..., keep=N)` retains the N newest complete
+  checkpoints and GCs the rest (atomically, and never the newest) —
+  the fallback's raw material.
+* `save_checkpoint(..., async_save=True)` snapshots the tree (D2H
+  overlapped via `copy_to_host_async`; donation-safe — the caller may
+  feed the same params to a donating train step immediately) and moves
+  the serialization + atomic commit + retention GC — the disk-bound
+  cost — onto a saver thread. The next save (or load, or
+  `wait_for_pending_save()`) is the in-flight barrier and re-raises a
+  failed write there.
+* `install_emergency_checkpoint` registers a state provider so a
+  SIGTERM (preemption notice) or the collective-hang watchdog's
+  `checkpoint` escalation triggers one best-effort synchronous save
+  before the process goes down; `resume_from_latest` is the other half
+  of the supervisor-restart loop.
 
 The npz format was chosen over a hand-rolled binary for a deliberate
 reason: a checkpoint must outlive the process that wrote it, and numpy's
@@ -37,15 +62,33 @@ keys alone with no pickled structure.
 
 import json
 import os
+import signal
+import threading
+import traceback
+import warnings
+import zlib
 
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state"]
+from ..observability import chaos as _chaos
+
+__all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state",
+           "CheckpointCorrupt", "wait_for_pending_save",
+           "list_checkpoints", "resume_from_latest",
+           "install_emergency_checkpoint",
+           "uninstall_emergency_checkpoint",
+           "save_emergency_checkpoint"]
 
 _SEP = "."          # path component separator inside npz keys
 _PARAMS = "p"       # key prefix: model parameters
 _MOMENTUM = "m"     # key prefix: optimizer momentum/state tree
 _QSUF = "#"         # q8 sub-leaf suffix marker: "...wq#q8", "...wq#scale"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint that must not be trusted: torn/truncated/missing
+    data file or a per-array digest mismatch. The message names the
+    file and, for digest failures, expected vs actual."""
 
 
 def _is_q8(leaf):
@@ -84,6 +127,20 @@ def _gather_to_host(x):
         x = multihost_utils.process_allgather(x, tiled=True)
     import jax
     return np.asarray(jax.device_get(x))
+
+
+def _gather_all(flat):
+    """Host snapshot of every leaf, D2H transfers overlapped: kick off
+    every addressable leaf's async copy first, then complete them in
+    order. Returns {key: np.ndarray}."""
+    for v in flat.values():
+        start = getattr(v, "copy_to_host_async", None)
+        if start is not None and getattr(v, "is_fully_addressable", True):
+            try:
+                start()
+            except Exception:        # best-effort overlap only
+                break
+    return {k: _gather_to_host(v) for k, v in flat.items()}
 
 
 def _unflatten(flat):
@@ -133,11 +190,55 @@ def _cfg_from_json(d):
     return TransformerConfig(**d)
 
 
+def _crc(arr):
+    """crc32 hex of the array's exact on-disk bytes (dtype-agnostic:
+    the same bytes hash the same whether numpy later views them as
+    bf16 or a raw void record)."""
+    return "%08x" % (zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                     & 0xFFFFFFFF)
+
+
+# ------------------------------------------------------- async in-flight --
+
+_pending_lock = threading.Lock()
+_pending = [None]                    # the one in-flight saver thread
+
+
+class _Saver(threading.Thread):
+    def __init__(self, fn):
+        super().__init__(name="mxnet-ckpt-saver", daemon=True)
+        self._fn = fn
+        self.error = None
+
+    def run(self):
+        try:
+            self._fn()
+        except BaseException as e:       # noqa: BLE001 — re-raised at barrier
+            self.error = e
+
+
+def wait_for_pending_save():
+    """Block until the in-flight async save (if any) committed; re-raise
+    its failure here. Every save/load barriers through this, so an async
+    write error surfaces at the next checkpoint touchpoint instead of
+    vanishing with the thread."""
+    with _pending_lock:
+        t = _pending[0]
+    if t is None:
+        return
+    t.join()
+    with _pending_lock:
+        if _pending[0] is t:
+            _pending[0] = None
+    if t.error is not None:
+        raise t.error
+
+
 def save_checkpoint(path, cfg, params, momentum=None, step=0,
-                    metadata=None):
+                    metadata=None, keep=1, async_save=False):
     """Write a training (or serving) checkpoint directory.
 
-    path      directory (created); holds manifest.json + the data file
+    path      directory (created); holds manifest.json + the data files
               it references (arrays-<step>-<id>.npz)
     cfg       the TransformerConfig the params were built with — stored
               so a restore needs nothing but the path
@@ -148,19 +249,37 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
     step      training step counter, returned on restore
     metadata  optional JSON-serializable dict (loss history, tokenizer
               tag, ...)
+    keep      retain this many complete checkpoints (default 1 — the
+              pre-retention behavior); older ones are GC'd after the
+              commit, the newest never
+    async_save  snapshot to host now (overlapped D2H; donation-safe),
+              serialize + commit + GC on a saver thread; the next
+              save/load is the in-flight barrier. Multi-controller runs
+              save synchronously (the completion barrier is a
+              collective and must stay on the calling thread).
     """
+    wait_for_pending_save()          # in-flight barrier (and re-raise)
     flat = {}
     _flatten(params, _PARAMS, flat)
     if momentum is not None:
         _flatten(momentum, _MOMENTUM, flat)
-    host = {k: _gather_to_host(v) for k, v in flat.items()}
 
     import jax
+    if async_save and jax.process_count() == 1:
+        host = _gather_all(flat)
+        t = _Saver(lambda: _write_commit_sweep(
+            path, cfg, host, momentum is not None, step, metadata, keep))
+        with _pending_lock:
+            _pending[0] = t
+        t.start()
+        return path
+
+    host = _gather_all(flat)
     write_error = None
     try:
         if jax.process_index() == 0:
             _write_commit_sweep(path, cfg, host, momentum is not None,
-                                step, metadata)
+                                step, metadata, keep)
     except Exception as e:          # noqa: BLE001 — re-raised below
         # the barrier must still be reached: a proc-0 failure that
         # skipped it would leave every other process blocked in the
@@ -184,15 +303,19 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
     return path
 
 
-def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata):
+def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata,
+                        keep=1):
     """Process-0 write path. The data file gets a unique name and the
-    manifest points at it: a crash at ANY point leaves the previous
-    manifest (and the previous data file it references) fully intact —
-    the manifest os.replace is the single commit point. Leftovers from
-    crashed saves (older committed data files, orphaned .tmp files) are
-    swept after a successful commit."""
+    manifests point at it: a crash at ANY point leaves every previously
+    committed checkpoint fully intact — the final manifest.json
+    os.replace is the latest-pointer commit. A retained per-save copy
+    (manifest-<step>-<id>.json) lands first so retention/fallback can
+    enumerate complete checkpoints without parsing the pointer.
+    Afterwards the sweep GCs past-`keep` checkpoints, unreferenced data
+    files, and orphaned .tmp files — never the newest."""
     os.makedirs(path, exist_ok=True)
-    arrays_file = "arrays-%d-%s.npz" % (int(step), os.urandom(4).hex())
+    stamp = "%d-%s" % (int(step), os.urandom(4).hex())
+    arrays_file = "arrays-%s.npz" % stamp
     manifest = {
         "format": "mxnet_tpu.transformer.checkpoint/1",
         "config": _cfg_to_json(cfg),
@@ -205,6 +328,9 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata):
         # on load
         "dtypes": {k: np.dtype(v.dtype).name for k, v in host.items()},
         "arrays": sorted(host),
+        # per-array digest of the exact bytes written: load_checkpoint
+        # refuses a torn/truncated file instead of rebuilding garbage
+        "checksums": {k: _crc(v) for k, v in host.items()},
         "metadata": metadata or {},
     }
     # serialize BEFORE touching the directory: a non-JSON metadata
@@ -214,51 +340,152 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata):
     with open(tmp, "wb") as f:
         np.savez(f, **host)
     os.replace(tmp, os.path.join(path, arrays_file))
-    tmp = os.path.join(path, ".manifest.json.tmp")
-    with open(tmp, "w") as f:
-        f.write(manifest_text)
-    os.replace(tmp, os.path.join(path, "manifest.json"))  # commit
+    # chaos site: a crash/preemption injected HERE (data landed, nothing
+    # committed) is the torn-save case the commit-point test replays
+    _chaos.fire("checkpoint.write", path=path, step=int(step))
+    retained = "manifest-%s.json" % stamp
+    for name in (retained, "manifest.json"):
+        tmp = os.path.join(path, "." + name + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(manifest_text)
+        os.replace(tmp, os.path.join(path, name))   # last one = commit
+    _sweep(path, keep, stamp)
+
+
+def _retained_manifests(path):
+    """[(step, mtime, filename, arrays_file)] for every readable
+    retained manifest, oldest first."""
+    out = []
+    for name in os.listdir(path):
+        if not (name.startswith("manifest-") and name.endswith(".json")):
+            continue
+        full = os.path.join(path, name)
+        try:
+            with open(full) as f:
+                m = json.load(f)
+            mtime = os.path.getmtime(full)
+        except (OSError, ValueError):
+            continue
+        out.append((int(m.get("step", -1)), mtime, name,
+                    m.get("arrays_file")))
+    out.sort(key=lambda e: (e[0], e[1], e[2]))
+    return out
+
+
+def _sweep(path, keep, current_stamp):
+    """Retention GC: keep the newest ``keep`` complete checkpoints
+    (always including the one just written), drop older manifest/data
+    pairs, unreferenced data files, and orphaned tmps."""
+    keep = max(int(keep), 1)
+    entries = _retained_manifests(path)
+    keepers = {e[2] for e in entries[-keep:]}
+    keepers.add("manifest-%s.json" % current_stamp)
+    referenced = {e[3] for e in entries if e[2] in keepers}
+    # a pre-retention checkpoint has only manifest.json: protect the
+    # data file the latest pointer references, whatever wrote it
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            referenced.add(json.load(f).get("arrays_file"))
+    except (OSError, ValueError):
+        pass
     for stale in os.listdir(path):
-        committed_stale = (stale.startswith("arrays")
-                           and stale != arrays_file)
+        doomed_manifest = (stale.startswith("manifest-")
+                           and stale.endswith(".json")
+                           and stale not in keepers)
+        doomed_arrays = (stale.startswith("arrays")
+                         and stale not in referenced)
         orphaned_tmp = stale.startswith(".") and stale.endswith(".tmp")
-        if committed_stale or orphaned_tmp:
+        if doomed_manifest or doomed_arrays or orphaned_tmp:
             try:
                 os.remove(os.path.join(path, stale))
             except OSError:
                 pass
 
 
-def load_checkpoint(path, mesh=None):
-    """Read a checkpoint directory back into live pytrees.
+def list_checkpoints(path):
+    """Complete retained checkpoints under ``path`` as
+    [(step, manifest_filename)], oldest first. (A pre-retention
+    directory — bare manifest.json only — lists as [(step,
+    'manifest.json')].)"""
+    if not os.path.isdir(path):
+        return []
+    entries = [(e[0], e[2]) for e in _retained_manifests(path)]
+    if not entries and os.path.exists(os.path.join(path,
+                                                   "manifest.json")):
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                entries = [(int(json.load(f).get("step", -1)),
+                            "manifest.json")]
+        except (OSError, ValueError):
+            pass
+    return entries
 
-    Returns ``(cfg, params, momentum, step, metadata)`` — momentum is
-    None when the checkpoint carried none. With ``mesh`` given, params
-    and momentum are laid out onto it via ``shard_params`` (specs name
-    mesh axes, so any factorization whose axis sizes divide the weight
-    dims works — including one different from the saving run's).
-    Without a mesh, leaves come back as host-resident jnp arrays.
-    """
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+
+def _read_arrays(path, manifest, manifest_name):
+    """The verified read of one manifest's data file: every entry's
+    bytes must exist and match the recorded digest. Raises
+    CheckpointCorrupt naming the file on any torn/truncated/missing
+    state."""
+    arrays_file = manifest.get("arrays_file", "arrays.npz")
+    full = os.path.join(path, arrays_file)
+    checksums = manifest.get("checksums")     # absent on old checkpoints
+    dtypes = manifest.get("dtypes", {})
+    flat = {}
+    try:
+        with np.load(full) as npz:
+            members = set(npz.files)
+            for k in manifest.get("arrays", sorted(members)):
+                if k not in members:
+                    raise CheckpointCorrupt(
+                        "checkpoint %s (%s): array %r missing from %s"
+                        % (path, manifest_name, k, arrays_file))
+                arr = npz[k]
+                if checksums is not None:
+                    got = _crc(arr)
+                    want = checksums.get(k)
+                    if got != want:
+                        raise CheckpointCorrupt(
+                            "checkpoint %s (%s): array %r in %s is "
+                            "corrupt — digest %s, manifest says %s"
+                            % (path, manifest_name, k, arrays_file,
+                               got, want))
+                want_dt = dtypes.get(k)
+                if want_dt and arr.dtype.name != want_dt:
+                    # ml_dtypes entry stored as a void record:
+                    # reinterpret the bytes (itemsizes match by
+                    # construction)
+                    arr = arr.view(np.dtype(want_dt))
+                flat[k] = arr
+    except CheckpointCorrupt:
+        raise
+    except FileNotFoundError:
+        raise CheckpointCorrupt(
+            "checkpoint %s (%s): data file %s is missing"
+            % (path, manifest_name, arrays_file)) from None
+    except Exception as e:        # torn zip/zlib stream, short read, ...
+        raise CheckpointCorrupt(
+            "checkpoint %s (%s): data file %s is unreadable (%s: %s)"
+            % (path, manifest_name, arrays_file,
+               type(e).__name__, e)) from e
+    return flat
+
+
+def _load_manifest(path, manifest_name, mesh):
+    full = os.path.join(path, manifest_name)
+    try:
+        with open(full) as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise CheckpointCorrupt(
+            "checkpoint %s: manifest %s is not valid JSON (%s)"
+            % (path, manifest_name, e)) from e
     if not str(manifest.get("format", "")).startswith(
             "mxnet_tpu.transformer.checkpoint/"):
         raise ValueError("not a transformer checkpoint: %s" % path)
     cfg = _cfg_from_json(manifest["config"])
+    flat = _read_arrays(path, manifest, manifest_name)
 
     import jax.numpy as jnp
-    dtypes = manifest.get("dtypes", {})
-    arrays_file = manifest.get("arrays_file", "arrays.npz")
-    with np.load(os.path.join(path, arrays_file)) as npz:
-        flat = {}
-        for k in npz.files:
-            arr = npz[k]
-            want = dtypes.get(k)
-            if want and arr.dtype.name != want:
-                # ml_dtypes entry stored as a void record: reinterpret
-                # the bytes (itemsizes match by construction)
-                arr = arr.view(np.dtype(want))
-            flat[k] = arr
     pref = _PARAMS + _SEP
     mref = _MOMENTUM + _SEP
     params = _unflatten({k[len(pref):]: v for k, v in flat.items()
@@ -287,6 +514,68 @@ def load_checkpoint(path, mesh=None):
         manifest.get("metadata", {})
 
 
+def load_checkpoint(path, mesh=None, fallback=True):
+    """Read a checkpoint directory back into live pytrees.
+
+    Returns ``(cfg, params, momentum, step, metadata)`` — momentum is
+    None when the checkpoint carried none. With ``mesh`` given, params
+    and momentum are laid out onto it via ``shard_params`` (specs name
+    mesh axes, so any factorization whose axis sizes divide the weight
+    dims works — including one different from the saving run's).
+    Without a mesh, leaves come back as host-resident jnp arrays.
+
+    Every array is digest-verified against the manifest; a torn,
+    truncated or missing data file raises :class:`CheckpointCorrupt`
+    naming the file and digests. With ``fallback=True`` (default) a
+    corrupt newest checkpoint falls back — with a warning — to the
+    newest older retained checkpoint (``save_checkpoint(keep=N)``)
+    before giving up.
+    """
+    wait_for_pending_save()
+    candidates = []
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        candidates.append("manifest.json")
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                latest_arrays = json.load(f).get("arrays_file")
+        except (OSError, ValueError):
+            latest_arrays = None
+    else:
+        latest_arrays = None
+    retained = _retained_manifests(path) if os.path.isdir(path) else []
+    for _step, _mt, name, arrays in reversed(retained):
+        if arrays == latest_arrays and candidates:
+            continue                 # same checkpoint as the pointer
+        candidates.append(name)
+    if not candidates:
+        # preserve the pre-retention contract: a missing directory /
+        # manifest surfaces as the old FileNotFoundError
+        with open(os.path.join(path, "manifest.json")) as f:
+            pass
+    first_error = None
+    for i, name in enumerate(candidates):
+        try:
+            out = _load_manifest(path, name, mesh)
+        except CheckpointCorrupt as e:
+            if first_error is None:
+                first_error = e
+            if not fallback:
+                raise
+            if i + 1 < len(candidates):
+                warnings.warn(
+                    "mxnet_tpu.checkpoint: %s — falling back to an "
+                    "older retained checkpoint" % e,
+                    RuntimeWarning, stacklevel=2)
+            continue
+        if first_error is not None:
+            warnings.warn(
+                "mxnet_tpu.checkpoint: recovered from %s at step %d "
+                "after a corrupt newer checkpoint"
+                % (name, out[3]), RuntimeWarning, stacklevel=2)
+        return out
+    raise first_error
+
+
 def restore_train_state(path, mesh):
     """Resume helper: checkpoint -> (cfg, params, momentum, step) ready
     to feed `make_train_step(cfg, mesh)`. A checkpoint saved without
@@ -308,3 +597,124 @@ def restore_train_state(path, mesh):
         # the already-sharded params inherits their layout
         momentum = init_momentum(params)
     return cfg, params, momentum, step
+
+
+def resume_from_latest(path, mesh=None, init=None):
+    """The supervisor-restart entry point: resume training from the
+    newest loadable checkpoint under ``path`` (corrupt newer ones fall
+    back per `load_checkpoint`). Returns ``(cfg, params, momentum,
+    step)``. With no checkpoint present, calls ``init()`` (which must
+    return that same tuple, conventionally with step 0) — so a worker
+    that always starts with ``resume_from_latest(dir, mesh,
+    init=fresh)`` is restartable by construction."""
+    wait_for_pending_save()
+    has_any = os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, "manifest.json"))
+        or _retained_manifests(path))
+    if not has_any:
+        if init is None:
+            raise FileNotFoundError(
+                "no checkpoint under %s and no init() provided" % path)
+        return init()
+    return restore_train_state(path, mesh)
+
+
+# ------------------------------------------------- emergency checkpoint --
+
+_emergency_lock = threading.Lock()
+_emergency = {"path": None, "state": None, "keep": 2,
+              "prev_sigterm": None, "sigterm": False, "watchdog": False}
+
+
+def save_emergency_checkpoint(reason="emergency"):
+    """One best-effort SYNCHRONOUS save of the registered training
+    state (joins any in-flight async save first). Returns the path, or
+    None when no provider is installed. Never raises on a missing
+    registration — the callers (signal handler, watchdog thread) are
+    last-gasp paths."""
+    with _emergency_lock:
+        path, state, keep = (_emergency["path"], _emergency["state"],
+                             _emergency["keep"])
+    if path is None or state is None:
+        return None
+    st = state()
+    meta = dict(st.get("metadata") or {})
+    meta["emergency"] = str(reason)
+    save_checkpoint(path, st["cfg"], st["params"],
+                    momentum=st.get("momentum"),
+                    step=int(st.get("step", 0)),
+                    metadata=meta, keep=keep)
+    return path
+
+
+def _sigterm_handler(signum, frame):
+    with _emergency_lock:
+        prev = _emergency["prev_sigterm"]
+    try:
+        p = save_emergency_checkpoint("sigterm")
+        if p:
+            print("mxnet_tpu.checkpoint: SIGTERM — emergency "
+                  "checkpoint committed to %s" % p, flush=True)
+    except Exception:                # last-gasp: report, then go down
+        traceback.print_exc()
+    if callable(prev):
+        prev(signum, frame)
+        return
+    raise SystemExit(143)            # 128 + SIGTERM, supervisor-visible
+
+
+def install_emergency_checkpoint(path, state, keep=2, on_sigterm=True,
+                                 on_watchdog=True):
+    """Arm emergency checkpointing: ``state()`` must return a dict with
+    ``cfg``/``params`` (and optionally ``momentum``/``step``/
+    ``metadata``) reflecting the CURRENT training state — call it
+    cheap, it runs at preemption time. With ``on_sigterm`` a SIGTERM
+    triggers one best-effort save and then exits 143 (chaining any
+    previously installed handler); with ``on_watchdog`` the
+    collective-hang watchdog's ``MXNET_OBS_WATCHDOG_ACTION=checkpoint``
+    escalation saves through the same provider before aborting."""
+    global _emergency
+    with _emergency_lock:
+        _emergency["path"] = path
+        _emergency["state"] = state
+        _emergency["keep"] = int(keep)
+    if on_sigterm:
+        try:
+            prev = signal.signal(signal.SIGTERM, _sigterm_handler)
+            with _emergency_lock:
+                if prev is not _sigterm_handler:
+                    _emergency["prev_sigterm"] = prev
+                _emergency["sigterm"] = True
+        except ValueError:           # not the main thread
+            warnings.warn(
+                "mxnet_tpu.checkpoint: SIGTERM handler not installed "
+                "(not on the main thread); emergency checkpointing "
+                "stays available to the watchdog only",
+                RuntimeWarning, stacklevel=2)
+    if on_watchdog:
+        from ..observability import watchdog as _wd
+        _wd.set_emergency_hook(save_emergency_checkpoint)
+        with _emergency_lock:
+            _emergency["watchdog"] = True
+    return path
+
+
+def uninstall_emergency_checkpoint():
+    """Disarm: restore the previous SIGTERM disposition and drop the
+    provider/watchdog hook."""
+    with _emergency_lock:
+        prev = _emergency["prev_sigterm"]
+        had_sig = _emergency["sigterm"]
+        had_wd = _emergency["watchdog"]
+        _emergency.update({"path": None, "state": None,
+                           "prev_sigterm": None, "sigterm": False,
+                           "watchdog": False})
+    if had_sig:
+        try:
+            signal.signal(signal.SIGTERM,
+                          prev if prev is not None else signal.SIG_DFL)
+        except ValueError:
+            pass
+    if had_wd:
+        from ..observability import watchdog as _wd
+        _wd.set_emergency_hook(None)

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from . import transformer as tf
+from ..observability import chaos as _chaos
 from ..observability import core as _obs
 
 
@@ -210,14 +211,16 @@ def _jitted_slot_write(cfg):
 
 
 class Request(object):
-    __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token")
+    __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token",
+                 "seed")
 
-    def __init__(self, rid, prompt, n_new, stop_token=None):
+    def __init__(self, rid, prompt, n_new, stop_token=None, seed=0):
         self.rid = rid
         self.tokens = list(prompt)   # prompt + generated so far
         self.n_new = n_new
         self.emitted = 0             # generated count
         self.stop_token = stop_token
+        self.seed = seed             # sampling seed (requeue needs it)
 
     @property
     def done(self):
@@ -316,6 +319,13 @@ class ContinuousBatcher(object):
             self._pipe_fn = _jitted_pipeline_chunk(
                 cfg, *self._controls, self.chunk_size)
             self._patch_fn = _jitted_lane_patch(cfg)
+        # dispatch-failure recovery: a failed decode dispatch frees the
+        # lanes and requeues the live requests (greedy streams resume
+        # bit-exactly) instead of wedging the batcher; consecutive
+        # failures past the cap re-raise — a deterministic fault must
+        # not become a silent requeue loop
+        self._dispatch_failures = 0
+        self._max_dispatch_failures = 3
         self._next_rid = 0
         # prefix cache: tuple(tokens) -> (row_cache, last_row_logits),
         # LRU-bounded. Each entry holds one [1, max_len] row cache on
@@ -466,7 +476,8 @@ class ContinuousBatcher(object):
                 self._cache, row_cache, jnp.int32(slot))
             self._pos[slot] = t_p      # next decode writes position t_p
             self._tok[slot] = first
-        req = Request(self._next_rid, prompt, n_new, stop_token)
+        req = Request(self._next_rid, prompt, n_new, stop_token,
+                      seed=seed)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
@@ -505,18 +516,25 @@ class ContinuousBatcher(object):
         if not any(s is not None for s in self._slots):
             return finished
         k = self.chunk_size
-        if k == 1:
-            nxt, keys, self._cache = _jitted_ragged_step(
-                self.cfg, *self._controls)(
-                self.params, self._cache, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._keys))
-            toks = np.asarray(nxt).astype(np.int32)[None]   # [1, B]
-        else:
-            toks, keys, self._cache = _jitted_ragged_chunk(
-                self.cfg, *self._controls, k)(
-                self.params, self._cache, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._keys))
-            toks = np.asarray(toks).astype(np.int32)        # [k, B]
+        try:
+            if _chaos.enabled():
+                _chaos.fire("serving.dispatch", mode="sync")
+            if k == 1:
+                nxt, keys, self._cache = _jitted_ragged_step(
+                    self.cfg, *self._controls)(
+                    self.params, self._cache, jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), jnp.asarray(self._keys))
+                toks = np.asarray(nxt).astype(np.int32)[None]  # [1, B]
+            else:
+                toks, keys, self._cache = _jitted_ragged_chunk(
+                    self.cfg, *self._controls, k)(
+                    self.params, self._cache, jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), jnp.asarray(self._keys))
+                toks = np.asarray(toks).astype(np.int32)       # [k, B]
+        except Exception as exc:     # noqa: BLE001 — requeue-or-raise
+            self._recover_dispatch_failure(exc)
+            return finished
+        self._dispatch_failures = 0
         # np.array (copy): asarray would give a READ-ONLY view of the
         # device buffer and the next admit()'s in-place key write fails
         self._keys = np.array(keys, np.uint32)
@@ -558,7 +576,11 @@ class ContinuousBatcher(object):
                 self._free(i)
         while (len(self._inflight) < self.pipeline_depth
                and any(s is not None for s in self._slots)):
-            self._dispatch_chunk()
+            try:
+                self._dispatch_chunk()
+            except Exception as exc:  # noqa: BLE001 — requeue-or-raise
+                self._recover_dispatch_failure(exc)
+                return finished
         if self._inflight:
             finished.update(self._sync_oldest())
         if not any(s is not None for s in self._slots):
@@ -577,9 +599,13 @@ class ContinuousBatcher(object):
         the old occupant's in-flight tokens by rid mismatch)."""
         with _obs.span("serving.dispatch", cat="serving",
                        depth=len(self._inflight) + 1):
+            if _chaos.enabled():
+                _chaos.fire("serving.dispatch", mode="pipelined",
+                            depth=len(self._inflight) + 1)
             toks, cache, tok, pos, keys = self._pipe_fn(
                 self.params, self._cache, self._dev_tok,
                 self._dev_pos, self._dev_keys)
+        self._dispatch_failures = 0
         self._cache = cache
         self._dev_tok, self._dev_pos, self._dev_keys = tok, pos, keys
         self._inflight.append(
@@ -616,6 +642,84 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 self._free(i)
         return finished
+
+    # ---- dispatch-failure recovery ----
+
+    def _recover_dispatch_failure(self, exc):
+        """A decode dispatch raised (injected fault, transient XLA
+        failure). The jitted chunk donates its carry, so whatever it
+        consumed is gone — rebuild the pool from scratch and REQUEUE
+        every live request from its synced token state: lanes freed,
+        carry re-zeroed, each request re-prefilled at its current
+        prefix. Greedy streams continue bit-exactly (decode is a pure
+        function of the token prefix); sampled streams continue on a
+        deterministically reseeded chain (the in-flight key chain died
+        with the carry). After ``_max_dispatch_failures`` consecutive
+        failures the error re-raises — a deterministic fault must not
+        loop as an infinite requeue."""
+        self._dispatch_failures += 1
+        if _obs.enabled():
+            _obs.counter("serving.dispatch_failures").add(1)
+            _obs.record_instant(
+                "serving.dispatch_failed", cat="serving",
+                args={"error": "%s: %s" % (type(exc).__name__, exc),
+                      "consecutive": self._dispatch_failures})
+        if self._dispatch_failures > self._max_dispatch_failures:
+            raise exc
+        pending = [r for r in self._slots if r is not None]
+        self._slots = [None] * self.max_batch
+        self._cache = tf.init_cache(self.cfg, self.max_batch)
+        self._pos = np.zeros((self.max_batch,), np.int32)
+        self._tok = np.zeros((self.max_batch,), np.int32)
+        self._keys = np.zeros((self.max_batch, 2), np.uint32)
+        if self.pipeline_depth > 1:
+            self._inflight.clear()
+            self._dev_tok = jnp.zeros((self.max_batch,), jnp.int32)
+            self._dev_pos = jnp.zeros((self.max_batch,), jnp.int32)
+            self._dev_keys = jnp.zeros((self.max_batch, 2), jnp.uint32)
+        for req in pending:
+            self._readmit(req)
+
+    def _readmit(self, req):
+        """Put a live request back into a (guaranteed free) lane from
+        its token history: the cache is re-prefilled over everything
+        but the last token, and decode resumes feeding that last token
+        at its true position — the standard continuation identity
+        (cache holds keys for tokens[:-1], tok=tokens[-1],
+        pos=len-1)."""
+        slot = next(i for i, s in enumerate(self._slots) if s is None)
+        ctx, last = req.tokens[:-1], req.tokens[-1]
+        m = len(ctx)
+        assert m >= 1, "a live request always has prompt + first token"
+        row_cache = tf.init_cache(self.cfg, 1)
+        width = min(_bucket(m), self.cfg.max_len)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :m] = ctx
+        _, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
+            self.params, row_cache, jnp.asarray(padded),
+            jnp.int32(0), jnp.int32(m - 1))
+        if self.greedy:
+            key_np = np.zeros((2,), np.uint32)
+        else:
+            key_np = np.asarray(jax.random.fold_in(
+                jax.random.PRNGKey(req.seed), req.emitted), np.uint32)
+        self._cache = _jitted_slot_write(self.cfg)(
+            self._cache, row_cache, jnp.int32(slot))
+        if self.pipeline_depth > 1:
+            self._dev_tok, self._dev_pos, self._dev_keys = \
+                self._patch_fn(self._dev_tok, self._dev_pos,
+                               self._dev_keys, jnp.int32(slot),
+                               jnp.int32(last), jnp.int32(m),
+                               jnp.asarray(key_np))
+        else:
+            self._pos[slot] = m
+            self._tok[slot] = last
+            self._keys[slot] = key_np
+        self._slots[slot] = req
+        if _obs.enabled():
+            _obs.record_instant("serving.requeued", cat="serving",
+                                args={"rid": req.rid, "lane": slot,
+                                      "resume_pos": m})
 
     def cancel(self, rid):
         """Evict a request mid-decode (client disconnect, timeout):

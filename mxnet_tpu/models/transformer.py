@@ -1175,17 +1175,34 @@ def _beam_core(params, prompt, cache, n_new, k, length_penalty, cfg,
     return buf.reshape(b, k, total), scores
 
 
-def make_train_step(cfg, mesh=None, lr=1e-2):
+def make_train_step(cfg, mesh=None, lr=1e-2, guard=False):
     """Jitted full training step: (params, opt_state, tokens) ->
     (params, opt_state, loss). SGD with momentum, all-reduce of grads is
-    implicit in GSPMD (grads inherit param shardings)."""
+    implicit in GSPMD (grads inherit param shardings).
+
+    With ``guard=True`` the step returns a fourth output ``skipped``
+    (device bool) and applies the NON-FINITE STEP GUARD entirely on
+    device: if the loss or any gradient is NaN/Inf, params and momentum
+    pass through untouched — one divergent batch can never poison the
+    weights, and an uninterrupted guarded run stays bit-identical to
+    the unguarded one as long as nothing trips (the selects choose the
+    same updated arrays)."""
 
     def step(params, momentum, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
-        momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
-        params = jax.tree.map(lambda p, m: p - lr * m.astype(p.dtype),
-                              params, momentum)
-        return params, momentum, loss
+        new_m = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+        new_p = jax.tree.map(lambda p, m: p - lr * m.astype(p.dtype),
+                             params, new_m)
+        if not guard:
+            return new_p, new_m, loss
+        ok = jnp.isfinite(loss)
+        for g in jax.tree.leaves(grads):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+        params = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                              new_p, params)
+        momentum = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                new_m, momentum)
+        return params, momentum, loss, jnp.logical_not(ok)
 
     return jax.jit(step, donate_argnums=(0, 1))
 
@@ -1198,4 +1215,7 @@ def init_momentum(params):
 # re-exported here so the flagship's whole train/serve/persist surface is
 # reachable from one module
 from .checkpoint import (save_checkpoint, load_checkpoint,  # noqa: E402
-                         restore_train_state)
+                         restore_train_state, resume_from_latest,
+                         CheckpointCorrupt, wait_for_pending_save,
+                         install_emergency_checkpoint,
+                         uninstall_emergency_checkpoint)

@@ -21,6 +21,7 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
+from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
 from ..observability import recompile as _obs_recompile
@@ -403,7 +404,23 @@ class Module(BaseModule):
         assert self.params_initialized and self.optimizer_initialized
         with _obs.span("update", cat="step",
                        on_kvstore=bool(self._update_on_kvstore)):
-            self._update_impl()
+            if _chaos.enabled():
+                # chaos site: a "nan" rule poisons this step's grads
+                _chaos.poison_ndarrays(
+                    "module.grads",
+                    [self._exec.grad_dict[n]
+                     for n in self._param_names
+                     if n in self._exec.grad_dict])
+            if _chaos.step_guard_enabled() and not _chaos.all_finite(
+                    [self._exec.grad_dict[n]._data
+                     for n in self._param_names
+                     if n in self._exec.grad_dict]):
+                # skip push+update entirely: with update_on_kvstore the
+                # weight update happens inside the store's push, so the
+                # guard must gate BEFORE any gradient leaves the exec
+                _chaos.count_skipped_step("module")
+            else:
+                self._update_impl()
         if _obs.enabled():
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)

@@ -29,6 +29,7 @@ dumps a post-mortem after ``MXNET_OBS_COLLECTIVE_TIMEOUT`` seconds
 instead of hanging silently.
 """
 
+from . import chaos
 from . import core
 from . import dist
 from . import export
@@ -49,7 +50,8 @@ from .export import (chrome_trace, dump_chrome_trace, aggregate,
 from .recompile import get_detector, note_call, record_retrace
 from .watchdog import get_watchdog
 
-__all__ = ["core", "dist", "export", "hlo", "attribution", "recompile",
+__all__ = ["chaos", "core", "dist", "export", "hlo", "attribution",
+           "recompile",
            "watchdog", "ops_enabled", "format_ops_table",
            "compare_summaries", "ops_summary", "enabled",
            "set_enabled", "span", "counter", "gauge", "record_span",

@@ -1,0 +1,383 @@
+"""Deterministic fault injection — the chaos layer the recovery paths
+are proven against.
+
+Large-scale jobs die in ways unit tests never exercise: a rank that
+stops dispatching mid-collective, a preemption notice that lands in the
+middle of ``save_checkpoint``, a gradient that goes NaN after three
+days, a record read that hiccups once per epoch. The TensorFlow system
+paper (PAPERS.md) makes user-level checkpointing plus automatic restart
+the backbone of fault tolerance at scale; this module provides the
+*inject* half of that loop so every detector and recovery path in the
+repo (step guards, io retries, emergency checkpointing, the watchdog
+escalation policy, serving requeue) is exercised by tests on the CPU
+mesh instead of by waiting for real hardware to fail.
+
+Faults are DETERMINISTIC: a rule fires on exact occurrence counts of a
+named site, never on a random draw, so a failing chaos test replays
+bit-for-bit. Sites are cheap named checkpoints on the hot paths —
+``chaos.fire("kvstore.pushpull_fused", ...)`` — that reduce to one
+guarded branch (``enabled()``: a list check + one `_fastenv` read, the
+PR 2 cost model) when no spec is installed. With ``MXNET_CHAOS`` unset
+and no programmatic rules there is no behavior change anywhere.
+
+Spec grammar (``MXNET_CHAOS`` env var, or ``install(spec)``)::
+
+    spec  := rule (';' rule)*
+    rule  := <site-glob> ':' <fault> (':' key '=' value)*
+    fault := delay | hang | error | nan | crash | sigterm
+
+    keys: at=N     fire on the Nth match of this rule (0-based)
+          every=N  fire on every Nth match (occ % N == 0)
+          count=M  total firings allowed (default 1; 0 = unlimited)
+          ms=F     delay/hang duration in milliseconds
+                   (delay default 100, hang default 30000)
+          rank=R   only on jax process R (other ranks don't count occs)
+          code=C   exit code for crash (default 13)
+
+    MXNET_CHAOS="kvstore.pushpull_fused:delay:ms=250:at=3"
+    MXNET_CHAOS="io.read:error:count=2;trainer.grads:nan:at=5"
+
+Programmatic rules stack on top of the env spec::
+
+    from mxnet_tpu.observability import chaos
+    chaos.inject("serving.dispatch", "error", at=2)
+    ...
+    chaos.reset()
+
+Fault semantics at a site:
+
+* ``delay`` — sleep ``ms`` (straggler injection; the PR 3 detector's
+  natural prey).
+* ``hang``  — block up to ``ms`` (default 30 s) or until ``release()``
+  — a rank that stopped dispatching, the watchdog's prey.
+* ``error`` — raise ``ChaosError`` (an ``OSError``, so io retry paths
+  treat it as a transient read failure).
+* ``nan``   — returned to the caller in the fired list; sites that own
+  a value (gradients) poison it via ``poison_ndarrays``. Injecting a
+  value corruption is necessarily cooperative — chaos cannot know the
+  shape of every site's payload.
+* ``crash`` — ``os._exit(code)``: SIGKILL semantics, no cleanup, no
+  atexit — the commit-point torture test.
+* ``sigterm`` — ``os.kill(getpid(), SIGTERM)``: a preemption notice;
+  exercises the emergency-checkpoint handler.
+
+``stats`` is the always-on cheap view (the ``kv.dispatch_stats``
+pattern); with ``MXNET_OBS=1`` every firing also lands a
+``chaos.inject`` instant + ``chaos.injected``/``chaos.<fault>``
+counters in the trace, and skipped-update steps (the NaN guard) count
+``chaos.skipped_steps`` — so a post-mortem trace shows exactly which
+fault fired where.
+
+The step guards (``MXNET_STEP_GUARD=1``) live here too: Trainer/Module
+ask ``step_guard_enabled()`` + ``all_finite()`` before applying an
+update, skip the step on non-finite loss/grads (backing off the AMP
+loss scale when one is attached), and count the skip — weights are
+never poisoned by one bad batch.
+"""
+
+import fnmatch
+import os
+import signal
+import threading
+import time
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["ChaosError", "Rule", "enabled", "fire", "inject", "install",
+           "reset", "release", "rules", "stats", "poison_ndarrays",
+           "step_guard_enabled", "all_finite", "count_skipped_step"]
+
+FAULTS = ("delay", "hang", "error", "nan", "crash", "sigterm")
+
+DEFAULT_DELAY_MS = 100.0
+DEFAULT_HANG_MS = 30000.0
+DEFAULT_CRASH_CODE = 13
+
+
+class ChaosError(OSError):
+    """The injected transient failure. Subclasses OSError so retrying
+    readers (io.py) treat it exactly like a real flaky read."""
+
+
+class Rule(object):
+    """One parsed injection rule. ``seen`` counts matches (after the
+    rank filter), ``fired`` counts executions — both are the replayable
+    determinism this module is named for."""
+
+    __slots__ = ("pattern", "fault", "at", "every", "count", "ms",
+                 "rank", "code", "seen", "fired")
+
+    def __init__(self, pattern, fault, at=None, every=None, count=1,
+                 ms=None, rank=None, code=DEFAULT_CRASH_CODE):
+        if fault not in FAULTS:
+            raise ValueError("unknown chaos fault %r (one of %s)"
+                             % (fault, "/".join(FAULTS)))
+        self.pattern = pattern
+        self.fault = fault
+        self.at = None if at is None else int(at)
+        self.every = None if every is None else int(every)
+        self.count = int(count)
+        self.ms = None if ms is None else float(ms)
+        self.rank = None if rank is None else int(rank)
+        self.code = int(code)
+        self.seen = 0
+        self.fired = 0
+
+    def __repr__(self):
+        return ("Rule(%r, %r, at=%s, every=%s, count=%s, ms=%s, "
+                "rank=%s, seen=%d, fired=%d)"
+                % (self.pattern, self.fault, self.at, self.every,
+                   self.count, self.ms, self.rank, self.seen,
+                   self.fired))
+
+    def matches(self, site):
+        return fnmatch.fnmatchcase(site, self.pattern)
+
+    def due(self):
+        """Called under the lock with ``seen`` NOT yet incremented for
+        this occurrence; decides whether this occurrence fires."""
+        occ = self.seen
+        if self.count and self.fired >= self.count:
+            return False
+        if self.at is not None:
+            return occ == self.at
+        if self.every is not None:
+            return occ % self.every == 0
+        return True
+
+
+def parse_spec(spec):
+    """``site:fault[:k=v]*`` rules joined by ``;`` -> list of Rule."""
+    out = []
+    for chunk in (spec or "").split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                "chaos rule %r needs at least <site>:<fault>" % chunk)
+        kw = {}
+        for kv in parts[2:]:
+            if "=" not in kv:
+                raise ValueError(
+                    "chaos rule %r: expected key=value, got %r"
+                    % (chunk, kv))
+            k, v = kv.split("=", 1)
+            if k not in ("at", "every", "count", "ms", "rank", "code"):
+                raise ValueError(
+                    "chaos rule %r: unknown key %r" % (chunk, k))
+            kw[k] = v
+        out.append(Rule(parts[0], parts[1], **kw))
+    return out
+
+
+_lock = threading.Lock()
+_prog = []              # programmatic rules (inject()/install())
+_env_spec = None        # spec string the cached _env_rules were built from
+_env_rules = []
+_release = threading.Event()
+
+# always-on cheap counters (the kv.dispatch_stats pattern); obs
+# counters mirror them when MXNET_OBS is on
+stats = {"fired": 0, "skipped_steps": 0}
+for _f in FAULTS:
+    stats[_f] = 0
+
+
+def enabled():
+    """THE site guard: any programmatic rule, or MXNET_CHAOS set. One
+    list check + one `_fastenv` read — the PR 2 off-cost budget."""
+    if _prog:
+        return True
+    v = _fastenv.get("MXNET_CHAOS")
+    return bool(v)
+
+
+def _current_rules():
+    """Programmatic rules + (cached) env-spec rules. The cache is keyed
+    on the spec STRING so a monkeypatched env rebuilds, while an
+    unchanged spec keeps its occurrence counters across calls."""
+    global _env_spec, _env_rules
+    spec = _fastenv.get("MXNET_CHAOS") or ""
+    if spec != _env_spec:
+        _env_rules = parse_spec(spec)
+        _env_spec = spec
+    return _prog + _env_rules
+
+
+def rules():
+    """Snapshot of the active rules (live objects — counters visible)."""
+    with _lock:
+        return list(_current_rules())
+
+
+def _rank():
+    from . import dist
+    try:
+        return dist.process_index()
+    except Exception:
+        return 0
+
+
+def fire(site, **info):
+    """Run the chaos checkpoint named ``site``. Executes every due
+    matching rule's fault and returns the list of fault names fired
+    (callers act on ``"nan"`` themselves). May sleep, raise
+    ChaosError, SIGTERM the process, or _exit — by design."""
+    if not enabled():
+        return ()
+    due = []
+    with _lock:
+        rs = _current_rules()
+        rank = None
+        for r in rs:
+            if not r.matches(site):
+                continue
+            if r.rank is not None:
+                if rank is None:
+                    rank = _rank()
+                if r.rank != rank:
+                    continue
+            if r.due():
+                due.append(r)
+                r.fired += 1
+            r.seen += 1
+        for r in due:
+            stats["fired"] += 1
+            stats[r.fault] += 1
+    if not due:
+        return ()
+    fired = tuple(r.fault for r in due)
+    if core.enabled():
+        for r in due:
+            core.counter("chaos.injected").add(1)
+            core.counter("chaos." + r.fault).add(1)
+            core.record_instant(
+                "chaos.inject", cat="chaos",
+                args=dict(info, site=site, fault=r.fault,
+                          occurrence=r.seen - 1))
+    for r in due:
+        _execute(r, site)
+    return fired
+
+
+def _execute(rule, site):
+    if rule.fault == "delay":
+        time.sleep((DEFAULT_DELAY_MS if rule.ms is None
+                    else rule.ms) / 1e3)
+    elif rule.fault == "hang":
+        # blocks until release() or the (bounded) hang budget — a rank
+        # that stopped dispatching, from the peers' point of view
+        _release.wait((DEFAULT_HANG_MS if rule.ms is None
+                       else rule.ms) / 1e3)
+    elif rule.fault == "error":
+        raise ChaosError(
+            "chaos: injected fault at site %r (occurrence %d of rule %r)"
+            % (site, rule.seen - 1, rule.pattern))
+    elif rule.fault == "crash":
+        os._exit(rule.code)          # SIGKILL semantics: no cleanup
+    elif rule.fault == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+    # "nan" has no side effect here: the caller owns the value
+
+
+def release():
+    """Unblock every in-flight ``hang`` fault (tests un-wedge the rank
+    they hung)."""
+    _release.set()
+
+
+def inject(site, fault, **kw):
+    """Install one programmatic rule; returns it (live counters)."""
+    r = Rule(site, fault, **kw)
+    with _lock:
+        _prog.append(r)
+    return r
+
+
+def install(spec):
+    """Install a whole spec string programmatically (the env grammar)."""
+    rs = parse_spec(spec)
+    with _lock:
+        _prog.extend(rs)
+    return rs
+
+
+def reset():
+    """Drop programmatic rules, forget the env-spec cache (counters
+    restart), clear stats and the hang release latch."""
+    global _env_spec, _env_rules
+    with _lock:
+        del _prog[:]
+        _env_spec = None
+        _env_rules = []
+        for k in stats:
+            stats[k] = 0
+    _release.clear()
+
+
+# ----------------------------------------------------- value poisoning --
+
+def poison_ndarrays(site, arrays, **info):
+    """Fire ``site`` and, if a ``nan`` rule was due, overwrite every
+    float NDArray in ``arrays`` with NaN (a gradient gone bad). Returns
+    True when poisoned. One guarded branch when chaos is off."""
+    if not enabled():
+        return False
+    if "nan" not in fire(site, **info):
+        return False
+    import jax.numpy as jnp
+    for a in arrays:
+        data = getattr(a, "_data", None)
+        if data is None or not jnp.issubdtype(data.dtype, jnp.floating):
+            continue
+        a._data = jnp.full_like(data, jnp.nan)
+    return True
+
+
+# --------------------------------------------------------- step guards --
+
+def step_guard_enabled():
+    """MXNET_STEP_GUARD=1 arms the Trainer/Module non-finite step
+    guard. Off by default: the finiteness check syncs one scalar from
+    device per step, a cost the un-armed hot path must not pay."""
+    v = _fastenv.get("MXNET_STEP_GUARD")
+    return v is not None and v not in ("", "0", "false", "False")
+
+
+def all_finite(datas):
+    """One device-side finiteness verdict over a list of jax arrays
+    (floats checked, ints vacuously finite); a single bool syncs to
+    host."""
+    import jax.numpy as jnp
+    verdicts = []
+    for d in datas:
+        if d is None:
+            continue
+        if jnp.issubdtype(jnp.asarray(d).dtype, jnp.floating):
+            verdicts.append(jnp.all(jnp.isfinite(d)))
+    if not verdicts:
+        return True
+    ok = verdicts[0]
+    for v in verdicts[1:]:
+        ok = jnp.logical_and(ok, v)
+    return bool(ok)
+
+
+def count_skipped_step(where, scaler=None):
+    """Bookkeeping for one guarded (skipped) update: the always-on
+    stats view, the obs counter/instant when recording, and the AMP
+    loss-scale backoff when a scaler rides the trainer."""
+    with _lock:
+        stats["skipped_steps"] += 1
+    if core.enabled():
+        core.counter("chaos.skipped_steps").add(1)
+        core.record_instant("chaos.step_skipped", cat="chaos",
+                            args={"where": where})
+    if scaler is not None:
+        try:
+            scaler.update_scale(True)    # overflow=True: back off
+        except Exception:
+            pass

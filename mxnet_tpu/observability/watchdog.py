@@ -20,10 +20,19 @@ few times per second only while operations are in flight. The sideband
 check-in (two small file writes per collective) happens only when the
 directory knob is set.
 
-The watchdog never kills the process: training may still complete if
-the missing rank eventually arrives (the post-mortem then gets a
-"completed after post-mortem" follow-up), and on a real hang the
-operator gets the report while attaching a debugger.
+Escalation policy (``MXNET_OBS_WATCHDOG_ACTION``): by default
+(``report``) the watchdog never kills the process — training may still
+complete if the missing rank eventually arrives (the post-mortem then
+gets a "completed after post-mortem" follow-up), and on a real hang the
+operator gets the report while attaching a debugger. Under a
+supervisor (k8s restart policy, a relaunch loop) hanging forever is
+the WORSE outcome, so two escalations exist: ``abort`` exits with
+``ABORT_EXIT_CODE`` right after the post-mortem, and ``checkpoint``
+first runs the registered emergency hook
+(``models/checkpoint.install_emergency_checkpoint`` wires
+``save_emergency_checkpoint``) so the restart resumes from the hang
+point instead of the last routine save — then aborts. Escalation fires
+at most once per process; the post-mortem is always dumped first.
 """
 
 import json
@@ -37,9 +46,32 @@ from . import core
 from .. import _fastenv
 
 __all__ = ["timeout_s", "enabled", "sideband_dir", "CollectiveWatchdog",
-           "get_watchdog", "watch", "read_sideband"]
+           "get_watchdog", "watch", "read_sideband", "action",
+           "set_emergency_hook", "ABORT_EXIT_CODE"]
 
 DEFAULT_POLL_S = 0.25
+
+# distinctive, supervisor-visible exit for watchdog-driven aborts
+ABORT_EXIT_CODE = 43
+
+_ACTIONS = ("report", "checkpoint", "abort")
+
+_emergency_hook = None
+
+
+def action():
+    """MXNET_OBS_WATCHDOG_ACTION: report (default) | checkpoint |
+    abort. Unknown values degrade to report."""
+    a = (_fastenv.get("MXNET_OBS_WATCHDOG_ACTION") or "report").lower()
+    return a if a in _ACTIONS else "report"
+
+
+def set_emergency_hook(fn):
+    """Register ``fn(reason)`` to run before a ``checkpoint``-action
+    abort (normally ``models.checkpoint.save_emergency_checkpoint``).
+    Pass None to clear."""
+    global _emergency_hook
+    _emergency_hook = fn
 
 
 def timeout_s():
@@ -81,13 +113,18 @@ class CollectiveWatchdog(object):
     daemon thread."""
 
     def __init__(self, timeout=None, clock=time.monotonic, rank=None,
-                 nprocs=None, thread=True, emit=None):
+                 nprocs=None, thread=True, emit=None, action=None,
+                 abort=None, emergency_hook=None):
         self._timeout = timeout
         self.clock = clock
         self._rank = rank
         self._nprocs = nprocs
         self._use_thread = thread
         self._emit = emit
+        self._action = action        # None -> env knob; tests inject
+        self._abort = abort          # None -> os._exit(ABORT_EXIT_CODE)
+        self._emergency_hook = emergency_hook   # None -> module hook
+        self._escalated = False
         self._cv = threading.Condition()
         self._active = {}            # token -> op dict
         self._seq = 0
@@ -99,6 +136,10 @@ class CollectiveWatchdog(object):
     @property
     def timeout(self):
         return timeout_s() if self._timeout is None else float(self._timeout)
+
+    @property
+    def escalation(self):
+        return action() if self._action is None else self._action
 
     @property
     def rank(self):
@@ -178,6 +219,49 @@ class CollectiveWatchdog(object):
             "watchdog timeout on rank %d — post-mortem dumped"
             % (op["name"], self.timeout, self.rank),
             RuntimeWarning, stacklevel=2)
+        self._escalate(op)
+
+    # ------------------------------------------------------ escalation --
+    def _escalate(self, op):
+        """MXNET_OBS_WATCHDOG_ACTION policy, applied AFTER the
+        post-mortem: ``checkpoint`` runs the emergency hook (best
+        effort — the collective is hung, the step state is the last
+        completed one) then aborts; ``abort`` aborts directly so a
+        supervisor can restart the job instead of watching it hang.
+        At most once per process."""
+        act = self.escalation
+        if act == "report" or self._escalated:
+            return
+        self._escalated = True
+        if act == "checkpoint":
+            hook = self._emergency_hook if self._emergency_hook \
+                is not None else _emergency_hook
+            if hook is None:
+                self._report(
+                    "[watchdog] rank %d: action=checkpoint but no "
+                    "emergency hook registered (see models.checkpoint."
+                    "install_emergency_checkpoint) — aborting without "
+                    "a hang-point checkpoint" % self.rank)
+            else:
+                try:
+                    path = hook("watchdog:%s" % op["name"])
+                    self._report(
+                        "[watchdog] rank %d: emergency checkpoint %s "
+                        "committed before abort" % (self.rank, path))
+                except Exception as e:     # noqa: BLE001 — last gasp
+                    self._report(
+                        "[watchdog] rank %d: emergency checkpoint "
+                        "FAILED (%s: %s) — aborting anyway"
+                        % (self.rank, type(e).__name__, e))
+        self._report(
+            "[watchdog] rank %d: action=%s — aborting with exit code "
+            "%d for supervisor restart" % (self.rank, act,
+                                           ABORT_EXIT_CODE))
+        if self._abort is not None:
+            self._abort(ABORT_EXIT_CODE)
+        else:                              # pragma: no cover - fatal
+            sys.stderr.flush()
+            os._exit(ABORT_EXIT_CODE)
 
     def _report(self, text):
         if self._emit is not None:

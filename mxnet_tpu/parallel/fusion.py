@@ -43,6 +43,7 @@ except AttributeError:                  # 0.4.x experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .. import _fastenv
+from ..observability import chaos as _chaos
 from ..observability import watchdog as _wd
 
 __all__ = ["DEFAULT_BUCKET_BYTES", "bucket_bytes", "fusion_enabled",
@@ -505,6 +506,11 @@ class ShardSlot(object):
         with _wd.watch("fusion.shard_update", lane=str(self.lane.dtype),
                        bytes=self.l_pad * self.mdtype.itemsize,
                        keys=len(self.lane.segments)):
+            if _chaos.enabled():
+                # chaos site: the sharded-update program is one of the
+                # named collectives the injection harness can hang
+                _chaos.fire("fusion.shard_update",
+                            lane=str(self.lane.dtype))
             self.flat_w, self.states = self._fns[scatter](
                 g, self.flat_w, self.states, scalars, mults)
             gathered = _gather_fn(self.devices, self.l_pad,

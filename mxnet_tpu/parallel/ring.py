@@ -59,6 +59,7 @@ def _shard_map(f, mesh, in_specs, out_specs, axis_names=None,
 
 
 from . import ring_permute
+from ..observability import chaos as _chaos
 from ..observability import watchdog as _wd
 
 __all__ = ["ring_attention", "local_attention_block",
@@ -70,10 +71,15 @@ def _watched_dispatch(name, fn, *args, **info):
     watchdog off (the default) this is a single guarded branch around a
     plain call; armed, completion is awaited inside the watched window
     so a rank stuck in the ring's ppermute/psum rendezvous produces a
-    post-mortem instead of a silent stall."""
+    post-mortem instead of a silent stall. The chaos site of the same
+    name can delay/hang/fail the dispatch for the injection harness."""
     if not _wd.enabled():
+        if _chaos.enabled():
+            _chaos.fire(name, **{k: str(v) for k, v in info.items()})
         return fn(*args)
     with _wd.watch(name, **info):
+        if _chaos.enabled():
+            _chaos.fire(name, **{k: str(v) for k, v in info.items()})
         out = fn(*args)
         jax.block_until_ready(out)
     return out

@@ -9,15 +9,25 @@ run step for step. Plus the serving side: an int8-quantized tree must
 round-trip to disk exactly.
 """
 
+import json
+import os
+import subprocess
+import sys
+
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mxnet_tpu.models import transformer as T
 from mxnet_tpu.models.checkpoint import (
-    save_checkpoint, load_checkpoint, restore_train_state)
+    save_checkpoint, load_checkpoint, restore_train_state,
+    CheckpointCorrupt, list_checkpoints, resume_from_latest,
+    wait_for_pending_save)
 from mxnet_tpu.parallel import make_mesh
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _cfg(**kw):
@@ -181,3 +191,299 @@ def test_load_rejects_non_checkpoint(tmp_path):
         json.dump({"format": "something-else"}, f)
     with pytest.raises(ValueError):
         load_checkpoint(str(tmp_path / "bad"))
+
+
+# ------------------------------------------------ corruption detection --
+
+def _arrays_file(path):
+    with open(os.path.join(path, "manifest.json")) as f:
+        return json.load(f)["arrays_file"]
+
+
+def test_truncated_data_file_raises_named_digest(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=0), step=3)
+    data = os.path.join(ck, _arrays_file(ck))
+    with open(data, "r+b") as f:
+        f.truncate(os.path.getsize(data) // 2)
+    with pytest.raises(CheckpointCorrupt) as e:
+        load_checkpoint(ck)
+    msg = str(e.value)
+    assert "arrays-" in msg        # names the file
+    assert ck in msg
+
+
+def test_flipped_bytes_raise_digest_mismatch(tmp_path):
+    """Same size, corrupt payload: only the per-array crc32 can catch
+    this — the failure names expected vs actual digest."""
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=0), step=3)
+    data = os.path.join(ck, _arrays_file(ck))
+    blob = bytearray(open(data, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF   # one flipped byte mid-payload
+    with open(data, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(CheckpointCorrupt) as e:
+        load_checkpoint(ck)
+    assert ("digest" in str(e.value) or "unreadable" in str(e.value))
+
+
+def test_missing_data_file_raises_clear_error(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=0), step=3)
+    os.remove(os.path.join(ck, _arrays_file(ck)))
+    with pytest.raises(CheckpointCorrupt, match="missing"):
+        load_checkpoint(ck)
+
+
+def test_corrupt_newest_falls_back_to_retained(tmp_path):
+    """keep=2 retains the previous checkpoint; when the newest is torn
+    the loader warns and recovers the older one instead of dying."""
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    p1 = T.init_params(cfg, seed=1)
+    save_checkpoint(ck, cfg, p1, step=1, keep=2)
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=2), step=2, keep=2)
+    data = os.path.join(ck, _arrays_file(ck))
+    with open(data, "r+b") as f:
+        f.truncate(10)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        _, loaded, _, step, _ = load_checkpoint(ck)
+    assert step == 1
+    _tree_equal(p1, loaded)
+    # fallback=False keeps the old strict contract
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(ck, fallback=False)
+
+
+# ------------------------------------------------------------ retention --
+
+def test_keep_n_retention_gc(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    for step in range(1, 5):
+        save_checkpoint(ck, cfg, T.init_params(cfg, seed=step),
+                        step=step, keep=2)
+    steps = [s for s, _ in list_checkpoints(ck)]
+    assert steps == [3, 4]
+    data_files = [f for f in os.listdir(ck) if f.startswith("arrays")]
+    assert len(data_files) == 2
+    _, _, _, step, _ = load_checkpoint(ck)
+    assert step == 4
+
+
+def test_keep_default_matches_previous_single_checkpoint(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=1), step=1)
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=2), step=2)
+    data_files = [f for f in os.listdir(ck) if f.startswith("arrays")]
+    assert len(data_files) == 1
+    assert [s for s, _ in list_checkpoints(ck)] == [2]
+
+
+# ----------------------------------------------------------- async save --
+
+def test_async_save_round_trip_and_barrier(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    params = T.init_params(cfg, seed=0)
+    mom = T.init_momentum(params)
+    snapshot = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+    save_checkpoint(ck, cfg, params, momentum=mom, step=4,
+                    async_save=True)
+    # donation-safety: the training thread immediately feeds the SAME
+    # arrays to a donating step while the saver thread writes
+    step_fn = T.make_train_step(cfg, lr=0.1)
+    tokens = _tokens(cfg, batch=4)
+    params, mom, _ = step_fn(params, mom, tokens)
+    wait_for_pending_save()
+    _, loaded, mom_l, step, _ = load_checkpoint(ck)
+    assert step == 4 and mom_l is not None
+    _tree_equal(snapshot, loaded)   # the at-save snapshot, not post-step
+
+
+def test_async_save_next_save_is_barrier(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    params = T.init_params(cfg, seed=0)
+    save_checkpoint(ck, cfg, params, step=1, async_save=True, keep=2)
+    save_checkpoint(ck, cfg, params, step=2, keep=2)   # joins pending
+    assert [s for s, _ in list_checkpoints(ck)] == [1, 2]
+
+
+# ----------------------------------------- commit point under kill -9 --
+
+_KILL9_WORKER = r"""
+import os, sys
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from mxnet_tpu.models import transformer as T
+from mxnet_tpu.models.checkpoint import save_checkpoint
+cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=16)
+ck = sys.argv[1]
+save_checkpoint(ck, cfg, T.init_params(cfg, seed=1), step=1, keep=2)
+print("FIRST-SAVE-OK", flush=True)
+# the second save dies between the data-file write and the manifest
+# commit (SIGKILL semantics via the chaos crash fault)
+os.environ["MXNET_CHAOS"] = "checkpoint.write:crash:code=19"
+save_checkpoint(ck, cfg, T.init_params(cfg, seed=2), step=2, keep=2)
+print("UNREACHABLE", flush=True)
+"""
+
+
+def test_kill9_mid_save_leaves_previous_checkpoint_loadable(tmp_path):
+    """The commit-point contract: a process killed -9 between writing
+    arrays-*.npz and committing the manifest leaves the PREVIOUS
+    checkpoint fully loadable (and the torn remains are swept by the
+    next successful save)."""
+    ck = str(tmp_path / "ck")
+    r = subprocess.run(
+        [sys.executable, "-c", _KILL9_WORKER % {"root": ROOT}, ck],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert "FIRST-SAVE-OK" in r.stdout, r.stderr
+    assert "UNREACHABLE" not in r.stdout
+    assert r.returncode == 19
+    cfg = _cfg()
+    _, loaded, _, step, _ = load_checkpoint(ck)
+    assert step == 1
+    _tree_equal(T.init_params(cfg, seed=1), loaded)
+    # a later save sweeps the orphaned step-2 data file
+    save_checkpoint(ck, cfg, T.init_params(cfg, seed=3), step=3)
+    data_files = [f for f in os.listdir(ck) if f.startswith("arrays")]
+    assert len(data_files) == 1
+
+
+# ------------------------------------------------- SIGTERM preemption --
+
+_SIGTERM_WORKER = r"""
+import os, signal, sys
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from mxnet_tpu.models import transformer as T
+from mxnet_tpu.models.checkpoint import install_emergency_checkpoint
+cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=16)
+params = T.init_params(cfg, seed=0)
+mom = T.init_momentum(params)
+state = {"step": 0}
+install_emergency_checkpoint(
+    sys.argv[1], lambda: {"cfg": cfg, "params": params,
+                          "momentum": mom, "step": state["step"]})
+step_fn = T.make_train_step(cfg, lr=0.1)
+import jax.numpy as jnp
+tokens = jnp.zeros((2, 16), jnp.int32)
+for i in range(1, 4):
+    params, mom, loss = step_fn(params, mom, tokens)
+    state["step"] = i
+print("PRE-SIGTERM step=%%d" %% state["step"], flush=True)
+os.kill(os.getpid(), signal.SIGTERM)   # the preemption notice
+print("UNREACHABLE", flush=True)
+"""
+
+
+def test_sigterm_triggers_emergency_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    r = subprocess.run(
+        [sys.executable, "-c", _SIGTERM_WORKER % {"root": ROOT}, ck],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert "PRE-SIGTERM step=3" in r.stdout, r.stderr
+    assert "UNREACHABLE" not in r.stdout
+    assert r.returncode == 143          # 128 + SIGTERM
+    assert "emergency checkpoint committed" in r.stdout
+    _, params, mom, step = restore_train_state(str(tmp_path / "ck"),
+                                               mesh=None)
+    assert step == 3 and mom is not None
+    meta = load_checkpoint(ck)[4]
+    assert meta["emergency"] == "sigterm"
+
+
+# -------------------------------------------------- resume-from-latest --
+
+def test_resume_from_latest_init_and_resume(tmp_path):
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    calls = []
+
+    def fresh():
+        calls.append(1)
+        p = T.init_params(cfg, seed=0)
+        return cfg, p, T.init_momentum(p), 0
+
+    c1, p1, m1, s1 = resume_from_latest(ck, init=fresh)
+    assert s1 == 0 and calls == [1]
+    save_checkpoint(ck, cfg, p1, momentum=m1, step=5)
+    c2, p2, m2, s2 = resume_from_latest(ck, init=fresh)
+    assert s2 == 5 and calls == [1]     # init NOT called again
+    _tree_equal(p1, p2)
+    with pytest.raises(FileNotFoundError):
+        resume_from_latest(str(tmp_path / "void"))
+
+
+_RESUME_WORKER = r"""
+import os, sys
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from mxnet_tpu.models import transformer as T
+from mxnet_tpu.models.checkpoint import (save_checkpoint,
+                                         resume_from_latest)
+cfg = T.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=16)
+ckdir, steps, crash_after = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+crash_after = int(crash_after) if crash_after != "none" else None
+rng = np.random.RandomState(0)
+tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+
+def fresh():
+    p = T.init_params(cfg, seed=0)
+    return cfg, p, T.init_momentum(p), 0
+
+_, params, mom, start = resume_from_latest(ckdir, init=fresh)
+step_fn = T.make_train_step(cfg, lr=0.1)
+for step in range(start + 1, steps + 1):
+    params, mom, loss = step_fn(params, mom, tokens)
+    # bit-exact resume needs the loss DIGITS, not a rounding
+    print("LOSS %%d %%s" %% (step, float(loss).hex()), flush=True)
+    save_checkpoint(ckdir, cfg, params, momentum=mom, step=step,
+                    keep=2)
+    if crash_after is not None and step >= crash_after:
+        os._exit(21)     # hard crash, mid-run
+"""
+
+
+@pytest.mark.slow
+def test_two_process_crash_resume_matches_uninterrupted(tmp_path):
+    """The satellite resume test: process 1 trains and hard-crashes at
+    step 3; process 2 resumes from the latest checkpoint and finishes.
+    The concatenated loss trajectory must be BIT-exact (float hex)
+    against an uninterrupted run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(ckdir, steps, crash):
+        return subprocess.run(
+            [sys.executable, "-c", _RESUME_WORKER % {"root": ROOT},
+             ckdir, str(steps), crash],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    base = run(str(tmp_path / "a"), 6, "none")
+    assert base.returncode == 0, base.stderr
+    losses_a = [l.split()[1:] for l in base.stdout.splitlines()
+                if l.startswith("LOSS")]
+
+    crashed = run(str(tmp_path / "b"), 6, "3")
+    assert crashed.returncode == 21
+    resumed = run(str(tmp_path / "b"), 6, "none")
+    assert resumed.returncode == 0, resumed.stderr
+    losses_b = [l.split()[1:] for l in
+                (crashed.stdout + resumed.stdout).splitlines()
+                if l.startswith("LOSS")]
+    assert losses_b == losses_a
+    assert [s for s, _ in losses_b] == [str(i) for i in range(1, 7)]

@@ -1,0 +1,372 @@
+"""Chaos smoke: one injected fault per class, recovery asserted.
+
+Run by the opt-in tier-1 lane (``TIER1_CHAOS=1 ci/tier1.sh``) and
+usable standalone:
+
+    MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+Every fault class from docs/ROBUSTNESS.md gets one scenario, and each
+scenario asserts BOTH halves of the loop — the fault fired (chaos
+stats / post-mortem artifact) and the system recovered (weights
+intact, stream bit-exact, checkpoint loadable, resume bit-exact):
+
+  nan      trainer step guard skips the poisoned update; weights
+           bit-identical, chaos.skipped_steps counted
+  ioerror  record iterator retries two injected read failures and
+           still delivers every batch
+  serving  an injected dispatch failure frees the lanes and requeues;
+           greedy streams match solo generate() bit-exactly
+  hang     (subprocess) a hung collective under
+           MXNET_OBS_WATCHDOG_ACTION=checkpoint dumps a post-mortem,
+           commits an emergency checkpoint, aborts with exit 43 — and
+           that checkpoint restores
+  sigterm  (subprocess) an injected preemption triggers the emergency
+           SIGTERM save; exit 143, checkpoint at the preempted step
+  crash    (subprocesses) an injected hard crash mid-run, then a
+           relaunch via resume_from_latest: the concatenated loss
+           trajectory is bit-exact (float hex) vs an uninterrupted run
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("MXNET_OBS", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as T
+    return T.TransformerConfig(vocab_size=41, d_model=16, n_heads=2,
+                               n_layers=1, d_ff=32, max_len=32,
+                               dtype=jnp.float32)
+
+
+# ------------------------------------------------------------ scenarios --
+
+def nan_guard():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.observability import chaos
+
+    os.environ["MXNET_STEP_GUARD"] = "1"
+    chaos.reset()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="device")
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.random.uniform(shape=(4, 6))
+    y = mx.nd.random.uniform(shape=(4, 2))
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+
+    step()
+    before = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    chaos.inject("trainer.grads", "nan", at=0)
+    step()                                    # poisoned -> skipped
+    after = {k: v.data().asnumpy().copy()
+             for k, v in net.collect_params().items()}
+    for k in before:
+        if not np.array_equal(before[k], after[k]):
+            print("[chaos_smoke] FAIL(nan): weights moved on a "
+                  "poisoned step (%s)" % k)
+            return 1
+    if chaos.stats["skipped_steps"] != 1:
+        print("[chaos_smoke] FAIL(nan): skipped_steps=%r"
+              % chaos.stats["skipped_steps"])
+        return 1
+    step()                                    # rule exhausted: resumes
+    resumed = {k: v.data().asnumpy().copy()
+               for k, v in net.collect_params().items()}
+    if all(np.array_equal(before[k], resumed[k]) for k in before):
+        print("[chaos_smoke] FAIL(nan): training did not resume")
+        return 1
+    chaos.reset()
+    print("[chaos_smoke] nan OK: poisoned step skipped, weights "
+          "bit-identical, training resumed")
+    return 0
+
+
+def ioerror():
+    import numpy as np
+    from mxnet_tpu import io as mx_io, recordio
+    from mxnet_tpu.observability import chaos
+
+    chaos.reset()
+    os.environ["MXNET_IO_BACKOFF_MS"] = "1"
+    d = tempfile.mkdtemp(prefix="chaos_smoke_io_")
+    path, idx = os.path.join(d, "img.rec"), os.path.join(d, "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (8, 8, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".npy"))
+    w.close()
+    chaos.inject("io.read", "error", count=2)
+    it = mx_io.ImageRecordIter(path_imgrec=path, path_imgidx=idx,
+                               data_shape=(3, 8, 8), batch_size=4)
+    batches = list(it)
+    if len(batches) != 2 or chaos.stats["error"] != 2:
+        print("[chaos_smoke] FAIL(ioerror): batches=%d injected=%d"
+              % (len(batches), chaos.stats["error"]))
+        return 1
+    chaos.reset()
+    print("[chaos_smoke] ioerror OK: 2 injected read failures retried, "
+          "full epoch delivered")
+    return 0
+
+
+def serving():
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import chaos
+
+    chaos.reset()
+    cfg = _tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    rng = np.random.RandomState(0)
+    jobs = [(list(rng.randint(1, 41, 4)), 6) for _ in range(3)]
+    solo = [np.asarray(T.generate(params,
+                                  jnp.asarray([p], jnp.int32), n, cfg,
+                                  greedy=True))[0].tolist()
+            for p, n in jobs]
+    chaos.inject("serving.dispatch", "error", at=1)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    results, order = srv.run(jobs)
+    if len(results) != len(jobs) or chaos.stats["error"] != 1:
+        print("[chaos_smoke] FAIL(serving): results=%d injected=%d"
+              % (len(results), chaos.stats["error"]))
+        return 1
+    for j, rid in enumerate(order):
+        if results[rid] != solo[j]:
+            print("[chaos_smoke] FAIL(serving): stream %d diverged "
+                  "after requeue" % j)
+            return 1
+    chaos.reset()
+    print("[chaos_smoke] serving OK: dispatch failure requeued, all "
+          "streams bit-exact vs solo generate()")
+    return 0
+
+
+def hang_worker(ckdir):
+    """Subprocess body: one collective hangs; the watchdog must
+    post-mortem, emergency-checkpoint, and abort(43)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models.checkpoint import install_emergency_checkpoint
+
+    cfg = _tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    install_emergency_checkpoint(
+        ckdir, lambda: {"cfg": cfg, "params": params, "step": 7},
+        on_sigterm=False)
+    kv = mx.kvstore.create("device")
+    kv.init(0, mx.nd.ones((8,)))
+    kv.push(0, mx.nd.ones((8,)))     # chaos hangs HERE; watchdog fires
+    print("UNREACHABLE", flush=True)
+    return 1
+
+
+def hang():
+    from mxnet_tpu.observability import watchdog as wd
+    from mxnet_tpu.models.checkpoint import load_checkpoint
+
+    d = tempfile.mkdtemp(prefix="chaos_smoke_hang_")
+    ckdir = os.path.join(d, "ck")
+    sideband = os.path.join(d, "wd")
+    env = dict(os.environ)
+    env.update({
+        "MXNET_OBS": "1",
+        "MXNET_OBS_COLLECTIVE_TIMEOUT": "0.5",
+        "MXNET_OBS_WATCHDOG_ACTION": "checkpoint",
+        "MXNET_OBS_WATCHDOG_DIR": sideband,
+        "MXNET_CHAOS": "kvstore.push:hang:ms=60000",
+        "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+        "CHAOS_SMOKE_WORKER": "hang",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), ckdir],
+        capture_output=True, text=True, timeout=300, env=env)
+    if r.returncode != wd.ABORT_EXIT_CODE or "UNREACHABLE" in r.stdout:
+        print("[chaos_smoke] FAIL(hang): rc=%d\n%s\n%s"
+              % (r.returncode, r.stdout, r.stderr))
+        return 1
+    pm = os.path.join(sideband, "postmortem.rank0.txt")
+    if not os.path.exists(pm):
+        print("[chaos_smoke] FAIL(hang): no post-mortem artifact at %s"
+              % pm)
+        return 1
+    with open(pm) as f:
+        report = f.read()
+    if "kvstore.push" not in report:
+        print("[chaos_smoke] FAIL(hang): post-mortem does not name "
+              "the collective:\n%s" % report)
+        return 1
+    _, _, _, step, meta = load_checkpoint(ckdir)
+    if step != 7 or not str(meta.get("emergency", "")).startswith(
+            "watchdog:"):
+        print("[chaos_smoke] FAIL(hang): emergency checkpoint "
+              "step=%r meta=%r" % (step, meta))
+        return 1
+    print("[chaos_smoke] hang OK: post-mortem names kvstore.push, "
+          "emergency checkpoint loadable at step 7, abort rc=%d"
+          % wd.ABORT_EXIT_CODE)
+    return 0
+
+
+def train_worker(ckdir, steps):
+    """Subprocess body for sigterm/crash scenarios: a restartable
+    training loop — resume_from_latest, per-step checkpoint, a
+    chaos site at every step boundary for the injected faults."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models.checkpoint import (
+        save_checkpoint, resume_from_latest,
+        install_emergency_checkpoint)
+    from mxnet_tpu.observability import chaos
+
+    cfg = _tiny_cfg()
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 41, (4, 32)), jnp.int32)
+
+    def fresh():
+        p = T.init_params(cfg, seed=0)
+        return cfg, p, T.init_momentum(p), 0
+
+    _, params, mom, start = resume_from_latest(ckdir, init=fresh)
+    state = {"params": params, "mom": mom, "step": start}
+    install_emergency_checkpoint(
+        ckdir, lambda: {"cfg": cfg, "params": state["params"],
+                        "momentum": state["mom"],
+                        "step": state["step"]})
+    step_fn = T.make_train_step(cfg, lr=0.1)
+    for step in range(start + 1, steps + 1):
+        params, mom, loss = step_fn(params, mom, tokens)
+        state.update(params=params, mom=mom, step=step)
+        print("LOSS %d %s" % (step, float(loss).hex()), flush=True)
+        save_checkpoint(ckdir, cfg, params, momentum=mom, step=step,
+                        keep=2)
+        chaos.fire("train.step", step=step)   # sigterm/crash land here
+    return 0
+
+
+def sigterm():
+    from mxnet_tpu.models.checkpoint import load_checkpoint
+    d = tempfile.mkdtemp(prefix="chaos_smoke_sigterm_")
+    ckdir = os.path.join(d, "ck")
+    env = dict(os.environ)
+    env.update({"MXNET_CHAOS": "train.step:sigterm:at=1",
+                "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+                "CHAOS_SMOKE_WORKER": "train"})
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), ckdir, "5"],
+        capture_output=True, text=True, timeout=300, env=env)
+    if r.returncode != 143:
+        print("[chaos_smoke] FAIL(sigterm): rc=%d\n%s\n%s"
+              % (r.returncode, r.stdout, r.stderr))
+        return 1
+    _, _, _, step, meta = load_checkpoint(ckdir)
+    if step != 2 or meta.get("emergency") != "sigterm":
+        print("[chaos_smoke] FAIL(sigterm): step=%r meta=%r"
+              % (step, meta))
+        return 1
+    print("[chaos_smoke] sigterm OK: preemption at step 2 committed "
+          "an emergency checkpoint, exit 143")
+    return 0
+
+
+def crash():
+    d = tempfile.mkdtemp(prefix="chaos_smoke_crash_")
+    env_base = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+                "CHAOS_SMOKE_WORKER": "train"}
+
+    def run(ckdir, chaos_spec=None):
+        env = dict(os.environ, **env_base)
+        env.pop("MXNET_CHAOS", None)
+        if chaos_spec:
+            env["MXNET_CHAOS"] = chaos_spec
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), ckdir, "5"],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    base = run(os.path.join(d, "a"))
+    if base.returncode != 0:
+        print("[chaos_smoke] FAIL(crash): baseline rc=%d\n%s"
+              % (base.returncode, base.stderr))
+        return 1
+    want = [l for l in base.stdout.splitlines() if l.startswith("LOSS")]
+
+    crashed = run(os.path.join(d, "b"),
+                  "train.step:crash:at=2:code=21")
+    if crashed.returncode != 21:
+        print("[chaos_smoke] FAIL(crash): injected run rc=%d"
+              % crashed.returncode)
+        return 1
+    resumed = run(os.path.join(d, "b"))
+    if resumed.returncode != 0:
+        print("[chaos_smoke] FAIL(crash): resume rc=%d\n%s"
+              % (resumed.returncode, resumed.stderr))
+        return 1
+    got = [l for l in (crashed.stdout + resumed.stdout).splitlines()
+           if l.startswith("LOSS")]
+    if got != want:
+        print("[chaos_smoke] FAIL(crash): resumed loss trajectory "
+              "diverged:\n  want %s\n  got  %s" % (want, got))
+        return 1
+    print("[chaos_smoke] crash OK: crash at step 3, "
+          "resume-from-latest; %d-step loss trajectory bit-exact"
+          % len(want))
+    return 0
+
+
+SCENARIOS = [("nan", nan_guard), ("ioerror", ioerror),
+             ("serving", serving), ("hang", hang),
+             ("sigterm", sigterm), ("crash", crash)]
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("args", nargs="*")
+    p.add_argument("--only", help="run one scenario (%s)"
+                   % "/".join(n for n, _ in SCENARIOS))
+    args = p.parse_args()
+    worker = os.environ.get("CHAOS_SMOKE_WORKER")
+    if worker == "hang":
+        return hang_worker(args.args[0])
+    if worker == "train":
+        return train_worker(args.args[0], int(args.args[1]))
+    failures = 0
+    for name, fn in SCENARIOS:
+        if args.only and name != args.only:
+            continue
+        failures += fn()
+    if failures:
+        print("[chaos_smoke] %d scenario(s) FAILED" % failures)
+        return 1
+    print("[chaos_smoke] all fault classes recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
