@@ -33,8 +33,16 @@ emulated at ~2x the compute cost, and that emulation tax drowns the
 host-side round-trip effect the A/B exists to measure (on TPU, where
 bf16 is native, the leg keeps the serving default dtype).
 
+After the throughput legs, the continuous-batching pools run once more
+INSTRUMENTED (MXNET_OBS forced on for that run only) to print the
+request-level TTFT / ITL / e2e / queue-wait percentile table from the
+batcher's log-bucketed histograms, emit the same distributions as a
+machine-readable JSON line (captured by run_chip_queue.py's stdout
+archive), and — with ``--json PATH`` — write them as an artifact file.
+
     python - < benchmark/serving_bench.py
     python - --pipeline-depth 2 < benchmark/serving_bench.py
+    python - --json serving_latency.json < benchmark/serving_bench.py
     MXNET_SERVING_SMOKE=1 JAX_PLATFORMS=cpu python - < benchmark/serving_bench.py
 
 Run from /root/repo via stdin so cwd lands on sys.path (leave the
@@ -42,6 +50,7 @@ environment's PYTHONPATH=/root/.axon_site untouched — the axon plugin
 registers through it; overriding OR popping it breaks registration).
 """
 
+import json
 import os
 import sys
 import time
@@ -73,6 +82,69 @@ def _pipeline_depth_arg(argv=None):
         if a.startswith("--pipeline-depth="):
             return int(a.split("=", 1)[1])
     return None
+
+
+def _json_arg(argv=None):
+    """--json PATH from the stdin-run argv: write the per-leg latency
+    distributions there (chip legs archive the artifact next to the
+    BENCH_TABLE stdout capture)."""
+    argv = sys.argv[1:] if argv is None else argv
+    for i, a in enumerate(argv):
+        if a == "--json" and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith("--json="):
+            return a.split("=", 1)[1]
+    return None
+
+
+_LATENCY_HISTS = ("serving.ttft_ms", "serving.itl_ms",
+                  "serving.e2e_ms", "serving.queue_ms")
+
+
+def _latency_report(run_fn, leg, **extra):
+    """One extra run with telemetry ON: collect the request-level
+    TTFT/ITL/e2e/queue-wait histograms the batcher records, print the
+    percentile table + one machine-readable JSON line (the chip queue
+    captures stdout), and return the distributions for the --json
+    artifact. The timed legs above run with telemetry off — the
+    distributions come from their own run so the throughput numbers
+    stay uninstrumented."""
+    from mxnet_tpu.observability import core as obs
+    from mxnet_tpu.observability import histogram as hist
+    obs.set_enabled(True)
+    obs.reset()
+    try:
+        run_fn()
+        dists = {name: h.snapshot()
+                 for name, h in sorted(hist.histograms().items())
+                 if name in _LATENCY_HISTS}
+        goodput = obs.counters().get("serving.goodput_tok_s")
+        goodput = goodput.value if goodput is not None else None
+    finally:
+        obs.set_enabled(None)
+        obs.reset()
+    fmt = "%-22s %8s %10s %10s %10s %10s %10s"
+    print("%s latency percentiles (ms, instrumented run):" % leg)
+    print(fmt % ("metric", "count", "mean", "p50", "p90", "p99",
+                 "p99.9"))
+    for name, s in dists.items():
+        print(fmt % (name, s["count"], "%.3f" % s["mean"],
+                     "%.3f" % s["p50"], "%.3f" % s["p90"],
+                     "%.3f" % s["p99"], "%.3f" % s["p999"]))
+    rec = dict(extra)
+    rec.update({"leg": "%s_latency" % leg, "goodput_tok_s": goodput,
+                "distributions": dists})
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _write_artifact(path, reports):
+    if not path:
+        return
+    with open(path, "w") as f:
+        json.dump({"bench": "serving_bench", "reports": reports}, f,
+                  indent=1)
+    print("wrote latency artifact -> %s" % path, flush=True)
 
 
 def pipeline_ab(depth):
@@ -129,11 +201,13 @@ def pipeline_ab(depth):
         waiting, arr_i, step_i = [], 0, 0
         while arr_i < len(jobs) or waiting or srv.active_count:
             if arr_i < len(jobs) and step_i % 2 == 0:
-                waiting.append(jobs[arr_i])
+                # arrival stamp: queue-wait / TTFT cover time spent
+                # waiting for a lane (only read when telemetry is on)
+                waiting.append((jobs[arr_i], time.perf_counter_ns()))
                 arr_i += 1
             while waiting and srv.has_capacity:
-                p, n = waiting.pop(0)
-                srv.admit(p, n)
+                (p, n), enq = waiting.pop(0)
+                srv.admit(p, n, enqueued_ns=enq)
             srv.step()
             step_i += 1
 
@@ -147,6 +221,11 @@ def pipeline_ab(depth):
           % (depth, sync_rate, pipe_rate, pipe_rate / sync_rate,
              chunk, slots, n_jobs, vocab, np.dtype(dtype).name,
              backend), flush=True)
+    rep = _latency_report(lambda: run_mixed(depth),
+                          "continuous_pipeline_ab",
+                          pipeline_depth=depth, chunk=chunk,
+                          slots=slots, backend=backend)
+    _write_artifact(_json_arg(), [rep])
 
 
 def main():
@@ -309,11 +388,12 @@ def main():
         waiting, arr_i, step_i = [], 0, 0
         while arr_i < len(jobs) or waiting or srv.active_count:
             if arr_i < len(jobs) and step_i % 2 == 0:
-                waiting.append(jobs[arr_i])
+                # arrival stamp: queue-wait / TTFT cover lane waits
+                waiting.append((jobs[arr_i], time.perf_counter_ns()))
                 arr_i += 1
             while waiting and srv.has_capacity:
-                p, n = waiting.pop(0)
-                srv.admit(p, n)
+                (p, n), enq = waiting.pop(0)
+                srv.admit(p, n, enqueued_ns=enq)
             srv.step()
             step_i += 1
 
@@ -322,6 +402,17 @@ def main():
           '"chunk": %d, "slots": %d, "jobs": %d, '
           '"arrival_every_steps": 2}'
           % (rate, chunk, slots, n_jobs), flush=True)
+
+    # --- request-level latency distributions: TTFT/ITL/e2e/queue-wait
+    # percentiles from one instrumented run of each pool leg (the
+    # timed legs above stay uninstrumented) ---
+    reports = [
+        _latency_report(lambda: run_pool(chunk), "continuous",
+                        chunk=chunk, slots=slots, backend=backend),
+        _latency_report(run_mixed_arrival, "continuous_mixed_arrival",
+                        chunk=chunk, slots=slots, backend=backend),
+    ]
+    _write_artifact(_json_arg(), reports)
 
 
 if __name__ == "__main__":

@@ -75,17 +75,23 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
     # two gloo workers train against dist_tpu_sync (clock-anchor
     # handshake at kvstore creation), dump rank-local traces, and the
     # parent merges them — the merged chrome trace must carry BOTH
-    # rank lanes on the aligned timebase (obs_smoke exits non-zero
-    # otherwise). Serial like everything else on the 1-core host.
+    # rank lanes on the aligned timebase AND the bucket-wise merged
+    # trainer.step_ms histogram (per-rank counts sum; obs_smoke exits
+    # non-zero otherwise). Serial like everything else on the 1-core
+    # host.
     if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --nproc 2; then
         echo "[tier1] FAIL: distributed observability smoke"
         exit 1
     fi
 
-    echo "==== [tier1] serving observability smoke (pipelined batcher spans) ===="
-    # a pipelined ContinuousBatcher run must land dispatch/sync/patch
-    # spans + in-flight-depth / lane-occupancy / admit-latency gauges
-    # in the emitted trace (docs/SERVING.md chunk pipelining)
+    echo "==== [tier1] serving observability smoke (request lifecycle + live scrape) ===="
+    # a pipelined ContinuousBatcher run, scraped live mid-run, must
+    # land the full request lifecycle in the emitted trace: dispatch/
+    # sync/patch/prefill/queue-wait spans, complete per-request flow
+    # chains, TTFT/ITL/e2e/queue histograms (mergeable bucket states
+    # included), occupancy/goodput gauges, and /metrics + /healthz
+    # must answer with the serving series (docs/OBSERVABILITY.md
+    # "Serving observability")
     if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --serving; then
         echo "[tier1] FAIL: serving observability smoke"
         exit 1

@@ -18,6 +18,8 @@ update -> all-gather per bucket (PAPERS.md cross-replica sharding),
 which cuts per-replica optimizer state by (N-1)/N.
 """
 
+import time as _time
+
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..base import MXNetError
@@ -139,6 +141,7 @@ class Trainer(object):
         allreduce across data-parallel replicas, apply optimizer
         (gluon/trainer.py:305)."""
         self._ready()
+        _t_step_ns = _time.perf_counter_ns() if _obs.enabled() else None
         with _obs.span("trainer.step", cat="step"):
             self._optimizer.rescale_grad = self._scale / batch_size
             if _chaos.enabled():
@@ -169,6 +172,12 @@ class Trainer(object):
                 self._optimizer.rescale_grad /= scaler.loss_scale
             self._update(ignore_stale_grad)
         if _obs.enabled():
+            # bounded-memory step-time distribution (p99 over the whole
+            # run, not the ring suffix); per-rank histograms merge
+            # bucket-wise in merged traces
+            if _t_step_ns is not None:
+                _obs.histogram("trainer.step_ms", "ms").observe(
+                    (_time.perf_counter_ns() - _t_step_ns) / 1e6)
             # arm the recompile detector once the step's graphs exist,
             # and (multi-worker, every MXNET_OBS_SKEW_EVERY steps) run
             # the cross-rank straggler exchange

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from . import transformer as tf
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
+from ..observability import http as _obs_http
+from ..observability import slo as _slo
 
 
 def _bucket(n, lo=8):
@@ -212,7 +214,8 @@ def _jitted_slot_write(cfg):
 
 class Request(object):
     __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token",
-                 "seed")
+                 "seed", "t_enq_ns", "t_admit_ns", "t_first_ns",
+                 "t_last_ns", "slo_bad")
 
     def __init__(self, rid, prompt, n_new, stop_token=None, seed=0):
         self.rid = rid
@@ -221,6 +224,13 @@ class Request(object):
         self.emitted = 0             # generated count
         self.stop_token = stop_token
         self.seed = seed             # sampling seed (requeue needs it)
+        # request-lifecycle clock (perf_counter_ns; None with obs off):
+        # enqueue -> admit -> first token -> last host-visible token
+        self.t_enq_ns = None
+        self.t_admit_ns = None
+        self.t_first_ns = None
+        self.t_last_ns = None
+        self.slo_bad = False         # any observation missed its SLO
 
     @property
     def done(self):
@@ -327,6 +337,12 @@ class ContinuousBatcher(object):
         self._dispatch_failures = 0
         self._max_dispatch_failures = 3
         self._next_rid = 0
+        # goodput accounting: completed (delivered) tokens since the
+        # first admission — feeds the serving.goodput_tok_s gauge
+        self._completed_tokens = 0
+        self._t_serve_start_ns = None
+        if _obs.enabled():
+            _obs_http.maybe_start()    # MXNET_OBS_HTTP live scrape
         # prefix cache: tuple(tokens) -> (row_cache, last_row_logits),
         # LRU-bounded. Each entry holds one [1, max_len] row cache on
         # device — prefix_cache_slots bounds that memory
@@ -393,7 +409,8 @@ class ContinuousBatcher(object):
         self._prefix_cache[best] = hit               # LRU refresh
         return len(best), hit[0], hit[1]
 
-    def admit(self, prompt, n_new, seed=0, stop_token=None):
+    def admit(self, prompt, n_new, seed=0, stop_token=None,
+              enqueued_ns=None):
         """Prefill `prompt` into a free slot; returns the request id,
         or None when every slot is busy. The first generated token is
         produced here (from the prefill logits), so a request with
@@ -401,10 +418,15 @@ class ContinuousBatcher(object):
         request's sampling chain (ignored under greedy), exactly as
         generate(seed=...) would. `stop_token` ends the request early
         when emitted (EOS semantics; the stop token is included in the
-        returned stream)."""
+        returned stream). `enqueued_ns` (perf_counter_ns) is when the
+        request entered the caller's queue — with telemetry on it
+        anchors the serving.queue_wait span and the serving.queue_ms /
+        serving.ttft_ms histograms (run()/stream() pass it; without it
+        TTFT is measured from this call)."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
-        t_admit = time.perf_counter() if _obs.enabled() else None
+        obs_on = _obs.enabled()
+        t0_ns = time.perf_counter_ns() if obs_on else None
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         t_p = len(prompt)
         if t_p < 1:
@@ -416,6 +438,9 @@ class ContinuousBatcher(object):
                     None)
         if slot is None:
             return None
+        rid = self._next_rid
+        pre_span = _obs.span("serving.prefill", cat="serving", rid=rid,
+                             lane=slot, prompt_tokens=t_p).start()
         # longest cached prefix (0 + a fresh row cache when none):
         # only the suffix prefills
         p_len, row_cache, pfx_logits = self._lookup_prefix(prompt)
@@ -476,16 +501,14 @@ class ContinuousBatcher(object):
                 self._cache, row_cache, jnp.int32(slot))
             self._pos[slot] = t_p      # next decode writes position t_p
             self._tok[slot] = first
-        req = Request(self._next_rid, prompt, n_new, stop_token,
-                      seed=seed)
+        pre_span.stop()
+        req = Request(rid, prompt, n_new, stop_token, seed=seed)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
         self._slots[slot] = req
-        if t_admit is not None:
-            _obs.gauge("serving.admit_to_first_token_ms").set(
-                (time.perf_counter() - t_admit) * 1e3)
-            _obs.gauge("serving.lane_occupancy").set(self.active_count)
+        if obs_on:
+            self._note_admit(req, slot, t0_ns, enqueued_ns)
         return req.rid
 
     # ---- decode ----
@@ -506,55 +529,76 @@ class ContinuousBatcher(object):
         staleness; see the class docstring)."""
         if self.pipeline_depth > 1:
             return self._step_pipelined()
+        obs_on = _obs.enabled()
         finished = {}
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
             if req is not None and req.done:
                 finished[req.rid] = list(req.tokens)
+                if obs_on:
+                    self._note_finish(req)
                 self._free(i)
         if not any(s is not None for s in self._slots):
             return finished
         k = self.chunk_size
         try:
-            if _chaos.enabled():
-                _chaos.fire("serving.dispatch", mode="sync")
-            if k == 1:
-                nxt, keys, self._cache = _jitted_ragged_step(
-                    self.cfg, *self._controls)(
-                    self.params, self._cache, jnp.asarray(self._tok),
-                    jnp.asarray(self._pos), jnp.asarray(self._keys))
-                toks = np.asarray(nxt).astype(np.int32)[None]  # [1, B]
-            else:
-                toks, keys, self._cache = _jitted_ragged_chunk(
-                    self.cfg, *self._controls, k)(
-                    self.params, self._cache, jnp.asarray(self._tok),
-                    jnp.asarray(self._pos), jnp.asarray(self._keys))
-                toks = np.asarray(toks).astype(np.int32)       # [k, B]
+            # the synchronous dispatch blocks through the host fetch,
+            # so one span covers dispatch + sync
+            with _obs.span("serving.dispatch", cat="serving",
+                           mode="sync", chunk=k,
+                           lanes=self.active_count):
+                if _chaos.enabled():
+                    _chaos.fire("serving.dispatch", mode="sync")
+                if k == 1:
+                    nxt, keys, self._cache = _jitted_ragged_step(
+                        self.cfg, *self._controls)(
+                        self.params, self._cache,
+                        jnp.asarray(self._tok),
+                        jnp.asarray(self._pos),
+                        jnp.asarray(self._keys))
+                    toks = np.asarray(nxt).astype(np.int32)[None]
+                else:
+                    toks, keys, self._cache = _jitted_ragged_chunk(
+                        self.cfg, *self._controls, k)(
+                        self.params, self._cache,
+                        jnp.asarray(self._tok),
+                        jnp.asarray(self._pos),
+                        jnp.asarray(self._keys))
+                    toks = np.asarray(toks).astype(np.int32)   # [k, B]
         except Exception as exc:     # noqa: BLE001 — requeue-or-raise
             self._recover_dispatch_failure(exc)
             return finished
         self._dispatch_failures = 0
+        t_sync = time.perf_counter_ns() if obs_on else None
         # np.array (copy): asarray would give a READ-ONLY view of the
         # device buffer and the next admit()'s in-place key write fails
         self._keys = np.array(keys, np.uint32)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
+            grew = req.emitted
             for j in range(k):
                 req.tokens.append(int(toks[j, i]))
                 req.emitted += 1
                 if req.done:
                     break
+            grew = req.emitted - grew
             # the device advanced every lane k steps regardless of
             # where its request ended; mirror that here so a
             # CONTINUING lane's next chunk starts from the device's
             # true rolling state (freed lanes reset below)
             self._pos[i] += k
             self._tok[i] = toks[k - 1, i]
+            if t_sync is not None:
+                self._note_progress(req, i, grew, t_sync)
             if req.done:
                 finished[req.rid] = list(req.tokens)
+                if t_sync is not None:
+                    self._note_finish(req, t_sync)
                 self._free(i)
+        if obs_on:
+            self._publish_occupancy()
         return finished
 
     # ---- pipelined scheduling (pipeline_depth > 1) ----
@@ -567,12 +611,15 @@ class ContinuousBatcher(object):
         synchronous round trip that gates every chunk at depth 1 thus
         amortizes over `depth` chunks, which is the whole lever when
         the chip sits behind a network tunnel (docs/SERVING.md)."""
+        obs_on = _obs.enabled()
         finished = {}
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
             if req is not None and req.done:
                 finished[req.rid] = list(req.tokens)
+                if obs_on:
+                    self._note_finish(req)
                 self._free(i)
         while (len(self._inflight) < self.pipeline_depth
                and any(s is not None for s in self._slots)):
@@ -614,7 +661,7 @@ class ContinuousBatcher(object):
         if _obs.enabled():
             _obs.gauge("serving.inflight_depth").set(
                 len(self._inflight))
-            _obs.gauge("serving.lane_occupancy").set(self.active_count)
+            self._publish_occupancy()
 
     def _sync_oldest(self):
         """Fetch the oldest in-flight chunk's emissions and credit
@@ -626,6 +673,8 @@ class ContinuousBatcher(object):
         with _obs.span("serving.sync", cat="serving",
                        behind=len(self._inflight)):
             toks = np.asarray(toks_dev).astype(np.int32)     # [k, B]
+        obs_on = _obs.enabled()
+        t_sync = time.perf_counter_ns() if obs_on else None
         finished = {}
         for i, rid in enumerate(lanes):
             if rid is None:
@@ -633,14 +682,21 @@ class ContinuousBatcher(object):
             req = self._slots[i]
             if req is None or req.rid != rid or req.done:
                 continue               # canceled / replaced mid-flight
+            grew = req.emitted
             for j in range(toks.shape[0]):
                 req.tokens.append(int(toks[j, i]))
                 req.emitted += 1
                 if req.done:
                     break
+            if t_sync is not None:
+                self._note_progress(req, i, req.emitted - grew, t_sync)
             if req.done:
                 finished[req.rid] = list(req.tokens)
+                if t_sync is not None:
+                    self._note_finish(req, t_sync)
                 self._free(i)
+        if obs_on:
+            self._publish_occupancy()
         return finished
 
     # ---- dispatch-failure recovery ----
@@ -720,6 +776,12 @@ class ContinuousBatcher(object):
             _obs.record_instant("serving.requeued", cat="serving",
                                 args={"rid": req.rid, "lane": slot,
                                       "resume_pos": m})
+            # keep the request's flow chain alive across the requeue so
+            # the trace ties pre-failure decode to the resumed lane
+            _obs.record_flow("serving.request", req.rid, "t",
+                             cat="serving",
+                             args={"rid": req.rid, "lane": slot,
+                                   "requeued": True})
 
     def cancel(self, rid):
         """Evict a request mid-decode (client disconnect, timeout):
@@ -733,6 +795,8 @@ class ContinuousBatcher(object):
         for i, req in enumerate(self._slots):
             if req is not None and req.rid == rid:
                 out = list(req.tokens)
+                if _obs.enabled():
+                    self._note_finish(req, evicted=True)
                 self._free(i)
                 return out
         return None
@@ -758,22 +822,124 @@ class ContinuousBatcher(object):
             self._pos[i] = 0
             self._tok[i] = 0
 
-    def _admit_job(self, job):
+    # ---- request-level observability ----
+    # Every caller guards on _obs.enabled(): with telemetry off none of
+    # these run and the batcher pays exactly the guarded branches.
+
+    def _note_admit(self, req, lane, t_admit_ns, enqueued_ns):
+        """Admission bookkeeping: queue-wait span + histogram, TTFT
+        histogram, the flow-chain start, and the (deprecated)
+        last-value admit gauge."""
+        t1 = time.perf_counter_ns()
+        req.t_enq_ns = enqueued_ns
+        req.t_admit_ns = t_admit_ns
+        req.t_first_ns = req.t_last_ns = t1
+        if self._t_serve_start_ns is None:
+            self._t_serve_start_ns = t_admit_ns
+        if enqueued_ns is not None:
+            q_ms = (t_admit_ns - enqueued_ns) / 1e6
+            _obs.record_span("serving.queue_wait", "serving",
+                             enqueued_ns, t_admit_ns,
+                             {"rid": req.rid})
+            _obs.histogram("serving.queue_ms", "ms").observe(q_ms)
+            if _slo.check("queue_ms", q_ms):
+                req.slo_bad = True
+        # TTFT from enqueue when known (client-visible), else from the
+        # admit call; the first token is produced inside admit()
+        ttft_ms = (t1 - (enqueued_ns if enqueued_ns is not None
+                         else t_admit_ns)) / 1e6
+        _obs.histogram("serving.ttft_ms", "ms").observe(ttft_ms)
+        if _slo.check("ttft_ms", ttft_ms):
+            req.slo_bad = True
+        # DEPRECATED last-value view (pre-histogram consumers; see
+        # docs/OBSERVABILITY.md) — serving.ttft_ms is the real signal
+        _obs.gauge("serving.admit_to_first_token_ms").set(
+            (t1 - t_admit_ns) / 1e6)
+        _obs.record_flow("serving.request", req.rid, "s",
+                         cat="serving",
+                         args={"rid": req.rid, "lane": lane})
+        self._publish_occupancy()
+
+    def _note_progress(self, req, lane, grew, t_ns):
+        """`grew` tokens of `req` became host-visible at `t_ns` (one
+        chunk sync): inter-token-latency samples — the chunk lands at
+        once, so the gap since the request's previous host-visible
+        token spreads evenly over the chunk — plus the flow step tying
+        this sync into the request's chain."""
+        if grew <= 0:
+            return
+        h = _obs.histogram("serving.itl_ms", "ms")
+        gap_ms = ((t_ns - req.t_last_ns) / 1e6 / grew
+                  if req.t_last_ns is not None else 0.0)
+        for _ in range(grew):
+            h.observe(gap_ms)
+            if _slo.check("itl_ms", gap_ms):
+                req.slo_bad = True
+        req.t_last_ns = t_ns
+        _obs.record_flow("serving.request", req.rid, "t",
+                         cat="serving",
+                         args={"rid": req.rid, "lane": lane,
+                               "tokens": grew})
+
+    def _note_finish(self, req, t_ns=None, evicted=False):
+        """Request left the pool (finished or evicted): e2e histogram,
+        goodput gauge, the flow-chain finish, a finish/evict instant,
+        and the request's SLO verdict into the rolling attainment."""
+        t_ns = time.perf_counter_ns() if t_ns is None else t_ns
+        start = req.t_enq_ns if req.t_enq_ns is not None \
+            else req.t_admit_ns
+        if start is not None and not evicted:
+            e2e_ms = (t_ns - start) / 1e6
+            _obs.histogram("serving.e2e_ms", "ms").observe(e2e_ms)
+            if _slo.check("e2e_ms", e2e_ms):
+                req.slo_bad = True
+        # evicted requests still delivered their synced tokens
+        self._completed_tokens += req.emitted
+        if self._t_serve_start_ns is not None:
+            elapsed_s = (t_ns - self._t_serve_start_ns) / 1e9
+            if elapsed_s > 0:
+                _obs.gauge("serving.goodput_tok_s").set(
+                    self._completed_tokens / elapsed_s)
+        _obs.record_flow("serving.request", req.rid, "f",
+                         cat="serving", args={"rid": req.rid})
+        _obs.record_instant(
+            "serving.evict" if evicted else "serving.finish",
+            cat="serving",
+            args={"rid": req.rid, "emitted": req.emitted})
+        if _slo.active():
+            _slo.request_complete(not req.slo_bad)
+
+    def _publish_occupancy(self):
+        """Lane and KV-cache utilization gauges — the per-replica load
+        signal the ROADMAP-1 router reads off the scrape endpoint."""
+        active = self.active_count
+        _obs.gauge("serving.lane_occupancy").set(active)
+        _obs.gauge("serving.lane_utilization").set(
+            active / float(self.max_batch))
+        ctx = sum(len(r.tokens) for r in self._slots if r is not None)
+        _obs.gauge("serving.kv_utilization").set(
+            ctx / float(self.max_batch * self.cfg.max_len))
+
+    def _admit_job(self, job, enqueued_ns=None):
         """(prompt, n_new[, seed[, stop_token]]) -> rid or None."""
         return self.admit(job[0], job[1],
                           seed=job[2] if len(job) > 2 else 0,
-                          stop_token=job[3] if len(job) > 3 else None)
+                          stop_token=job[3] if len(job) > 3 else None,
+                          enqueued_ns=enqueued_ns)
 
     def run(self, requests):
         """Convenience driver: serve `requests` (an iterable of
         (prompt, n_new[, seed[, stop_token]])) through the slot pool,
         admitting as capacity frees. Returns {rid: tokens} for all of
-        them, plus the admission order as a list of rids."""
+        them, plus the admission order as a list of rids. With
+        telemetry on, every job is stamped as enqueued at entry so
+        queue-wait and TTFT cover time spent waiting for a lane."""
+        enq_ns = time.perf_counter_ns() if _obs.enabled() else None
         queue = list(requests)
         order, results = [], {}
         while queue or self.active_count:
             while queue and self.has_capacity:
-                rid = self._admit_job(queue[0])
+                rid = self._admit_job(queue[0], enqueued_ns=enq_ns)
                 if rid is None:
                     break
                 order.append(rid)
@@ -793,11 +959,12 @@ class ContinuousBatcher(object):
         terminal ``(rid, None, True)`` event — token None, since
         eviction produces no new token — so consumers keying cleanup
         off ``done`` always see it."""
+        enq_ns = time.perf_counter_ns() if _obs.enabled() else None
         queue = list(requests)
         live = {}                    # rid -> Request (for delta tracking)
         while queue or self.active_count:
             while queue and self.has_capacity:
-                rid = self._admit_job(queue[0])
+                rid = self._admit_job(queue[0], enqueued_ns=enq_ns)
                 if rid is None:
                     break
                 queue.pop(0)

@@ -27,13 +27,25 @@ straggler detection (``MXNET_OBS_SKEW_EVERY`` /
 ``MXNET_OBS_STRAGGLER_FACTOR``), and a collective hang watchdog that
 dumps a post-mortem after ``MXNET_OBS_COLLECTIVE_TIMEOUT`` seconds
 instead of hanging silently.
+
+Serving gets the request-level half (``histogram.py``, ``slo.py``,
+``http.py``): bounded-memory log-bucketed latency histograms
+(``serving.ttft_ms``/``itl_ms``/``e2e_ms``/``queue_ms``, bucket-wise
+mergeable across ranks), per-request lifecycle spans + chrome-trace
+flow chains threaded through the ContinuousBatcher, ``MXNET_OBS_SLO``
+violation counters with a rolling ``serving.slo_attainment`` gauge,
+and a ``MXNET_OBS_HTTP`` live ``/metrics`` + ``/healthz`` scrape
+endpoint (docs/OBSERVABILITY.md "Serving observability").
 """
 
 from . import chaos
 from . import core
 from . import dist
 from . import export
+from . import histogram
 from . import hlo
+from . import http
+from . import slo
 from . import attribution
 from . import recompile
 from . import watchdog
@@ -41,8 +53,12 @@ from .attribution import (ops_enabled, format_ops_table,
                           compare_summaries)
 from .attribution import summary as ops_summary
 from .core import (enabled, set_enabled, span, counter, gauge,
-                   record_span, record_instant, records, counters,
-                   dropped, reset)
+                   record_span, record_instant, record_flow, records,
+                   counters, dropped, reset)
+from .core import histogram as get_histogram
+from .histogram import Histogram
+from .http import start as start_http_server
+from .http import stop as stop_http_server
 from .dist import (merge_traces, detect_stragglers, skew_summary,
                    exchange_phase_stats)
 from .export import (chrome_trace, dump_chrome_trace, aggregate,
@@ -50,12 +66,14 @@ from .export import (chrome_trace, dump_chrome_trace, aggregate,
 from .recompile import get_detector, note_call, record_retrace
 from .watchdog import get_watchdog
 
-__all__ = ["chaos", "core", "dist", "export", "hlo", "attribution",
-           "recompile",
+__all__ = ["chaos", "core", "dist", "export", "histogram", "hlo",
+           "http", "slo", "attribution", "recompile",
            "watchdog", "ops_enabled", "format_ops_table",
            "compare_summaries", "ops_summary", "enabled",
-           "set_enabled", "span", "counter", "gauge", "record_span",
-           "record_instant", "records", "counters", "dropped", "reset",
+           "set_enabled", "span", "counter", "gauge", "get_histogram",
+           "Histogram", "record_span", "record_instant", "record_flow",
+           "records", "counters", "dropped", "reset",
+           "start_http_server", "stop_http_server",
            "chrome_trace", "dump_chrome_trace", "aggregate",
            "aggregate_table", "prometheus_text", "write_prometheus",
            "get_detector", "note_call", "record_retrace", "merge_traces",

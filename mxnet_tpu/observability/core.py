@@ -33,8 +33,9 @@ import time
 from .. import _fastenv
 
 __all__ = ["enabled", "set_enabled", "span", "counter", "gauge",
-           "record_span", "record_instant", "records", "counters",
-           "dropped", "reset", "ring_capacity", "Counter", "Gauge"]
+           "histogram", "record_span", "record_instant", "record_flow",
+           "records", "counters", "dropped", "reset", "ring_capacity",
+           "Counter", "Gauge"]
 
 DEFAULT_RING = 65536
 
@@ -103,6 +104,16 @@ def record_instant(name, cat="event", args=None):
     """Record a zero-duration marker."""
     _append(("i", name, cat, _now_us(), 0, threading.get_ident(),
              args or {}))
+
+
+def record_flow(name, flow_id, phase, cat="flow", args=None):
+    """Record one chrome-trace flow event: ``phase`` is ``"s"``
+    (start), ``"t"`` (step) or ``"f"`` (finish). Events sharing
+    ``(name, flow_id)`` render as one arrowed chain across lanes and
+    threads — how a serving request's admit→decode→finish is tied
+    together across pipeline-depth dispatches."""
+    _append(("F", name, cat, _now_us(), (str(phase), int(flow_id)),
+             threading.get_ident(), args or {}))
 
 
 class span(object):
@@ -215,6 +226,13 @@ def gauge(name, unit=""):
     return g
 
 
+def histogram(name, unit=""):
+    """Get-or-create the named log-bucketed histogram (bounded-memory
+    distribution with mergeable buckets — ``histogram.Histogram``)."""
+    from . import histogram as _h
+    return _h.histogram(name, unit)
+
+
 def records():
     """Snapshot of ring contents, oldest first."""
     with _lock:
@@ -241,11 +259,14 @@ def dropped():
 
 
 def reset():
-    """Clear the ring and the counter registry (tests, new profile
-    sessions). The ring is rebuilt at the current MXNET_OBS_RING."""
+    """Clear the ring, the counter registry and the histogram registry
+    (tests, new profile sessions). The ring is rebuilt at the current
+    MXNET_OBS_RING."""
     global _ring, _head, _total
     with _lock:
         _ring = [None] * 0
         _head = 0
         _total = 0
         _counters.clear()
+    from . import histogram as _h
+    _h.reset()

@@ -187,6 +187,13 @@ def merge_traces(paths, out=None):
     loaded.sort(key=lambda t: t[0])
 
     ref = next((a for _, a, _, _ in loaded if a), None)
+    # per-rank serving/latency histograms merge BUCKET-WISE (same
+    # log-bucket edges on every rank), so the merged trace carries
+    # fleet-level distributions, not one rank's
+    from . import histogram as _hist
+    hist_merged, hist_conflicts = _hist.merge_state_maps(
+        [(t.get("otherData") or {}).get("histograms")
+         for _, _, t, _ in loaded])
     events, offsets, unaligned, dropped = [], {}, [], 0
     for rank, anchor, trace, _p in loaded:
         if anchor and ref:
@@ -206,6 +213,11 @@ def merge_traces(paths, out=None):
             ev["pid"] = rank
             if "ts" in ev:
                 ev["ts"] = ev["ts"] - off
+            if ev.get("ph") in ("s", "t", "f") and "id" in ev:
+                # flow chains bind on (cat, id) across the WHOLE trace,
+                # not per pid — scope ids per rank so rank 0's request
+                # 0 and rank 1's request 0 stay separate chains
+                ev["id"] = int(ev["id"]) + (rank << 32)
             events.append(ev)
         dropped += int((trace.get("otherData") or {})
                        .get("dropped_records", 0) or 0)
@@ -224,6 +236,8 @@ def merge_traces(paths, out=None):
             "merged_ranks": [r for r, _, _, _ in loaded],
             "clock_offsets_us": {str(r): o for r, o in offsets.items()},
             "unaligned_ranks": unaligned,
+            "histograms": hist_merged,
+            "histogram_merge_conflicts": hist_conflicts,
             "dropped_records": dropped}}
     if out:
         with open(out, "w") as f:

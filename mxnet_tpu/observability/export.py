@@ -27,11 +27,14 @@ def chrome_trace(extra_events=None):
     per-rank lanes (``dist.merge_traces``); ``otherData`` carries the
     rank + barrier clock anchor the merge aligns timelines with."""
     from . import dist
+    from . import histogram as _hist
     rank = dist.process_index()
     events = [{"name": "process_name", "ph": "M", "pid": rank,
                "args": {"name": "rank %d" % rank}}]
+    last_ts = 0
     for rec in core.records():
         ph, name, cat, ts, val, tid, args = rec
+        last_ts = max(last_ts, ts)
         if ph == "X":
             events.append({"name": name, "cat": cat, "ph": "X",
                            "ts": ts, "dur": val, "pid": rank, "tid": tid,
@@ -40,10 +43,28 @@ def chrome_trace(extra_events=None):
             events.append({"name": name, "cat": cat, "ph": "C",
                            "ts": ts, "pid": rank,
                            "args": {name.rsplit(".", 1)[-1]: val}})
+        elif ph == "F":
+            # flow events: val is (phase, flow_id); "s"/"t"/"f" chains
+            # sharing an id render as one arrowed flow in the viewer
+            fph, fid = val
+            ev = {"name": name, "cat": cat, "ph": fph, "ts": ts,
+                  "pid": rank, "tid": tid, "id": fid, "args": args}
+            if fph == "f":
+                ev["bp"] = "e"     # bind the finish to its slice
+            events.append(ev)
         else:
             events.append({"name": name, "cat": cat, "ph": "i",
                            "ts": ts, "pid": rank, "tid": tid, "s": "t",
                            "args": args})
+    # histogram snapshots: a counter row per histogram (quantiles
+    # visible in the viewer) at the trace's end; the full mergeable
+    # bucket state rides otherData.histograms
+    hist_states = _hist.states()
+    for name, h in sorted(_hist.histograms().items()):
+        if h.count:
+            events.append({"name": name, "cat": "histogram", "ph": "C",
+                           "ts": last_ts, "pid": rank,
+                           "args": h.quantiles()})
     if extra_events:
         events.extend(extra_events)
     trace = {"traceEvents": events, "displayTimeUnit": "ms",
@@ -51,6 +72,7 @@ def chrome_trace(extra_events=None):
                            "rank": rank,
                            "num_processes": dist.process_count(),
                            "clock_anchor": dist.clock_anchor(),
+                           "histograms": hist_states,
                            "dropped_records": core.dropped()}}
     return trace
 
@@ -106,7 +128,10 @@ def aggregate():
             "p50": _percentile(vals, 0.50),
             "p99": _percentile(vals, 0.99),
             "value": c.value}
-    return {"spans": spans, "counters": counters}
+    from . import histogram as _hist
+    hists = {name: h.snapshot()
+             for name, h in sorted(_hist.histograms().items())}
+    return {"spans": spans, "counters": counters, "histograms": hists}
 
 
 def aggregate_table():
@@ -137,6 +162,19 @@ def aggregate_table():
                              "%g" % s["min"], "%g" % s["max"],
                              "%g" % s["p50"], "%g" % s["p99"],
                              "%g" % s["value"]))
+    if agg["histograms"]:
+        fmth = "%-32s %8s %12s %10s %10s %10s %10s %10s %10s"
+        lines.append("")
+        lines.append("Histograms (log-bucketed, exact count/sum)")
+        lines.append("=" * 10)
+        lines.append(fmth % ("Name", "Count", "Sum", "Mean", "P50",
+                             "P90", "P99", "P99.9", "Max"))
+        for name, h in agg["histograms"].items():
+            lines.append(fmth % (
+                name, h["count"], "%.3f" % h["sum"], "%.3f" % h["mean"],
+                "%.3f" % h["p50"], "%.3f" % h["p90"],
+                "%.3f" % h["p99"], "%.3f" % h["p999"],
+                "%.3f" % h["max"]))
     from . import dist
     lines.extend(dist.format_skew_table())
     from . import attribution
@@ -188,6 +226,28 @@ def prometheus_text():
     for name, s in agg["counters"].items():
         lines.append('mxnet_obs_value{name="%s"} %g'
                      % (_prom_name(name), s["value"]))
+    from . import histogram as _hist
+    hists = _hist.histograms()
+    if hists:
+        lines.append("# HELP mxnet_obs_hist log-bucketed latency "
+                     "histograms (serving.* request distributions)")
+        lines.append("# TYPE mxnet_obs_hist histogram")
+        for name, h in sorted(hists.items()):
+            pname = _prom_name(name)
+            for le, cum in h.cumulative_buckets():
+                lines.append(
+                    'mxnet_obs_hist_bucket{name="%s",le="%s"} %d'
+                    % (pname,
+                       "+Inf" if le == float("inf") else "%g" % le,
+                       cum))
+            lines.append('mxnet_obs_hist_sum{name="%s"} %.6f'
+                         % (pname, h.sum))
+            lines.append('mxnet_obs_hist_count{name="%s"} %d'
+                         % (pname, h.count))
+            for q, label in _hist.QUANTILES:
+                lines.append(
+                    'mxnet_obs_hist_quantile{name="%s",quantile="%s"} '
+                    '%.6f' % (pname, q, h.percentile(q)))
     from . import dist
     lines.append("# HELP mxnet_obs_rank this process's rank (label the "
                  "scrape per worker in multi-host jobs)")

@@ -1,0 +1,279 @@
+"""Log-bucketed latency histograms — the bounded-memory distribution
+primitive the serving layer records into.
+
+The PR 2 ``Counter`` keeps exact count/total/min/max but gets its
+percentiles from the sample ring, so a long run's p99 is computed over
+whatever suffix survived the ring — fine for step phases (thousands of
+samples, all recent ones representative), wrong for per-request serving
+latency where the SLO question is "p99 over the whole run". A
+``Histogram`` trades exact values for EXACT-count log-spaced buckets:
+
+* **bounded memory** — a fixed maximum of ``MAX_BUCKETS`` integer
+  counts per histogram, grown lazily, never a per-sample record. A
+  million observations cost the same bytes as a hundred.
+* **bounded relative error** — bucket upper edges follow
+  ``lo * growth**i`` (defaults: ``lo`` = 1e-3 ms, ``growth`` = 2**0.25
+  ≈ 1.19), so any reported quantile is within one bucket — ≤ ~19%
+  relative — of the true sample quantile, with linear interpolation
+  inside the bucket doing better in practice. ``count``/``sum``/
+  ``min``/``max`` stay exact.
+* **mergeable** — two histograms with the same ``(lo, growth)`` merge
+  bucket-wise (``merge_state``), which is how ``dist.merge_traces`` /
+  ``tools/obs_merge.py`` combine per-rank serving distributions into
+  fleet-level percentiles without ever shipping samples.
+* **one guarded branch when off** — ``observe()`` returns after the
+  ``core.enabled()`` check (the PR 2 contract); nothing allocates.
+
+Knobs: ``MXNET_OBS_HIST_LO`` (lowest bucket upper edge, default 1e-3 —
+values at/below it share bucket 0) and ``MXNET_OBS_HIST_GROWTH``
+(bucket edge growth factor, default 2**0.25), both read at histogram
+creation. Explicit ``lo=``/``growth=`` arguments beat the env.
+"""
+
+import math
+import threading
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["Histogram", "histogram", "histograms", "states",
+           "merge_state", "merge_state_maps", "reset", "MAX_BUCKETS",
+           "DEFAULT_LO", "DEFAULT_GROWTH", "QUANTILES"]
+
+# bucket 0 holds (-inf, lo]; bucket i>=1 holds (lo*g^(i-1), lo*g^i];
+# the last bucket is open-ended. 192 buckets at the default growth
+# cover 1e-3 .. ~1e11 ms — every latency this repo can produce.
+MAX_BUCKETS = 192
+DEFAULT_LO = 1e-3
+DEFAULT_GROWTH = 2.0 ** 0.25
+
+# the quantiles every exporter reports (p50/p90/p99/p99.9)
+QUANTILES = ((0.50, "p50"), (0.90, "p90"), (0.99, "p99"),
+             (0.999, "p999"))
+
+_lock = threading.Lock()
+_histograms = {}
+
+
+class Histogram(object):
+    """Thread-safe log-bucketed histogram; see the module docstring."""
+
+    __slots__ = ("name", "unit", "lo", "growth", "_log_g", "counts",
+                 "count", "sum", "min", "max")
+
+    def __init__(self, name, unit="", lo=None, growth=None):
+        self.name = name
+        self.unit = unit
+        self.lo = float(_fastenv.get("MXNET_OBS_HIST_LO", DEFAULT_LO)
+                        if lo is None else lo)
+        self.growth = float(_fastenv.get("MXNET_OBS_HIST_GROWTH",
+                                         DEFAULT_GROWTH)
+                            if growth is None else growth)
+        if self.lo <= 0 or self.growth <= 1.0:
+            raise ValueError("histogram needs lo > 0 and growth > 1 "
+                             "(got lo=%g growth=%g)"
+                             % (self.lo, self.growth))
+        self._log_g = math.log(self.growth)
+        self.counts = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    # ------------------------------------------------------ buckets --
+
+    def _index(self, value):
+        if value <= self.lo:
+            return 0
+        # ceil with a float-noise epsilon so an exact edge value lands
+        # in the bucket it bounds (upper edges are inclusive)
+        idx = int(math.ceil(math.log(value / self.lo) / self._log_g
+                            - 1e-9))
+        return min(max(idx, 1), MAX_BUCKETS - 1)
+
+    def _upper(self, i):
+        """Upper edge of bucket i (bucket 0's edge is ``lo``)."""
+        return self.lo * self.growth ** i if i else self.lo
+
+    def _lower(self, i):
+        return self.lo * self.growth ** (i - 1) if i else 0.0
+
+    # ---------------------------------------------------- recording --
+
+    def observe(self, value):
+        """Record one sample. A no-op (one guarded branch) when
+        telemetry is off."""
+        if not core.enabled():
+            return
+        value = float(value)
+        idx = self._index(value) if value > 0 else 0
+        with _lock:
+            if idx >= len(self.counts):
+                self.counts.extend([0] * (idx + 1 - len(self.counts)))
+            self.counts[idx] += 1
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min,
+                                                          value)
+            self.max = value if self.max is None else max(self.max,
+                                                          value)
+
+    # ------------------------------------------------------ reading --
+
+    def percentile(self, q):
+        """Estimated q-quantile (q in [0, 1]): walk the cumulative
+        bucket counts, interpolate linearly inside the landing bucket,
+        clamp to the exact observed [min, max]."""
+        with _lock:
+            counts = list(self.counts)
+            n, mn, mx = self.count, self.min, self.max
+        if not n:
+            return 0.0
+        target = q * n
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                frac = (target - cum) / c
+                val = self._lower(i) + \
+                    (self._upper(i) - self._lower(i)) * frac
+                return min(max(val, mn), mx)
+            cum += c
+        return mx
+
+    def quantiles(self):
+        return {label: self.percentile(q) for q, label in QUANTILES}
+
+    def snapshot(self):
+        """Exporter view: exact count/sum/min/max/mean + the standard
+        quantile estimates."""
+        out = {"count": self.count, "sum": self.sum,
+               "min": self.min if self.min is not None else 0.0,
+               "max": self.max if self.max is not None else 0.0,
+               "mean": (self.sum / self.count) if self.count else 0.0,
+               "unit": self.unit}
+        out.update(self.quantiles())
+        return out
+
+    def cumulative_buckets(self):
+        """[(upper_edge, cumulative_count)] over the populated prefix
+        plus the +Inf total — the Prometheus histogram series."""
+        with _lock:
+            counts = list(self.counts)
+            n = self.count
+        out, cum = [], 0
+        for i, c in enumerate(counts):
+            cum += c
+            out.append((self._upper(i), cum))
+        out.append((float("inf"), n))
+        return out
+
+    # ------------------------------------------------- merge / state --
+
+    def state(self):
+        """The mergeable serialized form (rides the chrome trace's
+        ``otherData.histograms`` so per-rank dumps can be combined
+        bucket-wise)."""
+        with _lock:
+            return {"name": self.name, "unit": self.unit,
+                    "lo": self.lo, "growth": self.growth,
+                    "counts": list(self.counts), "count": self.count,
+                    "sum": self.sum, "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_state(cls, st):
+        h = cls(st.get("name", ""), st.get("unit", ""),
+                lo=st["lo"], growth=st["growth"])
+        h.counts = [int(c) for c in st.get("counts", [])]
+        h.count = int(st.get("count", 0))
+        h.sum = float(st.get("sum", 0.0))
+        h.min = st.get("min")
+        h.max = st.get("max")
+        return h
+
+    def merge(self, other):
+        """Fold ``other`` (Histogram or state dict) into self
+        bucket-wise. Raises ValueError on (lo, growth) mismatch —
+        bucket indices would not mean the same latency."""
+        st = other.state() if isinstance(other, Histogram) else other
+        if abs(st["lo"] - self.lo) > 1e-12 * self.lo \
+                or abs(st["growth"] - self.growth) > 1e-9:
+            raise ValueError(
+                "cannot merge histograms with different bucketing: "
+                "(lo=%g, growth=%g) vs (lo=%g, growth=%g)"
+                % (self.lo, self.growth, st["lo"], st["growth"]))
+        with _lock:
+            counts = st.get("counts", [])
+            if len(counts) > len(self.counts):
+                self.counts.extend([0] * (len(counts)
+                                          - len(self.counts)))
+            for i, c in enumerate(counts):
+                self.counts[i] += int(c)
+            self.count += int(st.get("count", 0))
+            self.sum += float(st.get("sum", 0.0))
+            for key, pick in (("min", min), ("max", max)):
+                v = st.get(key)
+                if v is not None:
+                    mine = getattr(self, key)
+                    setattr(self, key,
+                            v if mine is None else pick(mine, v))
+        return self
+
+
+def merge_state(a, b):
+    """Bucket-wise merge of two state dicts -> a new state dict."""
+    return Histogram.from_state(a).merge(b).state()
+
+
+def merge_state_maps(maps):
+    """Merge per-rank ``{name: state}`` maps (``merge_traces``'s
+    histogram half). Returns ``(merged_map, conflicts)`` where
+    ``conflicts`` lists names whose bucketing disagreed across ranks
+    (first rank's state is kept for those)."""
+    out, conflicts = {}, []
+    for m in maps:
+        for name, st in (m or {}).items():
+            if name not in out:
+                out[name] = dict(st)
+                continue
+            try:
+                out[name] = merge_state(out[name], st)
+            except ValueError:
+                if name not in conflicts:
+                    conflicts.append(name)
+    return out, conflicts
+
+
+# ------------------------------------------------------ registry -----
+
+def histogram(name, unit="", lo=None, growth=None):
+    """Get-or-create the named histogram (process-global registry,
+    the ``core.counter`` pattern)."""
+    h = _histograms.get(name)
+    if h is None:
+        with _lock:
+            h = _histograms.get(name)
+            if h is None:
+                h = _histograms[name] = Histogram(name, unit, lo=lo,
+                                                  growth=growth)
+    return h
+
+
+def histograms():
+    """Snapshot of the registry (name -> Histogram)."""
+    with _lock:
+        return dict(_histograms)
+
+
+def states():
+    """{name: state dict} for every registered histogram — what the
+    chrome trace exports and the cross-rank merge combines."""
+    return {name: h.state() for name, h in sorted(histograms().items())}
+
+
+def reset():
+    """Clear the registry (tests, new profile sessions); called by
+    ``core.reset()`` so one reset clears the whole telemetry state."""
+    with _lock:
+        _histograms.clear()

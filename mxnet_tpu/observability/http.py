@@ -1,0 +1,165 @@
+"""Live scrape endpoint — a background HTTP thread serving the current
+telemetry instead of waiting for an on-dump textfile.
+
+The PR 2 Prometheus exporter only writes at ``profiler.dump()`` time,
+so a live run is invisible until someone dumps. With
+``MXNET_OBS_HTTP=<port>`` set (and telemetry on) a daemon thread serves:
+
+* ``GET /metrics``  — the Prometheus exposition text, rendered fresh
+  per scrape (counters, gauges, span summaries, the log-bucketed
+  ``serving.*`` histograms with per-bucket series and quantiles).
+* ``GET /healthz``  — a JSON snapshot for load-balancer/router health
+  probes: rank, uptime, lane occupancy and the other gauges, histogram
+  quantiles, SLO attainment — the per-replica load signal the
+  ROADMAP-1 router consumes.
+
+The server starts lazily (first instrumented ``ContinuousBatcher``,
+``profiler.set_state('run')`` or ``profiler.dump()``) via
+``maybe_start()``, binds once per process, and never takes the
+telemetry hot path: every scrape reads the same snapshots the
+exporters use. A failed bind (port taken) warns once and stays off —
+observability must never take serving down. Multi-process runs on one
+host should point each rank at its own port; ``/healthz`` reports the
+rank so a scraper can label the target.
+
+``start(port)`` / ``stop()`` are the programmatic API (tests bind port
+0 for an ephemeral port; ``port()`` reports the bound one).
+"""
+
+import json
+import os
+import threading
+import time
+import warnings
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["start", "stop", "maybe_start", "port"]
+
+_lock = threading.Lock()
+_server = None
+_thread = None
+_t0 = time.time()
+_failed = False
+
+
+def _healthz():
+    """The /healthz JSON snapshot (also what tests assert on)."""
+    from . import dist, export, slo
+    from . import histogram as _hist
+    agg = export.aggregate()
+    return {
+        "status": "ok",
+        "rank": dist.process_index(),
+        "num_processes": dist.process_count(),
+        "pid": os.getpid(),
+        "enabled": core.enabled(),
+        "uptime_s": time.time() - _t0,
+        "dropped_records": core.dropped(),
+        "counters": {name: s["value"]
+                     for name, s in agg["counters"].items()},
+        "histograms": {name: {k: h[k] for k in
+                              ("count", "mean", "p50", "p90", "p99",
+                               "p999", "max")}
+                       for name, h in agg["histograms"].items()},
+        "slo": {"targets": dict(slo.targets()),
+                "attainment": slo.attainment()},
+    }
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            try:
+                if path == "/metrics":
+                    from . import export
+                    body = export.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/healthz":
+                    body = (json.dumps(_healthz(), indent=1)
+                            + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/":
+                    body = (b"mxnet_tpu.observability scrape endpoint\n"
+                            b"/metrics  prometheus exposition\n"
+                            b"/healthz  JSON health snapshot\n")
+                    ctype = "text/plain"
+                else:
+                    self.send_error(404, "unknown path %r" % path)
+                    return
+            except Exception as exc:   # never take the scraper down
+                self.send_error(500, "snapshot failed: %s" % exc)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):   # scrapes must not spam stderr
+            pass
+
+    return Handler
+
+
+def start(port):
+    """Bind and serve on a daemon thread; idempotent (returns the
+    already-bound port on a second call). ``port=0`` binds an
+    ephemeral port — the return value is always the real one."""
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        from http.server import ThreadingHTTPServer
+        _server = ThreadingHTTPServer(("0.0.0.0", int(port)),
+                                      _make_handler())
+        _thread = threading.Thread(target=_server.serve_forever,
+                                   name="mxnet-obs-http", daemon=True)
+        _thread.start()
+        return _server.server_address[1]
+
+
+def maybe_start():
+    """Start the endpoint iff MXNET_OBS_HTTP names a port and no server
+    is up yet. A bind failure warns once and disables further attempts
+    — the scrape endpoint is best-effort, serving is not."""
+    global _failed
+    if _server is not None or _failed:
+        return port()
+    v = _fastenv.get("MXNET_OBS_HTTP")
+    if not v or v in ("0", "false", "False"):
+        return None
+    try:
+        return start(int(v))
+    except Exception as exc:
+        _failed = True
+        warnings.warn("mxnet_tpu.observability: MXNET_OBS_HTTP=%s "
+                      "endpoint failed to start (%s); continuing "
+                      "without live scrape" % (v, exc),
+                      RuntimeWarning, stacklevel=2)
+        return None
+
+
+def port():
+    """The bound port, or None when the server is down."""
+    with _lock:
+        return _server.server_address[1] if _server else None
+
+
+def stop():
+    """Shut the endpoint down (tests; production lets the daemon thread
+    die with the process)."""
+    global _server, _thread, _failed
+    with _lock:
+        srv, thr = _server, _thread
+        _server = _thread = None
+        _failed = False
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if thr is not None:
+        thr.join(timeout=5)

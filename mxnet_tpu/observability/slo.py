@@ -1,0 +1,147 @@
+"""SLO accounting — per-target violation counters and a rolling
+attainment gauge over the serving latency histograms.
+
+The ROADMAP-1 router needs ONE admit/shed signal per replica: "is this
+replica meeting its latency objectives right now?". This module turns
+the ``MXNET_OBS_SLO`` spec into that signal:
+
+    MXNET_OBS_SLO="ttft_ms=500,itl_ms=50"        # comma or ';' joined
+    MXNET_OBS_SLO="ttft_ms=500;e2e_ms=2000;queue_ms=100"
+
+Each ``<metric>=<threshold>`` names one of the serving latency metrics
+(``ttft_ms``, ``itl_ms``, ``e2e_ms``, ``queue_ms`` — the keys match the
+``serving.<metric>`` histograms, but any metric a call site checks is
+accepted). Every observation the serving layer records is also checked
+here (``check``): a value past its threshold increments the
+``serving.slo_violation.<metric>`` counter. When a request finishes,
+the batcher reports whether ANY of its observations violated
+(``request_complete``), and the rolling fraction of compliant requests
+over the last ``MXNET_OBS_SLO_WINDOW`` completions (default 256) is
+published as the ``serving.slo_attainment`` gauge — 1.0 when every
+recent request met every target, degrading toward 0.0 as violations
+accumulate. That gauge rides every exporter (Prometheus text/scrape,
+chrome trace, aggregate table, ``/healthz``), so a router polling
+``MXNET_OBS_HTTP`` gets the shed signal without parsing distributions.
+
+A malformed spec warns ONCE and disables accounting rather than
+breaking the serving path; ``parse_spec`` itself raises so tests and
+tools can validate eagerly. With ``MXNET_OBS_SLO`` unset everything
+here reduces to one guarded check.
+"""
+
+import threading
+import warnings
+from collections import deque
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["parse_spec", "targets", "active", "window", "check",
+           "request_complete", "attainment", "reset",
+           "DEFAULT_WINDOW"]
+
+DEFAULT_WINDOW = 256
+
+_lock = threading.Lock()
+_spec_cache = None          # spec string the cached _targets parse from
+_targets = {}
+_warned = False
+_results = deque()          # rolling per-request compliance booleans
+
+
+def parse_spec(spec):
+    """``metric=threshold`` pairs joined by ``,`` or ``;`` -> dict.
+    Thresholds are positive floats; raises ValueError on anything
+    malformed (the eager/validating entry point)."""
+    out = {}
+    for chunk in (spec or "").replace(";", ",").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        if "=" not in chunk:
+            raise ValueError(
+                "SLO rule %r: expected <metric>=<threshold>" % chunk)
+        key, val = chunk.split("=", 1)
+        key = key.strip()
+        try:
+            thr = float(val)
+        except ValueError:
+            raise ValueError("SLO rule %r: threshold %r is not a "
+                             "number" % (chunk, val))
+        if not key or thr <= 0:
+            raise ValueError("SLO rule %r: need a metric name and a "
+                             "positive threshold" % chunk)
+        out[key] = thr
+    return out
+
+
+def targets():
+    """The parsed MXNET_OBS_SLO targets (cached on the spec string so a
+    monkeypatched env re-parses). A malformed spec warns once and
+    yields no targets — telemetry must never break serving."""
+    global _spec_cache, _targets, _warned
+    spec = _fastenv.get("MXNET_OBS_SLO") or ""
+    if spec != _spec_cache:
+        try:
+            _targets = parse_spec(spec)
+        except ValueError as exc:
+            if not _warned:
+                warnings.warn("mxnet_tpu.observability: ignoring "
+                              "malformed MXNET_OBS_SLO (%s)" % exc,
+                              RuntimeWarning, stacklevel=2)
+                _warned = True
+            _targets = {}
+        _spec_cache = spec
+    return _targets
+
+
+def active():
+    """Any targets configured? THE call-site guard."""
+    return bool(targets())
+
+
+def window():
+    return int(_fastenv.get("MXNET_OBS_SLO_WINDOW", DEFAULT_WINDOW))
+
+
+def check(metric, value):
+    """One observation against its target. Returns True (and counts a
+    ``serving.slo_violation.<metric>``) when the value misses the SLO;
+    False when compliant or untracked."""
+    thr = targets().get(metric)
+    if thr is None or value <= thr:
+        return False
+    core.counter("serving.slo_violation.%s" % metric).add(1)
+    return True
+
+
+def request_complete(compliant):
+    """Fold one finished request's verdict into the rolling window and
+    publish the ``serving.slo_attainment`` gauge. Returns the current
+    attainment fraction."""
+    w = max(window(), 1)
+    with _lock:
+        _results.append(bool(compliant))
+        while len(_results) > w:
+            _results.popleft()
+        att = sum(_results) / float(len(_results))
+    core.gauge("serving.slo_attainment").set(att)
+    return att
+
+
+def attainment():
+    """Current rolling attainment (None before any completion)."""
+    with _lock:
+        if not _results:
+            return None
+        return sum(_results) / float(len(_results))
+
+
+def reset():
+    """Forget the rolling window and the spec cache (tests)."""
+    global _spec_cache, _targets, _warned
+    with _lock:
+        _results.clear()
+        _spec_cache = None
+        _targets = {}
+        _warned = False

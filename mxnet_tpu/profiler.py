@@ -67,6 +67,8 @@ def set_state(state="stop", profile_process="worker"):
     if state == "run" and not _state["running"]:
         _state["obs_prev"] = _obs_core._override
         _obs_core.set_enabled(True)
+        from .observability import http as _obs_http
+        _obs_http.maybe_start()    # MXNET_OBS_HTTP live scrape, if set
         if _config.get("xla_trace", True):
             trace_dir = str(_config["filename"]) + ".tracedir"
             _state["dir"] = trace_dir
@@ -122,7 +124,9 @@ def dump(finished=True, profile_process="worker"):
         _state["dir"] = None
     from .observability import attribution as _obs_attr
     from .observability import dist as _obs_dist
+    from .observability import http as _obs_http
     from . import storage as _storage
+    _obs_http.maybe_start()        # MXNET_OBS_HTTP live scrape, if set
     _obs_dist.ensure_clock_anchor()
     _storage.publish_device_memory_gauges()
     # per-operator attribution: per-scope flops/bytes gauges ride the

@@ -1,0 +1,436 @@
+"""Per-request serving observability (ISSUE 7): the log-bucketed
+Histogram primitive (bucket/percentile math vs numpy references,
+cross-rank bucket-wise merge), request lifecycle tracing through the
+ContinuousBatcher (spans + flow events under admission staleness,
+mid-flight eviction and chaos-injected requeue), SLO accounting
+(MXNET_OBS_SLO violation counters + rolling attainment), the live
+MXNET_OBS_HTTP scrape endpoint, and the one-guarded-branch-when-off
+contract on every new instrumented path."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer as tf
+from mxnet_tpu.models.serving import ContinuousBatcher
+from mxnet_tpu.observability import chaos, core, dist, export
+from mxnet_tpu.observability import histogram as hist
+from mxnet_tpu.observability import http as obs_http
+from mxnet_tpu.observability import slo
+from mxnet_tpu.observability.histogram import Histogram
+
+
+@pytest.fixture
+def obs_on(monkeypatch):
+    """Clean, enabled telemetry + SLO/chaos state for one test."""
+    monkeypatch.setenv("MXNET_OBS", "1")
+    monkeypatch.delenv("MXNET_OBS_SLO", raising=False)
+    core.set_enabled(None)
+    core.reset()
+    slo.reset()
+    chaos.reset()
+    yield core
+    core.set_enabled(None)
+    core.reset()
+    slo.reset()
+    chaos.reset()
+
+
+@pytest.fixture
+def obs_off(monkeypatch):
+    monkeypatch.delenv("MXNET_OBS", raising=False)
+    monkeypatch.delenv("MXNET_OBS_HTTP", raising=False)
+    core.set_enabled(None)
+    core.reset()
+    slo.reset()
+    yield core
+    core.set_enabled(None)
+    core.reset()
+    slo.reset()
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=97, d_model=16, n_heads=2, n_layers=1,
+                d_ff=32, max_len=48, dtype=jnp.float32)
+    base.update(kw)
+    return tf.TransformerConfig(**base)
+
+
+_PARAMS_CACHE = {}
+
+
+def _setup(seed=0):
+    cfg = _cfg()
+    if seed not in _PARAMS_CACHE:
+        _PARAMS_CACHE[seed] = tf.init_params(cfg, seed=seed)
+    return cfg, _PARAMS_CACHE[seed]
+
+
+# ------------------------------------------------------- histogram --
+
+def test_histogram_percentiles_vs_numpy(obs_on):
+    rng = np.random.RandomState(0)
+    vals = rng.lognormal(mean=2.0, sigma=1.2, size=20000)
+    h = Histogram("lat", "ms")
+    for v in vals:
+        h.observe(v)
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(vals.sum(), rel=1e-9)
+    assert h.min == pytest.approx(vals.min())
+    assert h.max == pytest.approx(vals.max())
+    for q in (0.5, 0.9, 0.99, 0.999):
+        ref = np.percentile(vals, q * 100)
+        est = h.percentile(q)
+        # log buckets bound relative error by the growth factor;
+        # interpolation does far better in practice (<1% measured)
+        assert abs(est - ref) / ref < 0.05, (q, est, ref)
+    qs = h.quantiles()
+    assert set(qs) == {"p50", "p90", "p99", "p999"}
+    assert qs["p50"] <= qs["p90"] <= qs["p99"] <= qs["p999"]
+
+
+def test_histogram_bucket_edges_and_bounded_memory(obs_on):
+    h = Histogram("edges", lo=1.0, growth=2.0)
+    for v in (-3.0, 0.0, 0.5, 1.0):     # all at/below lo -> bucket 0
+        h.observe(v)
+    assert h.counts[0] == 4
+    h.observe(1.5)                       # (1, 2]   -> bucket 1
+    h.observe(2.0)                       # edge is inclusive -> bucket 1
+    h.observe(2.1)                       # (2, 4]   -> bucket 2
+    assert h.counts[1] == 2 and h.counts[2] == 1
+    # a preposterous value clamps into the last bucket, list stays
+    # bounded, and the estimate clamps to the exact observed max
+    h.observe(1e30)
+    assert len(h.counts) <= hist.MAX_BUCKETS
+    assert h.percentile(1.0) == pytest.approx(1e30)
+    assert h.count == 8
+
+
+def test_histogram_merge_bucket_wise(obs_on):
+    rng = np.random.RandomState(1)
+    vals = rng.gamma(2.0, 20.0, size=8000)
+    a, b = Histogram("m"), Histogram("m")
+    for v in vals[:3000]:
+        a.observe(v)
+    for v in vals[3000:]:
+        b.observe(v)
+    merged = Histogram.from_state(hist.merge_state(a.state(),
+                                                   b.state()))
+    assert merged.count == len(vals)
+    assert merged.sum == pytest.approx(vals.sum(), rel=1e-9)
+    for q in (0.5, 0.99):
+        ref = np.percentile(vals, q * 100)
+        assert abs(merged.percentile(q) - ref) / ref < 0.05
+    # mismatched bucketing must refuse, not silently mis-merge
+    other = Histogram("m", growth=1.5)
+    other.observe(1.0)
+    with pytest.raises(ValueError):
+        a.merge(other.state())
+    # merge_state_maps keeps going and reports the conflict
+    out, conflicts = hist.merge_state_maps(
+        [{"m": a.state()}, {"m": other.state()}])
+    assert conflicts == ["m"] and out["m"]["count"] == a.count
+
+
+def test_histogram_off_records_nothing(obs_off):
+    h = hist.histogram("noop")
+    h.observe(5.0)
+    assert h.count == 0 and h.counts == []
+
+
+def test_histogram_exporters(obs_on):
+    h = core.histogram("serving.test_ms", "ms")
+    for v in (1.0, 2.0, 4.0, 100.0):
+        h.observe(v)
+    agg = export.aggregate()["histograms"]["serving.test_ms"]
+    assert agg["count"] == 4 and agg["sum"] == pytest.approx(107.0)
+    table = export.aggregate_table()
+    assert "Histograms" in table and "serving.test_ms" in table
+    prom = export.prometheus_text()
+    assert 'mxnet_obs_hist_count{name="serving_test_ms"} 4' in prom
+    assert 'mxnet_obs_hist_sum{name="serving_test_ms"} 107' in prom
+    assert 'le="+Inf"} 4' in prom
+    trace = export.chrome_trace()
+    st = trace["otherData"]["histograms"]["serving.test_ms"]
+    assert st["count"] == 4 and sum(st["counts"]) == 4
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "serving.test_ms" in names
+
+
+def test_merge_traces_combines_histograms(obs_on, tmp_path):
+    rng = np.random.RandomState(2)
+    vals = rng.lognormal(1.0, 0.8, size=4000)
+    paths = []
+    for rank, chunk in enumerate((vals[:1500], vals[1500:])):
+        core.reset()
+        h = core.histogram("serving.ttft_ms", "ms")
+        for v in chunk:
+            h.observe(v)
+        trace = export.chrome_trace()
+        trace["otherData"]["rank"] = rank
+        p = tmp_path / ("trace%s.json" % (".rank1" if rank else ""))
+        p.write_text(json.dumps(trace))
+        paths.append(str(p))
+    merged = dist.merge_traces(paths)
+    st = merged["otherData"]["histograms"]["serving.ttft_ms"]
+    assert st["count"] == len(vals)
+    assert merged["otherData"]["histogram_merge_conflicts"] == []
+    m = Histogram.from_state(st)
+    ref = np.percentile(vals, 99)
+    assert abs(m.percentile(0.99) - ref) / ref < 0.05
+
+
+# --------------------------------------- request lifecycle tracing --
+
+def _flow_chains(recs):
+    """{rid: [flow phases]} from raw ring records."""
+    chains = {}
+    for r in recs:
+        if r[0] == "F" and r[1] == "serving.request":
+            chains.setdefault(r[4][1], []).append(r[4][0])
+    return chains
+
+
+def test_lifecycle_spans_flows_and_histograms(obs_on):
+    cfg, params = _setup()
+    rng = np.random.RandomState(0)
+    jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(3)]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    results, order = srv.run(jobs)
+    assert len(results) == len(jobs)
+    recs = core.records()
+    names = {r[1] for r in recs}
+    for needed in ("serving.prefill", "serving.queue_wait",
+                   "serving.dispatch", "serving.sync", "serving.patch",
+                   "serving.finish", "serving.goodput_tok_s",
+                   "serving.kv_utilization",
+                   "serving.lane_utilization"):
+        assert needed in names, needed
+    # every request: flow chain starts with "s", ends with "f", with
+    # at least one decode step in between
+    chains = _flow_chains(recs)
+    assert set(chains) == set(order)
+    for rid, phases in chains.items():
+        assert phases[0] == "s" and phases[-1] == "f" \
+            and "t" in phases, (rid, phases)
+    # prefill spans carry the rid; queue_wait present per request
+    prefill_rids = {r[6]["rid"] for r in recs
+                    if r[0] == "X" and r[1] == "serving.prefill"}
+    assert prefill_rids == set(order)
+    assert sum(1 for r in recs
+               if r[0] == "X" and r[1] == "serving.queue_wait") \
+        == len(jobs)
+    # histogram counts: one TTFT + queue + e2e per request; ITL covers
+    # every decoded (non-first) token
+    hs = hist.histograms()
+    assert hs["serving.ttft_ms"].count == len(jobs)
+    assert hs["serving.queue_ms"].count == len(jobs)
+    assert hs["serving.e2e_ms"].count == len(jobs)
+    assert hs["serving.itl_ms"].count == sum(n - 1 for _, n in jobs)
+    # deprecated last-value gauge still exported for back-compat
+    assert core.counters()["serving.admit_to_first_token_ms"].count \
+        == len(jobs)
+
+
+def test_lifecycle_under_admission_staleness(obs_on):
+    """A request admitted mid-flight (pipeline window full) still gets
+    a complete, correctly-ordered lifecycle: flow start at admit, first
+    credit only after its first post-admission dispatch syncs."""
+    cfg, params = _setup(seed=7)
+    rng = np.random.RandomState(3)
+    p1 = list(rng.randint(1, 97, 6))
+    p2 = list(rng.randint(1, 97, 4))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=3)
+    r1 = srv.admit(p1, 10)
+    done = dict(srv.step())             # window fills to depth 3
+    assert len(srv._inflight) > 0
+    r2 = srv.admit(p2, 5)               # admitted MID-FLIGHT
+    while r1 not in done or r2 not in done:
+        done.update(srv.step())
+    chains = _flow_chains(core.records())
+    for rid in (r1, r2):
+        phases = chains[rid]
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert phases.count("f") == 1
+    assert hist.histograms()["serving.e2e_ms"].count == 2
+
+
+def test_mid_flight_eviction_records_evict(obs_on):
+    cfg, params = _setup(seed=21)
+    rng = np.random.RandomState(7)
+    p1 = list(rng.randint(1, 97, 5))
+    p2 = list(rng.randint(1, 97, 5))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    r1 = srv.admit(p1, 12)
+    r2 = srv.admit(p2, 12)
+    done = dict(srv.step())
+    done.update(srv.step())
+    assert len(srv._inflight) > 0       # eviction happens mid-flight
+    assert srv.cancel(r1) is not None
+    while r2 not in done:
+        done.update(srv.step())
+    recs = core.records()
+    evicts = [r for r in recs if r[1] == "serving.evict"]
+    assert len(evicts) == 1 and evicts[0][6]["rid"] == r1
+    chains = _flow_chains(recs)
+    assert chains[r1][-1] == "f"        # evicted chain still closes
+    # e2e counts only true completions, not the eviction
+    assert hist.histograms()["serving.e2e_ms"].count == 1
+    finishes = [r for r in recs if r[1] == "serving.finish"]
+    assert [f[6]["rid"] for f in finishes] == [r2]
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_chaos_requeue_keeps_lifecycle_and_streams(obs_on, depth):
+    """A chaos-injected dispatch failure (the PR 6 site) requeues the
+    live requests: the trace records serving.requeued + a flow step
+    tying the resumed lane into the original chain, every flow chain
+    still closes exactly once, and the streams stay bit-exact."""
+    cfg, params = _setup(seed=5)
+    rng = np.random.RandomState(11)
+    jobs = [(list(rng.randint(1, 97, 4)), 6) for _ in range(3)]
+    solo = [np.asarray(tf.generate(
+        params, jnp.asarray([p], jnp.int32), n, cfg)[0]).tolist()
+        for p, n in jobs]
+    chaos.inject("serving.dispatch", "error", at=1)
+    srv = ContinuousBatcher(params, cfg, max_batch=2,
+                            pipeline_depth=depth)
+    results, order = srv.run(jobs)
+    assert len(results) == len(jobs)
+    for j, rid in enumerate(order):
+        assert results[rid] == solo[j], "stream diverged after requeue"
+    recs = core.records()
+    requeued = [r for r in recs if r[1] == "serving.requeued"]
+    assert requeued, "no serving.requeued instant in the trace"
+    flow_requeues = [r for r in recs
+                     if r[0] == "F" and r[6].get("requeued")]
+    assert {r[4][1] for r in flow_requeues} \
+        == {r[6]["rid"] for r in requeued}
+    chains = _flow_chains(recs)
+    for rid in order:
+        assert chains[rid].count("s") == 1
+        assert chains[rid].count("f") == 1
+    assert core.counters()["serving.dispatch_failures"].count == 1
+
+
+# ------------------------------------------------- SLO accounting --
+
+def test_slo_spec_grammar():
+    assert slo.parse_spec("ttft_ms=500,itl_ms=50") \
+        == {"ttft_ms": 500.0, "itl_ms": 50.0}
+    assert slo.parse_spec("ttft_ms=500; e2e_ms=2e3") \
+        == {"ttft_ms": 500.0, "e2e_ms": 2000.0}
+    assert slo.parse_spec("") == {}
+    for bad in ("ttft_ms", "ttft_ms=abc", "=5", "ttft_ms=-1"):
+        with pytest.raises(ValueError):
+            slo.parse_spec(bad)
+
+
+def test_slo_malformed_env_warns_once_and_disables(obs_on,
+                                                   monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_SLO", "ttft_ms=oops")
+    slo.reset()
+    with pytest.warns(RuntimeWarning, match="malformed MXNET_OBS_SLO"):
+        assert slo.targets() == {}
+    assert not slo.active()             # cached, no second warning
+    assert slo.check("ttft_ms", 1e9) is False
+
+
+def test_slo_violations_and_attainment(obs_on, monkeypatch):
+    cfg, params = _setup()
+    rng = np.random.RandomState(0)
+    jobs = [(list(rng.randint(1, 97, 4)), 4) for _ in range(3)]
+    # impossibly tight TTFT: every request violates, attainment 0
+    monkeypatch.setenv("MXNET_OBS_SLO", "ttft_ms=0.000001")
+    slo.reset()
+    ContinuousBatcher(params, cfg, max_batch=2).run(jobs)
+    viol = core.counters()["serving.slo_violation.ttft_ms"]
+    assert viol.count == len(jobs)
+    assert core.counters()["serving.slo_attainment"].value == 0.0
+    assert slo.attainment() == 0.0
+    # generous targets: zero violations, attainment 1
+    core.reset()
+    slo.reset()
+    monkeypatch.setenv("MXNET_OBS_SLO", "ttft_ms=1e9,itl_ms=1e9")
+    ContinuousBatcher(params, cfg, max_batch=2).run(jobs)
+    assert "serving.slo_violation.ttft_ms" not in core.counters()
+    assert core.counters()["serving.slo_attainment"].value == 1.0
+
+
+def test_slo_rolling_window(obs_on, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_SLO", "ttft_ms=100")
+    monkeypatch.setenv("MXNET_OBS_SLO_WINDOW", "4")
+    slo.reset()
+    for ok in (False, False, True, True, True, True):
+        slo.request_complete(ok)
+    # the two misses fell out of the 4-wide window
+    assert slo.attainment() == 1.0
+    assert core.counters()["serving.slo_attainment"].value == 1.0
+
+
+# ------------------------------------------------- HTTP endpoint --
+
+def test_http_scrape_roundtrip(obs_on):
+    h = core.histogram("serving.ttft_ms", "ms")
+    for v in (1.0, 5.0, 9.0):
+        h.observe(v)
+    core.gauge("serving.lane_occupancy").set(2)
+    port = obs_http.start(0)
+    try:
+        assert obs_http.port() == port
+        base = "http://127.0.0.1:%d" % port
+        prom = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        assert 'mxnet_obs_hist_count{name="serving_ttft_ms"} 3' in prom
+        assert 'mxnet_obs_value{name="serving_lane_occupancy"} 2' \
+            in prom
+        hz = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read().decode())
+        assert hz["status"] == "ok"
+        assert hz["counters"]["serving.lane_occupancy"] == 2
+        assert hz["histograms"]["serving.ttft_ms"]["count"] == 3
+        assert hz["rank"] == dist.process_index()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        # idempotent: a second start returns the same bound port
+        assert obs_http.start(0) == port
+    finally:
+        obs_http.stop()
+    assert obs_http.port() is None
+
+
+def test_http_env_gate(obs_on, monkeypatch):
+    monkeypatch.delenv("MXNET_OBS_HTTP", raising=False)
+    assert obs_http.maybe_start() is None
+    monkeypatch.setenv("MXNET_OBS_HTTP", "0")
+    assert obs_http.maybe_start() is None
+
+
+# ------------------------------------------ off-path (PR 2 contract) --
+
+def test_serving_instrumentation_off_is_silent(obs_off, monkeypatch):
+    """With MXNET_OBS unset, every new instrumented path — admission
+    with enqueue stamps, sync + pipelined decode, eviction, SLO env
+    set, the drivers — leaves the ring, counter registry AND histogram
+    registry untouched (one guarded branch per site)."""
+    monkeypatch.setenv("MXNET_OBS_SLO", "ttft_ms=0.000001")
+    cfg, params = _setup()
+    rng = np.random.RandomState(0)
+    jobs = [(list(rng.randint(1, 97, 4)), 4) for _ in range(3)]
+    for depth in (1, 2):
+        srv = ContinuousBatcher(params, cfg, max_batch=2,
+                                pipeline_depth=depth)
+        results, order = srv.run(jobs)
+        assert len(results) == len(jobs)
+        rid = srv.admit(jobs[0][0], 8)
+        srv.step()
+        srv.cancel(rid)
+    assert core.records() == []
+    assert core.counters() == {}
+    assert hist.histograms() == {}
+    assert slo.attainment() is None

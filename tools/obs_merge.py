@@ -50,6 +50,15 @@ def main(argv=None):
     if other["unaligned_ranks"]:
         print("[obs_merge] WARNING: no clock anchor for ranks %s — "
               "their lanes are unshifted" % other["unaligned_ranks"])
+    hists = other.get("histograms", {})
+    if hists:
+        print("[obs_merge] merged histograms (bucket-wise): %s"
+              % ", ".join("%s n=%d" % (n, h.get("count", 0))
+                          for n, h in sorted(hists.items())))
+    if other.get("histogram_merge_conflicts"):
+        print("[obs_merge] WARNING: bucketing mismatch for %s — kept "
+              "the first rank's buckets"
+              % other["histogram_merge_conflicts"])
     return 0
 
 

@@ -1,0 +1,177 @@
+"""Render a per-request serving timeline + latency percentile table
+from a dumped observability trace.
+
+A ``ContinuousBatcher`` run with ``MXNET_OBS=1`` leaves a chrome trace
+(``profiler.dump()``, or the merged output of ``tools/obs_merge.py``)
+carrying the request lifecycle: ``serving.prefill`` / ``serving.queue_wait``
+spans with a ``rid``, ``serving.request`` flow events tying each
+request's admit -> per-chunk token credits -> finish across
+pipeline-depth dispatches, ``serving.finish`` / ``serving.evict`` /
+``serving.requeued`` instants, and the log-bucketed ``serving.*``
+latency histograms in ``otherData.histograms``. This CLI turns that
+into the two debugging views the trace viewer doesn't give you
+directly:
+
+* a per-request TIMELINE — admit / first-token / sync / finish
+  landmarks per rid, with an ASCII lane so a slow stream is visible at
+  a glance (which request, stalled where, requeued how often);
+* the PERCENTILE TABLE — TTFT / ITL / e2e / queue-wait p50/p90/p99/
+  p99.9 recomputed from the trace's bucket states (works on merged
+  multi-rank traces: buckets are already combined fleet-wide).
+
+    python tools/obs_serving.py trace.json
+    python tools/obs_serving.py merged.json --json summary.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+TIMELINE_WIDTH = 56
+
+
+def collect_requests(trace):
+    """{rid: lifecycle dict} from the trace's serving events."""
+    reqs = {}
+
+    def rec(rid):
+        return reqs.setdefault(int(rid), {
+            "rid": int(rid), "admit_ts": None, "first_ts": None,
+            "finish_ts": None, "syncs": [], "tokens": 0,
+            "queue_ms": None, "prefill_ms": None, "requeues": 0,
+            "evicted": False, "lane": None, "rank": None})
+
+    for ev in trace.get("traceEvents", []):
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        rid = args.get("rid")
+        if rid is None or not name.startswith("serving."):
+            continue
+        r = rec(rid)
+        ts = ev.get("ts", 0)
+        ph = ev.get("ph")
+        if name == "serving.prefill" and ph == "X":
+            r["admit_ts"] = ts
+            r["prefill_ms"] = ev.get("dur", 0) / 1000.0
+            r["lane"] = args.get("lane", r["lane"])
+            r["rank"] = ev.get("pid", r["rank"])
+        elif name == "serving.queue_wait" and ph == "X":
+            r["queue_ms"] = ev.get("dur", 0) / 1000.0
+        elif name == "serving.request":
+            if ph == "s":
+                r["first_ts"] = ts
+            elif ph == "t":
+                r["syncs"].append(ts)
+                r["tokens"] += int(args.get("tokens", 0) or 0)
+                if args.get("requeued"):
+                    r["requeues"] += 1
+            elif ph == "f":
+                r["finish_ts"] = ts
+        elif name in ("serving.finish", "serving.evict"):
+            r["finish_ts"] = ts
+            r["tokens"] = int(args.get("emitted", r["tokens"]))
+            r["evicted"] = name == "serving.evict"
+    return reqs
+
+
+def render_timeline(reqs):
+    """ASCII lanes, one per request: Q(ueue) P(refill/admit) then a
+    dot per sync landmark, F(inish)/E(vict)/R(equeue markers)."""
+    spans = [r for r in reqs.values() if r["admit_ts"] is not None]
+    if not spans:
+        return ["(no serving.* request events in this trace)"]
+    t0 = min(r["admit_ts"] - (r["queue_ms"] or 0) * 1000 for r in spans)
+    t1 = max(max([r["finish_ts"] or r["admit_ts"]]
+                 + r["syncs"]) for r in spans)
+    scale = (t1 - t0) or 1
+
+    def col(ts):
+        return min(int((ts - t0) / scale * (TIMELINE_WIDTH - 1)),
+                   TIMELINE_WIDTH - 1)
+
+    lines = ["per-request timeline (%.1f ms window, '.'=chunk sync)"
+             % (scale / 1000.0),
+             "%-6s %-6s %-8s %s" % ("rid", "rank", "status", "lane")]
+    for r in sorted(spans, key=lambda x: x["admit_ts"]):
+        lane = [" "] * TIMELINE_WIDTH
+        if r["queue_ms"]:
+            q0 = col(r["admit_ts"] - r["queue_ms"] * 1000)
+            for c in range(q0, col(r["admit_ts"])):
+                lane[c] = "-"
+        lane[col(r["admit_ts"])] = "A"
+        for ts in r["syncs"]:
+            c = col(ts)
+            lane[c] = "." if lane[c] == " " else lane[c]
+        if r["finish_ts"] is not None:
+            lane[col(r["finish_ts"])] = "E" if r["evicted"] else "F"
+        status = ("evicted" if r["evicted"]
+                  else "done" if r["finish_ts"] is not None
+                  else "live")
+        if r["requeues"]:
+            status += "+rq%d" % r["requeues"]
+        lines.append("%-6d %-6s %-8s |%s|"
+                     % (r["rid"],
+                        r["rank"] if r["rank"] is not None else "-",
+                        status, "".join(lane)))
+    return lines
+
+
+def percentile_rows(trace):
+    """[(name, stats)] from otherData.histograms bucket states."""
+    from mxnet_tpu.observability.histogram import Histogram
+    out = []
+    for name, st in sorted(
+            (trace.get("otherData") or {}).get("histograms",
+                                               {}).items()):
+        h = Histogram.from_state(st)
+        if h.count:
+            out.append((name, h.snapshot()))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("trace", help="chrome trace from profiler.dump() "
+                                 "or tools/obs_merge.py")
+    p.add_argument("--json", default=None,
+                   help="also write the per-request records + "
+                        "histogram stats as JSON")
+    args = p.parse_args(argv)
+
+    with open(args.trace) as f:
+        trace = json.load(f)
+    reqs = collect_requests(trace)
+    for line in render_timeline(reqs):
+        print(line)
+
+    rows = percentile_rows(trace)
+    if rows:
+        fmt = "%-24s %8s %10s %10s %10s %10s %10s"
+        print()
+        print("latency percentiles (from bucketed histograms; "
+              "ms unless named otherwise)")
+        print(fmt % ("Name", "Count", "Mean", "P50", "P90", "P99",
+                     "P99.9"))
+        for name, s in rows:
+            print(fmt % (name, s["count"], "%.3f" % s["mean"],
+                         "%.3f" % s["p50"], "%.3f" % s["p90"],
+                         "%.3f" % s["p99"], "%.3f" % s["p999"]))
+    else:
+        print("\n(no histogram states in this trace — dumped with an "
+              "older build, or nothing observed)")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"requests": sorted(reqs.values(),
+                                          key=lambda r: r["rid"]),
+                       "histograms": dict(rows)}, f, indent=1)
+        print("\nwrote %s" % args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,10 +25,12 @@ validates that the merged chrome trace carries BOTH rank lanes:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --nproc 2
 
-``--serving`` runs the serving half (ISSUE 5): a pipelined
-ContinuousBatcher serves a couple of requests and the emitted trace
-must carry the dispatch/sync/patch spans plus the in-flight-depth /
-lane-occupancy / admit-latency gauges:
+``--serving`` runs the serving half (ISSUEs 5 + 7): a pipelined
+ContinuousBatcher serves a few requests while a live HTTP endpoint is
+scraped mid-run, and the emitted trace must carry the full request
+lifecycle — dispatch/sync/patch/prefill/queue-wait spans, per-request
+flow chains, the TTFT/ITL/e2e/queue histograms (bucket states included)
+and the occupancy/goodput gauges:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --serving
 """
@@ -142,25 +144,60 @@ def ops_smoke():
 
 
 def serving_smoke():
-    """--serving: one pipelined serving step must land its spans and
-    gauges in the emitted chrome trace (the ISSUE 5 obs acceptance
-    path: dispatch/sync/patch + depth/occupancy/admit-latency)."""
+    """--serving: a pipelined ContinuousBatcher run under churn must
+    land the request lifecycle in the emitted chrome trace — dispatch/
+    sync/patch/prefill/queue-wait spans, serving.request flow events
+    tying admit->syncs->finish per rid, the bounded-memory TTFT/ITL/
+    e2e/queue histograms (events + mergeable bucket states), the
+    occupancy/goodput gauges — and the MXNET_OBS_HTTP-style live
+    endpoint must answer a /metrics + /healthz scrape MID-RUN."""
+    import urllib.request
+
     import numpy as np
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.models import transformer as tf
     from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import http as obs_http
 
     cfg = tf.TransformerConfig(vocab_size=97, d_model=16, n_heads=2,
                                n_layers=1, d_ff=32, max_len=48,
                                dtype=jnp.float32)
     params = tf.init_params(cfg, seed=0)
     rng = np.random.RandomState(0)
-    jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(3)]
+    jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(4)]
     srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
-    results, order = srv.run(jobs)
+
+    port = obs_http.start(0)       # ephemeral port; env-free smoke
+    scraped = {"metrics": None, "healthz": None}
+    results = {}
+    try:
+        for n_done, (rid, tok, done) in enumerate(srv.stream(jobs)):
+            if done:
+                results[rid] = True
+            if n_done == 8 and scraped["metrics"] is None:
+                # mid-run: lanes busy, chunks in flight
+                base = "http://127.0.0.1:%d" % port
+                scraped["metrics"] = urllib.request.urlopen(
+                    base + "/metrics", timeout=10).read().decode()
+                scraped["healthz"] = json.loads(urllib.request.urlopen(
+                    base + "/healthz", timeout=10).read().decode())
+    finally:
+        obs_http.stop()
     if len(results) != len(jobs):
         print("[obs_smoke] FAIL: serving pool lost requests")
+        return 1
+    if not scraped["metrics"] \
+            or "mxnet_obs_hist" not in scraped["metrics"] \
+            or 'name="serving_ttft_ms"' not in scraped["metrics"]:
+        print("[obs_smoke] FAIL: live /metrics scrape lacks serving "
+              "histograms")
+        return 1
+    hz = scraped["healthz"]
+    if not hz or hz.get("status") != "ok" \
+            or "serving.lane_occupancy" not in hz.get("counters", {}):
+        print("[obs_smoke] FAIL: /healthz snapshot incomplete: %s"
+              % (sorted((hz or {}).get("counters", {})),))
         return 1
 
     fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_srv_"),
@@ -171,15 +208,44 @@ def serving_smoke():
         trace = json.load(f)
     names = {e["name"] for e in trace["traceEvents"]}
     required = {"serving.dispatch", "serving.sync", "serving.patch",
+                "serving.prefill", "serving.queue_wait",
+                "serving.finish", "serving.request",
                 "serving.inflight_depth", "serving.lane_occupancy",
-                "serving.admit_to_first_token_ms"}
+                "serving.kv_utilization", "serving.goodput_tok_s",
+                "serving.admit_to_first_token_ms", "serving.ttft_ms",
+                "serving.itl_ms", "serving.e2e_ms"}
     missing = required - names
     if missing:
         print("[obs_smoke] FAIL: serving trace missing: %s"
               % sorted(missing))
         return 1
-    print("[obs_smoke] serving trace OK: %d events -> %s"
-          % (len(trace["traceEvents"]), path))
+    # every request's flow chain must be complete: one start, >=1
+    # step, one finish per rid
+    flows = {}
+    for e in trace["traceEvents"]:
+        if e["name"] == "serving.request" and e["ph"] in "stf":
+            flows.setdefault(e["id"], set()).add(e["ph"])
+    bad = [rid for rid, phs in flows.items() if phs != {"s", "t", "f"}]
+    if len(flows) != len(jobs) or bad:
+        print("[obs_smoke] FAIL: request flow chains incomplete "
+              "(%d chains, broken: %s)" % (len(flows), bad))
+        return 1
+    hists = trace["otherData"].get("histograms", {})
+    for hname in ("serving.ttft_ms", "serving.itl_ms",
+                  "serving.e2e_ms", "serving.queue_ms"):
+        if not hists.get(hname, {}).get("count"):
+            print("[obs_smoke] FAIL: histogram %s missing/empty in "
+                  "trace otherData" % hname)
+            return 1
+    table = mx.profiler.dumps(aggregate=True)
+    if "Histograms" not in table or "serving.ttft_ms" not in table:
+        print("[obs_smoke] FAIL: aggregate table lacks the serving "
+              "histogram section")
+        return 1
+    print("[obs_smoke] serving trace OK: %d events, %d request flow "
+          "chains, %d histograms, live scrape on :%d -> %s"
+          % (len(trace["traceEvents"]), len(flows), len(hists), port,
+             path))
     return 0
 
 
@@ -237,10 +303,28 @@ def orchestrate(nproc):
         print("[obs_smoke] FAIL: ranks %s merged without a clock "
               "anchor" % unaligned)
         return 1
+    # the merged trace must carry BUCKET-WISE merged histograms: each
+    # rank's trainer.step_ms counts sum into the fleet distribution
+    rank_counts = []
+    for p in inputs:
+        with open(p) as f:
+            other = json.load(f).get("otherData", {})
+        rank_counts.append(other.get("histograms", {})
+                           .get("trainer.step_ms", {}).get("count", 0))
+    merged_hist = merged["otherData"].get("histograms", {}) \
+        .get("trainer.step_ms", {})
+    if not all(rank_counts) \
+            or merged_hist.get("count") != sum(rank_counts):
+        print("[obs_smoke] FAIL: merged trainer.step_ms histogram "
+              "count %s != per-rank counts %s summed"
+              % (merged_hist.get("count"), rank_counts))
+        return 1
     print("[obs_smoke] merged trace OK: %d ranks, %d events, clock "
-          "offsets %s -> %s"
+          "offsets %s, trainer.step_ms histogram %s=%d -> %s"
           % (nproc, len(merged["traceEvents"]),
              merged["otherData"]["clock_offsets_us"],
+             "+".join(str(c) for c in rank_counts),
+             merged_hist.get("count", 0),
              os.path.join(outdir, "merged.json")))
     return 0
 
