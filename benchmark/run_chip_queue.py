@@ -100,6 +100,14 @@ QUEUE = [
     ("serving_pipeline",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--pipeline-depth", "2"]}, 1800, False),
+    # paged KV cache A/B: dense-lane vs block-pool batcher at an EQUAL
+    # cache-HBM budget on a mixed-length workload — admission is
+    # bounded by actual block demand instead of lanes x max_len (the
+    # CPU smoke admits 2.5x concurrently at a slight throughput GAIN;
+    # docs/SERVING.md "Paged KV cache")
+    ("serving_paged",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--paged"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",

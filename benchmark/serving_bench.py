@@ -28,7 +28,17 @@ pipelining A/B: the mixed-arrival workload through the synchronous
 (depth=1) batcher vs the pipelined one at depth D — same jobs, same
 chunking, streams bit-identical (tested), the only variable being how
 many chunk dispatches ride in flight against the device-resident
-carry. On CPU the A/B model runs float32: CPU bf16 is software-
+carry.
+
+With ``--paged`` it runs the paged-KV A/B instead: a mixed-length
+workload through the dense-lane batcher vs the paged one at an EQUAL
+cache-HBM budget (the paged pool holds exactly the dense lanes' cache
+positions, split into MXNET_KV_BLOCK_SIZE blocks, spread over more
+lanes). Streams are bit-identical (tested); what changes is
+ADMISSION — dense burns a [max_len] row per request, paged burns the
+request's actual worst-case blocks — so the leg prints peak/total
+admitted-request columns alongside tokens/s, then the PR 7 latency
+percentile table from one instrumented paged run. On CPU the A/B model runs float32: CPU bf16 is software-
 emulated at ~2x the compute cost, and that emulation tax drowns the
 host-side round-trip effect the A/B exists to measure (on TPU, where
 bf16 is native, the leg keeps the serving default dtype).
@@ -228,6 +238,115 @@ def pipeline_ab(depth):
     _write_artifact(_json_arg(), [rep])
 
 
+def paged_ab():
+    """The paged-KV A/B (see the module docstring): same HBM budget,
+    dense lanes vs block pool, mixed-length mixed-arrival workload.
+    Columns: peak concurrently-admitted requests, total tokens/s."""
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+
+    backend = jax.default_backend()
+    if SMOKE:
+        vocab = 8192
+        d_model, heads, layers, max_len = 32, 2, 1, 96
+        t_prompt = 24
+        n_jobs, dense_slots, block_size = 12, 2, 8
+    else:
+        vocab = 32000
+        d_model, heads, layers, max_len = 512, 8, 8, 4096
+        t_prompt = 512
+        n_jobs, dense_slots = 32, 8
+        block_size = int(os.environ.get("MXNET_KV_BLOCK_SIZE", "16"))
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    cfg = tf.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=dtype)
+    params = tf.init_params(cfg, seed=0)
+    # the HBM budget: dense_slots full-context rows, expressed in
+    # blocks for the paged pool; 4x the lanes so admission is bounded
+    # by BLOCKS, not lane count
+    num_blocks = dense_slots * (max_len // block_size) + 1
+    paged_slots = dense_slots * 4
+    jrng = np.random.RandomState(1)
+    # mixed-length: short interactive prompts next to near-full ones,
+    # budgets well under max_len — the regime where a dense row wastes
+    # most of its positions
+    jobs = []
+    for _ in range(n_jobs):
+        t_p = int(jrng.randint(max(2, t_prompt // 8), t_prompt))
+        n_new = int(jrng.randint(8, max(9, t_prompt // 2)))
+        jobs.append((list(jrng.randint(1, vocab, t_p)), n_new))
+    total_new = sum(n for _, n in jobs)
+    print("serving paged A/B: backend=%s dtype=%s d_model=%d "
+          "layers=%d max_len=%d block=%d budget=%d blocks "
+          "(dense %d lanes, paged %d lanes)"
+          % (backend, np.dtype(dtype).name, d_model, layers, max_len,
+             block_size, num_blocks - 1, dense_slots, paged_slots),
+          flush=True)
+
+    def make(paged):
+        if paged:
+            return ContinuousBatcher(
+                params, cfg, max_batch=paged_slots, paged=True,
+                block_size=block_size, num_blocks=num_blocks)
+        return ContinuousBatcher(params, cfg, max_batch=dense_slots)
+
+    def run_mixed(paged, stats=None):
+        srv = make(paged)
+        waiting, arr_i, step_i = [], 0, 0
+        peak = 0
+        while arr_i < len(jobs) or waiting or srv.active_count:
+            if arr_i < len(jobs) and step_i % 2 == 0:
+                waiting.append((jobs[arr_i], time.perf_counter_ns()))
+                arr_i += 1
+            while waiting and srv.has_capacity:
+                (p, n), enq = waiting[0]
+                if srv.admit(p, n, enqueued_ns=enq) is None:
+                    break
+                waiting.pop(0)
+            peak = max(peak, srv.active_count)
+            srv.step()
+            step_i += 1
+        if stats is not None:
+            stats["peak_admitted"] = peak
+
+    stats = {"dense": {}, "paged": {}}
+    run_mixed(False, stats["dense"])        # warm + admission stats
+    run_mixed(True, stats["paged"])
+    dense_rate = _time_tokens(lambda: run_mixed(False), total_new)
+    paged_rate = _time_tokens(lambda: run_mixed(True), total_new)
+    fmt = "%-8s %18s %14s"
+    print(fmt % ("config", "peak admitted", "tokens/s"))
+    print(fmt % ("dense", stats["dense"]["peak_admitted"],
+                 "%.1f" % dense_rate))
+    print(fmt % ("paged", stats["paged"]["peak_admitted"],
+                 "%.1f" % paged_rate))
+    print('{"leg": "continuous_paged_ab", "block_size": %d, '
+          '"num_blocks": %d, "dense_slots": %d, "paged_slots": %d, '
+          '"dense_peak_admitted": %d, "paged_peak_admitted": %d, '
+          '"dense_tokens_per_s": %.1f, "paged_tokens_per_s": %.1f, '
+          '"admitted_ratio": %.2f, "throughput_ratio": %.3f, '
+          '"jobs": %d, "backend": "%s"}'
+          % (block_size, num_blocks, dense_slots, paged_slots,
+             stats["dense"]["peak_admitted"],
+             stats["paged"]["peak_admitted"],
+             dense_rate, paged_rate,
+             stats["paged"]["peak_admitted"]
+             / max(stats["dense"]["peak_admitted"], 1),
+             paged_rate / dense_rate, n_jobs, backend), flush=True)
+    rep = _latency_report(lambda: run_mixed(True), "continuous_paged",
+                          block_size=block_size,
+                          num_blocks=num_blocks,
+                          paged_slots=paged_slots, backend=backend)
+    _write_artifact(_json_arg(), [rep])
+
+
 def main():
     from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
@@ -419,5 +538,7 @@ if __name__ == "__main__":
     _depth = _pipeline_depth_arg()
     if _depth is not None:
         pipeline_ab(_depth)
+    elif "--paged" in sys.argv[1:]:
+        paged_ab()
     else:
         main()

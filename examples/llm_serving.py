@@ -58,6 +58,11 @@ def main():
                          "(quantize_weights_int8)")
     ap.add_argument("--beam", type=int, default=0,
                     help="also decode with beam search of this width")
+    ap.add_argument("--paged-router", action="store_true",
+                    help="also serve the prompts through a 2-replica "
+                         "ReplicaRouter over paged-KV batchers "
+                         "(docs/SERVING.md 'Paged KV cache' / "
+                         "'Routing'); streams must equal generate()")
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention: use this many KV "
                          "heads (< heads shrinks the cache)")
@@ -138,6 +143,24 @@ def main():
     if match < 0.95:
         print("FAILED: generation diverged from the learned pattern")
         return 1
+    if args.paged_router:
+        # the fleet path: 2 paged-KV replicas behind the SLO-aware
+        # router; every stream must be bit-exact vs solo generate()
+        from mxnet_tpu.models.router import ReplicaRouter
+        bs = 4 if cfg.max_len % 4 == 0 else 1
+        router = ReplicaRouter.build(params, cfg, n_replicas=2,
+                                     max_batch=2, paged=True,
+                                     block_size=bs)
+        jobs = [(prompt_np[i].tolist(), args.gen)
+                for i in range(prompt_np.shape[0])]
+        results, order = router.run(jobs)
+        for i, rid in enumerate(order):
+            if results[rid] != out[i].tolist():
+                print("FAILED: routed stream %d diverged from "
+                      "generate()" % i)
+                return 1
+        print("paged router: %d requests over 2 replicas, streams "
+              "bit-exact vs generate()" % len(jobs))
     print("SERVED OK")
     return 0
 

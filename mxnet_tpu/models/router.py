@@ -1,0 +1,268 @@
+"""SLO-aware request router over N ContinuousBatcher replicas.
+
+One batcher is one device loop; scaling the serving story the way the
+TensorFlow system paper (PAPERS.md) scales one graph over many workers
+means putting a ROUTER in front of N replicas and feeding it live load
+signals. This module is that router, built on exactly the signals PR 7
+exported for it:
+
+* **Routing** — each admission goes to the healthiest replica by its
+  ``/healthz``-shaped snapshot (``ContinuousBatcher.health_snapshot()``
+  for in-process replicas; the identical names ride the
+  ``MXNET_OBS_HTTP`` ``/healthz`` ``counters`` for a scraped fleet):
+  paged KV headroom (``serving.kv_available_blocks``) first, free lanes
+  otherwise, lane utilization as the tiebreak.
+* **SLO-aware admission** — a replica whose rolling
+  ``serving.slo_attainment`` sits below ``slo_floor``
+  (``MXNET_ROUTER_SLO_FLOOR``) stops taking NEW admissions until it
+  recovers; its live streams keep decoding.
+* **Shedding** — when no replica can admit and the backlog exceeds
+  ``shed_queue`` (``MXNET_ROUTER_SHED_QUEUE``), the newest queued
+  requests are shed: the ``serving.slo_violation.shed`` counter
+  increments, the caller sees ``None`` for that rid, and the router
+  keeps serving instead of hanging.
+* **Failure draining** — a replica whose dispatch dies for good (the
+  PR 6 requeue path re-raises after its consecutive-failure cap) is
+  marked dead and DRAINED: its live requests go back to the front of
+  the router queue as continuations from their synced token prefix, so
+  greedy streams resume bit-exactly on a surviving replica (sampled
+  streams continue on a deterministically reseeded chain, the PR 6
+  recovery contract). Name replicas (``ContinuousBatcher(name="r1")``)
+  and a chaos spec like ``serving.dispatch.r1:error:every=1:count=0``
+  kills exactly one replica of the pool, replayably.
+
+The replicas are process- or thread-local (the CPU smoke runs them in
+one process; telemetry is process-global, so per-replica SLO attainment
+degrades to the shared rolling window there — occupancy and block
+headroom are per-instance either way).
+
+    srv = ReplicaRouter.build(params, cfg, n_replicas=2, max_batch=4,
+                              paged=True)
+    results, order = srv.run(jobs)          # {rid: tokens-or-None}
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+
+from .serving import ContinuousBatcher
+from .. import _fastenv
+from ..observability import core as _obs
+
+__all__ = ["ReplicaRouter"]
+
+
+class _Job(object):
+    __slots__ = ("rid", "prompt", "n_new", "seed", "stop_token",
+                 "enq_ns")
+
+    def __init__(self, rid, prompt, n_new, seed, stop_token, enq_ns):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.n_new = int(n_new)
+        self.seed = int(seed)
+        self.stop_token = stop_token
+        self.enq_ns = enq_ns
+
+
+class ReplicaRouter(object):
+    """Route a request queue over N ContinuousBatcher replicas (see the
+    module docstring for the policy). The API mirrors the batcher's:
+    ``submit()`` enqueues and returns a router-level rid, ``step()``
+    admits + steps every live replica and returns ``{rid: tokens}``
+    for completions (``None`` marks a shed request), ``run(jobs)``
+    drives a whole workload. Every completed stream equals its solo
+    ``generate()`` output — the per-replica identity the batcher
+    already guarantees, preserved across re-routing."""
+
+    def __init__(self, replicas, shed_queue=None, slo_floor=None):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas = list(replicas)
+        for i, r in enumerate(self.replicas):
+            if r.name is None:
+                r.name = "r%d" % i
+                r._chaos_site = "serving.dispatch.%s" % r.name
+        self._alive = [True] * len(self.replicas)
+        if shed_queue is None:
+            v = _fastenv.get("MXNET_ROUTER_SHED_QUEUE")
+            shed_queue = int(v) if v else None
+        self.shed_queue = shed_queue
+        if slo_floor is None:
+            v = _fastenv.get("MXNET_ROUTER_SLO_FLOOR")
+            slo_floor = float(v) if v else None
+        self.slo_floor = slo_floor
+        self._queue = deque()          # _Job, oldest first
+        self._next_rid = 0
+        # (replica_idx, replica_rid) -> (router_rid, _Job)
+        self._live = {}
+        self.shed_rids = []
+
+    @classmethod
+    def build(cls, params, cfg, n_replicas=2, shed_queue=None,
+              slo_floor=None, **batcher_kw):
+        """Construct n named replicas (r0..rN-1) over shared params and
+        front them — the one-liner the bench and smoke use."""
+        reps = [ContinuousBatcher(params, cfg, name="r%d" % i,
+                                  **batcher_kw)
+                for i in range(n_replicas)]
+        return cls(reps, shed_queue=shed_queue, slo_floor=slo_floor)
+
+    # ---- queueing ----
+
+    @property
+    def alive_count(self):
+        return sum(self._alive)
+
+    @property
+    def active_count(self):
+        """Live requests across the fleet (admitted, not finished)."""
+        return len(self._live)
+
+    def submit(self, prompt, n_new, seed=0, stop_token=None):
+        """Enqueue one request; returns its router-level rid. Admission
+        happens at the next step(), on whichever replica the routing
+        policy picks."""
+        rid = self._next_rid
+        self._next_rid += 1
+        enq = time.perf_counter_ns() if _obs.enabled() else None
+        self._queue.append(_Job(rid, prompt, n_new, seed, stop_token,
+                                enq))
+        return rid
+
+    # ---- routing policy ----
+
+    def _eligible(self):
+        """Replicas that may take NEW admissions this round: alive,
+        lane+block capacity, and (when slo_floor is set) rolling SLO
+        attainment at or above the floor — best headroom first."""
+        scored = []
+        for i, r in enumerate(self.replicas):
+            if not self._alive[i] or not r.has_capacity:
+                continue
+            snap = r.health_snapshot()
+            att = snap.get("serving.slo_attainment")
+            if self.slo_floor is not None and att is not None \
+                    and att < self.slo_floor:
+                continue
+            headroom = snap.get("serving.kv_available_blocks")
+            if headroom is None:
+                headroom = r.max_batch - snap["serving.lane_occupancy"]
+            scored.append((-headroom,
+                           snap["serving.lane_utilization"], i))
+        return [i for _, _, i in sorted(scored)]
+
+    def _admit_queued(self, finished):
+        while self._queue:
+            order = self._eligible()
+            if not order:
+                break
+            job = self._queue[0]
+            admitted = False
+            for i in order:
+                rep_rid = self.replicas[i].admit(
+                    job.prompt, job.n_new, seed=job.seed,
+                    stop_token=job.stop_token, enqueued_ns=job.enq_ns)
+                if rep_rid is not None:
+                    self._queue.popleft()
+                    self._live[(i, rep_rid)] = (job.rid, job)
+                    if _obs.enabled():
+                        _obs.counter("router.routed").add(1)
+                    admitted = True
+                    break
+            if not admitted:
+                break
+        # shed the backlog the fleet cannot absorb (newest first —
+        # the oldest waiters keep their place)
+        if self.shed_queue is not None:
+            while len(self._queue) > self.shed_queue:
+                job = self._queue.pop()
+                self.shed_rids.append(job.rid)
+                finished[job.rid] = None
+                _obs.counter("serving.slo_violation.shed").add(1)
+                if _obs.enabled():
+                    _obs.counter("router.shed").add(1)
+                    _obs.record_instant(
+                        "router.shed", cat="serving",
+                        args={"rid": job.rid,
+                              "queued": len(self._queue)})
+
+    def _drain_replica(self, i, exc):
+        """Replica i's dispatch died for good: mark it dead and put its
+        live requests back at the FRONT of the queue as continuations
+        from their synced token prefix — the same resume identity as
+        the in-replica requeue (cache is a pure function of the
+        prefix), so greedy streams stay bit-exact on whichever replica
+        picks them up. Sampled continuations are deterministically
+        reseeded (seed folded with the emission count)."""
+        self._alive[i] = False
+        rep = self.replicas[i]
+        drained = []
+        for (ri, rep_rid), (rid, job) in sorted(self._live.items()):
+            if ri != i:
+                continue
+            req = next((r for r in rep._slots
+                        if r is not None and r.rid == rep_rid), None)
+            del self._live[(ri, rep_rid)]
+            if req is None:
+                continue
+            cont = _Job(rid, req.tokens,
+                        req.n_new - req.emitted,
+                        (job.seed * 1000003 + req.emitted) & 0x7fffffff,
+                        req.stop_token, job.enq_ns)
+            drained.append(cont)
+        for cont in reversed(drained):
+            self._queue.appendleft(cont)
+        if _obs.enabled():
+            _obs.counter("router.replica_failures").add(1)
+            _obs.counter("router.drained_requests").add(len(drained))
+            _obs.record_instant(
+                "router.replica_failed", cat="serving",
+                args={"replica": rep.name, "drained": len(drained),
+                      "error": "%s: %s" % (type(exc).__name__, exc)})
+
+    # ---- scheduling ----
+
+    def step(self):
+        """One fleet scheduling round: admit what the policy allows,
+        shed what it must, step every live replica (draining any that
+        die), and return ``{router_rid: tokens}`` for requests that
+        finished — ``None`` for shed ones. Raises the last replica
+        failure when NO replica survives (the fleet cannot make
+        progress; callers own the restart policy above that)."""
+        finished = {}
+        self._admit_queued(finished)
+        last_exc = None
+        for i, rep in enumerate(self.replicas):
+            if not self._alive[i]:
+                continue
+            try:
+                done = rep.step()
+            except Exception as exc:   # noqa: BLE001 — drain-or-raise
+                last_exc = exc
+                self._drain_replica(i, exc)
+                continue
+            for rep_rid, toks in done.items():
+                key = (i, rep_rid)
+                if key in self._live:
+                    rid, _ = self._live.pop(key)
+                    finished[rid] = toks
+        if not any(self._alive):
+            raise last_exc if last_exc is not None else RuntimeError(
+                "no live replicas")
+        if _obs.enabled():
+            _obs.gauge("router.queue_depth").set(len(self._queue))
+            _obs.gauge("router.replicas_alive").set(self.alive_count)
+        return finished
+
+    def run(self, requests):
+        """Serve ``(prompt, n_new[, seed[, stop_token]])`` jobs through
+        the fleet. Returns ({rid: tokens-or-None-if-shed}, submission
+        order) — same contract as ContinuousBatcher.run() plus the
+        shed marker."""
+        order = [self.submit(*job) for job in requests]
+        results = {}
+        while self._queue or self._live:
+            results.update(self.step())
+        return results, order

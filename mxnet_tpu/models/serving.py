@@ -34,6 +34,20 @@ Design notes (all static-shape, XLA-friendly):
   by dispatch-time lane identity, which is what keeps every stream
   bit-identical to the synchronous pool and to solo generate().
 
+* PAGED KV cache (paged=True / MXNET_KV_PAGED): the per-lane dense
+  [max_len] cache rows become one per-layer block pool + per-lane int32
+  block tables (tf.init_paged_cache / tf.decode_step_paged — reads are
+  a fused gather into the same dense contraction, so streams stay
+  bit-exact). Admission accounts in BLOCKS against a refcounting
+  free-list allocator: capacity = pool blocks, not lanes x max_len,
+  blocks allocate lazily as positions advance (against an
+  admission-time reservation) and free on finish/evict, and
+  cache_prefix becomes refcounted block SHARING (full prefix blocks
+  stored once, copy-on-extend for partial tails, freed at refcount
+  zero). Composes with int8-KV (quantized pool + per-block scales),
+  GQA, chunking, pipelining (the carry holds pool + tables), and the
+  dispatch-failure requeue path.
+
 Greedy decoding (the serving default); sampling per-row is a
 straightforward extension (thread a per-slot PRNG key through step()).
 Weight-only int8 trees (quantize_weights_int8) pass through unchanged.
@@ -48,10 +62,13 @@ import jax
 import jax.numpy as jnp
 
 from . import transformer as tf
+from .. import _fastenv
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import http as _obs_http
 from ..observability import slo as _slo
+
+DEFAULT_KV_BLOCK_SIZE = 16
 
 
 def _bucket(n, lo=8):
@@ -212,6 +229,223 @@ def _jitted_slot_write(cfg):
         donate_argnums=tf._serving_donate(0)))
 
 
+# ---- paged-cache compiled programs -------------------------------------
+# Ragged decode through the per-layer block pool + per-lane block tables
+# (tf.decode_step_paged): same scheduling shapes as the dense programs
+# with the cache argument split into (pool, tables). The pool is donated
+# like the dense cache; tables are donated only by the pipelined chunk
+# (which carries them device-resident) — the sync programs read them.
+
+def _jitted_ragged_step_paged(cfg, greedy, temperature, top_k, top_p):
+    def build(fz):
+        def step(params, pool, tables, tok, pos, keys):
+            logits, pool = tf.decode_step_paged(params, pool, tables,
+                                                tok, pos, fz)
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, keys, pool
+            split = jax.vmap(jax.random.split)(keys)
+            keys, subs = split[:, 0], split[:, 1]
+            nxt = jax.vmap(
+                lambda l, k: tf._sample_logits(
+                    l[None], k, temperature, top_k, top_p)[0]
+            )(logits, subs)
+            return nxt, keys, pool
+        return jax.jit(step, donate_argnums=tf._serving_donate(1))
+    return tf._serving_jit(
+        ("decode_ragged_paged", greedy, float(temperature), top_k,
+         top_p), cfg, build)
+
+
+def _jitted_ragged_chunk_paged(cfg, greedy, temperature, top_k, top_p,
+                               k):
+    def build(fz):
+        def chunk(params, pool, tables, tok, pos, keys):
+            def body(carry, _):
+                pool, tok, pos, keys = carry
+                logits, pool = tf.decode_step_paged(
+                    params, pool, tables, tok, pos, fz)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda l, kk: tf._sample_logits(
+                            l[None], kk, temperature, top_k, top_p)[0]
+                    )(logits, subs)
+                return (pool, nxt, pos + 1, keys), nxt
+            (pool, _, _, keys), toks = jax.lax.scan(
+                body, (pool, tok, pos, keys), None, length=k)
+            return toks, keys, pool            # toks [k, B]
+        return jax.jit(chunk, donate_argnums=tf._serving_donate(1))
+    return tf._serving_jit(
+        ("decode_ragged_chunk_paged", greedy, float(temperature),
+         top_k, top_p, k), cfg, build)
+
+
+def _jitted_pipeline_chunk_paged(cfg, greedy, temperature, top_k,
+                                 top_p, k):
+    """Paged twin of _jitted_pipeline_chunk: the rolling carry is
+    (pool, tables, tok, pos, keys), all device-resident and donated —
+    tables pass through unchanged (allocation patches apply between
+    dispatches, host-side)."""
+    def build(fz):
+        def chunk(params, pool, tables, tok, pos, keys):
+            def body(carry, _):
+                pool, tok, pos, keys = carry
+                logits, pool = tf.decode_step_paged(
+                    params, pool, tables, tok, pos, fz)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda l, kk: tf._sample_logits(
+                            l[None], kk, temperature, top_k, top_p)[0]
+                    )(logits, subs)
+                return (pool, nxt, pos + 1, keys), nxt
+            (pool, tok, pos, keys), toks = jax.lax.scan(
+                body, (pool, tok, pos, keys), None, length=k)
+            return toks, pool, tables, tok, pos, keys
+        return jax.jit(chunk,
+                       donate_argnums=tf._serving_donate(1, 2, 3, 4, 5))
+    return tf._serving_jit(
+        ("decode_pipeline_paged", greedy, float(temperature), top_k,
+         top_p, k), cfg, build)
+
+
+def _jitted_block_write(cfg, n):
+    """Scatter `n` consecutive blocks of a [1, max_len] row cache
+    (positions [start, start + n*bs)) into pool blocks `ids` — the
+    paged admission's slot-write: only the NON-SHARED tail of a prompt
+    is ever written, whole blocks at a time (so a freed-and-reallocated
+    block is completely overwritten, no tail-clear needed)."""
+    def build(fz):
+        def wr(pool, row, ids, start):
+            def leaf(pleaf, rleaf):
+                bs = pleaf.shape[1]
+                sl = jax.lax.dynamic_slice_in_dim(
+                    rleaf.astype(pleaf.dtype), start, n * bs, axis=1)
+                return pleaf.at[ids].set(
+                    sl.reshape((n, bs) + pleaf.shape[2:]))
+            return [{name: leaf(pl[name], rl[name]) for name in pl}
+                    for pl, rl in zip(pool, row)]
+        return jax.jit(wr, donate_argnums=tf._serving_donate(0))
+    return tf._serving_jit(("paged_block_write", n), cfg, build)
+
+
+def _jitted_gather_row(cfg, nb):
+    """Gather `nb` pool blocks into a fresh [1, max_len] row cache
+    (zero beyond nb*bs) — the admission-side prefix materialization:
+    the suffix prefill attends over the shared prefix through this
+    row, while the shared blocks themselves stay untouched in the
+    pool."""
+    def build(fz):
+        def ga(pool, ids):
+            def leaf(pleaf):
+                bs = pleaf.shape[1]
+                got = jnp.take(pleaf, ids, axis=0)
+                got = got.reshape((1, nb * bs) + pleaf.shape[2:])
+                full = jnp.zeros((1, fz.max_len) + pleaf.shape[2:],
+                                 pleaf.dtype)
+                return full.at[:, : nb * bs].set(got)
+            return [{name: leaf(pl[name]) for name in pl}
+                    for pl in pool]
+        return jax.jit(ga)
+    return tf._serving_jit(("paged_gather_row", nb), cfg, build)
+
+
+def _jitted_table_row(cfg):
+    """Replace lane i's whole block-table row (admission / park)."""
+    return tf._serving_jit("paged_table_row", cfg, lambda fz: jax.jit(
+        lambda tb, i, row: tb.at[i].set(row),
+        donate_argnums=tf._serving_donate(0)))
+
+
+def _jitted_table_entry(cfg):
+    """Point one table entry at a freshly allocated block (the lazy
+    per-dispatch extension)."""
+    return tf._serving_jit("paged_table_entry", cfg, lambda fz: jax.jit(
+        lambda tb, i, j, bid: tb.at[i, j].set(bid),
+        donate_argnums=tf._serving_donate(0)))
+
+
+class BlockAllocator(object):
+    """Free-list allocator with per-block refcounts over the paged KV
+    pool. Block 0 is the reserved null block (unallocated table entries
+    point at it) and is never handed out. A block mapped into several
+    tables (shared prefix) carries one reference per mapping — prefix
+    cache entry included — and returns to the free list only at
+    refcount zero, so evicting one sharer can never free a block a
+    live lane still reads.
+
+    ``reserved`` tracks the worst-case FUTURE block demand of admitted
+    requests: admission reserves its whole lifetime up front (that is
+    the block-accounted capacity check), the lazy per-dispatch
+    allocation converts reservation into real blocks as positions
+    advance, and ``available`` (free minus reserved) is what admission
+    and the router may still promise. A live request can therefore
+    never stall on an empty free list."""
+
+    __slots__ = ("num_blocks", "ref", "reserved", "_free")
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is null)")
+        self.num_blocks = int(num_blocks)
+        # pop() hands out low ids first
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self.ref = np.zeros((self.num_blocks,), np.int32)
+        self.reserved = 0
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def available(self):
+        return len(self._free) - self.reserved
+
+    def alloc(self, n):
+        """n fresh blocks at refcount 1 (raises when the free list is
+        short — callers gate on available/reserved, so this firing
+        means an accounting bug, not load)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                "paged KV free list exhausted (%d requested, %d free) "
+                "— admission accounting should have prevented this"
+                % (n, len(self._free)))
+        ids = [self._free.pop() for _ in range(n)]
+        for b in ids:
+            self.ref[b] = 1
+        return ids
+
+    def share(self, ids):
+        """One more reference on each block (a new table mapping)."""
+        for b in ids:
+            if self.ref[b] < 1:
+                raise RuntimeError("sharing unallocated block %d" % b)
+            self.ref[b] += 1
+
+    def release(self, ids):
+        """Drop one reference per block; a block frees at zero."""
+        for b in ids:
+            self.ref[b] -= 1
+            if self.ref[b] < 0:
+                raise RuntimeError("double free of block %d" % b)
+            if self.ref[b] == 0:
+                self._free.append(b)
+
+    def reserve(self, n):
+        self.reserved += int(n)
+
+    def unreserve(self, n):
+        self.reserved -= int(n)
+        assert self.reserved >= 0, "reservation accounting underflow"
+
+
 class Request(object):
     __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token",
                  "seed", "t_enq_ns", "t_admit_ns", "t_first_ns",
@@ -280,11 +514,35 @@ class ContinuousBatcher(object):
     previous occupant, whose emissions are discarded by request
     identity at sync). Token streams are bit-identical to
     pipeline_depth=1 and to solo generate() (tested). depth=1 is the
-    synchronous batcher, unchanged."""
+    synchronous batcher, unchanged.
+
+    `paged=True` (default: MXNET_KV_PAGED) virtualizes the cache into
+    fixed-size blocks (`block_size`, default MXNET_KV_BLOCK_SIZE=16):
+    one per-layer pool of `num_blocks` blocks replaces the per-lane
+    dense rows, each lane maps positions through an int32 block table,
+    and capacity decouples from max_len — admission accounts in BLOCKS
+    (the request's prompt + n_new worst-case demand must fit the free
+    list) instead of assuming every lane owns a [max_len] row, so a
+    pool sized for B dense lanes admits far more mixed-length
+    requests. Blocks allocate lazily as positions advance (against an
+    admission-time reservation, so a live lane never stalls) and free
+    on finish/evict. `cache_prefix` becomes REFCOUNTED BLOCK SHARING:
+    an admitted prompt starting with a cached prefix maps the prefix's
+    full blocks into its table (stored once, copy-on-extend for the
+    partial tail), and a shared block frees only at refcount zero.
+    Streams stay bit-exact vs solo generate() — the gathered view
+    feeds the identical attention contraction — and int8-KV, GQA,
+    chunking, pipelining, and dispatch-failure requeue all compose.
+
+    `name` labels this replica's chaos site (serving.dispatch.<name>)
+    so fleet tests can kill one replica of a router pool
+    deterministically."""
 
     def __init__(self, params, cfg, max_batch=8, greedy=None,
                  temperature=1.0, top_k=None, top_p=None,
-                 chunk_size=1, prefix_cache_slots=4, pipeline_depth=1):
+                 chunk_size=1, prefix_cache_slots=4, pipeline_depth=1,
+                 paged=None, block_size=None, num_blocks=None,
+                 name=None):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
@@ -307,8 +565,45 @@ class ContinuousBatcher(object):
         self.greedy = greedy
         self.chunk_size = int(chunk_size)
         self.pipeline_depth = int(pipeline_depth)
+        self.name = name
+        self._chaos_site = ("serving.dispatch" if name is None
+                            else "serving.dispatch.%s" % name)
         self._controls = (self.greedy, float(temperature), top_k, top_p)
-        self._cache = tf.init_cache(cfg, self.max_batch)
+        if paged is None:
+            paged = (_fastenv.get("MXNET_KV_PAGED") or "") \
+                not in ("", "0", "false", "False")
+        self.paged = bool(paged)
+        if self.paged:
+            if block_size is None:
+                block_size = int(_fastenv.get("MXNET_KV_BLOCK_SIZE",
+                                              DEFAULT_KV_BLOCK_SIZE))
+            self.block_size = int(block_size)
+            if self.block_size < 1 \
+                    or cfg.max_len % self.block_size:
+                raise ValueError(
+                    "block_size %d must divide max_len %d (set "
+                    "MXNET_KV_BLOCK_SIZE accordingly)"
+                    % (self.block_size, cfg.max_len))
+            self._nb = cfg.max_len // self.block_size   # table width
+            if num_blocks is None:
+                # dense-equivalent HBM budget by default: every lane
+                # could still hold a full-context row (+ the null block)
+                num_blocks = self.max_batch * self._nb + 1
+            self.num_blocks = int(num_blocks)
+            self._alloc = BlockAllocator(self.num_blocks)
+            self._pool = tf.init_paged_cache(cfg, self.num_blocks,
+                                             self.block_size)
+            self._tables = jnp.zeros((self.max_batch, self._nb),
+                                     jnp.int32)
+            self._lane_blocks = [[] for _ in range(self.max_batch)]
+            self._lane_need = [0] * self.max_batch
+            # scheduled position per lane = device pos after every
+            # dispatched chunk (the pipelined carry never syncs it);
+            # drives the lazy pre-dispatch block allocation
+            self._sched_pos = np.zeros((self.max_batch,), np.int64)
+            self._cache = None
+        else:
+            self._cache = tf.init_cache(cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._tok = np.zeros((self.max_batch,), np.int32)
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
@@ -326,8 +621,12 @@ class ContinuousBatcher(object):
             self._inflight = deque()
             # resolved once — a pipelined dispatch must not pay the
             # _serving_jit registry lookup per chunk
-            self._pipe_fn = _jitted_pipeline_chunk(
-                cfg, *self._controls, self.chunk_size)
+            self._pipe_fn = (
+                _jitted_pipeline_chunk_paged(cfg, *self._controls,
+                                             self.chunk_size)
+                if self.paged else
+                _jitted_pipeline_chunk(cfg, *self._controls,
+                                       self.chunk_size))
             self._patch_fn = _jitted_lane_patch(cfg)
         # dispatch-failure recovery: a failed decode dispatch frees the
         # lanes and requeues the live requests (greedy streams resume
@@ -343,9 +642,12 @@ class ContinuousBatcher(object):
         self._t_serve_start_ns = None
         if _obs.enabled():
             _obs_http.maybe_start()    # MXNET_OBS_HTTP live scrape
-        # prefix cache: tuple(tokens) -> (row_cache, last_row_logits),
-        # LRU-bounded. Each entry holds one [1, max_len] row cache on
-        # device — prefix_cache_slots bounds that memory
+        # prefix cache, LRU-bounded (prefix_cache_slots). Dense mode:
+        # tuple(tokens) -> (row_cache, last_row_logits) — one [1,
+        # max_len] row cache on device per entry. Paged mode:
+        # tuple(tokens) -> (block_ids, last_row_logits) — the prefix
+        # lives IN the pool, refcounted, and admissions map its full
+        # blocks instead of copying them
         self._prefix_cache = {}
         self._prefix_slots = int(prefix_cache_slots)
 
@@ -357,7 +659,97 @@ class ContinuousBatcher(object):
 
     @property
     def has_capacity(self):
-        return self.active_count < self.max_batch
+        """A free lane — and, under paging, at least one block of
+        unpromised capacity (free minus reservations, counting
+        evictable prefix entries): admission accounts in BLOCKS, so a
+        pool can be full long before its lanes are (and vice versa).
+        The per-request check is admit() itself — a specific prompt's
+        worst-case demand can still exceed one free block."""
+        if self.active_count >= self.max_batch:
+            return False
+        if self.paged:
+            return self._alloc.available >= 1 \
+                or bool(self._prefix_cache)
+        return True
+
+    @property
+    def free_blocks(self):
+        """Unallocated pool blocks (None when not paged) — the router's
+        primary load signal."""
+        return self._alloc.free_blocks if self.paged else None
+
+    def health_snapshot(self):
+        """The per-replica routing signals, /healthz-shaped (same names
+        a scraper reads off MXNET_OBS_HTTP's /healthz `counters`):
+        lane occupancy, paged-pool headroom, rolling SLO attainment.
+        models/router.py polls this for in-process replicas; a
+        multi-process fleet scrapes the HTTP endpoint instead."""
+        active = self.active_count
+        snap = {
+            "serving.lane_occupancy": active,
+            "serving.lane_utilization": active / float(self.max_batch),
+            "serving.slo_attainment": _slo.attainment(),
+        }
+        if self.paged:
+            usable = self.num_blocks - 1
+            snap["serving.kv_free_blocks"] = self._alloc.free_blocks
+            snap["serving.kv_available_blocks"] = self._alloc.available
+            snap["serving.kv_block_utilization"] = \
+                (usable - self._alloc.free_blocks) / float(usable)
+        return snap
+
+    # ---- paged block accounting ----
+
+    def _block_math(self, t_p, total_len):
+        """(lifetime_blocks, init_blocks) for a request whose final
+        stream is `total_len` tokens from a `t_p`-token prompt: the
+        deepest cache write of its life is position total_len - 2 (the
+        final emitted token is never written), and admission must also
+        cover position t_p — the first decode write target."""
+        last_pos = max(t_p, total_len - 2)
+        return (last_pos // self.block_size + 1,
+                t_p // self.block_size + 1)
+
+    def _evict_prefixes(self, demand, keep=None):
+        """LRU-evict cached prefixes until `demand` blocks are
+        available (or nothing evictable remains). Released blocks hit
+        the free list only at refcount zero, so an entry shared with
+        live lanes yields nothing until they finish — which is exactly
+        the safety the refcount exists for. `keep` shields the entry
+        the in-progress admission is about to share."""
+        while self._alloc.available < demand:
+            victim = next(
+                (k for k in self._prefix_cache if k != keep
+                 and any(self._alloc.ref[b] == 1
+                         for b in self._prefix_cache[k][0])),
+                None)                  # oldest evictable first (LRU);
+            if victim is None:         # an entry pinned by live lanes
+                return False           # would free nothing — skip it
+            blocks, _ = self._prefix_cache.pop(victim)
+            self._alloc.release(blocks)
+            if _obs.enabled():
+                _obs.record_instant(
+                    "serving.prefix_evict", cat="serving",
+                    args={"prefix_len": len(victim),
+                          "blocks": len(blocks)})
+        return True
+
+    def _lookup_prefix_blocks(self, prompt):
+        """Paged twin of _lookup_prefix: longest cached prefix ->
+        (p_len, block_ids, last_row_logits), LRU-refreshed; (0, [],
+        None) on a miss. The blocks stay refcounted by the entry —
+        admission adds its own reference per shared FULL block."""
+        best = None
+        for key in self._prefix_cache:
+            if len(key) <= len(prompt) \
+                    and tuple(prompt[:len(key)]) == key:
+                if best is None or len(key) > len(best):
+                    best = key
+        if best is None:
+            return 0, [], None
+        hit = self._prefix_cache.pop(best)
+        self._prefix_cache[best] = hit               # LRU refresh
+        return len(best), hit[0], hit[1]
 
     def cache_prefix(self, tokens):
         """Prefill `tokens` once and keep the row cache + last-row
@@ -379,6 +771,8 @@ class ContinuousBatcher(object):
             raise ValueError("prefix %d must leave room under "
                              "max_len %d" % (len(toks),
                                              self.cfg.max_len))
+        if self.paged:
+            return self._cache_prefix_paged(toks)
         key = tuple(toks)
         hit = self._prefix_cache.pop(key, None)
         if hit is None:
@@ -391,6 +785,60 @@ class ContinuousBatcher(object):
         while len(self._prefix_cache) > self._prefix_slots:
             self._prefix_cache.pop(next(iter(self._prefix_cache)))
         return len(toks)
+
+    def _cache_prefix_paged(self, toks):
+        """Paged cache_prefix: the prefix is prefilled once into POOL
+        blocks (refcount 1 held by the cache entry) and shared by
+        admissions at block granularity. A nested shorter prefix's
+        full blocks are themselves shared into the new entry — nesting
+        costs only the tail. LRU-bounded like the dense path, except
+        the bound (and block pressure from admissions) releases
+        references, not device rows."""
+        key = tuple(toks)
+        hit = self._prefix_cache.pop(key, None)
+        if hit is not None:
+            self._prefix_cache[key] = hit            # LRU refresh
+            return len(toks)
+        p = len(toks)
+        bs = self.block_size
+        # share a nested cached prefix's full blocks, if any
+        p_sub, sub_blocks, _ = self._lookup_prefix_blocks(toks)
+        s = p_sub // bs
+        nb = (p + bs - 1) // bs
+        own_n = nb - s
+        if own_n > self._alloc.available \
+                and not self._evict_prefixes(
+                    own_n, keep=tuple(toks[:p_sub]) if p_sub else None):
+            raise RuntimeError(
+                "no free KV blocks for a %d-token prefix (%d needed, "
+                "%d available)" % (p, own_n, self._alloc.available))
+        if p_sub:
+            nb_sub = (p_sub + bs - 1) // bs
+            row = _jitted_gather_row(self.cfg, nb_sub)(
+                self._pool, jnp.asarray(sub_blocks[:nb_sub], jnp.int32))
+        else:
+            row = tf.init_cache(self.cfg, 1)
+        # exact-length suffix prefill (no bucket pad): the cached
+        # blocks hold zeros beyond the prefix, so nothing stale is
+        # ever attendable through a sharer's table
+        logits, row = tf._jitted_prefill_chunk_row(self.cfg)(
+            self.params, row,
+            jnp.asarray([toks[p_sub:]], jnp.int32),
+            jnp.int32(p_sub), jnp.int32(p - p_sub - 1))
+        own = self._alloc.alloc(own_n)
+        if s:
+            self._alloc.share(sub_blocks[:s])
+        self._pool = _jitted_block_write(self.cfg, own_n)(
+            self._pool, row, jnp.asarray(own, jnp.int32),
+            jnp.int32(s * bs))
+        self._prefix_cache[key] = (sub_blocks[:s] + own, logits)
+        while len(self._prefix_cache) > self._prefix_slots:
+            old = next(iter(self._prefix_cache))
+            blocks, _ = self._prefix_cache.pop(old)
+            self._alloc.release(blocks)
+        if _obs.enabled():
+            self._publish_occupancy()
+        return p
 
     def _lookup_prefix(self, prompt):
         """Longest cached prefix of `prompt` -> (p_len, row_cache,
@@ -408,6 +856,84 @@ class ContinuousBatcher(object):
         hit = self._prefix_cache.pop(best)
         self._prefix_cache[best] = hit               # LRU refresh
         return len(best), hit[0], hit[1]
+
+    def _paged_prefill(self, prompt, t_p, p_len, pfx_blocks,
+                       pfx_logits):
+        """Build the admission row cache through the pool: gather the
+        cached prefix's blocks into a [1, max_len] row (zero-padded),
+        prefill the suffix at bucket width (exactly the dense path's
+        compile-once-per-bucket rule), and return (last_logits,
+        row_cache). The shared blocks themselves are untouched — the
+        row exists so the suffix's attention can read the prefix."""
+        bs = self.block_size
+        if p_len:
+            nb_pfx = (p_len + bs - 1) // bs
+            row_cache = _jitted_gather_row(self.cfg, nb_pfx)(
+                self._pool,
+                jnp.asarray(pfx_blocks[:nb_pfx], jnp.int32))
+        else:
+            row_cache = tf.init_cache(self.cfg, 1)
+        if p_len == t_p:
+            return pfx_logits[0], row_cache
+        width = min(_bucket(t_p - p_len), self.cfg.max_len - p_len)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, : t_p - p_len] = prompt[p_len:]
+        logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
+            self.params, row_cache, jnp.asarray(padded),
+            jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
+        return logits[0], row_cache
+
+    def _paged_map_lane(self, slot, t_p, row_cache, p_len, pfx_blocks,
+                        lifetime, init_n):
+        """Map a lane's block table for a fresh admission: the cached
+        prefix's FULL blocks are shared in place (refcount++), the
+        remainder through position t_p is freshly allocated and
+        written whole-block from the row cache (copy-on-extend: a
+        partial prefix tail is copied, never written shared), the rest
+        of the lifetime is reserved for the lazy per-dispatch
+        extension, and unneeded entries stay on the null block."""
+        bs = self.block_size
+        shared = p_len // bs
+        own_n = init_n - shared        # >= 1: covers the first write
+        own = self._alloc.alloc(own_n)
+        if shared:
+            self._alloc.share(pfx_blocks[:shared])
+        self._alloc.reserve(lifetime - init_n)
+        self._pool = _jitted_block_write(self.cfg, own_n)(
+            self._pool, row_cache, jnp.asarray(own, jnp.int32),
+            jnp.int32(shared * bs))
+        lane = list(pfx_blocks[:shared]) + own
+        trow = np.zeros((self._nb,), np.int32)
+        trow[: len(lane)] = lane
+        self._tables = _jitted_table_row(self.cfg)(
+            self._tables, jnp.int32(slot), jnp.asarray(trow))
+        self._lane_blocks[slot] = lane
+        self._lane_need[slot] = lifetime
+        self._sched_pos[slot] = t_p
+
+    def _ensure_coverage(self, k):
+        """Allocate (lazily) the blocks the next k decode positions of
+        every live lane will write, drawn from the reservation admit()
+        made — the free list cannot run dry here, by accounting.
+        Entries past a lane's lifetime need stay null: a request that
+        finishes mid-chunk coasts its remaining writes into the
+        garbage sink."""
+        bs = self.block_size
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            pos = int(self._sched_pos[i] if self.pipeline_depth > 1
+                      else self._pos[i])
+            end = min((pos + k - 1) // bs, self._lane_need[i] - 1,
+                      self._nb - 1)
+            while len(self._lane_blocks[i]) <= end:
+                bid = self._alloc.alloc(1)[0]
+                self._alloc.unreserve(1)
+                j = len(self._lane_blocks[i])
+                self._lane_blocks[i].append(bid)
+                self._tables = _jitted_table_entry(self.cfg)(
+                    self._tables, jnp.int32(i), jnp.int32(j),
+                    jnp.int32(bid))
 
     def admit(self, prompt, n_new, seed=0, stop_token=None,
               enqueued_ns=None):
@@ -438,31 +964,59 @@ class ContinuousBatcher(object):
                     None)
         if slot is None:
             return None
+        if self.paged:
+            # block-accounted admission: the prompt + n_new worst-case
+            # demand (minus the cached prefix's shareable full blocks)
+            # must fit the unpromised free list — LRU prefix eviction
+            # may make room, a live lane's blocks never move
+            p_len, pfx_blocks, pfx_logits = \
+                self._lookup_prefix_blocks(prompt)
+            shared = p_len // self.block_size
+            lifetime, init_n = self._block_math(t_p, t_p + n_new)
+            demand = lifetime - shared
+            if demand > self.num_blocks - 1:
+                raise ValueError(
+                    "request needs %d KV blocks but the pool has only "
+                    "%d usable (num_blocks=%d incl. the null block)"
+                    % (demand, self.num_blocks - 1, self.num_blocks))
+            if demand > self._alloc.available and not \
+                    self._evict_prefixes(
+                        demand,
+                        keep=tuple(prompt[:p_len]) if p_len else None):
+                return None
         rid = self._next_rid
         pre_span = _obs.span("serving.prefill", cat="serving", rid=rid,
                              lane=slot, prompt_tokens=t_p).start()
-        # longest cached prefix (0 + a fresh row cache when none):
-        # only the suffix prefills
-        p_len, row_cache, pfx_logits = self._lookup_prefix(prompt)
-        if p_len == t_p:
-            last = pfx_logits[0]       # whole prompt is the prefix
+        if self.paged:
+            last, row_cache = self._paged_prefill(
+                prompt, t_p, p_len, pfx_blocks, pfx_logits)
+            self._paged_map_lane(slot, t_p, row_cache, p_len,
+                                 pfx_blocks, lifetime, init_n)
         else:
-            # clamp: the bucket can pass max_len (e.g. max_len=96,
-            # suffix 70 -> bucket 128) and the cache axis is max_len
-            # wide; width >= suffix always holds since t_p + n_new <=
-            # max_len
-            width = min(_bucket(t_p - p_len),
-                        self.cfg.max_len - p_len)
-            padded = np.zeros((1, width), np.int32)
-            padded[0, : t_p - p_len] = prompt[p_len:]
-            # one compiled prefill per bucket width (prefill_chunk
-            # already specializes per chunk shape); fills positions
-            # [p_len, p_len+width) — rows beyond t_p are pad garbage
-            # that decode overwrites before attention can reach them
-            logits, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
-                self.params, row_cache, jnp.asarray(padded),
-                jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
-            last = logits[0]
+            # longest cached prefix (0 + a fresh row cache when none):
+            # only the suffix prefills
+            p_len, row_cache, pfx_logits = self._lookup_prefix(prompt)
+            if p_len == t_p:
+                last = pfx_logits[0]   # whole prompt is the prefix
+            else:
+                # clamp: the bucket can pass max_len (e.g. max_len=96,
+                # suffix 70 -> bucket 128) and the cache axis is
+                # max_len wide; width >= suffix always holds since
+                # t_p + n_new <= max_len
+                width = min(_bucket(t_p - p_len),
+                            self.cfg.max_len - p_len)
+                padded = np.zeros((1, width), np.int32)
+                padded[0, : t_p - p_len] = prompt[p_len:]
+                # one compiled prefill per bucket width (prefill_chunk
+                # already specializes per chunk shape); fills positions
+                # [p_len, p_len+width) — rows beyond t_p are pad
+                # garbage that decode overwrites before attention can
+                # reach them
+                logits, row_cache = \
+                    tf._jitted_prefill_chunk_row(self.cfg)(
+                        self.params, row_cache, jnp.asarray(padded),
+                        jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
+                last = logits[0]
         if self.pipeline_depth > 1:
             # prefill-into-lane, all device-side: pick the first token
             # on device (generate()'s exact chain), patch the row
@@ -476,8 +1030,9 @@ class ContinuousBatcher(object):
                 self.cfg, *self._controls)(last, jnp.int32(seed))
             with _obs.span("serving.patch", cat="serving", kind="admit",
                            lane=slot):
-                self._cache = _jitted_slot_write(self.cfg)(
-                    self._cache, row_cache, jnp.int32(slot))
+                if not self.paged:   # paged: blocks already scattered
+                    self._cache = _jitted_slot_write(self.cfg)(
+                        self._cache, row_cache, jnp.int32(slot))
                 self._dev_tok, self._dev_pos, self._dev_keys = \
                     self._patch_fn(self._dev_tok, self._dev_pos,
                                    self._dev_keys, jnp.int32(slot),
@@ -497,8 +1052,9 @@ class ContinuousBatcher(object):
                                               temperature, top_k,
                                               top_p)[0])
                 self._keys[slot] = np.asarray(key, np.uint32)
-            self._cache = _jitted_slot_write(self.cfg)(
-                self._cache, row_cache, jnp.int32(slot))
+            if not self.paged:         # paged: blocks already scattered
+                self._cache = _jitted_slot_write(self.cfg)(
+                    self._cache, row_cache, jnp.int32(slot))
             self._pos[slot] = t_p      # next decode writes position t_p
             self._tok[slot] = first
         pre_span.stop()
@@ -543,29 +1099,39 @@ class ContinuousBatcher(object):
             return finished
         k = self.chunk_size
         try:
+            if self.paged:
+                self._ensure_coverage(k)
             # the synchronous dispatch blocks through the host fetch,
             # so one span covers dispatch + sync
             with _obs.span("serving.dispatch", cat="serving",
                            mode="sync", chunk=k,
                            lanes=self.active_count):
                 if _chaos.enabled():
-                    _chaos.fire("serving.dispatch", mode="sync")
+                    _chaos.fire(self._chaos_site, mode="sync")
+                args = (self.params,)
+                if self.paged:
+                    args += (self._pool, self._tables)
+                else:
+                    args += (self._cache,)
+                args += (jnp.asarray(self._tok),
+                         jnp.asarray(self._pos),
+                         jnp.asarray(self._keys))
                 if k == 1:
-                    nxt, keys, self._cache = _jitted_ragged_step(
-                        self.cfg, *self._controls)(
-                        self.params, self._cache,
-                        jnp.asarray(self._tok),
-                        jnp.asarray(self._pos),
-                        jnp.asarray(self._keys))
+                    fn = (_jitted_ragged_step_paged if self.paged
+                          else _jitted_ragged_step)(
+                        self.cfg, *self._controls)
+                    nxt, keys, state = fn(*args)
                     toks = np.asarray(nxt).astype(np.int32)[None]
                 else:
-                    toks, keys, self._cache = _jitted_ragged_chunk(
-                        self.cfg, *self._controls, k)(
-                        self.params, self._cache,
-                        jnp.asarray(self._tok),
-                        jnp.asarray(self._pos),
-                        jnp.asarray(self._keys))
+                    fn = (_jitted_ragged_chunk_paged if self.paged
+                          else _jitted_ragged_chunk)(
+                        self.cfg, *self._controls, k)
+                    toks, keys, state = fn(*args)
                     toks = np.asarray(toks).astype(np.int32)   # [k, B]
+                if self.paged:
+                    self._pool = state
+                else:
+                    self._cache = state
         except Exception as exc:     # noqa: BLE001 — requeue-or-raise
             self._recover_dispatch_failure(exc)
             return finished
@@ -644,16 +1210,28 @@ class ContinuousBatcher(object):
         identity that decides, at sync, whose stream each lane's
         emissions belong to (a lane re-admitted mid-flight discards
         the old occupant's in-flight tokens by rid mismatch)."""
+        if self.paged:
+            self._ensure_coverage(self.chunk_size)
         with _obs.span("serving.dispatch", cat="serving",
                        depth=len(self._inflight) + 1):
             if _chaos.enabled():
-                _chaos.fire("serving.dispatch", mode="pipelined",
+                _chaos.fire(self._chaos_site, mode="pipelined",
                             depth=len(self._inflight) + 1)
-            toks, cache, tok, pos, keys = self._pipe_fn(
-                self.params, self._cache, self._dev_tok,
-                self._dev_pos, self._dev_keys)
+            if self.paged:
+                toks, pool, tables, tok, pos, keys = self._pipe_fn(
+                    self.params, self._pool, self._tables,
+                    self._dev_tok, self._dev_pos, self._dev_keys)
+                self._pool, self._tables = pool, tables
+            else:
+                toks, cache, tok, pos, keys = self._pipe_fn(
+                    self.params, self._cache, self._dev_tok,
+                    self._dev_pos, self._dev_keys)
+                self._cache = cache
         self._dispatch_failures = 0
-        self._cache = cache
+        if self.paged:
+            # every lane's device position advances k per chunk —
+            # mirror it so the NEXT dispatch's coverage is exact
+            self._sched_pos += self.chunk_size
         self._dev_tok, self._dev_pos, self._dev_keys = tok, pos, keys
         self._inflight.append(
             (toks, [r.rid if r is not None else None
@@ -724,7 +1302,21 @@ class ContinuousBatcher(object):
             raise exc
         pending = [r for r in self._slots if r is not None]
         self._slots = [None] * self.max_batch
-        self._cache = tf.init_cache(self.cfg, self.max_batch)
+        if self.paged:
+            # the donated pool died with the dispatch — and the prefix
+            # cache's blocks lived in it, so those entries die too
+            # (re-cache_prefix() after recovery to restore sharing)
+            self._pool = tf.init_paged_cache(self.cfg, self.num_blocks,
+                                             self.block_size)
+            self._tables = jnp.zeros((self.max_batch, self._nb),
+                                     jnp.int32)
+            self._alloc = BlockAllocator(self.num_blocks)
+            self._lane_blocks = [[] for _ in range(self.max_batch)]
+            self._lane_need = [0] * self.max_batch
+            self._sched_pos = np.zeros((self.max_batch,), np.int64)
+            self._prefix_cache.clear()
+        else:
+            self._cache = tf.init_cache(self.cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._tok = np.zeros((self.max_batch,), np.int32)
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
@@ -759,8 +1351,19 @@ class ContinuousBatcher(object):
         else:
             key_np = np.asarray(jax.random.fold_in(
                 jax.random.PRNGKey(req.seed), req.emitted), np.uint32)
-        self._cache = _jitted_slot_write(self.cfg)(
-            self._cache, row_cache, jnp.int32(slot))
+        if self.paged:
+            # remaining lifetime from the resume point (the fresh
+            # allocator always fits what the old pool held — prefix
+            # sharing died with it, but each request's own demand was
+            # admission-checked without assuming sharing survives a
+            # pool rebuild)
+            total = len(req.tokens) + (req.n_new - req.emitted)
+            lifetime, init_n = self._block_math(m, total)
+            self._paged_map_lane(slot, m, row_cache, 0, [], lifetime,
+                                 init_n)
+        else:
+            self._cache = _jitted_slot_write(self.cfg)(
+                self._cache, row_cache, jnp.int32(slot))
         if self.pipeline_depth > 1:
             self._dev_tok, self._dev_pos, self._dev_keys = \
                 self._patch_fn(self._dev_tok, self._dev_pos,
@@ -810,6 +1413,22 @@ class ContinuousBatcher(object):
         after the in-flight chunks (whose writes to this lane are the
         already-harmless idle-lane garbage)."""
         self._slots[i] = None
+        if self.paged:
+            # return the lane's references (a shared prefix block
+            # frees only when its LAST sharer lets go) and the unused
+            # tail of its reservation, then park the table on the
+            # null block — in-flight chunks still write through their
+            # dispatch-time tables, whole-block overwrites on
+            # reallocation make that harmless
+            blocks = self._lane_blocks[i]
+            self._alloc.release(blocks)
+            self._alloc.unreserve(self._lane_need[i] - len(blocks))
+            self._lane_blocks[i] = []
+            self._lane_need[i] = 0
+            self._sched_pos[i] = 0
+            self._tables = _jitted_table_row(self.cfg)(
+                self._tables, jnp.int32(i),
+                jnp.zeros((self._nb,), jnp.int32))
         if self.pipeline_depth > 1:
             with _obs.span("serving.patch", cat="serving", kind="park",
                            lane=i):
@@ -919,6 +1538,12 @@ class ContinuousBatcher(object):
         ctx = sum(len(r.tokens) for r in self._slots if r is not None)
         _obs.gauge("serving.kv_utilization").set(
             ctx / float(self.max_batch * self.cfg.max_len))
+        if self.paged:
+            usable = self.num_blocks - 1
+            free = self._alloc.free_blocks
+            _obs.gauge("serving.kv_free_blocks").set(free)
+            _obs.gauge("serving.kv_block_utilization").set(
+                (usable - free) / float(usable))
 
     def _admit_job(self, job, enqueued_ns=None):
         """(prompt, n_new[, seed[, stop_token]]) -> rid or None."""

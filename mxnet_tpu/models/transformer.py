@@ -33,7 +33,7 @@ __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_decode_step", "generate", "shard_cache", "prefill",
            "quantize_weights_int8", "beam_search", "prefill_chunk",
            "speculative_generate", "save_checkpoint", "load_checkpoint",
-           "restore_train_state"]
+           "restore_train_state", "init_paged_cache", "decode_step_paged"]
 
 
 @dataclass
@@ -968,6 +968,123 @@ def decode_step(params, cache, tokens, pos, cfg):
         x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
     x = _rms_norm(x, params["ln_f"])
     return jnp.einsum("bd,vd->bv", x, params["embed"]), new_cache
+
+
+# ------------------------------------------------------- paged decode ---
+# The KV cache virtualized into fixed-size BLOCKS: one per-layer pool
+# `[num_blocks, block_size, KVH, Dh]` shared by every lane, plus per-lane
+# int32 block TABLES `[B, max_len // block_size]` mapping position range
+# [j*bs, (j+1)*bs) to a pool block. Capacity decouples from max_len — a
+# lane holds exactly the blocks its context needs, and a block mapped
+# into two tables (shared prefix) is stored once. Block 0 is the
+# reserved NULL block: unallocated table entries point at it, so decode
+# writes past a lane's allocation land in a shared garbage sink (never
+# attendable for a live request — attention masks to <= pos, and the
+# allocator covers every live position with a real block) instead of
+# corrupting a neighbour. Reads gather the pool through the table into
+# the dense [B, T] layout and reuse the SAME attention contractions as
+# the dense cache (_decode_attention and its int8/GQA/flash variants):
+# the gathered view carries bit-identical values at every unmasked
+# position, which is what keeps paged == dense == solo generate()
+# bit-exact rather than approximately equal. Allocation policy (free
+# list, refcounts, copy-on-extend sharing) lives in models/serving.py —
+# this layer is purely the compiled read/write geometry.
+
+def init_paged_cache(cfg, num_blocks, block_size):
+    """Zeroed per-layer block pools. Layout matches init_cache with the
+    position axis split into [num_blocks, block_size]; under
+    kv_cache_int8 the per-(position, head) fp32 scale planes split the
+    same way ([num_blocks, block_size, KVH]), so a block carries its
+    own scales and int8-KV composes per block."""
+    if num_blocks < 2:
+        raise ValueError("need >= 2 blocks (block 0 is the null block)")
+    hd = cfg.d_model // cfg.n_heads
+    shape = (num_blocks, block_size, _kvh(cfg), hd)
+    if cfg.kv_cache_int8:
+        sshape = shape[:3]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros(sshape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "vs": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _paged_gather(layer_pool, tables):
+    """Gather one layer's pool through the block tables into the dense
+    [B, NB*bs, ...] cache layout — ONE fused XLA gather feeding the
+    same attention contraction as the dense path (no Pallas). Table
+    entry j covers positions [j*bs, (j+1)*bs), so the flattened axis is
+    in position order and the `<= pos` mask applies unchanged."""
+    b, nb = tables.shape
+    flat = tables.reshape(-1)
+
+    def g(leaf):
+        got = jnp.take(leaf, flat, axis=0)        # [B*NB, bs, ...]
+        return got.reshape((b, nb * leaf.shape[1]) + leaf.shape[2:])
+
+    return {name: g(leaf) for name, leaf in layer_pool.items()}
+
+
+def _paged_write_ragged(layer_pool, k_new, v_new, tables, pos, cfg):
+    """Per-row scatter through the table: row i writes its k/v
+    [B, KVH, D] into block tables[i, pos[i]//bs] at offset pos[i]%bs —
+    quantizing on the way in under kv_cache_int8, like the dense
+    ragged write. A position past the table (a retired lane coasting
+    to its chunk boundary) clamps to the last entry, which the
+    allocator guarantees is never a shared block; an unallocated entry
+    is the null block. Either way the garbage is unreadable."""
+    bs = layer_pool["k"].shape[1]
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+
+    def st(name, arr):
+        return layer_pool[name].at[blk, off].set(
+            arr.astype(layer_pool[name].dtype))
+
+    if cfg.kv_cache_int8:
+        kq, ks = _kv_quant(k_new)
+        vq, vs = _kv_quant(v_new)
+        return {"k": st("k", kq), "ks": st("ks", ks),
+                "v": st("v", vq), "vs": st("vs", vs)}
+    return {"k": st("k", k_new), "v": st("v", v_new)}
+
+
+def decode_step_paged(params, pool, tables, tokens, pos, cfg):
+    """One ragged autoregressive step through the block tables.
+
+    tokens [B] int32, pos [B] int32 (always ragged — this is the
+    continuous-batching entry point), tables [B, max_len//bs] int32.
+    Returns (logits [B, vocab], updated pool); the tables themselves
+    are read-only here — allocation is the host scheduler's job.
+    Everything the dense step supports composes: RoPE (keys cached
+    rotated), GQA (the gathered view keeps KVH heads; the grouped
+    contraction reads each once per group), int8-KV (codes + per-block
+    scales gathered together, the one shared _int8_cache_attention
+    does the rest), quantized weight trees."""
+    params = _maybe_dequantize(params)
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + jnp.take(params["pos"], pos, axis=0)
+    new_pool = []
+    for p, layer_pool in zip(params["layers"], pool):
+        h = _rms_norm(x, p["ln1"])
+        q = jnp.einsum("bd,dhk->bhk", h, p["wq"])
+        k_new = jnp.einsum("bd,dhk->bhk", h, p["wk"])
+        v_new = jnp.einsum("bd,dhk->bhk", h, p["wv"])
+        if cfg.rope:
+            q = _rope(q, pos, cfg.rope_base)
+            k_new = _rope(k_new, pos, cfg.rope_base)
+        nlayer = _paged_write_ragged(layer_pool, k_new, v_new, tables,
+                                     pos, cfg)
+        new_pool.append(nlayer)
+        o = _decode_attention(q, _paged_gather(nlayer, tables), pos, cfg)
+        x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bd,vd->bv", x, params["embed"]), new_pool
 
 
 def make_decode_step(cfg):

@@ -25,12 +25,14 @@ validates that the merged chrome trace carries BOTH rank lanes:
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --nproc 2
 
-``--serving`` runs the serving half (ISSUEs 5 + 7): a pipelined
-ContinuousBatcher serves a few requests while a live HTTP endpoint is
-scraped mid-run, and the emitted trace must carry the full request
-lifecycle — dispatch/sync/patch/prefill/queue-wait spans, per-request
-flow chains, the TTFT/ITL/e2e/queue histograms (bucket states included)
-and the occupancy/goodput gauges:
+``--serving`` runs the serving half (ISSUEs 5 + 7 + 8): a pipelined
+PAGED ContinuousBatcher serves a few requests while a live HTTP
+endpoint is scraped mid-run, and the emitted trace must carry the full
+request lifecycle — dispatch/sync/patch/prefill/queue-wait spans,
+per-request flow chains, the TTFT/ITL/e2e/queue histograms (bucket
+states included), the occupancy/goodput gauges AND the paged-pool
+block gauges (kv_free_blocks / kv_block_utilization, which must also
+appear in the mid-run /healthz snapshot — the router's load signal):
 
     MXNET_OBS=1 JAX_PLATFORMS=cpu python tools/obs_smoke.py --serving
 """
@@ -166,7 +168,8 @@ def serving_smoke():
     params = tf.init_params(cfg, seed=0)
     rng = np.random.RandomState(0)
     jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(4)]
-    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2,
+                            paged=True, block_size=8)
 
     port = obs_http.start(0)       # ephemeral port; env-free smoke
     scraped = {"metrics": None, "healthz": None}
@@ -194,10 +197,17 @@ def serving_smoke():
               "histograms")
         return 1
     hz = scraped["healthz"]
+    needed_hz = ("serving.lane_occupancy", "serving.kv_free_blocks",
+                 "serving.kv_block_utilization")
     if not hz or hz.get("status") != "ok" \
-            or "serving.lane_occupancy" not in hz.get("counters", {}):
-        print("[obs_smoke] FAIL: /healthz snapshot incomplete: %s"
-              % (sorted((hz or {}).get("counters", {})),))
+            or any(k not in hz.get("counters", {}) for k in needed_hz):
+        print("[obs_smoke] FAIL: /healthz snapshot incomplete (need "
+              "%s): %s" % (list(needed_hz),
+                           sorted((hz or {}).get("counters", {}))))
+        return 1
+    if not 0.0 < hz["counters"]["serving.kv_block_utilization"] <= 1.0:
+        print("[obs_smoke] FAIL: mid-run block utilization %s not in "
+              "(0, 1]" % hz["counters"]["serving.kv_block_utilization"])
         return 1
 
     fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_srv_"),
@@ -212,6 +222,8 @@ def serving_smoke():
                 "serving.finish", "serving.request",
                 "serving.inflight_depth", "serving.lane_occupancy",
                 "serving.kv_utilization", "serving.goodput_tok_s",
+                "serving.kv_free_blocks",
+                "serving.kv_block_utilization",
                 "serving.admit_to_first_token_ms", "serving.ttft_ms",
                 "serving.itl_ms", "serving.e2e_ms"}
     missing = required - names
