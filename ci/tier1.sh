@@ -111,6 +111,20 @@ if [ "${TIER1_CHAOS:-0}" = "1" ]; then
         echo "[tier1] FAIL: chaos smoke"
         exit 1
     fi
+
+    echo "==== [tier1] elastic smoke (rank kill -> shrink -> bit-exact resume -> regrow) ===="
+    # docs/ROBUSTNESS.md "Elastic recovery", end to end on the CPU
+    # mesh: one injected rank kill in a 2-process gloo job; the
+    # supervisor (tools/elastic_launch.py) must shrink to world 1,
+    # the survivor's post-shrink loss trajectory must be BIT-exact vs
+    # a clean world-1 run resumed from the same shard set with zero
+    # skipped/replayed samples, the world must regrow to 2, and the
+    # merged trace must carry elastic.time_to_recovery_ms. Serial like
+    # everything else on the 1-core host.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py --elastic; then
+        echo "[tier1] FAIL: elastic smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -27,6 +27,7 @@ from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
 from ..observability import recompile as _obs_recompile
+from ..parallel import elastic as _elastic
 from ..parallel import fusion
 from .parameter import Parameter
 
@@ -183,6 +184,12 @@ class Trainer(object):
             # the cross-rank straggler exchange
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)
+        if _elastic.enabled():
+            # elastic membership: heartbeat + dead-peer check at the
+            # step boundary (the fast path — a peer detected here
+            # shrinks BEFORE the next collective can wedge this rank)
+            self._elastic_steps = getattr(self, "_elastic_steps", 0) + 1
+            _elastic.step_boundary(self._elastic_steps)
 
     def allreduce_grads(self):
         self._ready()

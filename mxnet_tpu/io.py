@@ -193,6 +193,27 @@ class DataIter(object):
     def getpad(self):
         pass
 
+    # ------------------------------------------------------- cursors --
+    # Exact-resume contract (docs/ROBUSTNESS.md "Elastic recovery"): a
+    # restored iterator must yield the SAME remaining batch sequence
+    # the saved one would have — zero skipped, zero replayed samples.
+    # state_dict() is a cheap JSON-able position (cursor + epoch order),
+    # never buffered data; elastic shard manifests persist it.
+
+    def state_dict(self):
+        """Resumable cursor for this iterator. Subclasses that own a
+        position implement it; the base class refuses loudly so a
+        checkpoint can never silently record a non-resumable source."""
+        raise NotImplementedError(
+            "%s does not support state_dict()/load_state_dict() — "
+            "elastic/exact resume needs a cursor-capable iterator"
+            % type(self).__name__)
+
+    def load_state_dict(self, state):
+        raise NotImplementedError(
+            "%s does not support state_dict()/load_state_dict()"
+            % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """io.py:493 — normalize to list of (name, numpy) pairs."""
@@ -333,6 +354,35 @@ class NDArrayIter(DataIter):
     def _shuffle_data(self):
         np.random.shuffle(self.idx)
 
+    def state_dict(self):
+        """Exact mid-epoch position: the cursor plus this epoch's
+        shuffle order (idx IS the epoch's sample permutation, so the
+        restore replays neither the shuffle nor any sample), plus the
+        roll_over tail cache when one is held. Arrays stay numpy —
+        persistence layers JSON-ify at write time (elastic
+        ``jsonable_cursor``)."""
+        state = {"cursor": int(self.cursor), "idx": self.idx.copy()}
+        if self._cache_data is not None:
+            state["cache_data"] = [np.asarray(c)
+                                   for c in self._cache_data]
+            state["cache_label"] = [np.asarray(c)
+                                    for c in self._cache_label]
+        return state
+
+    def load_state_dict(self, state):
+        self.cursor = int(state["cursor"])
+        self.idx = np.asarray(state["idx"], dtype=self.idx.dtype)
+        if "cache_data" in state:
+            self._cache_data = [
+                np.asarray(c, dtype=v.dtype)
+                for c, (_, v) in zip(state["cache_data"], self.data)]
+            self._cache_label = [
+                np.asarray(c, dtype=v.dtype)
+                for c, (_, v) in zip(state["cache_label"], self.label)]
+        else:
+            self._cache_data = None
+            self._cache_label = None
+
 
 class ResizeIter(DataIter):
     """Resize epoch length of an inner iterator (io.py:351)."""
@@ -377,6 +427,15 @@ class ResizeIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def state_dict(self):
+        return {"cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def load_state_dict(self, state):
+        self.cur = int(state["cur"])
+        self.data_iter.load_state_dict(state["inner"])
+        self.current_batch = None
 
 
 class PrefetchingIter(DataIter):
@@ -438,6 +497,11 @@ class PrefetchingIter(DataIter):
         self.current_batch = None
         self._drained = False
         self._fetchers = [self._Fetcher(it) for it in self.iters]
+        # quiescent-point cursor: captured whenever every fetcher is
+        # idle (inner iterators advanced exactly as far as the caller
+        # consumed), i.e. BEFORE each prefetch order goes out — the
+        # position an exact resume must restart from
+        self._inner_cursor = self._snapshot_inner()
         for f in self._fetchers:
             f.request()
 
@@ -478,6 +542,7 @@ class PrefetchingIter(DataIter):
         for it in self.iters:
             it.reset()
         self._drained = False
+        self._inner_cursor = self._snapshot_inner()
         for f in self._fetchers:
             f.request()
 
@@ -509,6 +574,9 @@ class PrefetchingIter(DataIter):
             batches[0].pad, batches[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
+        # fetchers are idle here: the inner cursors are exactly one
+        # consumed-batch past the previous snapshot
+        self._inner_cursor = self._snapshot_inner()
         for f in self._fetchers:
             f.request()
         return True
@@ -529,6 +597,49 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def _snapshot_inner(self):
+        """Inner cursors at a quiescent point (no fetch in flight).
+        Inners without cursor support snapshot as None — state_dict
+        names them if a resume is ever requested, instead of failing
+        every ordinary run up front."""
+        out = []
+        for it in self.iters:
+            try:
+                out.append(it.state_dict())
+            except NotImplementedError:
+                out.append(None)
+        return out
+
+    def state_dict(self):
+        """Resume position = the last consumed batch. The in-flight
+        prefetch does NOT advance it: snapshots are taken only while
+        the fetchers are idle, so the saved cursor never skips the
+        batch currently being prefetched."""
+        missing = [type(it).__name__
+                   for it, st in zip(self.iters, self._inner_cursor)
+                   if st is None]
+        if missing:
+            raise NotImplementedError(
+                "PrefetchingIter: inner iterator(s) %s do not support "
+                "state_dict() — exact resume is impossible through "
+                "them" % missing)
+        return {"inner": list(self._inner_cursor)}
+
+    def load_state_dict(self, state):
+        # drain any in-flight fetch, rewind the inners, refill
+        for f in self._fetchers:
+            if f.pending:
+                try:
+                    f.take()
+                except Exception:        # noqa: BLE001 — stale epoch
+                    pass
+        for it, st in zip(self.iters, state["inner"]):
+            it.load_state_dict(st)
+        self._drained = False
+        self._inner_cursor = self._snapshot_inner()
+        for f in self._fetchers:
+            f.request()
 
 
 class CSVIter(NDArrayIter):
@@ -701,6 +812,16 @@ class ImageRecordIter(DataIter):
         if self.shuffle:
             np.random.shuffle(self._order)
         self.cursor = 0
+
+    def state_dict(self):
+        """Mid-epoch record position: cursor + the epoch's (possibly
+        shuffled) record order."""
+        return {"cursor": int(self.cursor), "order": self._order.copy()}
+
+    def load_state_dict(self, state):
+        self.cursor = int(state["cursor"])
+        self._order = np.asarray(state["order"],
+                                 dtype=self._order.dtype)
 
     def _decode_one(self, header, payload):
         img = recordio._imdecode(payload)

@@ -60,6 +60,7 @@ list indices are numeric path components, so the tree rebuilds from the
 keys alone with no pickled structure.
 """
 
+import atexit
 import json
 import os
 import signal
@@ -73,8 +74,11 @@ import numpy as np
 from ..observability import chaos as _chaos
 
 __all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state",
-           "CheckpointCorrupt", "wait_for_pending_save",
-           "list_checkpoints", "resume_from_latest",
+           "CheckpointCorrupt", "CheckpointIncompatible",
+           "wait_for_pending_save",
+           "list_checkpoints", "resume_from_latest", "resume_elastic",
+           "save_shard_checkpoint", "load_shard_checkpoint",
+           "list_shard_generations", "shard_layout",
            "install_emergency_checkpoint",
            "uninstall_emergency_checkpoint",
            "save_emergency_checkpoint"]
@@ -89,6 +93,13 @@ class CheckpointCorrupt(RuntimeError):
     """A checkpoint that must not be trusted: torn/truncated/missing
     data file or a per-array digest mismatch. The message names the
     file and, for digest failures, expected vs actual."""
+
+
+class CheckpointIncompatible(CheckpointCorrupt):
+    """A checkpoint (or shard set) that cannot serve THIS resume: a
+    world-size / shard-layout / generation / config mismatch, or an
+    incomplete shard set. The message names the mismatching field and
+    both values — the alternative is a shape error deep inside jit."""
 
 
 def _is_q8(leaf):
@@ -202,6 +213,7 @@ def _crc(arr):
 
 _pending_lock = threading.Lock()
 _pending = [None]                    # the one in-flight saver thread
+_last_committed_step = [None]        # newest step this process committed
 
 
 class _Saver(threading.Thread):
@@ -349,6 +361,7 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata,
         with open(tmp, "w") as f:
             f.write(manifest_text)
         os.replace(tmp, os.path.join(path, name))   # last one = commit
+    _last_committed_step[0] = int(step)
     _sweep(path, keep, stamp)
 
 
@@ -582,31 +595,27 @@ def restore_train_state(path, mesh):
     momentum resumes with a zero momentum tree (fresh-optimizer
     semantics, matching the reference's `Module.fit(begin_epoch=N)`
     restart-from-checkpoint contract)."""
-    import jax
-    from .transformer import init_momentum
     cfg, params, momentum, step, _ = load_checkpoint(path, mesh=mesh)
-    if any(_is_q8(l) for l in jax.tree.leaves(params, is_leaf=_is_q8)):
-        raise ValueError(
-            "checkpoint holds int8-quantized weights — a serving "
-            "artifact, not a resumable training state; quantization "
-            "discards the fp weights SGD needs. Load it with "
-            "load_checkpoint() and serve it.")
-    if momentum is None:
-        # fresh-optimizer semantics (the reference's
-        # Module.fit(begin_epoch=N) restart contract); zeros_like on
-        # the already-sharded params inherits their layout
-        momentum = init_momentum(params)
-    return cfg, params, momentum, step
+    return _finish_train_state(cfg, params, momentum, step)
 
 
-def resume_from_latest(path, mesh=None, init=None):
+def resume_from_latest(path, mesh=None, init=None, expect_world=None,
+                       expect_generation=None, expect_cfg=None):
     """The supervisor-restart entry point: resume training from the
     newest loadable checkpoint under ``path`` (corrupt newer ones fall
     back per `load_checkpoint`). Returns ``(cfg, params, momentum,
     step)``. With no checkpoint present, calls ``init()`` (which must
     return that same tuple, conventionally with step 0) — so a worker
     that always starts with ``resume_from_latest(dir, mesh,
-    init=fresh)`` is restartable by construction."""
+    init=fresh)`` is restartable by construction.
+
+    The ``expect_*`` arguments validate manifest compatibility BEFORE
+    any state reaches jit: ``expect_cfg`` field-compares the saved
+    TransformerConfig against the one this run was built with;
+    ``expect_world`` / ``expect_generation`` check the elastic
+    metadata a sharded-elastic save records (``metadata["elastic"]``).
+    A mismatch raises :class:`CheckpointIncompatible` naming the field
+    and both values — instead of a shape error deep in jit."""
     wait_for_pending_save()
     has_any = os.path.isdir(path) and (
         os.path.exists(os.path.join(path, "manifest.json"))
@@ -616,14 +625,506 @@ def resume_from_latest(path, mesh=None, init=None):
             raise FileNotFoundError(
                 "no checkpoint under %s and no init() provided" % path)
         return init()
-    return restore_train_state(path, mesh)
+    cfg, params, momentum, step, meta = load_checkpoint(path, mesh=mesh)
+    _validate_manifest_compat(path, cfg, meta, expect_world,
+                              expect_generation, expect_cfg)
+    return _finish_train_state(cfg, params, momentum, step)
+
+
+def _validate_manifest_compat(path, cfg, meta, expect_world,
+                              expect_generation, expect_cfg):
+    """The named-mismatch gate for resume: config field diffs and the
+    elastic world/generation metadata, each raising
+    CheckpointIncompatible with both values spelled out."""
+    if expect_cfg is not None:
+        from dataclasses import asdict
+        saved, want = asdict(cfg), asdict(expect_cfg)
+        for field in sorted(saved):
+            if saved[field] != want.get(field):
+                raise CheckpointIncompatible(
+                    "checkpoint %s: config.%s is %r but this run was "
+                    "built with %r — refusing to resume a different "
+                    "model" % (path, field, saved[field],
+                               want.get(field)))
+    elastic = (meta or {}).get("elastic") or {}
+    if expect_world is not None and "world" in elastic \
+            and int(elastic["world"]) != int(expect_world):
+        raise CheckpointIncompatible(
+            "checkpoint %s: saved by a world of %s but resuming at "
+            "world %s — merge the elastic shard set (resume_elastic) "
+            "or restart the matching world"
+            % (path, elastic["world"], expect_world))
+    if expect_generation is not None and "generation" in elastic \
+            and int(elastic["generation"]) > int(expect_generation):
+        raise CheckpointIncompatible(
+            "checkpoint %s: saved at elastic generation %s, newer than "
+            "the launching generation %s — stale rendezvous record"
+            % (path, elastic["generation"], expect_generation))
+
+
+def _finish_train_state(cfg, params, momentum, step):
+    """Shared tail of the resume paths: reject serving-only quantized
+    trees, zero-init momentum when none was saved."""
+    import jax
+    from .transformer import init_momentum
+    if any(_is_q8(l) for l in jax.tree.leaves(params, is_leaf=_is_q8)):
+        raise ValueError(
+            "checkpoint holds int8-quantized weights — a serving "
+            "artifact, not a resumable training state; quantization "
+            "discards the fp weights SGD needs. Load it with "
+            "load_checkpoint() and serve it.")
+    if momentum is None:
+        momentum = init_momentum(params)
+    return cfg, params, momentum, step
+
+
+# ------------------------------------------- elastic shard checkpoints --
+#
+# A *shard set* is one per-rank checkpoint per survivor of an elastic
+# generation: replicated weights (every rank carries them — any one
+# readable copy restores), this rank's contiguous slice of each flat
+# optimizer lane, the data cursor, and the RNG snapshot. The lane
+# layout is the deterministic `fusion.plan_buckets` plan over the
+# momentum tree (same planner, same order, same env knobs as the PR 1
+# sharded weight update), padded to the world size exactly like
+# `ShardSlot` (`l_pad = ceil(size/world) * world`), so any two ranks
+# compute identical layouts from identical state. Merge-on-load
+# reassembles the full lanes from the recorded layout — NOT from a
+# replan, so a relaunch under different bucket knobs still loads — and
+# re-partitioning for a different world size is just the next save's
+# replan over the merged state.
+
+_SHARD_FORMAT = "mxnet_tpu.transformer.shard/1"
+
+
+def _local_value(key, x):
+    """Host copy of a leaf WITHOUT collectives. Elastic capture runs on
+    a survivor whose peers are dead: a `process_allgather` would hang
+    in the very rendezvous the shrink is escaping. Fully-addressable
+    leaves copy directly; a cross-process leaf restores from any local
+    shard that covers the full array (replicated layouts — the flagship
+    param/momentum case). A leaf that is genuinely partitioned across
+    processes is unrecoverable survivor-side and raises, naming it (the
+    documented degradation mode: fall back to the last full
+    checkpoint)."""
+    if isinstance(x, np.ndarray):
+        return x
+    if getattr(x, "is_fully_addressable", True):
+        import jax
+        return np.asarray(jax.device_get(x))
+    for s in x.addressable_shards:
+        if tuple(s.data.shape) == tuple(x.shape):
+            return np.asarray(s.data)
+    raise CheckpointIncompatible(
+        "shard capture: leaf %r is partitioned across processes (no "
+        "local replica covers its full value) — survivors cannot "
+        "reconstruct it; recover from the last full checkpoint instead"
+        % key)
+
+
+def shard_layout(momentum, world):
+    """Deterministic lane layout for sharding a momentum tree over
+    ``world`` ranks: ``fusion.plan_buckets`` over the flattened leaves
+    in sorted-key order, each lane padded so world divides it. Returns
+    ``{"signature", "world", "lanes": [{bucket, lane, dtype, size,
+    l_pad, segments}]}`` — segments as [key, shape, size, offset]."""
+    from ..parallel import fusion
+    flat = {}
+    _flatten(momentum, _MOMENTUM, flat)
+    entries = [(k, tuple(np.shape(flat[k])),
+                str(np.dtype(getattr(flat[k], "dtype", np.float32))))
+               for k in sorted(flat)]
+    plan = fusion.plan_buckets(entries)
+    sig = "%08x" % (zlib.crc32(
+        repr(fusion.plan_signature(entries)).encode()) & 0xFFFFFFFF)
+    world = int(world)
+    lanes = []
+    for bucket in plan:
+        for li, lane in enumerate(bucket.lanes):
+            l_pad = -(-lane.size // world) * world
+            lanes.append({
+                "bucket": bucket.index, "lane": li,
+                "dtype": str(lane.dtype), "size": lane.size,
+                "l_pad": l_pad,
+                "segments": [[s.key, list(s.shape), s.size, s.offset]
+                             for s in lane.segments]})
+    return {"signature": sig, "world": world, "lanes": lanes}
+
+
+def _lane_key(lane):
+    return "ms.%d.%d" % (lane["bucket"], lane["lane"])
+
+
+def _pack_lane_host(lane, flat):
+    """Host-side pack: the lane's segments raveled back to back, zero
+    padded to l_pad (the numpy twin of fusion.pack_lane)."""
+    dt = np.dtype(lane["dtype"])
+    out = np.zeros(lane["l_pad"], dt)
+    for key, _shape, size, offset in lane["segments"]:
+        out[offset:offset + size] = np.ravel(
+            np.asarray(flat[key])).astype(dt, copy=False)
+    return out
+
+
+def _shard_manifest_name(generation, rank, world):
+    return "shard-manifest-g%d-r%dof%d.json" % (generation, rank, world)
+
+
+def save_shard_checkpoint(path, cfg, params, momentum=None, step=0,
+                          rank=0, world=1, generation=0, cursor=None,
+                          rng=None, base_world=None, metadata=None,
+                          keep_generations=None):
+    """One survivor's shard of an elastic generation's state.
+
+    Writes ``shard-arrays-g<g>-r<r>of<w>-<stamp>.npz`` + its manifest:
+    replicated params in full, momentum as THIS rank's slice of every
+    flat lane (``shard_layout(momentum, world)``), the iterator
+    ``cursor`` (a ``state_dict()`` JSON), the ``rng`` snapshot, and the
+    layout itself so merge-on-load never needs to replan. Collective-
+    free by construction (see ``_local_value``) — callable from a
+    monitor thread while the main thread is wedged. Keeps the newest
+    ``keep_generations`` complete shard generations (default: the
+    ``MXNET_ELASTIC_KEEP_GENERATIONS`` knob, 2)."""
+    if keep_generations is None:
+        from .. import _fastenv
+        try:
+            keep_generations = int(_fastenv.get(
+                "MXNET_ELASTIC_KEEP_GENERATIONS", 2))
+        except (TypeError, ValueError):
+            keep_generations = 2
+    os.makedirs(path, exist_ok=True)
+    rank, world = int(rank), int(world)
+    if not 0 <= rank < world:
+        raise ValueError("shard rank %d outside world %d" % (rank, world))
+    flat_p = {}
+    _flatten(params, _PARAMS, flat_p)
+    host = {k: _local_value(k, v) for k, v in flat_p.items()}
+    layout = None
+    if momentum is not None:
+        layout = shard_layout(momentum, world)
+        flat_m = {}
+        _flatten(momentum, _MOMENTUM, flat_m)
+        host_m = {k: _local_value(k, v) for k, v in flat_m.items()}
+        for lane in layout["lanes"]:
+            packed = _pack_lane_host(lane, host_m)
+            n = lane["l_pad"] // world
+            host[_lane_key(lane)] = packed[rank * n:(rank + 1) * n]
+    stamp = "%d-%s" % (int(step), os.urandom(4).hex())
+    arrays_file = "shard-arrays-g%d-r%dof%d-%s.npz" % (generation, rank,
+                                                       world, stamp)
+    manifest = {
+        "format": _SHARD_FORMAT,
+        "config": _cfg_to_json(cfg),
+        "generation": int(generation), "world": world, "rank": rank,
+        "base_world": int(world if base_world is None else base_world),
+        "step": int(step),
+        "has_momentum": momentum is not None,
+        "layout": layout,
+        "arrays_file": arrays_file,
+        "dtypes": {k: np.dtype(v.dtype).name for k, v in host.items()},
+        "arrays": sorted(host),
+        "checksums": {k: _crc(v) for k, v in host.items()},
+        "cursor": cursor, "rng": rng,
+        "metadata": metadata or {},
+    }
+    manifest_text = json.dumps(manifest, indent=1, sort_keys=True)
+    tmp = os.path.join(path, "." + arrays_file + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **host)
+    os.replace(tmp, os.path.join(path, arrays_file))
+    _chaos.fire("checkpoint.write", path=path, step=int(step),
+                shard=rank)
+    name = _shard_manifest_name(generation, rank, world)
+    tmp = os.path.join(path, "." + name + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(manifest_text)
+    os.replace(tmp, os.path.join(path, name))     # the commit point
+    _last_committed_step[0] = int(step)
+    _sweep_shards(path, keep_generations)
+    return path
+
+
+def _shard_manifests(path):
+    """[(generation, rank, world, manifest dict, name)] for every
+    readable shard manifest under ``path``."""
+    out = []
+    if not os.path.isdir(path):
+        return out
+    for name in os.listdir(path):
+        if not (name.startswith("shard-manifest-")
+                and name.endswith(".json")):
+            continue
+        m = None
+        try:
+            with open(os.path.join(path, name)) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if m.get("format") != _SHARD_FORMAT:
+            continue
+        out.append((int(m.get("generation", -1)),
+                    int(m.get("rank", -1)),
+                    int(m.get("world", 0)), m, name))
+    out.sort(key=lambda e: (e[0], e[1]))
+    return out
+
+
+def list_shard_generations(path):
+    """Complete shard generations under ``path``, oldest first:
+    [(generation, step, world)] where every rank 0..world-1 committed a
+    manifest and all agree on the step."""
+    by_gen = {}
+    for gen, rank, world, m, _name in _shard_manifests(path):
+        by_gen.setdefault(gen, []).append((rank, world, m))
+    out = []
+    for gen in sorted(by_gen):
+        entries = by_gen[gen]
+        worlds = {w for _r, w, _m in entries}
+        steps = {int(m.get("step", -1)) for _r, _w, m in entries}
+        ranks = {r for r, _w, _m in entries}
+        if len(worlds) == 1 and len(steps) == 1 \
+                and ranks == set(range(next(iter(worlds)))):
+            out.append((gen, next(iter(steps)), next(iter(worlds))))
+    return out
+
+
+def _sweep_shards(path, keep_generations):
+    """Retention GC for shard sets: keep the newest ``keep_generations``
+    COMPLETE generations (and any incomplete newer one — a set being
+    written concurrently by the other survivors is not garbage), drop
+    older manifests and their data files."""
+    keep_generations = max(int(keep_generations), 1)
+    complete = [g for g, _s, _w in list_shard_generations(path)]
+    if not complete:
+        return
+    keep_from = complete[-keep_generations] \
+        if len(complete) >= keep_generations else complete[0]
+    for gen, _rank, _world, m, name in _shard_manifests(path):
+        if gen >= keep_from:
+            continue
+        for stale in (name, m.get("arrays_file")):
+            if not stale:
+                continue
+            try:
+                os.remove(os.path.join(path, stale))
+            except OSError:
+                pass
+
+
+def _check_same(field, values, path):
+    distinct = sorted(set(values), key=str)
+    if len(distinct) > 1:
+        raise CheckpointIncompatible(
+            "shard set %s: ranks disagree on %s (%s) — refusing to "
+            "merge a mixed set" % (path, field, distinct))
+    return distinct[0]
+
+
+def load_shard_checkpoint(path, mesh=None, generation=None,
+                          allow_partial=False):
+    """Merge-on-load of one shard generation.
+
+    Picks the newest COMPLETE generation (or ``generation``), verifies
+    every rank's arrays against its manifest digests, reassembles the
+    full flat optimizer lanes from the recorded layout, and rebuilds
+    ``(cfg, params, momentum, step, extras)`` where extras carries
+    ``generation`` / ``world`` / ``base_world`` / ``cursor`` / ``rng``
+    / ``metadata``. Params restore from the lowest-rank readable copy
+    (every rank carries them — redundancy IS the fallback). Mixed or
+    incomplete sets raise :class:`CheckpointIncompatible` naming the
+    mismatch; with ``allow_partial=True`` a missing rank's lane slices
+    zero-fill with a warning (fresh-optimizer semantics for the lost
+    slice) instead of failing the whole resume."""
+    sets = {}
+    for gen, rank, world, m, name in _shard_manifests(path):
+        sets.setdefault(gen, {})[rank] = (m, name)
+    if not sets:
+        raise FileNotFoundError("no shard manifests under %s" % path)
+    if generation is None:
+        complete = [g for g, _s, _w in list_shard_generations(path)]
+        generation = complete[-1] if complete else max(sets)
+    if generation not in sets:
+        raise CheckpointIncompatible(
+            "shard set %s: no manifests for generation %s (have %s)"
+            % (path, generation, sorted(sets)))
+    ranks = sets[generation]
+    world = _check_same("world size",
+                        [m.get("world") for m, _n in ranks.values()],
+                        path)
+    step = _check_same("step",
+                       [m.get("step") for m, _n in ranks.values()], path)
+    cfg_json = _check_same(
+        "config", [json.dumps(m.get("config"), sort_keys=True)
+                   for m, _n in ranks.values()], path)
+    has_momentum = any(m.get("has_momentum") for m, _n in ranks.values())
+    layouts = [m.get("layout") for m, _n in ranks.values()
+               if m.get("layout") is not None]
+    if layouts:
+        _check_same("shard layout",
+                    [l.get("signature") for l in layouts], path)
+    missing = sorted(set(range(world)) - set(ranks))
+    if missing and not allow_partial:
+        raise CheckpointIncompatible(
+            "shard set %s: generation %d is incomplete — missing "
+            "rank(s) %s of world %d (pass allow_partial=True to "
+            "zero-fill their optimizer slices)"
+            % (path, generation, missing, world))
+
+    # per-rank verified arrays (params fall back across ranks; a lane
+    # slice lost to corruption degrades like a missing rank)
+    arrays = {}
+    errors = []
+    for rank in sorted(ranks):
+        m, name = ranks[rank]
+        try:
+            arrays[rank] = _read_arrays(path, m, name)
+        except CheckpointCorrupt as e:
+            errors.append(e)
+            if not allow_partial:
+                raise
+            warnings.warn(
+                "mxnet_tpu.checkpoint: %s — zero-filling rank %d's "
+                "optimizer slices" % (e, rank),
+                RuntimeWarning, stacklevel=2)
+    if not arrays:
+        raise errors[0] if errors else CheckpointCorrupt(
+            "shard set %s: no readable rank" % path)
+    if missing:
+        warnings.warn(
+            "mxnet_tpu.checkpoint: shard generation %d missing rank(s) "
+            "%s — their optimizer slices resume as zeros"
+            % (generation, missing), RuntimeWarning, stacklevel=2)
+
+    first = min(arrays)
+    pref = _PARAMS + _SEP
+    flat_p = {k[len(pref):]: v for k, v in arrays[first].items()
+              if k.startswith(pref)}
+    momentum = None
+    if has_momentum and layouts:
+        layout = layouts[0]
+        flat_m = {}
+        for lane in layout["lanes"]:
+            key = _lane_key(lane)
+            n = lane["l_pad"] // world
+            dt = np.dtype(lane["dtype"])
+            full = np.zeros(lane["l_pad"], dt)
+            for rank in range(world):
+                got = arrays.get(rank, {}).get(key)
+                if got is None:
+                    continue
+                if got.shape != (n,):
+                    raise CheckpointIncompatible(
+                        "shard set %s: rank %d lane %s slice has shape "
+                        "%s, layout says (%d,) — layout/world mismatch"
+                        % (path, rank, key, got.shape, n))
+                full[rank * n:(rank + 1) * n] = got
+            for skey, shape, size, offset in lane["segments"]:
+                flat_m[skey[len(_MOMENTUM + _SEP):]] = \
+                    full[offset:offset + size].reshape(shape)
+        momentum = _unflatten(flat_m)
+    params = _unflatten(flat_p)
+    cfg = _cfg_from_json(json.loads(cfg_json))
+
+    import jax
+    import jax.numpy as jnp
+
+    def as_jnp(tree):
+        return jax.tree.map(
+            lambda x: x if _is_q8(x) else jnp.asarray(x), tree,
+            is_leaf=_is_q8)
+
+    if mesh is not None:
+        from .transformer import shard_params
+        params = shard_params(as_jnp(params), cfg, mesh)
+        if momentum is not None:
+            momentum = shard_params(as_jnp(momentum), cfg, mesh)
+    else:
+        params = as_jnp(params)
+        if momentum is not None:
+            momentum = as_jnp(momentum)
+    m0 = ranks[first][0]
+    extras = {"generation": int(generation), "world": int(world),
+              "base_world": int(m0.get("base_world", world)),
+              "cursor": m0.get("cursor"), "rng": m0.get("rng"),
+              "metadata": m0.get("metadata", {})}
+    return cfg, params, momentum, int(step), extras
+
+
+def resume_elastic(path, mesh=None, init=None, expect_world=None,
+                   expect_generation=None, allow_partial=False,
+                   generation=None):
+    """The elastic worker's resume entry point: newest usable state —
+    a shard set or a full checkpoint, whichever carries the LATER step
+    (ties go to the shard set: it also carries the cursor). Returns
+    ``(cfg, params, momentum, step, extras)``; ``extras`` is ``{}``
+    when resuming from a full checkpoint or ``init()``.
+
+    ``expect_world`` / ``expect_generation`` validate manifest
+    compatibility up front: a shard set recorded for a different world
+    than the merge can serve, or from a generation NEWER than the one
+    being launched (a stale supervisor reading a dead generation's
+    record), raises :class:`CheckpointIncompatible` naming the
+    mismatch instead of a shape error deep in jit. An explicit
+    ``generation`` pins the resume to that shard set (the bit-exact
+    comparison harness's entry point)."""
+    wait_for_pending_save()
+    shard_gens = list_shard_generations(path) if os.path.isdir(path) \
+        else []
+    if generation is not None:
+        shard_gens = [e for e in shard_gens if e[0] == int(generation)]
+        if not shard_gens:
+            raise CheckpointIncompatible(
+                "no complete shard set for generation %s under %s"
+                % (generation, path))
+    full = list_checkpoints(path) if generation is None else []
+    shard_step = shard_gens[-1][1] if shard_gens else None
+    full_step = full[-1][0] if full else None
+    if shard_step is not None and (full_step is None
+                                   or shard_step >= full_step):
+        gen = shard_gens[-1][0]
+        if expect_generation is not None and gen > int(expect_generation):
+            raise CheckpointIncompatible(
+                "shard set %s: newest generation %d is AHEAD of the "
+                "launching generation %d — the supervisor is reading a "
+                "stale rendezvous record" % (path, gen,
+                                             int(expect_generation)))
+        out = load_shard_checkpoint(path, mesh=mesh, generation=gen,
+                                    allow_partial=allow_partial)
+        if expect_world is not None and out[4]["world"] != int(
+                expect_world) and out[2] is None:
+            # a momentum-less set carries no reshardable lanes; params
+            # alone reshard freely, so only warn when nothing merges
+            raise CheckpointIncompatible(
+                "shard set %s: recorded world %d cannot serve world %d "
+                "(no optimizer lanes to re-partition)"
+                % (path, out[4]["world"], int(expect_world)))
+        cfg, params, momentum, step, extras = out
+        if momentum is None:
+            from .transformer import init_momentum
+            momentum = init_momentum(params)
+        return cfg, params, momentum, step, extras
+    if full:
+        cfg, params, momentum, step, meta = load_checkpoint(path,
+                                                            mesh=mesh)
+        cfg, params, momentum, step = _finish_train_state(
+            cfg, params, momentum, step)
+        extras = {k: meta[k] for k in ("cursor", "rng")
+                  if (meta or {}).get(k) is not None}
+        return cfg, params, momentum, step, extras
+    if init is None:
+        raise FileNotFoundError(
+            "no checkpoint under %s and no init() provided" % path)
+    out = init()
+    return tuple(out) + ({},) if len(out) == 4 else out
 
 
 # ------------------------------------------------- emergency checkpoint --
 
 _emergency_lock = threading.Lock()
 _emergency = {"path": None, "state": None, "keep": 2,
-              "prev_sigterm": None, "sigterm": False, "watchdog": False}
+              "prev_sigterm": None, "sigterm": False, "watchdog": False,
+              "prev_sigint": None, "sigint": False, "atexit": False,
+              "fired": False}
 
 
 def save_emergency_checkpoint(reason="emergency"):
@@ -640,6 +1141,11 @@ def save_emergency_checkpoint(reason="emergency"):
     st = state()
     meta = dict(st.get("metadata") or {})
     meta["emergency"] = str(reason)
+    # exact-resume payloads ride the metadata so even a full emergency
+    # save (no shard set) can restore the data cursor and RNG
+    for extra in ("cursor", "rng"):
+        if st.get(extra) is not None:
+            meta.setdefault(extra, st[extra])
     save_checkpoint(path, st["cfg"], st["params"],
                     momentum=st.get("momentum"),
                     step=int(st.get("step", 0)),
@@ -650,6 +1156,7 @@ def save_emergency_checkpoint(reason="emergency"):
 def _sigterm_handler(signum, frame):
     with _emergency_lock:
         prev = _emergency["prev_sigterm"]
+        _emergency["fired"] = True
     try:
         p = save_emergency_checkpoint("sigterm")
         if p:
@@ -663,21 +1170,93 @@ def _sigterm_handler(signum, frame):
     raise SystemExit(143)            # 128 + SIGTERM, supervisor-visible
 
 
+def _sigint_handler(signum, frame):
+    """A ctrl-C (or supervisor SIGINT) is a preemption notice too: one
+    best-effort save, then the conventional 130 exit — chaining any
+    non-default previous handler (the default would just raise
+    KeyboardInterrupt past the save we came here for)."""
+    with _emergency_lock:
+        prev = _emergency["prev_sigint"]
+        _emergency["fired"] = True
+    try:
+        p = save_emergency_checkpoint("sigint")
+        if p:
+            print("mxnet_tpu.checkpoint: SIGINT — emergency "
+                  "checkpoint committed to %s" % p, flush=True)
+    except Exception:
+        traceback.print_exc()
+    if callable(prev) and prev is not signal.default_int_handler:
+        prev(signum, frame)
+        return
+    raise SystemExit(130)            # 128 + SIGINT, supervisor-visible
+
+
+def _atexit_pass():
+    """Best-effort final save at interpreter exit: covers the exits no
+    signal announces (sys.exit from library code, main falling off the
+    end mid-epoch). Skips when a signal path already saved, when the
+    provider was uninstalled, or when the current step is already the
+    last committed one — a clean completion must not pay a duplicate
+    save."""
+    with _emergency_lock:
+        armed = _emergency["path"] is not None \
+            and _emergency["state"] is not None \
+            and not _emergency["fired"]
+        state = _emergency["state"]
+        last = _last_committed_step[0]
+    if not armed:
+        return
+    try:
+        import jax
+        if jax.process_count() > 1:
+            # a multi-controller save is a collective (completion
+            # barrier); an uncoordinated atexit save would wedge the
+            # surviving peers — the per-rank shard path covers this
+            return
+        st = state()
+        if last is not None and int(st.get("step", -1)) == last:
+            return
+        save_emergency_checkpoint("atexit")
+    except Exception:                # exit paths never raise
+        traceback.print_exc()
+
+
+def _prune_stale_sideband():
+    """Drop heartbeat / shrink / watchdog-sideband files from previous
+    elastic generations so a relaunch can never read a dead
+    generation's membership as live. No-op outside an elastic run."""
+    try:
+        from ..parallel import elastic
+        d = elastic.elastic_dir()
+        if d:
+            elastic.prune_stale(d, elastic.generation_env())
+    except Exception:                # best-effort hygiene only
+        pass
+
+
 def install_emergency_checkpoint(path, state, keep=2, on_sigterm=True,
-                                 on_watchdog=True):
+                                 on_watchdog=True, on_sigint=True,
+                                 atexit_pass=True):
     """Arm emergency checkpointing: ``state()`` must return a dict with
     ``cfg``/``params`` (and optionally ``momentum``/``step``/
     ``metadata``) reflecting the CURRENT training state — call it
     cheap, it runs at preemption time. With ``on_sigterm`` a SIGTERM
     triggers one best-effort save and then exits 143 (chaining any
-    previously installed handler); with ``on_watchdog`` the
-    collective-hang watchdog's ``MXNET_OBS_WATCHDOG_ACTION=checkpoint``
-    escalation saves through the same provider before aborting."""
+    previously installed handler); ``on_sigint`` does the same for
+    SIGINT (exit 130); ``atexit_pass`` registers one best-effort save
+    at interpreter exit for the step the periodic cadence missed; with
+    ``on_watchdog`` the collective-hang watchdog's
+    ``MXNET_OBS_WATCHDOG_ACTION=checkpoint`` escalation saves through
+    the same provider before aborting. Installing also prunes stale
+    elastic heartbeat / watchdog sideband files from previous
+    generations (``parallel.elastic.prune_stale``)."""
     global _emergency
     with _emergency_lock:
         _emergency["path"] = path
         _emergency["state"] = state
         _emergency["keep"] = int(keep)
+        _emergency["fired"] = False
+    _prune_stale_sideband()
     if on_sigterm:
         try:
             prev = signal.signal(signal.SIGTERM, _sigterm_handler)
@@ -691,6 +1270,21 @@ def install_emergency_checkpoint(path, state, keep=2, on_sigterm=True,
                 "(not on the main thread); emergency checkpointing "
                 "stays available to the watchdog only",
                 RuntimeWarning, stacklevel=2)
+    if on_sigint:
+        try:
+            prev = signal.signal(signal.SIGINT, _sigint_handler)
+            with _emergency_lock:
+                if prev is not _sigint_handler:
+                    _emergency["prev_sigint"] = prev
+                _emergency["sigint"] = True
+        except ValueError:
+            pass                     # same not-main-thread degradation
+    if atexit_pass:
+        with _emergency_lock:
+            need = not _emergency["atexit"]
+            _emergency["atexit"] = True
+        if need:
+            atexit.register(_atexit_pass)
     if on_watchdog:
         from ..observability import watchdog as _wd
         _wd.set_emergency_hook(save_emergency_checkpoint)
@@ -700,19 +1294,30 @@ def install_emergency_checkpoint(path, state, keep=2, on_sigterm=True,
 
 
 def uninstall_emergency_checkpoint():
-    """Disarm: restore the previous SIGTERM disposition and drop the
-    provider/watchdog hook."""
+    """Disarm: restore the previous SIGTERM/SIGINT dispositions and
+    drop the provider/watchdog hook (the atexit registration stays but
+    no-ops once the provider is gone)."""
     with _emergency_lock:
         prev = _emergency["prev_sigterm"]
+        prev_int = _emergency["prev_sigint"]
         had_sig = _emergency["sigterm"]
+        had_int = _emergency["sigint"]
         had_wd = _emergency["watchdog"]
         _emergency.update({"path": None, "state": None,
                            "prev_sigterm": None, "sigterm": False,
-                           "watchdog": False})
+                           "prev_sigint": None, "sigint": False,
+                           "watchdog": False, "fired": False})
     if had_sig:
         try:
             signal.signal(signal.SIGTERM,
                           prev if prev is not None else signal.SIG_DFL)
+        except ValueError:
+            pass
+    if had_int:
+        try:
+            signal.signal(signal.SIGINT,
+                          prev_int if prev_int is not None
+                          else signal.default_int_handler)
         except ValueError:
             pass
     if had_wd:

@@ -25,7 +25,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "current_mesh",
            "use_mesh", "set_mesh", "shard", "replicate", "all_reduce",
            "all_gather", "reduce_scatter", "ring_permute", "device_count",
-           "init_distributed", "fusion", "bucketed_all_reduce"]
+           "init_distributed", "fusion", "elastic",
+           "bucketed_all_reduce"]
 
 _CURRENT_MESH = None
 

@@ -1,0 +1,741 @@
+"""Elastic multi-host training: generation rendezvous, shrink, regrow.
+
+The repo's pre-elastic failure story is "kill the whole world, restart
+from the last periodic checkpoint" (examples/elastic_training.py) —
+which discards everything the survivors still hold: their live
+optimizer shards (parallel/fusion.py partitions optimizer state by
+rank, the PAPERS.md cross-replica sharding), the replicated weights at
+the CURRENT step rather than the last save, and the exact data-stream
+position. This module is the survivor-side half of ROADMAP item 5's
+multi-host story:
+
+* **generation rendezvous** — every world membership is a numbered
+  *generation*. A file sideband (``MXNET_ELASTIC_DIR``, defaulting to
+  the watchdog's ``MXNET_OBS_WATCHDOG_DIR`` transport — same
+  shared-directory contract, same atomic-replace writes) carries the
+  generation record, per-rank heartbeats, and shrink/boundary records.
+  No collective is ever used for membership: the sideband must keep
+  working precisely when a peer has stopped answering collectives.
+* **failure detection** — ranks heartbeat (`Heartbeat` thread, one
+  atomic file replace per interval); a peer whose heartbeat is older
+  than ``heartbeat_s * miss`` is presumed dead. A watchdog post-mortem
+  file for the current generation (``postmortem.rank<r>.txt``) counts
+  as independent evidence — a rank wedged in a collective is dead for
+  membership purposes even while its heart still beats.
+* **coordinated shrink** — on detection, every survivor (re-indexed
+  over the sorted survivor set) captures its post-shrink shard of the
+  training state into a per-rank sharded checkpoint
+  (``models/checkpoint.save_shard_checkpoint``: replicated weights +
+  the survivor's slice of the flat optimizer lanes + data cursor + RNG
+  — layout derived from the deterministic ``fusion.plan_buckets``
+  replan at the NEW world size), writes the generation-(g+1) shrink
+  record, and exits with ``SHRINK_EXIT_CODE`` (44). The supervisor
+  (``tools/elastic_launch.py``) relaunches at generation g+1, world
+  N−k; a recovered host rejoins at the next generation boundary
+  (``BOUNDARY_EXIT_CODE`` 45 → regrow to the full world).
+* **exact resume** — ``resume_elastic`` loads the newest usable state
+  (shard set or full checkpoint, whichever is newer), merge-on-load
+  re-partitions the optimizer lanes for ANY new world size, the data
+  cursor restores the iterator mid-epoch (io.py ``state_dict``), and
+  ``MXNET_ELASTIC_KEEP_GLOBAL_BATCH=1`` compensates a shrunk world
+  with gradient accumulation so global batch semantics survive.
+  Correctness bar (tests/test_elastic.py, chaos_smoke --elastic): the
+  post-shrink loss trajectory is bit-identical to a clean run started
+  from the same step at the new world size, with zero skipped or
+  replayed samples.
+
+Observability: ``elastic.generation`` gauge, ``elastic.restart`` /
+``elastic.shrink`` / ``elastic.regrow`` counters, and the
+``elastic.time_to_recovery_ms`` histogram (PR 7 ``Histogram`` — merges
+bucket-wise across ranks into the merged trace) observed by every
+worker that comes up inside a recovery window.
+"""
+
+import json
+import os
+import threading
+import time
+
+from .. import _fastenv
+
+__all__ = ["SHRINK_EXIT_CODE", "BOUNDARY_EXIT_CODE", "enabled",
+           "elastic_dir", "rank_env", "world_env", "generation_env",
+           "heartbeat_s", "miss_threshold", "keep_global_batch",
+           "accumulation_factor", "read_generation", "write_generation",
+           "heartbeat_path", "write_heartbeat", "read_heartbeats",
+           "dead_ranks", "shrink_record_path", "write_shrink_record",
+           "read_shrink_record", "prune_stale", "capture_rng",
+           "restore_rng", "jsonable_cursor", "cursor_from_json",
+           "Heartbeat", "ElasticCoordinator",
+           "install_coordinator", "current_coordinator", "step_boundary",
+           "make_accum_train_step", "observe_recovery"]
+
+# supervisor-visible exit taxonomy (documented in docs/ROBUSTNESS.md;
+# 43 = watchdog abort lives in observability/watchdog.py)
+SHRINK_EXIT_CODE = 44        # coordinated shrink: relaunch at g+1, N-k
+BOUNDARY_EXIT_CODE = 45      # generation boundary, work remaining (regrow)
+
+DEFAULT_HEARTBEAT_S = 2.0
+DEFAULT_MISS = 3
+
+
+# ------------------------------------------------------------ env knobs --
+
+def elastic_dir():
+    """MXNET_ELASTIC_DIR: the rendezvous sideband directory. Falls back
+    to MXNET_OBS_WATCHDOG_DIR — one shared directory serves both the
+    watchdog check-in and the elastic membership protocol."""
+    return _fastenv.get("MXNET_ELASTIC_DIR") \
+        or _fastenv.get("MXNET_OBS_WATCHDOG_DIR")
+
+
+def enabled():
+    """THE site guard (trainer step boundary): a sideband directory is
+    configured. One `_fastenv` read when off."""
+    return bool(elastic_dir())
+
+
+def rank_env():
+    """This process's elastic rank (the launcher's proc id)."""
+    return int(_fastenv.get("MXNET_TPU_PROC_ID", "0") or 0)
+
+
+def world_env():
+    return int(_fastenv.get("MXNET_TPU_NUM_PROC", "1") or 1)
+
+
+def generation_env():
+    return int(_fastenv.get("MXNET_ELASTIC_GENERATION", "0") or 0)
+
+
+def heartbeat_s():
+    """MXNET_ELASTIC_HEARTBEAT_S: seconds between heartbeat writes."""
+    try:
+        return float(_fastenv.get("MXNET_ELASTIC_HEARTBEAT_S",
+                                  DEFAULT_HEARTBEAT_S))
+    except (TypeError, ValueError):
+        return DEFAULT_HEARTBEAT_S
+
+
+def miss_threshold():
+    """MXNET_ELASTIC_MISS: missed heartbeat intervals before a peer is
+    presumed dead (default 3)."""
+    try:
+        return max(int(_fastenv.get("MXNET_ELASTIC_MISS", DEFAULT_MISS)),
+                   1)
+    except (TypeError, ValueError):
+        return DEFAULT_MISS
+
+
+def keep_global_batch():
+    """MXNET_ELASTIC_KEEP_GLOBAL_BATCH=1: a shrunk world compensates
+    with gradient accumulation so the global batch (and therefore the
+    loss trajectory semantics) survives the world-size change."""
+    v = _fastenv.get("MXNET_ELASTIC_KEEP_GLOBAL_BATCH")
+    return v is not None and v not in ("", "0", "false", "False")
+
+
+def accumulation_factor(base_world, world):
+    """Microbatches per step so ``world`` ranks cover ``base_world``
+    ranks' global batch. Raises when the shrunk world cannot tile the
+    original batch evenly — silently changing the effective batch is
+    exactly the bug this knob exists to prevent."""
+    base_world, world = int(base_world), int(world)
+    if world <= 0 or base_world <= 0:
+        raise ValueError("world sizes must be positive (base=%d, now=%d)"
+                         % (base_world, world))
+    if base_world % world:
+        raise ValueError(
+            "MXNET_ELASTIC_KEEP_GLOBAL_BATCH: world %d cannot evenly "
+            "cover the original world %d's global batch — choose a "
+            "divisor world size or restart without compensation"
+            % (world, base_world))
+    return base_world // world
+
+
+# ----------------------------------------------------- sideband records --
+
+def _atomic_write_json(path, obj):
+    tmp = os.path.join(os.path.dirname(path),
+                       "." + os.path.basename(path) + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_generation(d):
+    """The current generation record ``{"generation", "world",
+    "ranks", ...}`` or None."""
+    return _read_json(os.path.join(d, "gen.json"))
+
+
+def write_generation(d, generation, world, ranks=None, base_world=None,
+                     since_wall=None):
+    """Commit the generation record (atomic replace — the rendezvous
+    'pointer'). ``since_wall`` stamps when the previous generation's
+    failure was detected, so the first worker up can observe
+    time-to-recovery."""
+    os.makedirs(d, exist_ok=True)
+    rec = {"generation": int(generation), "world": int(world),
+           "ranks": list(range(world)) if ranks is None else list(ranks),
+           "wall": time.time()}
+    if base_world is not None:
+        rec["base_world"] = int(base_world)
+    if since_wall is not None:
+        rec["since_wall"] = float(since_wall)
+    _atomic_write_json(os.path.join(d, "gen.json"), rec)
+    return rec
+
+
+def heartbeat_path(d, rank, generation):
+    return os.path.join(d, "hb.g%d.rank%d.json" % (generation, rank))
+
+
+def write_heartbeat(d, rank, generation, step=None, wall=None):
+    """One atomic heartbeat: wall time + the last completed step."""
+    os.makedirs(d, exist_ok=True)
+    _atomic_write_json(heartbeat_path(d, rank, generation),
+                       {"rank": int(rank), "generation": int(generation),
+                        "step": None if step is None else int(step),
+                        "wall": time.time() if wall is None else wall})
+
+
+def read_heartbeats(d, generation):
+    """{rank: record} for every readable heartbeat of ``generation``."""
+    out = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    prefix = "hb.g%d.rank" % generation
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        rec = _read_json(os.path.join(d, name))
+        if rec is not None:
+            out[int(rec.get("rank", -1))] = rec
+    return out
+
+
+def _postmortem_ranks(d):
+    """Ranks that left a watchdog post-mortem in the sideband — a rank
+    wedged in a collective is dead for membership purposes even while
+    its heartbeat thread still beats."""
+    out = set()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("postmortem.rank") and name.endswith(".txt"):
+            try:
+                out.add(int(name[len("postmortem.rank"):-len(".txt")]))
+            except ValueError:
+                continue
+    return out
+
+
+def dead_ranks(d, generation, world, self_rank, now=None,
+               stale_s=None, grace_s=None):
+    """Peers presumed dead: heartbeat missing/older than ``stale_s``
+    (default heartbeat_s * miss) or a watchdog post-mortem on file.
+    ``grace_s`` (default = stale_s) suppresses the missing-file verdict
+    right after a generation starts, while peers are still coming up."""
+    now = time.time() if now is None else now
+    stale_s = heartbeat_s() * miss_threshold() if stale_s is None \
+        else float(stale_s)
+    grace_s = stale_s if grace_s is None else float(grace_s)
+    beats = read_heartbeats(d, generation)
+    gen = read_generation(d) or {}
+    gen_wall = float(gen.get("wall", 0.0)) \
+        if gen.get("generation") == generation else 0.0
+    dead = set()
+    for r in range(world):
+        if r == self_rank:
+            continue
+        rec = beats.get(r)
+        if rec is None:
+            # never checked in: only counts as dead once the start-up
+            # grace window (measured from the generation commit) passed
+            if gen_wall and now - gen_wall > grace_s:
+                dead.add(r)
+            continue
+        if now - float(rec.get("wall", 0.0)) > stale_s:
+            dead.add(r)
+    for r in _postmortem_ranks(d):
+        if r != self_rank and r < world:
+            dead.add(r)
+    return dead
+
+
+def shrink_record_path(d, generation):
+    return os.path.join(d, "shrink.g%d.json" % generation)
+
+
+def write_shrink_record(d, new_generation, survivors, dead, step,
+                        base_world=None, wall=None):
+    """The coordinated-shrink proposal every survivor writes (same
+    content from every writer — the atomic replace makes the last one
+    win harmlessly): relaunch at ``new_generation`` with ``survivors``
+    as the new world, resuming from ``step``."""
+    os.makedirs(d, exist_ok=True)
+    rec = {"generation": int(new_generation),
+           "survivors": sorted(int(r) for r in survivors),
+           "dead": sorted(int(r) for r in dead),
+           "world": len(survivors), "step": int(step),
+           "wall": time.time() if wall is None else wall}
+    if base_world is not None:
+        rec["base_world"] = int(base_world)
+    _atomic_write_json(shrink_record_path(d, new_generation), rec)
+    return rec
+
+
+def read_shrink_record(d, generation):
+    return _read_json(shrink_record_path(d, generation))
+
+
+def prune_stale(d, generation):
+    """Delete sideband state from generations BEFORE ``generation`` —
+    heartbeats, shrink records, watchdog check-ins and post-mortems. A
+    relaunch must never read a dead generation's membership as live
+    (the satellite contract: ``install_emergency_checkpoint`` calls
+    this through ``models/checkpoint``)."""
+    if not d or not os.path.isdir(d):
+        return 0
+    removed = 0
+    for name in os.listdir(d):
+        doomed = False
+        for prefix in ("hb.g", "shrink.g"):
+            if name.startswith(prefix) and name.endswith(".json"):
+                try:
+                    g = int(name[len(prefix):].split(".")[0])
+                except ValueError:
+                    continue
+                doomed = g < generation
+        # the watchdog sideband carries no generation tag: any check-in
+        # or post-mortem written before this generation's record is a
+        # previous incarnation's state
+        if name.startswith("wd.rank") or name.startswith("postmortem."):
+            gen = read_generation(d)
+            wall = float((gen or {}).get("wall", 0.0))
+            try:
+                doomed = wall > 0 and \
+                    os.path.getmtime(os.path.join(d, name)) < wall
+            except OSError:
+                continue
+        if doomed:
+            try:
+                os.remove(os.path.join(d, name))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+# ------------------------------------------------------------- cursors --
+
+def jsonable_cursor(state):
+    """io.py ``state_dict()`` payloads keep numpy arrays for hot-path
+    cheapness; manifests are JSON. Arrays become ``{"__nd__": dtype,
+    "data": nested lists}`` markers, reversibly."""
+    import numpy as np
+    if isinstance(state, np.ndarray):
+        return {"__nd__": str(state.dtype), "data": state.tolist()}
+    if isinstance(state, np.generic):
+        return state.item()
+    if isinstance(state, dict):
+        return {k: jsonable_cursor(v) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        return [jsonable_cursor(v) for v in state]
+    return state
+
+
+def cursor_from_json(state):
+    """Inverse of :func:`jsonable_cursor`."""
+    import numpy as np
+    if isinstance(state, dict):
+        if set(state) == {"__nd__", "data"}:
+            return np.asarray(state["data"],
+                              dtype=np.dtype(state["__nd__"]))
+        return {k: cursor_from_json(v) for k, v in state.items()}
+    if isinstance(state, list):
+        return [cursor_from_json(v) for v in state]
+    return state
+
+
+# ------------------------------------------------------------------ rng --
+
+def capture_rng(rng=None):
+    """JSON-able snapshot of a numpy RandomState (default: the global
+    numpy stream the shuffling iterators draw from)."""
+    import numpy as np
+    state = (rng.get_state() if rng is not None
+             else np.random.get_state())
+    name, keys, pos, has_gauss, cached = state
+    return {"name": str(name), "keys": [int(k) for k in keys],
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def restore_rng(snap, rng=None):
+    """Inverse of :func:`capture_rng`."""
+    import numpy as np
+    state = (snap["name"], np.asarray(snap["keys"], np.uint32),
+             int(snap["pos"]), int(snap["has_gauss"]),
+             float(snap["cached"]))
+    if rng is not None:
+        rng.set_state(state)
+        return rng
+    np.random.set_state(state)
+    return None
+
+
+# -------------------------------------------------------------- threads --
+
+class Heartbeat(threading.Thread):
+    """Daemon heartbeat writer: one atomic file replace per interval.
+    ``beat(step)`` from the training loop refreshes immediately and
+    records the last completed step (the shrink record's resume
+    point)."""
+
+    def __init__(self, d, rank, generation, interval=None):
+        super().__init__(name="mxnet-elastic-heartbeat", daemon=True)
+        self.dir = d
+        self.rank = int(rank)
+        self.generation = int(generation)
+        self.interval = heartbeat_s() if interval is None \
+            else float(interval)
+        self.step = None
+        self._stop = threading.Event()
+        self._last_write = 0.0
+        self.beat()
+
+    def beat(self, step=None):
+        """Record liveness. Called from the training loop per step:
+        the file write is throttled to half the interval (the thread
+        covers the cadence), so a ms-scale step never pays a file
+        replace per iteration."""
+        if step is not None:
+            self.step = int(step)
+        now = time.time()
+        if now - self._last_write < self.interval / 2.0:
+            return
+        self._last_write = now
+        try:
+            write_heartbeat(self.dir, self.rank, self.generation,
+                            step=self.step, wall=now)
+        except OSError:                 # sideband is best-effort
+            pass
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            self._last_write = 0.0      # thread beats are never skipped
+            self.beat()
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticCoordinator(object):
+    """One rank's view of the elastic protocol: heartbeat out, watch
+    the peers, and on a detected death run the coordinated shrink —
+    capture this survivor's shard of the training state, commit the
+    g+1 shrink record, and leave with ``SHRINK_EXIT_CODE``.
+
+    ``state()`` must return the provider dict
+    ``models/checkpoint.install_emergency_checkpoint`` takes (``cfg`` /
+    ``params`` / ``momentum`` / ``step`` and optionally ``cursor`` /
+    ``metadata``) reflecting the last COMPLETED step — it is called
+    from the monitor thread while the main thread may be wedged in a
+    collective the dead peer will never join, so the state must be
+    materialized and never donated to an in-flight dispatch.
+
+    ``clock`` / ``exit`` / ``monitor`` are injectable for tests (fake
+    time, captured exits, manual ``check()``)."""
+
+    def __init__(self, ckpt_dir, state, d=None, rank=None, world=None,
+                 generation=None, base_world=None, clock=time.time,
+                 exit=None, monitor=True, interval=None, stale_s=None):
+        self.ckpt_dir = ckpt_dir
+        self.state = state
+        self.dir = d or elastic_dir()
+        if not self.dir:
+            raise ValueError("elastic rendezvous needs MXNET_ELASTIC_DIR "
+                             "(or MXNET_OBS_WATCHDOG_DIR) set")
+        self.rank = rank_env() if rank is None else int(rank)
+        self.world = world_env() if world is None else int(world)
+        self.generation = generation_env() if generation is None \
+            else int(generation)
+        gen = read_generation(self.dir) or {}
+        self.base_world = int(base_world if base_world is not None
+                              else gen.get("base_world", self.world))
+        self.clock = clock
+        self._exit = exit
+        self._stale_s = stale_s
+        self._monitor = None
+        self._shrunk = threading.Event()
+        self.heartbeat = Heartbeat(self.dir, self.rank, self.generation,
+                                   interval=interval)
+        prune_stale(self.dir, self.generation)
+        self._obs_generation()
+        if monitor:
+            self.heartbeat.start()
+            self._monitor = threading.Thread(
+                target=self._watch, name="mxnet-elastic-monitor",
+                daemon=True)
+            self._monitor.start()
+
+    # ------------------------------------------------------ membership --
+    def beat(self, step=None):
+        self.heartbeat.beat(step)
+
+    def dead(self, now=None):
+        return dead_ranks(self.dir, self.generation, self.world,
+                          self.rank, now=now, stale_s=self._stale_s)
+
+    def check(self, now=None):
+        """One membership check; runs the coordinated shrink when a
+        peer died. Returns the dead set (empty when healthy)."""
+        if self.world <= 1:
+            return set()
+        dead = self.dead(now)
+        if dead:
+            self.shrink(dead)
+        return dead
+
+    # ---------------------------------------------------------- shrink --
+    def shrink(self, dead):
+        """The survivor-side capture: sharded emergency checkpoint at
+        the NEW world size, shrink record, exit 44. Idempotent —
+        concurrent detection from the monitor thread and the step
+        boundary runs it once."""
+        if self._shrunk.is_set():
+            return
+        self._shrunk.set()
+        survivors = sorted(set(range(self.world)) - set(dead))
+        new_rank = survivors.index(self.rank)
+        st = self.state()
+        step = int(st.get("step", 0))
+        from ..observability import core as _obs
+        if _obs.enabled():
+            _obs.counter("elastic.shrink").add(1)
+            _obs.record_instant(
+                "elastic.shrink", cat="elastic",
+                args={"generation": self.generation,
+                      "dead": sorted(int(r) for r in dead),
+                      "survivors": survivors, "step": step})
+        print("[elastic] rank %d g%d: peer(s) %s dead — capturing "
+              "shard %d/%d at step %d and leaving for generation %d"
+              % (self.rank, self.generation,
+                 sorted(int(r) for r in dead), new_rank,
+                 len(survivors), step, self.generation + 1),
+            flush=True)
+        from ..models import checkpoint as ckpt
+        try:
+            ckpt.save_shard_checkpoint(
+                self.ckpt_dir, st["cfg"], st["params"],
+                momentum=st.get("momentum"), step=step,
+                rank=new_rank, world=len(survivors),
+                generation=self.generation + 1,
+                cursor=st.get("cursor"), rng=st.get("rng"),
+                base_world=self.base_world,
+                metadata=dict(st.get("metadata") or {},
+                              shrink_from_world=self.world))
+        except Exception:               # last gasp: report, still leave
+            import traceback
+            traceback.print_exc()
+        try:
+            write_shrink_record(self.dir, self.generation + 1,
+                                survivors, dead, step,
+                                base_world=self.base_world)
+        except OSError:
+            pass
+        self.heartbeat.stop()
+        if self._exit is not None:
+            self._exit(SHRINK_EXIT_CODE)
+        else:                            # pragma: no cover - fatal
+            import sys
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(SHRINK_EXIT_CODE)
+
+    # -------------------------------------------------------- boundary --
+    def leave_at_boundary(self):
+        """Clean generation-boundary exit (work remaining): the
+        supervisor regrows the world to full strength. The caller is
+        responsible for having saved a resumable checkpoint first."""
+        self._shrunk.set()      # disarm: leaving deliberately
+        self.heartbeat.stop()
+        if self._exit is not None:
+            self._exit(BOUNDARY_EXIT_CODE)
+        else:                            # pragma: no cover - fatal
+            import sys
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(BOUNDARY_EXIT_CODE)
+
+    def stop(self):
+        """Clean shutdown (job complete / caller-managed exit): ends
+        the heartbeat AND the monitor, and disarms shrink — a peer
+        that disappears after this rank finished is not a failure this
+        rank should react to."""
+        self._shrunk.set()
+        self.heartbeat.stop()
+
+    # ------------------------------------------------------------- obs --
+    def _obs_generation(self):
+        from ..observability import core as _obs
+        if _obs.enabled():
+            _obs.gauge("elastic.generation").set(self.generation)
+            _obs.gauge("elastic.world").set(self.world)
+
+    def _watch(self):                    # pragma: no cover - timing
+        poll = max(0.05, self.heartbeat.interval / 2.0)
+        while not self._shrunk.is_set():
+            time.sleep(poll)
+            try:
+                self.check()
+            except Exception:            # never take the process down
+                pass
+
+
+# --------------------------------------------------- module coordinator --
+
+_installed = [None]
+
+
+def install_coordinator(coord):
+    """Register the process coordinator so framework step boundaries
+    (gluon Trainer, training loops calling ``step_boundary``) drive
+    the membership protocol without holding a reference."""
+    _installed[0] = coord
+    return coord
+
+
+def current_coordinator():
+    return _installed[0]
+
+
+_env_beat = [0.0]                      # throttle for the env-only path
+
+
+def step_boundary(step=None):
+    """The per-step elastic hook: heartbeat + membership check when a
+    coordinator is installed, bare heartbeat-by-env otherwise (write
+    throttled to half the heartbeat interval). One guarded
+    ``enabled()`` branch when elastic is off (the PR 2 cost contract —
+    callers guard too)."""
+    if not enabled():
+        return
+    coord = _installed[0]
+    if coord is not None:
+        coord.beat(step)
+        coord.check()
+        return
+    now = time.time()
+    if now - _env_beat[0] < heartbeat_s() / 2.0:
+        return
+    _env_beat[0] = now
+    d = elastic_dir()
+    try:
+        write_heartbeat(d, rank_env(), generation_env(), step=step)
+    except OSError:
+        pass
+
+
+def observe_recovery(generation=None, d=None):
+    """Observe time-to-recovery when this worker came up inside a
+    recovery window: the shrink/generation record carries the wall
+    time the failure was detected (``since_wall`` / shrink ``wall``);
+    now − then lands in the ``elastic.time_to_recovery_ms`` histogram
+    (bucket-wise mergeable across ranks — PR 7) and the
+    ``elastic.restart``/``elastic.regrow`` counters. Returns the
+    milliseconds observed, or None outside a recovery."""
+    d = d or elastic_dir()
+    generation = generation_env() if generation is None else generation
+    if not d or generation <= 0:
+        return None
+    since = None
+    kind = "restart"
+    rec = read_shrink_record(d, generation)
+    if rec is not None:
+        since = float(rec.get("wall", 0.0)) or None
+        kind = "shrink"
+    gen = read_generation(d)
+    if gen is not None and gen.get("generation") == generation:
+        since = float(gen.get("since_wall", 0.0)) or since
+        if rec is None and gen.get("world", 0) > \
+                (read_shrink_record(d, generation - 1) or {}).get(
+                    "world", gen.get("world", 0)):
+            kind = "regrow"
+    if since is None:
+        return None
+    ms = max((time.time() - since) * 1e3, 0.0)
+    from ..observability import core as _obs
+    if _obs.enabled():
+        _obs.histogram("elastic.time_to_recovery_ms", "ms").observe(ms)
+        _obs.counter("elastic.restart").add(1)
+        if kind == "regrow":
+            _obs.counter("elastic.regrow").add(1)
+        _obs.gauge("elastic.generation").set(generation)
+        _obs.record_instant("elastic.recovered", cat="elastic",
+                            args={"generation": generation,
+                                  "kind": kind,
+                                  "ms": round(ms, 3)})
+    return ms
+
+
+# -------------------------------------------- accumulation compensation --
+
+def make_accum_train_step(cfg, mesh=None, lr=1e-2, accum=1,
+                          donate=False):
+    """``models/transformer.make_train_step`` with gradient
+    accumulation: the step takes tokens ``[accum, B, T]``, averages
+    the ``accum`` microbatch gradients, and applies ONE optimizer
+    update — so a world shrunk by k can keep the original global batch
+    (``accumulation_factor``) at k× microbatches per step.
+
+    ``accum=1`` reduces to the same math as ``make_train_step`` (a
+    single-element mean is the identity). Donation is OFF by default:
+    elastic capture reads the last completed step's state from a
+    monitor thread while the next dispatch may be in flight, and a
+    donated buffer is exactly the state that would no longer exist.
+    Returns ``(params, momentum, mean_loss)``."""
+    import jax
+    import jax.numpy as jnp
+    from ..models.transformer import loss_fn
+
+    accum = int(accum)
+    if accum < 1:
+        raise ValueError("accum must be >= 1, got %d" % accum)
+
+    def step(params, momentum, tokens):
+        def micro(carry, tok):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tok, cfg, mesh)
+            g_sum, l_sum = carry
+            g_sum = jax.tree.map(jnp.add, g_sum, grads)
+            return (g_sum, l_sum + loss), None
+
+        zero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.result_type(p.dtype,
+                                                         jnp.float32)),
+            params)
+        (g_sum, l_sum), _ = jax.lax.scan(micro, (zero, jnp.float32(0.0)),
+                                         tokens)
+        scale = 1.0 / accum
+        grads = jax.tree.map(lambda g: g * scale, g_sum)
+        new_m = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+        new_p = jax.tree.map(lambda p, m: p - lr * m.astype(p.dtype),
+                             params, new_m)
+        return new_p, new_m, l_sum * scale
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

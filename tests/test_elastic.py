@@ -1,0 +1,786 @@
+"""Elastic multi-host training (mxnet_tpu/parallel/elastic.py +
+models/checkpoint.py shard sets + tools/elastic_launch.py).
+
+In-process coverage of every protocol leg: generation rendezvous and
+heartbeat-based death detection (fake clocks), survivor-side shard
+capture with merge-on-load resharding N->N-1 and N-1->N, iterator
+cursor round-trips (io.py state_dict/load_state_dict), accumulation
+compensation, manifest-compatibility validation, sideband pruning, the
+supervisor's exit-code taxonomy/backoff/max-restarts logic, and a
+chaos-driven coordinator shrink. The 2-process gloo kill-one-rank e2e
+(bit-exact post-shrink trajectory, regrow, recovery histogram) is the
+slow test at the bottom — the same chain the TIER1_CHAOS lane runs via
+``tools/chaos_smoke.py --elastic``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import io as mx_io
+from mxnet_tpu.models import transformer as T
+from mxnet_tpu.models import checkpoint as C
+from mxnet_tpu.parallel import elastic
+from mxnet_tpu.observability import chaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg():
+    import jax.numpy as jnp
+    return T.TransformerConfig(vocab_size=41, d_model=16, n_heads=2,
+                               n_layers=1, d_ff=32, max_len=32,
+                               dtype=jnp.float32)
+
+
+def tiny_state(seed=0):
+    cfg = tiny_cfg()
+    params = T.init_params(cfg, seed=seed)
+    rng = np.random.RandomState(seed + 100)
+    mom = jax.tree.map(
+        lambda p: __import__("jax.numpy", fromlist=["asarray"]).asarray(
+            rng.standard_normal(p.shape).astype(np.float32)), params)
+    return cfg, params, mom
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    elastic.install_coordinator(None)
+    elastic._env_beat[0] = 0.0
+    yield
+    chaos.reset()
+    elastic.install_coordinator(None)
+    elastic._env_beat[0] = 0.0
+
+
+# ---------------------------------------------------------- rendezvous --
+
+def test_generation_record_round_trip(tmp_path):
+    d = str(tmp_path)
+    rec = elastic.write_generation(d, 3, 2, base_world=4,
+                                   since_wall=123.0)
+    got = elastic.read_generation(d)
+    assert got["generation"] == 3 and got["world"] == 2
+    assert got["ranks"] == [0, 1] and got["base_world"] == 4
+    assert got["since_wall"] == 123.0 and rec["wall"] > 0
+
+
+def test_heartbeats_and_death_detection(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_S", "1.0")
+    monkeypatch.setenv("MXNET_ELASTIC_MISS", "3")
+    now = time.time()
+    elastic.write_generation(d, 0, 3)
+    elastic.write_heartbeat(d, 0, 0, step=5, wall=now)
+    elastic.write_heartbeat(d, 1, 0, step=5, wall=now)
+    elastic.write_heartbeat(d, 2, 0, step=4, wall=now - 10.0)
+    # rank 2's beat is 10 s stale vs the 3 s threshold
+    assert elastic.dead_ranks(d, 0, 3, self_rank=0, now=now) == {2}
+    # a fresh beat resurrects it
+    elastic.write_heartbeat(d, 2, 0, step=5, wall=now)
+    assert elastic.dead_ranks(d, 0, 3, self_rank=0, now=now) == set()
+
+
+def test_missing_heartbeat_counts_after_grace(tmp_path):
+    d = str(tmp_path)
+    elastic.write_generation(d, 0, 2)
+    gen_wall = elastic.read_generation(d)["wall"]
+    elastic.write_heartbeat(d, 0, 0, wall=gen_wall)
+    # inside the startup grace window a never-checked-in peer is NOT
+    # dead; past it, it is
+    assert elastic.dead_ranks(d, 0, 2, self_rank=0,
+                              now=gen_wall + 1.0, stale_s=5.0) == set()
+    assert elastic.dead_ranks(d, 0, 2, self_rank=0,
+                              now=gen_wall + 6.0, stale_s=5.0) == {1}
+
+
+def test_watchdog_postmortem_is_death_evidence(tmp_path):
+    d = str(tmp_path)
+    now = time.time()
+    elastic.write_generation(d, 0, 2)
+    elastic.write_heartbeat(d, 0, 0, wall=now)
+    elastic.write_heartbeat(d, 1, 0, wall=now)   # heart still beats...
+    with open(os.path.join(d, "postmortem.rank1.txt"), "w") as f:
+        f.write("hung in kvstore.pushpull_fused\n")
+    # ...but the rank is wedged in a collective: dead for membership
+    assert elastic.dead_ranks(d, 0, 2, self_rank=0, now=now) == {1}
+
+
+def test_heartbeats_are_generation_scoped(tmp_path):
+    d = str(tmp_path)
+    now = time.time()
+    elastic.write_heartbeat(d, 0, 0, wall=now)
+    assert elastic.read_heartbeats(d, 0).keys() == {0}
+    assert elastic.read_heartbeats(d, 1) == {}
+
+
+def test_prune_stale_drops_previous_generations(tmp_path):
+    d = str(tmp_path)
+    old = time.time() - 60
+    elastic.write_heartbeat(d, 0, 0, wall=old)
+    elastic.write_heartbeat(d, 1, 0, wall=old)
+    elastic.write_shrink_record(d, 1, [0], [1], step=3, wall=old)
+    for name in ("wd.rank0.json", "postmortem.rank1.txt"):
+        with open(os.path.join(d, name), "w") as f:
+            f.write("{}")
+    os.utime(os.path.join(d, "wd.rank0.json"), (old, old))
+    os.utime(os.path.join(d, "postmortem.rank1.txt"), (old, old))
+    elastic.write_generation(d, 2, 1)      # the new incarnation
+    elastic.write_heartbeat(d, 0, 2)
+    removed = elastic.prune_stale(d, 2)
+    assert removed >= 4
+    left = sorted(os.listdir(d))
+    assert "hb.g2.rank0.json" in left and "gen.json" in left
+    assert not any(n.startswith(("hb.g0", "shrink.g1", "wd.rank",
+                                 "postmortem.")) for n in left)
+
+
+def test_shrink_record_round_trip(tmp_path):
+    d = str(tmp_path)
+    rec = elastic.write_shrink_record(d, 2, survivors=[0, 2], dead=[1],
+                                      step=7, base_world=3)
+    got = elastic.read_shrink_record(d, 2)
+    assert got["survivors"] == [0, 2] and got["dead"] == [1]
+    assert got["world"] == 2 and got["step"] == 7
+    assert got["base_world"] == 3 and rec["wall"] > 0
+
+
+# ---------------------------------------------------------- coordinator --
+
+def test_coordinator_shrinks_on_dead_peer(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_S", "1.0")
+    monkeypatch.setenv("MXNET_ELASTIC_MISS", "2")
+    d, ck = str(tmp_path / "sb"), str(tmp_path / "ck")
+    cfg, params, mom = tiny_state()
+    exits = []
+    elastic.write_generation(d, 0, 2)
+    elastic.write_heartbeat(d, 1, 0, wall=time.time())
+    coord = elastic.ElasticCoordinator(
+        ck, lambda: {"cfg": cfg, "params": params, "momentum": mom,
+                     "step": 9, "cursor": {"cursor": 16}},
+        d=d, rank=0, world=2, generation=0, monitor=False,
+        exit=exits.append)
+    assert coord.check() == set()          # healthy peer
+    # rank 1 stops beating: 2 missed intervals later it is dead
+    future = time.time() + 10.0
+    dead = coord.check(now=future)
+    assert dead == {1} and exits == [elastic.SHRINK_EXIT_CODE]
+    rec = elastic.read_shrink_record(d, 1)
+    assert rec["survivors"] == [0] and rec["step"] == 9
+    # the survivor-side capture landed as a complete world-1 shard set
+    assert C.list_shard_generations(ck) == [(1, 9, 1)]
+    _, p2, m2, step, extras = C.load_shard_checkpoint(ck)
+    assert step == 9 and extras["cursor"] == {"cursor": 16}
+    for a, b in zip(jax.tree.leaves(mom), jax.tree.leaves(m2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # idempotent: a second check cannot double-exit
+    coord.check(now=future + 5)
+    assert exits == [elastic.SHRINK_EXIT_CODE]
+
+
+def test_coordinator_stop_disarms_shrink(tmp_path):
+    d, ck = str(tmp_path / "sb"), str(tmp_path / "ck")
+    cfg, params, mom = tiny_state()
+    exits = []
+    elastic.write_generation(d, 0, 2)
+    coord = elastic.ElasticCoordinator(
+        ck, lambda: {"cfg": cfg, "params": params, "step": 1},
+        d=d, rank=0, world=2, generation=0, monitor=False,
+        exit=exits.append)
+    coord.stop()
+    coord.check(now=time.time() + 100.0)   # peer long dead — but DONE
+    assert exits == []
+
+
+def test_step_boundary_heartbeats_without_coordinator(tmp_path,
+                                                      monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_ELASTIC_DIR", d)
+    monkeypatch.setenv("MXNET_TPU_PROC_ID", "0")
+    monkeypatch.setenv("MXNET_ELASTIC_GENERATION", "4")
+    assert elastic.enabled()
+    elastic.step_boundary(step=11)
+    beats = elastic.read_heartbeats(d, 4)
+    assert beats[0]["step"] == 11
+
+
+def test_chaos_driven_coordinator_shrink(tmp_path, monkeypatch):
+    """The replayable kill-one-rank site, in process: a chaos error at
+    the step site plus a stale peer heartbeat drives the coordinated
+    shrink exactly once."""
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_S", "0.5")
+    monkeypatch.setenv("MXNET_ELASTIC_MISS", "2")
+    d, ck = str(tmp_path / "sb"), str(tmp_path / "ck")
+    cfg, params, mom = tiny_state()
+    exits = []
+    elastic.write_generation(d, 0, 2)
+    elastic.write_heartbeat(d, 1, 0, wall=time.time() - 30.0)
+    coord = elastic.ElasticCoordinator(
+        ck, lambda: {"cfg": cfg, "params": params, "momentum": mom,
+                     "step": 3},
+        d=d, rank=0, world=2, generation=0, monitor=False,
+        exit=exits.append)
+    chaos.inject("train.step", "error", at=0)
+    with pytest.raises(chaos.ChaosError):
+        chaos.fire("train.step", step=3)
+    coord.check()
+    assert exits == [elastic.SHRINK_EXIT_CODE]
+    assert C.list_shard_generations(ck) == [(1, 3, 1)]
+
+
+# ------------------------------------------------- shard merge/reshard --
+
+def test_shard_layout_deterministic():
+    cfg, params, mom = tiny_state()
+    a = C.shard_layout(mom, 4)
+    b = C.shard_layout(mom, 4)
+    assert a == b
+    assert all(l["l_pad"] % 4 == 0 for l in a["lanes"])
+    c = C.shard_layout(mom, 3)
+    assert c["signature"] == a["signature"]   # plan is world-free
+    assert all(l["l_pad"] % 3 == 0 for l in c["lanes"])
+
+
+@pytest.mark.parametrize("worlds", [(3, 2), (2, 3), (4, 1), (1, 4)])
+def test_shard_merge_reshard_round_trip(tmp_path, worlds):
+    """N -> N' reshard: save a shard set at N, merge-load, save at N',
+    merge-load again — momentum and params bit-identical throughout."""
+    n, n2 = worlds
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    for r in range(n):
+        C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=5,
+                                rank=r, world=n, generation=1,
+                                keep_generations=8)
+    _, p1, m1, step, ex = C.load_shard_checkpoint(d)
+    assert step == 5 and ex["world"] == n
+    for r in range(n2):
+        C.save_shard_checkpoint(d, cfg, p1, momentum=m1, step=6,
+                                rank=r, world=n2, generation=2,
+                                keep_generations=8)
+    _, p2, m2, step2, ex2 = C.load_shard_checkpoint(d)
+    assert step2 == 6 and ex2["world"] == n2
+    for a, b in zip(jax.tree.leaves(mom), jax.tree.leaves(m2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_set_cursor_rng_metadata(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    rng = elastic.capture_rng()
+    cur = {"cursor": 24, "idx": {"__nd__": "int64",
+                                 "data": list(range(8))}}
+    C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=3,
+                            rank=0, world=1, generation=0, cursor=cur,
+                            rng=rng, base_world=2,
+                            metadata={"note": "x"})
+    _, _, _, _, ex = C.load_shard_checkpoint(d)
+    assert ex["cursor"] == cur and ex["rng"] == rng
+    assert ex["base_world"] == 2 and ex["metadata"] == {"note": "x"}
+
+
+def test_incomplete_set_raises_naming_ranks(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    for r in (0, 2):
+        C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=1,
+                                rank=r, world=3, generation=0)
+    with pytest.raises(C.CheckpointIncompatible, match=r"rank\(s\) \[1\]"):
+        C.load_shard_checkpoint(d, generation=0)
+    with pytest.warns(RuntimeWarning, match="missing rank"):
+        _, _, m, _, _ = C.load_shard_checkpoint(d, generation=0,
+                                                allow_partial=True)
+    assert m is not None                 # zero-filled, not absent
+
+
+def test_mixed_set_raises_naming_field(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=1,
+                            rank=0, world=2, generation=0)
+    C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=2,
+                            rank=1, world=2, generation=0)
+    with pytest.raises(C.CheckpointIncompatible, match="step"):
+        C.load_shard_checkpoint(d, generation=0)
+
+
+def test_corrupt_shard_params_fall_back_to_other_rank(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    for r in range(2):
+        C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=4,
+                                rank=r, world=2, generation=0)
+    # torch rank 0's data file: params must restore from rank 1
+    name = [n for n in os.listdir(d)
+            if n.startswith("shard-arrays-g0-r0of2")][0]
+    with open(os.path.join(d, name), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+    with pytest.warns(RuntimeWarning):
+        _, p2, m2, _, _ = C.load_shard_checkpoint(
+            d, generation=0, allow_partial=True)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_retention_keeps_newest_generations(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    for g in range(4):
+        C.save_shard_checkpoint(d, cfg, params, momentum=mom,
+                                step=g, rank=0, world=1, generation=g,
+                                keep_generations=2)
+    assert [g for g, _s, _w in C.list_shard_generations(d)] == [2, 3]
+    # no orphaned data files from the dropped generations
+    assert not any(n.startswith(("shard-arrays-g0", "shard-arrays-g1"))
+                   for n in os.listdir(d))
+
+
+def test_resume_elastic_prefers_newer_shard_set(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    C.save_checkpoint(d, cfg, params, momentum=mom, step=3)
+    C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=5,
+                            rank=0, world=1, generation=1,
+                            cursor={"cursor": 40})
+    _, _, _, step, extras = C.resume_elastic(d)
+    assert step == 5 and extras["cursor"] == {"cursor": 40}
+    # ...and the full checkpoint wins when IT is newer
+    C.save_checkpoint(d, cfg, params, momentum=mom, step=9)
+    _, _, _, step, extras = C.resume_elastic(d)
+    assert step == 9 and "cursor" not in extras
+
+
+def test_resume_elastic_stale_generation_raises(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    C.save_shard_checkpoint(d, cfg, params, momentum=mom, step=5,
+                            rank=0, world=1, generation=6)
+    with pytest.raises(C.CheckpointIncompatible, match="AHEAD"):
+        C.resume_elastic(d, expect_generation=4)
+    # the matching generation is fine
+    out = C.resume_elastic(d, expect_generation=6)
+    assert out[3] == 5
+
+
+def test_resume_from_latest_validates_cfg(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    C.save_checkpoint(d, cfg, params, momentum=mom, step=2)
+    other = T.TransformerConfig(vocab_size=41, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=32, max_len=32)
+    with pytest.raises(C.CheckpointIncompatible, match="d_model"):
+        C.resume_from_latest(d, expect_cfg=other)
+    out = C.resume_from_latest(d, expect_cfg=cfg)
+    assert out[3] == 2
+
+
+def test_resume_from_latest_validates_elastic_metadata(tmp_path):
+    cfg, params, mom = tiny_state()
+    d = str(tmp_path)
+    C.save_checkpoint(d, cfg, params, momentum=mom, step=2,
+                      metadata={"elastic": {"generation": 5,
+                                            "world": 4}})
+    with pytest.raises(C.CheckpointIncompatible, match="world"):
+        C.resume_from_latest(d, expect_world=2)
+    with pytest.raises(C.CheckpointIncompatible, match="generation"):
+        C.resume_from_latest(d, expect_generation=3)
+    out = C.resume_from_latest(d, expect_world=4, expect_generation=5)
+    assert out[3] == 2
+
+
+# ------------------------------------------------------------- cursors --
+
+def test_ndarray_iter_cursor_round_trip():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    it = mx_io.NDArrayIter(data, batch_size=2,
+                           last_batch_handle="discard")
+    first = [it.next().data[0].asnumpy() for _ in range(2)]
+    state = it.state_dict()
+    rest = [b.data[0].asnumpy() for b in it]
+    it2 = mx_io.NDArrayIter(data, batch_size=2,
+                            last_batch_handle="discard")
+    it2.load_state_dict(state)
+    rest2 = [b.data[0].asnumpy() for b in it2]
+    assert len(first) == 2 and len(rest) == len(rest2) == 3
+    for a, b in zip(rest, rest2):
+        assert np.array_equal(a, b)
+
+
+def test_ndarray_iter_cursor_preserves_shuffle_order():
+    data = np.arange(64).astype(np.float32).reshape(16, 4)
+    np.random.seed(11)
+    it = mx_io.NDArrayIter(data, batch_size=4, shuffle=True)
+    it.next()
+    state = it.state_dict()
+    rest = [b.data[0].asnumpy() for b in it]
+    np.random.seed(999)                    # a DIFFERENT global stream
+    it2 = mx_io.NDArrayIter(data, batch_size=4, shuffle=True)
+    it2.load_state_dict(state)             # ...must not matter
+    rest2 = [b.data[0].asnumpy() for b in it2]
+    for a, b in zip(rest, rest2):
+        assert np.array_equal(a, b)
+
+
+def test_image_record_iter_cursor_round_trip(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (8, 8, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make():
+        return mx_io.ImageRecordIter(path_imgrec=path, path_imgidx=idx,
+                                     data_shape=(3, 8, 8), batch_size=2)
+    it = make()
+    it.next()
+    state = it.state_dict()
+    rest = [b.label[0].asnumpy() for b in it]
+    it2 = make()
+    it2.load_state_dict(state)
+    rest2 = [b.label[0].asnumpy() for b in it2]
+    assert len(rest) == 3
+    for a, b in zip(rest, rest2):
+        assert np.array_equal(a, b)
+
+
+def test_resize_and_prefetching_iter_cursor_round_trip():
+    data = np.arange(48).reshape(12, 4).astype(np.float32)
+
+    def inner():
+        return mx_io.NDArrayIter(data, batch_size=3,
+                                 last_batch_handle="discard")
+    it = mx_io.PrefetchingIter(mx_io.ResizeIter(inner(), 6,
+                                                reset_internal=True))
+    consumed = [it.next().data[0].asnumpy() for _ in range(2)]
+    state = it.state_dict()
+    rest = [b.data[0].asnumpy() for b in it]
+    it2 = mx_io.PrefetchingIter(mx_io.ResizeIter(inner(), 6,
+                                                 reset_internal=True))
+    it2.load_state_dict(state)
+    rest2 = [b.data[0].asnumpy() for b in it2]
+    assert len(consumed) == 2 and len(rest) == len(rest2) == 4
+    for a, b in zip(rest, rest2):
+        assert np.array_equal(a, b)
+    # the in-flight prefetch must NOT have advanced the saved cursor
+    assert state["inner"][0]["cur"] == 2
+
+
+def test_cursor_json_round_trip():
+    data = np.arange(20).reshape(5, 4).astype(np.float32)
+    it = mx_io.NDArrayIter(data, batch_size=2,
+                           last_batch_handle="discard")
+    it.next()
+    state = it.state_dict()
+    wire = json.dumps(elastic.jsonable_cursor(state))
+    back = elastic.cursor_from_json(json.loads(wire))
+    it2 = mx_io.NDArrayIter(data, batch_size=2,
+                            last_batch_handle="discard")
+    it2.load_state_dict(back)
+    assert np.array_equal(it2.next().data[0].asnumpy(),
+                          it.next().data[0].asnumpy())
+
+
+def test_base_iterator_refuses_state_dict():
+    class Opaque(mx_io.DataIter):
+        pass
+    with pytest.raises(NotImplementedError, match="Opaque"):
+        Opaque().state_dict()
+
+
+def test_rng_capture_round_trip():
+    np.random.seed(42)
+    np.random.rand(3)
+    snap = elastic.capture_rng()
+    a = np.random.rand(5)
+    elastic.restore_rng(snap)
+    b = np.random.rand(5)
+    assert np.array_equal(a, b)
+    wire = json.loads(json.dumps(snap))    # survives the manifest
+    elastic.restore_rng(wire)
+    assert np.array_equal(np.random.rand(5), a)
+
+
+# --------------------------------------------- accumulation compensation --
+
+def test_accumulation_factor():
+    assert elastic.accumulation_factor(4, 2) == 2
+    assert elastic.accumulation_factor(2, 2) == 1
+    assert elastic.accumulation_factor(8, 1) == 8
+    with pytest.raises(ValueError, match="evenly"):
+        elastic.accumulation_factor(4, 3)
+    with pytest.raises(ValueError):
+        elastic.accumulation_factor(2, 0)
+
+
+def test_keep_global_batch_env(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC_KEEP_GLOBAL_BATCH", raising=False)
+    assert not elastic.keep_global_batch()
+    monkeypatch.setenv("MXNET_ELASTIC_KEEP_GLOBAL_BATCH", "1")
+    assert elastic.keep_global_batch()
+
+
+def test_accum_step_matches_plain_step_at_accum_1():
+    import jax.numpy as jnp
+    cfg = tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    mom = T.init_momentum(params)
+    tokens = jnp.asarray(np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (4, cfg.max_len)), jnp.int32)
+    plain = T.make_train_step(cfg, lr=0.1)
+    accum = elastic.make_accum_train_step(cfg, lr=0.1, accum=1)
+    # accum first: the plain step DONATES its inputs, the accum step
+    # deliberately does not (elastic capture needs them to survive)
+    p2, m2, l2 = accum(params, T.init_momentum(params), tokens[None])
+    p1, m1, l1 = plain(params, mom, tokens)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_accum_step_is_deterministic_and_averages():
+    import jax.numpy as jnp
+    cfg = tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    tokens = jnp.asarray(np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (2, 4, cfg.max_len)), jnp.int32)
+    step = elastic.make_accum_train_step(cfg, lr=0.1, accum=2)
+    p1, m1, l1 = step(params, T.init_momentum(params), tokens)
+    p2, m2, l2 = step(params, T.init_momentum(params), tokens)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the loss is the microbatch mean
+    lf = T.loss_fn(params, tokens[0], cfg, None)
+    ls = T.loss_fn(params, tokens[1], cfg, None)
+    np.testing.assert_allclose(float(l1),
+                               (float(lf) + float(ls)) / 2.0,
+                               rtol=1e-6)
+
+
+# ----------------------------------------------------------- supervisor --
+
+def _run_supervisor(tmp_path, script_body, n=2, max_restarts=3,
+                    extra=()):
+    """Drive tools/elastic_launch.py with a tiny scripted fake worker
+    (no jax import cost): the script decides its exit code from the
+    generation/world env."""
+    worker = tmp_path / "fake_worker.py"
+    worker.write_text("import os, sys, json\n"
+                      "g = int(os.environ['MXNET_ELASTIC_GENERATION'])\n"
+                      "w = int(os.environ['MXNET_TPU_NUM_PROC'])\n"
+                      "r = int(os.environ['MXNET_TPU_PROC_ID'])\n"
+                      "d = os.environ['MXNET_ELASTIC_DIR']\n"
+                      + script_body)
+    env = dict(os.environ, MXNET_ELASTIC_DIR=str(tmp_path / "sb"),
+               PYTHONPATH=ROOT)
+    env.pop("MXNET_CHAOS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "elastic_launch.py"),
+         "-n", str(n), "--max-restarts", str(max_restarts),
+         "--backoff-ms", "10", *extra,
+         "--", sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120, env=env)
+
+
+def test_supervisor_completes_clean_run(tmp_path):
+    r = _run_supervisor(tmp_path, "sys.exit(0)\n")
+    assert r.returncode == 0, r.stderr
+    assert "job complete" in r.stdout
+
+
+def test_supervisor_shrinks_on_44_and_finishes(tmp_path):
+    body = (
+        "sys.path.insert(0, %r)\n"
+        "from mxnet_tpu.parallel import elastic\n"
+        "if g == 0 and r == 0:\n"
+        "    elastic.write_shrink_record(d, 1, [0], [1], step=2)\n"
+        "    sys.exit(44)\n"
+        "if g == 0:\n"
+        "    sys.exit(31)\n"
+        "assert w == 1, w\n"
+        "sys.exit(0)\n" % ROOT)
+    r = _run_supervisor(tmp_path, body)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "shrink: survivors [0]" in r.stdout
+    assert "generation 1: world 1" in r.stdout
+
+
+def test_supervisor_regrows_at_boundary(tmp_path):
+    body = (
+        "sys.path.insert(0, %r)\n"
+        "from mxnet_tpu.parallel import elastic\n"
+        "if g == 0 and r == 0:\n"
+        "    elastic.write_shrink_record(d, 1, [0], [1], step=2)\n"
+        "    sys.exit(44)\n"
+        "if g == 0:\n"
+        "    sys.exit(31)\n"
+        "if g == 1:\n"
+        "    assert w == 1\n"
+        "    sys.exit(45)\n"          # boundary: work remaining
+        "assert w == 2, w\n"          # regrown
+        "sys.exit(0)\n" % ROOT)
+    r = _run_supervisor(tmp_path, body)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "regrow: world 1 -> 2" in r.stdout
+
+
+def test_supervisor_max_restarts_fails_loudly(tmp_path):
+    r = _run_supervisor(tmp_path, "sys.exit(7)\n", max_restarts=2)
+    assert r.returncode == 7
+    assert "crash-looping" in r.stderr
+    assert r.stdout.count("generation") >= 3   # 1 run + 2 restarts
+
+
+def test_supervisor_counts_watchdog_and_sigterm_restarts(tmp_path):
+    body = ("codes = {0: 43, 1: 143}\n"
+            "sys.exit(codes.get(g, 0))\n")
+    r = _run_supervisor(tmp_path, body, max_restarts=3)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "watchdog restart 1/3" in r.stdout
+    assert "sigterm restart 2/3" in r.stdout
+
+
+def test_supervisor_chaos_spec_scoped_to_one_generation(tmp_path):
+    body = ("spec = os.environ.get('MXNET_CHAOS')\n"
+            "if g == 0:\n"
+            "    assert spec == 'train.step:crash:at=0:rank=1', spec\n"
+            "    sys.exit(1)\n"
+            "assert spec is None, spec\n"
+            "sys.exit(0)\n")
+    r = _run_supervisor(tmp_path, body,
+                        extra=("--chaos-spec",
+                               "train.step:crash:at=0:rank=1"))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------ recovery metrics --
+
+def test_observe_recovery_histogram(tmp_path, monkeypatch):
+    from mxnet_tpu.observability import core as obs_core
+    from mxnet_tpu.observability import histogram as obs_hist
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_OBS", "1")
+    obs_core.reset()
+    obs_hist.reset()
+    elastic.write_shrink_record(d, 2, [0], [1], step=4,
+                                wall=time.time() - 1.5)
+    ms = elastic.observe_recovery(generation=2, d=d)
+    assert ms is not None and 1000.0 <= ms < 60000.0
+    st = obs_hist.states().get("elastic.time_to_recovery_ms")
+    assert st and st["count"] == 1
+    assert obs_core.counters()["elastic.restart"].value == 1
+    obs_core.reset()
+    obs_hist.reset()
+
+
+def test_observe_recovery_none_outside_recovery(tmp_path):
+    assert elastic.observe_recovery(generation=0,
+                                    d=str(tmp_path)) is None
+    assert elastic.observe_recovery(generation=3,
+                                    d=str(tmp_path)) is None
+
+
+# -------------------------------------------------- emergency satellites --
+
+_SIGINT_WORKER = """
+import os, signal, sys, time
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax.numpy as jnp
+from mxnet_tpu.models import transformer as T
+from mxnet_tpu.models.checkpoint import install_emergency_checkpoint
+cfg = T.TransformerConfig(vocab_size=41, d_model=16, n_heads=2,
+                          n_layers=1, d_ff=32, max_len=32,
+                          dtype=jnp.float32)
+params = T.init_params(cfg, seed=0)
+install_emergency_checkpoint(
+    sys.argv[1], lambda: {"cfg": cfg, "params": params, "step": 6})
+print("READY", flush=True)
+mode = sys.argv[2]
+if mode == "sigint":
+    os.kill(os.getpid(), signal.SIGINT)
+    time.sleep(30)
+    sys.exit(99)
+sys.exit(0)          # mode == atexit: fall off the end mid-run
+"""
+
+
+def test_sigint_emergency_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    r = subprocess.run(
+        [sys.executable, "-c", _SIGINT_WORKER % {"root": ROOT},
+         ck, "sigint"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 130, (r.returncode, r.stderr)
+    _, _, _, step, meta = C.load_checkpoint(ck)
+    assert step == 6 and meta["emergency"] == "sigint"
+
+
+def test_atexit_emergency_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    r = subprocess.run(
+        [sys.executable, "-c", _SIGINT_WORKER % {"root": ROOT},
+         ck, "atexit"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    _, _, _, step, meta = C.load_checkpoint(ck)
+    assert step == 6 and meta["emergency"] == "atexit"
+
+
+def test_install_prunes_stale_sideband(tmp_path, monkeypatch):
+    d = str(tmp_path / "sb")
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("MXNET_ELASTIC_DIR", d)
+    monkeypatch.setenv("MXNET_ELASTIC_GENERATION", "3")
+    old = time.time() - 60
+    elastic.write_heartbeat(d, 0, 1, wall=old)
+    elastic.write_generation(d, 3, 1)
+    cfg, params, _ = tiny_state()
+    try:
+        C.install_emergency_checkpoint(
+            ck, lambda: {"cfg": cfg, "params": params, "step": 0},
+            on_sigterm=False, on_sigint=False, on_watchdog=False,
+            atexit_pass=False)
+        assert not any(n.startswith("hb.g1")
+                       for n in os.listdir(d))
+    finally:
+        C.uninstall_emergency_checkpoint()
+
+
+# ------------------------------------------------------------ slow e2e --
+
+@pytest.mark.slow
+def test_two_process_kill_one_rank_e2e():
+    """The acceptance-criteria chain, via the canonical harness: a
+    2-process gloo run with one injected rank kill must shrink,
+    resume bit-exactly (vs a clean same-step world-1 run), regrow,
+    finish, and export the recovery histogram on the merged trace —
+    tools/chaos_smoke.py --elastic asserts each leg and exits 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_OBS="1",
+               PYTHONPATH=ROOT)
+    env.pop("MXNET_CHAOS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py"),
+         "--elastic"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    assert "elastic OK" in r.stdout

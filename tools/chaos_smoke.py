@@ -340,6 +340,131 @@ def crash():
     return 0
 
 
+def elastic():
+    """The elastic shrink-relaunch-resume chain, end to end on the CPU
+    mesh: a 2-process gloo job with one injected rank kill must (1)
+    shrink to world 1 and regrow to 2 under tools/elastic_launch.py,
+    (2) consume every sample exactly once across all generations
+    (cursor-exact), (3) produce a post-shrink loss trajectory
+    BIT-identical to a clean world-1 run resumed from the same shard
+    set, and (4) export the elastic.time_to_recovery_ms histogram on
+    the merged trace."""
+    import json
+    import re
+    import shutil
+
+    d = tempfile.mkdtemp(prefix="chaos_smoke_elastic_")
+    sb, ck = os.path.join(d, "sb"), os.path.join(d, "ck")
+    steps, rows = 6, 8
+    env = dict(os.environ)
+    env.update({
+        "MXNET_ELASTIC_DIR": sb,
+        "MXNET_ELASTIC_HEARTBEAT_S": "0.2",
+        "MXNET_ELASTIC_MISS": "3",
+        "MXNET_ELASTIC_KEEP_GLOBAL_BATCH": "1",
+        "MXNET_ELASTIC_KEEP_GENERATIONS": "8",
+        "MXNET_OBS": "1", "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+    })
+    env.pop("MXNET_CHAOS", None)
+    worker_py = os.path.join(ROOT, "examples", "elastic_training.py")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "elastic_launch.py"),
+         "-n", "2", "--max-restarts", "4", "--backoff-ms", "100",
+         "--chaos-spec", "train.step:crash:at=1:rank=1:code=31",
+         "--", sys.executable, worker_py, "--elastic-worker",
+         "--steps", str(steps), "--gen-steps", "2",
+         "--ckpt-dir", ck],
+        capture_output=True, text=True, timeout=540, env=env)
+    sys.stdout.write(r.stdout)
+    if r.returncode != 0:
+        print("[chaos_smoke] FAIL(elastic): supervisor rc=%d\n%s"
+              % (r.returncode, r.stderr[-2000:]))
+        return 1
+    out = r.stdout
+    if "-> shrink" not in out or "regrow: world 1 -> 2" not in out:
+        print("[chaos_smoke] FAIL(elastic): no shrink/regrow in the "
+              "supervisor log")
+        return 1
+
+    # (2) cursor-exact: the union of per-step DATA ranges must tile
+    # [0, steps*rows) exactly — zero skipped, zero replayed
+    ranges = {}
+    for m in re.finditer(r"^DATA g(\d+) r0 (\d+) (\d+) (\d+)$", out,
+                         re.M):
+        step, lo, hi = int(m.group(2)), int(m.group(3)), int(m.group(4))
+        if step in ranges and ranges[step] != (lo, hi):
+            print("[chaos_smoke] FAIL(elastic): step %d consumed both "
+                  "%s and %s" % (step, ranges[step], (lo, hi)))
+            return 1
+        ranges[step] = (lo, hi)
+    want = {s: ((s - 1) * rows, s * rows) for s in range(1, steps + 1)}
+    if ranges != want:
+        print("[chaos_smoke] FAIL(elastic): data ranges %s != %s"
+              % (ranges, want))
+        return 1
+
+    # (3) post-shrink bit-exactness: a clean world-1 run resumed from
+    # the SAME generation-1 shard set must reproduce g1's losses digit
+    # for digit
+    g1 = {int(m.group(1)): m.group(2) for m in re.finditer(
+        r"^LOSS g1 r0 (\d+) (\S+)$", out, re.M)}
+    if not g1:
+        print("[chaos_smoke] FAIL(elastic): no post-shrink LOSS lines")
+        return 1
+    clean_ck = os.path.join(d, "ck_clean")
+    shutil.copytree(ck, clean_ck)
+    env_clean = dict(env)
+    env_clean.update({
+        "MXNET_ELASTIC_DIR": os.path.join(d, "sb_clean"),
+        "MXNET_ELASTIC_GENERATION": "1",
+        "MXNET_ELASTIC_RESUME_GEN": "1",
+        "MXNET_ELASTIC_BASE_WORLD": "2",
+        "MXNET_TPU_NUM_PROC": "1", "MXNET_TPU_PROC_ID": "0",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    rc = subprocess.run(
+        [sys.executable, worker_py, "--elastic-worker",
+         "--steps", str(max(g1)), "--gen-steps", "0",
+         "--ckpt-dir", clean_ck],
+        capture_output=True, text=True, timeout=300, env=env_clean)
+    if rc.returncode != 0:
+        print("[chaos_smoke] FAIL(elastic): clean comparison run "
+              "rc=%d\n%s" % (rc.returncode, rc.stderr[-2000:]))
+        return 1
+    clean = {int(m.group(1)): m.group(2) for m in re.finditer(
+        r"^LOSS g1 r0 (\d+) (\S+)$", rc.stdout, re.M)}
+    if any(clean.get(s) != g1[s] for s in g1):
+        print("[chaos_smoke] FAIL(elastic): post-shrink trajectory "
+              "diverged from the clean same-step run:\n  elastic %s\n"
+              "  clean   %s" % (g1, clean))
+        return 1
+
+    # (4) recovery-time histogram on the merged trace of the recovered
+    # generation
+    from mxnet_tpu.observability import dist
+    base = os.path.join(sb, "trace-g1.json")
+    if not os.path.exists(base):
+        print("[chaos_smoke] FAIL(elastic): no generation-1 trace at "
+              "%s" % base)
+        return 1
+    merged = dist.merge_traces(base, out=os.path.join(d, "merged.json"))
+    hist = merged.get("otherData", {}).get("histograms", {}).get(
+        "elastic.time_to_recovery_ms", {})
+    if not hist.get("count"):
+        print("[chaos_smoke] FAIL(elastic): merged trace lacks the "
+              "elastic.time_to_recovery_ms histogram (%s)"
+              % json.dumps(list(merged.get("otherData", {})
+                                .get("histograms", {}))))
+        return 1
+    print("[chaos_smoke] elastic OK: kill -> shrink(44) -> bit-exact "
+          "world-1 resume -> regrow(45) -> done; %d/%d samples "
+          "cursor-exact, time_to_recovery_ms count=%d mean=%.0fms"
+          % (steps * rows, steps * rows, hist["count"],
+             hist.get("sum", 0.0) / max(hist["count"], 1)))
+    return 0
+
+
 SCENARIOS = [("nan", nan_guard), ("ioerror", ioerror),
              ("serving", serving), ("hang", hang),
              ("sigterm", sigterm), ("crash", crash)]
@@ -350,12 +475,20 @@ def main():
     p.add_argument("args", nargs="*")
     p.add_argument("--only", help="run one scenario (%s)"
                    % "/".join(n for n, _ in SCENARIOS))
+    p.add_argument("--elastic", action="store_true",
+                   help="run the elastic shrink/regrow e2e (2-process "
+                        "gloo; its own tier-1 lane invocation)")
     args = p.parse_args()
     worker = os.environ.get("CHAOS_SMOKE_WORKER")
     if worker == "hang":
         return hang_worker(args.args[0])
     if worker == "train":
         return train_worker(args.args[0], int(args.args[1]))
+    if args.elastic:
+        if elastic():
+            print("[chaos_smoke] elastic scenario FAILED")
+            return 1
+        return 0
     failures = 0
     for name, fn in SCENARIOS:
         if args.only and name != args.only:

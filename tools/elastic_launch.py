@@ -1,0 +1,216 @@
+"""Elastic supervisor: launch, watch, shrink, regrow, resume.
+
+The other half of ``mxnet_tpu/parallel/elastic.py``: a generation-based
+restart loop around a multi-process SPMD job. Workers run one
+*generation* of training; the supervisor interprets how each generation
+ends and relaunches accordingly — at a smaller world after a
+coordinated shrink, at full strength at the next boundary (regrow), or
+as a plain capped restart after a crash.
+
+    python tools/elastic_launch.py -n 2 --max-restarts 6 \
+        python examples/elastic_training.py --elastic-worker --steps 6
+
+Exit-code taxonomy (the worker side of the contract — documented in
+docs/ROBUSTNESS.md "Elastic recovery"):
+
+    0    generation finished AND the job is complete -> supervisor exits 0
+    43   watchdog abort (MXNET_OBS_WATCHDOG_ACTION): a collective hung;
+         an emergency checkpoint may have committed -> counted restart,
+         relaunch at generation g+1, same world
+    44   coordinated elastic shrink: survivors captured their shard
+         checkpoints and the g+1 shrink record names the new world ->
+         counted restart, relaunch at generation g+1 with the survivors
+    45   generation boundary, work remaining: a clean hand-back so a
+         recovered host can rejoin -> NOT counted, relaunch at g+1
+         regrown to the full world (unless --no-regrow)
+    143  SIGTERM (preemption): emergency checkpoint committed ->
+         counted restart, relaunch at g+1, same world
+    else hard crash (SIGKILL/OOM/bug) -> counted restart with
+         exponential backoff + jitter, relaunch at g+1, same world
+
+``--max-restarts`` bounds the COUNTED restarts: a crash-looping job
+fails loudly (the last failing code) instead of spinning forever.
+
+Workers rendezvous through the ``MXNET_TPU_*`` env this supervisor
+exports (the tools/launch.py contract) plus the elastic sideband:
+``MXNET_ELASTIC_DIR``, ``MXNET_ELASTIC_GENERATION`` and
+``MXNET_ELASTIC_BASE_WORLD`` (the full world, so
+``MXNET_ELASTIC_KEEP_GLOBAL_BATCH=1`` workers can compute their
+gradient-accumulation factor after a shrink).
+"""
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+import time
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from mxnet_tpu.parallel import elastic  # noqa: E402
+
+
+def worker_env(args, proc_id, world, generation):
+    env = dict(os.environ)
+    if args.chaos_spec is not None:
+        # the replayable kill-one-rank site: the spec reaches ONLY the
+        # targeted generation's workers, so an occurrence-counted rule
+        # (chaos counters are per-process) cannot re-fire after the
+        # relaunch and turn one injected failure into a crash loop
+        if generation == args.chaos_generation:
+            env["MXNET_CHAOS"] = args.chaos_spec
+        else:
+            env.pop("MXNET_CHAOS", None)
+    env.update({
+        "MXNET_TPU_NUM_PROC": str(world),
+        "MXNET_TPU_PROC_ID": str(proc_id),
+        "MXNET_ELASTIC_DIR": args.elastic_dir,
+        "MXNET_ELASTIC_GENERATION": str(generation),
+        "MXNET_ELASTIC_BASE_WORLD": str(args.num_workers),
+        # local virtual-device contract (tools/launch.py): one CPU
+        # device per process so collectives run without hardware
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.setdefault("XLA_FLAGS",
+                   "--xla_force_host_platform_device_count=1")
+    if world > 1:
+        # fresh port per generation: the previous generation's gloo
+        # coordinator socket may still be in TIME_WAIT
+        port = args.base_port + generation % 101
+        env["MXNET_TPU_COORDINATOR"] = "127.0.0.1:%d" % port
+    else:
+        env.pop("MXNET_TPU_COORDINATOR", None)
+    return env
+
+
+def run_generation(args, world, generation):
+    """Launch one generation's workers and collect their exit codes."""
+    elastic.write_generation(
+        args.elastic_dir, generation, world,
+        base_world=args.num_workers, since_wall=args._since_wall)
+    print("[elastic_launch] generation %d: world %d%s"
+          % (generation, world,
+             " (shrunk from %d)" % args.num_workers
+             if world < args.num_workers else ""), flush=True)
+    procs = [subprocess.Popen(args.command,
+                              env=worker_env(args, i, world, generation))
+             for i in range(world)]
+    return [p.wait() for p in procs]
+
+
+def classify(codes):
+    """The generation verdict, in precedence order."""
+    if all(c == 0 for c in codes):
+        return "done"
+    if elastic.SHRINK_EXIT_CODE in codes:
+        return "shrink"
+    if all(c in (0, elastic.BOUNDARY_EXIT_CODE) for c in codes):
+        return "boundary"
+    if 43 in codes:
+        return "watchdog"
+    if 143 in codes:
+        return "sigterm"
+    return "crash"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="elastic restart supervisor",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="full world size (the regrow target)")
+    ap.add_argument("--max-restarts", type=int, default=8,
+                    help="counted restarts before failing loudly")
+    ap.add_argument("--backoff-ms", type=float, default=200.0,
+                    help="initial crash-restart backoff (doubles, "
+                         "+ up to 50%% jitter, capped at 30 s)")
+    ap.add_argument("--no-regrow", action="store_true",
+                    help="stay at the shrunk world at boundaries")
+    ap.add_argument("--elastic-dir", default=None,
+                    help="rendezvous sideband directory (default: "
+                         "$MXNET_ELASTIC_DIR, else ./elastic_sideband)")
+    ap.add_argument("--base-port", type=int, default=8476,
+                    help="gloo coordinator base port (per-generation "
+                         "offset applied)")
+    ap.add_argument("--start-generation", type=int, default=0)
+    ap.add_argument("--chaos-spec", default=None,
+                    help="MXNET_CHAOS spec delivered ONLY to "
+                         "--chaos-generation's workers (replayable "
+                         "one-shot fault injection)")
+    ap.add_argument("--chaos-generation", type=int, default=0)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no worker command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    args.elastic_dir = (args.elastic_dir
+                        or os.environ.get("MXNET_ELASTIC_DIR")
+                        or os.path.abspath("elastic_sideband"))
+    os.makedirs(args.elastic_dir, exist_ok=True)
+
+    world = args.num_workers
+    generation = args.start_generation
+    restarts = 0
+    last_bad = 1
+    args._since_wall = None
+    while True:
+        codes = run_generation(args, world, generation)
+        verdict = classify(codes)
+        print("[elastic_launch] generation %d exited %s -> %s"
+              % (generation, codes, verdict), flush=True)
+        if verdict == "done":
+            print("[elastic_launch] job complete after %d generation(s)"
+                  ", %d counted restart(s)"
+                  % (generation + 1, restarts), flush=True)
+            return 0
+        args._since_wall = time.time()
+        if verdict == "boundary":
+            # clean hand-back: the recovered host rejoins here
+            new_world = args.num_workers if not args.no_regrow else world
+            if new_world > world:
+                print("[elastic_launch] regrow: world %d -> %d"
+                      % (world, new_world), flush=True)
+            world = new_world
+            generation += 1
+            continue
+        restarts += 1
+        last_bad = next((c for c in codes if c != 0), 1)
+        if restarts > args.max_restarts:
+            print("[elastic_launch] FAIL: %d restarts exceeded "
+                  "--max-restarts %d — the job is crash-looping, not "
+                  "recovering (last codes %s)"
+                  % (restarts, args.max_restarts, codes),
+                  file=sys.stderr, flush=True)
+            return last_bad
+        if verdict == "shrink":
+            rec = elastic.read_shrink_record(args.elastic_dir,
+                                             generation + 1)
+            if rec is None:
+                print("[elastic_launch] shrink exit without a shrink "
+                      "record — treating as a crash restart",
+                      file=sys.stderr, flush=True)
+                verdict = "crash"
+            else:
+                world = int(rec["world"])
+                print("[elastic_launch] shrink: survivors %s resume "
+                      "from step %d at world %d"
+                      % (rec["survivors"], rec["step"], world),
+                      flush=True)
+                generation += 1
+                continue
+        # watchdog / sigterm / crash: capped exponential backoff with
+        # jitter so N supervisors never stampede a shared resource
+        delay = min(args.backoff_ms * (2 ** (restarts - 1)), 30000.0)
+        delay *= 1.0 + 0.5 * random.random()
+        print("[elastic_launch] %s restart %d/%d in %.0f ms"
+              % (verdict, restarts, args.max_restarts, delay),
+              flush=True)
+        time.sleep(delay / 1e3)
+        generation += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
