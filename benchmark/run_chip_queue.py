@@ -30,6 +30,7 @@ probe), with a timeout; failures are recorded and the queue continues.
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -108,6 +109,14 @@ QUEUE = [
     ("serving_paged",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--paged"]}, 1800, False),
+    # batched speculative decoding A/B: n-gram self-drafting at k=4
+    # verifies every lane's [k+1] window in one ragged target pass, so
+    # target dispatches per emitted token fall with acceptance (CPU
+    # smoke cut them >= 1.5x on repetitive text; docs/SERVING.md
+    # "Speculative decoding")
+    ("serving_spec",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--spec-k", "4"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",
@@ -227,30 +236,75 @@ def run_leg(name, spec, timeout):
         with open(os.path.join(ROOT, spec["stdin"])) as f:
             script = f.read()
         argv = [sys.executable, "-"] + spec.get("args", [])
-        kwargs = {"input": script}
+        stdin_text = script
     else:
         argv = spec["argv"]
-        kwargs = {}
+        stdin_text = None
     t0 = time.time()
-    try:
-        r = subprocess.run(argv, cwd=ROOT, env=env, timeout=timeout,
-                           capture_output=True, text=True, **kwargs)
-        ok = r.returncode == 0
-        out = r.stdout[-4000:]
-        err = "" if ok else r.stderr[-1500:]
-    except subprocess.TimeoutExpired as e:
+    rc, out, err, timed_out = _run_leg_proc(argv, env, timeout,
+                                            stdin_text)
+    ok = rc == 0 and not timed_out
+    if timed_out:
         # keep whatever the leg printed before the kill — that partial
         # output may be the only data from a tunnel-alive window
-        def _txt(v):
-            if isinstance(v, bytes):
-                return v.decode(errors="replace")
-            return v or ""
-        ok = False
-        out = _txt(e.stdout)[-4000:]
-        err = (_txt(e.stderr)[-1200:] +
-               "\ntimeout after %ds" % timeout).strip()
+        out = out[-4000:]
+        err = (err[-1200:] +
+               "\ntimeout after %ds (process group killed)"
+               % timeout).strip()
+    else:
+        out = out[-4000:]
+        err = "" if ok else err[-1500:]
     return {"leg": name, "ok": ok, "seconds": round(time.time() - t0, 1),
             "ts": round(time.time(), 1), "stdout": out, "stderr": err}
+
+
+# how long the post-kill drain waits for the pipes to close before
+# abandoning them — generous for a flush, far below a leg timeout
+_DRAIN_GRACE_S = 30.0
+
+
+def _run_leg_proc(argv, env, timeout, stdin_text=None):
+    """Run one leg wedge-proof. subprocess.run(timeout=...) is not:
+    its timeout kills the LEG, then blocks in an UNBOUNDED
+    communicate() draining pipes any grandchild (the tunnel helper the
+    leg spawned) still holds open — BENCH_r05 hung exactly there,
+    hours past its per-leg timeout, with the queue state frozen on
+    RUNNING. Three changes close the hole: the leg gets its own
+    process group (start_new_session), the timeout kills the whole
+    group, and the post-kill drain is itself bounded — if some orphan
+    keeps a pipe fd past the grace period, we keep the partial output
+    and abandon the fds instead of the run.
+
+    Returns (returncode-or-None, stdout, stderr, timed_out)."""
+    def _txt(v):
+        if isinstance(v, bytes):
+            return v.decode(errors="replace")
+        return v or ""
+
+    proc = subprocess.Popen(
+        argv, cwd=ROOT, env=env, text=True,
+        stdin=subprocess.PIPE if stdin_text is not None else None,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(input=stdin_text, timeout=timeout)
+        return proc.returncode, _txt(out), _txt(err), False
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)   # pgid == pid (own
+        except OSError:                           # session)
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=_DRAIN_GRACE_S)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            out, err = exc.stdout, exc.stderr
+            for stream in (proc.stdout, proc.stderr, proc.stdin):
+                try:
+                    if stream:
+                        stream.close()
+                except OSError:
+                    pass
+        return None, _txt(out), _txt(err), True
 
 
 def _load_table(path, max_age_h=None):
@@ -364,6 +418,24 @@ def run_pending(args, table, probe):
                 % (name, timeout))
         res = run_leg(name, spec, timeout)
         res["attempts"] = (prior or {}).get("attempts", 0) + 1
+        if (not res["ok"] and not _looks_wedged(res)
+                and res["attempts"] < args.max_attempts):
+            # one immediate in-pass retry for non-wedge failures
+            # (claim-release lag, a transient OOM): the first failure
+            # is RECORDED in the row — and checkpointed — before the
+            # retry runs, so a crash mid-retry cannot erase the
+            # evidence, and a retry success still shows what happened
+            res["first_failure"] = {
+                "seconds": res["seconds"], "ts": res["ts"],
+                "stderr": res["stderr"][-600:]}
+            table[name] = res
+            _save_table(args.out, table)
+            _status("RETRYING %s after failure (attempt %d/%d)"
+                    % (name, res["attempts"] + 1, args.max_attempts))
+            retry = run_leg(name, spec, timeout)
+            retry["attempts"] = res["attempts"] + 1
+            retry["first_failure"] = res["first_failure"]
+            res = retry
         print(res["stdout"], flush=True)
         if res["stderr"]:
             print(res["stderr"], file=sys.stderr, flush=True)

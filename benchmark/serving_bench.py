@@ -43,6 +43,16 @@ emulated at ~2x the compute cost, and that emulation tax drowns the
 host-side round-trip effect the A/B exists to measure (on TPU, where
 bf16 is native, the leg keeps the serving default dtype).
 
+With ``--spec-k K`` it runs the BATCHED speculative-decoding A/B
+instead: the same request pool through the plain batcher vs spec_k=K
+n-gram self-drafting, on two workloads — repetitive (templated
+prompts, the prompt-lookup habitat) and adversarial (uniform-random
+prompts, where drafts mostly miss and the MXNET_SPEC_ACCEPT_FLOOR
+controller walks per-lane k down). Streams are bit-identical (tested);
+what changes is the TARGET-DISPATCHES-PER-EMITTED-TOKEN column — the
+round-trip count a wedged-tunnel chip pays per token — plus the
+measured acceptance rate and the live adaptive-k floor.
+
 After the throughput legs, the continuous-batching pools run once more
 INSTRUMENTED (MXNET_OBS forced on for that run only) to print the
 request-level TTFT / ITL / e2e / queue-wait percentile table from the
@@ -52,6 +62,7 @@ archive), and — with ``--json PATH`` — write them as an artifact file.
 
     python - < benchmark/serving_bench.py
     python - --pipeline-depth 2 < benchmark/serving_bench.py
+    python - --spec-k 4 < benchmark/serving_bench.py
     python - --json serving_latency.json < benchmark/serving_bench.py
     MXNET_SERVING_SMOKE=1 JAX_PLATFORMS=cpu python - < benchmark/serving_bench.py
 
@@ -90,6 +101,17 @@ def _pipeline_depth_arg(argv=None):
         if a == "--pipeline-depth" and i + 1 < len(argv):
             return int(argv[i + 1])
         if a.startswith("--pipeline-depth="):
+            return int(a.split("=", 1)[1])
+    return None
+
+
+def _spec_k_arg(argv=None):
+    """--spec-k K from the stdin-run argv; None when absent."""
+    argv = sys.argv[1:] if argv is None else argv
+    for i, a in enumerate(argv):
+        if a == "--spec-k" and i + 1 < len(argv):
+            return int(argv[i + 1])
+        if a.startswith("--spec-k="):
             return int(a.split("=", 1)[1])
     return None
 
@@ -235,6 +257,113 @@ def pipeline_ab(depth):
                           "continuous_pipeline_ab",
                           pipeline_depth=depth, chunk=chunk,
                           slots=slots, backend=backend)
+    _write_artifact(_json_arg(), [rep])
+
+
+def spec_ab(k):
+    """The batched-speculation A/B (see the module docstring): the
+    same request pool through the plain batcher vs spec_k=k n-gram
+    self-drafting, repetitive AND adversarial workloads, one JSON row
+    per leg. The headline column is target dispatches per emitted
+    token — on a chip behind a ~15 ms tunnel every dispatch is a
+    round trip, so that ratio IS the latency lever speculation pulls."""
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+
+    backend = jax.default_backend()
+    if SMOKE:
+        # unlike pipeline_ab, the headline column here is a DISPATCH
+        # COUNT ratio — timing-independent, so the compute-honesty
+        # vocab sizing doesn't bind. What the leg does need is a
+        # verified stream with real repetition: d_model 16 gives the
+        # random-init smoke model a strong enough greedy attractor
+        # that its own rollouts stand in for repetitive text
+        vocab = 8192
+        d_model, heads, layers, max_len = 16, 2, 1, 96
+        t_prompt, n_new, n_jobs, slots, chunk = 24, 64, 4, 2, 1
+    else:
+        vocab = 32000
+        d_model, heads, layers, max_len = 512, 8, 8, 4096
+        t_prompt, n_new, n_jobs, slots = 512, 128, 16, 8
+        chunk = int(os.environ.get("MXNET_SERVE_CHUNK", "16"))
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    cfg = tf.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=dtype)
+    params = tf.init_params(cfg, seed=0)
+    jrng = np.random.RandomState(3)
+    # repetitive: each prompt is a window of the MODEL'S OWN greedy
+    # rollout — the serve-continuation / quoted-context shape, where
+    # the continuation's n-grams already occur in the prompt. This is
+    # prompt-lookup drafting's habitat (code, templated output,
+    # re-served context in the real world)
+    rep_jobs = []
+    for _ in range(n_jobs):
+        seed = list(jrng.randint(1, vocab, 6))
+        stream = np.asarray(tf.generate(
+            params, jnp.asarray([seed], jnp.int32), t_prompt + 10,
+            cfg, greedy=True)[0])
+        rep_jobs.append((list(stream[-t_prompt:]), n_new))
+    adv_jobs = [(list(jrng.randint(1, vocab, t_prompt)), n_new)
+                for _ in range(n_jobs)]
+    total_new = n_jobs * n_new
+    print("serving speculative A/B: backend=%s dtype=%s d_model=%d "
+          "layers=%d k=%d chunk=%d slots=%d jobs=%d"
+          % (backend, np.dtype(dtype).name, d_model, layers, k,
+             chunk, slots, n_jobs), flush=True)
+
+    def run(jobs, **kw):
+        srv = ContinuousBatcher(params, cfg, max_batch=slots,
+                                chunk_size=chunk, **kw)
+        pending = list(jobs)
+        k_live = float(k)
+        while pending or srv.active_count:
+            while pending and srv.has_capacity:
+                p, n = pending.pop(0)
+                srv.admit(p, n)
+            srv.step()
+            if srv._spec_on and srv.active_count:
+                # adaptive-k low-water mark, read while lanes are LIVE
+                # (finish resets a lane's k back to spec_k)
+                k_live = min(k_live, srv.health_snapshot()
+                             ["serving.spec_k_live"])
+        return srv, k_live
+
+    def leg(name, jobs, **kw):
+        run(jobs, **kw)                       # compile / warm
+        t0 = time.time()
+        srv, k_live = run(jobs, **kw)
+        rate = total_new / (time.time() - t0)
+        dpt = srv.dispatch_count / total_new  # dispatches per token
+        snap = srv.health_snapshot()
+        row = {"leg": "serving_spec_ab", "workload": name,
+               "spec_k": kw.get("spec_k", 0),
+               "tokens_per_s": round(rate, 1),
+               "target_dispatches_per_token": round(dpt, 3),
+               "accept_rate": round(
+                   snap.get("serving.spec_draft_ratio", 0.0), 3),
+               "spec_k_live_min": k_live if kw.get("spec_k") else None,
+               "slots": slots, "jobs": n_jobs, "vocab": vocab,
+               "backend": backend}
+        print(json.dumps(row), flush=True)
+        return row
+
+    base = leg("repetitive", rep_jobs)
+    spec = leg("repetitive", rep_jobs, spec_k=k)
+    leg("adversarial", adv_jobs, spec_k=k, spec_accept_floor=0.6)
+    cut = (base["target_dispatches_per_token"]
+           / spec["target_dispatches_per_token"])
+    print('{"leg": "serving_spec_ab_summary", "spec_k": %d, '
+          '"dispatch_cut": %.2f}' % (k, cut), flush=True)
+    rep = _latency_report(lambda: run(rep_jobs, spec_k=k),
+                          "serving_spec_ab", spec_k=k, slots=slots,
+                          backend=backend)
     _write_artifact(_json_arg(), [rep])
 
 
@@ -536,8 +665,11 @@ def main():
 
 if __name__ == "__main__":
     _depth = _pipeline_depth_arg()
+    _spec = _spec_k_arg()
     if _depth is not None:
         pipeline_ab(_depth)
+    elif _spec is not None:
+        spec_ab(_spec)
     elif "--paged" in sys.argv[1:]:
         paged_ab()
     else:

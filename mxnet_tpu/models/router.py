@@ -254,6 +254,15 @@ class ReplicaRouter(object):
         if _obs.enabled():
             _obs.gauge("router.queue_depth").set(len(self._queue))
             _obs.gauge("router.replicas_alive").set(self.alive_count)
+            # fleet-wide speculative health: the WORST alive replica's
+            # acceptance ratio (the one an operator would retune
+            # spec_k for) — absent when no replica speculates
+            ratios = [
+                r.health_snapshot().get("serving.spec_draft_ratio")
+                for i, r in enumerate(self.replicas) if self._alive[i]]
+            ratios = [x for x in ratios if x is not None]
+            if ratios:
+                _obs.gauge("router.spec_accept_ratio").set(min(ratios))
         return finished
 
     def run(self, requests):

@@ -48,11 +48,27 @@ Design notes (all static-shape, XLA-friendly):
   GQA, chunking, pipelining (the carry holds pool + tables), and the
   dispatch-failure requeue path.
 
+* SPECULATIVE dispatches (spec_k / MXNET_SPEC_K): every decode round
+  drafts k tokens per lane — from a small draft model or, by default,
+  n-gram prompt-lookup against the lane's own stream — then verifies
+  all lanes' [k+1] windows in ONE ragged target pass
+  (tf.verify_chunk / verify_chunk_paged) with device-side cumprod
+  acceptance, so the accepted prefix + one free token land per lane
+  per dispatch (1..k+1 tokens instead of exactly 1). Rejected cache
+  writes heal by position (`attention <= pos`, as everywhere above);
+  paged block tables advance by ACCEPTED counts with worst-case draft
+  blocks released at sync; pipelining keeps depth speculative
+  dispatches in flight; a per-lane adaptive-k controller
+  (MXNET_SPEC_ACCEPT_FLOOR) shrinks the draft width where measured
+  acceptance is poor. Greedy-only, and bit-exact vs solo generate()
+  — the accept test IS the target argmax.
+
 Greedy decoding (the serving default); sampling per-row is a
 straightforward extension (thread a per-slot PRNG key through step()).
 Weight-only int8 trees (quantize_weights_int8) pass through unchanged.
 """
 
+import dataclasses
 import time
 from collections import deque
 
@@ -372,6 +388,209 @@ def _jitted_table_entry(cfg):
         donate_argnums=tf._serving_donate(0)))
 
 
+# ---- speculative-decoding compiled programs ----------------------------
+# Batched draft/verify/accept: each round proposes k tokens per lane,
+# verifies every lane's [k+1] window in ONE ragged target pass
+# (tf.verify_chunk / verify_chunk_paged), and rolls each lane forward by
+# its own accepted count — per-lane acceptance is the _spec_core cumprod
+# prefix-match, computed on device. Rejected cache entries heal by
+# position exactly as the solo path documents (the next window starts at
+# the first rejected position and rewrites everything it will attend).
+
+# smoothing of the per-lane measured-acceptance EWMA the adaptive-k
+# controller compares against MXNET_SPEC_ACCEPT_FLOOR
+_SPEC_EWMA_ALPHA = 0.3
+
+
+def _ngram_propose(hist, tok, pos, keff, k, ng):
+    """Prompt-lookup self-drafting (device-side, static-shape): for
+    each lane, find the LATEST earlier occurrence of the ng-token
+    suffix ending at the lane's current token and propose the k tokens
+    that followed it — drawn from the lane's OWN stream history
+    (`hist[b, :pos[b]+1]` is prompt + emissions, `hist[b, pos[b]] ==
+    tok[b]`). No second model; repetitive text (code, quoted context,
+    templated output) is where it pays. Lanes with no match, or a
+    match whose continuation runs off the known stream, fall back to
+    repeating the current token (right on runs, rejected otherwise —
+    never a correctness question, the verify pass decides every
+    emission). Draft slots at or past keff[b] are masked to the -1
+    sentinel, which no vocab id equals — that is how the per-lane
+    adaptive k shrinks the effective draft length inside one
+    static-width program."""
+    b, hl = hist.shape
+    j = jnp.arange(hl)
+    sidx = jnp.clip(pos[:, None] - (ng - 1) + jnp.arange(ng)[None],
+                    0, hl - 1)
+    suffix = jnp.take_along_axis(hist, sidx, axis=1)         # [B, ng]
+    m = jnp.ones((b, hl), bool)
+    for o in range(ng):                    # ng is tiny and static
+        m = m & (jnp.roll(hist, -o, axis=1) == suffix[:, o:o + 1])
+    # a candidate must END strictly before the suffix's own end — this
+    # both excludes the trivial self-match and keeps roll()'s
+    # wrap-around columns out of range
+    valid = (j[None, :] + ng - 1) < pos[:, None]
+    best = jnp.max(jnp.where(m & valid, j[None, :], -1), axis=1)
+    gidx = best[:, None] + ng + jnp.arange(k)[None]          # [B, k]
+    cand = jnp.take_along_axis(hist, jnp.clip(gidx, 0, hl - 1), axis=1)
+    usable = (best[:, None] >= 0) & (gidx <= pos[:, None])
+    drafts = jnp.where(usable, cand, tok[:, None])
+    return jnp.where(jnp.arange(k)[None] < keff[:, None], drafts, -1)
+
+
+def _jitted_spec_chunk(cfg, dcfg, k, ng, rounds, paged, use_model):
+    """`rounds` speculative rounds as ONE compiled program — the
+    dispatch unit of the speculative batcher, shaped like the
+    pipelined chunk so the same in-flight window applies: the carry
+    (cache/pool [+ draft cache/pool or n-gram history], lane tokens,
+    positions) stays device-resident and is donated; the only outputs
+    the host ever fetches are the per-round verified targets
+    [rounds, B, k+1] and emit counts [rounds, B] (emit = accepted + 1:
+    the verify logits always yield one token beyond the accepted
+    prefix, so every round advances every lane — speculation can never
+    be slower than stepping in tokens per dispatch). Greedy only; the
+    batcher enforces that at construction."""
+    kk = k + 1
+
+    def build(fz):
+        def accept(drafts, target):
+            # _spec_core's acceptance, batched: count the matching
+            # draft prefix per lane, emit it plus the one free token,
+            # and the lane's new current token is target[acc]
+            acc = jnp.cumprod(
+                (drafts == target[:, :k]).astype(jnp.int32),
+                axis=1).sum(axis=1)
+            emit = acc + 1
+            tok = jnp.take_along_axis(target, acc[:, None],
+                                      axis=1)[:, 0]
+            return emit, tok
+
+        def hist_update(hist, target, emit, pos):
+            # masked lane-buffer write: only the ACCEPTED window
+            # prefix enters the stream history (positions past
+            # max_len, and rejected slots, drop)
+            rows = jnp.arange(hist.shape[0])[:, None]
+            hpos = pos[:, None] + 1 + jnp.arange(kk)[None]
+            keep = jnp.arange(kk)[None] < emit[:, None]
+            safe = jnp.where(keep, hpos, fz.max_len + kk)
+            return hist.at[rows, safe].set(target, mode="drop")
+
+        if not use_model and not paged:
+            def chunk(params, cache, hist, tok, pos, keff):
+                def body(carry, _):
+                    cache, hist, tok, pos = carry
+                    drafts = _ngram_propose(hist, tok, pos, keff, k, ng)
+                    window = jnp.concatenate(
+                        [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
+                    logits, cache = tf.verify_chunk(
+                        params, cache, window, pos, fz)
+                    target = jnp.argmax(logits, axis=-1) \
+                        .astype(jnp.int32)
+                    emit, tok = accept(drafts, target)
+                    hist = hist_update(hist, target, emit, pos)
+                    return (cache, hist, tok, pos + emit), \
+                        (target, emit)
+                (cache, hist, tok, pos), (targets, emits) = \
+                    jax.lax.scan(body, (cache, hist, tok, pos), None,
+                                 length=rounds)
+                return targets, emits, cache, hist, tok, pos
+            donate = tf._serving_donate(1, 2, 3, 4)
+        elif not use_model:
+            def chunk(params, pool, tables, hist, tok, pos, keff):
+                def body(carry, _):
+                    pool, hist, tok, pos = carry
+                    drafts = _ngram_propose(hist, tok, pos, keff, k, ng)
+                    window = jnp.concatenate(
+                        [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
+                    logits, pool = tf.verify_chunk_paged(
+                        params, pool, tables, window, pos, fz)
+                    target = jnp.argmax(logits, axis=-1) \
+                        .astype(jnp.int32)
+                    emit, tok = accept(drafts, target)
+                    hist = hist_update(hist, target, emit, pos)
+                    return (pool, hist, tok, pos + emit), \
+                        (target, emit)
+                (pool, hist, tok, pos), (targets, emits) = \
+                    jax.lax.scan(body, (pool, hist, tok, pos), None,
+                                 length=rounds)
+                return targets, emits, pool, hist, tok, pos
+            donate = tf._serving_donate(1, 3, 4, 5)
+        elif not paged:
+            def chunk(params, dparams, cache, dcache, tok, pos, keff):
+                def body(carry, _):
+                    cache, dcache, tok, pos = carry
+                    def dstep(c, i):
+                        dc, t = c
+                        dl, dc = tf.decode_step(dparams, dc, t,
+                                                pos + i, dcfg)
+                        nxt = jnp.argmax(dl, axis=-1) \
+                            .astype(jnp.int32)
+                        return (dc, nxt), nxt
+                    (dcache, _), seq = jax.lax.scan(
+                        dstep, (dcache, tok), jnp.arange(k))
+                    drafts = jnp.where(
+                        jnp.arange(k)[None] < keff[:, None],
+                        seq.T, -1)
+                    window = jnp.concatenate(
+                        [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
+                    logits, cache = tf.verify_chunk(
+                        params, cache, window, pos, fz)
+                    target = jnp.argmax(logits, axis=-1) \
+                        .astype(jnp.int32)
+                    emit, tok = accept(drafts, target)
+                    return (cache, dcache, tok, pos + emit), \
+                        (target, emit)
+                (cache, dcache, tok, pos), (targets, emits) = \
+                    jax.lax.scan(body, (cache, dcache, tok, pos), None,
+                                 length=rounds)
+                return targets, emits, cache, dcache, tok, pos
+            donate = tf._serving_donate(2, 3, 4, 5)
+        else:
+            def chunk(params, dparams, pool, dpool, tables, tok, pos,
+                      keff):
+                def body(carry, _):
+                    pool, dpool, tok, pos = carry
+                    def dstep(c, i):
+                        dc, t = c
+                        dl, dc = tf.decode_step_paged(
+                            dparams, dc, tables, t, pos + i, dcfg)
+                        nxt = jnp.argmax(dl, axis=-1) \
+                            .astype(jnp.int32)
+                        return (dc, nxt), nxt
+                    (dpool, _), seq = jax.lax.scan(
+                        dstep, (dpool, tok), jnp.arange(k))
+                    drafts = jnp.where(
+                        jnp.arange(k)[None] < keff[:, None],
+                        seq.T, -1)
+                    window = jnp.concatenate(
+                        [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
+                    logits, pool = tf.verify_chunk_paged(
+                        params, pool, tables, window, pos, fz)
+                    target = jnp.argmax(logits, axis=-1) \
+                        .astype(jnp.int32)
+                    emit, tok = accept(drafts, target)
+                    return (pool, dpool, tok, pos + emit), \
+                        (target, emit)
+                (pool, dpool, tok, pos), (targets, emits) = \
+                    jax.lax.scan(body, (pool, dpool, tok, pos), None,
+                                 length=rounds)
+                return targets, emits, pool, dpool, tok, pos
+            donate = tf._serving_donate(2, 3, 5, 6)
+        return jax.jit(chunk, donate_argnums=donate)
+
+    key = ("spec_chunk", k, ng, rounds, paged, use_model,
+           dataclasses.astuple(dcfg) if use_model else None)
+    return tf._serving_jit(key, cfg, build)
+
+
+def _jitted_hist_row(cfg):
+    """Replace lane i's stream-history row (the n-gram drafting state)
+    at admission/requeue — the hist twin of the lane patch, sequenced
+    after the in-flight dispatches like every carry patch."""
+    return tf._serving_jit("spec_hist_row", cfg, lambda fz: jax.jit(
+        lambda h, i, row: h.at[i].set(row),
+        donate_argnums=tf._serving_donate(0)))
+
+
 class BlockAllocator(object):
     """Free-list allocator with per-block refcounts over the paged KV
     pool. Block 0 is the reserved null block (unallocated table entries
@@ -534,6 +753,24 @@ class ContinuousBatcher(object):
     feeds the identical attention contraction — and int8-KV, GQA,
     chunking, pipelining, and dispatch-failure requeue all compose.
 
+    `spec_k=k` (default: MXNET_SPEC_K) turns every decode round into a
+    SPECULATIVE draft/verify dispatch: k drafted tokens per lane —
+    n-gram prompt-lookup over the lane's own stream by default
+    (spec_ngram / MXNET_SPEC_NGRAM suffix length), or a small draft
+    model when (draft_params, draft_cfg) are given — verified by one
+    ragged [B, k+1] target pass with device-side acceptance, so each
+    lane advances 1..k+1 tokens per target dispatch. Composes with
+    chunking (chunk_size rounds per dispatch), pipelining (depth
+    speculative dispatches in flight), and paging (tables advance by
+    accepted counts; worst-case draft blocks are released at sync).
+    spec_accept_floor > 0 (MXNET_SPEC_ACCEPT_FLOOR) enables the
+    per-lane adaptive-k controller: a lane whose measured-acceptance
+    EWMA drops below the floor drafts one token fewer next round
+    (never below 1), and recovers one at a time while at/above it.
+    Greedy-only; streams stay bit-exact vs solo generate() (tested
+    across providers, paging, and depths). With spec_k unset nothing
+    here runs — behavior AND dispatch count are unchanged (tested).
+
     `name` labels this replica's chaos site (serving.dispatch.<name>)
     so fleet tests can kill one replica of a router pool
     deterministically."""
@@ -542,7 +779,9 @@ class ContinuousBatcher(object):
                  temperature=1.0, top_k=None, top_p=None,
                  chunk_size=1, prefix_cache_slots=4, pipeline_depth=1,
                  paged=None, block_size=None, num_blocks=None,
-                 name=None):
+                 name=None, spec_k=None, spec_ngram=None,
+                 spec_accept_floor=None, draft_params=None,
+                 draft_cfg=None):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
@@ -569,6 +808,66 @@ class ContinuousBatcher(object):
         self._chaos_site = ("serving.dispatch" if name is None
                             else "serving.dispatch.%s" % name)
         self._controls = (self.greedy, float(temperature), top_k, top_p)
+        # speculative dispatches (spec_k drafts verified per round)
+        if spec_k is None:
+            v = _fastenv.get("MXNET_SPEC_K")
+            spec_k = int(v) if v else None
+        self.spec_k = int(spec_k) if spec_k else None
+        self._spec_on = self.spec_k is not None
+        if self._spec_on:
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if not self.greedy:
+                raise ValueError(
+                    "speculative dispatches are greedy-only: the "
+                    "accept test compares drafts against the target "
+                    "argmax (drop spec_k to sample)")
+            if spec_ngram is None:
+                v = _fastenv.get("MXNET_SPEC_NGRAM")
+                spec_ngram = int(v) if v else 2
+            self.spec_ngram = int(spec_ngram)
+            if self.spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
+            if spec_accept_floor is None:
+                v = _fastenv.get("MXNET_SPEC_ACCEPT_FLOOR")
+                spec_accept_floor = float(v) if v else 0.0
+            self.spec_accept_floor = float(spec_accept_floor)
+            if (draft_params is None) != (draft_cfg is None):
+                raise ValueError(
+                    "draft_params and draft_cfg come as a pair")
+            if draft_cfg is not None:
+                if draft_cfg.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        "draft vocab %d != target vocab %d"
+                        % (draft_cfg.vocab_size, cfg.vocab_size))
+                if draft_cfg.max_len < cfg.max_len:
+                    raise ValueError(
+                        "draft max_len %d < target max_len %d — the "
+                        "draft cache shares the target's lane "
+                        "positions"
+                        % (draft_cfg.max_len, cfg.max_len))
+            self.draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            self._spec_provider = ("model" if draft_params is not None
+                                   else "ngram")
+        elif draft_params is not None or draft_cfg is not None:
+            raise ValueError("a draft model without spec_k does "
+                             "nothing — set spec_k (or MXNET_SPEC_K)")
+        else:
+            self.spec_ngram = None
+            self.spec_accept_floor = 0.0
+            self.draft_params = self.draft_cfg = None
+            self._spec_provider = None
+        # target-model dispatches issued (sync steps, pipelined chunks,
+        # speculative rounds' verify passes all count one per device
+        # dispatch) — the denominator of dispatches-per-token, and the
+        # off-path-silence invariant tests pin spec_k=None against
+        self.dispatch_count = 0
+        # speculative decode needs the device-resident carry even at
+        # depth 1 (per-lane positions advance by data-dependent
+        # accepted counts — mirroring them on the host would force a
+        # sync per dispatch); pipelining needs it by construction
+        self._device_carry = self.pipeline_depth > 1 or self._spec_on
         if paged is None:
             paged = (_fastenv.get("MXNET_KV_PAGED") or "") \
                 not in ("", "0", "false", "False")
@@ -608,7 +907,7 @@ class ContinuousBatcher(object):
         self._tok = np.zeros((self.max_batch,), np.int32)
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
         self._slots = [None] * self.max_batch   # Request or None
-        if self.pipeline_depth > 1:
+        if self._device_carry:
             # device-resident lane carry (the host-side mirrors above
             # go unused): tok/pos/keys live on device between
             # dispatches, so a chunk dispatch uploads nothing and a
@@ -617,17 +916,49 @@ class ContinuousBatcher(object):
             self._dev_pos = jnp.zeros((self.max_batch,), jnp.int32)
             self._dev_keys = jnp.zeros((self.max_batch, 2), jnp.uint32)
             # in-flight dispatches, oldest first: (emissions [k, B],
-            # per-lane rid snapshot at dispatch time)
+            # per-lane rid snapshot at dispatch time) — speculative
+            # records carry (targets, emits, rids, keff) instead
             self._inflight = deque()
             # resolved once — a pipelined dispatch must not pay the
             # _serving_jit registry lookup per chunk
-            self._pipe_fn = (
-                _jitted_pipeline_chunk_paged(cfg, *self._controls,
-                                             self.chunk_size)
-                if self.paged else
-                _jitted_pipeline_chunk(cfg, *self._controls,
-                                       self.chunk_size))
+            if self._spec_on:
+                self._spec_fn = _jitted_spec_chunk(
+                    cfg, self.draft_cfg, self.spec_k,
+                    self.spec_ngram, self.chunk_size, self.paged,
+                    self._spec_provider == "model")
+            else:
+                self._pipe_fn = (
+                    _jitted_pipeline_chunk_paged(cfg, *self._controls,
+                                                 self.chunk_size)
+                    if self.paged else
+                    _jitted_pipeline_chunk(cfg, *self._controls,
+                                           self.chunk_size))
             self._patch_fn = _jitted_lane_patch(cfg)
+        if self._spec_on:
+            # per-lane adaptive k: effective draft length (masked
+            # inside the static-width program) and the measured
+            # acceptance EWMA the floor controller reads
+            self._keff = np.full((self.max_batch,), self.spec_k,
+                                 np.int32)
+            self._accept_ewma = np.ones((self.max_batch,), np.float64)
+            self._spec_rounds = 0
+            self._spec_drafted = 0
+            self._spec_accepted = 0
+            if self._spec_provider == "ngram":
+                self._dev_hist = jnp.zeros(
+                    (self.max_batch, cfg.max_len), jnp.int32)
+                self._hist_fn = _jitted_hist_row(cfg)
+            elif self.paged:
+                # the draft pool SHARES the target's block tables: one
+                # table row covers both models' positions, so block
+                # accounting stays single-ledger (the cost: prefix
+                # sharing is disabled — cached blocks hold target K/V
+                # only; see admit()/cache_prefix)
+                self._dpool = tf.init_paged_cache(
+                    self.draft_cfg, self.num_blocks, self.block_size)
+            else:
+                self._dcache = tf.init_cache(self.draft_cfg,
+                                             self.max_batch)
         # dispatch-failure recovery: a failed decode dispatch frees the
         # lanes and requeues the live requests (greedy streams resume
         # bit-exactly) instead of wedging the batcher; consecutive
@@ -696,6 +1027,11 @@ class ContinuousBatcher(object):
             snap["serving.kv_available_blocks"] = self._alloc.available
             snap["serving.kv_block_utilization"] = \
                 (usable - self._alloc.free_blocks) / float(usable)
+        if self._spec_on:
+            snap["serving.spec_draft_ratio"] = (
+                self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else 1.0)
+            snap["serving.spec_k_live"] = float(np.mean(self._keff))
         return snap
 
     # ---- paged block accounting ----
@@ -764,6 +1100,13 @@ class ContinuousBatcher(object):
         if self._prefix_slots < 1:
             raise ValueError("prefix caching disabled "
                              "(prefix_cache_slots=0)")
+        if self.paged and self._spec_on \
+                and self._spec_provider == "model":
+            raise ValueError(
+                "prefix sharing is unavailable with a paged draft "
+                "model: cached blocks hold target K/V only, and the "
+                "draft pool rides the same block tables (use the "
+                "n-gram provider, or dense caching)")
         toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
         if not toks:
             raise ValueError("empty prefix")
@@ -922,7 +1265,7 @@ class ContinuousBatcher(object):
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
-            pos = int(self._sched_pos[i] if self.pipeline_depth > 1
+            pos = int(self._sched_pos[i] if self._device_carry
                       else self._pos[i])
             end = min((pos + k - 1) // bs, self._lane_need[i] - 1,
                       self._nb - 1)
@@ -969,8 +1312,16 @@ class ContinuousBatcher(object):
             # demand (minus the cached prefix's shareable full blocks)
             # must fit the unpromised free list — LRU prefix eviction
             # may make room, a live lane's blocks never move
-            p_len, pfx_blocks, pfx_logits = \
-                self._lookup_prefix_blocks(prompt)
+            if self._spec_on and self._spec_provider == "model":
+                # the draft pool rides the TARGET's block tables, and
+                # cached prefix blocks hold target K/V only — sharing
+                # one would leave the draft cache blind over the
+                # prefix, so model-draft paged serving prefills whole
+                # (cache_prefix refuses; see there)
+                p_len, pfx_blocks, pfx_logits = 0, [], None
+            else:
+                p_len, pfx_blocks, pfx_logits = \
+                    self._lookup_prefix_blocks(prompt)
             shared = p_len // self.block_size
             lifetime, init_n = self._block_math(t_p, t_p + n_new)
             demand = lifetime - shared
@@ -1017,7 +1368,7 @@ class ContinuousBatcher(object):
                         self.params, row_cache, jnp.asarray(padded),
                         jnp.int32(p_len), jnp.int32(t_p - p_len - 1))
                 last = logits[0]
-        if self.pipeline_depth > 1:
+        if self._device_carry:
             # prefill-into-lane, all device-side: pick the first token
             # on device (generate()'s exact chain), patch the row
             # cache and the lane's (tok, pos, key) into the carry —
@@ -1057,6 +1408,8 @@ class ContinuousBatcher(object):
                     self._cache, row_cache, jnp.int32(slot))
             self._pos[slot] = t_p      # next decode writes position t_p
             self._tok[slot] = first
+        if self._spec_on:
+            self._spec_admit(slot, prompt, t_p, first)
         pre_span.stop()
         req = Request(rid, prompt, n_new, stop_token, seed=seed)
         self._next_rid += 1
@@ -1082,7 +1435,13 @@ class ContinuousBatcher(object):
         With pipeline_depth > 1 each step() keeps up to depth chunk
         dispatches in flight and syncs only the oldest one — same
         return contract, tokens arrive one dispatch later (bounded
-        staleness; see the class docstring)."""
+        staleness; see the class docstring).
+
+        With spec_k set each dispatch is a speculative draft/verify
+        round (up to chunk_size * (spec_k + 1) tokens per lane per
+        dispatch), pipelined the same way."""
+        if self._spec_on:
+            return self._step_spec()
         if self.pipeline_depth > 1:
             return self._step_pipelined()
         obs_on = _obs.enabled()
@@ -1136,6 +1495,7 @@ class ContinuousBatcher(object):
             self._recover_dispatch_failure(exc)
             return finished
         self._dispatch_failures = 0
+        self.dispatch_count += 1
         t_sync = time.perf_counter_ns() if obs_on else None
         # np.array (copy): asarray would give a READ-ONLY view of the
         # device buffer and the next admit()'s in-place key write fails
@@ -1228,6 +1588,7 @@ class ContinuousBatcher(object):
                     self._dev_pos, self._dev_keys)
                 self._cache = cache
         self._dispatch_failures = 0
+        self.dispatch_count += 1
         if self.paged:
             # every lane's device position advances k per chunk —
             # mirror it so the NEXT dispatch's coverage is exact
@@ -1277,6 +1638,252 @@ class ContinuousBatcher(object):
             self._publish_occupancy()
         return finished
 
+    # ---- speculative scheduling (spec_k set) ----
+
+    def _step_spec(self):
+        """One speculative scheduling step: top the in-flight window up
+        to `pipeline_depth` draft/verify dispatches (depth 1 means the
+        classic dispatch-then-sync round trip, just k+1 wide per lane
+        per round), then sync only the oldest. Identical skeleton to
+        _step_pipelined — per-lane emissions were ALREADY ragged there,
+        speculation only makes the raggedness data-dependent."""
+        obs_on = _obs.enabled()
+        finished = {}
+        # retire requests already complete at admission (n_new=1, or a
+        # stop token straight out of the prefill logits)
+        for i, req in enumerate(self._slots):
+            if req is not None and req.done:
+                finished[req.rid] = list(req.tokens)
+                if obs_on:
+                    self._note_finish(req)
+                self._free(i)
+        while (len(self._inflight) < self.pipeline_depth
+               and any(s is not None for s in self._slots)):
+            try:
+                self._dispatch_spec()
+            except Exception as exc:  # noqa: BLE001 — requeue-or-raise
+                self._recover_dispatch_failure(exc)
+                return finished
+        if self._inflight:
+            finished.update(self._sync_oldest_spec())
+        if not any(s is not None for s in self._slots):
+            # nothing live: in-flight emissions belong to no request
+            self._inflight.clear()
+        return finished
+
+    def _dispatch_spec(self):
+        """Issue one speculative dispatch (chunk_size draft/verify
+        rounds) against the device-resident carry. Paged coverage is
+        reserved for the WORST case — every lane accepting every draft
+        every round — and the sync reconciles `_sched_pos` down to the
+        measured acceptance, releasing the over-reserved draft blocks
+        (see _reconcile_sched_pos)."""
+        worst = self.chunk_size * (self.spec_k + 1)
+        if self.paged:
+            self._ensure_coverage(worst)
+        keff = jnp.asarray(self._keff)
+        with _obs.span("serving.dispatch", cat="serving", mode="spec",
+                       depth=len(self._inflight) + 1,
+                       spec_k=self.spec_k):
+            if _chaos.enabled():
+                _chaos.fire(self._chaos_site, mode="spec",
+                            depth=len(self._inflight) + 1)
+            if self._spec_provider == "ngram":
+                if self.paged:
+                    targets, emits, pool, hist, tok, pos = \
+                        self._spec_fn(self.params, self._pool,
+                                      self._tables, self._dev_hist,
+                                      self._dev_tok, self._dev_pos,
+                                      keff)
+                    self._pool = pool
+                else:
+                    targets, emits, cache, hist, tok, pos = \
+                        self._spec_fn(self.params, self._cache,
+                                      self._dev_hist, self._dev_tok,
+                                      self._dev_pos, keff)
+                    self._cache = cache
+                self._dev_hist = hist
+            elif self.paged:
+                targets, emits, pool, dpool, tok, pos = \
+                    self._spec_fn(self.params, self.draft_params,
+                                  self._pool, self._dpool,
+                                  self._tables, self._dev_tok,
+                                  self._dev_pos, keff)
+                self._pool, self._dpool = pool, dpool
+            else:
+                targets, emits, cache, dcache, tok, pos = \
+                    self._spec_fn(self.params, self.draft_params,
+                                  self._cache, self._dcache,
+                                  self._dev_tok, self._dev_pos, keff)
+                self._cache, self._dcache = cache, dcache
+        self._dispatch_failures = 0
+        self.dispatch_count += 1
+        if self.paged:
+            # worst-case position mirror so the NEXT dispatch's
+            # coverage is sufficient whatever this one accepts; the
+            # sync subtracts the measured shortfall back out
+            self._sched_pos += worst
+        self._dev_tok, self._dev_pos = tok, pos
+        self._inflight.append(
+            (targets, emits,
+             [r.rid if r is not None else None for r in self._slots],
+             np.array(self._keff)))
+        if _obs.enabled():
+            _obs.gauge("serving.inflight_depth").set(
+                len(self._inflight))
+            self._publish_occupancy()
+
+    def _sync_oldest_spec(self):
+        """Fetch the oldest speculative dispatch's verified targets and
+        emit counts, credit each lane's ACCEPTED tokens to the request
+        that owned it at dispatch time (rid snapshot, exactly the
+        pipelined rule), feed the measured acceptance into the per-lane
+        EWMA the adaptive-k controller reads, and reconcile paged
+        block accounting down from worst case."""
+        targets_dev, emits_dev, lanes, keffs = self._inflight.popleft()
+        with _obs.span("serving.sync", cat="serving", mode="spec",
+                       behind=len(self._inflight)):
+            targets = np.asarray(targets_dev)      # [rounds, B, k+1]
+            emits = np.asarray(emits_dev).astype(np.int64)  # [rounds, B]
+        obs_on = _obs.enabled()
+        t_sync = time.perf_counter_ns() if obs_on else None
+        finished = {}
+        rounds = emits.shape[0]
+        for i, rid in enumerate(lanes):
+            if rid is None:
+                continue
+            req = self._slots[i]
+            if req is None or req.rid != rid or req.done:
+                continue               # canceled / replaced mid-flight
+            grew0 = req.emitted
+            # keff at DISPATCH time: the width these rounds actually
+            # drafted at, the denominator of their acceptance ratio
+            keff_i = max(int(keffs[i]), 1)
+            for r in range(rounds):
+                e = int(emits[r, i])
+                acc = e - 1            # accepted drafts this round
+                self._spec_rounds += 1
+                self._spec_drafted += keff_i
+                self._spec_accepted += acc
+                self._accept_ewma[i] += _SPEC_EWMA_ALPHA * (
+                    acc / keff_i - self._accept_ewma[i])
+                if obs_on:
+                    _obs.histogram("serving.spec_accept_len",
+                                   "tokens").observe(acc)
+                for j in range(e):
+                    req.tokens.append(int(targets[r, i, j]))
+                    req.emitted += 1
+                    if req.done:
+                        break
+                if req.done:
+                    break
+            if self.spec_accept_floor > 0.0:
+                # per-lane adaptive k: measured acceptance under the
+                # floor shrinks the draft width (never below 1 — one
+                # draft still doubles the best-case tokens/dispatch),
+                # at-or-above grows it back toward spec_k
+                if self._accept_ewma[i] < self.spec_accept_floor:
+                    self._keff[i] = max(1, int(self._keff[i]) - 1)
+                else:
+                    self._keff[i] = min(self.spec_k,
+                                        int(self._keff[i]) + 1)
+            if t_sync is not None:
+                self._note_progress(req, i, req.emitted - grew0,
+                                    t_sync)
+            if req.done:
+                finished[req.rid] = list(req.tokens)
+                if t_sync is not None:
+                    self._note_finish(req, t_sync)
+                self._free(i)
+        if self.paged:
+            self._reconcile_sched_pos(emits, lanes)
+        if obs_on:
+            _obs.gauge("serving.spec_draft_ratio").set(
+                self._spec_accepted / max(self._spec_drafted, 1))
+            self._publish_occupancy()
+        return finished
+
+    def _reconcile_sched_pos(self, emits, lanes):
+        """Walk `_sched_pos` back from the dispatch-time worst case to
+        the measured per-lane advance and release the block tail the
+        lane over-reserved for drafts it did not accept. Only lanes
+        whose occupant is UNCHANGED since dispatch (rid snapshot
+        matches) reconcile — a freed or re-admitted lane's patch
+        already reset its accounting authoritatively."""
+        worst = self.chunk_size * (self.spec_k + 1)
+        advance = emits.sum(axis=0)
+        for i, rid in enumerate(lanes):
+            if rid is None:
+                continue
+            req = self._slots[i]
+            if req is None or req.rid != rid:
+                continue
+            self._sched_pos[i] -= worst - int(advance[i])
+            self._trim_lane_blocks(i)
+
+    def _trim_lane_blocks(self, i):
+        """Release lane i's allocated blocks beyond its reconciled
+        coverage, converting them back into reservation (the lane's
+        lifetime need is unchanged — the blocks were just materialized
+        early for a worst case that did not happen). Safe against
+        in-flight dispatches: their writes are bounded by the KEPT
+        coverage (every dispatch's worst case beyond the synced one is
+        still counted in _sched_pos), and a trimmed block's positions
+        sit above every in-flight query position, so stale table
+        snapshots can only reach it through masked-out attention rows.
+        Trimmed blocks are always refcount-1: sharing only ever covers
+        prompt-prefix blocks, which reconciled coverage never drops."""
+        bs = self.block_size
+        keep = min(max(int(self._sched_pos[i]) - 1, 0) // bs,
+                   self._lane_need[i] - 1) + 1
+        blocks = self._lane_blocks[i]
+        while len(blocks) > max(keep, 1):
+            bid = blocks.pop()
+            self._tables = _jitted_table_entry(self.cfg)(
+                self._tables, jnp.int32(i), jnp.int32(len(blocks)),
+                jnp.int32(0))
+            self._alloc.release([bid])
+            self._alloc.reserve(1)
+
+    def _spec_admit(self, slot, ctx, t_p, first):
+        """Seed lane `slot`'s draft state for a stream whose cache-
+        resident prefix is the `t_p` tokens `ctx`, with `first` the
+        lane's current token at position t_p. The n-gram provider gets
+        its stream-history row (prefix + current token); the model
+        provider gets a full draft-model prefill over the prefix, so
+        draft steps and target verifies walk positions in lockstep
+        (and, under paging, the same block tables)."""
+        if self._spec_provider == "ngram":
+            row = np.zeros((self.cfg.max_len,), np.int32)
+            row[:t_p] = ctx
+            row[t_p] = first           # t_p < max_len: n_new >= 1
+            with _obs.span("serving.patch", cat="serving",
+                           kind="spec_hist", lane=slot):
+                self._dev_hist = self._hist_fn(
+                    self._dev_hist, jnp.int32(slot), jnp.asarray(row))
+            return
+        drow = tf.init_cache(self.draft_cfg, 1)
+        width = min(_bucket(t_p), self.draft_cfg.max_len)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :t_p] = ctx
+        with _obs.span("serving.prefill", cat="serving", kind="draft",
+                       lane=slot, prompt_tokens=t_p):
+            _, drow = tf._jitted_prefill_chunk_row(self.draft_cfg)(
+                self.draft_params, drow, jnp.asarray(padded),
+                jnp.int32(0), jnp.int32(t_p - 1))
+            if self.paged:
+                # the lane's freshly mapped blocks (all of them —
+                # model-draft paging never shares a prefix, see
+                # admit()) receive the draft rows whole-block
+                own = self._lane_blocks[slot]
+                self._dpool = _jitted_block_write(
+                    self.draft_cfg, len(own))(
+                        self._dpool, drow,
+                        jnp.asarray(own, jnp.int32), jnp.int32(0))
+            else:
+                self._dcache = _jitted_slot_write(self.draft_cfg)(
+                    self._dcache, drow, jnp.int32(slot))
+
     # ---- dispatch-failure recovery ----
 
     def _recover_dispatch_failure(self, exc):
@@ -1320,11 +1927,25 @@ class ContinuousBatcher(object):
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._tok = np.zeros((self.max_batch,), np.int32)
         self._keys = np.zeros((self.max_batch, 2), np.uint32)
-        if self.pipeline_depth > 1:
+        if self._device_carry:
             self._inflight.clear()
             self._dev_tok = jnp.zeros((self.max_batch,), jnp.int32)
             self._dev_pos = jnp.zeros((self.max_batch,), jnp.int32)
             self._dev_keys = jnp.zeros((self.max_batch, 2), jnp.uint32)
+        if self._spec_on:
+            # the donated draft state died with the failed dispatch;
+            # _readmit below re-seeds each live lane's slice of it
+            self._keff[:] = self.spec_k
+            self._accept_ewma[:] = 1.0
+            if self._spec_provider == "ngram":
+                self._dev_hist = jnp.zeros(
+                    (self.max_batch, self.cfg.max_len), jnp.int32)
+            elif self.paged:
+                self._dpool = tf.init_paged_cache(
+                    self.draft_cfg, self.num_blocks, self.block_size)
+            else:
+                self._dcache = tf.init_cache(self.draft_cfg,
+                                             self.max_batch)
         for req in pending:
             self._readmit(req)
 
@@ -1364,7 +1985,7 @@ class ContinuousBatcher(object):
         else:
             self._cache = _jitted_slot_write(self.cfg)(
                 self._cache, row_cache, jnp.int32(slot))
-        if self.pipeline_depth > 1:
+        if self._device_carry:
             self._dev_tok, self._dev_pos, self._dev_keys = \
                 self._patch_fn(self._dev_tok, self._dev_pos,
                                self._dev_keys, jnp.int32(slot),
@@ -1374,6 +1995,11 @@ class ContinuousBatcher(object):
             self._pos[slot] = m
             self._tok[slot] = last
             self._keys[slot] = key_np
+        if self._spec_on:
+            # re-seed the lane's draft state from the synced prefix —
+            # the requeue resumes exactly like a fresh admission whose
+            # prompt is everything synced so far
+            self._spec_admit(slot, ctx, m, last)
         self._slots[slot] = req
         if _obs.enabled():
             _obs.record_instant("serving.requeued", cat="serving",
@@ -1429,7 +2055,7 @@ class ContinuousBatcher(object):
             self._tables = _jitted_table_row(self.cfg)(
                 self._tables, jnp.int32(i),
                 jnp.zeros((self._nb,), jnp.int32))
-        if self.pipeline_depth > 1:
+        if self._device_carry:
             with _obs.span("serving.patch", cat="serving", kind="park",
                            lane=i):
                 self._dev_tok, self._dev_pos, self._dev_keys = \
@@ -1440,6 +2066,12 @@ class ContinuousBatcher(object):
         else:
             self._pos[i] = 0
             self._tok[i] = 0
+        if self._spec_on:
+            # reset the adaptive-k controller for the next occupant
+            # (the hist row / draft cache need no clearing — the next
+            # admission's _spec_admit overwrites them whole)
+            self._keff[i] = self.spec_k
+            self._accept_ewma[i] = 1.0
 
     # ---- request-level observability ----
     # Every caller guards on _obs.enabled(): with telemetry off none of
@@ -1447,8 +2079,7 @@ class ContinuousBatcher(object):
 
     def _note_admit(self, req, lane, t_admit_ns, enqueued_ns):
         """Admission bookkeeping: queue-wait span + histogram, TTFT
-        histogram, the flow-chain start, and the (deprecated)
-        last-value admit gauge."""
+        histogram, and the flow-chain start."""
         t1 = time.perf_counter_ns()
         req.t_enq_ns = enqueued_ns
         req.t_admit_ns = t_admit_ns
@@ -1470,10 +2101,6 @@ class ContinuousBatcher(object):
         _obs.histogram("serving.ttft_ms", "ms").observe(ttft_ms)
         if _slo.check("ttft_ms", ttft_ms):
             req.slo_bad = True
-        # DEPRECATED last-value view (pre-histogram consumers; see
-        # docs/OBSERVABILITY.md) — serving.ttft_ms is the real signal
-        _obs.gauge("serving.admit_to_first_token_ms").set(
-            (t1 - t_admit_ns) / 1e6)
         _obs.record_flow("serving.request", req.rid, "s",
                          cat="serving",
                          args={"rid": req.rid, "lane": lane})

@@ -33,7 +33,8 @@ __all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
            "make_decode_step", "generate", "shard_cache", "prefill",
            "quantize_weights_int8", "beam_search", "prefill_chunk",
            "speculative_generate", "save_checkpoint", "load_checkpoint",
-           "restore_train_state", "init_paged_cache", "decode_step_paged"]
+           "restore_train_state", "init_paged_cache", "decode_step_paged",
+           "verify_chunk", "verify_chunk_paged"]
 
 
 @dataclass
@@ -1085,6 +1086,176 @@ def decode_step_paged(params, pool, tables, tokens, pos, cfg):
         x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
     x = _rms_norm(x, params["ln_f"])
     return jnp.einsum("bd,vd->bv", x, params["embed"]), new_pool
+
+
+# ------------------------------------------------------ batched verify ---
+# The ragged-chunk forward that batched speculative decoding needs: C
+# tokens per lane, each lane's window anchored at its OWN position. Both
+# variants share the attention contractions with prefill_chunk (dense /
+# _int8_cache_attention), which is what keeps batched verify bit-exact
+# with the stepped decode it replaces.
+
+def _cache_write_ragged_chunk(layer_cache, k_new, v_new, positions, cfg):
+    """Per-row WINDOW scatter: row b writes its C fresh k/v
+    [B, C, KVH, D] at its own positions[b, :] — the C>1 generalization
+    of _cache_write_ragged. Out-of-bounds positions (a lane's window
+    running past max_len) are DROPPED by the scatter rather than
+    clamped, so a deep window can never corrupt an earlier,
+    still-attendable cache row."""
+    rows = jnp.arange(k_new.shape[0])[:, None]
+
+    def st(name, arr):
+        return layer_cache[name].at[rows, positions].set(
+            arr.astype(layer_cache[name].dtype), mode="drop")
+
+    if cfg.kv_cache_int8:
+        kq, ks = _kv_quant(k_new)
+        vq, vs = _kv_quant(v_new)
+        return {"k": st("k", kq), "ks": st("ks", ks),
+                "v": st("v", vq), "vs": st("vs", vs)}
+    return {"k": st("k", k_new), "v": st("v", v_new)}
+
+
+def verify_chunk(params, cache, tokens, pos, cfg):
+    """Process a RAGGED chunk: C tokens PER LANE, lane b's window
+    starting at its own position pos[b] ([B] int32 — data, not shape,
+    like every serving entry point). Row (b, i) carries the stream
+    token at position pos[b]+i, writes its K/V there, attends cache
+    positions <= pos[b]+i, and its logits predict position pos[b]+i+1.
+    This is the batched generalization of prefill_chunk (whose `start`
+    is one scalar for the whole batch) and the target pass of batched
+    speculative decoding: the [B, k+1] window [tok, d_1..d_k] yields
+    every lane's verification targets in ONE dispatch.
+
+    Stale K/V from rejected drafts heals by position exactly as the
+    solo _spec_core documents: the next round's window starts at the
+    first rejected position and rewrites every stale position before
+    any row can attend it. Windows that run past max_len (a parked
+    lane, a near-budget lane coasting) DROP their writes instead of
+    clamping. Returns (logits [B, C, vocab], cache)."""
+    params = _maybe_dequantize(params)
+    b, c = tokens.shape
+    positions = pos[:, None] + jnp.arange(c)[None, :]        # [B, C]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        # take() clamps OOB rows — their logits are garbage, but their
+        # writes drop and their emissions are never credited
+        x = x + jnp.take(params["pos"], positions, axis=0)
+    new_cache = []
+    g = cfg.n_heads // _kvh(cfg)
+    for p, layer_cache in zip(params["layers"], cache):
+        h = _rms_norm(x, p["ln1"])
+        q, k, v = _qkv(h, p)
+        if cfg.rope:
+            q = _rope(q, positions, cfg.rope_base)
+            k = _rope(k, positions, cfg.rope_base)
+        nlayer = _cache_write_ragged_chunk(layer_cache, k, v,
+                                           positions, cfg)
+        new_cache.append(nlayer)
+        dh = q.shape[-1]
+        qg = q.reshape(b, c, _kvh(cfg), g, dh)
+        t_pos = jnp.arange(nlayer["k"].shape[1])
+        mask = t_pos[None, None, :] <= positions[:, :, None]  # [B,C,T]
+        if cfg.kv_cache_int8:
+            o = _int8_cache_attention(qg, nlayer, mask, x.dtype) \
+                .reshape(b, c, cfg.n_heads, dh)
+        else:
+            ck, cv = nlayer["k"], nlayer["v"]
+            s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
+                           preferred_element_type=jnp.float32
+                           ) / np.sqrt(dh)
+            s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32
+                           ).astype(x.dtype).reshape(b, c,
+                                                     cfg.n_heads, dh)
+        x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bcd,vd->bcv", x, params["embed"]), new_cache
+
+
+def _paged_write_ragged_chunk(layer_pool, k_new, v_new, tables,
+                              positions, cfg):
+    """Window scatter through the block tables: row b writes its C
+    fresh k/v at positions[b, :], each position routed to block
+    tables[b, position//bs] at offset position%bs. Positions past the
+    TABLE (beyond max_len) are routed to the null block — unlike the
+    single-position _paged_write_ragged, clamping to the last entry is
+    not safe here, because a near-budget lane's window can overrun
+    while the lane is still live and its last block still attendable.
+    Unallocated entries are the null block as usual."""
+    bs = layer_pool["k"].shape[1]
+    nb = tables.shape[1]
+    blk_idx = positions // bs                                # [B, C]
+    blk = jnp.take_along_axis(tables, jnp.clip(blk_idx, 0, nb - 1),
+                              axis=1)
+    blk = jnp.where(blk_idx < nb, blk, 0)
+    off = positions % bs
+
+    def st(name, arr):
+        return layer_pool[name].at[blk, off].set(
+            arr.astype(layer_pool[name].dtype))
+
+    if cfg.kv_cache_int8:
+        kq, ks = _kv_quant(k_new)
+        vq, vs = _kv_quant(v_new)
+        return {"k": st("k", kq), "ks": st("ks", ks),
+                "v": st("v", vq), "vs": st("vs", vs)}
+    return {"k": st("k", k_new), "v": st("v", v_new)}
+
+
+def verify_chunk_paged(params, pool, tables, tokens, pos, cfg):
+    """verify_chunk through the block tables: same ragged-window
+    semantics, writes scattered into the pool
+    (_paged_write_ragged_chunk), reads through the gathered dense view
+    (_paged_gather) into the SAME attention contraction as the dense
+    verify — bit-identical values at every unmasked position, so
+    paged == dense == solo stays exact under speculation. Tables are
+    read-only here; allocation (including the speculative over-reserve
+    and release-on-reject) is the host scheduler's job.
+    Returns (logits [B, C, vocab], pool)."""
+    params = _maybe_dequantize(params)
+    b, c = tokens.shape
+    positions = pos[:, None] + jnp.arange(c)[None, :]        # [B, C]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + jnp.take(params["pos"], positions, axis=0)
+    new_pool = []
+    g = cfg.n_heads // _kvh(cfg)
+    for p, layer_pool in zip(params["layers"], pool):
+        h = _rms_norm(x, p["ln1"])
+        q, k, v = _qkv(h, p)
+        if cfg.rope:
+            q = _rope(q, positions, cfg.rope_base)
+            k = _rope(k, positions, cfg.rope_base)
+        nlayer = _paged_write_ragged_chunk(layer_pool, k, v, tables,
+                                           positions, cfg)
+        new_pool.append(nlayer)
+        dh = q.shape[-1]
+        qg = q.reshape(b, c, _kvh(cfg), g, dh)
+        att = _paged_gather(nlayer, tables)
+        t_pos = jnp.arange(att["k"].shape[1])
+        mask = t_pos[None, None, :] <= positions[:, :, None]  # [B,C,T]
+        if cfg.kv_cache_int8:
+            o = _int8_cache_attention(qg, att, mask, x.dtype) \
+                .reshape(b, c, cfg.n_heads, dh)
+        else:
+            ck, cv = att["k"], att["v"]
+            s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
+                           preferred_element_type=jnp.float32
+                           ) / np.sqrt(dh)
+            s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32
+                           ).astype(x.dtype).reshape(b, c,
+                                                     cfg.n_heads, dh)
+        x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
+        x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bcd,vd->bcv", x, params["embed"]), new_pool
 
 
 def make_decode_step(cfg):

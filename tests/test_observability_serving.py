@@ -232,9 +232,9 @@ def test_lifecycle_spans_flows_and_histograms(obs_on):
     assert hs["serving.queue_ms"].count == len(jobs)
     assert hs["serving.e2e_ms"].count == len(jobs)
     assert hs["serving.itl_ms"].count == sum(n - 1 for _, n in jobs)
-    # deprecated last-value gauge still exported for back-compat
-    assert core.counters()["serving.admit_to_first_token_ms"].count \
-        == len(jobs)
+    # the deprecated admit_to_first_token_ms last-value gauge is GONE —
+    # serving.ttft_ms (above) is the signal
+    assert "serving.admit_to_first_token_ms" not in core.counters()
 
 
 def test_lifecycle_under_admission_staleness(obs_on):

@@ -146,13 +146,14 @@ def ops_smoke():
 
 
 def serving_smoke():
-    """--serving: a pipelined ContinuousBatcher run under churn must
-    land the request lifecycle in the emitted chrome trace — dispatch/
-    sync/patch/prefill/queue-wait spans, serving.request flow events
-    tying admit->syncs->finish per rid, the bounded-memory TTFT/ITL/
-    e2e/queue histograms (events + mergeable bucket states), the
-    occupancy/goodput gauges — and the MXNET_OBS_HTTP-style live
-    endpoint must answer a /metrics + /healthz scrape MID-RUN."""
+    """--serving: a pipelined, SPECULATIVE ContinuousBatcher run under
+    churn must land the request lifecycle in the emitted chrome trace —
+    dispatch/sync/patch/prefill/queue-wait spans, serving.request flow
+    events tying admit->syncs->finish per rid, the bounded-memory
+    TTFT/ITL/e2e/queue histograms (events + mergeable bucket states),
+    the occupancy/goodput gauges, the spec acceptance histogram/gauge —
+    and the MXNET_OBS_HTTP-style live endpoint must answer a /metrics +
+    /healthz scrape MID-RUN (acceptance ratio included)."""
     import urllib.request
 
     import numpy as np
@@ -169,7 +170,7 @@ def serving_smoke():
     rng = np.random.RandomState(0)
     jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(4)]
     srv = ContinuousBatcher(params, cfg, max_batch=2, pipeline_depth=2,
-                            paged=True, block_size=8)
+                            paged=True, block_size=8, spec_k=2)
 
     port = obs_http.start(0)       # ephemeral port; env-free smoke
     scraped = {"metrics": None, "healthz": None}
@@ -198,7 +199,8 @@ def serving_smoke():
         return 1
     hz = scraped["healthz"]
     needed_hz = ("serving.lane_occupancy", "serving.kv_free_blocks",
-                 "serving.kv_block_utilization")
+                 "serving.kv_block_utilization",
+                 "serving.spec_draft_ratio")
     if not hz or hz.get("status") != "ok" \
             or any(k not in hz.get("counters", {}) for k in needed_hz):
         print("[obs_smoke] FAIL: /healthz snapshot incomplete (need "
@@ -224,8 +226,8 @@ def serving_smoke():
                 "serving.kv_utilization", "serving.goodput_tok_s",
                 "serving.kv_free_blocks",
                 "serving.kv_block_utilization",
-                "serving.admit_to_first_token_ms", "serving.ttft_ms",
-                "serving.itl_ms", "serving.e2e_ms"}
+                "serving.spec_accept_len", "serving.spec_draft_ratio",
+                "serving.ttft_ms", "serving.itl_ms", "serving.e2e_ms"}
     missing = required - names
     if missing:
         print("[obs_smoke] FAIL: serving trace missing: %s"
@@ -244,7 +246,8 @@ def serving_smoke():
         return 1
     hists = trace["otherData"].get("histograms", {})
     for hname in ("serving.ttft_ms", "serving.itl_ms",
-                  "serving.e2e_ms", "serving.queue_ms"):
+                  "serving.e2e_ms", "serving.queue_ms",
+                  "serving.spec_accept_len"):
         if not hists.get(hname, {}).get("count"):
             print("[obs_smoke] FAIL: histogram %s missing/empty in "
                   "trace otherData" % hname)
