@@ -117,6 +117,16 @@ QUEUE = [
     ("serving_spec",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--spec-k", "4"]}, 1800, False),
+    # overload resilience (not a throughput leg): a mixed-priority
+    # burst at ~4x the fleet's KV-block capacity over a 2-replica
+    # router with breakers + brownout on, one replica chaos-killed
+    # mid-storm — the JSON row is the degradation ledger (completed/
+    # shed/expired split, preemptions, per-priority attainment,
+    # breaker transitions) and the leg exits nonzero if the contract
+    # breaks (docs/ROBUSTNESS.md "Serving overload")
+    ("serving_overload",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--overload"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",

@@ -53,6 +53,17 @@ what changes is the TARGET-DISPATCHES-PER-EMITTED-TOKEN column — the
 round-trip count a wedged-tunnel chip pays per token — plus the
 measured acceptance rate and the live adaptive-k floor.
 
+With ``--overload`` it runs the overload-resilience leg instead (no
+throughput number — a degradation ledger): a seeded mixed-priority
+burst at ~4x the fleet's KV-block capacity over a 2-replica router
+with the circuit breaker and brownout ladder on, one replica chaos-
+killed mid-storm. The JSON row carries the completed/shed/expired
+split, preemption + bit-exact-resume counts, per-priority completion
+attainment, the brownout rung high-water mark, the breaker transition
+list, and the preempt-stall percentiles; the leg exits nonzero if the
+degradation contract breaks (a deadlock, a non-priority-0 drop, a
+diverged stream, or the killed replica failing to return).
+
 After the throughput legs, the continuous-batching pools run once more
 INSTRUMENTED (MXNET_OBS forced on for that run only) to print the
 request-level TTFT / ITL / e2e / queue-wait percentile table from the
@@ -476,6 +487,157 @@ def paged_ab():
     _write_artifact(_json_arg(), [rep])
 
 
+def overload_ab():
+    """The overload-resilience leg (``--overload``): a seeded mixed-
+    priority burst at ~4x the fleet's KV-block capacity lands on a
+    2-replica router (breaker + brownout on) while a chaos spec kills
+    replica r1 mid-storm — the ISSUE 12 acceptance workload, run as a
+    bench leg. Nothing here is a throughput number; the row reports
+    the DEGRADATION ledger: completed / shed / expired split (shed
+    and expired only ever priority 0), preemption + resume counts,
+    per-priority completion attainment, the brownout rung high-water
+    mark, the breaker transition list for the killed replica, and
+    whether every completed stream stayed bit-exact vs solo
+    generate() — plus the preempt-stall percentiles from the same
+    instrumented run."""
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.router import ReplicaRouter
+    from mxnet_tpu.observability import chaos
+    from mxnet_tpu.observability import core as obs
+    from mxnet_tpu.observability import histogram as hist
+
+    backend = jax.default_backend()
+    if SMOKE:
+        vocab = 8192
+        d_model, heads, layers, max_len = 32, 2, 1, 96
+        t_prompt, block_size = 6, 8
+        steady_new, storm_new = 10, 8
+        n_p2, n_p1, n_p0 = 3, 3, 4
+    else:
+        vocab = 32000
+        d_model, heads, layers, max_len = 512, 8, 8, 4096
+        t_prompt, block_size = 96, 16
+        steady_new, storm_new = 128, 64
+        n_p2, n_p1, n_p0 = 4, 4, 6
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    cfg = tf.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=dtype)
+    params = tf.init_params(cfg, seed=0)
+    # each replica gets exactly the blocks two steady streams pin, so
+    # the storm can only be funded by preemption, brownout shed, or
+    # deadline expiry — the degradation machinery under test
+    steady_life = (t_prompt + steady_new - 2) // block_size + 1
+    num_blocks = 2 * steady_life + 1
+    jrng = np.random.RandomState(12)
+
+    def prompt():
+        return list(jrng.randint(1, vocab, t_prompt))
+
+    steady = [(prompt(), steady_new, 0, None) for _ in range(4)]
+    storm = ([(prompt(), storm_new, 2, None) for _ in range(n_p2)]
+             + [(prompt(), storm_new, 1, None) for _ in range(n_p1)]
+             + [(prompt(), storm_new, 0, None) for _ in range(n_p0)]
+             + [(prompt(), storm_new, 0, 0) for _ in range(2)])
+    jobs = steady + storm
+    print("serving overload: backend=%s dtype=%s d_model=%d layers=%d "
+          "block=%d pool=%d blocks/replica, %d steady + %d storm jobs"
+          % (backend, np.dtype(dtype).name, d_model, layers,
+             block_size, num_blocks - 1, len(steady), len(storm)),
+          flush=True)
+
+    solo = {}
+    prio = {}
+    obs.set_enabled(True)
+    obs.reset()
+    chaos.reset()
+    t0 = time.time()
+    try:
+        pre0 = obs.counter("serving.preemptions").value
+        r = ReplicaRouter.build(
+            params, cfg, n_replicas=2, max_batch=3, shed_queue=8,
+            breaker=True, paged=True, block_size=block_size,
+            num_blocks=num_blocks, brownout=True)
+
+        def submit(batch):
+            for p, n, pr, ddl in batch:
+                rid = r.submit(p, n, priority=pr, deadline_ms=ddl)
+                prio[rid] = pr
+                solo[rid] = np.asarray(tf.generate(
+                    params, jnp.asarray([p], jnp.int32), n, cfg,
+                    greedy=True))[0].tolist()
+
+        results = {}
+        submit(steady)
+        rounds = 0
+        for _ in range(2):
+            results.update(r.step())
+            rounds += 1
+        chaos.install("serving.dispatch.r1:error:at=1;"
+                      "serving.dispatch.r1:error:at=2;"
+                      "serving.dispatch.r1:error:at=3;"
+                      "serving.dispatch.r1:error:at=4")
+        submit(storm)
+        rung_max = 0
+        while (r._queue or r._live) and rounds < 600:
+            results.update(r.step())
+            rung_max = max([rung_max] + [rep._bo_rung
+                                         for rep in r.replicas])
+            rounds += 1
+        wall = time.time() - t0
+        deadlocked = bool(r._queue or r._live)
+        preemptions = obs.counter("serving.preemptions").value - pre0
+        stall = hist.histograms().get("serving.preempt_stall_ms")
+        stall = stall.snapshot() if stall is not None else None
+        # one stall observation per preempted-then-resumed stream
+        resumed = stall["count"] if stall else 0
+        for rep in r.replicas:
+            rep.check_invariants(quiesce=True)   # zero leaked blocks
+    finally:
+        chaos.reset()
+        obs.set_enabled(None)
+        obs.reset()
+
+    dropped = set(r.shed_rids) | set(r.expired_rids)
+    exact = all(results.get(rid) == solo[rid]
+                for rid in prio if rid not in dropped)
+    attain = {}
+    for p in (0, 1, 2):
+        members = [rid for rid in prio if prio[rid] == p]
+        ok = sum(1 for rid in members
+                 if rid not in dropped
+                 and results.get(rid) == solo[rid])
+        attain["p%d" % p] = round(ok / float(len(members)), 3)
+    row = {
+        "leg": "serving_overload", "jobs": len(jobs),
+        "completed": len(prio) - len(dropped),
+        "shed": len(r.shed_rids), "expired": len(r.expired_rids),
+        "dropped_priorities": sorted({prio[rid] for rid in dropped}),
+        "preemptions": preemptions, "resumed": resumed,
+        "brownout_rung_max": rung_max,
+        "breaker_transitions": [list(ev) for ev in r.breaker_events],
+        "replica_recovered": (r._alive == [True, True]
+                              and r._brk_state == ["closed", "closed"]),
+        "attainment": attain, "bit_exact": exact,
+        "deadlocked": deadlocked, "rounds": rounds,
+        "wall_s": round(wall, 2),
+        "preempt_stall_ms": stall, "backend": backend,
+    }
+    print(json.dumps(row), flush=True)
+    if deadlocked or not exact or not row["replica_recovered"] \
+            or any(p > 0 for p in row["dropped_priorities"]) \
+            or attain["p2"] < 1.0 or attain["p1"] < 1.0:
+        print("serving overload leg FAILED its degradation contract",
+              flush=True)
+        sys.exit(1)
+
+
 def main():
     from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
@@ -672,5 +834,7 @@ if __name__ == "__main__":
         spec_ab(_spec)
     elif "--paged" in sys.argv[1:]:
         paged_ab()
+    elif "--overload" in sys.argv[1:]:
+        overload_ab()
     else:
         main()

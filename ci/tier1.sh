@@ -125,6 +125,20 @@ if [ "${TIER1_CHAOS:-0}" = "1" ]; then
         echo "[tier1] FAIL: elastic smoke"
         exit 1
     fi
+
+    echo "==== [tier1] overload smoke (priority storm -> preempt/shed/expire -> breaker recovery) ===="
+    # docs/ROBUSTNESS.md "Serving overload & graceful degradation",
+    # end to end: a seeded mixed-priority burst at ~4x KV-block
+    # capacity over a 2-replica router while a chaos spec kills r1
+    # mid-storm. Must complete with zero deadlocks and zero leaked
+    # blocks at quiesce, only priority-0 work shed/expired, the
+    # brownout ladder climbing and recovering, r1 returning through
+    # the breaker's HALF_OPEN canary, and every completed stream
+    # bit-exact vs solo generate().
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py --overload; then
+        echo "[tier1] FAIL: overload smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -12,24 +12,47 @@ exported for it:
   ``MXNET_OBS_HTTP`` ``/healthz`` ``counters`` for a scraped fleet):
   paged KV headroom (``serving.kv_available_blocks``) first, free lanes
   otherwise, lane utilization as the tiebreak.
+* **Priorities + deadlines** — ``submit(..., priority=, deadline_ms=)``
+  orders admission by priority class (larger first), oldest-first
+  within a class; a queued request whose deadline has passed — or is
+  infeasible given its queue position and the measured
+  ``serving.ttft_ms``/``serving.itl_ms`` medians — is EXPIRED up front
+  (``serving.slo_violation.expired``) instead of wasting a prefill.
+  With uniform priority and no deadlines the queue is plain FIFO,
+  bit-identical to the pre-priority router.
 * **SLO-aware admission** — a replica whose rolling
   ``serving.slo_attainment`` sits below ``slo_floor``
   (``MXNET_ROUTER_SLO_FLOOR``) stops taking NEW admissions until it
   recovers; its live streams keep decoding.
 * **Shedding** — when no replica can admit and the backlog exceeds
-  ``shed_queue`` (``MXNET_ROUTER_SHED_QUEUE``), the newest queued
-  requests are shed: the ``serving.slo_violation.shed`` counter
-  increments, the caller sees ``None`` for that rid, and the router
-  keeps serving instead of hanging.
-* **Failure draining** — a replica whose dispatch dies for good (the
-  PR 6 requeue path re-raises after its consecutive-failure cap) is
-  marked dead and DRAINED: its live requests go back to the front of
-  the router queue as continuations from their synced token prefix, so
-  greedy streams resume bit-exactly on a surviving replica (sampled
-  streams continue on a deterministically reseeded chain, the PR 6
-  recovery contract). Name replicas (``ContinuousBatcher(name="r1")``)
-  and a chaos spec like ``serving.dispatch.r1:error:every=1:count=0``
-  kills exactly one replica of the pool, replayably.
+  ``shed_queue`` (``MXNET_ROUTER_SHED_QUEUE``), the lowest-priority
+  newest queued requests are shed: the ``serving.slo_violation.shed``
+  counter increments, the caller sees ``None`` for that rid, and the
+  router keeps serving instead of hanging. Shed and expired are
+  separate counters — one is a capacity decision, the other a deadline
+  fact.
+* **Preemption absorption** — a replica that preempted low-priority
+  lanes to cover a high-priority admission (``serving.preemptions``)
+  hands the victims to the router, which requeues them at the front of
+  their priority class as continuations; they resume BIT-exactly vs
+  solo ``generate()`` (greedy and sampled — the batcher replays the
+  per-step key chain from the original seed).
+* **Failure draining + circuit breakers** — a replica whose dispatch
+  dies for good (the PR 6 requeue path re-raises after its
+  consecutive-failure cap) is DRAINED: its live requests go back to
+  the front of the router queue as continuations from their synced
+  token prefix, resuming bit-exactly on a surviving replica. Without
+  ``MXNET_ROUTER_BREAKER=1`` the drained replica is dead for good (the
+  pre-breaker contract); with it the replica enters a breaker loop —
+  CLOSED -> OPEN (capped exponential backoff, counted in router
+  steps) -> HALF_OPEN (one canary request routed normally and answered
+  bit-exactly) -> CLOSED — and the all-dead re-raise only fires once
+  every breaker is OPEN with its retries exhausted.
+  ``router.replica_state.<name>`` gauges export the machine (0=closed,
+  1=half_open, 2=open). Name replicas (``ContinuousBatcher(name="r1")``)
+  and a chaos spec like ``serving.dispatch.r1:error:at=6;...:at=7;
+  ...:at=8;...:at=9`` kills exactly one replica for exactly one drain
+  (four consecutive failures trip the batcher's re-raise), replayably.
 
 The replicas are process- or thread-local (the CPU smoke runs them in
 one process; telemetry is process-global, so per-replica SLO attainment
@@ -44,26 +67,37 @@ headroom are per-instance either way).
 import time
 from collections import deque
 
-import numpy as np
-
 from .serving import ContinuousBatcher
 from .. import _fastenv
 from ..observability import core as _obs
 
 __all__ = ["ReplicaRouter"]
 
+_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
 
 class _Job(object):
     __slots__ = ("rid", "prompt", "n_new", "seed", "stop_token",
-                 "enq_ns")
+                 "enq_ns", "priority", "deadline_ns", "emitted",
+                 "preempt_ns")
 
-    def __init__(self, rid, prompt, n_new, seed, stop_token, enq_ns):
+    def __init__(self, rid, prompt, n_new, seed, stop_token, enq_ns,
+                 priority=0, deadline_ns=None, emitted=0,
+                 preempt_ns=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.n_new = int(n_new)
         self.seed = int(seed)
         self.stop_token = stop_token
         self.enq_ns = enq_ns
+        self.priority = int(priority)
+        self.deadline_ns = deadline_ns
+        # emitted > 0 marks a CONTINUATION: `prompt` is the full synced
+        # token prefix (original prompt + emitted tokens), `n_new` the
+        # remaining budget, `seed` the ORIGINAL submit seed (the
+        # batcher replays the key chain `emitted` steps from it)
+        self.emitted = int(emitted)
+        self.preempt_ns = preempt_ns
 
 
 class ReplicaRouter(object):
@@ -71,12 +105,16 @@ class ReplicaRouter(object):
     module docstring for the policy). The API mirrors the batcher's:
     ``submit()`` enqueues and returns a router-level rid, ``step()``
     admits + steps every live replica and returns ``{rid: tokens}``
-    for completions (``None`` marks a shed request), ``run(jobs)``
+    for completions (``None`` marks a shed or expired request —
+    ``shed_rids``/``expired_rids`` tell them apart), ``run(jobs)``
     drives a whole workload. Every completed stream equals its solo
     ``generate()`` output — the per-replica identity the batcher
-    already guarantees, preserved across re-routing."""
+    already guarantees, preserved across re-routing, preemption and
+    breaker revival."""
 
-    def __init__(self, replicas, shed_queue=None, slo_floor=None):
+    def __init__(self, replicas, shed_queue=None, slo_floor=None,
+                 breaker=None, breaker_backoff=None,
+                 breaker_backoff_max=None, breaker_retries=None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
@@ -93,21 +131,48 @@ class ReplicaRouter(object):
             v = _fastenv.get("MXNET_ROUTER_SLO_FLOOR")
             slo_floor = float(v) if v else None
         self.slo_floor = slo_floor
+        if breaker is None:
+            breaker = (_fastenv.get("MXNET_ROUTER_BREAKER") or "") \
+                not in ("", "0", "false", "False")
+        self.breaker = bool(breaker)
+        if breaker_backoff is None:
+            v = _fastenv.get("MXNET_ROUTER_BREAKER_BACKOFF")
+            breaker_backoff = int(v) if v else 2
+        self._breaker_backoff = max(1, int(breaker_backoff))
+        if breaker_backoff_max is None:
+            v = _fastenv.get("MXNET_ROUTER_BREAKER_BACKOFF_MAX")
+            breaker_backoff_max = int(v) if v else 32
+        self._breaker_backoff_max = max(self._breaker_backoff,
+                                        int(breaker_backoff_max))
+        if breaker_retries is None:
+            v = _fastenv.get("MXNET_ROUTER_BREAKER_RETRIES")
+            breaker_retries = int(v) if v else 5
+        self._breaker_retries = max(0, int(breaker_retries))
+        n = len(self.replicas)
+        self._brk_state = ["closed"] * n
+        self._brk_backoff = [self._breaker_backoff] * n
+        self._brk_open_left = [0] * n     # step countdown while OPEN
+        self._brk_trips = [0] * n         # consecutive drains
+        self._brk_canary = [None] * n     # router rid probing HALF_OPEN
+        self.breaker_events = []          # (name, from_state, to_state)
         self._queue = deque()          # _Job, oldest first
         self._next_rid = 0
         # (replica_idx, replica_rid) -> (router_rid, _Job)
         self._live = {}
         self.shed_rids = []
+        self.expired_rids = []
+        self._last_exc = None
 
     @classmethod
     def build(cls, params, cfg, n_replicas=2, shed_queue=None,
-              slo_floor=None, **batcher_kw):
+              slo_floor=None, breaker=None, **batcher_kw):
         """Construct n named replicas (r0..rN-1) over shared params and
         front them — the one-liner the bench and smoke use."""
         reps = [ContinuousBatcher(params, cfg, name="r%d" % i,
                                   **batcher_kw)
                 for i in range(n_replicas)]
-        return cls(reps, shed_queue=shed_queue, slo_floor=slo_floor)
+        return cls(reps, shed_queue=shed_queue, slo_floor=slo_floor,
+                   breaker=breaker)
 
     # ---- queueing ----
 
@@ -120,64 +185,171 @@ class ReplicaRouter(object):
         """Live requests across the fleet (admitted, not finished)."""
         return len(self._live)
 
-    def submit(self, prompt, n_new, seed=0, stop_token=None):
+    def submit(self, prompt, n_new, seed=0, stop_token=None,
+               priority=0, deadline_ms=None):
         """Enqueue one request; returns its router-level rid. Admission
         happens at the next step(), on whichever replica the routing
-        policy picks."""
+        policy picks — higher `priority` admits first (FIFO within a
+        class), and a `deadline_ms` budget (from now) lets the router
+        expire the request up front instead of serving it late."""
         rid = self._next_rid
         self._next_rid += 1
-        enq = time.perf_counter_ns() if _obs.enabled() else None
+        now = (time.perf_counter_ns()
+               if (deadline_ms is not None or _obs.enabled()) else None)
+        enq = now if _obs.enabled() else None
+        ddl = (None if deadline_ms is None
+               else now + int(deadline_ms * 1e6))
         self._queue.append(_Job(rid, prompt, n_new, seed, stop_token,
-                                enq))
+                                enq, priority=priority,
+                                deadline_ns=ddl))
         return rid
 
     # ---- routing policy ----
 
-    def _eligible(self):
+    def _eligible(self, job=None):
         """Replicas that may take NEW admissions this round: alive,
         lane+block capacity, and (when slo_floor is set) rolling SLO
-        attainment at or above the floor — best headroom first."""
+        attainment at or above the floor — best headroom first. A
+        HALF_OPEN replica is eligible only while its canary slot is
+        unclaimed, and bypasses the SLO floor (the probe must be able
+        to run while the very attainment it is meant to restore is
+        depressed). With a `job` in hand, a replica with a free lane
+        but NO block headroom still qualifies — ranked last — when it
+        runs strictly-lower-priority work, because preempting that
+        work can fund the admission (the batcher's own admit() makes
+        the final call)."""
         scored = []
         for i, r in enumerate(self.replicas):
-            if not self._alive[i] or not r.has_capacity:
+            if not self._alive[i]:
+                continue
+            preempt_only = False
+            if not r.has_capacity:
+                if job is None or not getattr(r, "paged", False) \
+                        or r.active_count >= r.max_batch \
+                        or not any(q is not None
+                                   and q.priority < job.priority
+                                   for q in r._slots):
+                    continue
+                preempt_only = True
+            half_open = self._brk_state[i] == "half_open"
+            if half_open and self._brk_canary[i] is not None:
                 continue
             snap = r.health_snapshot()
             att = snap.get("serving.slo_attainment")
-            if self.slo_floor is not None and att is not None \
-                    and att < self.slo_floor:
+            if not half_open and self.slo_floor is not None \
+                    and att is not None and att < self.slo_floor:
                 continue
             headroom = snap.get("serving.kv_available_blocks")
             if headroom is None:
                 headroom = r.max_batch - snap["serving.lane_occupancy"]
-            scored.append((-headroom,
+            scored.append((preempt_only, -headroom,
                            snap["serving.lane_utilization"], i))
-        return [i for _, _, i in sorted(scored)]
+        return [i for _, _, _, i in sorted(scored)]
+
+    def _fleet_lanes(self):
+        return sum(r.max_batch for i, r in enumerate(self.replicas)
+                   if self._alive[i])
+
+    def _eta_ms(self, job, ahead):
+        """Optimistic completion estimate for a queued job with `ahead`
+        jobs of its priority class (or higher) in front of it, from the
+        measured latency medians: each wave of `fleet_lanes` admissions
+        costs one median stream (TTFT + n_new ITLs). Returns None when
+        the histograms are empty — never expire on no data."""
+        ttft = _obs.histogram("serving.ttft_ms", "ms")
+        itl = _obs.histogram("serving.itl_ms", "ms")
+        if not ttft.count or not itl.count:
+            return None
+        lanes = self._fleet_lanes()
+        if lanes <= 0:
+            return None
+        per = ttft.percentile(0.5) + job.n_new * itl.percentile(0.5)
+        return (ahead // lanes + 1) * per
+
+    def _expire_queued(self, finished):
+        """Walk the queue and expire every job whose deadline already
+        passed, or whose optimistic ETA (queue position x measured
+        medians) overruns the time it has left. An expiry costs the
+        caller nothing but the wait so far; serving it would cost a
+        prefill and block a lane for a stream nobody can use."""
+        if not any(j.deadline_ns is not None for j in self._queue):
+            return
+        now = time.perf_counter_ns()
+        keep = deque()
+        for job in self._queue:
+            expired = False
+            if job.deadline_ns is not None:
+                left_ms = (job.deadline_ns - now) / 1e6
+                if left_ms <= 0:
+                    expired = True
+                else:
+                    ahead = sum(1 for j in keep
+                                if j.priority >= job.priority)
+                    eta = self._eta_ms(job, ahead)
+                    expired = eta is not None and eta > left_ms
+            if not expired:
+                keep.append(job)
+                continue
+            self.expired_rids.append(job.rid)
+            finished[job.rid] = None
+            _obs.counter("serving.slo_violation.expired").add(1)
+            if _obs.enabled():
+                _obs.counter("router.expired").add(1)
+                _obs.record_instant(
+                    "router.expired", cat="serving",
+                    args={"rid": job.rid, "priority": job.priority})
+        self._queue = keep
 
     def _admit_queued(self, finished):
+        self._expire_queued(finished)
         while self._queue:
-            order = self._eligible()
+            # strict priority, FIFO within a class (max() returns the
+            # FIRST maximal element, so uniform priorities reduce
+            # exactly to the old head-of-line scan)
+            job = max(self._queue, key=lambda j: j.priority)
+            order = self._eligible(job)
             if not order:
                 break
-            job = self._queue[0]
             admitted = False
             for i in order:
-                rep_rid = self.replicas[i].admit(
-                    job.prompt, job.n_new, seed=job.seed,
-                    stop_token=job.stop_token, enqueued_ns=job.enq_ns)
+                rep = self.replicas[i]
+                if job.emitted > 0:
+                    rep_rid = rep.admit_continuation(
+                        job.prompt, job.n_new, seed=job.seed,
+                        emitted=job.emitted,
+                        stop_token=job.stop_token,
+                        priority=job.priority,
+                        preempted_ns=job.preempt_ns)
+                else:
+                    rep_rid = rep.admit(
+                        job.prompt, job.n_new, seed=job.seed,
+                        stop_token=job.stop_token,
+                        enqueued_ns=job.enq_ns,
+                        priority=job.priority)
                 if rep_rid is not None:
-                    self._queue.popleft()
+                    self._queue.remove(job)
                     self._live[(i, rep_rid)] = (job.rid, job)
+                    if self.breaker \
+                            and self._brk_state[i] == "half_open" \
+                            and self._brk_canary[i] is None:
+                        self._brk_canary[i] = job.rid
+                    if rep.preempted:
+                        self._absorb_preempted(i, rep)
                     if _obs.enabled():
                         _obs.counter("router.routed").add(1)
                     admitted = True
                     break
             if not admitted:
                 break
-        # shed the backlog the fleet cannot absorb (newest first —
-        # the oldest waiters keep their place)
+        # shed the backlog the fleet cannot absorb: lowest priority
+        # first, newest within a class — the oldest high-priority
+        # waiters keep their place
         if self.shed_queue is not None:
             while len(self._queue) > self.shed_queue:
-                job = self._queue.pop()
+                ix = min(range(len(self._queue)),
+                         key=lambda k: (self._queue[k].priority, -k))
+                job = self._queue[ix]
+                del self._queue[ix]
                 self.shed_rids.append(job.rid)
                 finished[job.rid] = None
                 _obs.counter("serving.slo_violation.shed").add(1)
@@ -185,17 +357,43 @@ class ReplicaRouter(object):
                     _obs.counter("router.shed").add(1)
                     _obs.record_instant(
                         "router.shed", cat="serving",
-                        args={"rid": job.rid,
+                        args={"rid": job.rid, "priority": job.priority,
                               "queued": len(self._queue)})
 
-    def _drain_replica(self, i, exc):
-        """Replica i's dispatch died for good: mark it dead and put its
-        live requests back at the FRONT of the queue as continuations
-        from their synced token prefix — the same resume identity as
-        the in-replica requeue (cache is a pure function of the
-        prefix), so greedy streams stay bit-exact on whichever replica
-        picks them up. Sampled continuations are deterministically
-        reseeded (seed folded with the emission count)."""
+    def _absorb_preempted(self, i, rep):
+        """Replica i preempted low-priority lanes to cover an
+        admission — move the victims into the router queue as
+        continuations at the front of the line (priority selection
+        still ranks them below the high-priority work that displaced
+        them). Their resume is bit-exact: full synced prefix, original
+        seed, cumulative emission count for the key-chain replay."""
+        conts = []
+        for req, t_ns in rep.preempted:
+            entry = self._live.pop((i, req.rid), None)
+            if entry is None:
+                continue               # not routed by us — drop
+            rid, job = entry
+            conts.append(_Job(rid, req.tokens,
+                              req.n_new - req.emitted, job.seed,
+                              req.stop_token, job.enq_ns,
+                              priority=job.priority,
+                              deadline_ns=job.deadline_ns,
+                              emitted=req.emitted, preempt_ns=t_ns))
+        rep.preempted = []
+        for cont in reversed(conts):
+            self._queue.appendleft(cont)
+
+    def _drain_replica(self, i, exc, finished):
+        """Replica i's dispatch died for good: take it out of rotation
+        and put its live requests back at the FRONT of the queue as
+        continuations from their synced token prefix — the same resume
+        identity as the in-replica requeue (cache is a pure function
+        of the prefix, the sampling key chain is replayed from the
+        original seed), so completed streams stay bit-exact on
+        whichever replica picks them up. Without the breaker the
+        replica is dead permanently; with it the breaker opens with
+        capped exponential backoff and the replica's state is rebuilt
+        (``reset_lanes``) ahead of its HALF_OPEN canary."""
         self._alive[i] = False
         rep = self.replicas[i]
         drained = []
@@ -207,13 +405,31 @@ class ReplicaRouter(object):
             del self._live[(ri, rep_rid)]
             if req is None:
                 continue
-            cont = _Job(rid, req.tokens,
-                        req.n_new - req.emitted,
-                        (job.seed * 1000003 + req.emitted) & 0x7fffffff,
-                        req.stop_token, job.enq_ns)
-            drained.append(cont)
+            if req.n_new - req.emitted <= 0:
+                # complete at the instant of death — nothing to resume
+                finished[rid] = list(req.tokens)
+                continue
+            drained.append(_Job(rid, req.tokens,
+                                req.n_new - req.emitted, job.seed,
+                                req.stop_token, job.enq_ns,
+                                priority=job.priority,
+                                deadline_ns=job.deadline_ns,
+                                emitted=req.emitted))
         for cont in reversed(drained):
             self._queue.appendleft(cont)
+        if self.breaker:
+            self._brk_trips[i] += 1
+            self._brk_canary[i] = None
+            if self._brk_trips[i] <= self._breaker_retries:
+                try:
+                    rep.reset_lanes()
+                except Exception:      # noqa: BLE001 — stay broken
+                    self._brk_trips[i] = self._breaker_retries + 1
+            if self._brk_trips[i] <= self._breaker_retries:
+                self._brk_open_left[i] = self._brk_backoff[i]
+                self._brk_backoff[i] = min(
+                    self._brk_backoff[i] * 2, self._breaker_backoff_max)
+            self._transition(i, "open")
         if _obs.enabled():
             _obs.counter("router.replica_failures").add(1)
             _obs.counter("router.drained_requests").add(len(drained))
@@ -222,38 +438,96 @@ class ReplicaRouter(object):
                 args={"replica": rep.name, "drained": len(drained),
                       "error": "%s: %s" % (type(exc).__name__, exc)})
 
+    # ---- circuit breaker ----
+
+    def _transition(self, i, state):
+        """Move replica i's breaker to `state`, record the transition
+        (``breaker_events``, instant, gauge)."""
+        old = self._brk_state[i]
+        if old == state:
+            return
+        self._brk_state[i] = state
+        self.breaker_events.append((self.replicas[i].name, old, state))
+        if _obs.enabled():
+            _obs.gauge("router.replica_state.%s"
+                       % self.replicas[i].name).set(_STATE_CODE[state])
+            _obs.record_instant(
+                "router.breaker", cat="serving",
+                args={"replica": self.replicas[i].name,
+                      "from": old, "to": state,
+                      "trips": self._brk_trips[i]})
+
+    def _breaker_tick(self, i):
+        """One router step elapsed for an OPEN replica: count the
+        backoff down; at zero enter HALF_OPEN — back in rotation for
+        exactly one canary admission."""
+        if self._brk_state[i] != "open" \
+                or self._brk_trips[i] > self._breaker_retries:
+            return
+        self._brk_open_left[i] -= 1
+        if self._brk_open_left[i] <= 0:
+            self._brk_canary[i] = None
+            self._alive[i] = True
+            self._transition(i, "half_open")
+
+    def _breaker_close(self, i):
+        """The canary finished bit-exactly: the replica is healthy —
+        close the breaker and forget the failure history."""
+        self._brk_trips[i] = 0
+        self._brk_backoff[i] = self._breaker_backoff
+        self._brk_canary[i] = None
+        self._transition(i, "closed")
+
     # ---- scheduling ----
 
     def step(self):
-        """One fleet scheduling round: admit what the policy allows,
-        shed what it must, step every live replica (draining any that
-        die), and return ``{router_rid: tokens}`` for requests that
-        finished — ``None`` for shed ones. Raises the last replica
-        failure when NO replica survives (the fleet cannot make
-        progress; callers own the restart policy above that)."""
+        """One fleet scheduling round: expire what cannot make its
+        deadline, admit what the policy allows, shed what it must,
+        step every live replica (draining any that die, ticking open
+        breakers), and return ``{router_rid: tokens}`` for requests
+        that finished — ``None`` for shed/expired ones. Raises the
+        last replica failure only when NO replica can ever make
+        progress again: every one dead (breaker off) or every breaker
+        OPEN with its retries exhausted (breaker on); callers own the
+        restart policy above that."""
         finished = {}
         self._admit_queued(finished)
         last_exc = None
         for i, rep in enumerate(self.replicas):
             if not self._alive[i]:
+                if self.breaker:
+                    self._breaker_tick(i)
                 continue
             try:
                 done = rep.step()
             except Exception as exc:   # noqa: BLE001 — drain-or-raise
                 last_exc = exc
-                self._drain_replica(i, exc)
+                self._last_exc = exc
+                self._drain_replica(i, exc, finished)
                 continue
+            if rep.preempted:
+                self._absorb_preempted(i, rep)
             for rep_rid, toks in done.items():
                 key = (i, rep_rid)
                 if key in self._live:
                     rid, _ = self._live.pop(key)
                     finished[rid] = toks
+                    if self.breaker and self._brk_canary[i] == rid:
+                        self._breaker_close(i)
         if not any(self._alive):
-            raise last_exc if last_exc is not None else RuntimeError(
-                "no live replicas")
+            exhausted = (not self.breaker) or all(
+                t > self._breaker_retries for t in self._brk_trips)
+            if exhausted:
+                exc = last_exc if last_exc is not None \
+                    else self._last_exc
+                raise exc if exc is not None else RuntimeError(
+                    "no live replicas")
         if _obs.enabled():
             _obs.gauge("router.queue_depth").set(len(self._queue))
             _obs.gauge("router.replicas_alive").set(self.alive_count)
+            for i, r in enumerate(self.replicas):
+                _obs.gauge("router.replica_state.%s" % r.name).set(
+                    _STATE_CODE[self._brk_state[i]])
             # fleet-wide speculative health: the WORST alive replica's
             # acceptance ratio (the one an operator would retune
             # spec_k for) — absent when no replica speculates
@@ -265,11 +539,29 @@ class ReplicaRouter(object):
                 _obs.gauge("router.spec_accept_ratio").set(min(ratios))
         return finished
 
+    def health_snapshot(self):
+        """Router-level ``/healthz`` mirror: queue + fleet gauges, the
+        shed/expired accounting (separate counters — satellite of the
+        overload PR), and every replica's breaker state, one dict of
+        scrape-shaped names."""
+        snap = {
+            "router.queue_depth": len(self._queue),
+            "router.replicas_alive": self.alive_count,
+            "router.active_requests": len(self._live),
+            "serving.slo_violation.shed": len(self.shed_rids),
+            "serving.slo_violation.expired": len(self.expired_rids),
+        }
+        for i, r in enumerate(self.replicas):
+            snap["router.replica_state.%s" % r.name] = \
+                _STATE_CODE[self._brk_state[i]]
+        return snap
+
     def run(self, requests):
-        """Serve ``(prompt, n_new[, seed[, stop_token]])`` jobs through
-        the fleet. Returns ({rid: tokens-or-None-if-shed}, submission
-        order) — same contract as ContinuousBatcher.run() plus the
-        shed marker."""
+        """Serve ``(prompt, n_new[, seed[, stop_token[, priority
+        [, deadline_ms]]]])`` jobs through the fleet. Returns
+        ({rid: tokens-or-None-if-shed-or-expired}, submission order) —
+        same contract as ContinuousBatcher.run() plus the shed/expired
+        marker (``shed_rids``/``expired_rids`` tell them apart)."""
         order = [self.submit(*job) for job in requests]
         results = {}
         while self._queue or self._live:

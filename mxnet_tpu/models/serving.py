@@ -664,19 +664,90 @@ class BlockAllocator(object):
         self.reserved -= int(n)
         assert self.reserved >= 0, "reservation accounting underflow"
 
+    def check_invariants(self, mappings=None, quiesce=False):
+        """Structural audit of the allocator — the standing leak/race
+        detector every serving PR gets for free. Raises RuntimeError on
+        the first violation, returns True otherwise.
+
+        * conservation: every non-null block is EITHER on the free list
+          (refcount 0) or referenced (refcount >= 1), never both, never
+          neither — and the free list holds no duplicates.
+        * ``mappings`` (optional): iterable of block-id lists (live lane
+          tables + prefix-cache entries). Each block's refcount must
+          equal the number of mappings that hold it, and no mapped
+          block may sit on the free list.
+        * ``reserved`` never exceeds the free list (``available >= 0``
+          is the promise admission accounting makes).
+        * ``quiesce=True``: nothing live may remain — every block free,
+          every refcount zero, zero reservation (the zero-leak bar the
+          overload harness asserts after a storm)."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise RuntimeError("free list holds duplicate block ids")
+        if 0 in free:
+            raise RuntimeError("null block 0 leaked onto the free list")
+        if int(self.ref[0]) != 0:
+            raise RuntimeError("null block 0 acquired a refcount")
+        for b in range(1, self.num_blocks):
+            r = int(self.ref[b])
+            if b in free and r != 0:
+                raise RuntimeError(
+                    "block %d is free but refcount=%d" % (b, r))
+            if b not in free and r < 1:
+                raise RuntimeError(
+                    "block %d leaked: refcount=%d and not free" % (b, r))
+        if self.reserved < 0:
+            raise RuntimeError("negative reservation")
+        if self.reserved > len(self._free):
+            raise RuntimeError(
+                "reserved %d exceeds free list %d — admission promised "
+                "blocks that cannot be delivered"
+                % (self.reserved, len(self._free)))
+        if mappings is not None:
+            want = {}
+            for blocks in mappings:
+                for b in blocks:
+                    want[b] = want.get(b, 0) + 1
+            for b, n in want.items():
+                if b in free:
+                    raise RuntimeError(
+                        "mapped block %d sits on the free list" % b)
+                if int(self.ref[b]) != n:
+                    raise RuntimeError(
+                        "block %d refcount=%d but %d mappings hold it"
+                        % (b, int(self.ref[b]), n))
+            for b in range(1, self.num_blocks):
+                if int(self.ref[b]) > 0 and b not in want:
+                    raise RuntimeError(
+                        "block %d refcount=%d but no mapping holds it "
+                        "(leak)" % (b, int(self.ref[b])))
+        if quiesce:
+            if self.reserved != 0:
+                raise RuntimeError(
+                    "quiesce with %d blocks still reserved"
+                    % self.reserved)
+            if len(self._free) != self.num_blocks - 1:
+                raise RuntimeError(
+                    "quiesce with %d of %d blocks leaked"
+                    % (self.num_blocks - 1 - len(self._free),
+                       self.num_blocks - 1))
+        return True
+
 
 class Request(object):
     __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token",
-                 "seed", "t_enq_ns", "t_admit_ns", "t_first_ns",
-                 "t_last_ns", "slo_bad")
+                 "seed", "priority", "t_enq_ns", "t_admit_ns",
+                 "t_first_ns", "t_last_ns", "slo_bad")
 
-    def __init__(self, rid, prompt, n_new, stop_token=None, seed=0):
+    def __init__(self, rid, prompt, n_new, stop_token=None, seed=0,
+                 priority=0):
         self.rid = rid
         self.tokens = list(prompt)   # prompt + generated so far
         self.n_new = n_new
         self.emitted = 0             # generated count
         self.stop_token = stop_token
         self.seed = seed             # sampling seed (requeue needs it)
+        self.priority = int(priority)  # larger = more important
         # request-lifecycle clock (perf_counter_ns; None with obs off):
         # enqueue -> admit -> first token -> last host-visible token
         self.t_enq_ns = None
@@ -781,7 +852,8 @@ class ContinuousBatcher(object):
                  paged=None, block_size=None, num_blocks=None,
                  name=None, spec_k=None, spec_ngram=None,
                  spec_accept_floor=None, draft_params=None,
-                 draft_cfg=None):
+                 draft_cfg=None, brownout=None, brownout_attain=None,
+                 brownout_trip=None, brownout_clear=None):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
@@ -981,6 +1053,44 @@ class ContinuousBatcher(object):
         # blocks instead of copying them
         self._prefix_cache = {}
         self._prefix_slots = int(prefix_cache_slots)
+        # KV-pressure preemption: admit(priority=...) may evict a
+        # strictly lower-priority lane to cover a block shortfall; the
+        # victim lands here as (Request, preempt_ns) for the caller
+        # (router._admit_queued, or run()) to resume bit-exactly via
+        # admit_continuation()
+        self.preempted = []
+        # brownout ladder (MXNET_SERVING_BROWNOUT=1): rung 0 is
+        # healthy; sustained SLO-attainment drop or block exhaustion
+        # climbs one rung at a time — 1: clamp the speculative draft
+        # width, 2: stop admitting new shareable prefixes, 3: throttle
+        # admission to one per scheduling round, 4: shed the lowest
+        # priority class — and sustained recovery walks back down
+        # (hysteresis: the trip and clear streaks differ)
+        if brownout is None:
+            brownout = (_fastenv.get("MXNET_SERVING_BROWNOUT") or "") \
+                not in ("", "0", "false", "False")
+        self.brownout = bool(brownout)
+        if brownout_attain is None:
+            v = _fastenv.get("MXNET_SERVING_BROWNOUT_ATTAIN")
+            brownout_attain = float(v) if v else 0.9
+        self._brownout_attain = float(brownout_attain)
+        if brownout_trip is None:
+            v = _fastenv.get("MXNET_SERVING_BROWNOUT_TRIP")
+            brownout_trip = int(v) if v else 3
+        self._brownout_trip = int(brownout_trip)
+        if brownout_clear is None:
+            v = _fastenv.get("MXNET_SERVING_BROWNOUT_CLEAR")
+            brownout_clear = int(v) if v else 8
+        self._brownout_clear = int(brownout_clear)
+        self._bo_rung = 0
+        self._bo_bad = 0
+        self._bo_good = 0
+        self._round_admits = 0
+        # MXNET_SERVING_DEBUG=1: allocator invariants audited at every
+        # idle point (cheap standing leak detector; tests call
+        # check_invariants unconditionally)
+        self._debug = (_fastenv.get("MXNET_SERVING_DEBUG") or "") \
+            not in ("", "0", "false", "False")
 
     # ---- admission ----
 
@@ -1032,7 +1142,45 @@ class ContinuousBatcher(object):
                 self._spec_accepted / self._spec_drafted
                 if self._spec_drafted else 1.0)
             snap["serving.spec_k_live"] = float(np.mean(self._keff))
+        if self.brownout:
+            snap["serving.brownout_rung"] = self._bo_rung
         return snap
+
+    def check_invariants(self, quiesce=False):
+        """Audit paged block accounting against every live mapping —
+        lane tables plus prefix-cache entries (see
+        BlockAllocator.check_invariants). ``quiesce=True`` additionally
+        demands zero live lanes, an empty prefix cache's worth of
+        references released, and a whole free list — the zero-leak bar.
+        A no-op (True) when not paged."""
+        if not self.paged:
+            return True
+        mappings = [b for b in self._lane_blocks if b]
+        mappings += [blocks for blocks, _ in
+                     self._prefix_cache.values()]
+        self._alloc.check_invariants(
+            mappings=mappings,
+            quiesce=quiesce and not self._prefix_cache)
+        if quiesce and self.active_count:
+            raise RuntimeError(
+                "quiesce with %d live requests" % self.active_count)
+        for i, req in enumerate(self._slots):
+            if req is None and self._lane_blocks[i]:
+                raise RuntimeError(
+                    "freed lane %d still maps %d blocks"
+                    % (i, len(self._lane_blocks[i])))
+            if req is None and self._lane_need[i]:
+                raise RuntimeError(
+                    "freed lane %d still reserves toward a %d-block "
+                    "lifetime" % (i, self._lane_need[i]))
+        return True
+
+    def _debug_idle_check(self):
+        """The MXNET_SERVING_DEBUG=1 idle-point audit: whenever the
+        pool drains, the allocator must balance (every future serving
+        change inherits this leak detector for free)."""
+        if self._debug and self.paged and self.active_count == 0:
+            self.check_invariants()
 
     # ---- paged block accounting ----
 
@@ -1279,7 +1427,7 @@ class ContinuousBatcher(object):
                     jnp.int32(bid))
 
     def admit(self, prompt, n_new, seed=0, stop_token=None,
-              enqueued_ns=None):
+              enqueued_ns=None, priority=0):
         """Prefill `prompt` into a free slot; returns the request id,
         or None when every slot is busy. The first generated token is
         produced here (from the prefill logits), so a request with
@@ -1291,7 +1439,13 @@ class ContinuousBatcher(object):
         request entered the caller's queue — with telemetry on it
         anchors the serving.queue_wait span and the serving.queue_ms /
         serving.ttft_ms histograms (run()/stream() pass it; without it
-        TTFT is measured from this call)."""
+        TTFT is measured from this call). `priority` (larger = more
+        important, default 0) drives KV-pressure PREEMPTION under
+        paging: when the block pool cannot cover this admission, the
+        lowest-priority strictly-below-`priority` lane is evicted to
+        ``self.preempted`` (its synced prefix captured for a bit-exact
+        resume via admit_continuation()) and its blocks fund this
+        admission. With uniform priorities nothing is ever preempted."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
         obs_on = _obs.enabled()
@@ -1303,6 +1457,9 @@ class ContinuousBatcher(object):
         if t_p + n_new > self.cfg.max_len:
             raise ValueError("prompt+n_new %d exceeds max_len %d"
                              % (t_p + n_new, self.cfg.max_len))
+        if self.brownout and self._bo_rung > 0 \
+                and not self._brownout_admit_ok(priority):
+            return None
         slot = next((i for i, s in enumerate(self._slots) if s is None),
                     None)
         if slot is None:
@@ -1319,6 +1476,11 @@ class ContinuousBatcher(object):
                 # prefix, so model-draft paged serving prefills whole
                 # (cache_prefix refuses; see there)
                 p_len, pfx_blocks, pfx_logits = 0, [], None
+            elif self.brownout and self._bo_rung >= 2:
+                # brownout rung 2+: no NEW shared-prefix admissions —
+                # sharing pins blocks past the sharer's own lifetime,
+                # the opposite of what an exhausted pool needs
+                p_len, pfx_blocks, pfx_logits = 0, [], None
             else:
                 p_len, pfx_blocks, pfx_logits = \
                     self._lookup_prefix_blocks(prompt)
@@ -1333,7 +1495,8 @@ class ContinuousBatcher(object):
             if demand > self._alloc.available and not \
                     self._evict_prefixes(
                         demand,
-                        keep=tuple(prompt[:p_len]) if p_len else None):
+                        keep=tuple(prompt[:p_len]) if p_len else None) \
+                    and not self._preempt_for(demand, priority):
                 return None
         rid = self._next_rid
         pre_span = _obs.span("serving.prefill", cat="serving", rid=rid,
@@ -1411,14 +1574,222 @@ class ContinuousBatcher(object):
         if self._spec_on:
             self._spec_admit(slot, prompt, t_p, first)
         pre_span.stop()
-        req = Request(rid, prompt, n_new, stop_token, seed=seed)
+        req = Request(rid, prompt, n_new, stop_token, seed=seed,
+                      priority=priority)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
         self._slots[slot] = req
+        self._round_admits += 1
         if obs_on:
             self._note_admit(req, slot, t0_ns, enqueued_ns)
         return req.rid
+
+    def admit_continuation(self, tokens, n_more, seed=0, emitted=1,
+                           stop_token=None, priority=0,
+                           preempted_ns=None):
+        """Resume a suspended stream BIT-exactly: `tokens` is the full
+        synced history (prompt + `emitted` generated tokens), `n_more`
+        the remaining budget. The cache is re-prefilled over
+        tokens[:-1] and decode resumes feeding the last token at its
+        true position — the requeue identity — and, under sampling,
+        the per-request key chain is REPLAYED to its post-`emitted`
+        state (split applied `emitted` times from PRNGKey(seed)), so a
+        preempted-then-resumed stream is bit-identical to its
+        uninterrupted solo run, sampled included (the dispatch-failure
+        requeue path keeps its coarser reseed contract). Returns the
+        NEW request id, or None when no lane/blocks are free.
+        `preempted_ns` (perf_counter_ns of the preemption) feeds the
+        serving.preempt_stall_ms histogram."""
+        if n_more < 1:
+            raise ValueError("n_more must be >= 1")
+        if emitted < 1:
+            raise ValueError(
+                "a continuation resumes a stream that emitted at "
+                "least its first token (emitted >= 1)")
+        obs_on = _obs.enabled()
+        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        m = len(tokens) - 1
+        if m < 1:
+            raise ValueError("continuation needs prompt + first token")
+        if len(tokens) + n_more > self.cfg.max_len:
+            raise ValueError("history+n_more %d exceeds max_len %d"
+                             % (len(tokens) + n_more, self.cfg.max_len))
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            return None
+        if self.paged:
+            lifetime, init_n = self._block_math(m, len(tokens) + n_more)
+            if lifetime > self.num_blocks - 1:
+                raise ValueError(
+                    "continuation needs %d KV blocks but the pool has "
+                    "only %d usable" % (lifetime, self.num_blocks - 1))
+            if lifetime > self._alloc.available and not \
+                    self._evict_prefixes(lifetime) \
+                    and not self._preempt_for(lifetime, priority):
+                return None
+        rid = self._next_rid
+        pre_span = _obs.span("serving.prefill", cat="serving", rid=rid,
+                             lane=slot, kind="resume",
+                             prompt_tokens=m).start()
+        ctx, last = tokens[:-1], tokens[-1]
+        row_cache = tf.init_cache(self.cfg, 1)
+        width = min(_bucket(m), self.cfg.max_len)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :m] = ctx
+        _, row_cache = tf._jitted_prefill_chunk_row(self.cfg)(
+            self.params, row_cache, jnp.asarray(padded),
+            jnp.int32(0), jnp.int32(m - 1))
+        key_np = self._resume_key(seed, emitted)
+        if self.paged:
+            self._paged_map_lane(slot, m, row_cache, 0, [], lifetime,
+                                 init_n)
+        else:
+            self._cache = _jitted_slot_write(self.cfg)(
+                self._cache, row_cache, jnp.int32(slot))
+        if self._device_carry:
+            with _obs.span("serving.patch", cat="serving",
+                           kind="resume", lane=slot):
+                self._dev_tok, self._dev_pos, self._dev_keys = \
+                    self._patch_fn(self._dev_tok, self._dev_pos,
+                                   self._dev_keys, jnp.int32(slot),
+                                   jnp.int32(last), jnp.int32(m),
+                                   jnp.asarray(key_np))
+        else:
+            self._pos[slot] = m
+            self._tok[slot] = last
+            self._keys[slot] = key_np
+        if self._spec_on:
+            self._spec_admit(slot, ctx, m, last)
+        pre_span.stop()
+        req = Request(rid, tokens, emitted + n_more, stop_token,
+                      seed=seed, priority=priority)
+        req.emitted = emitted
+        self._next_rid += 1
+        self._slots[slot] = req
+        self._round_admits += 1
+        if obs_on:
+            t1 = time.perf_counter_ns()
+            req.t_admit_ns = req.t_first_ns = req.t_last_ns = t1
+            if preempted_ns is not None:
+                _obs.histogram("serving.preempt_stall_ms", "ms") \
+                    .observe((t1 - preempted_ns) / 1e6)
+            _obs.record_instant(
+                "serving.resumed", cat="serving",
+                args={"rid": rid, "lane": slot, "resume_pos": m,
+                      "priority": priority})
+            self._publish_occupancy()
+        return rid
+
+    def _resume_key(self, seed, emitted):
+        """The per-request sampling key chain, replayed host-side to
+        its state after `emitted` tokens: admit() splits PRNGKey(seed)
+        once for the first token, every decode step splits once more
+        and carries split()[0] — so the carried key after `emitted`
+        tokens is split applied `emitted` times. This is what makes a
+        preempted sampled stream resume bit-exactly (zeros under
+        greedy: the chain is never read)."""
+        if self.greedy:
+            return np.zeros((2,), np.uint32)
+        key = jax.random.PRNGKey(seed)
+        for _ in range(int(emitted)):
+            key = jax.random.split(key)[0]
+        return np.asarray(key, np.uint32)
+
+    def _preempt_for(self, demand, priority):
+        """Fund a `priority` admission short `demand` available blocks
+        by preempting strictly-lower-priority lanes, lowest priority
+        first and the YOUNGEST (largest rid) within a class — the
+        cheapest prefix to throw away. Victims are captured into
+        ``self.preempted`` as (Request, preempt_ns) with their synced
+        token prefix intact (in-flight emissions discard by rid at
+        sync, the cancel() rule) and their blocks — speculative draft
+        over-allocation included — return to the pool via _free().
+        Returns True when the demand is covered. A cheap upper bound
+        (every victim's whole lifetime need) guards against preempting
+        work that could not cover the demand anyway; a shared prefix
+        block that outlives its sharer can still leave the greedy loop
+        short, in which case the victims resume later and the
+        admission simply fails this round."""
+        if not self.paged:
+            return False
+        victims = [i for i, r in enumerate(self._slots)
+                   if r is not None and r.priority < priority]
+        bound = self._alloc.available \
+            + sum(self._lane_need[i] for i in victims)
+        if bound < demand:
+            return False
+        while self._alloc.available < demand:
+            live = [(r.priority, -r.rid, i)
+                    for i, r in enumerate(self._slots)
+                    if r is not None and r.priority < priority]
+            if not live:
+                break
+            _, _, i = min(live)
+            req = self._slots[i]
+            t_ns = time.perf_counter_ns()
+            _obs.counter("serving.preemptions").add(1)
+            if _obs.enabled():
+                _obs.record_instant(
+                    "serving.preempt", cat="serving",
+                    args={"rid": req.rid, "lane": i,
+                          "priority": req.priority,
+                          "for_priority": priority,
+                          "synced": req.emitted})
+            self._free(i)
+            self.preempted.append((req, t_ns))
+        return self._alloc.available >= demand
+
+    def _brownout_admit_ok(self, priority):
+        """The rung-3/4 admission gates (rungs 1-2 act on the decode
+        and prefix paths, not here): rung 3 throttles to one admission
+        per scheduling round, rung 4 sheds the lowest priority class
+        outright."""
+        if self._bo_rung >= 4 and priority <= 0:
+            if _obs.enabled():
+                _obs.counter("serving.brownout_rejections").add(1)
+            return False
+        if self._bo_rung >= 3 and self._round_admits >= 1:
+            return False
+        return True
+
+    def _brownout_tick(self):
+        """One controller evaluation per scheduling round: sustained
+        SLO-attainment drop (below `brownout_attain`) or block
+        exhaustion climbs one rung after `brownout_trip` consecutive
+        bad rounds; `brownout_clear` consecutive healthy rounds walk
+        one rung back down. The asymmetric streaks are the hysteresis
+        — a single good round under churn must not bounce the ladder."""
+        self._round_admits = 0
+        bad = False
+        if _slo.active():
+            att = _slo.attainment()
+            if att is not None and att < self._brownout_attain:
+                bad = True
+        if self.paged and self._alloc.available <= 0:
+            bad = True
+        if bad:
+            self._bo_good = 0
+            self._bo_bad += 1
+            if self._bo_bad >= self._brownout_trip \
+                    and self._bo_rung < 4:
+                self._bo_bad = 0
+                self._set_rung(self._bo_rung + 1)
+        else:
+            self._bo_bad = 0
+            self._bo_good += 1
+            if self._bo_good >= self._brownout_clear \
+                    and self._bo_rung > 0:
+                self._bo_good = 0
+                self._set_rung(self._bo_rung - 1)
+
+    def _set_rung(self, rung):
+        self._bo_rung = rung
+        if _obs.enabled():
+            _obs.gauge("serving.brownout_rung").set(rung)
+            _obs.record_instant("serving.brownout", cat="serving",
+                                args={"rung": rung})
 
     # ---- decode ----
 
@@ -1455,6 +1826,7 @@ class ContinuousBatcher(object):
                     self._note_finish(req)
                 self._free(i)
         if not any(s is not None for s in self._slots):
+            self._end_round()
             return finished
         k = self.chunk_size
         try:
@@ -1493,6 +1865,7 @@ class ContinuousBatcher(object):
                     self._cache = state
         except Exception as exc:     # noqa: BLE001 — requeue-or-raise
             self._recover_dispatch_failure(exc)
+            self._end_round()
             return finished
         self._dispatch_failures = 0
         self.dispatch_count += 1
@@ -1525,7 +1898,18 @@ class ContinuousBatcher(object):
                 self._free(i)
         if obs_on:
             self._publish_occupancy()
+        self._end_round()
         return finished
+
+    def _end_round(self):
+        """Per-scheduling-round epilogue shared by every step path:
+        the brownout controller's tick and the MXNET_SERVING_DEBUG
+        idle-point allocator audit. One guarded branch each when
+        off."""
+        if self.brownout:
+            self._brownout_tick()
+        if self._debug:
+            self._debug_idle_check()
 
     # ---- pipelined scheduling (pipeline_depth > 1) ----
 
@@ -1553,6 +1937,7 @@ class ContinuousBatcher(object):
                 self._dispatch_chunk()
             except Exception as exc:  # noqa: BLE001 — requeue-or-raise
                 self._recover_dispatch_failure(exc)
+                self._end_round()
                 return finished
         if self._inflight:
             finished.update(self._sync_oldest())
@@ -1562,6 +1947,7 @@ class ContinuousBatcher(object):
             # drop the records (the device work itself is already
             # queued and harmless)
             self._inflight.clear()
+        self._end_round()
         return finished
 
     def _dispatch_chunk(self):
@@ -1663,12 +2049,14 @@ class ContinuousBatcher(object):
                 self._dispatch_spec()
             except Exception as exc:  # noqa: BLE001 — requeue-or-raise
                 self._recover_dispatch_failure(exc)
+                self._end_round()
                 return finished
         if self._inflight:
             finished.update(self._sync_oldest_spec())
         if not any(s is not None for s in self._slots):
             # nothing live: in-flight emissions belong to no request
             self._inflight.clear()
+        self._end_round()
         return finished
 
     def _dispatch_spec(self):
@@ -1681,7 +2069,13 @@ class ContinuousBatcher(object):
         worst = self.chunk_size * (self.spec_k + 1)
         if self.paged:
             self._ensure_coverage(worst)
-        keff = jnp.asarray(self._keff)
+        # brownout rung 1+: clamp the draft width to 1 — verify cost
+        # collapses toward plain decode while the ladder is engaged,
+        # and the adaptive controller takes back over on recovery
+        keff_np = (np.minimum(self._keff, 1)
+                   if self.brownout and self._bo_rung >= 1
+                   else self._keff)
+        keff = jnp.asarray(keff_np)
         with _obs.span("serving.dispatch", cat="serving", mode="spec",
                        depth=len(self._inflight) + 1,
                        spec_k=self.spec_k):
@@ -1727,7 +2121,7 @@ class ContinuousBatcher(object):
         self._inflight.append(
             (targets, emits,
              [r.rid if r is not None else None for r in self._slots],
-             np.array(self._keff)))
+             np.array(keff_np)))
         if _obs.enabled():
             _obs.gauge("serving.inflight_depth").set(
                 len(self._inflight))
@@ -1908,6 +2302,16 @@ class ContinuousBatcher(object):
         if self._dispatch_failures > self._max_dispatch_failures:
             raise exc
         pending = [r for r in self._slots if r is not None]
+        self._rebuild_state()
+        for req in pending:
+            self._readmit(req)
+
+    def _rebuild_state(self):
+        """Rebuild every piece of device + scheduling state from
+        scratch: slots emptied, pool/cache re-initialized, carry
+        re-zeroed, allocator and prefix cache reset. Shared by the
+        dispatch-failure requeue path (which then re-admits the live
+        requests) and reset_lanes() (which drops them)."""
         self._slots = [None] * self.max_batch
         if self.paged:
             # the donated pool died with the dispatch — and the prefix
@@ -1934,7 +2338,7 @@ class ContinuousBatcher(object):
             self._dev_keys = jnp.zeros((self.max_batch, 2), jnp.uint32)
         if self._spec_on:
             # the donated draft state died with the failed dispatch;
-            # _readmit below re-seeds each live lane's slice of it
+            # re-admission re-seeds each live lane's slice of it
             self._keff[:] = self.spec_k
             self._accept_ewma[:] = 1.0
             if self._spec_provider == "ngram":
@@ -1946,8 +2350,23 @@ class ContinuousBatcher(object):
             else:
                 self._dcache = tf.init_cache(self.draft_cfg,
                                              self.max_batch)
-        for req in pending:
-            self._readmit(req)
+
+    def reset_lanes(self):
+        """Abandon every live request and rebuild the batcher to its
+        just-constructed state (fresh pool, empty slots, zeroed carry,
+        cleared failure count). The circuit-breaker revival path uses
+        this to give a replica whose dispatch state may be poisoned a
+        clean slate before routing its HALF-OPEN canary — the dead
+        replica's requests were already drained to the router, so
+        nothing live is lost. Raises whatever the device raises if the
+        rebuild itself fails (the replica stays broken)."""
+        self._rebuild_state()
+        self._dispatch_failures = 0
+        self.preempted = []
+        self._bo_rung = self._bo_bad = self._bo_good = 0
+        self._round_admits = 0
+        if _obs.enabled():
+            _obs.record_instant("serving.reset_lanes", cat="serving")
 
     def _readmit(self, req):
         """Put a live request back into a (guaranteed free) lane from
@@ -2173,30 +2592,57 @@ class ContinuousBatcher(object):
                 (usable - free) / float(usable))
 
     def _admit_job(self, job, enqueued_ns=None):
-        """(prompt, n_new[, seed[, stop_token]]) -> rid or None."""
+        """(prompt, n_new[, seed[, stop_token[, priority]]]) -> rid
+        or None."""
         return self.admit(job[0], job[1],
                           seed=job[2] if len(job) > 2 else 0,
                           stop_token=job[3] if len(job) > 3 else None,
-                          enqueued_ns=enqueued_ns)
+                          enqueued_ns=enqueued_ns,
+                          priority=job[4] if len(job) > 4 else 0)
 
     def run(self, requests):
         """Convenience driver: serve `requests` (an iterable of
-        (prompt, n_new[, seed[, stop_token]])) through the slot pool,
-        admitting as capacity frees. Returns {rid: tokens} for all of
-        them, plus the admission order as a list of rids. With
-        telemetry on, every job is stamped as enqueued at entry so
-        queue-wait and TTFT cover time spent waiting for a lane."""
+        (prompt, n_new[, seed[, stop_token[, priority]]])) through the
+        slot pool, admitting as capacity frees. Returns {rid: tokens}
+        for all of them, plus the admission order as a list of rids.
+        A request preempted by a higher-priority admission is resumed
+        automatically once capacity frees; its tokens land under its
+        ORIGINAL rid (the resume allocates a fresh internal rid, which
+        run() aliases back). With telemetry on, every job is stamped
+        as enqueued at entry so queue-wait and TTFT cover time spent
+        waiting for a lane. stream() does not resume preemptions —
+        streaming callers own their requeue policy (the router does)."""
         enq_ns = time.perf_counter_ns() if _obs.enabled() else None
         queue = list(requests)
         order, results = [], {}
-        while queue or self.active_count:
+        alias = {}                     # resumed rid -> original rid
+        while queue or self.preempted or self.active_count:
             while queue and self.has_capacity:
                 rid = self._admit_job(queue[0], enqueued_ns=enq_ns)
                 if rid is None:
                     break
                 order.append(rid)
                 queue.pop(0)
+            # resume preempted work AFTER new admissions so a victim
+            # cannot re-grab the blocks its preemptor was owed
+            while self.preempted and self.has_capacity:
+                req, t_ns = self.preempted[0]
+                rid = self.admit_continuation(
+                    req.tokens, req.n_new - req.emitted, seed=req.seed,
+                    emitted=req.emitted, stop_token=req.stop_token,
+                    priority=req.priority, preempted_ns=t_ns)
+                if rid is None:
+                    if not self.active_count:
+                        raise RuntimeError(
+                            "preempted request %d cannot resume on an "
+                            "idle batcher" % req.rid)
+                    break              # wait for capacity
+                self.preempted.pop(0)
+                alias[rid] = alias.get(req.rid, req.rid)
             results.update(self.step())
+        if alias:
+            results = {alias.get(rid, rid): toks
+                       for rid, toks in results.items()}
         return results, order
 
     def stream(self, requests):

@@ -179,6 +179,42 @@ def test_spec_mid_flight_eviction(paged):
                                   _solo(params, _PROMPTS[2], 10, cfg))
 
 
+def test_spec_cancel_mid_round_trims_draft_reservation():
+    """cancel() landing while a speculative verify window is in flight
+    (paged): the lane's whole block claim — worst-case draft
+    over-reservation included — returns to the pool at the cut, the
+    freed lane's table parks on the null block, and the allocator
+    passes its conservation audit at the cut, every step after, and at
+    quiesce (zero leak). The PR 10 matrix's untested cell."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, spec_k=3,
+                            paged=True, block_size=8, pipeline_depth=2)
+    r0 = srv.admit(_PROMPTS[0], 14)
+    r1 = srv.admit(_PROMPTS[1], 14)
+    lane0 = next(i for i, r in enumerate(srv._slots)
+                 if r is not None and r.rid == r0)
+    done = {}
+    done.update(srv.step())          # verify windows in flight
+    claim = len(srv._lane_blocks[lane0])
+    need = srv._lane_need[lane0]
+    assert claim >= 1 and need >= claim
+    avail_before = srv._alloc.available
+    assert srv.cancel(r0) is not None
+    # the lane's mapped blocks AND its unconverted reservation came
+    # back (shared prefixes would hold some — none are cached here)
+    assert srv._alloc.available == avail_before + need
+    assert not srv._lane_blocks[lane0] and not srv._lane_need[lane0]
+    assert not np.asarray(srv._tables)[lane0].any()   # null routing
+    srv.check_invariants()
+    while r1 not in done:
+        done.update(srv.step())
+        srv.check_invariants()
+    np.testing.assert_array_equal(np.asarray(done[r1]),
+                                  _solo(params, _PROMPTS[1], 14, cfg))
+    assert srv.check_invariants(quiesce=True)
+
+
 @pytest.mark.parametrize("provider", ["ngram", "model"])
 def test_spec_requeue_on_dispatch_failure(provider):
     """The PR 6 recovery contract holds under speculation: an injected

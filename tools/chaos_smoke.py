@@ -25,6 +25,12 @@ intact, stream bit-exact, checkpoint loadable, resume bit-exact):
   crash    (subprocesses) an injected hard crash mid-run, then a
            relaunch via resume_from_latest: the concatenated loss
            trajectory is bit-exact (float hex) vs an uninterrupted run
+
+Two scenarios run as their own tier-1 lane invocations:
+``--elastic`` (the 2-process shrink/regrow chain) and ``--overload``
+(the ISSUE 12 serving overload storm: mixed-priority burst at ~4x
+block capacity, one replica chaos-killed mid-storm, recovery through
+the circuit breaker's HALF_OPEN canary).
 """
 
 import argparse
@@ -340,6 +346,163 @@ def crash():
     return 0
 
 
+def overload():
+    """The serving overload storm end to end, in process: steady
+    priority-0 streams pin every usable KV block on a 2-replica fleet,
+    then a seeded mixed-priority burst at ~4x block capacity lands
+    while an injected fault kills replica r1 mid-storm. Asserts the
+    whole degradation story from ISSUE 12: no deadlock (bounded
+    rounds), zero leaked blocks at quiesce, high-priority work
+    preempting and completing first, ONLY priority-0 work shed or
+    expired, the killed replica returning to rotation through
+    HALF_OPEN, and every completed stream bit-exact vs solo
+    generate()."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models.router import ReplicaRouter
+    from mxnet_tpu.observability import chaos
+    from mxnet_tpu.observability import core as obs
+
+    chaos.reset()
+    cfg = _tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    rng = np.random.RandomState(12)
+
+    def prompt():
+        return list(rng.randint(1, 41, 4))
+
+    # steady phase: four priority-0 streams sized to pin all four
+    # usable blocks on each replica (2 lifetime blocks per stream)
+    # while leaving one lane free — the preemption precondition
+    steady = [(prompt(), 10, 0, None) for _ in range(4)]
+    # storm phase: mixed priorities at ~4x the fleet's block capacity;
+    # two low-priority jobs carry an already-lapsed deadline
+    storm = ([(prompt(), 8, 2, None) for _ in range(3)]
+             + [(prompt(), 8, 1, None) for _ in range(3)]
+             + [(prompt(), 8, 0, None) for _ in range(4)]
+             + [(prompt(), 8, 0, 0) for _ in range(2)])
+    solo = {}
+
+    pre0 = obs.counter("serving.preemptions").value
+    r = ReplicaRouter.build(params, cfg, n_replicas=2, max_batch=3,
+                            shed_queue=8, breaker=True, paged=True,
+                            block_size=8, num_blocks=5, brownout=True)
+    prio, results, done_at, rung_max = {}, {}, {}, 0
+
+    def submit(batch):
+        for p, n, pr, ddl in batch:
+            rid = r.submit(p, n, priority=pr, deadline_ms=ddl)
+            prio[rid] = pr
+            solo[rid] = np.asarray(T.generate(
+                params, jnp.asarray([p], jnp.int32), n, cfg,
+                greedy=True))[0].tolist()
+
+    submit(steady)
+    rounds = 0
+    for _ in range(2):                    # let the steady load settle
+        results.update(r.step())
+        rounds += 1
+    chaos.install("serving.dispatch.r1:error:at=1;"
+                  "serving.dispatch.r1:error:at=2;"
+                  "serving.dispatch.r1:error:at=3;"
+                  "serving.dispatch.r1:error:at=4")
+    submit(storm)
+    try:
+        while (r._queue or r._live) and rounds < 400:
+            done = r.step()
+            results.update(done)
+            for rid in done:
+                done_at.setdefault(rid, rounds)
+            rung_max = max([rung_max] + [rep._bo_rung
+                                         for rep in r.replicas])
+            rounds += 1
+    finally:
+        chaos.reset()
+    if r._queue or r._live:
+        print("[chaos_smoke] FAIL(overload): DEADLOCK — %d queued, %d "
+              "live after %d rounds" % (len(r._queue), len(r._live),
+                                        rounds))
+        return 1
+
+    preemptions = obs.counter("serving.preemptions").value - pre0
+    if preemptions < 1:
+        print("[chaos_smoke] FAIL(overload): the burst never preempted "
+              "a low-priority lane")
+        return 1
+    if rung_max < 1:
+        print("[chaos_smoke] FAIL(overload): brownout ladder never "
+              "left rung 0 under block exhaustion")
+        return 1
+    dropped = set(r.shed_rids) | set(r.expired_rids)
+    if not r.shed_rids or len(r.expired_rids) < 2:
+        print("[chaos_smoke] FAIL(overload): shed=%d expired=%d — "
+              "expected both paths exercised"
+              % (len(r.shed_rids), len(r.expired_rids)))
+        return 1
+    if any(prio[rid] != 0 for rid in dropped):
+        print("[chaos_smoke] FAIL(overload): non-priority-0 work was "
+              "shed/expired: %s"
+              % sorted((rid, prio[rid]) for rid in dropped))
+        return 1
+    for name in ("shed", "expired"):
+        key = "serving.slo_violation." + name
+        if r.health_snapshot()[key] != len(getattr(r, name + "_rids")):
+            print("[chaos_smoke] FAIL(overload): %s miscounted in "
+                  "health_snapshot()" % key)
+            return 1
+
+    # every non-dropped request completed, bit-exact vs solo
+    for rid, pr in prio.items():
+        if rid in dropped:
+            continue
+        if results.get(rid) != solo[rid]:
+            print("[chaos_smoke] FAIL(overload): stream rid=%d "
+                  "(priority %d) diverged from solo generate()"
+                  % (rid, pr))
+            return 1
+    # priority-ordered completion: higher classes finish earlier on
+    # average than the priority-0 survivors (the steady streams all
+    # get preempted or drained and resume at the tail of the storm)
+    by_p = {p: [done_at[rid] for rid in prio
+                if prio[rid] == p and rid in done_at
+                and rid not in dropped]
+            for p in (0, 1, 2)}
+    mean = lambda xs: sum(xs) / float(len(xs))  # noqa: E731
+    if not by_p[2] or not by_p[1] or not by_p[0] \
+            or mean(by_p[2]) >= mean(by_p[0]) \
+            or mean(by_p[1]) >= mean(by_p[0]):
+        print("[chaos_smoke] FAIL(overload): completion order ignored "
+              "priority: %s" % by_p)
+        return 1
+
+    want = [("r1", "closed", "open"), ("r1", "open", "half_open"),
+            ("r1", "half_open", "closed")]
+    if any(ev not in r.breaker_events for ev in want):
+        print("[chaos_smoke] FAIL(overload): breaker never completed "
+              "open -> half_open -> closed for r1: %s"
+              % r.breaker_events)
+        return 1
+    if r._alive != [True, True] or r._brk_state != ["closed", "closed"]:
+        print("[chaos_smoke] FAIL(overload): fleet did not fully "
+              "recover: alive=%s state=%s" % (r._alive, r._brk_state))
+        return 1
+    for rep in r.replicas:
+        rep.check_invariants(quiesce=True)   # zero leaked blocks
+        if "serving.brownout_rung" not in rep.health_snapshot():
+            print("[chaos_smoke] FAIL(overload): %s health snapshot "
+                  "lacks serving.brownout_rung" % rep.name)
+            return 1
+    print("[chaos_smoke] overload OK: %d-job storm over 2 replicas — "
+          "%d preempted-and-resumed, %d shed + %d expired (all "
+          "priority 0), brownout peaked at rung %d, r1 killed and "
+          "recovered via HALF_OPEN, all %d completed streams bit-exact"
+          % (len(prio), preemptions, len(r.shed_rids),
+             len(r.expired_rids), rung_max,
+             sum(1 for rid in prio if rid not in dropped)))
+    return 0
+
+
 def elastic():
     """The elastic shrink-relaunch-resume chain, end to end on the CPU
     mesh: a 2-process gloo job with one injected rank kill must (1)
@@ -478,6 +641,10 @@ def main():
     p.add_argument("--elastic", action="store_true",
                    help="run the elastic shrink/regrow e2e (2-process "
                         "gloo; its own tier-1 lane invocation)")
+    p.add_argument("--overload", action="store_true",
+                   help="run the serving overload storm e2e (priority "
+                        "burst + replica kill; its own tier-1 lane "
+                        "invocation)")
     args = p.parse_args()
     worker = os.environ.get("CHAOS_SMOKE_WORKER")
     if worker == "hang":
@@ -487,6 +654,11 @@ def main():
     if args.elastic:
         if elastic():
             print("[chaos_smoke] elastic scenario FAILED")
+            return 1
+        return 0
+    if args.overload:
+        if overload():
+            print("[chaos_smoke] overload scenario FAILED")
             return 1
         return 0
     failures = 0

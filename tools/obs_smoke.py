@@ -212,6 +212,64 @@ def serving_smoke():
               "(0, 1]" % hz["counters"]["serving.kv_block_utilization"])
         return 1
 
+    # ---- overload telemetry (ISSUE 12): a tiny priority storm on a
+    # 2-replica fleet must export the brownout rung, the per-replica
+    # breaker state, the preemption counter + stall histogram, and
+    # the shed-vs-expired split — on /healthz AND in the trace
+    from mxnet_tpu.models.router import ReplicaRouter
+    from mxnet_tpu.observability import core as obs_core
+
+    pre0 = obs_core.counter("serving.preemptions").value
+    rng2 = np.random.RandomState(3)
+    rr = ReplicaRouter.build(params, cfg, n_replicas=2, max_batch=3,
+                             breaker=True, paged=True, block_size=8,
+                             num_blocks=5, brownout=True,
+                             brownout_trip=1)
+    for _ in range(4):                 # pin every usable block
+        rr.submit(list(rng2.randint(1, 97, 4)), 10, priority=0)
+    for _ in range(6):
+        rr.step()
+    rr.submit(list(rng2.randint(1, 97, 4)), 6, priority=1)  # preempts
+    rr.submit(list(rng2.randint(1, 97, 4)), 6, priority=0,
+              deadline_ms=0)                                # expires
+    hz2, steps = None, 0
+    port = obs_http.start(0)
+    try:
+        while (rr._queue or rr._live) and steps < 200:
+            rr.step()
+            if steps == 1:
+                hz2 = json.loads(urllib.request.urlopen(
+                    "http://127.0.0.1:%d/healthz" % port,
+                    timeout=10).read().decode())
+            steps += 1
+    finally:
+        obs_http.stop()
+    if steps >= 200:
+        print("[obs_smoke] FAIL: overload act did not quiesce")
+        return 1
+    if obs_core.counter("serving.preemptions").value - pre0 < 1 \
+            or not rr.expired_rids:
+        print("[obs_smoke] FAIL: overload act drove no preemption "
+              "or no deadline expiry")
+        return 1
+    needed_hz2 = ("serving.preemptions", "serving.brownout_rung",
+                  "serving.slo_violation.expired",
+                  "router.replica_state.r0",
+                  "router.replica_state.r1")
+    missing_hz2 = [k for k in needed_hz2
+                   if k not in (hz2 or {}).get("counters", {})]
+    if missing_hz2:
+        print("[obs_smoke] FAIL: /healthz lacks the overload gauges "
+              "%s" % missing_hz2)
+        return 1
+    for k in ("serving.slo_violation.shed",
+              "serving.slo_violation.expired",
+              "router.replica_state.r0"):
+        if k not in rr.health_snapshot():
+            print("[obs_smoke] FAIL: router health_snapshot() lacks "
+                  "%s" % k)
+            return 1
+
     fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_srv_"),
                          "trace.json")
     mx.profiler.set_config(filename=fname, xla_trace=False)
@@ -227,7 +285,10 @@ def serving_smoke():
                 "serving.kv_free_blocks",
                 "serving.kv_block_utilization",
                 "serving.spec_accept_len", "serving.spec_draft_ratio",
-                "serving.ttft_ms", "serving.itl_ms", "serving.e2e_ms"}
+                "serving.ttft_ms", "serving.itl_ms", "serving.e2e_ms",
+                "serving.preempt", "serving.preempt_stall_ms",
+                "serving.brownout_rung", "router.queue_depth",
+                "router.replica_state.r0", "router.replica_state.r1"}
     missing = required - names
     if missing:
         print("[obs_smoke] FAIL: serving trace missing: %s"
@@ -247,7 +308,8 @@ def serving_smoke():
     hists = trace["otherData"].get("histograms", {})
     for hname in ("serving.ttft_ms", "serving.itl_ms",
                   "serving.e2e_ms", "serving.queue_ms",
-                  "serving.spec_accept_len"):
+                  "serving.spec_accept_len",
+                  "serving.preempt_stall_ms"):
         if not hists.get(hname, {}).get("count"):
             print("[obs_smoke] FAIL: histogram %s missing/empty in "
                   "trace otherData" % hname)
