@@ -139,6 +139,20 @@ if [ "${TIER1_CHAOS:-0}" = "1" ]; then
         echo "[tier1] FAIL: overload smoke"
         exit 1
     fi
+
+    echo "==== [tier1] integrity smoke (one injected flip per corruption class) ===="
+    # docs/ROBUSTNESS.md "Silent corruption", end to end: a gradient-
+    # bucket flip caught by the replay audit (quarantine exit 46 with
+    # bucket evidence, then a bit-exact resume from the last verified
+    # checkpoint), a replicated-weight flip on one of three gloo ranks
+    # named by the fingerprint majority vote, a checkpoint byte flip
+    # refused by name with fallback to the verified ancestor, and a
+    # recordio record flip named (path, record index) — transient
+    # retried clean, at-rest exhausting into the enriched IOError.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py --integrity; then
+        echo "[tier1] FAIL: integrity smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

@@ -26,6 +26,7 @@ from ..base import MXNetError
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
+from ..observability import integrity as _integrity
 from ..observability import recompile as _obs_recompile
 from ..parallel import elastic as _elastic
 from ..parallel import fusion
@@ -152,6 +153,12 @@ class Trainer(object):
                     "trainer.grads",
                     [p.grad() for _, p in self._trainable()
                      if p._data is not None])
+                # silent weight corruption on this rank — the
+                # integrity cross-rank vote's prey
+                _chaos.poison_bitflip(
+                    "trainer.weights",
+                    [p.data() for _, p in self._trainable()
+                     if p._data is not None])
             if _chaos.step_guard_enabled() and not self._grads_finite():
                 # non-finite loss/grads: skip allreduce AND update (the
                 # update may live inside the store), back off the AMP
@@ -190,6 +197,12 @@ class Trainer(object):
             # shrinks BEFORE the next collective can wedge this rank)
             self._elastic_steps = getattr(self, "_elastic_steps", 0) + 1
             _elastic.step_boundary(self._elastic_steps)
+        if _integrity.enabled():
+            # silent-corruption detectors: replay-audit the lanes
+            # recorded during this step's fused all-reduce and, on
+            # cadence, run the cross-rank parameter fingerprint vote
+            _integrity.step_boundary(self._integrity_items(),
+                                     kv=self._kvstore)
 
     def allreduce_grads(self):
         self._ready()
@@ -206,6 +219,15 @@ class Trainer(object):
         """(kvstore slot, param) for every param that receives grads."""
         return ((slot, p) for slot, p in enumerate(self._params)
                 if p.grad_req != "null")
+
+    def _integrity_items(self):
+        """(slot, weight jax array) in the same reverse-registration
+        order the fused gradient path uses, so vote evidence names the
+        same bucket/lane a corrupt gradient would ride."""
+        items = [(slot, p.data()._data) for slot, p in self._trainable()
+                 if p._data is not None]
+        items.reverse()
+        return items
 
     def _allreduce_grads(self):
         if self._kvstore is None:

@@ -27,6 +27,7 @@ from . import _fastenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import recordio
+from .recordio import RecordCorrupt  # noqa: F401 (re-export)
 from .observability import chaos as _chaos
 from .observability import core as _obs
 
@@ -95,7 +96,7 @@ def _obs_batch(iter_obj, batch):
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "RecordCorrupt"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):

@@ -51,6 +51,7 @@ from .gradient_compression import GradientCompression
 from .ndarray import NDArray
 from .observability import chaos as _chaos
 from .observability import core as _obs
+from .observability import integrity as _integrity
 from .observability import watchdog as _wd
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
@@ -306,6 +307,27 @@ class KVStore(object):
                                  {s.key: datas[s.key][w]
                                   for s in lane.segments}, pad_to=pad)
                 for w in range(nw)]
+            if _chaos.enabled():
+                # SDC in the packed bucket buffer that is about to
+                # feed (and poison) the collective — the integrity
+                # replay audit's prey
+                per_worker = [
+                    _chaos.bitflip_array(
+                        "kvstore.bucket.pack", f, bucket=bucket.index,
+                        lane=lane.dtype, worker=w)
+                    for w, f in enumerate(per_worker)]
+            if _integrity.enabled():
+                # record the flats the collective consumes + a clean
+                # re-pack from the (immutable) source arrays; the
+                # step-boundary replay audit compares the digests
+                _integrity.note_lane(
+                    bucket.index, lane.dtype, per_worker,
+                    lambda lane=lane, pad=pad: [
+                        fusion.pack_lane(lane,
+                                         {s.key: datas[s.key][w]
+                                          for s in lane.segments},
+                                         pad_to=pad)
+                        for w in range(nw)])
             if slot is not None:
                 # reduce-scatter -> sharded update -> all-gather (2
                 # fused collective dispatches however many keys ride

@@ -72,10 +72,11 @@ import zlib
 import numpy as np
 
 from ..observability import chaos as _chaos
+from ..observability import integrity as _integrity
 
 __all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state",
            "CheckpointCorrupt", "CheckpointIncompatible",
-           "wait_for_pending_save",
+           "wait_for_pending_save", "verify_lineage",
            "list_checkpoints", "resume_from_latest", "resume_elastic",
            "save_shard_checkpoint", "load_shard_checkpoint",
            "list_shard_generations", "shard_layout",
@@ -215,6 +216,41 @@ _pending_lock = threading.Lock()
 _pending = [None]                    # the one in-flight saver thread
 _last_committed_step = [None]        # newest step this process committed
 
+# lineage tail: {"name", "digest", "step"} of the newest manifest this
+# process committed OR loaded — the next save records it as its parent,
+# so verify_lineage can walk save -> save -> resume -> save chains
+_lineage = [None]
+
+
+def _manifest_digest(text):
+    return "%08x" % (zlib.crc32(text.encode()) & 0xFFFFFFFF)
+
+
+def _note_lineage(path, name):
+    """Record ``name`` as the lineage tail after a successful load, so
+    a checkpoint saved by the resumed run chains to the one it resumed
+    from. The latest pointer resolves to its retained twin (same
+    content) — the pointer file itself is overwritten every save and
+    cannot anchor a chain."""
+    try:
+        full = os.path.join(path, name)
+        with open(full) as f:
+            text = f.read()
+        m = json.loads(text)
+        if name == "manifest.json":
+            for _s, _mt, rname, arrays in _retained_manifests(path):
+                if arrays == m.get("arrays_file"):
+                    name = rname
+                    with open(os.path.join(path, rname)) as f:
+                        text = f.read()
+                    break
+            else:
+                return
+        _lineage[0] = {"name": name, "digest": _manifest_digest(text),
+                       "step": int(m.get("step", -1))}
+    except (OSError, ValueError):
+        pass
+
 
 class _Saver(threading.Thread):
     def __init__(self, fn):
@@ -343,6 +379,13 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata,
         # per-array digest of the exact bytes written: load_checkpoint
         # refuses a torn/truncated file instead of rebuilding garbage
         "checksums": {k: _crc(v) for k, v in host.items()},
+        # lineage: one fingerprint over ALL parameter bytes (the same
+        # id serving's health_snapshot reports for these weights) plus
+        # the parent manifest's digest — verify_lineage walks the chain
+        "param_fingerprint": _integrity.tree_fingerprint(
+            {k: v for k, v in host.items()
+             if k.startswith(_PARAMS + _SEP)}),
+        "parent": _lineage[0],
         "metadata": metadata or {},
     }
     # serialize BEFORE touching the directory: a non-JSON metadata
@@ -352,6 +395,13 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata,
     with open(tmp, "wb") as f:
         np.savez(f, **host)
     os.replace(tmp, os.path.join(path, arrays_file))
+    if _chaos.enabled():
+        # chaos site: at-rest corruption — a bit of the landed data
+        # file rots BEFORE the manifest commits; verify-on-load must
+        # refuse this checkpoint and fall back
+        _chaos.corrupt_file("checkpoint.bytes",
+                            os.path.join(path, arrays_file),
+                            step=int(step))
     # chaos site: a crash/preemption injected HERE (data landed, nothing
     # committed) is the torn-save case the commit-point test replays
     _chaos.fire("checkpoint.write", path=path, step=int(step))
@@ -362,6 +412,9 @@ def _write_commit_sweep(path, cfg, host, has_momentum, step, metadata,
             f.write(manifest_text)
         os.replace(tmp, os.path.join(path, name))   # last one = commit
     _last_committed_step[0] = int(step)
+    _lineage[0] = {"name": retained,
+                   "digest": _manifest_digest(manifest_text),
+                   "step": int(step)}
     _sweep(path, keep, stamp)
 
 
@@ -497,6 +550,19 @@ def _load_manifest(path, manifest_name, mesh):
         raise ValueError("not a transformer checkpoint: %s" % path)
     cfg = _cfg_from_json(manifest["config"])
     flat = _read_arrays(path, manifest, manifest_name)
+    want_fp = manifest.get("param_fingerprint")
+    if want_fp is not None:
+        # the lineage gate: the recomputed parameter fingerprint must
+        # match the manifest — an unverifiable checkpoint is refused
+        # (the caller's candidates loop falls back to an ancestor)
+        got_fp = _integrity.tree_fingerprint(
+            {k: v for k, v in flat.items()
+             if k.startswith(_PARAMS + _SEP)})
+        if got_fp != want_fp:
+            raise CheckpointCorrupt(
+                "checkpoint %s (%s): parameter fingerprint %s does not "
+                "match manifest %s — refusing unverified weights"
+                % (path, manifest_name, got_fp, want_fp))
 
     import jax.numpy as jnp
     pref = _PARAMS + _SEP
@@ -585,8 +651,74 @@ def load_checkpoint(path, mesh=None, fallback=True):
                 "mxnet_tpu.checkpoint: recovered from %s at step %d "
                 "after a corrupt newer checkpoint"
                 % (name, out[3]), RuntimeWarning, stacklevel=2)
+        _note_lineage(path, name)
         return out
     raise first_error
+
+
+def verify_lineage(path, deep=False):
+    """Walk the retained-manifest chain newest -> oldest and verify it.
+
+    Returns a list of entries, newest first: ``{"name", "step",
+    "status", "parent"}`` where ``status`` is ``verified`` (manifest
+    readable; with ``deep=True`` also every array digest AND the
+    recomputed parameter fingerprint), ``corrupt`` (deep verification
+    failed — ``detail`` names why), or ``parent-mismatch`` (the parent
+    manifest on disk no longer matches the digest recorded at save
+    time). ``parent`` is ``root`` (chain start), ``verified``,
+    ``mismatch``, or ``pruned`` — a parent GC'd by retention ends the
+    chain and is NOT a failure."""
+    entries = _retained_manifests(path) if os.path.isdir(path) else []
+    texts, manifests = {}, {}
+    for _s, _mt, name, _arrays in entries:
+        try:
+            with open(os.path.join(path, name)) as f:
+                texts[name] = f.read()
+            manifests[name] = json.loads(texts[name])
+        except (OSError, ValueError):
+            continue
+    out = []
+    for _s, _mt, name, _arrays in reversed(entries):
+        m = manifests.get(name)
+        if m is None:
+            out.append({"name": name, "step": -1,
+                        "status": "corrupt", "parent": None,
+                        "detail": "manifest unreadable"})
+            continue
+        status, detail = "verified", None
+        if deep:
+            try:
+                flat = _read_arrays(path, m, name)
+                want = m.get("param_fingerprint")
+                if want is not None:
+                    got = _integrity.tree_fingerprint(
+                        {k: v for k, v in flat.items()
+                         if k.startswith(_PARAMS + _SEP)})
+                    if got != want:
+                        status = "corrupt"
+                        detail = ("param fingerprint %s != manifest %s"
+                                  % (got, want))
+            except CheckpointCorrupt as e:
+                status, detail = "corrupt", str(e)
+        parent = m.get("parent")
+        if not parent:
+            pstat = "root"
+        else:
+            ptext = texts.get(parent.get("name"))
+            if ptext is None:
+                pstat = "pruned"
+            elif _manifest_digest(ptext) == parent.get("digest"):
+                pstat = "verified"
+            else:
+                pstat = "mismatch"
+                if status == "verified":
+                    status = "parent-mismatch"
+        entry = {"name": name, "step": int(m.get("step", -1)),
+                 "status": status, "parent": pstat}
+        if detail:
+            entry["detail"] = detail
+        out.append(entry)
+    return out
 
 
 def restore_train_state(path, mesh):
@@ -824,6 +956,9 @@ def save_shard_checkpoint(path, cfg, params, momentum=None, step=0,
         "dtypes": {k: np.dtype(v.dtype).name for k, v in host.items()},
         "arrays": sorted(host),
         "checksums": {k: _crc(v) for k, v in host.items()},
+        "param_fingerprint": _integrity.tree_fingerprint(
+            {k: v for k, v in host.items()
+             if k.startswith(_PARAMS + _SEP)}),
         "cursor": cursor, "rng": rng,
         "metadata": metadata or {},
     }
@@ -997,6 +1132,16 @@ def load_shard_checkpoint(path, mesh=None, generation=None,
 
     first = min(arrays)
     pref = _PARAMS + _SEP
+    want_fp = ranks[first][0].get("param_fingerprint")
+    if want_fp is not None:
+        got_fp = _integrity.tree_fingerprint(
+            {k: v for k, v in arrays[first].items()
+             if k.startswith(pref)})
+        if got_fp != want_fp:
+            raise CheckpointCorrupt(
+                "shard set %s: rank %d parameter fingerprint %s does "
+                "not match manifest %s — refusing unverified weights"
+                % (path, first, got_fp, want_fp))
     flat_p = {k[len(pref):]: v for k, v in arrays[first].items()
               if k.startswith(pref)}
     momentum = None
@@ -1088,21 +1233,36 @@ def resume_elastic(path, mesh=None, init=None, expect_world=None,
                 "launching generation %d — the supervisor is reading a "
                 "stale rendezvous record" % (path, gen,
                                              int(expect_generation)))
-        out = load_shard_checkpoint(path, mesh=mesh, generation=gen,
-                                    allow_partial=allow_partial)
-        if expect_world is not None and out[4]["world"] != int(
-                expect_world) and out[2] is None:
-            # a momentum-less set carries no reshardable lanes; params
-            # alone reshard freely, so only warn when nothing merges
-            raise CheckpointIncompatible(
-                "shard set %s: recorded world %d cannot serve world %d "
-                "(no optimizer lanes to re-partition)"
-                % (path, out[4]["world"], int(expect_world)))
-        cfg, params, momentum, step, extras = out
-        if momentum is None:
-            from .transformer import init_momentum
-            momentum = init_momentum(params)
-        return cfg, params, momentum, step, extras
+        try:
+            out = load_shard_checkpoint(path, mesh=mesh, generation=gen,
+                                        allow_partial=allow_partial)
+        except CheckpointIncompatible:
+            raise
+        except CheckpointCorrupt as e:
+            # an unverifiable shard set must not serve the resume:
+            # fall through to the newest VERIFIED full checkpoint
+            # (load_checkpoint's own fallback chain) with a warning
+            if not full:
+                raise
+            warnings.warn(
+                "mxnet_tpu.checkpoint: %s — falling back to the "
+                "newest verified full checkpoint" % e,
+                RuntimeWarning, stacklevel=2)
+        else:
+            if expect_world is not None and out[4]["world"] != int(
+                    expect_world) and out[2] is None:
+                # a momentum-less set carries no reshardable lanes;
+                # params alone reshard freely, so only warn when
+                # nothing merges
+                raise CheckpointIncompatible(
+                    "shard set %s: recorded world %d cannot serve "
+                    "world %d (no optimizer lanes to re-partition)"
+                    % (path, out[4]["world"], int(expect_world)))
+            cfg, params, momentum, step, extras = out
+            if momentum is None:
+                from .transformer import init_momentum
+                momentum = init_momentum(params)
+            return cfg, params, momentum, step, extras
     if full:
         cfg, params, momentum, step, meta = load_checkpoint(path,
                                                             mesh=mesh)

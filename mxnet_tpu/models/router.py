@@ -65,6 +65,7 @@ headroom are per-instance either way).
 """
 
 import time
+import warnings
 from collections import deque
 
 from .serving import ContinuousBatcher
@@ -162,6 +163,7 @@ class ReplicaRouter(object):
         self.shed_rids = []
         self.expired_rids = []
         self._last_exc = None
+        self._fp_warned = None   # last mixed weight-version set warned
 
     @classmethod
     def build(cls, params, cfg, n_replicas=2, shed_queue=None,
@@ -537,7 +539,30 @@ class ReplicaRouter(object):
             ratios = [x for x in ratios if x is not None]
             if ratios:
                 _obs.gauge("router.spec_accept_ratio").set(min(ratios))
+            self._check_weight_versions()
         return finished
+
+    def _check_weight_versions(self):
+        """A fleet must serve ONE weight version: after a partial
+        weight rollout (or a silently corrupted replica reload) some
+        replicas answer from different parameters — per-request
+        results then depend on routing luck. Compare the alive
+        replicas' cached fingerprints; a mixed fleet bumps
+        ``router.weight_version_mismatch`` every scheduling round it
+        persists and warns once per distinct mix."""
+        fps = {r.name: r.weight_fingerprint
+               for i, r in enumerate(self.replicas) if self._alive[i]}
+        if len(set(fps.values())) <= 1:
+            return
+        _obs.counter("router.weight_version_mismatch").add(1)
+        mix = frozenset(fps.items())
+        if mix != self._fp_warned:
+            self._fp_warned = mix
+            warnings.warn(
+                "router: replicas serve MIXED weight versions: %s — "
+                "responses now depend on routing"
+                % ", ".join("%s=%s" % kv for kv in sorted(fps.items())),
+                RuntimeWarning, stacklevel=2)
 
     def health_snapshot(self):
         """Router-level ``/healthz`` mirror: queue + fleet gauges, the
@@ -554,6 +579,9 @@ class ReplicaRouter(object):
         for i, r in enumerate(self.replicas):
             snap["router.replica_state.%s" % r.name] = \
                 _STATE_CODE[self._brk_state[i]]
+        snap["router.weight_versions"] = len(
+            {r.weight_fingerprint
+             for i, r in enumerate(self.replicas) if self._alive[i]})
         return snap
 
     def run(self, requests):

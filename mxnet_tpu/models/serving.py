@@ -82,6 +82,7 @@ from .. import _fastenv
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import http as _obs_http
+from ..observability import integrity as _integrity
 from ..observability import slo as _slo
 
 DEFAULT_KV_BLOCK_SIZE = 16
@@ -1043,6 +1044,10 @@ class ContinuousBatcher(object):
         # first admission — feeds the serving.goodput_tok_s gauge
         self._completed_tokens = 0
         self._t_serve_start_ns = None
+        # weight-version identity (integrity.tree_fingerprint over the
+        # served params) — lazily computed once, cached: replicas of
+        # one fleet must agree, and the router checks they do
+        self._weight_fp = None
         if _obs.enabled():
             _obs_http.maybe_start()    # MXNET_OBS_HTTP live scrape
         # prefix cache, LRU-bounded (prefix_cache_slots). Dense mode:
@@ -1119,10 +1124,31 @@ class ContinuousBatcher(object):
         primary load signal."""
         return self._alloc.free_blocks if self.paged else None
 
+    @property
+    def weight_fingerprint(self):
+        """8-hex id of the served weights (one
+        ``integrity.tree_fingerprint`` call, cached — the params are
+        immutable for the batcher's lifetime). The same id appears in
+        checkpoint manifests (``param_fingerprint``), so an operator
+        can trace exactly which checkpoint a replica serves; the
+        router compares it across the fleet. Also published as the
+        ``serving.weight_version`` gauge (the id as an integer —
+        < 2^32, exact in a float64) for /healthz scrapers."""
+        if self._weight_fp is None:
+            from .checkpoint import _flatten
+            flat = {}
+            _flatten(self.params, "p", flat)
+            self._weight_fp = _integrity.tree_fingerprint(flat)
+            if _obs.enabled():
+                _obs.gauge("serving.weight_version").set(
+                    int(self._weight_fp, 16))
+        return self._weight_fp
+
     def health_snapshot(self):
         """The per-replica routing signals, /healthz-shaped (same names
         a scraper reads off MXNET_OBS_HTTP's /healthz `counters`):
-        lane occupancy, paged-pool headroom, rolling SLO attainment.
+        lane occupancy, paged-pool headroom, rolling SLO attainment,
+        the weight-version fingerprint.
         models/router.py polls this for in-process replicas; a
         multi-process fleet scrapes the HTTP endpoint instead."""
         active = self.active_count
@@ -1130,6 +1156,7 @@ class ContinuousBatcher(object):
             "serving.lane_occupancy": active,
             "serving.lane_utilization": active / float(self.max_batch),
             "serving.slo_attainment": _slo.attainment(),
+            "serving.weight_fingerprint": self.weight_fingerprint,
         }
         if self.paged:
             usable = self.num_blocks - 1

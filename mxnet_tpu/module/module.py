@@ -24,6 +24,7 @@ from ..initializer import Uniform, InitDesc
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
+from ..observability import integrity as _integrity
 from ..observability import recompile as _obs_recompile
 from ..model import save_checkpoint, load_checkpoint
 from .base_module import BaseModule, _check_input_names
@@ -424,6 +425,14 @@ class Module(BaseModule):
         if _obs.enabled():
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)
+        if _integrity.enabled():
+            # same reverse-registration order as the fused grad path,
+            # so vote evidence names the matching bucket/lane
+            _integrity.step_boundary(
+                [(i, self._exec.arg_dict[n]._data)
+                 for i, n in enumerate(self._param_names)
+                 if n in self._exec.grad_dict][::-1],
+                kv=self._kvstore)
 
     def _update_impl(self):
         self._params_dirty = True

@@ -41,6 +41,7 @@ endpoint (docs/OBSERVABILITY.md "Serving observability").
 from . import chaos
 from . import core
 from . import dist
+from . import integrity
 from . import export
 from . import histogram
 from . import hlo
@@ -67,7 +68,7 @@ from .recompile import get_detector, note_call, record_retrace
 from .watchdog import get_watchdog
 
 __all__ = ["chaos", "core", "dist", "export", "histogram", "hlo",
-           "http", "slo", "attribution", "recompile",
+           "http", "slo", "attribution", "integrity", "recompile",
            "watchdog", "ops_enabled", "format_ops_table",
            "compare_summaries", "ops_summary", "enabled",
            "set_enabled", "span", "counter", "gauge", "get_histogram",

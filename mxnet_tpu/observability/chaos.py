@@ -24,7 +24,7 @@ Spec grammar (``MXNET_CHAOS`` env var, or ``install(spec)``)::
 
     spec  := rule (';' rule)*
     rule  := <site-glob> ':' <fault> (':' key '=' value)*
-    fault := delay | hang | error | nan | crash | sigterm
+    fault := delay | hang | error | nan | crash | sigterm | bitflip
 
     keys: at=N     fire on the Nth match of this rule (0-based)
           every=N  fire on every Nth match (occ % N == 0)
@@ -33,6 +33,9 @@ Spec grammar (``MXNET_CHAOS`` env var, or ``install(spec)``)::
                    (delay default 100, hang default 30000)
           rank=R   only on jax process R (other ranks don't count occs)
           code=C   exit code for crash (default 13)
+          bit=B    bitflip: which bit of the element/byte to flip
+          elem=I   bitflip: which element (array sites) or byte
+                   (byte/file sites) to corrupt
 
     MXNET_CHAOS="kvstore.pushpull_fused:delay:ms=250:at=3"
     MXNET_CHAOS="io.read:error:count=2;trainer.grads:nan:at=5"
@@ -60,6 +63,12 @@ Fault semantics at a site:
   atexit — the commit-point torture test.
 * ``sigterm`` — ``os.kill(getpid(), SIGTERM)``: a preemption notice;
   exercises the emergency-checkpoint handler.
+* ``bitflip`` — silent data corruption: flip bit ``bit`` of element
+  ``elem`` at the exact occurrence the rule selects, replayably.
+  Cooperative like ``nan``: sites that own arrays use
+  ``poison_bitflip``/``bitflip_array``, byte/file sites use
+  ``corrupt_bytes``/``corrupt_file``. The integrity detectors
+  (observability/integrity.py) are proven against this fault.
 
 ``stats`` is the always-on cheap view (the ``kv.dispatch_stats``
 pattern); with ``MXNET_OBS=1`` every firing also lands a
@@ -84,11 +93,14 @@ import time
 from . import core
 from .. import _fastenv
 
-__all__ = ["ChaosError", "Rule", "enabled", "fire", "inject", "install",
-           "reset", "release", "rules", "stats", "poison_ndarrays",
+__all__ = ["ChaosError", "Rule", "enabled", "fire", "fire_rules",
+           "inject", "install", "reset", "release", "rules", "stats",
+           "poison_ndarrays", "poison_bitflip", "bitflip_array",
+           "corrupt_bytes", "corrupt_file",
            "step_guard_enabled", "all_finite", "count_skipped_step"]
 
-FAULTS = ("delay", "hang", "error", "nan", "crash", "sigterm")
+FAULTS = ("delay", "hang", "error", "nan", "crash", "sigterm",
+          "bitflip")
 
 DEFAULT_DELAY_MS = 100.0
 DEFAULT_HANG_MS = 30000.0
@@ -106,10 +118,11 @@ class Rule(object):
     determinism this module is named for."""
 
     __slots__ = ("pattern", "fault", "at", "every", "count", "ms",
-                 "rank", "code", "seen", "fired")
+                 "rank", "code", "bit", "elem", "seen", "fired")
 
     def __init__(self, pattern, fault, at=None, every=None, count=1,
-                 ms=None, rank=None, code=DEFAULT_CRASH_CODE):
+                 ms=None, rank=None, code=DEFAULT_CRASH_CODE,
+                 bit=0, elem=0):
         if fault not in FAULTS:
             raise ValueError("unknown chaos fault %r (one of %s)"
                              % (fault, "/".join(FAULTS)))
@@ -121,6 +134,8 @@ class Rule(object):
         self.ms = None if ms is None else float(ms)
         self.rank = None if rank is None else int(rank)
         self.code = int(code)
+        self.bit = int(bit)
+        self.elem = int(elem)
         self.seen = 0
         self.fired = 0
 
@@ -165,7 +180,8 @@ def parse_spec(spec):
                     "chaos rule %r: expected key=value, got %r"
                     % (chunk, kv))
             k, v = kv.split("=", 1)
-            if k not in ("at", "every", "count", "ms", "rank", "code"):
+            if k not in ("at", "every", "count", "ms", "rank", "code",
+                         "bit", "elem"):
                 raise ValueError(
                     "chaos rule %r: unknown key %r" % (chunk, k))
             kw[k] = v
@@ -226,6 +242,13 @@ def fire(site, **info):
     matching rule's fault and returns the list of fault names fired
     (callers act on ``"nan"`` themselves). May sleep, raise
     ChaosError, SIGTERM the process, or _exit — by design."""
+    return tuple(r.fault for r in fire_rules(site, **info))
+
+
+def fire_rules(site, **info):
+    """Like :func:`fire`, but returns the fired ``Rule`` objects —
+    for sites that consume rule parameters (``bitflip``'s
+    ``bit=``/``elem=``)."""
     if not enabled():
         return ()
     due = []
@@ -249,7 +272,6 @@ def fire(site, **info):
             stats[r.fault] += 1
     if not due:
         return ()
-    fired = tuple(r.fault for r in due)
     if core.enabled():
         for r in due:
             core.counter("chaos.injected").add(1)
@@ -260,7 +282,7 @@ def fire(site, **info):
                           occurrence=r.seen - 1))
     for r in due:
         _execute(r, site)
-    return fired
+    return tuple(due)
 
 
 def _execute(rule, site):
@@ -280,7 +302,8 @@ def _execute(rule, site):
         os._exit(rule.code)          # SIGKILL semantics: no cleanup
     elif rule.fault == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
-    # "nan" has no side effect here: the caller owns the value
+    # "nan" and "bitflip" have no side effect here: the caller owns
+    # the value (cooperative corruption — see the poison_* helpers)
 
 
 def release():
@@ -335,6 +358,113 @@ def poison_ndarrays(site, arrays, **info):
             continue
         a._data = jnp.full_like(data, jnp.nan)
     return True
+
+
+def _flip_in_array(data, bit, elem):
+    """One flipped bit in a jax array: bitcast to the same-width uint,
+    xor bit ``bit`` of element ``elem`` (both wrapped into range), and
+    bitcast back — every other bit of every other element is
+    untouched, so the corruption is exactly one bit wide."""
+    import jax
+    import jax.numpy as jnp
+    flat = jnp.ravel(data)
+    if flat.size == 0:
+        return data
+    utype = {1: jnp.uint8, 2: jnp.uint16,
+             4: jnp.uint32, 8: jnp.uint64}.get(flat.dtype.itemsize)
+    if utype is None:
+        return data
+    u = jax.lax.bitcast_convert_type(flat, utype)
+    idx = int(elem) % flat.size
+    mask = jnp.asarray(1, utype) << (int(bit) % (8 * flat.dtype.itemsize))
+    u = u.at[idx].set(u[idx] ^ mask)
+    return jax.lax.bitcast_convert_type(u, flat.dtype).reshape(
+        data.shape)
+
+
+def bitflip_array(site, arr, **info):
+    """Fire ``site``; for every due ``bitflip`` rule, return ``arr``
+    with bit ``rule.bit`` of element ``rule.elem`` flipped (a new
+    array — jax arrays are immutable). Returns ``arr`` unchanged when
+    nothing fired. One guarded branch when chaos is off."""
+    if not enabled():
+        return arr
+    for r in fire_rules(site, **info):
+        if r.fault == "bitflip":
+            arr = _flip_in_array(arr, r.bit, r.elem)
+    return arr
+
+
+def poison_bitflip(site, arrays, **info):
+    """Fire ``site``; for every due ``bitflip`` rule, flip one bit in
+    place across the NDArray list — ``elem`` indexes the virtual
+    concatenation of the arrays' flattened elements, so a spec can
+    target any parameter of a whole tree deterministically. Returns
+    True when a flip landed."""
+    if not enabled():
+        return False
+    due = [r for r in fire_rules(site, **info) if r.fault == "bitflip"]
+    if not due:
+        return False
+    arrays = [a for a in arrays if getattr(a, "_data", None) is not None]
+    if not arrays:
+        return False
+    total = sum(int(a._data.size) for a in arrays)
+    flipped = False
+    for r in due:
+        idx = r.elem % total if total else 0
+        for a in arrays:
+            n = int(a._data.size)
+            if idx < n:
+                a._data = _flip_in_array(a._data, r.bit, idx)
+                flipped = True
+                break
+            idx -= n
+    return flipped
+
+
+def corrupt_bytes(site, data, **info):
+    """Fire ``site``; for every due ``bitflip`` rule, return ``data``
+    (bytes) with bit ``rule.bit`` of byte ``rule.elem`` flipped."""
+    if not enabled():
+        return data
+    due = [r for r in fire_rules(site, **info) if r.fault == "bitflip"]
+    if not due or not data:
+        return data
+    ba = bytearray(data)
+    for r in due:
+        ba[r.elem % len(ba)] ^= 1 << (r.bit % 8)
+    return bytes(ba)
+
+
+def corrupt_file(site, path, **info):
+    """Fire ``site``; for every due ``bitflip`` rule, flip one bit of
+    the file at ``path`` in place (byte ``rule.elem``, bit
+    ``rule.bit``) — an at-rest corruption, e.g. a checkpoint byte
+    rotting on disk. Returns True when a flip landed."""
+    if not enabled():
+        return False
+    due = [r for r in fire_rules(site, path=str(path), **info)
+           if r.fault == "bitflip"]
+    if not due:
+        return False
+    flipped = False
+    for r in due:
+        try:
+            with open(path, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if not size:
+                    continue
+                off = r.elem % size
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ (1 << (r.bit % 8))]))
+                flipped = True
+        except OSError:
+            continue
+    return flipped
 
 
 # --------------------------------------------------------- step guards --

@@ -1,0 +1,488 @@
+"""Silent-corruption defense: fingerprints, replay audit, quarantine.
+
+The fault-tolerance stack (io retries, step guards, watchdog, elastic
+shrink, overload shedding) handles faults that *announce* themselves.
+The nastiest production failures don't: a flipped bit in a gradient
+bucket, a replica whose "replicated" weights have drifted, a
+checkpoint that is internally consistent but descends from a corrupted
+step. The TensorFlow system paper (PAPERS.md) treats consistency
+checking of replicated state as a first-class system concern — and the
+cross-replica weight-update sharding layout (fusion.ShardSlot) means a
+single corrupt rank silently poisons EVERY replica through the
+all-reduce unless the corruption is caught around the collective.
+
+Three detectors, one response:
+
+* **In-graph fingerprints** — a cheap device-side digest per bucket
+  lane: ``[sum, L2, bitcast-xor-hi, bitcast-xor-lo]`` as four float32s
+  (the xor halves are < 2^16, so float32 carries them exactly). The
+  sum/L2 catch magnitude damage, the folded xor catches ANY single-bit
+  flip (including exponent bits that leave the sum plausible). Every
+  ``MXNET_INTEGRITY_EVERY`` steps the ranks all-gather their per-lane
+  *parameter* fingerprints over the skew-exchange transport
+  (``dist._allgather_vec`` — a pure gather, so the bit patterns travel
+  exactly) and vote: a rank outside the strict majority is replica
+  drift, named with rank + bucket/lane + key evidence. Two-rank ties
+  are indeterminate (voting needs >= 3 ranks to localize) and warn
+  naming both ranks.
+* **Replay audit** — cross-rank voting can't see compute SDC that
+  corrupts *this* rank's contribution before the collective folds it
+  into everyone. On a sampled cadence
+  (``MXNET_INTEGRITY_REPLAY_EVERY``) the kvstore records each lane's
+  packed-gradient digest plus a closure that re-packs the lane from
+  the still-live source arrays; at the step boundary the pack is
+  replayed and re-digested — a mismatch is rank-local corruption, no
+  vote needed.
+* **Checkpoint lineage** — manifests carry a parameter fingerprint
+  (``tree_fingerprint``) and a parent-manifest digest;
+  ``models/checkpoint.verify_lineage`` walks the chain and the load
+  paths refuse a checkpoint whose recomputed fingerprint mismatches
+  (falling back to the newest verified ancestor).
+
+A rank judged corrupt (``MXNET_INTEGRITY_ACTION=quarantine``, the
+default) writes its evidence to the elastic sideband
+(``quarantine.g<g>.rank<r>.json``) and exits with taxonomy code 46.
+Survivors run the normal elastic shrink — but skip the shard capture
+when the dead rank is quarantined, because survivor state downstream
+of a poisoned all-reduce must not become the resume point; resume then
+restores from the last *verified* checkpoint. The supervisor
+(``tools/elastic_launch.py``) reads the evidence, prints it, and puts
+the host on a regrow cooldown list.
+
+Off-path contract (PR 2): with ``MXNET_INTEGRITY`` unset, every hook
+reduces to one guarded ``enabled()`` branch — dispatch count and step
+numerics are bit-identical to the pre-integrity behavior (tested by
+tests/test_integrity.py off-path identity).
+"""
+
+import os
+import sys
+import time
+import zlib
+
+import numpy as np
+
+from . import core as _obs
+from .. import _fastenv
+
+__all__ = ["QUARANTINE_EXIT_CODE", "enabled", "every", "replay_every",
+           "action", "digest", "digest_arrays", "combine",
+           "fingerprint_hex", "tree_fingerprint", "param_fingerprints",
+           "audit_armed", "note_lane", "run_replay_audit",
+           "exchange_and_vote", "step_boundary", "quarantine", "stats"]
+
+# supervisor-visible exit taxonomy (docs/ROBUSTNESS.md): 43 watchdog,
+# 44 elastic shrink, 45 generation boundary, 46 integrity quarantine
+QUARANTINE_EXIT_CODE = 46
+
+DEFAULT_EVERY = 32
+
+# always-on cheap counters (the kv.dispatch_stats pattern)
+stats = {"votes": 0, "audits": 0, "detected": 0, "quarantines": 0}
+
+
+# ------------------------------------------------------------ env knobs --
+
+def enabled():
+    """THE site guard: MXNET_INTEGRITY=1 arms every detector. One
+    `_fastenv` read when off — the PR 2 cost budget."""
+    v = _fastenv.get("MXNET_INTEGRITY")
+    return v is not None and v not in ("", "0", "false", "False")
+
+
+def every():
+    """MXNET_INTEGRITY_EVERY: steps between cross-rank parameter
+    fingerprint votes (default 32; 0 disables the vote)."""
+    try:
+        return int(_fastenv.get("MXNET_INTEGRITY_EVERY", DEFAULT_EVERY))
+    except (TypeError, ValueError):
+        return DEFAULT_EVERY
+
+
+def replay_every():
+    """MXNET_INTEGRITY_REPLAY_EVERY: steps between replay audits
+    (default = the vote cadence; 0 disables the audit)."""
+    v = _fastenv.get("MXNET_INTEGRITY_REPLAY_EVERY")
+    if v is None or v == "":
+        return every()
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return every()
+
+
+def action():
+    """MXNET_INTEGRITY_ACTION: ``quarantine`` (default — evidence to
+    the elastic sideband, exit 46) or ``warn`` (detect and report
+    only)."""
+    v = (_fastenv.get("MXNET_INTEGRITY_ACTION") or "quarantine").lower()
+    return v if v in ("warn", "quarantine") else "quarantine"
+
+
+# ---------------------------------------------------------- the digest --
+
+_digest_cache = {}
+
+
+def _xor_fold(flat):
+    """Traced xor-fold of a flat array's raw bits down to one uint32.
+    Order-independent (xor commutes), so the verdict is stable under
+    any reduction order — and ANY single flipped bit changes it."""
+    import jax
+    import jax.numpy as jnp
+    it = np.dtype(flat.dtype).itemsize
+    if it == 1:
+        u = jax.lax.bitcast_convert_type(flat, jnp.uint8).astype(
+            jnp.uint32)
+    elif it == 2:
+        u = jax.lax.bitcast_convert_type(flat, jnp.uint16).astype(
+            jnp.uint32)
+    elif it == 8:
+        u64 = jax.lax.bitcast_convert_type(flat, jnp.uint64)
+        x = jax.lax.reduce(u64, np.uint64(0), jax.lax.bitwise_xor, (0,))
+        return ((x >> np.uint64(32)).astype(jnp.uint32)
+                ^ (x & np.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+    else:
+        u = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    return jax.lax.reduce(u, np.uint32(0), jax.lax.bitwise_xor, (0,))
+
+
+def _digest_fn(shape, dtype):
+    """One cached jitted digest per array signature — the fingerprint
+    costs a handful of reductions fused into one dispatch."""
+    key = (tuple(shape), str(np.dtype(dtype)))
+    fn = _digest_cache.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _digest(x):
+            flat = jnp.ravel(x)
+            f = flat.astype(jnp.float32)
+            s = jnp.sum(f)
+            l2 = jnp.sum(f * f)
+            x32 = _xor_fold(flat)
+            hi = (x32 >> np.uint32(16)).astype(jnp.float32)
+            lo = (x32 & np.uint32(0xFFFF)).astype(jnp.float32)
+            return jnp.stack([s, l2, hi, lo])
+
+        fn = _digest_cache[key] = jax.jit(_digest)
+    return fn
+
+
+def digest(arr):
+    """``[sum, L2, xor_hi, xor_lo]`` of one jax array as a host
+    float32[4] — see the module docstring for why each component."""
+    try:
+        import jax
+        if (isinstance(arr, jax.Array)
+                and len(arr.sharding.device_set) > 1
+                and arr.is_fully_addressable):
+            # multi-device layouts hit backends whose SPMD partitioner
+            # rejects the xor reduction (CPU); the digest is a property
+            # of the VALUE, so gathering first changes nothing
+            arr = np.asarray(arr)
+    except (ImportError, AttributeError):
+        pass
+    out = _digest_fn(np.shape(arr), arr.dtype)(arr)
+    return np.asarray(out, np.float32)
+
+
+def combine(digests):
+    """Fold per-array digests into one lane digest: sums add, xor
+    halves xor — deterministic on the host, so equal inputs on two
+    ranks always yield byte-equal lane fingerprints."""
+    acc = np.zeros(4, np.float32)
+    xh = xl = 0
+    for d in digests:
+        d = np.asarray(d, np.float32)
+        acc[0] = np.float32(acc[0] + d[0])
+        acc[1] = np.float32(acc[1] + d[1])
+        xh ^= int(d[2])
+        xl ^= int(d[3])
+    acc[2] = np.float32(xh)
+    acc[3] = np.float32(xl)
+    return acc
+
+
+def digest_arrays(arrays):
+    """Combined digest of a list of jax arrays (a lane's per-worker
+    packed flats, a parameter group)."""
+    return combine([digest(a) for a in arrays])
+
+
+def fingerprint_hex(vec):
+    """Stable compact rendering of a digest vector for evidence
+    records — the exact float32 bit patterns, hex-encoded."""
+    return np.asarray(vec, "<f4").tobytes().hex()
+
+
+def tree_fingerprint(flat):
+    """Host-side fingerprint of a ``{name: array}`` tree: a crc32 fold
+    over the sorted entries' names, dtypes, shapes, and exact bytes.
+    One function everywhere a parameter identity is compared —
+    checkpoint manifests (``param_fingerprint``), serving
+    ``health_snapshot``, the router's mixed-fleet check — so the same
+    weights always produce the same 8-hex-char id."""
+    acc = 0
+    for k in sorted(flat):
+        arr = np.ascontiguousarray(np.asarray(flat[k]))
+        c = zlib.crc32(arr.tobytes()) & 0xFFFFFFFF
+        acc = zlib.crc32(
+            ("%s:%s:%s:%08x" % (k, arr.dtype, arr.shape, c)).encode(),
+            acc) & 0xFFFFFFFF
+    return "%08x" % acc
+
+
+# ------------------------------------------------ parameter lane plans --
+
+_plan_cache = {}
+
+
+def _param_plan(items):
+    """The PR 1 bucket plan over the parameter list (same priority
+    order the trainer fuses gradients in), cached by plan signature —
+    vote evidence names the same bucket/lane a corrupt gradient would
+    ride."""
+    from ..parallel import fusion
+    entries = [(k, tuple(np.shape(a)), str(np.dtype(a.dtype)))
+               for k, a in items]
+    sig = fusion.plan_signature(entries)
+    plan = _plan_cache.get(sig)
+    if plan is None:
+        plan = _plan_cache[sig] = fusion.plan_buckets(entries)
+    return plan
+
+
+def param_fingerprints(items):
+    """Per-bucket-lane parameter fingerprints. ``items``: ordered
+    ``(key, jax array)`` pairs. Returns ``(vec, lanes)`` — ``vec`` a
+    float32[4 * n_lanes] ready for the all-gather, ``lanes`` the
+    matching ``(bucket_index, dtype, keys)`` evidence labels."""
+    plan = _param_plan(items)
+    data = dict(items)
+    vecs, lanes = [], []
+    for bucket in plan:
+        for lane in bucket.lanes:
+            vecs.append(digest_arrays(
+                [data[seg.key] for seg in lane.segments]))
+            lanes.append((bucket.index, lane.dtype,
+                          [seg.key for seg in lane.segments]))
+    if not vecs:
+        return np.zeros(0, np.float32), []
+    return np.concatenate(vecs).astype(np.float32), lanes
+
+
+# ------------------------------------------------------- replay audit --
+
+_state = {"steps": 0}
+_pending = []            # lanes recorded for this step's replay audit
+_PENDING_CAP = 512       # safety for kvstore use outside a step loop
+
+
+def audit_armed():
+    """Whether THIS step's fused lanes should be recorded for replay
+    (decided before the step boundary increments the counter)."""
+    n = replay_every()
+    return n > 0 and _state["steps"] % n == 0
+
+
+def note_lane(bucket_index, lane_dtype, per_worker, repack):
+    """kvstore hook (caller guards ``enabled()``): when the audit is
+    armed, digest the packed flats that are about to feed the
+    collective and keep ``repack`` — a closure re-packing the lane
+    from the still-live source arrays (jax arrays are immutable, so
+    the sources can't be mutated out from under us)."""
+    if not audit_armed():
+        return
+    if len(_pending) >= _PENDING_CAP:
+        del _pending[0]
+    _pending.append({"bucket": int(bucket_index),
+                     "lane": str(lane_dtype),
+                     "digest": digest_arrays(per_worker),
+                     "repack": repack})
+
+
+def run_replay_audit():
+    """Re-pack every recorded lane and compare digests bitwise (NaN
+    payloads compare by bits, not by float equality). Returns the
+    evidence list for mismatching lanes — rank-LOCAL corruption: the
+    recorded flats this rank fed the collective do not match what its
+    own inputs produce."""
+    bad = []
+    if not _pending:
+        return bad
+    stats["audits"] += 1
+    if _obs.enabled():
+        _obs.counter("integrity.audits").add(1)
+    for rec in _pending:
+        clean = digest_arrays(rec["repack"]())
+        if clean.tobytes() != rec["digest"].tobytes():
+            bad.append({"kind": "replay_mismatch",
+                        "bucket": rec["bucket"], "lane": rec["lane"],
+                        "recorded": fingerprint_hex(rec["digest"]),
+                        "recomputed": fingerprint_hex(clean)})
+    del _pending[:]
+    return bad
+
+
+# ------------------------------------------------------- the vote --
+
+def exchange_and_vote(items, allgather=None, rank=None):
+    """One cross-rank parameter vote: all-gather the per-lane
+    fingerprints (``dist._allgather_vec`` — a pure gather, bit-exact
+    transport) and group ranks by exact fingerprint bytes per lane.
+
+    Returns ``{"drift": [...], "indeterminate": [...]}``: a strict
+    majority flags the minority ranks as replica drift with named
+    rank + bucket/lane + key evidence; a tie (2-rank split) is
+    indeterminate — voting needs >= 3 ranks to localize — and names
+    every disagreeing rank instead."""
+    from . import dist as _dist
+    vec, lanes = param_fingerprints(items)
+    gathered = np.asarray(
+        (_dist._allgather_vec if allgather is None else allgather)(vec),
+        np.float32)
+    rank = _dist.process_index() if rank is None else int(rank)
+    world = gathered.shape[0]
+    stats["votes"] += 1
+    if _obs.enabled():
+        _obs.counter("integrity.votes").add(1)
+    drift, indeterminate = [], []
+    for li, (bidx, dtype, keys) in enumerate(lanes):
+        rows = gathered[:, 4 * li:4 * li + 4]
+        groups = {}
+        for r in range(world):
+            groups.setdefault(rows[r].tobytes(), []).append(r)
+        if len(groups) == 1:
+            continue
+        maj = max(groups.values(), key=len)
+        ev = {"bucket": int(bidx), "lane": str(dtype), "keys": keys,
+              "step": _state["steps"], "rank": rank,
+              "fingerprints": {str(rs[0]): rows[rs[0]].tobytes().hex()
+                               for rs in groups.values()}}
+        if 2 * len(maj) > world:
+            minority = sorted(r for g in groups.values()
+                              if g is not maj for r in g)
+            drift.append(dict(ev, kind="replica_drift",
+                              drifted=minority,
+                              majority=fingerprint_hex(rows[maj[0]])))
+        else:
+            indeterminate.append(dict(
+                ev, kind="drift_indeterminate",
+                disagreeing=sorted(r for g in groups.values()
+                                   for r in g)))
+    return {"drift": drift, "indeterminate": indeterminate}
+
+
+# ------------------------------------------------- verdict + quarantine --
+
+def quarantine(evidence, exit=None):
+    """The corrupt-rank exit: write the evidence record to the elastic
+    sideband (survivors and the supervisor both read it), count,
+    flush, and leave with taxonomy code 46. ``exit`` is injectable for
+    tests; the default is ``os._exit`` — a rank judged corrupt must
+    not run cleanup that touches shared state."""
+    import socket
+    from ..parallel import elastic
+    rank = elastic.rank_env()
+    gen = elastic.generation_env()
+    rec = {"rank": rank, "generation": gen,
+           "host": "%s:rank%d" % (socket.gethostname(), rank),
+           "wall": time.time(), "evidence": evidence}
+    stats["quarantines"] += 1
+    d = elastic.elastic_dir()
+    if d:
+        try:
+            elastic.write_quarantine_record(d, rank, gen, rec)
+        except OSError:
+            pass
+    if _obs.enabled():
+        _obs.counter("integrity.quarantine").add(1)
+        _obs.record_instant("integrity.quarantine", cat="integrity",
+                            args={"rank": rank, "generation": gen,
+                                  "kind": evidence.get("kind")})
+    print("[integrity] rank %d g%d: QUARANTINE — %s" % (rank, gen,
+                                                        evidence),
+          file=sys.stderr, flush=True)
+    sys.stdout.flush()
+    if exit is not None:
+        exit(QUARANTINE_EXIT_CODE)
+        return
+    os._exit(QUARANTINE_EXIT_CODE)      # pragma: no cover - fatal
+
+
+def _detected(evidence, exit=None):
+    """One corruption verdict against THIS rank: count, report, and
+    either quarantine or (action=warn) keep running."""
+    stats["detected"] += 1
+    if _obs.enabled():
+        _obs.counter("integrity.detected").add(1)
+        _obs.record_instant("integrity.detected", cat="integrity",
+                            args=evidence)
+    if action() == "quarantine":
+        quarantine(evidence, exit=exit)
+    else:
+        print("[integrity] corruption detected (action=warn): %s"
+              % (evidence,), file=sys.stderr, flush=True)
+
+
+def _report(evidence):
+    """A verdict about ANOTHER rank (or indeterminate): evidence goes
+    to the trace and stderr; only the corrupt rank removes itself."""
+    stats["detected"] += 1
+    if _obs.enabled():
+        _obs.counter("integrity.detected").add(1)
+        _obs.record_instant("integrity.detected", cat="integrity",
+                            args=evidence)
+    print("[integrity] %s" % (evidence,), file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------ the step hook --
+
+def step_boundary(items=None, kv=None, allgather=None, rank=None,
+                  world=None, exit=None):
+    """Trainer/Module per-step hook (callers guard ``enabled()``).
+
+    Runs the replay audit over lanes recorded during this step's fused
+    all-reduce, then — every ``MXNET_INTEGRITY_EVERY`` steps of a
+    multi-worker job — the cross-rank parameter vote. The vote is a
+    collective (every rank reaches it at the same deterministic step
+    count, the ``dist.step_boundary`` skew-exchange pattern).
+    ``items``: ordered ``(key, jax array)`` parameter pairs.
+    ``allgather``/``rank``/``world``/``exit`` are injectable for
+    tests."""
+    if not enabled():
+        return
+    for ev in run_replay_audit():
+        _detected(ev, exit=exit)
+    n = every()
+    if n > 0 and items and _state["steps"] % n == 0:
+        if world is None:
+            if kv is not None:
+                world = getattr(kv, "num_workers", 1)
+            else:
+                from . import dist as _dist
+                world = _dist.process_count()
+        if world > 1 or allgather is not None:
+            from . import dist as _dist
+            rank = _dist.process_index() if rank is None else int(rank)
+            verdicts = exchange_and_vote(items, allgather=allgather,
+                                         rank=rank)
+            for ev in verdicts["indeterminate"]:
+                _report(ev)
+            for ev in verdicts["drift"]:
+                if rank in ev["drifted"]:
+                    _detected(ev, exit=exit)
+                else:
+                    _report(ev)
+    _state["steps"] += 1
+
+
+def _reset_for_tests():
+    """Clear counters, pending lanes, and caches."""
+    _state["steps"] = 0
+    del _pending[:]
+    _plan_cache.clear()
+    for k in stats:
+        stats[k] = 0

@@ -64,7 +64,9 @@ __all__ = ["SHRINK_EXIT_CODE", "BOUNDARY_EXIT_CODE", "enabled",
            "accumulation_factor", "read_generation", "write_generation",
            "heartbeat_path", "write_heartbeat", "read_heartbeats",
            "dead_ranks", "shrink_record_path", "write_shrink_record",
-           "read_shrink_record", "prune_stale", "capture_rng",
+           "read_shrink_record", "quarantine_record_path",
+           "write_quarantine_record", "read_quarantine_records",
+           "quarantined_ranks", "prune_stale", "capture_rng",
            "restore_rng", "jsonable_cursor", "cursor_from_json",
            "Heartbeat", "ElasticCoordinator",
            "install_coordinator", "current_coordinator", "step_boundary",
@@ -273,6 +275,12 @@ def dead_ranks(d, generation, world, self_rank, now=None,
     for r in _postmortem_ranks(d):
         if r != self_rank and r < world:
             dead.add(r)
+    # a quarantine record is death evidence too: the rank judged
+    # itself corrupt and is leaving (exit 46) — survivors need not
+    # wait out the heartbeat staleness window
+    for r in quarantined_ranks(d, generation):
+        if r != self_rank and 0 <= r < world:
+            dead.add(r)
     return dead
 
 
@@ -281,11 +289,13 @@ def shrink_record_path(d, generation):
 
 
 def write_shrink_record(d, new_generation, survivors, dead, step,
-                        base_world=None, wall=None):
+                        base_world=None, wall=None, quarantined=None):
     """The coordinated-shrink proposal every survivor writes (same
     content from every writer — the atomic replace makes the last one
     win harmlessly): relaunch at ``new_generation`` with ``survivors``
-    as the new world, resuming from ``step``."""
+    as the new world, resuming from ``step``. ``quarantined`` names
+    the dead ranks that were integrity-quarantined (no shard capture
+    happened — resume restores from a verified checkpoint)."""
     os.makedirs(d, exist_ok=True)
     rec = {"generation": int(new_generation),
            "survivors": sorted(int(r) for r in survivors),
@@ -294,12 +304,57 @@ def write_shrink_record(d, new_generation, survivors, dead, step,
            "wall": time.time() if wall is None else wall}
     if base_world is not None:
         rec["base_world"] = int(base_world)
+    if quarantined:
+        rec["quarantined"] = sorted(int(r) for r in quarantined)
     _atomic_write_json(shrink_record_path(d, new_generation), rec)
     return rec
 
 
 def read_shrink_record(d, generation):
     return _read_json(shrink_record_path(d, generation))
+
+
+def quarantine_record_path(d, generation, rank):
+    return os.path.join(d, "quarantine.g%d.rank%d.json"
+                        % (generation, rank))
+
+
+def write_quarantine_record(d, rank, generation, record):
+    """The integrity quarantine evidence (observability/integrity.py):
+    the rank judged corrupt writes WHY before exiting 46 — survivors
+    read it to skip capturing corrupt-descended state, the supervisor
+    reads it for the cooldown list."""
+    os.makedirs(d, exist_ok=True)
+    _atomic_write_json(quarantine_record_path(d, generation, rank),
+                       dict(record, rank=int(rank),
+                            generation=int(generation)))
+
+
+def read_quarantine_records(d, generation=None):
+    """All readable quarantine records (of ``generation`` when
+    given), as a list of dicts."""
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("quarantine.g")
+                and name.endswith(".json")):
+            continue
+        rec = _read_json(os.path.join(d, name))
+        if rec is None:
+            continue
+        if generation is not None \
+                and int(rec.get("generation", -1)) != int(generation):
+            continue
+        out.append(rec)
+    return out
+
+
+def quarantined_ranks(d, generation):
+    return set(int(r.get("rank", -1))
+               for r in read_quarantine_records(d, generation))
 
 
 def prune_stale(d, generation):
@@ -313,7 +368,7 @@ def prune_stale(d, generation):
     removed = 0
     for name in os.listdir(d):
         doomed = False
-        for prefix in ("hb.g", "shrink.g"):
+        for prefix in ("hb.g", "shrink.g", "quarantine.g"):
             if name.startswith(prefix) and name.endswith(".json"):
                 try:
                     g = int(name[len(prefix):].split(".")[0])
@@ -524,6 +579,9 @@ class ElasticCoordinator(object):
         new_rank = survivors.index(self.rank)
         st = self.state()
         step = int(st.get("step", 0))
+        quarantined = sorted(set(dead)
+                             & quarantined_ranks(self.dir,
+                                                 self.generation))
         from ..observability import core as _obs
         if _obs.enabled():
             _obs.counter("elastic.shrink").add(1)
@@ -531,6 +589,7 @@ class ElasticCoordinator(object):
                 "elastic.shrink", cat="elastic",
                 args={"generation": self.generation,
                       "dead": sorted(int(r) for r in dead),
+                      "quarantined": quarantined,
                       "survivors": survivors, "step": step})
         print("[elastic] rank %d g%d: peer(s) %s dead — capturing "
               "shard %d/%d at step %d and leaving for generation %d"
@@ -539,23 +598,36 @@ class ElasticCoordinator(object):
                  len(survivors), step, self.generation + 1),
             flush=True)
         from ..models import checkpoint as ckpt
-        try:
-            ckpt.save_shard_checkpoint(
-                self.ckpt_dir, st["cfg"], st["params"],
-                momentum=st.get("momentum"), step=step,
-                rank=new_rank, world=len(survivors),
-                generation=self.generation + 1,
-                cursor=st.get("cursor"), rng=st.get("rng"),
-                base_world=self.base_world,
-                metadata=dict(st.get("metadata") or {},
-                              shrink_from_world=self.world))
-        except Exception:               # last gasp: report, still leave
-            import traceback
-            traceback.print_exc()
+        if quarantined:
+            # the dead peer was QUARANTINED for silent corruption: the
+            # survivors' live state may descend from the poisoned
+            # all-reduce, so it must not become the resume point — no
+            # shard capture; resume falls back to the last VERIFIED
+            # checkpoint (models/checkpoint verify-on-load lineage)
+            print("[elastic] rank %d g%d: dead peer(s) %s quarantined "
+                  "for corruption — skipping shard capture; resume "
+                  "restores from the last verified checkpoint"
+                  % (self.rank, self.generation, quarantined),
+                  flush=True)
+        else:
+            try:
+                ckpt.save_shard_checkpoint(
+                    self.ckpt_dir, st["cfg"], st["params"],
+                    momentum=st.get("momentum"), step=step,
+                    rank=new_rank, world=len(survivors),
+                    generation=self.generation + 1,
+                    cursor=st.get("cursor"), rng=st.get("rng"),
+                    base_world=self.base_world,
+                    metadata=dict(st.get("metadata") or {},
+                                  shrink_from_world=self.world))
+            except Exception:           # last gasp: report, still leave
+                import traceback
+                traceback.print_exc()
         try:
             write_shrink_record(self.dir, self.generation + 1,
                                 survivors, dead, step,
-                                base_world=self.base_world)
+                                base_world=self.base_world,
+                                quarantined=quarantined)
         except OSError:
             pass
         self.heartbeat.stop()

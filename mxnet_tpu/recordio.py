@@ -16,14 +16,48 @@ import ctypes
 import numbers
 import os
 import struct
+import zlib
 from collections import namedtuple
 
 import numpy as np
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+from . import _fastenv
+from .observability import chaos as _chaos
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "RecordCorrupt",
+           "pack", "unpack", "pack_img", "unpack_img"]
 
 _kMagic = 0xced7230a
+
+
+class RecordCorrupt(IOError):
+    """A record failed its integrity check (bad magic or CRC mismatch).
+
+    Subclasses IOError so the io.py retry path (``io._retry_read``)
+    treats it as transient first: a bit flipped in the page cache or by
+    injected chaos recovers on re-read, while a flip ON DISK exhausts
+    the retries and surfaces this error naming the file and record.
+    """
+
+    def __init__(self, path, record_index, detail=""):
+        self.path = path
+        self.record_index = record_index
+        msg = "corrupt record %s in %s" % (record_index, path)
+        if detail:
+            msg += ": %s" % detail
+        super().__init__(msg)
+
+
+def _crc_enabled():
+    """MXNET_RECORDIO_CRC: write + verify the per-record CRC sidecar
+    (default on; 0 disables both). The sidecar keeps the .rec format
+    interchange-compatible — reference tooling ignores it."""
+    return str(_fastenv.get("MXNET_RECORDIO_CRC", "1")).lower() \
+        not in ("0", "false", "off", "")
+
+
+def _crc_path(uri):
+    return str(uri) + ".crc"
 
 
 def _encode_lrec(cflag, length):
@@ -40,6 +74,12 @@ class MXRecordIO(object):
     Format per record: uint32 magic | uint32 lrec (3-bit cflag, 29-bit
     len) | payload | pad to 4-byte boundary. cflag 0 = whole record;
     1/2/3 = begin/middle/end of a split record (records > 2^29 bytes).
+
+    Integrity (MXNET_RECORDIO_CRC, default on): writers emit a
+    ``<uri>.crc`` sidecar of offset -> crc32(payload); readers verify
+    each record against it and the frame magic, raising
+    ``RecordCorrupt(path, record_index)`` — an IOError, so the io.py
+    retry path re-reads once before the error surfaces.
     """
 
     def __init__(self, uri, flag):
@@ -53,12 +93,30 @@ class MXRecordIO(object):
         if self.flag == "w":
             self.fio = open(self.uri, "wb")
             self.writable = True
+            self._crc_entries = [] if _crc_enabled() else None
+            self._crc = None
         elif self.flag == "r":
             self.fio = open(self.uri, "rb")
             self.writable = False
+            self._crc_entries = None
+            self._crc = self._load_crc()
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        self._read_count = 0
+        self._pending_index = None
         self.pid = os.getpid()
+
+    def _load_crc(self):
+        """offset -> crc32 of the logical payload, from the sidecar."""
+        if not _crc_enabled() or not os.path.isfile(_crc_path(self.uri)):
+            return None
+        table = {}
+        with open(_crc_path(self.uri)) as fin:
+            for line in fin:
+                parts = line.strip().split("\t")
+                if len(parts) == 2:
+                    table[int(parts[0])] = int(parts[1], 16)
+        return table or None
 
     def __del__(self):
         self.close()
@@ -86,6 +144,10 @@ class MXRecordIO(object):
 
     def close(self):
         if self.fio is not None and not self.fio.closed:
+            if self.writable and self._crc_entries:
+                with open(_crc_path(self.uri), "w") as fout:
+                    for off, crc in self._crc_entries:
+                        fout.write("%d\t%08x\n" % (off, crc))
             self.fio.close()
         self.fio = None
         self.pid = None
@@ -101,6 +163,9 @@ class MXRecordIO(object):
     def write(self, buf):
         assert self.writable
         self._check_pid(allow_reset=False)
+        if self._crc_entries is not None:
+            self._crc_entries.append(
+                (self.fio.tell(), zlib.crc32(buf) & 0xFFFFFFFF))
         self.fio.write(struct.pack("<II", _kMagic,
                                    _encode_lrec(0, len(buf))))
         self.fio.write(buf)
@@ -114,14 +179,22 @@ class MXRecordIO(object):
     def read(self):
         assert not self.writable
         self._check_pid(allow_reset=True)
+        index = self._pending_index if self._pending_index is not None \
+            else self._read_count
+        self._pending_index = None
+        start = self.fio.tell()
         parts = []
         while True:
             head = self.fio.read(8)
             if len(head) < 8:
-                return b"".join(parts) if parts else None
+                if parts:
+                    break
+                return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _kMagic:
-                raise RuntimeError("Invalid record magic in %s" % self.uri)
+                raise RecordCorrupt(
+                    self.uri, index,
+                    "bad magic 0x%08x (want 0x%08x)" % (magic, _kMagic))
             cflag, length = _decode_lrec(lrec)
             data = self.fio.read(length)
             pad = (4 - (length % 4)) % 4
@@ -129,7 +202,25 @@ class MXRecordIO(object):
                 self.fio.read(pad)
             parts.append(data)
             if cflag in (0, 3):  # whole record or end-of-split
-                return b"".join(parts)
+                break
+        self._read_count += 1
+        data = b"".join(parts)
+        if _chaos.enabled():
+            # in-memory bit flip AFTER the read: a retried read sees
+            # the clean on-disk bytes (the transient-SDC scenario)
+            data = _chaos.corrupt_bytes("recordio.read", data,
+                                        path=self.uri, record=index)
+        want = self._crc.get(start) if self._crc else None
+        if want is not None:
+            got = zlib.crc32(data) & 0xFFFFFFFF
+            if got != want:
+                # rewind so a retry re-reads the same record
+                self.fio.seek(start)
+                self._read_count -= 1
+                raise RecordCorrupt(
+                    self.uri, index,
+                    "crc %08x != sidecar %08x" % (got, want))
+        return data
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -171,6 +262,7 @@ class MXIndexedRecordIO(MXRecordIO):
         assert not self.writable
         self._check_pid(allow_reset=True)
         self.fio.seek(self.idx[idx])
+        self._pending_index = idx  # name THIS key in corruption errors
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -205,8 +297,9 @@ class MXIndexedRecordIO(MXRecordIO):
                         break
                     magic, lrec = struct.unpack("<II", head)
                     if magic != _kMagic:
-                        raise RuntimeError(
-                            "Invalid record magic in %s" % self.uri)
+                        raise RecordCorrupt(
+                            self.uri, len(offsets),
+                            "bad magic 0x%08x during index scan" % magic)
                     cflag, length = _decode_lrec(lrec)
                     if cflag in (0, 1):       # logical record start
                         offsets.append(pos)
@@ -245,6 +338,18 @@ class MXIndexedRecordIO(MXRecordIO):
                 out = _native.recordio_read(self.uri, offsets, lengths,
                                             num_threads)
                 if out is not None:
+                    if self._crc:
+                        # the native scatter path bypasses read() — run
+                        # the same sidecar verification here
+                        for key, off, payload in zip(indices, offsets,
+                                                     out):
+                            want = self._crc.get(off)
+                            if want is not None and \
+                                    zlib.crc32(payload) & 0xFFFFFFFF \
+                                    != want:
+                                raise RecordCorrupt(
+                                    self.uri, key,
+                                    "crc mismatch on batched read")
                     return out
         return [self.read_idx(i) for i in indices]
 

@@ -26,11 +26,15 @@ intact, stream bit-exact, checkpoint loadable, resume bit-exact):
            relaunch via resume_from_latest: the concatenated loss
            trajectory is bit-exact (float hex) vs an uninterrupted run
 
-Two scenarios run as their own tier-1 lane invocations:
-``--elastic`` (the 2-process shrink/regrow chain) and ``--overload``
+Three scenarios run as their own tier-1 lane invocations:
+``--elastic`` (the 2-process shrink/regrow chain), ``--overload``
 (the ISSUE 12 serving overload storm: mixed-priority burst at ~4x
 block capacity, one replica chaos-killed mid-storm, recovery through
-the circuit breaker's HALF_OPEN canary).
+the circuit breaker's HALF_OPEN canary), and ``--integrity`` (the
+silent-corruption defense: one injected flip per corruption class —
+gradient bucket, replicated weight on one rank, checkpoint byte,
+recordio record — each detected with named evidence AND recovered
+from a verified state).
 """
 
 import argparse
@@ -628,6 +632,292 @@ def elastic():
     return 0
 
 
+def integrity_train_worker(ckdir, steps):
+    """Subprocess body for the --integrity grad-flip leg: a gluon
+    training loop through the fused kvstore path, one verified
+    checkpoint per step, restartable via load_checkpoint. A replay-
+    audit verdict quarantines INSIDE trainer.step (exit 46), before
+    the corrupted step's checkpoint is ever written."""
+    import numpy as np
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.models.checkpoint import (save_checkpoint,
+                                             load_checkpoint)
+
+    cfg = _tiny_cfg()               # carrier config for the manifest
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="device")
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(size=(8, 10)).astype(np.float32))
+    y = mx.nd.array(rng.uniform(size=(8, 4)).astype(np.float32))
+    params = net.collect_params()
+    start = 0
+    if os.path.exists(os.path.join(ckdir, "manifest.json")):
+        net(x)                      # materialize deferred-init shapes
+        _, saved, _, start, _ = load_checkpoint(ckdir)
+        for k, p in params.items():
+            p.data()._data = jnp.asarray(saved[k])
+    for step in range(start + 1, steps + 1):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)             # a detected flip exits 46 HERE
+        print("LOSS %d %s" % (step,
+                              float(loss.asnumpy().sum()).hex()),
+              flush=True)
+        save_checkpoint(ckdir, cfg,
+                        {k: p.data()._data for k, p in params.items()},
+                        step=step, keep=3)
+    return 0
+
+
+def vote_worker():
+    """Subprocess body for the --integrity weight-drift leg: one of
+    three gloo ranks trains with a chaos-flipped replicated weight;
+    the per-step fingerprint vote must name it."""
+    from mxnet_tpu import parallel
+    parallel.init_distributed()
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.observability import integrity
+
+    rank = jax.process_index()
+    assert jax.process_count() == 3
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05},
+                            kvstore="dist_tpu_sync")
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)          # same data on every rank
+    x = mx.nd.array(rng.uniform(size=(8, 10)).astype(np.float32))
+    y = mx.nd.array(rng.uniform(size=(8, 4)).astype(np.float32))
+    for _ in range(2):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+    if integrity.stats["votes"] < 1:
+        print("[chaos_smoke] FAIL(vote): rank %d never voted" % rank)
+        return 1
+    if rank == 1 and integrity.stats["detected"] < 1:
+        print("[chaos_smoke] FAIL(vote): the flipped rank saw no "
+              "verdict")
+        return 1
+    print("VOTE-RANK-OK %d" % rank, flush=True)
+    return 0
+
+
+def integrity_scenario():
+    """One injected flip per silent-corruption class, each asserting
+    BOTH detection (evidence naming rank/bucket/file/record) and
+    verified recovery (docs/ROBUSTNESS.md "Silent corruption")."""
+    import json
+
+    # ---- gradient-bucket flip -> replay audit -> quarantine(46) ----
+    # -> relaunch resumes BIT-exact from the last verified checkpoint
+    d = tempfile.mkdtemp(prefix="chaos_smoke_integrity_")
+    sb = os.path.join(d, "sb")
+    env_base = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+                "CHAOS_SMOKE_WORKER": "integrity_train"}
+
+    def run(ckdir, extra=None):
+        env = dict(os.environ, **env_base)
+        for k in ("MXNET_CHAOS", "MXNET_INTEGRITY",
+                  "MXNET_INTEGRITY_REPLAY_EVERY",
+                  "MXNET_INTEGRITY_ACTION", "MXNET_INTEGRITY_EVERY"):
+            env.pop(k, None)
+        env.update(extra or {})
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), ckdir, "5"],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    base = run(os.path.join(d, "a"))
+    if base.returncode != 0:
+        print("[chaos_smoke] FAIL(grad): baseline rc=%d\n%s"
+              % (base.returncode, base.stderr[-2000:]))
+        return 1
+    want = [l for l in base.stdout.splitlines() if l.startswith("LOSS")]
+
+    armed = {"MXNET_INTEGRITY": "1", "MXNET_INTEGRITY_EVERY": "0",
+             "MXNET_INTEGRITY_REPLAY_EVERY": "1",
+             "MXNET_INTEGRITY_ACTION": "quarantine",
+             "MXNET_ELASTIC_DIR": sb}
+    flipped = run(os.path.join(d, "b"),
+                  dict(armed,
+                       MXNET_CHAOS="kvstore.bucket.pack:bitflip:"
+                                   "at=2:bit=30:elem=5"))
+    if flipped.returncode != 46:
+        print("[chaos_smoke] FAIL(grad): flipped run rc=%d (want "
+              "quarantine 46)\n%s" % (flipped.returncode,
+                                      flipped.stderr[-2000:]))
+        return 1
+    rec_path = os.path.join(sb, "quarantine.g0.rank0.json")
+    if not os.path.exists(rec_path):
+        print("[chaos_smoke] FAIL(grad): no quarantine evidence at %s"
+              % rec_path)
+        return 1
+    with open(rec_path) as f:
+        ev = json.load(f).get("evidence", {})
+    if ev.get("kind") != "replay_mismatch" or "bucket" not in ev:
+        print("[chaos_smoke] FAIL(grad): evidence lacks bucket-level "
+              "replay verdict: %s" % ev)
+        return 1
+    resumed = run(os.path.join(d, "b"), armed)   # detectors stay armed
+    if resumed.returncode != 0:
+        print("[chaos_smoke] FAIL(grad): resume rc=%d\n%s"
+              % (resumed.returncode, resumed.stderr[-2000:]))
+        return 1
+    got = [l for l in (flipped.stdout + resumed.stdout).splitlines()
+           if l.startswith("LOSS")]
+    if got != want:
+        print("[chaos_smoke] FAIL(grad): post-quarantine trajectory "
+              "diverged:\n  want %s\n  got  %s" % (want, got))
+        return 1
+    print("[chaos_smoke] grad OK: bucket flip caught by the replay "
+          "audit (bucket %s), quarantine(46) with evidence, %d-step "
+          "loss trajectory bit-exact after verified-checkpoint resume"
+          % (ev.get("bucket"), len(want)))
+
+    # ---- replicated-weight flip on one rank -> 3-way vote ----
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+                "CHAOS_SMOKE_WORKER": "vote",
+                "MXNET_INTEGRITY": "1", "MXNET_INTEGRITY_EVERY": "1",
+                "MXNET_INTEGRITY_REPLAY_EVERY": "0",
+                "MXNET_INTEGRITY_ACTION": "warn",
+                "MXNET_CHAOS":
+                    "trainer.weights:bitflip:rank=1:at=0:bit=30"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "3", "--launcher", "local",
+         sys.executable, os.path.abspath(__file__)],
+        capture_output=True, text=True, timeout=420, env=env)
+    if r.returncode != 0 or r.stdout.count("VOTE-RANK-OK") != 3:
+        print("[chaos_smoke] FAIL(vote): rc=%d\n%s\n%s"
+              % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+        return 1
+    if "replica_drift" not in r.stderr \
+            or "'drifted': [1]" not in r.stderr:
+        print("[chaos_smoke] FAIL(vote): no replica_drift verdict "
+              "naming rank 1 in stderr:\n%s" % r.stderr[-2000:])
+        return 1
+    print("[chaos_smoke] vote OK: weight flip on rank 1 of 3 named by "
+          "the fingerprint majority vote on every rank")
+
+    # ---- checkpoint-byte flip -> refuse by name -> verified fallback --
+    import warnings
+
+    import numpy as np
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models import checkpoint as ckpt
+    from mxnet_tpu.observability import chaos
+
+    cfg = _tiny_cfg()
+    ck = os.path.join(d, "ck")
+    p1 = T.init_params(cfg, seed=1)
+    ckpt.save_checkpoint(ck, cfg, p1, step=1, keep=2)
+    chaos.install("checkpoint.bytes:bitflip:at=0:elem=4096:bit=6")
+    try:
+        ckpt.save_checkpoint(ck, cfg, T.init_params(cfg, seed=2),
+                             step=2, keep=2)
+    finally:
+        chaos.reset()
+    try:
+        ckpt.load_checkpoint(ck, fallback=False)
+    except ckpt.CheckpointCorrupt as e:
+        if "arrays-2" not in str(e):
+            print("[chaos_smoke] FAIL(checkpoint): corruption error "
+                  "does not name the data file: %s" % e)
+            return 1
+    else:
+        print("[chaos_smoke] FAIL(checkpoint): flipped byte loaded "
+              "without complaint")
+        return 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        _, got_p, _, step, _ = ckpt.load_checkpoint(ck)
+    if step != 1:
+        print("[chaos_smoke] FAIL(checkpoint): fell back to step %r, "
+              "want 1" % step)
+        return 1
+    a, b = {}, {}
+    ckpt._flatten(p1, "p", a)
+    ckpt._flatten(got_p, "p", b)
+    if any(np.asarray(b[k]).tobytes() != np.asarray(a[k]).tobytes()
+           for k in a):
+        print("[chaos_smoke] FAIL(checkpoint): fallback weights are "
+              "not bit-identical to the verified step-1 save")
+        return 1
+    print("[chaos_smoke] checkpoint OK: flipped byte refused naming "
+          "the data file, recovery fell back to the verified step-1 "
+          "checkpoint bit-exactly")
+
+    # ---- recordio record flip: transient retried, persistent fatal --
+    from mxnet_tpu import io as mx_io, recordio
+
+    chaos.reset()
+    rec_file = os.path.join(d, "data.rec")
+    payload = bytes(range(48))
+    w = recordio.MXRecordIO(rec_file, "w")
+    w.write(payload)
+    w.close()
+    r0 = recordio.MXRecordIO(rec_file, "r")
+    chaos.install("recordio.read:bitflip:at=0:bit=2:elem=5")
+    try:
+        r0.read()
+        print("[chaos_smoke] FAIL(recordio): transient flip read "
+              "without complaint")
+        return 1
+    except recordio.RecordCorrupt as e:
+        if e.path != rec_file or e.record_index != 0:
+            print("[chaos_smoke] FAIL(recordio): evidence names %r "
+                  "record %r" % (e.path, e.record_index))
+            return 1
+    if r0.read() != payload:           # rule exhausted: retry is clean
+        print("[chaos_smoke] FAIL(recordio): retry after a transient "
+              "flip did not deliver the clean record")
+        return 1
+    r0.close()
+    chaos.reset()
+    with open(rec_file, "r+b") as f:   # at-rest flip: every read fails
+        f.seek(11)
+        byte = f.read(1)
+        f.seek(11)
+        f.write(bytes([byte[0] ^ 4]))
+    os.environ["MXNET_IO_BACKOFF_MS"] = "1"
+    r1 = recordio.MXRecordIO(rec_file, "r")
+    try:
+        mx_io._retry_read(r1.read, "recordio.read", path=rec_file)
+        print("[chaos_smoke] FAIL(recordio): on-disk flip read "
+              "without complaint")
+        return 1
+    except IOError as e:
+        if "corrupt record 0" not in str(e) or rec_file not in str(e):
+            print("[chaos_smoke] FAIL(recordio): exhausted error "
+                  "lacks path/record evidence: %s" % e)
+            return 1
+    r1.close()
+    print("[chaos_smoke] recordio OK: transient flip named "
+          "(path, record 0) and recovered on retry; at-rest flip "
+          "exhausted retries into the enriched IOError")
+    return 0
+
+
 SCENARIOS = [("nan", nan_guard), ("ioerror", ioerror),
              ("serving", serving), ("hang", hang),
              ("sigterm", sigterm), ("crash", crash)]
@@ -645,12 +935,25 @@ def main():
                    help="run the serving overload storm e2e (priority "
                         "burst + replica kill; its own tier-1 lane "
                         "invocation)")
+    p.add_argument("--integrity", action="store_true",
+                   help="run the silent-corruption defense e2e (one "
+                        "injected flip per corruption class; its own "
+                        "tier-1 lane invocation)")
     args = p.parse_args()
     worker = os.environ.get("CHAOS_SMOKE_WORKER")
     if worker == "hang":
         return hang_worker(args.args[0])
     if worker == "train":
         return train_worker(args.args[0], int(args.args[1]))
+    if worker == "integrity_train":
+        return integrity_train_worker(args.args[0], int(args.args[1]))
+    if worker == "vote":
+        return vote_worker()
+    if args.integrity:
+        if integrity_scenario():
+            print("[chaos_smoke] integrity scenario FAILED")
+            return 1
+        return 0
     if args.elastic:
         if elastic():
             print("[chaos_smoke] elastic scenario FAILED")

@@ -23,6 +23,11 @@ docs/ROBUSTNESS.md "Elastic recovery"):
     45   generation boundary, work remaining: a clean hand-back so a
          recovered host can rejoin -> NOT counted, relaunch at g+1
          regrown to the full world (unless --no-regrow)
+    46   integrity quarantine (observability/integrity.py): the rank
+         judged itself corrupt, wrote its evidence to the sideband,
+         and left -> counted restart; its host goes on the cooldown
+         list (--quarantine-cooldown generations held out of regrow)
+         and the relaunch resumes from the last VERIFIED checkpoint
     143  SIGTERM (preemption): emergency checkpoint committed ->
          counted restart, relaunch at g+1, same world
     else hard crash (SIGKILL/OOM/bug) -> counted restart with
@@ -50,6 +55,7 @@ ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 sys.path.insert(0, ROOT)
 
 from mxnet_tpu.parallel import elastic  # noqa: E402
+from mxnet_tpu.observability import integrity  # noqa: E402
 
 
 def worker_env(args, proc_id, world, generation):
@@ -106,6 +112,8 @@ def classify(codes):
         return "done"
     if elastic.SHRINK_EXIT_CODE in codes:
         return "shrink"
+    if integrity.QUARANTINE_EXIT_CODE in codes:
+        return "quarantine"
     if all(c in (0, elastic.BOUNDARY_EXIT_CODE) for c in codes):
         return "boundary"
     if 43 in codes:
@@ -140,6 +148,9 @@ def main(argv=None):
                          "--chaos-generation's workers (replayable "
                          "one-shot fault injection)")
     ap.add_argument("--chaos-generation", type=int, default=0)
+    ap.add_argument("--quarantine-cooldown", type=int, default=2,
+                    help="generations a quarantined host is held out "
+                         "of regrow (the cooldown list)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -156,11 +167,32 @@ def main(argv=None):
     restarts = 0
     last_bad = 1
     args._since_wall = None
+    cooldown = {}     # host tag -> first generation it may rejoin
     while True:
         codes = run_generation(args, world, generation)
         verdict = classify(codes)
         print("[elastic_launch] generation %d exited %s -> %s"
               % (generation, codes, verdict), flush=True)
+        qranks = [i for i, c in enumerate(codes)
+                  if c == integrity.QUARANTINE_EXIT_CODE]
+        if qranks:
+            # surface the evidence the quarantined rank left behind,
+            # and put its host on the regrow cooldown list (on a real
+            # deployment the tag maps to a pod/host to drain)
+            recs = elastic.read_quarantine_records(args.elastic_dir,
+                                                   generation)
+            tags = {}
+            for rec in recs:
+                print("[elastic_launch] quarantine evidence: rank %s "
+                      "(%s) — %s" % (rec.get("rank"), rec.get("host"),
+                                     rec.get("evidence")), flush=True)
+                tags[int(rec.get("rank", -1))] = rec.get("host")
+            for r in qranks:
+                tag = tags.get(r) or "rank%d" % r
+                cooldown[tag] = generation + 1 + args.quarantine_cooldown
+                print("[elastic_launch] host %s on cooldown until "
+                      "generation %d" % (tag, cooldown[tag]),
+                      flush=True)
         if verdict == "done":
             print("[elastic_launch] job complete after %d generation(s)"
                   ", %d counted restart(s)"
@@ -168,8 +200,16 @@ def main(argv=None):
             return 0
         args._since_wall = time.time()
         if verdict == "boundary":
-            # clean hand-back: the recovered host rejoins here
-            new_world = args.num_workers if not args.no_regrow else world
+            # clean hand-back: the recovered host rejoins here — minus
+            # any hosts still on the quarantine cooldown list
+            target = args.num_workers if not args.no_regrow else world
+            held = sorted(t for t, g in cooldown.items()
+                          if g > generation + 1)
+            new_world = max(1, target - len(held))
+            if held and new_world < target:
+                print("[elastic_launch] regrow held back by cooldown: "
+                      "%s (world %d instead of %d)"
+                      % (held, new_world, target), flush=True)
             if new_world > world:
                 print("[elastic_launch] regrow: world %d -> %d"
                       % (world, new_world), flush=True)
@@ -201,6 +241,19 @@ def main(argv=None):
                       flush=True)
                 generation += 1
                 continue
+        if verdict == "quarantine":
+            # the corrupt rank removed itself (no shrink record at
+            # world 1, or before the survivors reacted): relaunch
+            # without it — workers resume from the last VERIFIED
+            # checkpoint (the verify-on-load lineage refuses anything
+            # descended from the corruption)
+            new_world = max(1, world - len(qranks))
+            print("[elastic_launch] quarantine: rank(s) %s removed — "
+                  "relaunching at world %d from the last verified "
+                  "checkpoint" % (qranks, new_world), flush=True)
+            world = new_world
+            generation += 1
+            continue
         # watchdog / sigterm / crash: capped exponential backoff with
         # jitter so N supervisors never stampede a shared resource
         delay = min(args.backoff_ms * (2 ** (restarts - 1)), 30000.0)
