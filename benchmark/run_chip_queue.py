@@ -127,6 +127,17 @@ QUEUE = [
     ("serving_overload",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--overload"]}, 1800, False),
+    # HBM-pressure resilience (not a throughput leg): one injected
+    # RESOURCE_EXHAUSTED on the paged decode dispatch — the batcher
+    # must shrink the KV pool and retry (blocks park, a lane preempts
+    # and resumes bit-exact) instead of rebuilding lanes — plus a
+    # kv_shrink brownout-rung walk through a FAILED pool grow and the
+    # clean grow that restores capacity. The JSON row is the
+    # degradation ledger and the leg exits nonzero if the contract
+    # breaks (docs/ROBUSTNESS.md "Memory pressure")
+    ("serving_mempressure",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--mem-pressure"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",

@@ -64,6 +64,20 @@ list, and the preempt-stall percentiles; the leg exits nonzero if the
 degradation contract breaks (a deadlock, a non-priority-0 drop, a
 diverged stream, or the killed replica failing to return).
 
+With ``--mem-pressure`` it runs the HBM-pressure resilience leg
+(again a degradation ledger, not a throughput number): a seeded
+mixed-length paged workload takes one deterministic
+RESOURCE_EXHAUSTED on its decode dispatch — the batcher must shrink
+the KV pool and retry (park blocks, preempt a lane through the
+bit-exact resume path) instead of rebuilding lanes — and a second
+batcher walks the kv_shrink brownout rung down through a FAILED pool
+grow (reduced capacity, no crash) and a clean grow that restores it.
+The JSON row carries blocks parked vs requested, lanes parked and
+resumed, the kv_shrink/OOM-taxonomy counters, stream bit-exactness
+vs solo generate(), the grow-back outcome, and whether the health
+snapshot exports mem.headroom_bytes; the leg exits nonzero if any of
+it breaks (docs/ROBUSTNESS.md "Memory pressure").
+
 After the throughput legs, the continuous-batching pools run once more
 INSTRUMENTED (MXNET_OBS forced on for that run only) to print the
 request-level TTFT / ITL / e2e / queue-wait percentile table from the
@@ -638,6 +652,204 @@ def overload_ab():
         sys.exit(1)
 
 
+def mem_pressure_ab():
+    """The memory-pressure leg (``--mem-pressure``): a seeded mixed-
+    length paged workload absorbs one deterministic RESOURCE_EXHAUSTED
+    on its decode dispatch — the batcher must respond with the ISSUE 14
+    shrink-and-retry (park KV blocks, preempt the lowest-priority lane
+    through the bit-exact resume path, redispatch against the smaller
+    pool) instead of the lane-rebuild — and a second batcher walks the
+    ``kv_shrink`` brownout rung down through a FAILED pool grow
+    (capacity loss, never a crash) and a clean grow that restores full
+    capacity. Nothing here is a throughput number; the row reports the
+    DEGRADATION ledger: blocks parked vs requested, lanes parked and
+    resumed, the kv_shrink/OOM-taxonomy counters, whether every stream
+    stayed bit-exact vs solo generate() across the shrink, zero leaked
+    blocks at quiesce, and the grow-back outcome — plus whether the
+    health snapshot carries the ``mem.headroom_bytes`` field the
+    router's starvation gate reads."""
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import chaos
+    from mxnet_tpu.observability import core as obs
+    from mxnet_tpu.observability import membudget
+
+    backend = jax.default_backend()
+    if SMOKE:
+        vocab = 8192
+        d_model, heads, layers, max_len = 32, 2, 1, 96
+        t_prompt, block_size = 24, 8
+        n_new, n_jobs, slots = 16, 6, 3
+    else:
+        vocab = 32000
+        d_model, heads, layers, max_len = 512, 8, 8, 2048
+        t_prompt = 192
+        block_size = int(os.environ.get("MXNET_KV_BLOCK_SIZE", "16"))
+        n_new, n_jobs, slots = 64, 8, 4
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    cfg = tf.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=dtype)
+    params = tf.init_params(cfg, seed=0)
+    # pool sized so the workload fits comfortably BEFORE the shrink —
+    # the injected OOM, not admission pressure, is what forces parking.
+    # The forced shrink leaves exactly one full stream-lifetime of
+    # blocks usable, so free capacity alone can never cover it and the
+    # lane-park/resume path is guaranteed to exercise, while any single
+    # stream still fits the post-shrink pool.
+    life = (t_prompt + n_new - 2) // block_size + 1
+    num_blocks = slots * life + 2
+    shrink_n = (num_blocks - 1) - life
+    jrng = np.random.RandomState(23)
+    jobs = []
+    for _ in range(n_jobs):
+        t_p = int(jrng.randint(max(2, t_prompt // 2), t_prompt))
+        jobs.append((list(jrng.randint(1, vocab, t_p)), n_new))
+    print("serving mem-pressure: backend=%s dtype=%s d_model=%d "
+          "layers=%d block=%d pool=%d blocks, forced shrink=%d, "
+          "%d jobs over %d lanes"
+          % (backend, np.dtype(dtype).name, d_model, layers,
+             block_size, num_blocks - 1, shrink_n, n_jobs, slots),
+          flush=True)
+
+    solo = [np.asarray(tf.generate(
+        params, jnp.asarray([p], jnp.int32), n, cfg,
+        greedy=True))[0].tolist() for p, n in jobs]
+    obs.set_enabled(True)
+    obs.reset()
+    chaos.reset()
+    membudget.reset()
+    # arm the budget subsystem for the leg's duration: warn-only (no
+    # enforcement), but note_oom taxonomy counting and the healthz
+    # memory section are armed-gated — the off-path stays one guarded
+    # branch for everyone who didn't opt in
+    os.environ["MXNET_MEM_BUDGET"] = "warn"
+    os.environ["MXNET_MEM_KV_SHRINK_BLOCKS"] = str(shrink_n)
+    t0 = time.time()
+    try:
+        shrinks0 = obs.counter("serving.kv_shrinks").value
+        # ---- phase A: OOM on the decode dispatch -> shrink-and-retry
+        chaos.inject("serving.dispatch", "oom", at=2)
+        srv = ContinuousBatcher(params, cfg, max_batch=slots,
+                                paged=True, block_size=block_size,
+                                num_blocks=num_blocks)
+        queue = list(jobs)
+        order, results, alias = [], {}, {}
+        parked_max = lanes_parked_max = resumed = rounds = 0
+        while queue or srv.preempted or srv.active_count:
+            while queue and srv.has_capacity:
+                rid = srv.admit(queue[0][0], queue[0][1])
+                if rid is None:
+                    break
+                order.append(rid)
+                queue.pop(0)
+            # resume parked lanes as capacity frees (the run() policy,
+            # inlined so the ledger can watch the preemption ledger)
+            while srv.preempted and srv.has_capacity:
+                req, t_ns = srv.preempted[0]
+                rid = srv.admit_continuation(
+                    req.tokens, req.n_new - req.emitted, seed=req.seed,
+                    emitted=req.emitted, stop_token=req.stop_token,
+                    priority=req.priority, preempted_ns=t_ns)
+                if rid is None:
+                    break
+                srv.preempted.pop(0)
+                alias[rid] = alias.get(req.rid, req.rid)
+                resumed += 1
+            results.update(srv.step())
+            lanes_parked_max = max(lanes_parked_max,
+                                   len(srv.preempted))
+            parked_max = max(parked_max, srv._alloc.parked_blocks)
+            rounds += 1
+            if rounds >= 600:
+                break
+        deadlocked = bool(queue or srv.preempted or srv.active_count)
+        fired_dispatch = chaos.stats["oom"]
+        kv_shrinks = int(
+            obs.counter("serving.kv_shrinks").value - shrinks0)
+        srv.check_invariants(quiesce=True)   # zero leaked blocks
+        # the starvation-gate export: present whenever the platform
+        # reports device memory stats (CPU doesn't — absent there is
+        # the correct answer, not a miss)
+        mem_section = ("mem.headroom_bytes" in srv.health_snapshot()
+                       or membudget.headroom_bytes() is None)
+        chaos.reset()
+        if alias:
+            results = {alias.get(rid, rid): toks
+                       for rid, toks in results.items()}
+        exact = all(results.get(rid) == solo[j]
+                    for j, rid in enumerate(order))
+
+        # ---- phase B: kv_shrink rung walk with a FAILED grow-back ----
+        srv2 = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                 block_size=block_size,
+                                 num_blocks=2 * life + 2, brownout=True)
+        os.environ.pop("MXNET_MEM_KV_SHRINK_BLOCKS", None)
+        srv2._set_rung(4)                  # kv_shrink rung parks
+        rung_parked = srv2._bo_parked
+        chaos.inject("kv.pool.grow", "oom", at=0)
+        srv2._set_rung(0)                  # grow-back OOMs: stay shrunk
+        fired_grow = chaos.stats["oom"]
+        stayed_shrunk = (srv2._alloc.parked_blocks == rung_parked
+                         and rung_parked > 0)
+        chaos.reset()
+        restored = (srv2.grow_pool(rung_parked) == rung_parked
+                    and srv2._alloc.parked_blocks == 0)
+        p, n = jobs[0]
+        rid = srv2.admit(p, n)
+        done = {}
+        grounds = 0
+        while rid not in done and grounds < 200:
+            done.update(srv2.step())
+            grounds += 1
+        post_grow_exact = done.get(rid) == solo[0]
+        srv2.check_invariants(quiesce=True)
+        wall = time.time() - t0
+        mb_stats = dict(membudget.stats)
+    finally:
+        os.environ.pop("MXNET_MEM_KV_SHRINK_BLOCKS", None)
+        os.environ.pop("MXNET_MEM_BUDGET", None)
+        chaos.reset()
+        membudget.reset()
+        obs.set_enabled(None)
+        obs.reset()
+
+    row = {
+        "leg": "serving_mempressure", "jobs": n_jobs, "slots": slots,
+        "block_size": block_size, "num_blocks": num_blocks,
+        "shrink_requested": shrink_n, "parked_blocks_max": parked_max,
+        "lanes_parked_max": lanes_parked_max, "resumed": resumed,
+        "kv_shrinks": kv_shrinks, "oom_injected": fired_dispatch,
+        "oom_caught": mb_stats["oom_caught"],
+        "oom_transient": mb_stats["oom_transient"],
+        "oom_structural": mb_stats["oom_structural"],
+        "bit_exact": exact, "deadlocked": deadlocked,
+        "rounds": rounds, "health_mem_section": mem_section,
+        "grow": {"rung_parked": rung_parked,
+                 "grow_oom_injected": fired_grow,
+                 "stayed_shrunk": stayed_shrunk,
+                 "restored": restored,
+                 "post_grow_bit_exact": post_grow_exact},
+        "wall_s": round(wall, 2), "backend": backend,
+    }
+    print(json.dumps(row), flush=True)
+    if deadlocked or not exact or fired_dispatch != 1 \
+            or kv_shrinks != 1 or parked_max < shrink_n \
+            or lanes_parked_max < 1 or resumed < 1 \
+            or not mem_section or fired_grow != 1 \
+            or not stayed_shrunk or not restored \
+            or not post_grow_exact:
+        print("serving mem-pressure leg FAILED its degradation "
+              "contract", flush=True)
+        sys.exit(1)
+
+
 def main():
     from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
@@ -836,5 +1048,7 @@ if __name__ == "__main__":
         paged_ab()
     elif "--overload" in sys.argv[1:]:
         overload_ab()
+    elif "--mem-pressure" in sys.argv[1:]:
+        mem_pressure_ab()
     else:
         main()

@@ -153,6 +153,20 @@ if [ "${TIER1_CHAOS:-0}" = "1" ]; then
         echo "[tier1] FAIL: integrity smoke"
         exit 1
     fi
+
+    echo "==== [tier1] memory-pressure smoke (one injected OOM per recovery path) ===="
+    # docs/ROBUSTNESS.md "Memory pressure", end to end on the CPU
+    # mesh: a deterministic RESOURCE_EXHAUSTED at each of the four
+    # sites — trainer.step (accum re-lower at 2x, global-batch loss
+    # trajectory preserved and deterministic), serving.dispatch (pool
+    # shrink-and-retry, streams bit-exact, zero leaked blocks),
+    # kv.pool.grow (a failed grow degrades capacity instead of
+    # crashing), checkpoint.snapshot (serial-gather retry, the
+    # committed checkpoint reloads bit-exact). No process may die.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py --oom; then
+        echo "[tier1] FAIL: memory-pressure smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

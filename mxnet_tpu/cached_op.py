@@ -24,6 +24,7 @@ from .base import MXNetError
 from .executor import apply_mirror, build_graph_fn, mirror_enabled
 from .observability import attribution as _obs_attr
 from .observability import core as _obs
+from .observability import membudget as _membudget
 from .observability import recompile as _obs_recompile
 
 # fixed key fed to RNG-free graphs (never consumed; avoids a per-call
@@ -166,7 +167,17 @@ class CachedOp:
         if diff_names:
             fn = self._get_fn(is_train, diff_names)
             diff_list = [args[n] for n in diff_names]
-            (outs, aux_up), vjp_fn = fn(diff_list, args, aux, rng_key)
+            if _membudget.enabled():
+                _membudget.preflight(
+                    "CachedOp[%s].fwd" % self._obs_name(), fn,
+                    (diff_list, args, aux, rng_key), signature=sig)
+            try:
+                (outs, aux_up), vjp_fn = fn(diff_list, args, aux,
+                                            rng_key)
+            except Exception as exc:
+                _membudget.note_oom(
+                    "CachedOp[%s].fwd" % self._obs_name(), exc)
+                raise
 
             diff_nds = [by_name[n] for n in diff_names]
 
@@ -198,7 +209,13 @@ class CachedOp:
                         origin, sig, jax.jit(_step),
                         (diff_list, args, aux, rng_key,
                          (cts_t, aux_ct)))
-                grads = _apply_vjp(vjp_fn, (cts_t, aux_ct))
+                if _membudget.enabled():
+                    _membudget.preflight(origin, signature=sig)
+                try:
+                    grads = _apply_vjp(vjp_fn, (cts_t, aux_ct))
+                except Exception as exc:
+                    _membudget.note_oom(origin, exc)
+                    raise
                 return grads
 
             node = autograd.TapeNode(
@@ -217,7 +234,16 @@ class CachedOp:
                 _obs_attr.register_program(
                     "CachedOp[%s].fwd" % self._obs_name(), sig, fn,
                     (args, aux, rng_key))
-            outs, aux_up = fn(args, aux, rng_key)
+            if _membudget.enabled():
+                _membudget.preflight(
+                    "CachedOp[%s].fwd" % self._obs_name(), fn,
+                    (args, aux, rng_key), signature=sig)
+            try:
+                outs, aux_up = fn(args, aux, rng_key)
+            except Exception as exc:
+                _membudget.note_oom(
+                    "CachedOp[%s].fwd" % self._obs_name(), exc)
+                raise
             results = [nd.NDArray(o, ctx) for o in outs]
 
         for name, val in aux_up.items():

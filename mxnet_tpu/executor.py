@@ -22,6 +22,7 @@ from . import inspector as _inspector
 from .base import MXNetError
 from .observability import attribution as _obs_attr
 from .observability import core as _obs
+from .observability import membudget as _membudget
 from .observability import recompile as _obs_recompile
 from .symbol import OP_AUX
 
@@ -381,8 +382,19 @@ class Executor:
                 _obs_attr.register_program(
                     "Executor[%s].fwd" % self._symbol.list_outputs()[0],
                     sig, self._fwd_res_fn, (diff, rest, aux_arrays, key))
-            outs, aux_up, vjp = self._fwd_res_fn(diff, rest, aux_arrays,
-                                                 key)
+            if _membudget.enabled() and self._jitted:
+                _membudget.preflight(
+                    "Executor[%s].fwd" % self._symbol.list_outputs()[0],
+                    self._fwd_res_fn, (diff, rest, aux_arrays, key),
+                    signature=sig)
+            try:
+                outs, aux_up, vjp = self._fwd_res_fn(diff, rest,
+                                                     aux_arrays, key)
+            except Exception as exc:
+                _membudget.note_oom(
+                    "Executor[%s].fwd" % self._symbol.list_outputs()[0],
+                    exc)
+                raise
             self._saved_vjp = (vjp, outs)
             for name, val in aux_up.items():
                 self.aux_dict[name]._data = val
@@ -394,7 +406,19 @@ class Executor:
                     "Executor[%s].infer"
                     % self._symbol.list_outputs()[0],
                     sig, self._infer_fn, (arg_arrays, aux_arrays, key))
-            outs = self._infer_fn(arg_arrays, aux_arrays, key)
+            if _membudget.enabled() and self._jitted:
+                _membudget.preflight(
+                    "Executor[%s].infer"
+                    % self._symbol.list_outputs()[0],
+                    self._infer_fn, (arg_arrays, aux_arrays, key),
+                    signature=sig)
+            try:
+                outs = self._infer_fn(arg_arrays, aux_arrays, key)
+            except Exception as exc:
+                _membudget.note_oom(
+                    "Executor[%s].infer"
+                    % self._symbol.list_outputs()[0], exc)
+                raise
         _engine.sync_if_needed(outs)
         fwd_span.stop()
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
@@ -421,7 +445,18 @@ class Executor:
             _obs_attr.register_program(
                 "Executor[%s].bwd" % self._symbol.list_outputs()[0],
                 self._obs_sig, self._bwd_fn, (vjp, cotangent))
-        grads = self._bwd_fn(vjp, cotangent)
+        if _membudget.enabled() and self._jitted:
+            _membudget.preflight(
+                "Executor[%s].bwd" % self._symbol.list_outputs()[0],
+                self._bwd_fn, (vjp, cotangent),
+                signature=self._obs_sig)
+        try:
+            grads = self._bwd_fn(vjp, cotangent)
+        except Exception as exc:
+            _membudget.note_oom(
+                "Executor[%s].bwd" % self._symbol.list_outputs()[0],
+                exc)
+            raise
         _engine.sync_if_needed(grads)
         for name, g in zip(self._diff_args, grads):
             req = self._grad_req.get(name, "write")

@@ -27,6 +27,7 @@ from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
 from ..observability import integrity as _integrity
+from ..observability import membudget as _membudget
 from ..observability import recompile as _obs_recompile
 from ..parallel import elastic as _elastic
 from ..parallel import fusion
@@ -144,41 +145,57 @@ class Trainer(object):
         (gluon/trainer.py:305)."""
         self._ready()
         _t_step_ns = _time.perf_counter_ns() if _obs.enabled() else None
-        with _obs.span("trainer.step", cat="step"):
-            self._optimizer.rescale_grad = self._scale / batch_size
-            if _chaos.enabled():
-                # chaos site: a "nan" rule poisons this step's local
-                # gradients — the fault the step guard below exists for
-                _chaos.poison_ndarrays(
-                    "trainer.grads",
-                    [p.grad() for _, p in self._trainable()
-                     if p._data is not None])
-                # silent weight corruption on this rank — the
-                # integrity cross-rank vote's prey
-                _chaos.poison_bitflip(
-                    "trainer.weights",
-                    [p.data() for _, p in self._trainable()
-                     if p._data is not None])
-            if _chaos.step_guard_enabled() and not self._grads_finite():
-                # non-finite loss/grads: skip allreduce AND update (the
-                # update may live inside the store), back off the AMP
-                # loss scale when one rides the trainer, and count the
-                # skip — one bad batch must never poison the weights
-                _chaos.count_skipped_step(
-                    "trainer", getattr(self, "_amp_loss_scaler", None))
-                return
-            self._allreduce_grads()
-            # AMP fp16 dynamic loss scaling (contrib.amp.init_trainer):
-            # check overflow, fold 1/scale into the update, skip the
-            # step when any grad is non-finite
-            scaler = getattr(self, "_amp_loss_scaler", None)
-            if scaler is not None:
-                skip = scaler.has_overflow(self._params)
-                scaler.update_scale(skip)
-                if skip:
+        try:
+            with _obs.span("trainer.step", cat="step"):
+                self._optimizer.rescale_grad = self._scale / batch_size
+                if _chaos.enabled():
+                    # chaos site: an "oom" rule raises a real-shaped
+                    # RESOURCE_EXHAUSTED here — the membudget taxonomy
+                    # and recovery paths' replayable prey
+                    _chaos.fire("trainer.step")
+                    # a "nan" rule poisons this step's local gradients
+                    # — the fault the step guard below exists for
+                    _chaos.poison_ndarrays(
+                        "trainer.grads",
+                        [p.grad() for _, p in self._trainable()
+                         if p._data is not None])
+                    # silent weight corruption on this rank — the
+                    # integrity cross-rank vote's prey
+                    _chaos.poison_bitflip(
+                        "trainer.weights",
+                        [p.data() for _, p in self._trainable()
+                         if p._data is not None])
+                if _chaos.step_guard_enabled() \
+                        and not self._grads_finite():
+                    # non-finite loss/grads: skip allreduce AND update
+                    # (the update may live inside the store), back off
+                    # the AMP loss scale when one rides the trainer,
+                    # and count the skip — one bad batch must never
+                    # poison the weights
+                    _chaos.count_skipped_step(
+                        "trainer",
+                        getattr(self, "_amp_loss_scaler", None))
                     return
-                self._optimizer.rescale_grad /= scaler.loss_scale
-            self._update(ignore_stale_grad)
+                self._allreduce_grads()
+                # AMP fp16 dynamic loss scaling
+                # (contrib.amp.init_trainer): check overflow, fold
+                # 1/scale into the update, skip the step when any grad
+                # is non-finite
+                scaler = getattr(self, "_amp_loss_scaler", None)
+                if scaler is not None:
+                    skip = scaler.has_overflow(self._params)
+                    scaler.update_scale(skip)
+                    if skip:
+                        return
+                    self._optimizer.rescale_grad /= scaler.loss_scale
+                self._update(ignore_stale_grad)
+        except Exception as exc:
+            # OOM taxonomy: classify a RESOURCE_EXHAUSTED (and, under
+            # MXNET_MEM_OOM_ACTION=checkpoint, route through the
+            # emergency provider + exit 47 for the supervisor). A
+            # non-OOM error — or an unarmed run — re-raises untouched.
+            _membudget.handle_trainer_oom(exc)
+            raise
         if _obs.enabled():
             # bounded-memory step-time distribution (p99 over the whole
             # run, not the ring suffix); per-rank histograms merge
@@ -191,6 +208,11 @@ class Trainer(object):
             # the cross-rank straggler exchange
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)
+            # step-cadence mem.device.* gauge refresh (no-op unless
+            # MXNET_MEM_GAUGE_EVERY is set) — headroom-driven brownout
+            # and routing act on live data, not dump-time snapshots
+            from .. import storage as _storage
+            _storage.maybe_publish_device_memory_gauges()
         if _elastic.enabled():
             # elastic membership: heartbeat + dead-peer check at the
             # step boundary (the fast path — a peer detected here

@@ -52,6 +52,7 @@ from .ndarray import NDArray
 from .observability import chaos as _chaos
 from .observability import core as _obs
 from .observability import integrity as _integrity
+from .observability import membudget as _membudget
 from .observability import watchdog as _wd
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
@@ -626,7 +627,16 @@ class KVStoreTPUSync(KVStore):
                     "%s[%s]x%d" % (datas[0].dtype, ",".join(
                         str(d) for d in shape), n),
                     reduce_fn, (global_arr,))
-        return reduce_fn(global_arr)
+        if _membudget.enabled():
+            _membudget.preflight(
+                "KVStore.allreduce", reduce_fn, (global_arr,),
+                signature="%s[%s]x%d" % (datas[0].dtype, ",".join(
+                    str(d) for d in shape), n))
+        try:
+            return reduce_fn(global_arr)
+        except Exception as exc:
+            _membudget.note_oom("KVStore.allreduce", exc)
+            raise
 
     def _cross_process_allreduce(self, datas):
         """Multi-host push: sum the local contributions, then one global

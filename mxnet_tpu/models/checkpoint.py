@@ -141,18 +141,67 @@ def _gather_to_host(x):
     return np.asarray(jax.device_get(x))
 
 
-def _gather_all(flat):
-    """Host snapshot of every leaf, D2H transfers overlapped: kick off
-    every addressable leaf's async copy first, then complete them in
-    order. Returns {key: np.ndarray}."""
-    for v in flat.values():
-        start = getattr(v, "copy_to_host_async", None)
-        if start is not None and getattr(v, "is_fully_addressable", True):
-            try:
-                start()
-            except Exception:        # best-effort overlap only
-                break
+def _gather_all(flat, overlap=True):
+    """Host snapshot of every leaf. With ``overlap`` (default) the D2H
+    transfers are overlapped: every addressable leaf's async copy is
+    kicked off first, then completed in order. ``overlap=False`` is the
+    memory-pressure fallback — leaf-by-leaf serial gather, so the
+    staging peak is one leaf instead of the whole tree. Returns
+    {key: np.ndarray}."""
+    if overlap:
+        for v in flat.values():
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None and getattr(v, "is_fully_addressable",
+                                             True):
+                try:
+                    start()
+                except Exception:    # best-effort overlap only
+                    break
     return {k: _gather_to_host(v) for k, v in flat.items()}
+
+
+def _flat_nbytes(flat):
+    total = 0
+    for v in flat.values():
+        try:
+            total += int(v.size) * int(np.dtype(v.dtype).itemsize)
+        except Exception:
+            pass
+    return total
+
+
+def _snapshot(flat):
+    """The D2H snapshot step of every save, memory-pressure aware
+    (ISSUE 14 satellite — this staging used to be invisible to
+    accounting). While the gather is in flight its bytes are counted
+    against headroom (``membudget.note_snapshot_start`` ledger, read by
+    concurrent preflights / the serving brownout); a snapshot that
+    would itself breach the reserve is DEFERRED to the serial
+    leaf-by-leaf gather (staging peak = one leaf) instead of pushing a
+    near-full device over the edge; a RESOURCE_EXHAUSTED mid-gather
+    (chaos site ``checkpoint.snapshot``, or the real thing) retries
+    once post-GC without overlap. All of it one guarded branch when no
+    ``MXNET_MEM_*`` knob (and no chaos spec) is set."""
+    from ..observability import membudget as _membudget
+    armed = _membudget.armed()
+    if not armed and not _chaos.enabled():
+        return _gather_all(flat)
+    nbytes = _flat_nbytes(flat)
+    overlap = _membudget.admit_snapshot(nbytes) if armed else True
+    _membudget.note_snapshot_start(nbytes)
+    try:
+        if _chaos.enabled():
+            _chaos.fire("checkpoint.snapshot", bytes=nbytes)
+        return _gather_all(flat, overlap=overlap)
+    except Exception as exc:
+        if not _membudget.is_resource_exhausted(exc):
+            raise
+        _membudget.note_oom("checkpoint.snapshot", exc)
+        import gc
+        gc.collect()
+        return _gather_all(flat, overlap=False)
+    finally:
+        _membudget.note_snapshot_end(nbytes)
 
 
 def _unflatten(flat):
@@ -314,7 +363,7 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
 
     import jax
     if async_save and jax.process_count() == 1:
-        host = _gather_all(flat)
+        host = _snapshot(flat)
         t = _Saver(lambda: _write_commit_sweep(
             path, cfg, host, momentum is not None, step, metadata, keep))
         with _pending_lock:
@@ -322,7 +371,7 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
         t.start()
         return path
 
-    host = _gather_all(flat)
+    host = _snapshot(flat)
     write_error = None
     try:
         if jax.process_index() == 0:

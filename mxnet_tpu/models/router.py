@@ -71,6 +71,7 @@ from collections import deque
 from .serving import ContinuousBatcher
 from .. import _fastenv
 from ..observability import core as _obs
+from ..observability import membudget as _membudget
 
 __all__ = ["ReplicaRouter"]
 
@@ -240,6 +241,14 @@ class ReplicaRouter(object):
             att = snap.get("serving.slo_attainment")
             if not half_open and self.slo_floor is not None \
                     and att is not None and att < self.slo_floor:
+                continue
+            mem_hb = snap.get("mem.headroom_bytes")
+            if mem_hb is not None \
+                    and mem_hb < _membudget.reserve_bytes():
+                # device memory starved below the configured reserve:
+                # routing new work there would only trip its OOM
+                # recovery — steer admissions elsewhere until the
+                # snapshot shows headroom again
                 continue
             headroom = snap.get("serving.kv_available_blocks")
             if headroom is None:

@@ -83,6 +83,7 @@ from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import http as _obs_http
 from ..observability import integrity as _integrity
+from ..observability import membudget as _membudget
 from ..observability import slo as _slo
 
 DEFAULT_KV_BLOCK_SIZE = 16
@@ -607,9 +608,18 @@ class BlockAllocator(object):
     allocation converts reservation into real blocks as positions
     advance, and ``available`` (free minus reserved) is what admission
     and the router may still promise. A live request can therefore
-    never stall on an empty free list."""
+    never stall on an empty free list.
 
-    __slots__ = ("num_blocks", "ref", "reserved", "_free")
+    Under memory pressure the pool is ELASTIC (ISSUE 14):
+    :meth:`shrink` moves free blocks onto a parked ledger — out of
+    circulation, never below what ``reserved`` has already promised —
+    and :meth:`grow` returns them; :meth:`extend` adds physically new
+    block ids after the batcher grew the device pool. Parked blocks
+    stay in the conservation law (pool == free + referenced + parked,
+    the "reserved-aware" identity ``check_invariants`` asserts after
+    every shrink/grow cycle)."""
+
+    __slots__ = ("num_blocks", "ref", "reserved", "_free", "_parked")
 
     def __init__(self, num_blocks):
         if num_blocks < 2:
@@ -619,6 +629,7 @@ class BlockAllocator(object):
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self.ref = np.zeros((self.num_blocks,), np.int32)
         self.reserved = 0
+        self._parked = []     # blocks taken out of circulation (shrink)
 
     @property
     def free_blocks(self):
@@ -627,6 +638,45 @@ class BlockAllocator(object):
     @property
     def available(self):
         return len(self._free) - self.reserved
+
+    @property
+    def parked_blocks(self):
+        return len(self._parked)
+
+    def shrink(self, n):
+        """Park up to ``n`` free blocks (out of circulation until
+        :meth:`grow`). Never parks below the admission promise —
+        ``reserved`` blocks stay deliverable — so a live request can
+        still never stall on the free list. Returns the count actually
+        parked."""
+        take = max(min(int(n), len(self._free) - self.reserved), 0)
+        for _ in range(take):
+            self._parked.append(self._free.pop())
+        return take
+
+    def grow(self, n):
+        """Unpark up to ``n`` blocks back onto the free list. Returns
+        the count actually returned to circulation."""
+        give = max(min(int(n), len(self._parked)), 0)
+        for _ in range(give):
+            self._free.append(self._parked.pop())
+        return give
+
+    def extend(self, n):
+        """``n`` physically NEW block ids (the batcher just grew the
+        device pool's block axis): widen the refcount array and free
+        the fresh ids. Returns the new ids."""
+        n = int(n)
+        if n <= 0:
+            return []
+        ids = list(range(self.num_blocks, self.num_blocks + n))
+        self.num_blocks += n
+        self.ref = np.concatenate(
+            [self.ref, np.zeros((n,), np.int32)])
+        # front of the pop-from-end free list: fresh high ids hand out
+        # LAST, keeping low-id locality for the common case
+        self._free = ids[::-1] + self._free
+        return ids
 
     def alloc(self, n):
         """n fresh blocks at refcount 1 (raises when the free list is
@@ -679,24 +729,52 @@ class BlockAllocator(object):
           block may sit on the free list.
         * ``reserved`` never exceeds the free list (``available >= 0``
           is the promise admission accounting makes).
-        * ``quiesce=True``: nothing live may remain — every block free,
-          every refcount zero, zero reservation (the zero-leak bar the
-          overload harness asserts after a storm)."""
+        * pool conservation after every shrink/grow cycle:
+          ``num_blocks - 1 == free + referenced + parked`` — parked
+          blocks are disjoint from the free list, carry refcount 0,
+          and hold no duplicates (the elastic ledger can neither leak
+          nor double-count a block).
+        * ``quiesce=True``: nothing live may remain — every block free
+          or parked, every refcount zero, zero reservation (the
+          zero-leak bar the overload harness asserts after a storm)."""
         free = set(self._free)
         if len(free) != len(self._free):
             raise RuntimeError("free list holds duplicate block ids")
+        parked = set(self._parked)
+        if len(parked) != len(self._parked):
+            raise RuntimeError("parked ledger holds duplicate block ids")
+        if parked & free:
+            raise RuntimeError(
+                "blocks %s both parked and free" % sorted(parked & free))
         if 0 in free:
             raise RuntimeError("null block 0 leaked onto the free list")
+        if 0 in parked:
+            raise RuntimeError("null block 0 leaked onto the parked "
+                               "ledger")
         if int(self.ref[0]) != 0:
             raise RuntimeError("null block 0 acquired a refcount")
+        referenced = 0
         for b in range(1, self.num_blocks):
             r = int(self.ref[b])
+            if b in parked:
+                if r != 0:
+                    raise RuntimeError(
+                        "block %d is parked but refcount=%d" % (b, r))
+                continue
             if b in free and r != 0:
                 raise RuntimeError(
                     "block %d is free but refcount=%d" % (b, r))
             if b not in free and r < 1:
                 raise RuntimeError(
                     "block %d leaked: refcount=%d and not free" % (b, r))
+            if r >= 1:
+                referenced += 1
+        if len(free) + referenced + len(parked) != self.num_blocks - 1:
+            raise RuntimeError(
+                "pool conservation broken: %d free + %d referenced + "
+                "%d parked != %d non-null blocks"
+                % (len(free), referenced, len(parked),
+                   self.num_blocks - 1))
         if self.reserved < 0:
             raise RuntimeError("negative reservation")
         if self.reserved > len(self._free):
@@ -727,11 +805,12 @@ class BlockAllocator(object):
                 raise RuntimeError(
                     "quiesce with %d blocks still reserved"
                     % self.reserved)
-            if len(self._free) != self.num_blocks - 1:
+            if len(self._free) + len(self._parked) \
+                    != self.num_blocks - 1:
                 raise RuntimeError(
                     "quiesce with %d of %d blocks leaked"
-                    % (self.num_blocks - 1 - len(self._free),
-                       self.num_blocks - 1))
+                    % (self.num_blocks - 1 - len(self._free)
+                       - len(self._parked), self.num_blocks - 1))
         return True
 
 
@@ -963,6 +1042,16 @@ class ContinuousBatcher(object):
                 num_blocks = self.max_batch * self._nb + 1
             self.num_blocks = int(num_blocks)
             self._alloc = BlockAllocator(self.num_blocks)
+            if _membudget.enabled():
+                # pool init is the one serving allocation whose size is
+                # known analytically before any program compiles —
+                # preflight it against live headroom like a jit boundary
+                _membudget.preflight_bytes(
+                    "serving.paged_pool",
+                    tf.paged_cache_nbytes(cfg, self.num_blocks,
+                                          self.block_size),
+                    signature="%dx%d" % (self.num_blocks,
+                                         self.block_size))
             self._pool = tf.init_paged_cache(cfg, self.num_blocks,
                                              self.block_size)
             self._tables = jnp.zeros((self.max_batch, self._nb),
@@ -1065,10 +1154,12 @@ class ContinuousBatcher(object):
         # admit_continuation()
         self.preempted = []
         # brownout ladder (MXNET_SERVING_BROWNOUT=1): rung 0 is
-        # healthy; sustained SLO-attainment drop or block exhaustion
-        # climbs one rung at a time — 1: clamp the speculative draft
-        # width, 2: stop admitting new shareable prefixes, 3: throttle
-        # admission to one per scheduling round, 4: shed the lowest
+        # healthy; sustained SLO-attainment drop, block exhaustion, or
+        # (membudget-armed) device-headroom starvation climbs one rung
+        # at a time — 1: clamp the speculative draft width, 2: stop
+        # admitting new shareable prefixes, 3: throttle admission to
+        # one per scheduling round, 4: kv_shrink — park part of the KV
+        # pool (returned on the walk back down), 5: shed the lowest
         # priority class — and sustained recovery walks back down
         # (hysteresis: the trip and clear streaks differ)
         if brownout is None:
@@ -1091,6 +1182,8 @@ class ContinuousBatcher(object):
         self._bo_bad = 0
         self._bo_good = 0
         self._round_admits = 0
+        # blocks the kv_shrink rung parked (returned when it clears)
+        self._bo_parked = 0
         # MXNET_SERVING_DEBUG=1: allocator invariants audited at every
         # idle point (cheap standing leak detector; tests call
         # check_invariants unconditionally)
@@ -1164,6 +1257,16 @@ class ContinuousBatcher(object):
             snap["serving.kv_available_blocks"] = self._alloc.available
             snap["serving.kv_block_utilization"] = \
                 (usable - self._alloc.free_blocks) / float(usable)
+            if self._alloc.parked_blocks:
+                snap["serving.kv_parked_blocks"] = \
+                    self._alloc.parked_blocks
+        if _membudget.armed():
+            # live device headroom (None on platforms without memory
+            # stats): the router's starvation gate stops admitting to
+            # a replica whose headroom fell below the reserve
+            hb = _membudget.headroom_bytes()
+            if hb is not None:
+                snap["mem.headroom_bytes"] = hb
         if self._spec_on:
             snap["serving.spec_draft_ratio"] = (
                 self._spec_accepted / self._spec_drafted
@@ -1768,12 +1871,116 @@ class ContinuousBatcher(object):
             self.preempted.append((req, t_ns))
         return self._alloc.available >= demand
 
+    # ---- elastic KV pool (memory pressure) ----
+
+    def shrink_pool(self, n):
+        """Give back ``n`` blocks of KV capacity under memory pressure
+        (the OOM shrink-and-retry path and the ``kv_shrink`` brownout
+        rung both land here). Escalation order, cheapest first:
+        park free capacity beyond the admission promises -> evict
+        unreferenced prefix-cache blocks -> park the lowest-priority
+        lane through the PR 11 preemption path (it lands on
+        ``self.preempted`` and resumes bit-exactly via
+        ``admit_continuation``). Returns the number of blocks actually
+        parked (0 when not paged or nothing could be released)."""
+        if not self.paged:
+            return 0
+        n = int(n)
+        parked = self._alloc.shrink(n)
+        while parked < n:
+            need = n - parked
+            self._evict_prefixes(need)     # best-effort; may be partial
+            got = self._alloc.shrink(need)
+            parked += got
+            if got:
+                continue
+            live = [(r.priority, -r.rid, i)
+                    for i, r in enumerate(self._slots) if r is not None]
+            if not live:
+                break
+            _, _, i = min(live)
+            req = self._slots[i]
+            t_ns = time.perf_counter_ns()
+            _obs.counter("serving.preemptions").add(1)
+            if _obs.enabled():
+                _obs.record_instant(
+                    "serving.preempt", cat="serving",
+                    args={"rid": req.rid, "lane": i,
+                          "priority": req.priority,
+                          "reason": "kv_shrink",
+                          "synced": req.emitted})
+            self._free(i)
+            self.preempted.append((req, t_ns))
+        if parked and _obs.enabled():
+            _obs.counter("serving.kv_shrinks").add(1)
+            _obs.record_instant(
+                "serving.kv_shrink", cat="serving",
+                args={"requested": n, "parked": parked,
+                      "pool_parked": self._alloc.parked_blocks})
+        return parked
+
+    def grow_pool(self, n):
+        """Return ``n`` blocks of KV capacity: unpark shrink-ledger
+        blocks first, then physically extend the device pool (zero
+        blocks appended to every leaf — existing ids and tables stay
+        valid) for the remainder. Physical growth preflights its byte
+        cost against live headroom and fires the ``kv.pool.grow``
+        chaos site, so a grow under pressure fails loudly instead of
+        wedging the device. Returns the number of blocks returned to
+        circulation."""
+        if not self.paged or int(n) <= 0:
+            return 0
+        n = int(n)
+        if _chaos.enabled():
+            _chaos.fire("kv.pool.grow", blocks=n)
+        got = self._alloc.grow(n)
+        rest = n - got
+        if rest > 0:
+            nbytes = tf.paged_cache_nbytes(self.cfg, rest,
+                                           self.block_size)
+            if getattr(self, "_dpool", None) is not None:
+                nbytes += tf.paged_cache_nbytes(self.draft_cfg, rest,
+                                                self.block_size)
+            if _membudget.enabled():
+                _membudget.preflight_bytes(
+                    "kv.pool.grow", nbytes,
+                    signature="%d+%d" % (self.num_blocks, rest))
+            self._pool = tf.grow_paged_cache(self._pool, rest)
+            if getattr(self, "_dpool", None) is not None:
+                self._dpool = tf.grow_paged_cache(self._dpool, rest)
+            self._alloc.extend(rest)
+            self.num_blocks += rest
+            got += rest
+        if _obs.enabled():
+            _obs.record_instant(
+                "serving.kv_grow", cat="serving",
+                args={"requested": n, "returned": got,
+                      "num_blocks": self.num_blocks})
+        return got
+
+    def _oom_shrink(self, exc):
+        """A decode dispatch hit RESOURCE_EXHAUSTED: classify it
+        through the membudget taxonomy and respond with
+        shrink-and-retry — park part of the pool and let the next
+        ``step()`` redispatch against the smaller footprint — instead
+        of the PR 6 lane-rebuild (which would throw away every lane's
+        device state for what is a capacity problem, not a corruption
+        problem). An injected chaos OOM fires BEFORE the jitted chunk
+        consumes its donated carry, so lane state is intact; a real
+        post-donation OOM that persists after the shrink falls through
+        to the rebuild on the next consecutive failure. Returns True
+        when the shrink released capacity (the caller skips the
+        rebuild)."""
+        _membudget.note_oom(self._chaos_site, exc)
+        parked = self.shrink_pool(self._kv_shrink_blocks())
+        return parked > 0
+
     def _brownout_admit_ok(self, priority):
-        """The rung-3/4 admission gates (rungs 1-2 act on the decode
-        and prefix paths, not here): rung 3 throttles to one admission
-        per scheduling round, rung 4 sheds the lowest priority class
-        outright."""
-        if self._bo_rung >= 4 and priority <= 0:
+        """The rung-3/5 admission gates (rungs 1-2 act on the decode
+        and prefix paths, rung 4 on the pool, not here): rung 3
+        throttles to one admission per scheduling round, rung 5 sheds
+        the lowest priority class outright."""
+        if self._bo_rung >= 5 and priority <= 0:
             if _obs.enabled():
                 _obs.counter("serving.brownout_rejections").add(1)
             return False
@@ -1781,13 +1988,25 @@ class ContinuousBatcher(object):
             return False
         return True
 
+    def _kv_shrink_blocks(self):
+        """How many blocks the kv_shrink rung parks
+        (MXNET_MEM_KV_SHRINK_BLOCKS; default a quarter of the usable
+        pool)."""
+        v = _fastenv.get("MXNET_MEM_KV_SHRINK_BLOCKS")
+        try:
+            n = int(v) if v else 0
+        except (TypeError, ValueError):
+            n = 0
+        return n if n > 0 else max((self.num_blocks - 1) // 4, 1)
+
     def _brownout_tick(self):
         """One controller evaluation per scheduling round: sustained
-        SLO-attainment drop (below `brownout_attain`) or block
-        exhaustion climbs one rung after `brownout_trip` consecutive
-        bad rounds; `brownout_clear` consecutive healthy rounds walk
-        one rung back down. The asymmetric streaks are the hysteresis
-        — a single good round under churn must not bounce the ladder."""
+        SLO-attainment drop (below `brownout_attain`), block
+        exhaustion, or (membudget-armed) device headroom below the
+        reserve climbs one rung after `brownout_trip` consecutive bad
+        rounds; `brownout_clear` consecutive healthy rounds walk one
+        rung back down. The asymmetric streaks are the hysteresis — a
+        single good round under churn must not bounce the ladder."""
         self._round_admits = 0
         bad = False
         if _slo.active():
@@ -1796,11 +2015,19 @@ class ContinuousBatcher(object):
                 bad = True
         if self.paged and self._alloc.available <= 0:
             bad = True
+        if not bad and self.paged and _membudget.enabled():
+            # proactive kv_shrink driver: act on the headroom gauge
+            # BEFORE the allocator notices anything (the gauge moves
+            # first when a co-located training job or snapshot eats
+            # the device)
+            hb = _membudget.headroom_bytes()
+            if hb is not None and hb < _membudget.reserve_bytes():
+                bad = True
         if bad:
             self._bo_good = 0
             self._bo_bad += 1
             if self._bo_bad >= self._brownout_trip \
-                    and self._bo_rung < 4:
+                    and self._bo_rung < 5:
                 self._bo_bad = 0
                 self._set_rung(self._bo_rung + 1)
         else:
@@ -1812,7 +2039,26 @@ class ContinuousBatcher(object):
                 self._set_rung(self._bo_rung - 1)
 
     def _set_rung(self, rung):
+        prev = self._bo_rung
         self._bo_rung = rung
+        if self.paged:
+            # the kv_shrink rung (4) parks part of the pool on the way
+            # up and returns it on the way down — the proactive twin of
+            # the OOM shrink-and-retry path
+            if rung >= 4 and prev < 4 and not self._bo_parked:
+                self._bo_parked = self.shrink_pool(
+                    self._kv_shrink_blocks())
+            elif rung < 4 and prev >= 4 and self._bo_parked:
+                try:
+                    self.grow_pool(self._bo_parked)
+                    self._bo_parked = 0
+                except Exception as exc:
+                    # a grow that OOMs (real or injected) leaves the
+                    # pool shrunk — correctness never depends on
+                    # growing back, only capacity does
+                    if not _membudget.is_resource_exhausted(exc):
+                        raise
+                    _membudget.note_oom("kv.pool.grow", exc)
         if _obs.enabled():
             _obs.gauge("serving.brownout_rung").set(rung)
             _obs.record_instant("serving.brownout", cat="serving",
@@ -1878,12 +2124,18 @@ class ContinuousBatcher(object):
                     fn = (_jitted_ragged_step_paged if self.paged
                           else _jitted_ragged_step)(
                         self.cfg, *self._controls)
+                    if _membudget.enabled():
+                        _membudget.preflight(self._chaos_site, fn,
+                                             args)
                     nxt, keys, state = fn(*args)
                     toks = np.asarray(nxt).astype(np.int32)[None]
                 else:
                     fn = (_jitted_ragged_chunk_paged if self.paged
                           else _jitted_ragged_chunk)(
                         self.cfg, *self._controls, k)
+                    if _membudget.enabled():
+                        _membudget.preflight(self._chaos_site, fn,
+                                             args)
                     toks, keys, state = fn(*args)
                     toks = np.asarray(toks).astype(np.int32)   # [k, B]
                 if self.paged:
@@ -1930,13 +2182,17 @@ class ContinuousBatcher(object):
 
     def _end_round(self):
         """Per-scheduling-round epilogue shared by every step path:
-        the brownout controller's tick and the MXNET_SERVING_DEBUG
-        idle-point allocator audit. One guarded branch each when
+        the brownout controller's tick, the MXNET_SERVING_DEBUG
+        idle-point allocator audit, and the MXNET_MEM_GAUGE_EVERY
+        device-memory gauge cadence. One guarded branch each when
         off."""
         if self.brownout:
             self._brownout_tick()
         if self._debug:
             self._debug_idle_check()
+        if _obs.enabled():
+            from .. import storage as _storage
+            _storage.maybe_publish_device_memory_gauges()
 
     # ---- pipelined scheduling (pipeline_depth > 1) ----
 
@@ -1991,14 +2247,21 @@ class ContinuousBatcher(object):
                 _chaos.fire(self._chaos_site, mode="pipelined",
                             depth=len(self._inflight) + 1)
             if self.paged:
-                toks, pool, tables, tok, pos, keys = self._pipe_fn(
-                    self.params, self._pool, self._tables,
-                    self._dev_tok, self._dev_pos, self._dev_keys)
+                args = (self.params, self._pool, self._tables,
+                        self._dev_tok, self._dev_pos, self._dev_keys)
+                if _membudget.enabled():
+                    _membudget.preflight(self._chaos_site,
+                                         self._pipe_fn, args)
+                toks, pool, tables, tok, pos, keys = \
+                    self._pipe_fn(*args)
                 self._pool, self._tables = pool, tables
             else:
-                toks, cache, tok, pos, keys = self._pipe_fn(
-                    self.params, self._cache, self._dev_tok,
-                    self._dev_pos, self._dev_keys)
+                args = (self.params, self._cache, self._dev_tok,
+                        self._dev_pos, self._dev_keys)
+                if _membudget.enabled():
+                    _membudget.preflight(self._chaos_site,
+                                         self._pipe_fn, args)
+                toks, cache, tok, pos, keys = self._pipe_fn(*args)
                 self._cache = cache
         self._dispatch_failures = 0
         self.dispatch_count += 1
@@ -2111,31 +2374,41 @@ class ContinuousBatcher(object):
                             depth=len(self._inflight) + 1)
             if self._spec_provider == "ngram":
                 if self.paged:
+                    args = (self.params, self._pool, self._tables,
+                            self._dev_hist, self._dev_tok,
+                            self._dev_pos, keff)
+                else:
+                    args = (self.params, self._cache,
+                            self._dev_hist, self._dev_tok,
+                            self._dev_pos, keff)
+            elif self.paged:
+                args = (self.params, self.draft_params, self._pool,
+                        self._dpool, self._tables, self._dev_tok,
+                        self._dev_pos, keff)
+            else:
+                args = (self.params, self.draft_params, self._cache,
+                        self._dcache, self._dev_tok, self._dev_pos,
+                        keff)
+            if _membudget.enabled():
+                _membudget.preflight(self._chaos_site, self._spec_fn,
+                                     args)
+            if self._spec_provider == "ngram":
+                if self.paged:
                     targets, emits, pool, hist, tok, pos = \
-                        self._spec_fn(self.params, self._pool,
-                                      self._tables, self._dev_hist,
-                                      self._dev_tok, self._dev_pos,
-                                      keff)
+                        self._spec_fn(*args)
                     self._pool = pool
                 else:
                     targets, emits, cache, hist, tok, pos = \
-                        self._spec_fn(self.params, self._cache,
-                                      self._dev_hist, self._dev_tok,
-                                      self._dev_pos, keff)
+                        self._spec_fn(*args)
                     self._cache = cache
                 self._dev_hist = hist
             elif self.paged:
                 targets, emits, pool, dpool, tok, pos = \
-                    self._spec_fn(self.params, self.draft_params,
-                                  self._pool, self._dpool,
-                                  self._tables, self._dev_tok,
-                                  self._dev_pos, keff)
+                    self._spec_fn(*args)
                 self._pool, self._dpool = pool, dpool
             else:
                 targets, emits, cache, dcache, tok, pos = \
-                    self._spec_fn(self.params, self.draft_params,
-                                  self._cache, self._dcache,
-                                  self._dev_tok, self._dev_pos, keff)
+                    self._spec_fn(*args)
                 self._cache, self._dcache = cache, dcache
         self._dispatch_failures = 0
         self.dispatch_count += 1
@@ -2328,6 +2601,11 @@ class ContinuousBatcher(object):
                       "consecutive": self._dispatch_failures})
         if self._dispatch_failures > self._max_dispatch_failures:
             raise exc
+        if self.paged and _membudget.is_resource_exhausted(exc) \
+                and self._oom_shrink(exc):
+            # memory pressure, not corruption: the pool shrank and the
+            # lanes are intact — the next step() retries as-is
+            return
         pending = [r for r in self._slots if r is not None]
         self._rebuild_state()
         for req in pending:
@@ -2353,6 +2631,10 @@ class ContinuousBatcher(object):
             self._lane_need = [0] * self.max_batch
             self._sched_pos = np.zeros((self.max_batch,), np.int64)
             self._prefix_cache.clear()
+            # the fresh allocator parks nothing: the brownout ledger
+            # must agree, or its walk-down would grow past the
+            # original pool
+            self._bo_parked = 0
         else:
             self._cache = tf.init_cache(self.cfg, self.max_batch)
         self._pos = np.zeros((self.max_batch,), np.int32)
@@ -2391,6 +2673,7 @@ class ContinuousBatcher(object):
         self._dispatch_failures = 0
         self.preempted = []
         self._bo_rung = self._bo_bad = self._bo_good = 0
+        self._bo_parked = 0     # the rebuilt allocator parks nothing
         self._round_admits = 0
         if _obs.enabled():
             _obs.record_instant("serving.reset_lanes", cat="serving")

@@ -1013,6 +1013,35 @@ def init_paged_cache(cfg, num_blocks, block_size):
             for _ in range(cfg.n_layers)]
 
 
+def paged_cache_nbytes(cfg, num_blocks, block_size):
+    """Analytic byte size of the pool :func:`init_paged_cache` would
+    build — mirrors its dtype geometry (int8 k/v + fp32 scale planes
+    under kv_cache_int8, else ``cfg.dtype``) without allocating. The
+    memory budget's preflight for pool init/grow reads this."""
+    hd = cfg.d_model // cfg.n_heads
+    cells = num_blocks * block_size * _kvh(cfg)
+    if cfg.kv_cache_int8:
+        per_layer = 2 * cells * hd * 1 + 2 * cells * 4   # k/v + ks/vs
+    else:
+        per_layer = 2 * cells * hd * jnp.dtype(cfg.dtype).itemsize
+    return int(per_layer * cfg.n_layers)
+
+
+def grow_paged_cache(pool, extra_blocks):
+    """The pool with ``extra_blocks`` fresh zero blocks appended to
+    every leaf's block axis. Existing blocks keep their ids and values
+    (a pure concat — no copy of live data semantics change), so block
+    tables remain valid and the allocator simply extends its free list
+    with the new ids."""
+    if extra_blocks <= 0:
+        return pool
+    def g(leaf):
+        pad = jnp.zeros((extra_blocks,) + leaf.shape[1:], leaf.dtype)
+        return jnp.concatenate([leaf, pad], axis=0)
+    return [{name: g(leaf) for name, leaf in layer.items()}
+            for layer in pool]
+
+
 def _paged_gather(layer_pool, tables):
     """Gather one layer's pool through the block tables into the dense
     [B, NB*bs, ...] cache layout — ONE fused XLA gather feeding the

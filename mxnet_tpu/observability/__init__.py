@@ -47,6 +47,7 @@ from . import histogram
 from . import hlo
 from . import http
 from . import slo
+from . import membudget
 from . import attribution
 from . import recompile
 from . import watchdog
@@ -68,7 +69,8 @@ from .recompile import get_detector, note_call, record_retrace
 from .watchdog import get_watchdog
 
 __all__ = ["chaos", "core", "dist", "export", "histogram", "hlo",
-           "http", "slo", "attribution", "integrity", "recompile",
+           "http", "slo", "membudget", "attribution", "integrity",
+           "recompile",
            "watchdog", "ops_enabled", "format_ops_table",
            "compare_summaries", "ops_summary", "enabled",
            "set_enabled", "span", "counter", "gauge", "get_histogram",

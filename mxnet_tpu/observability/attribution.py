@@ -48,6 +48,7 @@ from .. import _fastenv
 
 __all__ = ["ops_enabled", "note_scope", "known_scopes", "register_program",
            "needs_program", "abstract_args", "on_compile", "analyses",
+           "program_analysis",
            "summary", "format_ops_table", "publish_counters",
            "compare_summaries", "reset", "DEFAULT_TOLERANCES"]
 
@@ -210,6 +211,35 @@ def analyses(refresh=False):
                     "peak_scopes": {}, "error": str(exc)}
         out.append(entry["analysis"])
     return out
+
+
+def program_analysis(origin, signature=None):
+    """The cached breakdown for ONE registered executable — the memory
+    budget's preflight source (``membudget.preflight`` reads
+    ``memory`` / ``peak_bytes`` / ``peak_scopes`` from it). Exact
+    (origin, signature) when the caller has the recompile-detector
+    signature, else the first entry for ``origin``. None when the
+    program was never registered; computes (and caches) the analysis on
+    first use, same as :func:`analyses`."""
+    with _lock:
+        entry = _programs.get((origin, signature))
+        if entry is None:
+            for (org, _sig), ent in _programs.items():
+                if org == origin:
+                    entry = ent
+                    break
+    if entry is None:
+        return None
+    if entry["analysis"] is None:
+        try:
+            entry["analysis"] = _analyze(entry)
+        except Exception as exc:         # backend without as_text, etc.
+            entry["analysis"] = {
+                "origin": entry["origin"],
+                "signature": entry["signature"],
+                "scopes": {}, "totals": {}, "peak_bytes": 0,
+                "peak_scopes": {}, "error": str(exc)}
+    return entry["analysis"]
 
 
 # ----------------------------------------------------------- summary --

@@ -25,6 +25,7 @@ Spec grammar (``MXNET_CHAOS`` env var, or ``install(spec)``)::
     spec  := rule (';' rule)*
     rule  := <site-glob> ':' <fault> (':' key '=' value)*
     fault := delay | hang | error | nan | crash | sigterm | bitflip
+             | oom
 
     keys: at=N     fire on the Nth match of this rule (0-based)
           every=N  fire on every Nth match (occ % N == 0)
@@ -36,6 +37,8 @@ Spec grammar (``MXNET_CHAOS`` env var, or ``install(spec)``)::
           bit=B    bitflip: which bit of the element/byte to flip
           elem=I   bitflip: which element (array sites) or byte
                    (byte/file sites) to corrupt
+          bytes=N  oom: the allocation size the injected
+                   RESOURCE_EXHAUSTED claims (default 1 GiB)
 
     MXNET_CHAOS="kvstore.pushpull_fused:delay:ms=250:at=3"
     MXNET_CHAOS="io.read:error:count=2;trainer.grads:nan:at=5"
@@ -69,6 +72,13 @@ Fault semantics at a site:
   ``poison_bitflip``/``bitflip_array``, byte/file sites use
   ``corrupt_bytes``/``corrupt_file``. The integrity detectors
   (observability/integrity.py) are proven against this fault.
+* ``oom``  — raise ``ChaosResourceExhausted``: a real-shaped XLA
+  RESOURCE_EXHAUSTED (same message grammar the PJRT allocator emits,
+  claiming ``bytes=N``), so every OOM recovery path — the membudget
+  taxonomy, training accum re-lowering, serving's KV shrink-and-retry,
+  the deferred checkpoint snapshot — replays deterministically on the
+  CPU mesh. Sites: ``trainer.step``, ``serving.dispatch``,
+  ``kv.pool.grow``, ``checkpoint.snapshot``.
 
 ``stats`` is the always-on cheap view (the ``kv.dispatch_stats``
 pattern); with ``MXNET_OBS=1`` every firing also lands a
@@ -93,23 +103,33 @@ import time
 from . import core
 from .. import _fastenv
 
-__all__ = ["ChaosError", "Rule", "enabled", "fire", "fire_rules",
+__all__ = ["ChaosError", "ChaosResourceExhausted", "Rule", "enabled",
+           "fire", "fire_rules",
            "inject", "install", "reset", "release", "rules", "stats",
            "poison_ndarrays", "poison_bitflip", "bitflip_array",
            "corrupt_bytes", "corrupt_file",
            "step_guard_enabled", "all_finite", "count_skipped_step"]
 
 FAULTS = ("delay", "hang", "error", "nan", "crash", "sigterm",
-          "bitflip")
+          "bitflip", "oom")
 
 DEFAULT_DELAY_MS = 100.0
 DEFAULT_HANG_MS = 30000.0
 DEFAULT_CRASH_CODE = 13
+DEFAULT_OOM_BYTES = 1 << 30
 
 
 class ChaosError(OSError):
     """The injected transient failure. Subclasses OSError so retrying
     readers (io.py) treat it exactly like a real flaky read."""
+
+
+class ChaosResourceExhausted(RuntimeError):
+    """The injected allocation failure. The message carries the
+    RESOURCE_EXHAUSTED status text the PJRT allocator emits, so
+    ``membudget.is_resource_exhausted`` — and any substring-matching
+    handler written for the real XlaRuntimeError — routes it
+    identically to a genuine device OOM."""
 
 
 class Rule(object):
@@ -118,11 +138,12 @@ class Rule(object):
     determinism this module is named for."""
 
     __slots__ = ("pattern", "fault", "at", "every", "count", "ms",
-                 "rank", "code", "bit", "elem", "seen", "fired")
+                 "rank", "code", "bit", "elem", "bytes", "seen",
+                 "fired")
 
     def __init__(self, pattern, fault, at=None, every=None, count=1,
                  ms=None, rank=None, code=DEFAULT_CRASH_CODE,
-                 bit=0, elem=0):
+                 bit=0, elem=0, bytes=DEFAULT_OOM_BYTES):
         if fault not in FAULTS:
             raise ValueError("unknown chaos fault %r (one of %s)"
                              % (fault, "/".join(FAULTS)))
@@ -136,6 +157,7 @@ class Rule(object):
         self.code = int(code)
         self.bit = int(bit)
         self.elem = int(elem)
+        self.bytes = int(bytes)
         self.seen = 0
         self.fired = 0
 
@@ -181,7 +203,7 @@ def parse_spec(spec):
                     % (chunk, kv))
             k, v = kv.split("=", 1)
             if k not in ("at", "every", "count", "ms", "rank", "code",
-                         "bit", "elem"):
+                         "bit", "elem", "bytes"):
                 raise ValueError(
                     "chaos rule %r: unknown key %r" % (chunk, k))
             kw[k] = v
@@ -298,6 +320,15 @@ def _execute(rule, site):
         raise ChaosError(
             "chaos: injected fault at site %r (occurrence %d of rule %r)"
             % (site, rule.seen - 1, rule.pattern))
+    elif rule.fault == "oom":
+        # real-shaped RESOURCE_EXHAUSTED: the leading status text
+        # matches what the PJRT allocator raises, so substring-matching
+        # handlers treat the injection exactly like the real thing
+        raise ChaosResourceExhausted(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate %d bytes. chaos: injected oom at site %r "
+            "(occurrence %d of rule %r)"
+            % (rule.bytes, site, rule.seen - 1, rule.pattern))
     elif rule.fault == "crash":
         os._exit(rule.code)          # SIGKILL semantics: no cleanup
     elif rule.fault == "sigterm":

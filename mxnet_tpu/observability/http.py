@@ -49,6 +49,11 @@ def _healthz():
     from . import dist, export, slo
     from . import histogram as _hist
     agg = export.aggregate()
+    try:
+        from . import membudget
+        mem = membudget.healthz_snapshot()
+    except Exception:  # noqa: BLE001 — health must never 500
+        mem = {}
     return {
         "status": "ok",
         "rank": dist.process_index(),
@@ -65,6 +70,7 @@ def _healthz():
                        for name, h in agg["histograms"].items()},
         "slo": {"targets": dict(slo.targets()),
                 "attainment": slo.attainment()},
+        "mem": mem,
     }
 
 

@@ -1,0 +1,528 @@
+"""HBM-pressure resilience — preflight memory budgeting + the OOM
+taxonomy (ISSUE 14 tentpole).
+
+Every failure class the robustness arc handles degrades gracefully
+except one: an HBM allocation failure still kills the process outright.
+The TensorFlow system paper (PAPERS.md) treats memory exhaustion as a
+first-class scheduling signal rather than a fatal error, and the
+cross-replica weight-update sharding paper shows per-replica memory is
+a *tunable*. The two halves this module adds:
+
+1. **Preflight budgeting.** The PR 4 attribution layer already computes
+   per-executable ``memory_analysis()`` sizes and peak watermarks, and
+   the PR 3 gauges already read live PJRT ``device_memory_stats`` —
+   nothing consumed either before XLA's RESOURCE_EXHAUSTED did. With
+   ``MXNET_MEM_BUDGET`` set, the first dispatch of every registered jit
+   boundary (CachedOp fwd/step, Executor fwd/infer/bwd, the KVStore
+   bucketed reduce, serving's decode/verify dispatch, paged-pool init)
+   sums the executable's predicted peak (arguments + outputs − aliased
+   + temps, max'd with the HLO def-to-last-use watermark) against live
+   device headroom minus a ``MXNET_MEM_BUDGET_RESERVE_MB`` safety
+   margin. A predicted breach surfaces *before* the device wedges:
+   warn-only under ``MXNET_MEM_BUDGET=warn`` (or ``1``), a raised
+   :class:`MemoryBudgetExceeded` — naming the executable, the predicted
+   peak, the live headroom, and the top-3 scopes by watermark from the
+   attribution breakdown — under ``MXNET_MEM_BUDGET=enforce``.
+
+2. **OOM taxonomy + recovery.** The same boundaries classify a caught
+   RESOURCE_EXHAUSTED as *transient-fragmentation* (a post-GC retry
+   probe finds the headroom again) or *structural-overcommit* (the
+   program cannot fit, full stop). ``MXNET_MEM_OOM_ACTION=accum`` lets
+   a training loop re-lower its step through
+   ``elastic.make_accum_train_step`` at 2× accumulation (global batch
+   and loss trajectory preserved — the PR 9 elastic-accum bar;
+   :func:`escalate_accum` refuses non-divisor factors loudly);
+   ``=checkpoint`` routes through the PR 6 emergency provider and exits
+   :data:`OOM_EXIT_CODE` (47) so ``tools/elastic_launch.py`` relaunches
+   at the reduced setting (supervisor-side sticky
+   ``MXNET_MEM_ACCUM_FACTOR``). Serving recovers in-process instead:
+   the paged pool shrinks and the dispatch retries
+   (``models/serving.py``).
+
+The PR 6 ``async_save`` fix rides along: the D2H snapshot's in-flight
+bytes were invisible to memory accounting — :func:`note_snapshot_start`
+counts them against :func:`headroom_bytes`, and
+:func:`admit_snapshot` defers (serializes) a snapshot that would itself
+breach the reserve.
+
+With every ``MXNET_MEM_*`` knob unset each hook is one guarded branch
+(the PR 2 off-cost contract): dispatch counts and numerics stay
+bit-identical — tested in tests/test_membudget.py.
+"""
+
+import os
+import sys
+import threading
+import warnings
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["OOM_EXIT_CODE", "MemoryBudgetExceeded", "budget_mode",
+           "enabled", "armed", "oom_action", "reserve_bytes",
+           "sticky_accum_factor", "headroom_bytes", "device_headroom",
+           "predicted_peak_bytes", "preflight", "preflight_bytes",
+           "is_resource_exhausted", "classify_oom", "note_oom",
+           "escalate_accum", "handle_trainer_oom", "checkpoint_and_exit",
+           "note_snapshot_start", "note_snapshot_end",
+           "snapshot_bytes_in_flight", "admit_snapshot",
+           "healthz_snapshot", "stats", "reset"]
+
+# supervisor-visible exit code (the taxonomy row next to 43 watchdog /
+# 44 shrink / 45 boundary / 46 quarantine — docs/ROBUSTNESS.md): the
+# worker hit structural memory overcommit, committed an emergency
+# checkpoint, and asks elastic_launch to relaunch it at a reduced
+# setting (sticky accumulation factor)
+OOM_EXIT_CODE = 47
+
+DEFAULT_RESERVE_MB = 64.0
+
+_lock = threading.Lock()
+_checked = set()          # (origin, signature) preflight verdicts issued
+_snapshot_inflight = [0]  # bytes of D2H checkpoint snapshots in flight
+
+# always-on cheap counters (the chaos.stats pattern); obs counters
+# mirror them when MXNET_OBS is on
+stats = {"preflight_checks": 0, "preflight_breaches": 0,
+         "oom_caught": 0, "oom_transient": 0, "oom_structural": 0,
+         "oom_accum": 0, "oom_checkpoint": 0, "snapshot_deferred": 0}
+
+
+class MemoryBudgetExceeded(RuntimeError):
+    """Preflight verdict: the executable's predicted peak does not fit
+    the live device headroom (minus the reserve). Raised only under
+    ``MXNET_MEM_BUDGET=enforce``; warn mode warns with the same text."""
+
+    def __init__(self, origin, predicted, headroom, reserve, scopes):
+        self.origin = origin
+        self.predicted_bytes = int(predicted)
+        self.headroom_bytes = int(headroom)
+        self.reserve_bytes = int(reserve)
+        self.scopes = dict(scopes or {})
+        top = sorted(self.scopes.items(), key=lambda kv: -kv[1])[:3]
+        msg = ("memory budget: %s predicts a %.1f MB peak against "
+               "%.1f MB live headroom (reserve %.1f MB)"
+               % (origin, predicted / 1e6, headroom / 1e6,
+                  reserve / 1e6))
+        if top:
+            msg += "; top scopes by watermark: " + ", ".join(
+                "%s=%.1fMB" % (s, b / 1e6) for s, b in top)
+        super().__init__(msg)
+
+
+# ------------------------------------------------------------- knobs --
+
+def budget_mode():
+    """``MXNET_MEM_BUDGET``: None (off) / ``"warn"`` (``warn``/``1``) /
+    ``"enforce"``. One ``_fastenv`` read — THE preflight site guard."""
+    v = _fastenv.get("MXNET_MEM_BUDGET")
+    if not v or v in ("0", "false", "False"):
+        return None
+    return "enforce" if v == "enforce" else "warn"
+
+
+def enabled():
+    return budget_mode() is not None
+
+
+def oom_action():
+    """``MXNET_MEM_OOM_ACTION``: None / ``"accum"`` / ``"checkpoint"``
+    — the training-side response to a classified OOM."""
+    v = _fastenv.get("MXNET_MEM_OOM_ACTION")
+    return v if v in ("accum", "checkpoint") else None
+
+
+def armed():
+    """True when ANY memory-pressure response is configured — the
+    guard the OOM-classification hooks sit behind."""
+    return enabled() or oom_action() is not None
+
+
+def reserve_bytes():
+    """``MXNET_MEM_BUDGET_RESERVE_MB`` safety margin (default 64 MB):
+    headroom the budget refuses to promise — runtime scratch,
+    fragmentation slack, the next allocation's breathing room."""
+    try:
+        mb = float(_fastenv.get("MXNET_MEM_BUDGET_RESERVE_MB",
+                                DEFAULT_RESERVE_MB))
+    except (TypeError, ValueError):
+        mb = DEFAULT_RESERVE_MB
+    return int(mb * 1e6)
+
+
+def sticky_accum_factor():
+    """``MXNET_MEM_ACCUM_FACTOR``: the supervisor-side sticky
+    accumulation factor an exit-47 relaunch carries (default 1) —
+    training loops start their step at this factor so the OOM that
+    killed the previous generation is not re-lowered verbatim."""
+    try:
+        return max(int(_fastenv.get("MXNET_MEM_ACCUM_FACTOR", "1")
+                       or 1), 1)
+    except (TypeError, ValueError):
+        return 1
+
+
+# ---------------------------------------------------------- headroom --
+
+def device_headroom():
+    """Live per-device free HBM from the PJRT counters:
+    {device: bytes_limit - bytes_in_use} for every device that reports
+    both (CPU backends typically report neither)."""
+    from .. import storage
+    out = {}
+    for dev, st in storage.device_memory_stats().items():
+        if "bytes_limit" in st and "bytes_in_use" in st:
+            out[dev] = int(st["bytes_limit"]) - int(st["bytes_in_use"])
+    return out
+
+def headroom_bytes():
+    """The budget's denominator: the TIGHTEST device's free bytes minus
+    the in-flight snapshot ledger (D2H staging the runtime has not
+    surfaced in bytes_in_use yet). None when no device reports limits —
+    every consumer treats unknown headroom as "stand down", never as
+    infinite."""
+    per = device_headroom()
+    if not per:
+        return None
+    return min(per.values()) - _snapshot_inflight[0]
+
+
+# ----------------------------------------------- snapshot byte ledger --
+
+def note_snapshot_start(nbytes):
+    """An async_save D2H snapshot of ``nbytes`` is in flight: count it
+    against headroom until :func:`note_snapshot_end` (the PR 6 gap this
+    PR closes — the snapshot used to be invisible to accounting)."""
+    if not armed():
+        return
+    with _lock:
+        _snapshot_inflight[0] += int(nbytes)
+    if core.enabled():
+        core.gauge("mem.snapshot_inflight_bytes", "bytes").set(
+            _snapshot_inflight[0])
+
+
+def note_snapshot_end(nbytes):
+    if not armed():
+        return
+    with _lock:
+        _snapshot_inflight[0] = max(_snapshot_inflight[0] - int(nbytes),
+                                    0)
+    if core.enabled():
+        core.gauge("mem.snapshot_inflight_bytes", "bytes").set(
+            _snapshot_inflight[0])
+
+
+def snapshot_bytes_in_flight():
+    return _snapshot_inflight[0]
+
+
+def admit_snapshot(nbytes):
+    """May an ``nbytes`` overlapped D2H snapshot start right now?
+    False when the staging would itself breach the reserve — the caller
+    defers to a leaf-by-leaf serial gather (peak = the largest leaf)
+    instead of pushing a near-full device into the exact OOM the
+    checkpoint insures against. Unknown headroom admits (the CPU mesh
+    and platforms without stats keep the old behavior)."""
+    hb = headroom_bytes()
+    if hb is None:
+        return True
+    if int(nbytes) <= hb - reserve_bytes():
+        return True
+    stats["snapshot_deferred"] += 1
+    if core.enabled():
+        core.counter("mem.snapshot_deferred").add(1)
+        core.record_instant(
+            "mem.snapshot_deferred", cat="mem",
+            args={"bytes": int(nbytes), "headroom": hb})
+    return False
+
+
+# ---------------------------------------------------------- preflight --
+
+def predicted_peak_bytes(memory, watermark=0):
+    """Predicted live-bytes peak of one executable from its
+    ``memory_analysis()`` sizes: arguments + outputs − aliased
+    (donated buffers are counted once) + temporaries, max'd against the
+    HLO def-to-last-use watermark (which sees intra-program liveness
+    the coarse sum cannot)."""
+    memory = memory or {}
+    total = (memory.get("argument_size_in_bytes", 0)
+             + memory.get("output_size_in_bytes", 0)
+             - memory.get("alias_size_in_bytes", 0)
+             + memory.get("temp_size_in_bytes", 0))
+    return max(int(total), int(watermark or 0))
+
+
+def _signature_of(args):
+    """A cheap structural key for the preflight cache when the caller
+    has no recompile-detector signature: leaf shapes/dtypes."""
+    import jax
+    parts = []
+    for leaf in jax.tree.leaves(args):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            parts.append("%s%s" % (leaf.dtype, tuple(leaf.shape)))
+        else:
+            parts.append(repr(leaf))
+    return "|".join(parts)
+
+
+def _memory_of(fn, args):
+    """Lower + compile ``fn`` from the abstract signature of ``args``
+    and return its ``memory_analysis()`` sizes (no registry entry
+    needed; suppresses recompile events — this is analysis, not a
+    retrace)."""
+    from . import attribution, recompile
+    aargs = attribution.abstract_args(args)
+    with recompile.suppress_events():
+        compiled = fn.lower(*aargs).compile()
+    ma = compiled.memory_analysis()
+    return {k: int(getattr(ma, k)) for k in
+            ("argument_size_in_bytes", "output_size_in_bytes",
+             "temp_size_in_bytes", "alias_size_in_bytes") if
+            hasattr(ma, k)}
+
+
+def _breach(origin, predicted, hb, scopes):
+    stats["preflight_breaches"] += 1
+    err = MemoryBudgetExceeded(origin, predicted, hb, reserve_bytes(),
+                               scopes)
+    if core.enabled():
+        core.counter("mem.budget_breaches").add(1)
+        core.record_instant(
+            "mem.budget_breach", cat="mem",
+            args={"origin": origin, "predicted_bytes": int(predicted),
+                  "headroom_bytes": int(hb),
+                  "mode": budget_mode()})
+    if budget_mode() == "enforce":
+        raise err
+    warnings.warn(str(err), RuntimeWarning, stacklevel=3)
+
+
+def preflight(origin, fn=None, args=None, signature=None):
+    """The budget check a jit boundary runs before its FIRST dispatch
+    of ``(origin, signature)``: predicted peak vs live headroom minus
+    the reserve. Uses the PR 4 attribution registry's cached analysis
+    when the program is registered there (which also names the top-3
+    watermark scopes in a breach), lowering ``fn`` directly otherwise.
+    Warm calls are one set-membership probe; with ``MXNET_MEM_BUDGET``
+    unset callers never reach here (one guarded branch). Returns the
+    predicted peak in bytes, or None when the check could not run
+    (unknown headroom, no analyzable program)."""
+    if budget_mode() is None:
+        return None
+    if signature is None and args is not None:
+        signature = _signature_of(args)
+    key = (origin, signature)
+    if key in _checked:
+        return None
+    with _lock:
+        if key in _checked:
+            return None
+        _checked.add(key)
+    hb = headroom_bytes()
+    if hb is None:
+        return None         # platform reports no limits: stand down
+    stats["preflight_checks"] += 1
+    memory, watermark, scopes = None, 0, {}
+    from . import attribution
+    analysis = attribution.program_analysis(origin, signature)
+    if analysis is not None and not analysis.get("error"):
+        memory = analysis.get("memory") or {}
+        watermark = analysis.get("peak_bytes", 0)
+        scopes = analysis.get("peak_scopes") or {}
+    elif fn is not None and args is not None:
+        try:
+            memory = _memory_of(fn, args)
+        except Exception:    # backend without memory_analysis, etc.
+            return None
+    if not memory and not watermark:
+        return None
+    predicted = predicted_peak_bytes(memory, watermark)
+    if core.enabled():
+        core.gauge("mem.predicted_peak_bytes", "bytes").set(predicted)
+    if predicted > hb - reserve_bytes():
+        _breach(origin, predicted, hb, scopes)
+    return predicted
+
+
+def preflight_bytes(origin, nbytes, signature=None):
+    """Direct-bytes preflight for allocations with a known size and no
+    compiled program (paged-pool init/grow): same verdict path, same
+    breach surface. Returns True when the allocation fits (or headroom
+    is unknown)."""
+    if budget_mode() is None:
+        return True
+    key = (origin, signature)
+    with _lock:
+        first = key not in _checked
+        _checked.add(key)
+    if not first:
+        return True
+    hb = headroom_bytes()
+    if hb is None:
+        return True
+    stats["preflight_checks"] += 1
+    if int(nbytes) > hb - reserve_bytes():
+        _breach(origin, int(nbytes), hb, {})
+        return False
+    return True
+
+
+# ------------------------------------------------------- OOM taxonomy --
+
+def is_resource_exhausted(exc):
+    """Does ``exc`` look like an XLA allocation failure? Matches the
+    runtime's RESOURCE_EXHAUSTED status (XlaRuntimeError carries it in
+    the message), generic out-of-memory texts, and the chaos layer's
+    real-shaped injected fault — all three must route identically
+    through the taxonomy."""
+    if exc is None:
+        return False
+    text = "%s: %s" % (type(exc).__name__, exc)
+    return ("RESOURCE_EXHAUSTED" in text
+            or "ResourceExhausted" in text
+            or "Out of memory" in text
+            or "out of memory" in text)
+
+
+def classify_oom(predicted=None):
+    """The post-GC retry probe: drop dead host references (freeing
+    their device buffers), re-read headroom, and judge — *transient*
+    fragmentation when the freed headroom would now cover the demand
+    (or, with no known demand, when any headroom above the reserve
+    reappeared), *structural* overcommit otherwise. Structural is the
+    verdict that justifies changing the program (accum re-lowering,
+    pool shrink, exit 47); transient justifies a plain retry."""
+    import gc
+    gc.collect()
+    hb = headroom_bytes()
+    if hb is None:
+        # no stats to probe with: assume the allocation is structural —
+        # the conservative verdict (a retry that would have succeeded
+        # costs one re-lower; a retry loop against a too-big program
+        # costs the job)
+        return "structural"
+    if predicted is not None:
+        fits = int(predicted) <= hb - reserve_bytes()
+    else:
+        fits = hb > reserve_bytes()
+    return "transient" if fits else "structural"
+
+
+def note_oom(origin, exc, predicted=None):
+    """Classify a RESOURCE_EXHAUSTED caught at boundary ``origin``.
+    No-op (None) when unarmed or for non-OOM errors — the except
+    handlers this sits in stay one guarded branch off-path. Returns
+    the taxonomy verdict string otherwise."""
+    if not armed() or not is_resource_exhausted(exc):
+        return None
+    stats["oom_caught"] += 1
+    verdict = classify_oom(predicted)
+    stats["oom_" + verdict] += 1
+    if core.enabled():
+        core.counter("mem.oom_caught").add(1)
+        core.counter("mem.oom_" + verdict).add(1)
+        core.record_instant(
+            "mem.oom", cat="mem",
+            args={"origin": origin, "taxonomy": verdict,
+                  "error": "%s: %s" % (type(exc).__name__, exc)})
+    return verdict
+
+
+def escalate_accum(accum, batch_rows, factor=2):
+    """The ``MXNET_MEM_OOM_ACTION=accum`` response: the next
+    accumulation factor (current × ``factor``) for re-lowering the
+    step through ``elastic.make_accum_train_step`` — the same
+    global-batch-preserving compensation PR 9 uses for shrinks. Refuses
+    loudly when the global batch cannot tile the new factor: silently
+    changing the effective batch is exactly the bug this knob
+    prevents."""
+    accum, batch_rows = int(accum), int(batch_rows)
+    new = accum * int(factor)
+    if batch_rows <= 0 or new <= 0:
+        raise ValueError("escalate_accum needs positive sizes "
+                         "(batch_rows=%d, accum=%d)" % (batch_rows,
+                                                        accum))
+    if batch_rows % new:
+        raise ValueError(
+            "MXNET_MEM_OOM_ACTION=accum: global batch of %d rows "
+            "cannot tile an accumulation factor of %d — the OOM is "
+            "structural at this batch geometry (reduce the batch or "
+            "model instead)" % (batch_rows, new))
+    stats["oom_accum"] += 1
+    if core.enabled():
+        core.counter("mem.oom_accum_relower").add(1)
+        core.gauge("mem.accum_factor").set(new)
+    return new
+
+
+def checkpoint_and_exit(reason="oom"):
+    """The ``MXNET_MEM_OOM_ACTION=checkpoint`` leg: commit through the
+    PR 6 emergency provider (best-effort — an armed provider writes an
+    exact-resume checkpoint, an unarmed one is skipped) and exit
+    :data:`OOM_EXIT_CODE` so ``elastic_launch`` counts the restart and
+    relaunches with the sticky accumulation factor doubled."""
+    stats["oom_checkpoint"] += 1
+    path = None
+    try:
+        from ..models import checkpoint as _ckpt
+        path = _ckpt.save_emergency_checkpoint("oom:%s" % reason)
+    except Exception:
+        pass
+    print("mxnet_tpu.membudget: %s — emergency checkpoint %s; "
+          "exiting %d for the supervisor"
+          % (reason, path or "not armed", OOM_EXIT_CODE),
+          file=sys.stderr, flush=True)
+    if core.enabled():
+        core.counter("mem.oom_exit").add(1)
+        core.record_instant("mem.oom_exit", cat="mem",
+                            args={"reason": str(reason),
+                                  "checkpoint": path})
+    raise SystemExit(OOM_EXIT_CODE)
+
+
+def handle_trainer_oom(exc):
+    """Trainer.step's except hook: classify a RESOURCE_EXHAUSTED and,
+    under ``MXNET_MEM_OOM_ACTION=checkpoint``, route through the
+    emergency provider + exit 47. The ``accum`` action cannot re-lower
+    a Gluon trainer's update in place — the caller re-raises and the
+    driving loop (or the supervisor restart with the sticky factor)
+    owns the re-lowering. No-op for non-OOM errors / unarmed runs."""
+    if not armed() or not is_resource_exhausted(exc):
+        return
+    verdict = note_oom("trainer.step", exc)
+    if oom_action() == "checkpoint" and verdict == "structural":
+        checkpoint_and_exit("trainer.step %s oom" % verdict)
+
+
+# ------------------------------------------------------------ healthz --
+
+def healthz_snapshot():
+    """The /healthz ``mem`` section: live headroom (ledger applied),
+    the reserve, in-flight snapshot bytes, and the cheap counters —
+    what the router's starvation gate and an operator's dashboard
+    read."""
+    try:
+        hb = headroom_bytes()
+    except Exception:
+        hb = None
+    return {"headroom_bytes": hb,
+            "reserve_bytes": reserve_bytes(),
+            "snapshot_inflight_bytes": _snapshot_inflight[0],
+            "oom_caught": stats["oom_caught"],
+            "budget_mode": budget_mode() or "off"}
+
+
+def reset():
+    """Forget preflight verdicts + counters (tests, fresh sessions)."""
+    with _lock:
+        _checked.clear()
+        _snapshot_inflight[0] = 0
+        for k in stats:
+            stats[k] = 0
+
+
+# os is used by nothing else but keeps parity with sibling modules'
+# exit paths should checkpoint_and_exit ever need _exit semantics
+_ = os

@@ -73,7 +73,10 @@ __all__ = ["SHRINK_EXIT_CODE", "BOUNDARY_EXIT_CODE", "enabled",
            "make_accum_train_step", "observe_recovery"]
 
 # supervisor-visible exit taxonomy (documented in docs/ROBUSTNESS.md;
-# 43 = watchdog abort lives in observability/watchdog.py)
+# 43 = watchdog abort lives in observability/watchdog.py, 46 =
+# quarantine in observability/integrity.py, 47 = structural OOM in
+# observability/membudget.py — the supervisor relaunches with a doubled
+# sticky accumulation factor)
 SHRINK_EXIT_CODE = 44        # coordinated shrink: relaunch at g+1, N-k
 BOUNDARY_EXIT_CODE = 45      # generation boundary, work remaining (regrow)
 

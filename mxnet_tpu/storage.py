@@ -17,10 +17,12 @@ keeps is the OBSERVABILITY the reference pool provided:
 import threading
 import weakref
 
+from . import _fastenv
 from .observability import core as _obs
 
 __all__ = ["device_memory_stats", "start_tracking", "stop_tracking",
-           "reset_stats", "summary", "publish_device_memory_gauges"]
+           "reset_stats", "summary", "publish_device_memory_gauges",
+           "maybe_publish_device_memory_gauges"]
 
 _TRACKING = False
 _LOCK = threading.Lock()
@@ -115,9 +117,12 @@ def device_memory_stats(device=None):
 
 def publish_device_memory_gauges():
     """Route the PJRT per-device byte counters into obs gauges
-    (``mem.device.<stat>.<device>``). One guarded branch with telemetry
-    off; refreshed by ``profiler.dump()`` and the cross-rank skew
-    exchange so long-run dashboards see live/peak HBM per device.
+    (``mem.device.<stat>.<device>``, plus the derived
+    ``mem.device.bytes_available.<device>`` = limit − in_use the
+    brownout/headroom consumers read). One guarded branch with
+    telemetry off; refreshed by ``profiler.dump()``, the cross-rank
+    skew exchange, and — when ``MXNET_MEM_GAUGE_EVERY`` is set — every
+    N trainer steps (:func:`maybe_publish_device_memory_gauges`).
     Returns the stats it published (empty when disabled)."""
     if not _obs.enabled():
         return {}
@@ -127,4 +132,35 @@ def publish_device_memory_gauges():
             if key in st:
                 _obs.gauge("mem.device.%s.%s" % (key, dev),
                            "bytes").set(st[key])
+        if "bytes_limit" in st and "bytes_in_use" in st:
+            _obs.gauge("mem.device.bytes_available.%s" % dev,
+                       "bytes").set(int(st["bytes_limit"])
+                                    - int(st["bytes_in_use"]))
     return stats
+
+
+_GAUGE_STEP = [0]
+
+
+def maybe_publish_device_memory_gauges(step=None):
+    """Step-cadence refresh of the ``mem.device.*`` gauges:
+    ``MXNET_MEM_GAUGE_EVERY=N`` publishes every N steps (unset/0 keeps
+    the old dump/skew-exchange-only cadence). Headroom-driven brownout
+    and router decisions act on data at most N steps stale instead of
+    one profiler-dump stale. One `_fastenv` read + one counter bump on
+    the off path."""
+    every = _fastenv.get("MXNET_MEM_GAUGE_EVERY")
+    if not every:
+        return {}
+    try:
+        every = int(every)
+    except (TypeError, ValueError):
+        return {}
+    if every <= 0:
+        return {}
+    if step is None:
+        _GAUGE_STEP[0] += 1
+        step = _GAUGE_STEP[0]
+    if step % every:
+        return {}
+    return publish_device_memory_gauges()

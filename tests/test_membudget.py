@@ -1,0 +1,640 @@
+"""HBM-pressure resilience (observability/membudget.py + its wiring):
+preflight memory budgeting, the OOM taxonomy with adaptive recovery,
+elastic KV-pool sizing, and the deterministic oom chaos fault.
+
+The oracles: a predicted breach surfaces BEFORE dispatch (warn or
+MemoryBudgetExceeded, naming the executable and the top scopes); a
+caught RESOURCE_EXHAUSTED classifies transient vs structural and the
+configured action preserves the global batch (accum re-lower) or the
+training state (checkpoint + exit 47, supervisor sticky accum); the
+serving pool shrinks and retries with every completed stream still
+bit-exact vs solo generate(); and with every MXNET_MEM_* knob unset
+each hook is one guarded branch — dispatch counts and numerics stay
+bit-identical.
+"""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import storage
+from mxnet_tpu.models import transformer as tf
+from mxnet_tpu.models.serving import BlockAllocator, ContinuousBatcher
+from mxnet_tpu.observability import chaos, membudget
+from mxnet_tpu.observability import core as obs
+from mxnet_tpu.parallel import elastic
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    membudget.reset()
+    chaos.reset()
+    yield
+    membudget.reset()
+    chaos.reset()
+
+
+def _fake_stats(monkeypatch, limit, in_use=0):
+    monkeypatch.setattr(
+        storage, "device_memory_stats",
+        lambda device=None: {"dev0": {"bytes_limit": int(limit),
+                                      "bytes_in_use": int(in_use)}})
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=97, d_model=16, n_heads=2, n_layers=1,
+                d_ff=32, max_len=48, dtype=jnp.float32)
+    base.update(kw)
+    return tf.TransformerConfig(**base)
+
+
+def _solo(params, prompt, n, cfg, **kw):
+    return np.asarray(tf.generate(params, jnp.asarray([prompt],
+                                                      jnp.int32),
+                                  n, cfg, **kw)[0])
+
+
+# --------------------------------------------------- knobs + off path --
+
+
+def test_off_by_default(monkeypatch):
+    for k in ("MXNET_MEM_BUDGET", "MXNET_MEM_OOM_ACTION",
+              "MXNET_MEM_ACCUM_FACTOR"):
+        monkeypatch.delenv(k, raising=False)
+    assert membudget.budget_mode() is None
+    assert not membudget.enabled()
+    assert membudget.oom_action() is None
+    assert not membudget.armed()
+    assert membudget.sticky_accum_factor() == 1
+    # every hook is a no-op: no counters move, nothing raises
+    assert membudget.preflight("nowhere") is None
+    assert membudget.note_oom("nowhere", RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory")) is None
+    membudget.handle_trainer_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory"))
+    membudget.note_snapshot_start(1 << 20)
+    assert membudget.snapshot_bytes_in_flight() == 0
+    assert all(v == 0 for v in membudget.stats.values())
+
+
+def test_knob_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "1")
+    assert membudget.budget_mode() == "warn"
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "warn")
+    assert membudget.budget_mode() == "warn"
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "enforce")
+    assert membudget.budget_mode() == "enforce"
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "0")
+    assert membudget.budget_mode() is None
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    assert membudget.oom_action() == "accum" and membudget.armed()
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "nonsense")
+    assert membudget.oom_action() is None
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "2.5")
+    assert membudget.reserve_bytes() == int(2.5e6)
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "junk")
+    assert membudget.reserve_bytes() == int(
+        membudget.DEFAULT_RESERVE_MB * 1e6)
+    monkeypatch.setenv("MXNET_MEM_ACCUM_FACTOR", "4")
+    assert membudget.sticky_accum_factor() == 4
+    monkeypatch.setenv("MXNET_MEM_ACCUM_FACTOR", "0")
+    assert membudget.sticky_accum_factor() == 1
+
+
+def test_predicted_peak_bytes():
+    mem = {"argument_size_in_bytes": 100, "output_size_in_bytes": 40,
+           "alias_size_in_bytes": 30, "temp_size_in_bytes": 25}
+    assert membudget.predicted_peak_bytes(mem) == 135
+    # the HLO watermark wins when it sees a higher intra-program peak
+    assert membudget.predicted_peak_bytes(mem, watermark=500) == 500
+    assert membudget.predicted_peak_bytes(None, watermark=7) == 7
+
+
+def test_headroom_tracks_tightest_device_and_ledger(monkeypatch):
+    monkeypatch.setattr(
+        storage, "device_memory_stats",
+        lambda device=None: {
+            "d0": {"bytes_limit": 1000, "bytes_in_use": 100},
+            "d1": {"bytes_limit": 1000, "bytes_in_use": 400},
+            "d2": {}})                     # no limits: not a vote
+    assert membudget.device_headroom() == {"d0": 900, "d1": 600}
+    assert membudget.headroom_bytes() == 600
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")   # arm ledger
+    membudget.note_snapshot_start(250)
+    assert membudget.headroom_bytes() == 350
+    membudget.note_snapshot_end(250)
+    assert membudget.headroom_bytes() == 600
+
+
+def test_headroom_unknown_on_cpu():
+    # the CPU backend reports no limits: every consumer stands down
+    assert membudget.headroom_bytes() is None
+    assert membudget.preflight("site", signature="s") is None
+    assert membudget.preflight_bytes("site2", 1 << 40) is True
+
+
+# ----------------------------------------------------------- preflight --
+
+
+def test_preflight_bytes_warn_enforce_and_cache(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "warn")
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "0.001")
+    _fake_stats(monkeypatch, limit=10000, in_use=0)
+    assert membudget.preflight_bytes("pool", 5000) is True
+    with pytest.warns(RuntimeWarning, match="memory budget"):
+        assert membudget.preflight_bytes("pool2", 20000) is False
+    assert membudget.stats["preflight_breaches"] == 1
+    # warm path: the verdict for (origin, signature) is issued once
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert membudget.preflight_bytes("pool2", 20000) is True
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "enforce")
+    with pytest.raises(membudget.MemoryBudgetExceeded) as ei:
+        membudget.preflight_bytes("pool3", 20000)
+    assert ei.value.origin == "pool3"
+    assert ei.value.predicted_bytes == 20000
+    assert ei.value.headroom_bytes == 10000
+
+
+def test_breach_message_names_top3_scopes():
+    err = membudget.MemoryBudgetExceeded(
+        "Executor[x].fwd", 8e6, 1e6, 5e5,
+        {"dense0": 4e6, "conv1": 3e6, "embed": 2e6, "tail": 1.0})
+    msg = str(err)
+    assert "Executor[x].fwd" in msg
+    assert "8.0 MB peak" in msg and "1.0 MB live headroom" in msg
+    assert "dense0" in msg and "conv1" in msg and "embed" in msg
+    assert "tail" not in msg          # top-3 by watermark only
+
+
+def test_preflight_lowers_fn_and_warns_on_breach(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "warn")
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "0")
+    _fake_stats(monkeypatch, limit=1 << 30)
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    args = (np.zeros((16, 16), np.float32),)
+    predicted = membudget.preflight("jit.double", fn, args)
+    assert predicted is not None and predicted >= 16 * 16 * 4
+    assert membudget.stats["preflight_checks"] == 1
+    assert membudget.stats["preflight_breaches"] == 0
+    # same signature: cached, no second check
+    membudget.preflight("jit.double", fn, args)
+    assert membudget.stats["preflight_checks"] == 1
+    # shrink the device under the program: the breach names the origin
+    _fake_stats(monkeypatch, limit=max(predicted - 1, 1))
+    with pytest.warns(RuntimeWarning, match="jit.double2"):
+        membudget.preflight("jit.double2", fn, args)
+    assert membudget.stats["preflight_breaches"] == 1
+
+
+def test_preflight_uses_attribution_registry(monkeypatch):
+    from mxnet_tpu.observability import attribution
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "enforce")
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "0")
+    _fake_stats(monkeypatch, limit=500)
+    monkeypatch.setattr(
+        attribution, "program_analysis",
+        lambda origin, signature=None: {
+            "memory": {"argument_size_in_bytes": 600},
+            "peak_bytes": 900,
+            "peak_scopes": {"blockA": 700, "blockB": 200}})
+    with pytest.raises(membudget.MemoryBudgetExceeded) as ei:
+        membudget.preflight("Registered.step", signature="sig0")
+    assert ei.value.predicted_bytes == 900      # watermark wins
+    assert "blockA" in str(ei.value)
+
+
+# -------------------------------------------------------- OOM taxonomy --
+
+
+def test_is_resource_exhausted():
+    assert membudget.is_resource_exhausted(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"))
+    assert membudget.is_resource_exhausted(RuntimeError(
+        "Allocator ran out of memory trying"))
+    assert membudget.is_resource_exhausted(
+        chaos.ChaosResourceExhausted("RESOURCE_EXHAUSTED: x"))
+    assert not membudget.is_resource_exhausted(ValueError("nope"))
+    assert not membudget.is_resource_exhausted(None)
+
+
+def test_classify_oom(monkeypatch):
+    # headroom reappears above the reserve after GC -> transient
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "0.001")
+    _fake_stats(monkeypatch, limit=10000, in_use=0)
+    assert membudget.classify_oom() == "transient"
+    assert membudget.classify_oom(predicted=5000) == "transient"
+    assert membudget.classify_oom(predicted=50000) == "structural"
+    _fake_stats(monkeypatch, limit=10000, in_use=9990)
+    assert membudget.classify_oom() == "structural"
+
+
+def test_classify_oom_unknown_headroom_is_structural():
+    # no stats to probe with: the conservative verdict
+    assert membudget.classify_oom() == "structural"
+
+
+def test_note_oom_counts_taxonomy(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    _fake_stats(monkeypatch, limit=1 << 30, in_use=0)
+    exc = chaos.ChaosResourceExhausted("RESOURCE_EXHAUSTED: Out of "
+                                       "memory")
+    assert membudget.note_oom("trainer.step", exc) == "transient"
+    assert membudget.note_oom("trainer.step", ValueError("x")) is None
+    _fake_stats(monkeypatch, limit=100, in_use=100)
+    assert membudget.note_oom("trainer.step", exc) == "structural"
+    assert membudget.stats["oom_caught"] == 2
+    assert membudget.stats["oom_transient"] == 1
+    assert membudget.stats["oom_structural"] == 1
+
+
+def test_escalate_accum():
+    assert membudget.escalate_accum(1, 8) == 2
+    assert membudget.escalate_accum(2, 8) == 4
+    with pytest.raises(ValueError, match="cannot tile"):
+        membudget.escalate_accum(2, 6)       # 6 % 4 != 0
+    with pytest.raises(ValueError):
+        membudget.escalate_accum(1, 0)
+
+
+def test_checkpoint_and_exit_uses_exit_47():
+    with pytest.raises(SystemExit) as ei:
+        membudget.checkpoint_and_exit("test oom")
+    assert ei.value.code == membudget.OOM_EXIT_CODE == 47
+    assert membudget.stats["oom_checkpoint"] == 1
+
+
+def test_handle_trainer_oom_actions(monkeypatch):
+    exc = chaos.ChaosResourceExhausted("RESOURCE_EXHAUSTED: Out of "
+                                       "memory")
+    # unarmed / non-OOM: silent pass-through
+    membudget.handle_trainer_oom(exc)
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    membudget.handle_trainer_oom(exc)        # accum: caller re-lowers
+    assert membudget.stats["oom_caught"] == 1
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "checkpoint")
+    _fake_stats(monkeypatch, limit=1 << 30, in_use=0)
+    membudget.handle_trainer_oom(exc)        # transient: no exit
+    with monkeypatch.context() as m:
+        # structural (headroom gone): checkpoint + exit 47
+        _fake_stats(m, limit=100, in_use=100)
+        with pytest.raises(SystemExit) as ei:
+            membudget.handle_trainer_oom(exc)
+        assert ei.value.code == 47
+
+
+# ------------------------------------------------------ chaos oom fault --
+
+
+def test_chaos_oom_fault_deterministic_and_real_shaped():
+    rules = chaos.parse_spec("trainer.step:oom:bytes=12345:at=1")
+    assert rules[0].fault == "oom" and rules[0].bytes == 12345
+    chaos.inject("trainer.step", "oom", bytes=12345, at=1)
+    assert chaos.fire("trainer.step") == ()          # occurrence 0
+    with pytest.raises(chaos.ChaosResourceExhausted) as ei:
+        chaos.fire("trainer.step")                   # occurrence 1
+    msg = str(ei.value)
+    assert msg.startswith("RESOURCE_EXHAUSTED: Out of memory")
+    assert "12345 bytes" in msg and "trainer.step" in msg
+    assert membudget.is_resource_exhausted(ei.value)
+    assert chaos.fire("trainer.step") == ()          # rule exhausted
+    assert chaos.stats["oom"] == 1
+
+
+# ------------------------------------------- accum re-lower (recovery) --
+
+
+def test_accum_relower_preserves_global_batch_trajectory():
+    """The MXNET_MEM_OOM_ACTION=accum recovery bar: after an OOM at
+    step 2, the step re-lowers at 2x accumulation over the SAME global
+    batch; the recovered trajectory is deterministic (bit-exact on
+    re-run) and matches the uninterrupted accum=1 trajectory to
+    microbatch-mean tolerance — the PR 9 elastic-accum contract."""
+    cfg = _cfg(max_len=12)
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, cfg.vocab_size, (4, cfg.max_len))
+               for _ in range(4)]
+
+    def run(switch_at=None):
+        params = tf.init_params(cfg, seed=1)
+        mom = tf.init_momentum(params)
+        accum, losses = 1, []
+        step = elastic.make_accum_train_step(cfg, lr=0.1, accum=1)
+        for i, b in enumerate(batches):
+            if switch_at is not None and i == switch_at:
+                accum = membudget.escalate_accum(accum, b.shape[0])
+                step = elastic.make_accum_train_step(cfg, lr=0.1,
+                                                     accum=accum)
+            toks = jnp.asarray(
+                b.reshape(accum, b.shape[0] // accum, cfg.max_len),
+                jnp.int32)
+            params, mom, loss = step(params, mom, toks)
+            losses.append(float(loss))
+        return losses
+
+    plain = run()
+    recovered = run(switch_at=2)
+    assert recovered == run(switch_at=2)     # deterministic, bit-exact
+    np.testing.assert_allclose(recovered, plain, rtol=1e-5)
+
+
+# --------------------------------------------------- allocator elastic --
+
+
+def test_allocator_shrink_grow_conservation():
+    a = BlockAllocator(10)
+    ids = a.alloc(3)
+    a.reserve(2)
+    # 6 free, 2 reserved: at most 4 may park whatever is asked
+    assert a.shrink(100) == 4
+    assert a.parked_blocks == 4 and a.available == 0
+    assert a.check_invariants(mappings=[ids])
+    assert a.shrink(1) == 0                  # nothing left beyond the promise
+    assert a.grow(2) == 2
+    assert a.parked_blocks == 2 and a.available == 2
+    assert a.check_invariants(mappings=[ids])
+    a.unreserve(2)
+    a.release(ids)
+    assert a.grow(100) == 2                  # everything returns
+    assert a.check_invariants(quiesce=True)
+
+
+def test_allocator_extend_adds_fresh_ids():
+    a = BlockAllocator(4)
+    first = a.alloc(3)                       # exhaust the pool
+    assert a.free_blocks == 0
+    new = a.extend(2)
+    assert new == [4, 5] and a.num_blocks == 6
+    assert a.check_invariants(mappings=[first])
+    got = a.alloc(2)
+    assert set(got) == {4, 5}
+    a.release(first)
+    a.release(got)
+    assert a.check_invariants(quiesce=True)
+
+
+def test_allocator_parked_corruption_raises():
+    a = BlockAllocator(6)
+    a.shrink(2)
+    b = a._parked[0]
+    a.ref[b] = 1
+    with pytest.raises(RuntimeError, match="parked but refcount"):
+        a.check_invariants()
+    a.ref[b] = 0
+    a._free.append(b)                        # parked AND free
+    with pytest.raises(RuntimeError, match="both parked and free"):
+        a.check_invariants()
+
+
+# --------------------------------------------- serving pool elasticity --
+
+
+def test_serving_shrink_and_grow_pool():
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                            block_size=8, num_blocks=10)
+    parked = srv.shrink_pool(3)
+    assert parked == 3 and srv._alloc.parked_blocks == 3
+    srv.check_invariants()
+    assert srv.grow_pool(3) == 3             # unparks, no physical growth
+    assert srv._alloc.parked_blocks == 0
+    # growing past the ledger physically extends the device pool
+    before = srv.num_blocks
+    assert srv.grow_pool(4) == 4
+    assert srv.num_blocks == before + 4
+    assert srv._pool[0]["k"].shape[0] == before + 4
+    srv.check_invariants(quiesce=True)
+    # the widened pool still serves bit-exact streams
+    r = srv.admit([3, 5, 7], 6)
+    done = {}
+    while r not in done:
+        done.update(srv.step())
+    np.testing.assert_array_equal(np.asarray(done[r]),
+                                  _solo(params, [3, 5, 7], 6, cfg))
+
+
+def test_serving_oom_dispatch_shrinks_and_retries_bit_exact():
+    """An injected RESOURCE_EXHAUSTED on a decode dispatch triggers
+    shrink-and-retry instead of the lane rebuild: the pool parks
+    blocks, no process dies, and every completed stream is still
+    bit-exact vs solo generate()."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    jobs = [([3, 5, 7, 5], 6), ([11, 2, 9, 4], 6)]
+    solo = [_solo(params, p, n, cfg) for p, n in jobs]
+    chaos.inject("serving.dispatch", "oom", at=1)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                            block_size=8, num_blocks=12)
+    results, order = srv.run(jobs)
+    assert chaos.stats["oom"] == 1
+    assert srv._alloc.parked_blocks > 0      # the shrink happened
+    for j, rid in enumerate(order):
+        np.testing.assert_array_equal(np.asarray(results[rid]),
+                                      solo[j])
+    srv.check_invariants(quiesce=True)
+
+
+def test_kv_shrink_rung_parks_and_grows_back(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_KV_SHRINK_BLOCKS", "2")
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                            block_size=8, num_blocks=10,
+                            brownout=True)
+    srv._set_rung(4)
+    assert srv._bo_parked == 2
+    assert srv._alloc.parked_blocks == 2
+    srv._set_rung(3)                         # walk-down grows back
+    assert srv._bo_parked == 0
+    assert srv._alloc.parked_blocks == 0
+    # a grow that OOMs leaves the pool shrunk instead of raising
+    srv._set_rung(4)
+    chaos.inject("kv.pool.grow", "oom", at=0)
+    srv._set_rung(0)
+    assert srv._bo_parked == 2               # still parked, no crash
+    assert srv._alloc.parked_blocks == 2
+    srv.check_invariants()
+
+
+def test_health_snapshot_exports_headroom(monkeypatch):
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                            block_size=8, num_blocks=6)
+    snap = srv.health_snapshot()
+    assert "mem.headroom_bytes" not in snap  # unarmed / unknown
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    _fake_stats(monkeypatch, limit=1000, in_use=250)
+    snap = srv.health_snapshot()
+    assert snap["mem.headroom_bytes"] == 750
+
+
+def test_router_skips_memory_starved_replica(monkeypatch):
+    from mxnet_tpu.models.router import ReplicaRouter
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    mk = lambda: ContinuousBatcher(params, cfg, max_batch=2,
+                                   paged=True, block_size=8,
+                                   num_blocks=8)
+    r = ReplicaRouter([mk(), mk()])
+    assert len(r._eligible()) == 2
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "1")
+    snap0 = r.replicas[0].health_snapshot()
+    starved = dict(snap0, **{"mem.headroom_bytes": 10})
+    monkeypatch.setattr(r.replicas[0], "health_snapshot",
+                        lambda: dict(starved))
+    eligible = r._eligible()
+    assert eligible == [1]                   # replica 0 gated out
+    healthy = dict(snap0, **{"mem.headroom_bytes": 10 << 20})
+    monkeypatch.setattr(r.replicas[0], "health_snapshot",
+                        lambda: dict(healthy))
+    assert len(r._eligible()) == 2
+
+
+# ------------------------------------------------- checkpoint snapshot --
+
+
+def test_snapshot_ledger_and_deferred_admission(monkeypatch):
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    _fake_stats(monkeypatch, limit=10000, in_use=0)
+    monkeypatch.setenv("MXNET_MEM_BUDGET_RESERVE_MB", "0.001")
+    assert membudget.admit_snapshot(5000) is True
+    assert membudget.admit_snapshot(9500) is False   # breaches reserve
+    assert membudget.stats["snapshot_deferred"] == 1
+    membudget.note_snapshot_start(4000)
+    assert membudget.headroom_bytes() == 6000
+    assert membudget.admit_snapshot(5500) is False   # ledger counted
+    membudget.note_snapshot_end(4000)
+    assert membudget.admit_snapshot(5500) is True
+
+
+def test_checkpoint_snapshot_oom_retries_serial_and_commits(tmp_path,
+                                                            monkeypatch):
+    from mxnet_tpu.models import checkpoint as ck
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    cfg = _cfg(max_len=12)
+    params = tf.init_params(cfg, seed=5)
+    chaos.inject("checkpoint.snapshot", "oom", at=0)
+    path = str(tmp_path / "oomck")
+    ck.save_checkpoint(path, cfg, params)    # survives the injected OOM
+    assert chaos.stats["oom"] == 1
+    assert membudget.stats["oom_caught"] == 1
+    assert membudget.snapshot_bytes_in_flight() == 0  # ledger closed
+    cfg2, p2 = ck.load_checkpoint(path)[:2]
+    assert cfg2 == cfg
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------- gauges --
+
+
+def test_gauge_cadence(monkeypatch):
+    calls = []
+    monkeypatch.setattr(storage, "publish_device_memory_gauges",
+                        lambda: calls.append(1) or {})
+    storage._GAUGE_STEP[0] = 0
+    monkeypatch.delenv("MXNET_MEM_GAUGE_EVERY", raising=False)
+    for _ in range(4):
+        storage.maybe_publish_device_memory_gauges()
+    assert calls == []                       # off: one guarded branch
+    monkeypatch.setenv("MXNET_MEM_GAUGE_EVERY", "2")
+    storage._GAUGE_STEP[0] = 0
+    for _ in range(5):
+        storage.maybe_publish_device_memory_gauges()
+    assert len(calls) == 2                   # steps 2 and 4
+    assert storage.maybe_publish_device_memory_gauges(step=6) == {}
+    assert len(calls) == 3
+    monkeypatch.setenv("MXNET_MEM_GAUGE_EVERY", "junk")
+    assert storage.maybe_publish_device_memory_gauges() == {}
+    assert len(calls) == 3
+
+
+def test_bytes_available_gauge(monkeypatch):
+    monkeypatch.setenv("MXNET_OBS", "1")
+    _fake_stats(monkeypatch, limit=1000, in_use=300)
+    storage.publish_device_memory_gauges()
+    assert obs.gauge("mem.device.bytes_available.dev0").value == 700
+
+
+def test_healthz_carries_mem_section():
+    from mxnet_tpu.observability import http
+    snap = http._healthz()
+    assert snap["mem"]["budget_mode"] == "off"
+    assert "headroom_bytes" in snap["mem"]
+    assert "reserve_bytes" in snap["mem"]
+
+
+# ----------------------------------------------- supervisor (exit 47) --
+
+
+def test_classify_oom_exit_precedence():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import elastic_launch as el
+    assert el.classify([0, 47]) == "oom"
+    assert el.classify([47, 45]) == "oom"    # oom beats boundary
+    assert el.classify([44, 47]) == "shrink"  # shrink beats oom
+    assert el.classify([46, 47]) == "quarantine"
+    assert el.classify([43, 0]) == "watchdog"
+
+
+def test_supervisor_sticky_accum_doubles_on_47(tmp_path):
+    """A worker that exits 47 until the supervisor hands it a doubled
+    MXNET_MEM_ACCUM_FACTOR: the restart is counted, the factor is
+    sticky across the relaunch, and the job completes."""
+    worker = tmp_path / "oom_worker.py"
+    worker.write_text(
+        "import os, sys\n"
+        "f = int(os.environ.get('MXNET_MEM_ACCUM_FACTOR', '1'))\n"
+        "sys.exit(0 if f >= 2 else 47)\n")
+    env = dict(os.environ, MXNET_ELASTIC_DIR=str(tmp_path / "sb"),
+               PYTHONPATH=ROOT)
+    env.pop("MXNET_MEM_ACCUM_FACTOR", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "elastic_launch.py"),
+         "-n", "1", "--max-restarts", "3", "--backoff-ms", "10",
+         "--", sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sticky accumulation factor 2" in r.stdout
+    assert "job complete" in r.stdout
+
+
+# ------------------------------------------------------ off-path bars --
+
+
+def test_off_path_dispatch_count_and_numerics_identical(monkeypatch):
+    """The acceptance bar: with every MXNET_MEM_* knob unset the
+    serving loop's dispatch count and tokens are bit-identical to a
+    budget-armed run on a platform without memory stats (the hooks
+    stand down) — the wiring never perturbs scheduling or numerics."""
+    cfg = _cfg()
+    params = tf.init_params(cfg, seed=3)
+    jobs = [([3, 5, 7, 5], 6), ([11, 2, 9, 4], 6)]
+
+    def run():
+        srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                block_size=8, num_blocks=12)
+        results, order = srv.run(jobs)
+        return srv.dispatch_count, [results[r] for r in order]
+
+    for k in ("MXNET_MEM_BUDGET", "MXNET_MEM_OOM_ACTION",
+              "MXNET_MEM_GAUGE_EVERY"):
+        monkeypatch.delenv(k, raising=False)
+    base_count, base_tokens = run()
+    monkeypatch.setenv("MXNET_MEM_BUDGET", "warn")
+    monkeypatch.setenv("MXNET_MEM_OOM_ACTION", "accum")
+    armed_count, armed_tokens = run()
+    assert armed_count == base_count
+    assert armed_tokens == base_tokens

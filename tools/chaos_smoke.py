@@ -26,15 +26,19 @@ intact, stream bit-exact, checkpoint loadable, resume bit-exact):
            relaunch via resume_from_latest: the concatenated loss
            trajectory is bit-exact (float hex) vs an uninterrupted run
 
-Three scenarios run as their own tier-1 lane invocations:
+Four scenarios run as their own tier-1 lane invocations:
 ``--elastic`` (the 2-process shrink/regrow chain), ``--overload``
 (the ISSUE 12 serving overload storm: mixed-priority burst at ~4x
 block capacity, one replica chaos-killed mid-storm, recovery through
-the circuit breaker's HALF_OPEN canary), and ``--integrity`` (the
+the circuit breaker's HALF_OPEN canary), ``--integrity`` (the
 silent-corruption defense: one injected flip per corruption class —
 gradient bucket, replicated weight on one rank, checkpoint byte,
 recordio record — each detected with named evidence AND recovered
-from a verified state).
+from a verified state), and ``--oom`` (the ISSUE 14 memory-pressure
+closure: one injected RESOURCE_EXHAUSTED per recovery path —
+trainer accum re-lower with the global-batch trajectory preserved,
+serving pool shrink-and-retry with bit-exact streams, pool-grow
+degradation, checkpoint snapshot serial retry — no process death).
 """
 
 import argparse
@@ -918,6 +922,182 @@ def integrity_scenario():
     return 0
 
 
+def mem_pressure():
+    """The ISSUE 14 memory-pressure closure: one deterministic
+    injected RESOURCE_EXHAUSTED per recovery path — every site listed
+    in docs/ROBUSTNESS.md "Memory pressure" must recover WITHOUT
+    process death, on the CPU mesh, replayably:
+
+      trainer.step         accum re-lower at 2x: the recovered loss
+                           trajectory is deterministic (bit-identical
+                           across reruns) and matches the
+                           uninterrupted global-batch run
+      serving.dispatch     pool shrink-and-retry: blocks park, lanes
+                           survive, every stream bit-exact vs solo
+      kv.pool.grow         a grow that OOMs leaves the pool shrunk
+                           (capacity loss, never a crash); the next
+                           clean grow restores it
+      checkpoint.snapshot  the D2H gather retries serially and the
+                           committed checkpoint loads bit-exact
+    """
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models import checkpoint as ck
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import chaos, membudget
+    from mxnet_tpu.parallel import elastic as el
+
+    chaos.reset()
+    membudget.reset()
+    os.environ["MXNET_MEM_OOM_ACTION"] = "accum"
+    cfg = _tiny_cfg()
+    try:
+        # ---- trainer.step: OOM -> accum re-lower, trajectory kept --
+        rng = np.random.RandomState(0)
+        batches = [rng.randint(0, 41, (4, cfg.max_len))
+                   for _ in range(4)]
+
+        def train(inject):
+            chaos.reset()
+            if inject:
+                chaos.inject("trainer.step", "oom", at=2)
+            params = T.init_params(cfg, seed=1)
+            mom = T.init_momentum(params)
+            accum = membudget.sticky_accum_factor()
+            step = el.make_accum_train_step(cfg, lr=0.1, accum=accum)
+            losses = []
+            for b in batches:
+                while True:
+                    try:
+                        if chaos.enabled():
+                            chaos.fire("trainer.step")
+                        toks = jnp.asarray(
+                            b.reshape(accum, b.shape[0] // accum,
+                                      cfg.max_len), jnp.int32)
+                        params, mom, loss = step(params, mom, toks)
+                        break
+                    except Exception as exc:
+                        if not membudget.is_resource_exhausted(exc):
+                            raise
+                        membudget.note_oom("trainer.step", exc)
+                        accum = membudget.escalate_accum(
+                            accum, b.shape[0])
+                        step = el.make_accum_train_step(cfg, lr=0.1,
+                                                        accum=accum)
+                losses.append(float(loss))
+            fired = chaos.stats["oom"]
+            chaos.reset()
+            return losses, accum, fired
+
+        plain, accum0, _ = train(inject=False)
+        rec1, accum1, fired1 = train(inject=True)
+        rec2, accum2, _ = train(inject=True)
+        if accum0 != 1 or accum1 != 2 or fired1 != 1:
+            print("[chaos_smoke] FAIL(oom/trainer): accum %d -> %d, "
+                  "%d faults fired" % (accum0, accum1, fired1))
+            return 1
+        if [x.hex() for x in rec1] != [x.hex() for x in rec2]:
+            print("[chaos_smoke] FAIL(oom/trainer): recovered "
+                  "trajectory is not deterministic")
+            return 1
+        if not np.allclose(rec1, plain, rtol=1e-5):
+            print("[chaos_smoke] FAIL(oom/trainer): recovered "
+                  "trajectory diverged from the global batch: %s vs %s"
+                  % (rec1, plain))
+            return 1
+
+        # ---- serving.dispatch: OOM -> shrink-and-retry ----
+        params = T.init_params(cfg, seed=0)
+        jobs = [([3, 5, 7, 5], 6), ([11, 2, 9, 4], 6)]
+        solo = [np.asarray(T.generate(
+            params, jnp.asarray([p], jnp.int32), n, cfg,
+            greedy=True))[0].tolist() for p, n in jobs]
+        chaos.inject("serving.dispatch", "oom", at=1)
+        srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                block_size=8, num_blocks=12)
+        results, order = srv.run(jobs)
+        if chaos.stats["oom"] != 1 or srv._alloc.parked_blocks < 1:
+            print("[chaos_smoke] FAIL(oom/serving): fired=%d parked=%d"
+                  % (chaos.stats["oom"], srv._alloc.parked_blocks))
+            return 1
+        for j, rid in enumerate(order):
+            if results[rid] != solo[j]:
+                print("[chaos_smoke] FAIL(oom/serving): stream %d "
+                      "diverged after shrink-and-retry" % j)
+                return 1
+        srv.check_invariants(quiesce=True)
+        chaos.reset()
+
+        # ---- kv.pool.grow: OOM stays shrunk, clean grow restores ----
+        srv2 = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                 block_size=8, num_blocks=10,
+                                 brownout=True)
+        srv2._set_rung(4)                  # kv_shrink rung parks
+        parked = srv2._bo_parked
+        chaos.inject("kv.pool.grow", "oom", at=0)
+        srv2._set_rung(0)                  # grow-back OOMs: stay shrunk
+        if parked < 1 or srv2._bo_parked != parked \
+                or srv2._alloc.parked_blocks != parked:
+            print("[chaos_smoke] FAIL(oom/grow): parked=%d bo=%d "
+                  "ledger=%d" % (parked, srv2._bo_parked,
+                                 srv2._alloc.parked_blocks))
+            return 1
+        chaos.reset()
+        if srv2.grow_pool(parked) != parked \
+                or srv2._alloc.parked_blocks != 0:
+            print("[chaos_smoke] FAIL(oom/grow): clean grow did not "
+                  "restore the pool")
+            return 1
+        r = srv2.admit([3, 5, 7], 6)       # shrunk-then-grown pool serves
+        done = {}
+        while r not in done:
+            done.update(srv2.step())
+        want = np.asarray(T.generate(
+            params, jnp.asarray([[3, 5, 7]], jnp.int32), 6, cfg,
+            greedy=True))[0].tolist()
+        if done[r] != want:
+            print("[chaos_smoke] FAIL(oom/grow): post-grow stream "
+                  "diverged")
+            return 1
+        srv2.check_invariants(quiesce=True)
+
+        # ---- checkpoint.snapshot: OOM retries serial + commits ----
+        chaos.inject("checkpoint.snapshot", "oom", at=0)
+        params2 = T.init_params(cfg, seed=5)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "oomck")
+            ck.save_checkpoint(path, cfg, params2)
+            if chaos.stats["oom"] != 1:
+                print("[chaos_smoke] FAIL(oom/ckpt): fault never fired")
+                return 1
+            if membudget.snapshot_bytes_in_flight() != 0:
+                print("[chaos_smoke] FAIL(oom/ckpt): snapshot ledger "
+                      "left open")
+                return 1
+            cfg2, p2 = ck.load_checkpoint(path)[:2]
+            for a, b in zip(jax.tree.leaves(params2),
+                            jax.tree.leaves(p2)):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    print("[chaos_smoke] FAIL(oom/ckpt): reloaded "
+                          "params diverged")
+                    return 1
+        chaos.reset()
+    finally:
+        os.environ.pop("MXNET_MEM_OOM_ACTION", None)
+        membudget.reset()
+        chaos.reset()
+    print("[chaos_smoke] oom OK: trainer re-lowered at accum=2 with a "
+          "deterministic global-batch trajectory, serving shrank and "
+          "retried bit-exact, a failed pool grow degraded to reduced "
+          "capacity, and the checkpoint snapshot retried serially and "
+          "reloaded bit-exact — no process died")
+    return 0
+
+
 SCENARIOS = [("nan", nan_guard), ("ioerror", ioerror),
              ("serving", serving), ("hang", hang),
              ("sigterm", sigterm), ("crash", crash)]
@@ -939,6 +1119,12 @@ def main():
                    help="run the silent-corruption defense e2e (one "
                         "injected flip per corruption class; its own "
                         "tier-1 lane invocation)")
+    p.add_argument("--oom", action="store_true",
+                   help="run the memory-pressure e2e (one injected "
+                        "RESOURCE_EXHAUSTED per recovery path: trainer "
+                        "accum re-lower, serving shrink-and-retry, "
+                        "pool-grow degradation, checkpoint snapshot "
+                        "retry; its own tier-1 lane invocation)")
     args = p.parse_args()
     worker = os.environ.get("CHAOS_SMOKE_WORKER")
     if worker == "hang":
@@ -952,6 +1138,11 @@ def main():
     if args.integrity:
         if integrity_scenario():
             print("[chaos_smoke] integrity scenario FAILED")
+            return 1
+        return 0
+    if args.oom:
+        if mem_pressure():
+            print("[chaos_smoke] oom scenario FAILED")
             return 1
         return 0
     if args.elastic:

@@ -28,6 +28,13 @@ docs/ROBUSTNESS.md "Elastic recovery"):
          and left -> counted restart; its host goes on the cooldown
          list (--quarantine-cooldown generations held out of regrow)
          and the relaunch resumes from the last VERIFIED checkpoint
+    47   structural OOM (observability/membudget.py,
+         MXNET_MEM_OOM_ACTION=checkpoint): the step cannot fit even
+         after GC; an emergency checkpoint committed -> counted
+         restart, relaunch at g+1 same world with a DOUBLED sticky
+         gradient-accumulation factor (MXNET_MEM_ACCUM_FACTOR) so the
+         resumed job runs smaller micro-batches at the same global
+         batch
     143  SIGTERM (preemption): emergency checkpoint committed ->
          counted restart, relaunch at g+1, same world
     else hard crash (SIGKILL/OOM/bug) -> counted restart with
@@ -56,6 +63,7 @@ sys.path.insert(0, ROOT)
 
 from mxnet_tpu.parallel import elastic  # noqa: E402
 from mxnet_tpu.observability import integrity  # noqa: E402
+from mxnet_tpu.observability import membudget  # noqa: E402
 
 
 def worker_env(args, proc_id, world, generation):
@@ -79,6 +87,11 @@ def worker_env(args, proc_id, world, generation):
         # device per process so collectives run without hardware
         "JAX_PLATFORMS": "cpu",
     })
+    if getattr(args, "_accum_factor", 1) > 1:
+        # sticky OOM recovery: a structural-OOM exit (47) doubled the
+        # factor; every later generation inherits it so the job does
+        # not relapse into the same allocation it just died on
+        env["MXNET_MEM_ACCUM_FACTOR"] = str(args._accum_factor)
     env.setdefault("XLA_FLAGS",
                    "--xla_force_host_platform_device_count=1")
     if world > 1:
@@ -114,6 +127,8 @@ def classify(codes):
         return "shrink"
     if integrity.QUARANTINE_EXIT_CODE in codes:
         return "quarantine"
+    if membudget.OOM_EXIT_CODE in codes:
+        return "oom"
     if all(c in (0, elastic.BOUNDARY_EXIT_CODE) for c in codes):
         return "boundary"
     if 43 in codes:
@@ -167,6 +182,8 @@ def main(argv=None):
     restarts = 0
     last_bad = 1
     args._since_wall = None
+    args._accum_factor = max(
+        1, int(os.environ.get("MXNET_MEM_ACCUM_FACTOR", "1") or 1))
     cooldown = {}     # host tag -> first generation it may rejoin
     while True:
         codes = run_generation(args, world, generation)
@@ -254,8 +271,17 @@ def main(argv=None):
             world = new_world
             generation += 1
             continue
-        # watchdog / sigterm / crash: capped exponential backoff with
-        # jitter so N supervisors never stampede a shared resource
+        if verdict == "oom":
+            # structural OOM: the worker checkpointed and left (exit
+            # 47). Relaunch at the same world with a doubled sticky
+            # accumulation factor — smaller micro-batches, same global
+            # batch — so the resumed step fits where the old one died.
+            args._accum_factor *= 2
+            print("[elastic_launch] oom: relaunching with sticky "
+                  "accumulation factor %d (MXNET_MEM_ACCUM_FACTOR)"
+                  % args._accum_factor, flush=True)
+        # watchdog / oom / sigterm / crash: capped exponential backoff
+        # with jitter so N supervisors never stampede a shared resource
         delay = min(args.backoff_ms * (2 ** (restarts - 1)), 30000.0)
         delay *= 1.0 + 0.5 * random.random()
         print("[elastic_launch] %s restart %d/%d in %.0f ms"
