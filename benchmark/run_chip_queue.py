@@ -138,6 +138,14 @@ QUEUE = [
     ("serving_mempressure",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--mem-pressure"]}, 1800, False),
+    # durability tax: the same paged + pipelined workload with the
+    # request write-ahead journal off and on — streams and dispatch
+    # counts must be bit-identical (the journal is off-path by
+    # contract) and the row reports the throughput overhead the <3%
+    # chip target tracks (docs/ROBUSTNESS.md "Durable serving")
+    ("serving_journal",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--journal"]}, 1800, False),
     ("train_lm",
      {"stdin": "benchmark/train_lm_bench.py"}, 1500, False),
     ("train_lm_d2048",

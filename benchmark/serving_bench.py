@@ -78,6 +78,14 @@ vs solo generate(), the grow-back outcome, and whether the health
 snapshot exports mem.headroom_bytes; the leg exits nonzero if any of
 it breaks (docs/ROBUSTNESS.md "Memory pressure").
 
+With ``--journal`` it runs the durability-tax A/B leg: the same
+seeded paged + pipelined workload with the request write-ahead
+journal off and on. The hard contract is the journal being OFF-PATH —
+streams and dispatch counts bit-identical between legs — with the
+overhead percentage reported (chip target <3%; the CPU-smoke gate is
+``MXNET_SERVING_JOURNAL_AB_MAX_PCT``, default 25, because 1-core
+timing noise dwarfs the real tax).
+
 After the throughput legs, the continuous-batching pools run once more
 INSTRUMENTED (MXNET_OBS forced on for that run only) to print the
 request-level TTFT / ITL / e2e / queue-wait percentile table from the
@@ -850,6 +858,108 @@ def mem_pressure_ab():
         sys.exit(1)
 
 
+def journal_ab():
+    """The durability-tax leg (``--journal``): the SAME seeded
+    mixed-length paged + pipelined workload runs twice — journal off,
+    then journal on (a fresh WAL dir, default fsync policy) — and the
+    row reports the token throughput of both legs plus the overhead
+    percentage. The HARD contract is that the journal is off-path:
+    every stream's tokens and the batcher's dispatch_count must be
+    BIT-identical between legs (a journal that changes scheduling or
+    numerics is a correctness bug, not a tax), and the journal must
+    actually have recorded the workload (every rid tombstoned, GC-able
+    state). The overhead gate is ``MXNET_SERVING_JOURNAL_AB_MAX_PCT``
+    (default 25 — CPU smoke timing is noisy; the chip-queue target
+    from the ISSUE is <3% and the row is what tracks it)."""
+    import tempfile
+
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.journal import RequestJournal
+    from mxnet_tpu.models.serving import ContinuousBatcher
+
+    backend = jax.default_backend()
+    if SMOKE:
+        vocab = 8192
+        d_model, heads, layers, max_len = 32, 2, 1, 96
+        t_prompt, block_size = 24, 8
+        n_new, n_jobs, slots = 16, 6, 3
+    else:
+        vocab = 32000
+        d_model, heads, layers, max_len = 512, 8, 8, 2048
+        t_prompt = 192
+        block_size = int(os.environ.get("MXNET_KV_BLOCK_SIZE", "16"))
+        n_new, n_jobs, slots = 64, 8, 4
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    cfg = tf.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+        dtype=dtype)
+    params = tf.init_params(cfg, seed=0)
+    life = (t_prompt + n_new - 2) // block_size + 1
+    num_blocks = slots * life + 2
+    jrng = np.random.RandomState(31)
+    jobs = []
+    for _ in range(n_jobs):
+        t_p = int(jrng.randint(max(2, t_prompt // 2), t_prompt))
+        jobs.append((list(jrng.randint(1, vocab, t_p)), n_new, 0))
+    print("serving journal: backend=%s dtype=%s d_model=%d layers=%d "
+          "block=%d pool=%d blocks, %d jobs over %d lanes"
+          % (backend, np.dtype(dtype).name, d_model, layers,
+             block_size, num_blocks, n_jobs, slots), flush=True)
+
+    def leg(journal):
+        srv = ContinuousBatcher(params, cfg, max_batch=slots,
+                                paged=True, block_size=block_size,
+                                num_blocks=num_blocks,
+                                pipeline_depth=2, journal=journal)
+        t0 = time.perf_counter()
+        results, order = srv.run(list(jobs))
+        dt = time.perf_counter() - t0
+        toks = [results[rid] for rid in order]
+        srv.check_invariants(quiesce=True)
+        return toks, srv.dispatch_count, n_jobs * n_new / dt
+
+    leg(False)                         # warm the compile caches
+    toks_off, disp_off, rate_off = leg(False)
+    with tempfile.TemporaryDirectory() as td:
+        toks_on, disp_on, rate_on = leg(td)
+        j = RequestJournal(td)
+        depth, records = j.depth_bytes, j.lag_records
+        live, fin, skipped = j.replay()
+        j.close()
+    bit_exact = toks_on == toks_off
+    dispatch_equal = disp_on == disp_off
+    recorded = not live and len(fin) == n_jobs and not skipped
+    overhead = (rate_off - rate_on) / rate_off * 100.0
+    max_pct = float(os.environ.get(
+        "MXNET_SERVING_JOURNAL_AB_MAX_PCT", "25"))
+    row = {
+        "leg": "journal_ab", "backend": backend,
+        "tokens_per_s_off": round(rate_off, 1),
+        "tokens_per_s_on": round(rate_on, 1),
+        "overhead_pct": round(overhead, 2),
+        "max_overhead_pct": max_pct,
+        "bit_exact": bit_exact, "dispatch_equal": dispatch_equal,
+        "journal_recorded": recorded,
+        "journal_depth_bytes": depth, "journal_records": records,
+    }
+    print(json.dumps(row), flush=True)
+    if not (bit_exact and dispatch_equal and recorded):
+        print("serving journal leg FAILED its off-path contract "
+              "(tokens/dispatches must be bit-identical with the "
+              "journal attached)", flush=True)
+        sys.exit(1)
+    if overhead > max_pct:
+        print("serving journal leg FAILED: %.2f%% overhead exceeds "
+              "the %.1f%% gate" % (overhead, max_pct), flush=True)
+        sys.exit(1)
+
+
 def main():
     from benchmark.common import fetch_barrier
     from mxnet_tpu._discover import pin_platform_from_env
@@ -1050,5 +1160,7 @@ if __name__ == "__main__":
         overload_ab()
     elif "--mem-pressure" in sys.argv[1:]:
         mem_pressure_ab()
+    elif "--journal" in sys.argv[1:]:
+        journal_ab()
     else:
         main()

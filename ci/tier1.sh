@@ -167,6 +167,22 @@ if [ "${TIER1_CHAOS:-0}" = "1" ]; then
         echo "[tier1] FAIL: memory-pressure smoke"
         exit 1
     fi
+
+    echo "==== [tier1] durable-serving smoke (kill-9 journal replay + rollout rollback) ===="
+    # docs/ROBUSTNESS.md "Durable serving & zero-downtime rollout",
+    # end to end: a hard kill (exit 9, no cleanup) at a journal
+    # commit point under paged x spec x pipeline (greedy AND
+    # sampled), replayed BIT-exactly by a fresh batcher's recover();
+    # torn-tail and CRC-flipped records skipped with named evidence
+    # while the records behind them survive; a chaos-failed canary
+    # rolling the whole fleet back to the prior verified fingerprint
+    # with zero dropped in-flight requests; and a hot-swap whose
+    # manifest fingerprint mismatches refused before touching a
+    # replica.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/chaos_smoke.py --durable; then
+        echo "[tier1] FAIL: durable-serving smoke"
+        exit 1
+    fi
 fi
 
 echo "[tier1] gate PASSED"

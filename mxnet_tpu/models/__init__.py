@@ -7,5 +7,6 @@ live in `mxnet_tpu.gluon.model_zoo.vision` behind the MXNet Gluon API.
 
 from . import transformer
 from . import checkpoint
+from . import journal
 from . import serving
 from . import router

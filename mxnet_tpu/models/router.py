@@ -64,28 +64,35 @@ headroom are per-instance either way).
     results, order = srv.run(jobs)          # {rid: tokens-or-None}
 """
 
+import os
 import time
 import warnings
 from collections import deque
 
+from . import journal as _journal
 from .serving import ContinuousBatcher
 from .. import _fastenv
+from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import membudget as _membudget
 
 __all__ = ["ReplicaRouter"]
 
 _STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+# rolling-rollout phases, gauge-coded for /healthz scrapers
+_ROLLOUT_CODE = {"idle": 0, "draining": 1, "canary": 2, "done": 3,
+                 "rolled_back": 4}
 
 
 class _Job(object):
     __slots__ = ("rid", "prompt", "n_new", "seed", "stop_token",
                  "enq_ns", "priority", "deadline_ns", "emitted",
-                 "preempt_ns")
+                 "preempt_ns", "key", "fp", "prompt0", "n0")
 
     def __init__(self, rid, prompt, n_new, seed, stop_token, enq_ns,
                  priority=0, deadline_ns=None, emitted=0,
-                 preempt_ns=None):
+                 preempt_ns=None, key=None, fp=None, prompt0=None,
+                 n0=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.n_new = int(n_new)
@@ -100,6 +107,15 @@ class _Job(object):
         # batcher replays the key chain `emitted` steps from it)
         self.emitted = int(emitted)
         self.preempt_ns = preempt_ns
+        self.key = key                 # idempotency key (dedup window)
+        # weight-version affinity: a continuation resumes only on a
+        # replica serving the fingerprint its prefix was computed under
+        self.fp = fp
+        # the ORIGINAL submission (restart-from-origin fallback when
+        # affinity cannot be satisfied mid-rollout)
+        self.prompt0 = list(prompt0) if prompt0 is not None \
+            else list(prompt)
+        self.n0 = int(n0) if n0 is not None else int(n_new)
 
 
 class ReplicaRouter(object):
@@ -116,7 +132,9 @@ class ReplicaRouter(object):
 
     def __init__(self, replicas, shed_queue=None, slo_floor=None,
                  breaker=None, breaker_backoff=None,
-                 breaker_backoff_max=None, breaker_retries=None):
+                 breaker_backoff_max=None, breaker_retries=None,
+                 journal=None, rollout_attain=None,
+                 rollout_window=None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
@@ -165,6 +183,36 @@ class ReplicaRouter(object):
         self.expired_rids = []
         self._last_exc = None
         self._fp_warned = None   # last mixed weight-version set warned
+        # the router's own write-ahead journal covers its QUEUE (the
+        # replicas journal their admitted streams into per-name
+        # subdirectories of the same MXNET_SERVING_JOURNAL_DIR):
+        # submit() appends an emitted=0 record, admission tombstones it
+        # (reason "admit" — the replica's record takes over), a
+        # drain/preemption requeue re-journals the continuation here
+        # and tombstones the replica's record (reason "resume")
+        if journal is None:
+            jd = _fastenv.get("MXNET_SERVING_JOURNAL_DIR")
+            journal = _journal.RequestJournal(
+                os.path.join(jd, "router")) if jd else False
+        elif isinstance(journal, str):
+            journal = _journal.RequestJournal(journal)
+        self._journal = journal or None
+        # idempotency dedup window (keys also pass through to the
+        # replica, so its journal-backed window survives a crash)
+        self._idem = {}
+        self._idem_done = {}
+        self._redeliver = {}     # rid -> tokens, served at next step()
+        # rolling weight rollout (start_rollout / _rollout_tick)
+        self._rollout = None
+        self.rollout_events = []     # (event, detail) audit trail
+        if rollout_attain is None:
+            v = _fastenv.get("MXNET_ROUTER_ROLLOUT_ATTAIN")
+            rollout_attain = float(v) if v else None
+        self.rollout_attain = rollout_attain
+        if rollout_window is None:
+            v = _fastenv.get("MXNET_ROUTER_ROLLOUT_WINDOW")
+            rollout_window = int(v) if v else 8
+        self.rollout_window = max(1, int(rollout_window))
 
     @classmethod
     def build(cls, params, cfg, n_replicas=2, shed_queue=None,
@@ -189,12 +237,30 @@ class ReplicaRouter(object):
         return len(self._live)
 
     def submit(self, prompt, n_new, seed=0, stop_token=None,
-               priority=0, deadline_ms=None):
+               priority=0, deadline_ms=None, key=None):
         """Enqueue one request; returns its router-level rid. Admission
         happens at the next step(), on whichever replica the routing
         policy picks — higher `priority` admits first (FIFO within a
         class), and a `deadline_ms` budget (from now) lets the router
-        expire the request up front instead of serving it late."""
+        expire the request up front instead of serving it late.
+        `key` is an idempotency key: a duplicate submission returns
+        the ORIGINAL rid (still live: keep waiting on it; finished:
+        the recorded result re-delivers at the next step()) instead of
+        double-serving — ``serving.dedup_hits`` counts the hits, and
+        with a journal attached the window survives restarts."""
+        if key is not None:
+            hit = self._idem.get(key)
+            if hit is None and key in self._idem_done:
+                rid0, toks0 = self._idem_done[key]
+                self._redeliver[rid0] = list(toks0)
+                hit = rid0
+            if hit is not None:
+                _obs.counter("serving.dedup_hits").add(1)
+                if _obs.enabled():
+                    _obs.record_instant(
+                        "router.dedup", cat="serving",
+                        args={"rid": hit, "key": str(key)})
+                return hit
         rid = self._next_rid
         self._next_rid += 1
         now = (time.perf_counter_ns()
@@ -202,14 +268,23 @@ class ReplicaRouter(object):
         enq = now if _obs.enabled() else None
         ddl = (None if deadline_ms is None
                else now + int(deadline_ms * 1e6))
-        self._queue.append(_Job(rid, prompt, n_new, seed, stop_token,
-                                enq, priority=priority,
-                                deadline_ns=ddl))
+        job = _Job(rid, prompt, n_new, seed, stop_token, enq,
+                   priority=priority, deadline_ns=ddl, key=key)
+        self._queue.append(job)
+        if key is not None:
+            self._idem[key] = rid
+        if self._journal is not None:
+            # emitted=0: a pure queue entry — recovery re-enqueues it
+            # whole (deadlines are wall-clock local and do not survive)
+            self._journal.append_submit(
+                rid, job.prompt, n_new, seed=seed,
+                stop_token=stop_token, priority=priority, key=key,
+                emitted=0)
         return rid
 
     # ---- routing policy ----
 
-    def _eligible(self, job=None):
+    def _eligible(self, job=None, ignore_affinity=False):
         """Replicas that may take NEW admissions this round: alive,
         lane+block capacity, and (when slo_floor is set) rolling SLO
         attainment at or above the floor — best headroom first. A
@@ -220,10 +295,27 @@ class ReplicaRouter(object):
         but NO block headroom still qualifies — ranked last — when it
         runs strictly-lower-priority work, because preempting that
         work can fund the admission (the batcher's own admit() makes
-        the final call)."""
+        the final call). During a rollout the current target takes
+        nothing, and a CONTINUATION routes version-affinely: only to a
+        replica serving the fingerprint its prefix was computed under
+        (``router.weight_version_mismatch`` counts the skips —
+        _admit_queued owns the restart-from-origin fallback when no
+        affine replica remains)."""
         scored = []
+        ro = self._rollout
         for i, r in enumerate(self.replicas):
             if not self._alive[i]:
+                continue
+            if ro is not None and ro["phase"] in ("draining", "canary") \
+                    and i == ro["idx"]:
+                continue           # rollout target: drains, takes none
+            if not ignore_affinity and job is not None \
+                    and job.emitted > 0 and job.fp is not None \
+                    and r.weight_fingerprint != job.fp:
+                # resuming under different weights would splice two
+                # models into one stream — the mismatch counter is the
+                # GATE here, not just an alarm
+                _obs.counter("router.weight_version_mismatch").add(1)
                 continue
             preempt_only = False
             if not r.has_capacity:
@@ -303,6 +395,7 @@ class ReplicaRouter(object):
                 continue
             self.expired_rids.append(job.rid)
             finished[job.rid] = None
+            self._retire_job(job, "expired")
             _obs.counter("serving.slo_violation.expired").add(1)
             if _obs.enabled():
                 _obs.counter("router.expired").add(1)
@@ -320,6 +413,18 @@ class ReplicaRouter(object):
             job = max(self._queue, key=lambda j: j.priority)
             order = self._eligible(job)
             if not order:
+                if job.emitted > 0 and job.fp is not None \
+                        and self._eligible(job, ignore_affinity=True):
+                    # every replica serving this stream's weight
+                    # version is gone (mid-rollout): restart from the
+                    # ORIGINAL prompt so the whole stream comes from
+                    # ONE version instead of splicing two
+                    job.emitted = 0
+                    job.prompt = list(job.prompt0)
+                    job.n_new = job.n0
+                    job.fp = None
+                    _obs.counter("router.rollout_restarts").add(1)
+                    continue
                 break
             admitted = False
             for i in order:
@@ -330,16 +435,19 @@ class ReplicaRouter(object):
                         emitted=job.emitted,
                         stop_token=job.stop_token,
                         priority=job.priority,
-                        preempted_ns=job.preempt_ns)
+                        preempted_ns=job.preempt_ns, key=job.key)
                 else:
                     rep_rid = rep.admit(
                         job.prompt, job.n_new, seed=job.seed,
                         stop_token=job.stop_token,
                         enqueued_ns=job.enq_ns,
-                        priority=job.priority)
+                        priority=job.priority, key=job.key)
                 if rep_rid is not None:
                     self._queue.remove(job)
                     self._live[(i, rep_rid)] = (job.rid, job)
+                    if self._journal is not None:
+                        # the replica's record owns the stream now
+                        self._journal.append_finish(job.rid, "admit")
                     if self.breaker \
                             and self._brk_state[i] == "half_open" \
                             and self._brk_canary[i] is None:
@@ -363,6 +471,7 @@ class ReplicaRouter(object):
                 del self._queue[ix]
                 self.shed_rids.append(job.rid)
                 finished[job.rid] = None
+                self._retire_job(job, "shed")
                 _obs.counter("serving.slo_violation.shed").add(1)
                 if _obs.enabled():
                     _obs.counter("router.shed").add(1)
@@ -370,6 +479,28 @@ class ReplicaRouter(object):
                         "router.shed", cat="serving",
                         args={"rid": job.rid, "priority": job.priority,
                               "queued": len(self._queue)})
+
+    def _retire_job(self, job, reason):
+        """A queued job left the router for good (shed / expired):
+        release its idempotency claim and tombstone its journal
+        record so GC can truncate the segment."""
+        if job.key is not None and self._idem.get(job.key) == job.rid:
+            self._idem.pop(job.key, None)
+        if self._journal is not None:
+            self._journal.append_finish(job.rid, reason)
+
+    def _requeue_cont(self, rep, rep_rid, cont):
+        """A stream moved OFF a replica back into the router queue:
+        the router's journal record takes ownership (fresh submit with
+        the synced prefix) and the replica's record is tombstoned —
+        a crash at any point replays exactly one of the two."""
+        if self._journal is not None:
+            self._journal.append_submit(
+                cont.rid, cont.prompt, cont.n_new, seed=cont.seed,
+                stop_token=cont.stop_token, priority=cont.priority,
+                key=cont.key, emitted=cont.emitted)
+        if rep._journal is not None:
+            rep._journal.append_finish(rep_rid, "resume")
 
     def _absorb_preempted(self, i, rep):
         """Replica i preempted low-priority lanes to cover an
@@ -384,12 +515,16 @@ class ReplicaRouter(object):
             if entry is None:
                 continue               # not routed by us — drop
             rid, job = entry
-            conts.append(_Job(rid, req.tokens,
-                              req.n_new - req.emitted, job.seed,
-                              req.stop_token, job.enq_ns,
-                              priority=job.priority,
-                              deadline_ns=job.deadline_ns,
-                              emitted=req.emitted, preempt_ns=t_ns))
+            cont = _Job(rid, req.tokens,
+                        req.n_new - req.emitted, job.seed,
+                        req.stop_token, job.enq_ns,
+                        priority=job.priority,
+                        deadline_ns=job.deadline_ns,
+                        emitted=req.emitted, preempt_ns=t_ns,
+                        key=job.key, fp=rep.weight_fingerprint,
+                        prompt0=job.prompt0, n0=job.n0)
+            self._requeue_cont(rep, req.rid, cont)
+            conts.append(cont)
         rep.preempted = []
         for cont in reversed(conts):
             self._queue.appendleft(cont)
@@ -419,13 +554,24 @@ class ReplicaRouter(object):
             if req.n_new - req.emitted <= 0:
                 # complete at the instant of death — nothing to resume
                 finished[rid] = list(req.tokens)
+                if rep._journal is not None:
+                    rep._journal.append_finish(rep_rid, "finish",
+                                               tokens=req.tokens)
+                if job.key is not None:
+                    if self._idem.get(job.key) == rid:
+                        self._idem.pop(job.key, None)
+                    self._idem_done[job.key] = (rid, list(req.tokens))
                 continue
-            drained.append(_Job(rid, req.tokens,
-                                req.n_new - req.emitted, job.seed,
-                                req.stop_token, job.enq_ns,
-                                priority=job.priority,
-                                deadline_ns=job.deadline_ns,
-                                emitted=req.emitted))
+            cont = _Job(rid, req.tokens,
+                        req.n_new - req.emitted, job.seed,
+                        req.stop_token, job.enq_ns,
+                        priority=job.priority,
+                        deadline_ns=job.deadline_ns,
+                        emitted=req.emitted, key=job.key,
+                        fp=rep.weight_fingerprint,
+                        prompt0=job.prompt0, n0=job.n0)
+            self._requeue_cont(rep, rep_rid, cont)
+            drained.append(cont)
         for cont in reversed(drained):
             self._queue.appendleft(cont)
         if self.breaker:
@@ -502,6 +648,14 @@ class ReplicaRouter(object):
         OPEN with its retries exhausted (breaker on); callers own the
         restart policy above that."""
         finished = {}
+        if self._redeliver:
+            # deduped already-finished streams (idempotency hits and
+            # journal recovery) re-deliver here, no dispatch spent
+            finished.update(self._redeliver)
+            self._redeliver.clear()
+        if self._rollout is not None \
+                and self._rollout["phase"] == "draining":
+            self._rollout_tick(finished)
         self._admit_queued(finished)
         last_exc = None
         for i, rep in enumerate(self.replicas):
@@ -519,10 +673,19 @@ class ReplicaRouter(object):
             if rep.preempted:
                 self._absorb_preempted(i, rep)
             for rep_rid, toks in done.items():
+                if self._rollout is not None \
+                        and self._rollout.get("canary") == (i, rep_rid):
+                    # the rollout's synthetic probe, not client work
+                    self._rollout_canary_done(i, toks, finished)
+                    continue
                 key = (i, rep_rid)
                 if key in self._live:
-                    rid, _ = self._live.pop(key)
+                    rid, job = self._live.pop(key)
                     finished[rid] = toks
+                    if job.key is not None:
+                        if self._idem.get(job.key) == rid:
+                            self._idem.pop(job.key, None)
+                        self._idem_done[job.key] = (rid, list(toks))
                     if self.breaker and self._brk_canary[i] == rid:
                         self._breaker_close(i)
         if not any(self._alive):
@@ -548,8 +711,355 @@ class ReplicaRouter(object):
             ratios = [x for x in ratios if x is not None]
             if ratios:
                 _obs.gauge("router.spec_accept_ratio").set(min(ratios))
+            _obs.gauge("router.rollout_phase").set(
+                _ROLLOUT_CODE[self._rollout["phase"]]
+                if self._rollout else 0)
             self._check_weight_versions()
+        if self._rollout is not None \
+                and self._rollout["phase"] == "done" \
+                and self._rollout["watch_left"] > 0:
+            # post-swap SLO watch: a fleet whose attainment collapses
+            # right after an upgrade rolls back even though every
+            # canary matched (the canary proves numerics, not load)
+            ro = self._rollout
+            ro["watch_left"] -= 1
+            if self.rollout_attain is not None:
+                bad = [r.name for i, r in enumerate(self.replicas)
+                       if self._alive[i]
+                       and (r.health_snapshot()
+                            .get("serving.slo_attainment") or 1.0)
+                       < self.rollout_attain]
+                if bad:
+                    self._rollback_fleet(
+                        finished, "post-swap SLO collapse on %s"
+                        % ",".join(bad))
         return finished
+
+    # ---- rolling weight rollout ----
+
+    def start_rollout(self, params, manifest=None, canary_tokens=None):
+        """Begin a zero-downtime rolling upgrade of the fleet to
+        `params`, verified against PR 13's checkpoint lineage BEFORE
+        any replica is touched: `manifest` is a checkpoint directory
+        (``verify_lineage`` must pass and its ``param_fingerprint``
+        must match the incoming tree) or a manifest dict; bad lineage
+        raises ``CheckpointCorrupt`` with the fleet untouched.
+
+        The upgrade then proceeds one replica at a time, driven by
+        step(): the target stops taking admissions, its live streams
+        requeue onto still-affine replicas (version-affine routing),
+        the drained replica hot-swaps (``swap_weights`` — membudget
+        preflight, drain-then-swap degradation), and a BIT-EXACT
+        canary (a synthetic probe checked against solo ``generate()``
+        under the new weights, `canary_tokens` long —
+        ``MXNET_ROUTER_ROLLOUT_CANARY_TOKENS``, default 4) gates the
+        next replica. A diverged canary, a failed swap, or a post-swap
+        SLO collapse (``MXNET_ROUTER_ROLLOUT_ATTAIN`` over
+        ``MXNET_ROUTER_ROLLOUT_WINDOW`` steps) AUTO-ROLLS-BACK every
+        already-upgraded replica to the prior verified fingerprint —
+        live streams survive the rollback (the swap preserves them).
+        Returns the target fingerprint."""
+        from . import checkpoint as _ckpt
+        from ..observability import integrity as _integrity
+        if self._rollout is not None \
+                and self._rollout["phase"] in ("draining", "canary"):
+            raise RuntimeError("a rollout is already in progress")
+        want = None
+        if isinstance(manifest, str):
+            chain = _ckpt.verify_lineage(manifest)
+            if not chain or chain[0]["status"] != "verified":
+                raise _ckpt.CheckpointCorrupt(
+                    "start_rollout: lineage of %s does not verify (%s)"
+                    % (manifest, chain[0]["status"] if chain
+                       else "no manifests"))
+            import json as _json
+            with open(os.path.join(manifest, chain[0]["name"])) as f:
+                want = _json.load(f).get("param_fingerprint")
+        elif isinstance(manifest, dict):
+            want = manifest.get("param_fingerprint")
+        new_fp = _integrity.params_fingerprint(params)
+        if want is not None and new_fp != want:
+            raise _ckpt.CheckpointCorrupt(
+                "start_rollout: incoming parameter fingerprint %s "
+                "does not match manifest %s — refusing unverified "
+                "weights" % (new_fp, want))
+        if canary_tokens is None:
+            v = _fastenv.get("MXNET_ROUTER_ROLLOUT_CANARY_TOKENS")
+            canary_tokens = int(v) if v else 4
+        self._rollout = {
+            "params": params, "manifest": manifest, "fp": new_fp,
+            "prior": [r.params for r in self.replicas],
+            "prior_fp": [r.weight_fingerprint for r in self.replicas],
+            "phase": "draining", "idx": 0, "canary": None,
+            "expected": None, "canary_tokens": max(1, canary_tokens),
+            "watch_left": self.rollout_window,
+        }
+        self.rollout_events.append(("start", new_fp))
+        if _obs.enabled():
+            _obs.record_instant(
+                "router.rollout_start", cat="serving",
+                args={"fingerprint": new_fp,
+                      "replicas": len(self.replicas)})
+        return new_fp
+
+    @property
+    def rollout_phase(self):
+        return self._rollout["phase"] if self._rollout else "idle"
+
+    def _rollout_tick(self, finished):
+        """One draining-phase round for the current target: requeue
+        its live streams (they resume version-affinely elsewhere),
+        and once it is empty, swap + launch the canary."""
+        ro = self._rollout
+        i = ro["idx"]
+        rep = self.replicas[i]
+        if not self._alive[i]:
+            # a dead replica has nothing to drain or swap — its
+            # breaker canary re-verifies whatever weights it holds
+            # if it ever recovers
+            self.rollout_events.append(("skipped_dead", rep.name))
+            self._rollout_advance()
+            return
+        self._rollout_drain(i, finished)
+        if rep.preempted:
+            self._absorb_preempted(i, rep)
+        if rep.active_count == 0 and not rep.preempted:
+            self._rollout_swap(i, finished)
+
+    def _rollout_drain(self, i, finished):
+        """Move the target's live streams back into the router queue
+        as continuations from their synced prefixes — the same resume
+        identity as a replica drain, but the replica stays healthy
+        (cancel() frees each lane; nothing in flight is lost)."""
+        rep = self.replicas[i]
+        conts = []
+        for (ri, rep_rid), (rid, job) in sorted(self._live.items()):
+            if ri != i:
+                continue
+            req = next((r for r in rep._slots
+                        if r is not None and r.rid == rep_rid), None)
+            del self._live[(ri, rep_rid)]
+            if req is None:
+                continue
+            if req.n_new - req.emitted <= 0:
+                finished[rid] = list(req.tokens)
+                if job.key is not None:
+                    if self._idem.get(job.key) == rid:
+                        self._idem.pop(job.key, None)
+                    self._idem_done[job.key] = (rid, list(req.tokens))
+                if rep._journal is not None:
+                    # a crash replays this as finished, not canceled
+                    rep._journal.append_finish(
+                        rep_rid, "finish", tokens=req.tokens)
+                rep.cancel(rep_rid)
+                continue
+            cont = _Job(rid, req.tokens, req.n_new - req.emitted,
+                        job.seed, req.stop_token, job.enq_ns,
+                        priority=job.priority,
+                        deadline_ns=job.deadline_ns,
+                        emitted=req.emitted, key=job.key,
+                        fp=rep.weight_fingerprint,
+                        prompt0=job.prompt0, n0=job.n0)
+            rep.cancel(rep_rid)    # journal-tombstoned (reason cancel)
+            if self._journal is not None:
+                self._journal.append_submit(
+                    cont.rid, cont.prompt, cont.n_new, seed=cont.seed,
+                    stop_token=cont.stop_token,
+                    priority=cont.priority, key=cont.key,
+                    emitted=cont.emitted)
+            conts.append(cont)
+        for cont in reversed(conts):
+            self._queue.appendleft(cont)
+        if conts and _obs.enabled():
+            _obs.counter("router.rollout_drained").add(len(conts))
+
+    def _rollout_swap(self, i, finished):
+        """The drained target hot-swaps and admits its bit-exact
+        canary probe. Any swap failure rolls the fleet back."""
+        ro = self._rollout
+        rep = self.replicas[i]
+        try:
+            if _chaos.enabled():
+                _chaos.fire("router.rollout", replica=rep.name,
+                            phase="swap")
+            rep.swap_weights(ro["params"], manifest=ro["manifest"])
+        except Exception as exc:       # noqa: BLE001 — rollback
+            self._rollback_fleet(
+                finished, "swap failed on %s: %s: %s"
+                % (rep.name, type(exc).__name__, exc))
+            return
+        import numpy as np
+        from . import transformer as tf
+        n_tok = ro["canary_tokens"]
+        prompt = [1 % rep.cfg.vocab_size, 2 % rep.cfg.vocab_size,
+                  3 % rep.cfg.vocab_size]
+        expected = [int(t) for t in np.asarray(tf.generate(
+            rep.params, np.asarray([prompt]), n_tok, rep.cfg,
+            greedy=rep.greedy, seed=0))[0]]
+        rep_rid = rep.admit(prompt, n_tok, seed=0)
+        if rep_rid is None:
+            self._rollback_fleet(
+                finished, "canary admission refused on %s" % rep.name)
+            return
+        ro["canary"] = (i, rep_rid)
+        ro["expected"] = expected
+        ro["phase"] = "canary"
+        self.rollout_events.append(("canary", rep.name))
+
+    def _rollout_canary_done(self, i, toks, finished):
+        """The canary probe finished: bit-exact against solo
+        generate() under the new weights closes this replica's
+        upgrade; ANY divergence (or an injected ``router.rollout``
+        fault — the chaos site for a canary that lies) rolls the
+        fleet back."""
+        ro = self._rollout
+        rep = self.replicas[i]
+        try:
+            if _chaos.enabled():
+                _chaos.fire("router.rollout", replica=rep.name,
+                            phase="canary")
+            ok = list(toks) == ro["expected"]
+        except Exception:              # noqa: BLE001 — divergence
+            ok = False
+        if not ok:
+            self._rollback_fleet(
+                finished, "canary diverged on %s" % rep.name)
+            return
+        ro["canary"] = None
+        self.rollout_events.append(("upgraded", rep.name))
+        if _obs.enabled():
+            _obs.record_instant(
+                "router.rollout_upgraded", cat="serving",
+                args={"replica": rep.name, "fingerprint": ro["fp"]})
+        self._rollout_advance()
+
+    def _rollout_advance(self):
+        ro = self._rollout
+        ro["idx"] += 1
+        if ro["idx"] >= len(self.replicas):
+            ro["phase"] = "done"
+            ro["watch_left"] = self.rollout_window
+            self.rollout_events.append(("done", ro["fp"]))
+        else:
+            ro["phase"] = "draining"
+
+    def _rollback_fleet(self, finished, reason):
+        """Roll every already-upgraded replica back to the PRIOR
+        verified fingerprint (captured at start_rollout — rollback
+        needs no manifest re-verification, those exact params were
+        serving before). Live streams survive: swap_weights preserves
+        them, and the canary probe is canceled, not a client
+        stream."""
+        ro = self._rollout
+        if ro.get("canary") is not None:
+            ci, crid = ro["canary"]
+            self.replicas[ci].cancel(crid)
+            ro["canary"] = None
+        for i, rep in enumerate(self.replicas):
+            if rep.weight_fingerprint == ro["prior_fp"][i]:
+                continue
+            try:
+                rep.swap_weights(ro["prior"][i])
+            except Exception as exc:   # noqa: BLE001 — drain it
+                self._drain_replica(i, exc, finished)
+        ro["phase"] = "rolled_back"
+        ro["reason"] = reason
+        _obs.counter("router.rollbacks").add(1)
+        self.rollout_events.append(("rolled_back", reason))
+        if _obs.enabled():
+            _obs.record_instant(
+                "router.rollback", cat="serving",
+                args={"reason": reason,
+                      "restored": [fp for fp in ro["prior_fp"]]})
+        warnings.warn(
+            "router: rollout of %s rolled back — %s"
+            % (ro["fp"], reason), RuntimeWarning, stacklevel=2)
+
+    # ---- crash recovery ----
+
+    def recover(self):
+        """Replay the router's queue journal AND every replica's own
+        journal after a whole-process crash. Queue records (emitted=0
+        submits that never reached a replica, and requeued
+        continuations) re-enter the router queue; each replica's
+        recovered streams are adopted under fresh router rids (their
+        completions return from step() like any other), its recorded
+        finished streams re-deliver at the next step(), and parked
+        overflow moves into the router queue. Returns
+        ``(requeued_rids, finished, skipped)``."""
+        if self._journal is None:
+            raise RuntimeError(
+                "recover() needs a journal attached "
+                "(MXNET_SERVING_JOURNAL_DIR or journal=)")
+        live, fin, skipped = self._journal.replay()
+        self._next_rid = max(self._next_rid, self._journal.max_rid + 1)
+        done = {}
+        for rid, rec in fin.items():
+            done[rid] = list(rec["tokens"])
+            if rec.get("key") is not None:
+                self._idem_done[rec["key"]] = (rid, list(rec["tokens"]))
+        requeued = []
+        for rid in sorted(live):
+            rec = live[rid]
+            job = _Job(rid, rec["tokens"], rec["n_new"], rec["seed"],
+                       rec["stop"], None, priority=rec["prio"],
+                       emitted=rec["emitted"], key=rec.get("key"))
+            self._queue.append(job)
+            if job.key is not None:
+                self._idem[job.key] = rid
+            requeued.append(rid)
+        for i, rep in enumerate(self.replicas):
+            if rep._journal is None:
+                continue
+            # the pre-recovery view maps old rids to their submit
+            # records — recover() itself rewrites the journal
+            pre, _pf, _ps = rep._journal.replay()
+            resumed, rdone, rskip = rep.recover()
+            skipped = skipped + rskip
+            for rep_rid, toks in rdone.items():
+                rid = self._next_rid
+                self._next_rid += 1
+                done[rid] = list(toks)
+            for old_rid, new_rid in resumed.items():
+                if new_rid is None:
+                    continue           # parked; absorbed below
+                rec = pre.get(old_rid, {})
+                rid = self._next_rid
+                self._next_rid += 1
+                job = _Job(rid, rec.get("tokens", []),
+                           max(int(rec.get("n_new", 0))
+                               - int(rec.get("emitted", 1)), 1),
+                           rec.get("seed", 0), rec.get("stop"), None,
+                           priority=rec.get("prio", 0),
+                           emitted=rec.get("emitted", 1),
+                           key=rec.get("key"))
+                self._live[(i, new_rid)] = (rid, job)
+                if job.key is not None:
+                    self._idem[job.key] = rid
+                requeued.append(rid)
+            for req, t_ns in rep.preempted:
+                # capacity overflow at replica recovery: the router
+                # queue owns it now (journal ownership moves too)
+                rid = self._next_rid
+                self._next_rid += 1
+                cont = _Job(rid, req.tokens, req.n_new - req.emitted,
+                            req.seed, req.stop_token, None,
+                            priority=req.priority, emitted=req.emitted,
+                            key=req.key, preempt_ns=t_ns)
+                self._requeue_cont(rep, req.rid, cont)
+                self._queue.append(cont)
+                if cont.key is not None:
+                    self._idem[cont.key] = rid
+                requeued.append(rid)
+            rep.preempted = []
+        self._redeliver.update(done)
+        if _obs.enabled():
+            _obs.counter("router.journal_recoveries").add(1)
+            _obs.record_instant(
+                "router.recover", cat="serving",
+                args={"requeued": len(requeued),
+                      "finished": len(done), "skipped": len(skipped)})
+        return requeued, done, skipped
 
     def _check_weight_versions(self):
         """A fleet must serve ONE weight version: after a partial
@@ -591,6 +1101,15 @@ class ReplicaRouter(object):
         snap["router.weight_versions"] = len(
             {r.weight_fingerprint
              for i, r in enumerate(self.replicas) if self._alive[i]})
+        snap["router.rollout_phase"] = _ROLLOUT_CODE[self.rollout_phase]
+        if self._rollout is not None:
+            snap["router.rollout_target_fp"] = int(
+                self._rollout["fp"], 16)
+        if self._journal is not None:
+            snap["router.journal_depth_bytes"] = \
+                self._journal.depth_bytes
+            snap["router.journal_lag_records"] = \
+                self._journal.lag_records
         return snap
 
     def run(self, requests):
@@ -601,6 +1120,8 @@ class ReplicaRouter(object):
         marker (``shed_rids``/``expired_rids`` tell them apart)."""
         order = [self.submit(*job) for job in requests]
         results = {}
-        while self._queue or self._live:
+        while self._queue or self._live or (
+                self._rollout is not None
+                and self._rollout["phase"] in ("draining", "canary")):
             results.update(self.step())
         return results, order

@@ -69,6 +69,8 @@ Weight-only int8 trees (quantize_weights_int8) pass through unchanged.
 """
 
 import dataclasses
+import json
+import os
 import time
 from collections import deque
 
@@ -77,6 +79,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import journal as _journal
 from . import transformer as tf
 from .. import _fastenv
 from ..observability import chaos as _chaos
@@ -816,11 +819,11 @@ class BlockAllocator(object):
 
 class Request(object):
     __slots__ = ("rid", "tokens", "n_new", "emitted", "stop_token",
-                 "seed", "priority", "t_enq_ns", "t_admit_ns",
+                 "seed", "priority", "key", "t_enq_ns", "t_admit_ns",
                  "t_first_ns", "t_last_ns", "slo_bad")
 
     def __init__(self, rid, prompt, n_new, stop_token=None, seed=0,
-                 priority=0):
+                 priority=0, key=None):
         self.rid = rid
         self.tokens = list(prompt)   # prompt + generated so far
         self.n_new = n_new
@@ -828,6 +831,7 @@ class Request(object):
         self.stop_token = stop_token
         self.seed = seed             # sampling seed (requeue needs it)
         self.priority = int(priority)  # larger = more important
+        self.key = key               # idempotency key (dedup window)
         # request-lifecycle clock (perf_counter_ns; None with obs off):
         # enqueue -> admit -> first token -> last host-visible token
         self.t_enq_ns = None
@@ -933,7 +937,8 @@ class ContinuousBatcher(object):
                  name=None, spec_k=None, spec_ngram=None,
                  spec_accept_floor=None, draft_params=None,
                  draft_cfg=None, brownout=None, brownout_attain=None,
-                 brownout_trip=None, brownout_clear=None):
+                 brownout_trip=None, brownout_clear=None,
+                 journal=None):
         if cfg.max_len < 8:
             raise ValueError("max_len too small for the bucket floor")
         if chunk_size < 1:
@@ -1189,6 +1194,33 @@ class ContinuousBatcher(object):
         # check_invariants unconditionally)
         self._debug = (_fastenv.get("MXNET_SERVING_DEBUG") or "") \
             not in ("", "0", "false", "False")
+        # request write-ahead journal (models/journal.py): every
+        # admission / synced emission / preemption / finish appends a
+        # CRC-guarded record, and recover() replays it after a crash.
+        # journal=None reads MXNET_SERVING_JOURNAL_DIR (a NAMED replica
+        # journals into a per-replica subdirectory, so an in-process
+        # fleet's segments never collide); journal=False is off even
+        # with the env set (the router journals for its fleet instead);
+        # a str is a directory; a RequestJournal is used as-is. Off is
+        # one guarded branch per hook — dispatch count and numerics are
+        # bit-identical with the journal unset (tested).
+        if journal is None:
+            jd = _fastenv.get("MXNET_SERVING_JOURNAL_DIR")
+            if jd and name is not None:
+                jd = os.path.join(jd, name)
+            journal = _journal.RequestJournal(jd) if jd else False
+        elif isinstance(journal, str):
+            journal = _journal.RequestJournal(journal)
+        self._journal = journal or None
+        # idempotency dedup window: key -> live rid, and key ->
+        # (rid, final tokens) once finished; a duplicate submit returns
+        # the ORIGINAL rid (serving.dedup_hits counts them) and a
+        # finished duplicate re-delivers through _pending_finished
+        self._idem = {}
+        self._idem_done = {}
+        # results to deliver at the next step() without a dispatch:
+        # dedup re-deliveries and streams drained by swap_weights()
+        self._pending_finished = {}
 
     # ---- admission ----
 
@@ -1228,10 +1260,7 @@ class ContinuousBatcher(object):
         ``serving.weight_version`` gauge (the id as an integer —
         < 2^32, exact in a float64) for /healthz scrapers."""
         if self._weight_fp is None:
-            from .checkpoint import _flatten
-            flat = {}
-            _flatten(self.params, "p", flat)
-            self._weight_fp = _integrity.tree_fingerprint(flat)
+            self._weight_fp = _integrity.params_fingerprint(self.params)
             if _obs.enabled():
                 _obs.gauge("serving.weight_version").set(
                     int(self._weight_fp, 16))
@@ -1250,7 +1279,13 @@ class ContinuousBatcher(object):
             "serving.lane_utilization": active / float(self.max_batch),
             "serving.slo_attainment": _slo.attainment(),
             "serving.weight_fingerprint": self.weight_fingerprint,
+            "serving.weight_version": int(self.weight_fingerprint, 16),
         }
+        if self._journal is not None:
+            snap["serving.journal_depth_bytes"] = \
+                self._journal.depth_bytes
+            snap["serving.journal_lag_records"] = \
+                self._journal.lag_records
         if self.paged:
             usable = self.num_blocks - 1
             snap["serving.kv_free_blocks"] = self._alloc.free_blocks
@@ -1557,7 +1592,7 @@ class ContinuousBatcher(object):
                     jnp.int32(bid))
 
     def admit(self, prompt, n_new, seed=0, stop_token=None,
-              enqueued_ns=None, priority=0):
+              enqueued_ns=None, priority=0, key=None):
         """Prefill `prompt` into a free slot; returns the request id,
         or None when every slot is busy. The first generated token is
         produced here (from the prefill logits), so a request with
@@ -1575,9 +1610,32 @@ class ContinuousBatcher(object):
         lowest-priority strictly-below-`priority` lane is evicted to
         ``self.preempted`` (its synced prefix captured for a bit-exact
         resume via admit_continuation()) and its blocks fund this
-        admission. With uniform priorities nothing is ever preempted."""
+        admission. With uniform priorities nothing is ever preempted.
+        `key` is an optional IDEMPOTENCY key: a duplicate submission
+        (same key, this batcher's dedup window) returns the ORIGINAL
+        request's rid instead of double-admitting — still live, the
+        caller keeps consuming its stream; already finished, the
+        recorded result is re-delivered by the next step(). Dedup hits
+        count ``serving.dedup_hits``; with a journal attached the
+        window survives restarts (recover() repopulates it)."""
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
+        if key is not None:
+            hit = self._idem.get(key)
+            if hit is None and key in self._idem_done:
+                rid0, toks0 = self._idem_done[key]
+                self._pending_finished[rid0] = list(toks0)
+                hit = rid0
+            if hit is not None:
+                _obs.counter("serving.dedup_hits").add(1)
+                if _obs.enabled():
+                    _obs.record_instant(
+                        "serving.dedup", cat="serving",
+                        args={"rid": hit, "key": str(key)})
+                return hit
+        # the sampling path below rebinds `key` to the PRNG chain —
+        # keep the idempotency key under its own name past that point
+        idem_key = key
         obs_on = _obs.enabled()
         t0_ns = time.perf_counter_ns() if obs_on else None
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -1705,19 +1763,28 @@ class ContinuousBatcher(object):
             self._spec_admit(slot, prompt, t_p, first)
         pre_span.stop()
         req = Request(rid, prompt, n_new, stop_token, seed=seed,
-                      priority=priority)
+                      priority=priority, key=idem_key)
         self._next_rid += 1
         req.tokens.append(first)
         req.emitted = 1
         self._slots[slot] = req
         self._round_admits += 1
+        if idem_key is not None:
+            self._idem[idem_key] = req.rid
+        if self._journal is not None:
+            # the submit record carries the first token (emitted=1):
+            # replay resumes as a continuation from exactly here
+            self._journal.append_submit(
+                req.rid, req.tokens, n_new, seed=seed,
+                stop_token=stop_token, priority=priority,
+                key=idem_key, emitted=1)
         if obs_on:
             self._note_admit(req, slot, t0_ns, enqueued_ns)
         return req.rid
 
     def admit_continuation(self, tokens, n_more, seed=0, emitted=1,
                            stop_token=None, priority=0,
-                           preempted_ns=None):
+                           preempted_ns=None, resumes=None, key=None):
         """Resume a suspended stream BIT-exactly: `tokens` is the full
         synced history (prompt + `emitted` generated tokens), `n_more`
         the remaining budget. The cache is re-prefilled over
@@ -1730,7 +1797,11 @@ class ContinuousBatcher(object):
         requeue path keeps its coarser reseed contract). Returns the
         NEW request id, or None when no lane/blocks are free.
         `preempted_ns` (perf_counter_ns of the preemption) feeds the
-        serving.preempt_stall_ms histogram."""
+        serving.preempt_stall_ms histogram. `resumes` names the
+        journaled rid this continuation supersedes (the park record's
+        owner): with a journal attached the old rid is tombstoned
+        (reason ``resume``) so a later replay resumes the NEW record
+        only. `key` carries the original idempotency key forward."""
         if n_more < 1:
             raise ValueError("n_more must be >= 1")
         if emitted < 1:
@@ -1794,11 +1865,20 @@ class ContinuousBatcher(object):
             self._spec_admit(slot, ctx, m, last)
         pre_span.stop()
         req = Request(rid, tokens, emitted + n_more, stop_token,
-                      seed=seed, priority=priority)
+                      seed=seed, priority=priority, key=key)
         req.emitted = emitted
         self._next_rid += 1
         self._slots[slot] = req
         self._round_admits += 1
+        if key is not None:
+            self._idem[key] = req.rid
+        if self._journal is not None:
+            if resumes is not None:
+                self._journal.append_finish(resumes, "resume")
+            self._journal.append_submit(
+                req.rid, req.tokens, req.n_new, seed=seed,
+                stop_token=stop_token, priority=priority, key=key,
+                emitted=emitted)
         if obs_on:
             t1 = time.perf_counter_ns()
             req.t_admit_ns = req.t_first_ns = req.t_last_ns = t1
@@ -1867,6 +1947,9 @@ class ContinuousBatcher(object):
                           "priority": req.priority,
                           "for_priority": priority,
                           "synced": req.emitted})
+            if self._journal is not None:
+                self._journal.append_park(req.rid, req.tokens,
+                                          req.emitted)
             self._free(i)
             self.preempted.append((req, t_ns))
         return self._alloc.available >= demand
@@ -1909,6 +1992,9 @@ class ContinuousBatcher(object):
                           "priority": req.priority,
                           "reason": "kv_shrink",
                           "synced": req.emitted})
+            if self._journal is not None:
+                self._journal.append_park(req.rid, req.tokens,
+                                          req.emitted)
             self._free(i)
             self.preempted.append((req, t_ns))
         if parked and _obs.enabled():
@@ -2090,6 +2176,11 @@ class ContinuousBatcher(object):
             return self._step_pipelined()
         obs_on = _obs.enabled()
         finished = {}
+        if self._pending_finished:
+            # re-delivery of deduped already-finished streams (recover
+            # and idempotency hits) rides the next step's return
+            finished.update(self._pending_finished)
+            self._pending_finished.clear()
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
@@ -2097,6 +2188,7 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 if obs_on:
                     self._note_finish(req)
+                self._note_done(req)
                 self._free(i)
         if not any(s is not None for s in self._slots):
             self._end_round()
@@ -2162,6 +2254,10 @@ class ContinuousBatcher(object):
                 if req.done:
                     break
             grew = req.emitted - grew
+            if self._journal is not None and grew:
+                self._journal.append_emit(
+                    req.rid, req.tokens[len(req.tokens) - grew:],
+                    req.emitted)
             # the device advanced every lane k steps regardless of
             # where its request ended; mirror that here so a
             # CONTINUING lane's next chunk starts from the device's
@@ -2174,6 +2270,7 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 if t_sync is not None:
                     self._note_finish(req, t_sync)
+                self._note_done(req)
                 self._free(i)
         if obs_on:
             self._publish_occupancy()
@@ -2190,7 +2287,14 @@ class ContinuousBatcher(object):
             self._brownout_tick()
         if self._debug:
             self._debug_idle_check()
+        if self._journal is not None:
+            self._journal.maybe_gc()
         if _obs.enabled():
+            if self._journal is not None:
+                _obs.gauge("serving.journal_depth_bytes").set(
+                    self._journal.depth_bytes)
+                _obs.gauge("serving.journal_lag_records").set(
+                    self._journal.lag_records)
             from .. import storage as _storage
             _storage.maybe_publish_device_memory_gauges()
 
@@ -2206,6 +2310,11 @@ class ContinuousBatcher(object):
         the chip sits behind a network tunnel (docs/SERVING.md)."""
         obs_on = _obs.enabled()
         finished = {}
+        if self._pending_finished:
+            # re-delivery of deduped already-finished streams (recover
+            # and idempotency hits) rides the next step's return
+            finished.update(self._pending_finished)
+            self._pending_finished.clear()
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
@@ -2213,6 +2322,7 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 if obs_on:
                     self._note_finish(req)
+                self._note_done(req)
                 self._free(i)
         while (len(self._inflight) < self.pipeline_depth
                and any(s is not None for s in self._slots)):
@@ -2303,12 +2413,17 @@ class ContinuousBatcher(object):
                 req.emitted += 1
                 if req.done:
                     break
+            if self._journal is not None and req.emitted > grew:
+                self._journal.append_emit(
+                    req.rid, req.tokens[grew - req.emitted:],
+                    req.emitted)
             if t_sync is not None:
                 self._note_progress(req, i, req.emitted - grew, t_sync)
             if req.done:
                 finished[req.rid] = list(req.tokens)
                 if t_sync is not None:
                     self._note_finish(req, t_sync)
+                self._note_done(req)
                 self._free(i)
         if obs_on:
             self._publish_occupancy()
@@ -2325,6 +2440,11 @@ class ContinuousBatcher(object):
         speculation only makes the raggedness data-dependent."""
         obs_on = _obs.enabled()
         finished = {}
+        if self._pending_finished:
+            # re-delivery of deduped already-finished streams (recover
+            # and idempotency hits) rides the next step's return
+            finished.update(self._pending_finished)
+            self._pending_finished.clear()
         # retire requests already complete at admission (n_new=1, or a
         # stop token straight out of the prefill logits)
         for i, req in enumerate(self._slots):
@@ -2332,6 +2452,7 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 if obs_on:
                     self._note_finish(req)
+                self._note_done(req)
                 self._free(i)
         while (len(self._inflight) < self.pipeline_depth
                and any(s is not None for s in self._slots)):
@@ -2471,6 +2592,10 @@ class ContinuousBatcher(object):
                         break
                 if req.done:
                     break
+            if self._journal is not None and req.emitted > grew0:
+                self._journal.append_emit(
+                    req.rid, req.tokens[grew0 - req.emitted:],
+                    req.emitted)
             if self.spec_accept_floor > 0.0:
                 # per-lane adaptive k: measured acceptance under the
                 # floor shrinks the draft width (never below 1 — one
@@ -2488,6 +2613,7 @@ class ContinuousBatcher(object):
                 finished[req.rid] = list(req.tokens)
                 if t_sync is not None:
                     self._note_finish(req, t_sync)
+                self._note_done(req)
                 self._free(i)
         if self.paged:
             self._reconcile_sched_pos(emits, lanes)
@@ -2741,6 +2867,191 @@ class ContinuousBatcher(object):
                              args={"rid": req.rid, "lane": slot,
                                    "requeued": True})
 
+    # ---- durability: crash recovery + weight hot-swap ----
+
+    def recover(self):
+        """Replay the attached journal after a process crash and
+        re-enter every request it recorded.
+
+        Finished requests (tombstone reason ``finish``, or a live
+        record whose stream was already complete when the process
+        died) are served from their recorded emissions — staged into
+        the next step()'s return — and repopulate the idempotency
+        window, so a client's re-submit dedups instead of recomputing.
+        Live requests re-enter as continuations from their journaled
+        synced prefix and resume BIT-exactly (greedy and sampled: the
+        submit record carries the sampling seed and the synced count,
+        and ``_resume_key`` replays the key chain). A live record that
+        does not fit the current pool is parked on ``self.preempted``
+        exactly like a PR 14 preemption victim — run()/the router
+        resumes it when a lane frees.
+
+        Returns ``(resumed, finished, skipped)``: old rid -> new rid
+        (None = parked), rid -> final tokens, and the journal's
+        skipped-record evidence (torn tail, CRC mismatch — each
+        ``{"segment", "record", "reason"}``)."""
+        if self._journal is None:
+            raise RuntimeError(
+                "recover() needs a journal attached "
+                "(MXNET_SERVING_JOURNAL_DIR or journal=)")
+        live, fin, skipped = self._journal.replay()
+        # fresh-process rids must not collide with journaled ones: a
+        # replayed fin for rid N must never tombstone a NEW request
+        self._next_rid = max(self._next_rid,
+                             self._journal.max_rid + 1)
+        done = {}
+        for rid, rec in fin.items():
+            done[rid] = list(rec["tokens"])
+            if rec.get("key") is not None:
+                self._idem_done[rec["key"]] = (rid, list(rec["tokens"]))
+        resumed = {}
+        for rid in sorted(live):
+            rec = live[rid]
+            toks = list(rec["tokens"])
+            emitted = int(rec["emitted"])
+            n_more = int(rec["n_new"]) - emitted
+            stop = rec["stop"]
+            if emitted >= 1 and (n_more <= 0 or
+                                 (stop is not None and toks
+                                  and toks[-1] == stop)):
+                # crashed after the final emission landed but before
+                # the fin record did: the stream is complete — serve
+                # it and write the tombstone now
+                done[rid] = list(toks)
+                if rec.get("key") is not None:
+                    self._idem_done[rec["key"]] = (rid, list(toks))
+                self._journal.append_finish(rid, "finish", tokens=toks)
+                continue
+            if emitted == 0:
+                # never emitted (a router-side queue record): a fresh
+                # admission replays the whole prompt
+                new = self.admit(toks, rec["n_new"], seed=rec["seed"],
+                                 stop_token=stop,
+                                 priority=rec["prio"],
+                                 key=rec.get("key"))
+                if new is not None:
+                    self._journal.append_finish(rid, "resume")
+                resumed[rid] = new
+                continue
+            new = self.admit_continuation(
+                toks, n_more, seed=rec["seed"], emitted=emitted,
+                stop_token=stop, priority=rec["prio"],
+                resumes=rid, key=rec.get("key"))
+            if new is None:
+                # capacity-blocked: park it like a preemption victim
+                # (its journal record stays live, so a second crash
+                # before it resumes still recovers it)
+                req = Request(rid, toks, rec["n_new"], stop,
+                              seed=rec["seed"],
+                              priority=rec["prio"],
+                              key=rec.get("key"))
+                req.emitted = emitted
+                self.preempted.append((req, time.perf_counter_ns()))
+            resumed[rid] = new
+        self._pending_finished.update(done)
+        if _obs.enabled():
+            _obs.counter("serving.journal_recoveries").add(1)
+            _obs.record_instant(
+                "serving.recover", cat="serving",
+                args={"resumed": len(resumed), "finished": len(done),
+                      "skipped": len(skipped)})
+        return resumed, done, skipped
+
+    def swap_weights(self, params, manifest=None):
+        """Hot-swap the served weights without dropping a request.
+
+        ``manifest`` gates the swap on PR 13's lineage machinery:
+        a checkpoint-directory path runs ``verify_lineage`` (the
+        newest retained manifest must verify) and reads its
+        ``param_fingerprint``; a manifest dict supplies the
+        fingerprint directly; None skips verification (rollback to an
+        already-served params object). The incoming tree's recomputed
+        fingerprint must MATCH — mismatched weights raise
+        ``CheckpointCorrupt`` and the old params keep serving.
+
+        HBM preflight (PR 14 membudget): old + new params are resident
+        together during the swap; when that does not fit the budget the
+        swap degrades to drain-then-swap (the old reference is dropped
+        at the quiesce point before the new one is installed —
+        ``mode="drain"`` in the result).
+
+        The swap quiesces at a dispatch boundary: in-flight chunks are
+        synced (their emissions deliver through the next step()), live
+        lanes are captured, device state is rebuilt against the new
+        params, and every live request re-enters through ``_readmit``
+        — same continuation identity as the dispatch-failure requeue,
+        so streams continue under the new weights with their synced
+        prefixes intact. Returns ``{"fingerprint", "previous",
+        "mode"}``."""
+        from . import checkpoint as _ckpt
+        want = None
+        if isinstance(manifest, str):
+            chain = _ckpt.verify_lineage(manifest)
+            if not chain or chain[0]["status"] != "verified":
+                raise _ckpt.CheckpointCorrupt(
+                    "swap_weights: lineage of %s does not verify (%s)"
+                    % (manifest,
+                       chain[0]["status"] if chain else "no manifests"))
+            with open(os.path.join(manifest, chain[0]["name"])) as f:
+                want = json.load(f).get("param_fingerprint")
+        elif isinstance(manifest, dict):
+            want = manifest.get("param_fingerprint")
+        new_fp = _integrity.params_fingerprint(params)
+        if want is not None and new_fp != want:
+            raise _ckpt.CheckpointCorrupt(
+                "swap_weights: incoming parameter fingerprint %s does "
+                "not match manifest %s — refusing unverified weights"
+                % (new_fp, want))
+        if _chaos.enabled():
+            _chaos.fire("serving.swap", fingerprint=new_fp)
+        mode = "resident"
+        if _membudget.enabled():
+            try:
+                ok = _membudget.preflight_bytes(
+                    "serving.swap", _membudget.tree_nbytes(params),
+                    signature=new_fp)
+            except _membudget.MemoryBudgetExceeded:
+                ok = False
+            if not ok:
+                mode = "drain"
+        prev_fp = self.weight_fingerprint
+        # quiesce: sync every in-flight dispatch so no chunk computed
+        # under the old weights lands after the swap (its emissions
+        # deliver through _pending_finished at the next step())
+        inflight = getattr(self, "_inflight", None)
+        if inflight:
+            sync = (self._sync_oldest_spec if self._spec_on
+                    else self._sync_oldest)
+            while inflight:
+                self._pending_finished.update(sync())
+        pending = [r for r in self._slots if r is not None]
+        if mode == "drain":
+            # drop the old reference before materializing against the
+            # new one — the degraded path for budgets that cannot hold
+            # both trees resident
+            self.params = None
+        self.params = params
+        self._weight_fp = None
+        if pending or self.paged or self._device_carry:
+            # the cache/pool holds K/V computed under the OLD weights:
+            # rebuild from scratch and re-prefill every live request
+            # under the new ones (same path as the dispatch-failure
+            # requeue)
+            self._rebuild_state()
+            for req in pending:
+                self._readmit(req)
+        else:
+            self._prefix_cache.clear()
+        new_fp = self.weight_fingerprint
+        _obs.counter("serving.weight_swaps").add(1)
+        if _obs.enabled():
+            _obs.record_instant(
+                "serving.swap", cat="serving",
+                args={"fingerprint": new_fp, "previous": prev_fp,
+                      "mode": mode, "live": len(pending)})
+        return {"fingerprint": new_fp, "previous": prev_fp,
+                "mode": mode}
+
     def cancel(self, rid):
         """Evict a request mid-decode (client disconnect, timeout):
         frees its slot immediately for the next admission. Returns the
@@ -2755,6 +3066,7 @@ class ContinuousBatcher(object):
                 out = list(req.tokens)
                 if _obs.enabled():
                     self._note_finish(req, evicted=True)
+                self._note_done(req, reason="cancel")
                 self._free(i)
                 return out
         return None
@@ -2884,6 +3196,23 @@ class ContinuousBatcher(object):
         if _slo.active():
             _slo.request_complete(not req.slo_bad)
 
+    def _note_done(self, req, reason="finish"):
+        """Terminal bookkeeping every finish site runs UNCONDITIONALLY
+        (unlike the _obs-gated _note_finish): releases the request's
+        idempotency claim — promoting a normally-finished one into the
+        dedup window so a duplicate submit re-delivers its tokens —
+        and writes the journal tombstone that lets GC truncate its
+        segment."""
+        if req.key is not None:
+            if self._idem.get(req.key) == req.rid:
+                self._idem.pop(req.key, None)
+            if reason == "finish":
+                self._idem_done[req.key] = (req.rid, list(req.tokens))
+        if self._journal is not None:
+            self._journal.append_finish(
+                req.rid, reason,
+                tokens=req.tokens if reason == "finish" else None)
+
     def _publish_occupancy(self):
         """Lane and KV-cache utilization gauges — the per-replica load
         signal the ROADMAP-1 router reads off the scrape endpoint."""
@@ -2940,7 +3269,8 @@ class ContinuousBatcher(object):
                 rid = self.admit_continuation(
                     req.tokens, req.n_new - req.emitted, seed=req.seed,
                     emitted=req.emitted, stop_token=req.stop_token,
-                    priority=req.priority, preempted_ns=t_ns)
+                    priority=req.priority, preempted_ns=t_ns,
+                    resumes=req.rid, key=req.key)
                 if rid is None:
                     if not self.active_count:
                         raise RuntimeError(

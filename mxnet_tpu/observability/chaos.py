@@ -80,6 +80,15 @@ Fault semantics at a site:
   CPU mesh. Sites: ``trainer.step``, ``serving.dispatch``,
   ``kv.pool.grow``, ``checkpoint.snapshot``.
 
+Durable-serving sites (the PR 15 surface): ``journal.append`` fires
+before every WAL record lands (``crash`` here is the kill-at-
+commit-point torture; ``bitflip`` via ``corrupt_file`` rots a record
+at rest), ``journal.replay`` fires per segment during recovery scan,
+``serving.swap`` fires inside ``swap_weights`` after the lineage gate,
+and ``router.rollout`` fires at each rolling-upgrade step (swap and
+canary phases — ``error`` at the canary phase is the lying-canary
+fault the auto-rollback is proven against).
+
 ``stats`` is the always-on cheap view (the ``kv.dispatch_stats``
 pattern); with ``MXNET_OBS=1`` every firing also lands a
 ``chaos.inject`` instant + ``chaos.injected``/``chaos.<fault>``

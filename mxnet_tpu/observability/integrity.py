@@ -234,6 +234,18 @@ def tree_fingerprint(flat):
     return "%08x" % acc
 
 
+def params_fingerprint(params):
+    """Fingerprint of a raw parameter pytree, flattened exactly the way
+    checkpoint manifests flatten it — so this id compares equal to the
+    manifest's ``param_fingerprint`` for the same weights. Serving's
+    ``weight_fingerprint`` and the hot-swap lineage gate both resolve
+    through here."""
+    from ..models import checkpoint as _ckpt
+    flat = {}
+    _ckpt._flatten(params, _ckpt._PARAMS, flat)
+    return tree_fingerprint(flat)
+
+
 # ------------------------------------------------ parameter lane plans --
 
 _plan_cache = {}

@@ -369,6 +369,24 @@ def preflight_bytes(origin, nbytes, signature=None):
     return True
 
 
+def tree_nbytes(tree):
+    """Total payload bytes of every leaf in a pytree — the direct-bytes
+    cost a weight hot-swap must preflight (the incoming params are
+    resident alongside the old set until the swap commits)."""
+    import numpy as np
+    from jax import tree_util
+    total = 0
+    for leaf in tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * (np.dtype(dtype).itemsize if dtype is not None
+                      else 8)
+    return int(total)
+
+
 # ------------------------------------------------------- OOM taxonomy --
 
 def is_resource_exhausted(exc):

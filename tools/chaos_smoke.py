@@ -26,7 +26,7 @@ intact, stream bit-exact, checkpoint loadable, resume bit-exact):
            relaunch via resume_from_latest: the concatenated loss
            trajectory is bit-exact (float hex) vs an uninterrupted run
 
-Four scenarios run as their own tier-1 lane invocations:
+Five scenarios run as their own tier-1 lane invocations:
 ``--elastic`` (the 2-process shrink/regrow chain), ``--overload``
 (the ISSUE 12 serving overload storm: mixed-priority burst at ~4x
 block capacity, one replica chaos-killed mid-storm, recovery through
@@ -38,7 +38,13 @@ from a verified state), and ``--oom`` (the ISSUE 14 memory-pressure
 closure: one injected RESOURCE_EXHAUSTED per recovery path —
 trainer accum re-lower with the global-batch trajectory preserved,
 serving pool shrink-and-retry with bit-exact streams, pool-grow
-degradation, checkpoint snapshot serial retry — no process death).
+degradation, checkpoint snapshot serial retry — no process death),
+and ``--durable`` (the ISSUE 15 durable-serving closure: a kill -9 at
+a journal commit point replayed bit-exactly by ``recover()``, torn and
+CRC-corrupt records skipped with named evidence, a chaos-failed canary
+rolling the fleet back to the prior verified fingerprint with zero
+dropped requests, and a lineage-gated hot-swap refusing unverified
+weights).
 """
 
 import argparse
@@ -1098,6 +1104,232 @@ def mem_pressure():
     return 0
 
 
+_DURABLE_JOBS = [([1, 2, 3], 6, 0), ([4, 5], 6, 1), ([7, 8, 9], 6, 2)]
+_DURABLE_MODES = {
+    # paged x spec x pipeline greedy, and paged x pipeline sampled —
+    # the ISSUE 15 recovery matrix's two hardest columns
+    "spec_greedy": dict(paged=True, block_size=4, num_blocks=24,
+                        pipeline_depth=2, spec_k=2, spec_ngram=2,
+                        greedy=True),
+    "pipe_sampled": dict(paged=True, block_size=4, num_blocks=24,
+                         pipeline_depth=2, greedy=False),
+}
+
+
+def durable_worker(jdir, mode):
+    """Subprocess body for the kill-9 leg: serve the fixed job set with
+    the journal attached; the parent's MXNET_CHAOS spec hard-kills us
+    mid-emission at a journal commit point (exit code 9)."""
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    cfg = _tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+    srv = ContinuousBatcher(params, cfg, max_batch=4, journal=jdir,
+                            **_DURABLE_MODES[mode])
+    for prompt, n_new, seed in _DURABLE_JOBS:
+        srv.admit(prompt, n_new, seed=seed)
+    done = {}
+    for _ in range(300):
+        done.update(srv.step())
+        if len(done) == len(_DURABLE_JOBS):
+            return 0               # chaos never fired — parent fails rc
+    return 0
+
+
+def durable():
+    """The ISSUE 15 durable-serving closure, four legs:
+
+      kill-9 replay   (subprocess x2) a journal-commit-point hard kill
+                      (exit 9, no cleanup) under paged x spec x
+                      pipeline greedy AND paged x pipeline sampled; a
+                      fresh batcher's recover() replays every stream
+                      BIT-exactly vs an uninterrupted run
+      torn/corrupt    a torn tail and a CRC-flipped record are skipped
+                      with named evidence; the records behind them
+                      still replay
+      canary rollback (fleet) an injected ``router.rollout`` fault at
+                      the canary phase rolls every replica back to the
+                      prior verified fingerprint with ZERO dropped
+                      in-flight requests
+      lineage gate    a hot-swap whose manifest fingerprint does not
+                      match the incoming weights is refused before any
+                      replica is touched
+    """
+    import tempfile
+
+    from mxnet_tpu.models import transformer as T
+    from mxnet_tpu.models import checkpoint as ck
+    from mxnet_tpu.models.journal import RequestJournal
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.models.router import ReplicaRouter
+    from mxnet_tpu.observability import chaos
+
+    chaos.reset()
+    cfg = _tiny_cfg()
+    params = T.init_params(cfg, seed=0)
+
+    # ---- kill-9 replay, both matrix columns ----
+    for mode in ("spec_greedy", "pipe_sampled"):
+        ref_srv = ContinuousBatcher(params, cfg, max_batch=4,
+                                    journal=False,
+                                    **_DURABLE_MODES[mode])
+        ref, order = ref_srv.run(
+            [(p, n, s) for p, n, s in _DURABLE_JOBS])
+        ref = {rid: ref[rid] for rid in order}
+        with tempfile.TemporaryDirectory() as td:
+            env = dict(os.environ)
+            env.pop("MXNET_SERVING_JOURNAL_DIR", None)
+            env.update({
+                "CHAOS_SMOKE_WORKER": "durable_serve",
+                # each record is TWO rule matches (the pre-write fire
+                # + the at-rest corrupt_file hook): at=8 is the
+                # pre-write fire of the 5th record — after all three
+                # submits and one emission checkpoint landed
+                "MXNET_CHAOS": "journal.append:crash:at=8:code=9",
+                "JAX_PLATFORMS": "cpu", "MXNET_OBS": "1"})
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), td, mode],
+                env=env, cwd=ROOT, capture_output=True, text=True,
+                timeout=600)
+            if proc.returncode != 9:
+                print("[chaos_smoke] FAIL(durable/%s): worker exited "
+                      "%d, wanted the injected kill (9)\n%s" % (
+                          mode, proc.returncode, proc.stderr[-2000:]))
+                return 1
+            srv = ContinuousBatcher(params, cfg, max_batch=4,
+                                    journal=td,
+                                    **_DURABLE_MODES[mode])
+            resumed, rdone, skipped = srv.recover()
+            if skipped:
+                print("[chaos_smoke] FAIL(durable/%s): clean journal "
+                      "replay skipped records: %s" % (mode, skipped))
+                return 1
+            if not resumed and len(rdone) != len(_DURABLE_JOBS):
+                print("[chaos_smoke] FAIL(durable/%s): nothing to "
+                      "recover — the kill landed too late" % mode)
+                return 1
+            got = dict(rdone)
+            new2old = {v: k for k, v in resumed.items()
+                       if v is not None}
+            parked = [k for k, v in resumed.items() if v is None]
+            for _ in range(400):
+                while srv.preempted and parked:
+                    req, _t = srv.preempted.pop(0)
+                    new = srv.admit_continuation(
+                        req.tokens, req.n_new - req.emitted,
+                        seed=req.seed, emitted=req.emitted,
+                        stop_token=req.stop_token, resumes=req.rid,
+                        key=req.key)
+                    if new is None:
+                        srv.preempted.insert(0, (req, _t))
+                        break
+                    new2old[new] = req.rid
+                    parked.remove(req.rid)
+                if not parked and all(
+                        n in got or o in got
+                        for n, o in new2old.items()):
+                    break
+                for rid, toks in srv.step().items():
+                    got[new2old.get(rid, rid)] = toks
+            for i, rid in enumerate(sorted(ref)):
+                if got.get(rid) != ref[rid]:
+                    print("[chaos_smoke] FAIL(durable/%s): stream %d "
+                          "diverged after kill-9 replay: %s vs %s"
+                          % (mode, i, got.get(rid), ref[rid]))
+                    return 1
+            srv.check_invariants(quiesce=True)
+
+    # ---- torn tail + CRC flip: skipped with evidence, rest replay --
+    with tempfile.TemporaryDirectory() as td:
+        j = RequestJournal(td)
+        j.append_submit(0, [1, 2, 3, 9], 6, seed=0, emitted=1)
+        j.append_submit(1, [4, 5, 8], 6, seed=1, emitted=1)
+        j.append_emit(0, [7], 2)
+        j.close()
+        seg = sorted(n for n in os.listdir(td)
+                     if n.endswith(".wal"))[0]
+        path = os.path.join(td, seg)
+        with open(path, "rb") as f:
+            lines = f.read().split(b"\n")
+        # flip one payload byte of record 1 (rid 1's submit): CRC
+        # mismatch; then a torn tail with no record terminator
+        bad = bytearray(lines[1])
+        bad[-1] ^= 0x01
+        lines[1] = bytes(bad)
+        with open(path, "wb") as f:
+            f.write(b"\n".join(lines[:3]) + b"\n")
+            f.write(b"deadbeef {\"t\": \"submit\", \"rid\": 2")
+        live, fin, skipped = RequestJournal(td).replay()
+        reasons = sorted(s["reason"].split(" ")[0] for s in skipped)
+        if reasons != ["crc", "torn"]:
+            print("[chaos_smoke] FAIL(durable/torn): wanted crc+torn "
+                  "evidence, got %s" % skipped)
+            return 1
+        if sorted(live) != [0] or live[0]["tokens"] != [1, 2, 3, 9, 7]:
+            print("[chaos_smoke] FAIL(durable/torn): surviving "
+                  "records did not replay: %s" % live)
+            return 1
+
+    # ---- chaos-failed canary -> fleet rollback, zero dropped ----
+    import warnings
+    p1 = T.init_params(cfg, seed=1)
+    reps = [ContinuousBatcher(params, cfg, max_batch=4, journal=False)
+            for _ in range(2)]
+    router = ReplicaRouter(reps, journal=False)
+    fp0 = reps[0].weight_fingerprint
+    order = [router.submit([1, 2, 3], 6, seed=s) for s in range(5)]
+    router.step()
+    chaos.inject("router.rollout", "error", at=1)   # the canary fire
+    router.start_rollout(p1)
+    results = {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(500):
+            if not (router._queue or router._live
+                    or router.rollout_phase in ("draining", "canary")):
+                break
+            results.update(router.step())
+    chaos.reset()
+    if router.rollout_phase != "rolled_back":
+        print("[chaos_smoke] FAIL(durable/rollback): phase %s after "
+              "a chaos-failed canary" % router.rollout_phase)
+        return 1
+    if any(r.weight_fingerprint != fp0 for r in reps):
+        print("[chaos_smoke] FAIL(durable/rollback): fleet not "
+              "restored to the prior fingerprint %s: %s"
+              % (fp0, [r.weight_fingerprint for r in reps]))
+        return 1
+    dropped = [r for r in order
+               if r not in results or results[r] is None]
+    if dropped:
+        print("[chaos_smoke] FAIL(durable/rollback): %d in-flight "
+              "request(s) dropped across the rollback" % len(dropped))
+        return 1
+
+    # ---- lineage gate: a mismatched manifest refuses the swap ----
+    srv = ContinuousBatcher(params, cfg, max_batch=2, journal=False)
+    fp = srv.weight_fingerprint
+    try:
+        srv.swap_weights(p1, manifest={"param_fingerprint": "0" * 8})
+        print("[chaos_smoke] FAIL(durable/lineage): unverified swap "
+              "was accepted")
+        return 1
+    except ck.CheckpointCorrupt:
+        pass
+    if srv.weight_fingerprint != fp:
+        print("[chaos_smoke] FAIL(durable/lineage): refused swap "
+              "still changed the weights")
+        return 1
+
+    print("[chaos_smoke] durable OK: kill-9 at a journal commit point "
+          "replayed bit-exact (paged x spec x pipeline greedy, paged "
+          "x pipeline sampled), torn/CRC-corrupt records skipped "
+          "with named evidence, a chaos-failed canary rolled the "
+          "fleet back to the prior verified fingerprint with zero "
+          "dropped requests, and an unverified hot-swap was refused")
+    return 0
+
+
 SCENARIOS = [("nan", nan_guard), ("ioerror", ioerror),
              ("serving", serving), ("hang", hang),
              ("sigterm", sigterm), ("crash", crash)]
@@ -1125,8 +1357,16 @@ def main():
                         "accum re-lower, serving shrink-and-retry, "
                         "pool-grow degradation, checkpoint snapshot "
                         "retry; its own tier-1 lane invocation)")
+    p.add_argument("--durable", action="store_true",
+                   help="run the durable-serving e2e (kill-9 journal "
+                        "replay bit-exact, torn/CRC records skipped "
+                        "with evidence, chaos-failed canary fleet "
+                        "rollback with zero drops, lineage-gated "
+                        "hot-swap; its own tier-1 lane invocation)")
     args = p.parse_args()
     worker = os.environ.get("CHAOS_SMOKE_WORKER")
+    if worker == "durable_serve":
+        return durable_worker(args.args[0], args.args[1])
     if worker == "hang":
         return hang_worker(args.args[0])
     if worker == "train":
@@ -1143,6 +1383,11 @@ def main():
     if args.oom:
         if mem_pressure():
             print("[chaos_smoke] oom scenario FAILED")
+            return 1
+        return 0
+    if args.durable:
+        if durable():
+            print("[chaos_smoke] durable scenario FAILED")
             return 1
         return 0
     if args.elastic:

@@ -92,6 +92,10 @@ def worker_env(args, proc_id, world, generation):
         # factor; every later generation inherits it so the job does
         # not relapse into the same allocation it just died on
         env["MXNET_MEM_ACCUM_FACTOR"] = str(args._accum_factor)
+    if getattr(args, "serving_journal_dir", None):
+        # durable serving under supervision: each relaunched worker
+        # finds the SAME request journal and recover()s its streams
+        env["MXNET_SERVING_JOURNAL_DIR"] = args.serving_journal_dir
     env.setdefault("XLA_FLAGS",
                    "--xla_force_host_platform_device_count=1")
     if world > 1:
@@ -163,6 +167,12 @@ def main(argv=None):
                          "--chaos-generation's workers (replayable "
                          "one-shot fault injection)")
     ap.add_argument("--chaos-generation", type=int, default=0)
+    ap.add_argument("--serving-journal-dir", default=None,
+                    help="export MXNET_SERVING_JOURNAL_DIR to every "
+                         "worker generation: a serving worker that "
+                         "dies and relaunches replays its request "
+                         "journal (recover()) instead of dropping "
+                         "in-flight streams")
     ap.add_argument("--quarantine-cooldown", type=int, default=2,
                     help="generations a quarantined host is held out "
                          "of regrow (the cooldown list)")
