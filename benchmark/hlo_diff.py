@@ -20,6 +20,15 @@ docstring.
     python - < benchmark/hlo_diff.py                 # both legs, diff
     python - framework < benchmark/hlo_diff.py
     python - handbuilt < benchmark/hlo_diff.py
+    python - serving < benchmark/hlo_diff.py         # gather vs kernel
+
+The ``serving`` mode diffs the paged decode step with
+MXNET_PAGED_DECODE_PALLAS off (fused-XLA gather feeding the dense
+contraction) vs on (the kernels/paged_decode.py batched-lane Pallas
+kernel) at a small int8-KV GQA shape — so a byte-count regression in
+the gather path is attributable per opcode and per scope, and the
+kernel's custom-call shows up against the gather/dynamic-slice bytes
+it removes. Shape knobs: MXNET_HLO_SERVING_SLOTS / _MAXLEN / _DMODEL.
 
 Run from /root/repo via stdin so the repo root stays on sys.path.
 """
@@ -115,5 +124,64 @@ def main():
                 op, d / 1e9, fa[op][1], ha[op][1]))
 
 
+def serving():
+    """Kernel-off vs kernel-on serving HLO at one small paged shape.
+
+    Both programs are the REAL entry point (decode_step_paged under
+    jit, int8-KV + GQA + block tables); the only variable is the
+    MXNET_PAGED_DECODE_PALLAS flag at trace time. The diff row set is
+    what the serving_megakernel bench leg's GB/step numbers roll up
+    from, instruction by instruction."""
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.observability import hlo
+    from mxnet_tpu.models import transformer as tf
+
+    slots = int(os.environ.get("MXNET_HLO_SERVING_SLOTS", "8"))
+    max_len = int(os.environ.get("MXNET_HLO_SERVING_MAXLEN", "1024"))
+    d_model = int(os.environ.get("MXNET_HLO_SERVING_DMODEL", "256"))
+    block = 16
+    cfg = tf.TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_heads=8, n_kv_heads=2,
+        n_layers=2, d_ff=4 * d_model, max_len=max_len,
+        kv_cache_int8=True)
+    params = tf.init_params(cfg, seed=0)
+    pool = tf.init_paged_cache(cfg, slots * (max_len // block) + 1,
+                               block)
+    tables = jnp.zeros((slots, max_len // block), jnp.int32)
+    toks = jnp.zeros((slots,), jnp.int32)
+    pos = jnp.zeros((slots,), jnp.int32)
+
+    def lower(flag):
+        if flag:
+            os.environ["MXNET_PAGED_DECODE_PALLAS"] = "1"
+        else:
+            os.environ.pop("MXNET_PAGED_DECODE_PALLAS", None)
+        fn = jax.jit(lambda p, pl, tb, t, ps:
+                     tf.decode_step_paged(p, pl, tb, t, ps, cfg))
+        c = fn.lower(params, pool, tables, toks, pos).compile()
+        return hlo.parse_hlo(c.as_text())
+
+    print("serving decode HLO: slots=%d max_len=%d d_model=%d "
+          "int8_kv=on block=%d" % (slots, max_len, d_model, block))
+    ga, gt = summarize("gather (flag off)", lower(False))
+    ka, kt = summarize("kernel (flag on)", lower(True))
+    os.environ.pop("MXNET_PAGED_DECODE_PALLAS", None)
+    print("\n== diff (kernel - gather) ==")
+    print("  total: %+.3f GB" % ((kt - gt) / 1e9))
+    for op in sorted(set(ga) | set(ka),
+                     key=lambda o: -(ka[o][0] - ga[o][0])):
+        d = ka[op][0] - ga[op][0]
+        if abs(d) < 1e6:
+            continue
+        print("  %-24s %+8.3f GB  (x%d vs x%d)" % (
+            op, d / 1e9, ka[op][1], ga[op][1]))
+
+
 if __name__ == "__main__":
-    main()
+    if "serving" in sys.argv[1:]:
+        serving()
+    else:
+        main()

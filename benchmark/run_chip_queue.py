@@ -117,6 +117,19 @@ QUEUE = [
     ("serving_spec",
      {"stdin": "benchmark/serving_bench.py",
       "args": ["--spec-k", "4"]}, 1800, False),
+    # decode megakernel A/B: the paged x int8 x spec serving mix with
+    # MXNET_PAGED_DECODE_PALLAS off (fused-XLA gather, the 4075 tok/s
+    # incumbent) vs on (kernels/paged_decode.py batched-lane Pallas
+    # kernel) at bs {8,16} x T {1024,4096}. ACCEPTANCE BAR (ISSUE 16):
+    # the kernel arm beats dense-XLA tok/s at bs >= 8 on this mix, its
+    # attribution scopes (paged_decode_kernel / paged_verify_kernel)
+    # report bytes moved, and greedy streams are bit-exact between
+    # arms — the leg exits nonzero on divergence. Honest prior: the
+    # per-SEQUENCE flash-decode kernel LOST 841 vs 4075 (PERF.md r5);
+    # this one amortizes the grid over all lanes and skips dead blocks
+    ("serving_megakernel",
+     {"stdin": "benchmark/serving_bench.py",
+      "args": ["--megakernel"]}, 2400, False),
     # overload resilience (not a throughput leg): a mixed-priority
     # burst at ~4x the fleet's KV-block capacity over a 2-replica
     # router with breakers + brownout on, one replica chaos-killed

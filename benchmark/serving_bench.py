@@ -43,6 +43,15 @@ emulated at ~2x the compute cost, and that emulation tax drowns the
 host-side round-trip effect the A/B exists to measure (on TPU, where
 bf16 is native, the leg keeps the serving default dtype).
 
+With ``--megakernel`` it runs the decode-megakernel A/B instead: the
+same paged x int8-KV x speculative workload with
+MXNET_PAGED_DECODE_PALLAS off (fused-XLA gather) vs on (the batched-
+lane Pallas kernel, kernels/paged_decode.py), bs in {8, 16} x T in
+{1024, 4096}. Greedy streams are enforced bit-exact between arms (the
+leg exits nonzero otherwise); the row reports tokens/s per arm, the
+speedup, and GB/step with the kernel's own attribution-scope bytes
+broken out.
+
 With ``--spec-k K`` it runs the BATCHED speculative-decoding A/B
 instead: the same request pool through the plain batcher vs spec_k=K
 n-gram self-drafting, on two workloads — repetitive (templated
@@ -507,6 +516,163 @@ def paged_ab():
                           num_blocks=num_blocks,
                           paged_slots=paged_slots, backend=backend)
     _write_artifact(_json_arg(), [rep])
+
+
+def megakernel_ab():
+    """The decode-megakernel A/B (``--megakernel``): the SAME paged x
+    int8-KV x speculative workload through the ContinuousBatcher with
+    MXNET_PAGED_DECODE_PALLAS off (fused-XLA gather + dense
+    contraction, today's path) vs on (kernels/paged_decode.py batched-
+    lane Pallas kernel reading the pool through the tables). The
+    _serving_jit key includes the flag, so each arm compiles its own
+    programs — no cross-arm cache staleness.
+
+    ACCEPTANCE BAR (ISSUE 16): on chip the kernel arm must BEAT the
+    dense-XLA arm's tokens/s on the paged x int8 x spec mix at
+    bs >= 8 (configs below sweep bs in {8, 16} x T in {1024, 4096}),
+    and the attribution rows must report the kernel's bytes moved
+    (`paged_decode_kernel` / `paged_verify_kernel` scopes in the
+    GB/step column). Greedy streams are enforced BIT-EXACT between
+    arms — the leg exits nonzero on any stream mismatch, so a faster
+    wrong kernel can never post a number. The honest prior this kernel
+    answers: the per-sequence flash-decode kernel LOST its A/B 841 vs
+    4075 tok/s (PERF.md round 5); the gather-path bytes are what it
+    never attacked.
+    """
+    from benchmark.common import fetch_barrier  # noqa: F401  (parity)
+    from mxnet_tpu._discover import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import attribution
+
+    backend = jax.default_backend()
+    dtype = jnp.float32 if backend == "cpu" else jnp.bfloat16
+    if SMOKE:
+        configs = [(4, 128)]                   # (slots, max_len)
+        vocab, d_model, heads, layers = 8192, 32, 2, 1
+        t_prompt, n_jobs, spec_k, block_size = 16, 6, 2, 8
+    else:
+        configs = [(8, 1024), (8, 4096), (16, 1024), (16, 4096)]
+        vocab, d_model, heads, layers = 32000, 512, 8, 8
+        t_prompt, n_jobs, spec_k = 256, 24, 3
+        block_size = int(os.environ.get("MXNET_KV_BLOCK_SIZE", "16"))
+
+    def one_config(slots, max_len):
+        cfg = tf.TransformerConfig(
+            vocab_size=vocab, d_model=d_model, n_heads=heads,
+            n_layers=layers, d_ff=4 * d_model, max_len=max_len,
+            dtype=dtype, kv_cache_int8=True)
+        params = tf.init_params(cfg, seed=0)
+        num_blocks = slots * (max_len // block_size) + 1
+        jrng = np.random.RandomState(17)
+        jobs = []
+        for _ in range(n_jobs):
+            t_p = int(jrng.randint(max(2, t_prompt // 8), t_prompt))
+            n_new = int(jrng.randint(8, max(9, t_prompt // 2)))
+            jobs.append((list(jrng.randint(1, vocab, t_p)), n_new))
+        total_new = sum(n for _, n in jobs)
+
+        def run(collect=None):
+            srv = ContinuousBatcher(
+                params, cfg, max_batch=slots, paged=True,
+                block_size=block_size, num_blocks=num_blocks,
+                spec_k=spec_k)
+            waiting, arr_i, step_i = list(jobs), 0, 0
+            while waiting or srv.active_count:
+                while waiting and srv.has_capacity:
+                    p, n = waiting[0]
+                    if srv.admit(p, n) is None:
+                        break
+                    waiting.pop(0)
+                for rid, toks in srv.step().items():
+                    if collect is not None:
+                        collect[rid] = list(toks)
+                step_i += 1
+
+        def arm(on):
+            # trace-time flag: set BEFORE any dispatch compiles; the
+            # jit key carries it, so arms never share a program
+            if on:
+                os.environ["MXNET_PAGED_DECODE_PALLAS"] = "1"
+            else:
+                os.environ.pop("MXNET_PAGED_DECODE_PALLAS", None)
+            streams = {}
+            run(collect=streams)               # warm + stream capture
+            rate = _time_tokens(run, total_new)
+            # GB/step through the attribution scopes: lower the real
+            # serving entry points under this arm's flag and read the
+            # per-scope HBM rollup (the kernel arm's bytes land under
+            # paged_decode_kernel / paged_verify_kernel)
+            origin = "bench.megakernel.%s" % ("pallas" if on else
+                                              "dense")
+            pool = tf.init_paged_cache(cfg, num_blocks, block_size)
+            tables = jnp.zeros((slots, max_len // block_size),
+                               jnp.int32)
+            toks = jnp.zeros((slots,), jnp.int32)
+            pos = jnp.zeros((slots,), jnp.int32)
+            step_fn = jax.jit(lambda p, pl, tb, t, ps:
+                              tf.decode_step_paged(p, pl, tb, t, ps,
+                                                   cfg))
+            attribution.register_program(
+                origin, None, step_fn, (params, pool, tables, toks,
+                                        pos))
+            ana = attribution.program_analysis(origin) or {}
+            totals = ana.get("totals", {})
+            kscopes = {name: round(ent.get("hbm_bytes", 0) / 1e9, 4)
+                       for name, ent in ana.get("scopes", {}).items()
+                       if "paged_" in name and "_kernel" in name}
+            return streams, rate, {
+                "gb_per_step": round(totals.get("hbm_bytes", 0) / 1e9,
+                                     4),
+                "kernel_scope_gb": kscopes}
+
+        d_streams, d_rate, d_bytes = arm(False)
+        p_streams, p_rate, p_bytes = arm(True)
+        os.environ.pop("MXNET_PAGED_DECODE_PALLAS", None)
+        exact = d_streams == p_streams
+        row = {"leg": "serving_megakernel",
+               "slots": slots, "max_len": max_len,
+               "spec_k": spec_k, "block_size": block_size,
+               "int8_kv": True, "jobs": n_jobs,
+               "streams_bit_exact": exact,
+               "dense_tokens_per_s": round(d_rate, 1),
+               "pallas_tokens_per_s": round(p_rate, 1),
+               "speedup": round(p_rate / max(d_rate, 1e-9), 3),
+               "dense_gb_per_step": d_bytes["gb_per_step"],
+               "pallas_gb_per_step": p_bytes["gb_per_step"],
+               "pallas_kernel_scope_gb": p_bytes["kernel_scope_gb"],
+               "backend": backend}
+        print(json.dumps(row), flush=True)
+        if not exact:
+            bad = sorted(r for r in d_streams
+                         if d_streams[r] != p_streams.get(r))
+            print("megakernel A/B FAILED: greedy streams diverge "
+                  "between arms (requests %s) — a kernel that does "
+                  "not reproduce the dense path's tokens has no "
+                  "business posting a throughput number" % bad[:8],
+                  flush=True)
+            sys.exit(1)
+        return row
+
+    fmt = "%-14s %8s %10s %10s %8s"
+    print("serving megakernel A/B: backend=%s dtype=%s d_model=%d "
+          "layers=%d spec_k=%d block=%d int8_kv=on"
+          % (backend, np.dtype(dtype).name, d_model, layers, spec_k,
+             block_size), flush=True)
+    print(fmt % ("config", "dense", "pallas", "speedup", "exact"))
+    rows = []
+    for slots, max_len in configs:
+        r = one_config(slots, max_len)
+        rows.append(r)
+        print(fmt % ("bs%d/T%d" % (slots, max_len),
+                     "%.1f" % r["dense_tokens_per_s"],
+                     "%.1f" % r["pallas_tokens_per_s"],
+                     "%.3f" % r["speedup"],
+                     r["streams_bit_exact"]), flush=True)
+    _write_artifact(_json_arg(), rows)
 
 
 def overload_ab():
@@ -1156,6 +1322,8 @@ if __name__ == "__main__":
         spec_ab(_spec)
     elif "--paged" in sys.argv[1:]:
         paged_ab()
+    elif "--megakernel" in sys.argv[1:]:
+        megakernel_ab()
     elif "--overload" in sys.argv[1:]:
         overload_ab()
     elif "--mem-pressure" in sys.argv[1:]:

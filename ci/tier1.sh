@@ -31,6 +31,19 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "==== [tier1] paged megakernel lane (MXNET_PAGED_DECODE_PALLAS=1, interpret mode) ===="
+# the batched-lane Pallas decode/verify kernel must be a DROP-IN: the
+# kernel parity matrix plus the whole existing paged-serving contract
+# suite re-run with the flag forced on (streams bit-exact vs solo
+# generate(), spec/chunk/pipeline composition unchanged). Interpret
+# mode on CPU — the same kernel code the chip compiles.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu MXNET_PAGED_DECODE_PALLAS=1 \
+        python -m pytest tests/test_paged_kernel.py tests/test_serving_paged.py \
+            -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "[tier1] FAIL: paged megakernel lane"
+    exit 1
+fi
+
 echo "==== [tier1] dispatch-overhead smoke (benchmark/opperf.py --dispatch) ===="
 # serial, after the suite has fully exited; a wedged/slow ladder is a
 # real regression signal, not something to skip

@@ -9,6 +9,7 @@ interpret mode elsewhere, so their tests execute on any backend.
 
 from .flash_attention import (flash_attention, flash_decode,
                               dense_decode_with_lse)
+from .paged_decode import paged_attention
 
 __all__ = ["flash_attention", "flash_decode",
-           "dense_decode_with_lse"]
+           "dense_decode_with_lse", "paged_attention"]

@@ -32,27 +32,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
-
-# Row statistics (m, l, lse, delta) ride through HBM/VMEM with a
-# trailing lane dimension, every lane holding the same value. Mosaic
-# requires the last two dims of any block to be (8, 128)-divisible or
-# equal to the array dims; a [rows]-shaped stat with the batch dim
-# squeezed out of the block violates that, so [rows, 128] is the
-# lowerable layout (same choice as jax's reference TPU kernels). The
-# rule's "equal to the array dim" clause also admits [rows, 1] blocks
-# at 1/128th the stat HBM traffic (the dk/dv kernel re-streams lse and
-# delta once per q block) — env-overridable for the on-chip A/B
-# (benchmark/run_chip_queue.py flash_stat_lanes1 / train_lm_lanes1).
-_STAT_LANES = int(os.environ.get("MXNET_FLASH_STAT_LANES", "128"))
-
-
-def _causal_mask(s, q_start, k_start, block_q, block_k):
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 0)
-    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
-                                               (block_q, block_k), 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+# masking value, stat-lane layout, block clamp rule, and the per-shape
+# block_k choice cache are shared with kernels/paged_decode.py — one
+# source of truth for both kernel families (kernels/common.py)
+from .common import (NEG_INF as _NEG_INF, STAT_LANES as _STAT_LANES,
+                     causal_mask as _causal_mask, choose_block_k)
+from .common import adjust_block as _adjust_block_common
 
 
 # ------------------------------------------------------------- forward --
@@ -637,8 +622,12 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=None,
                          "heads %d" % (heads, kv_heads))
     g = heads // kv_heads
     if block_k is None:
-        block_k = next((bb for bb in (512, 256, 128)
-                        if t_max % bb == 0), t_max)
+        # memoized per shape (the choice is pure shape math, but it
+        # used to sit on the per-call path): largest of (512, 256, 128)
+        # dividing the cache length, else one full-length block — the
+        # same cache the paged decode kernel keys its block_k on
+        block_k = choose_block_k(
+            t_max, shape_key=("flash_decode", b, kv_heads, g, head_dim))
     block_k = min(block_k, t_max)
     if t_max % block_k:
         raise ValueError("block_k %d must divide the cache length %d"
@@ -655,32 +644,11 @@ def flash_decode_with_lse(q, k_cache, v_cache, lengths, block_k=None,
             lse[..., 0].reshape(b, heads))
 
 
-_MIN_BLOCK = 8          # below this the grid is degenerate, not tiled
-
-
 def _adjust_block(block, seq, name):
-    """Clamp ``block`` to ``seq`` and make it divide; refuse to let the
-    gcd collapse toward 1 (prime/odd T with a non-dividing block) —
-    that is a correct but pathologically fine grid of near-one-element
-    steps. Fall back to ONE full-sequence block and warn so an explicit
-    or env block choice that does not divide T is visible (ADVICE r5:
-    previously a silent degenerate grid)."""
-    import math
-    import warnings
-    adjusted = min(block, seq)
-    if seq % adjusted:
-        adjusted = math.gcd(seq, adjusted)
-    if adjusted < min(seq, _MIN_BLOCK):
-        warnings.warn(
-            "flash_attention: %s=%d does not divide sequence length %d "
-            "and the gcd adjustment collapses to %d (a degenerate "
-            "%d-step grid); falling back to a single full-sequence "
-            "block of %d. Pick a %s that divides the sequence to tile "
-            "properly." % (name, block, seq, adjusted,
-                           seq // max(adjusted, 1), seq, name),
-            stacklevel=3)
-        return seq
-    return adjusted
+    """kernels.common.adjust_block with this family's name in the
+    warning (kept as a module symbol — tests and callers import it)."""
+    return _adjust_block_common(block, seq, name,
+                                family="flash_attention")
 
 
 def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,

@@ -257,6 +257,18 @@ def _flash_min_seq():
         return 8192
 
 
+def _paged_pallas_requested():
+    """MXNET_PAGED_DECODE_PALLAS=1 routes decode_step_paged /
+    verify_chunk_paged through the batched-lane Pallas megakernel
+    (kernels/paged_decode.py) instead of the fused-gather dense
+    contraction. Read at trace time through _fastenv (sub-microsecond,
+    monkeypatch-safe) and folded into the _serving_jit key, so an A/B
+    harness can flip the flag between arms without stale programs."""
+    from .. import _fastenv
+    return _fastenv.get("MXNET_PAGED_DECODE_PALLAS", "0") not in (
+        "0", "", "false", "False", None)
+
+
 def _causal_attention(q, k, v, cfg, out_dtype):
     """Single-device causal attention over [B, T, H, D] — flash kernel
     (one block when T fits/divides 128, else gcd(T, 128)-sized blocks,
@@ -668,7 +680,11 @@ def _serving_jit(kind, cfg, build):
     # choices (e.g. _serving_donate's donation tuple) into the wrapper,
     # so a process that pins a different backend after warming must not
     # reuse a stale wrapper
-    key = (kind, jax.default_backend()) + dataclasses.astuple(cfg)
+    # the paged-kernel flag is trace-time env state the builders bake
+    # in, so it keys too: a bench toggling MXNET_PAGED_DECODE_PALLAS
+    # between arms must get two programs, not one stale one
+    key = (kind, jax.default_backend(),
+           _paged_pallas_requested()) + dataclasses.astuple(cfg)
     fn = _PREFILL_JIT_CACHE.pop(key, None)
     if fn is None:
         frozen = dataclasses.replace(cfg)   # defensive copy: later
@@ -1110,7 +1126,21 @@ def decode_step_paged(params, pool, tables, tokens, pos, cfg):
         nlayer = _paged_write_ragged(layer_pool, k_new, v_new, tables,
                                      pos, cfg)
         new_pool.append(nlayer)
-        o = _decode_attention(q, _paged_gather(nlayer, tables), pos, cfg)
+        if _paged_pallas_requested():
+            # batched-lane megakernel: reads the pool THROUGH the
+            # tables (no dense gather copy), skips dead blocks per
+            # lane. The batcher's membudget preflight already covers
+            # this jit boundary (it preflights every dispatch fn), and
+            # the scope makes its bytes attributable via hlo/attribution.
+            from ..kernels import paged_attention
+            from ..observability import attribution as _obs_attr
+            _obs_attr.note_scope("paged_decode_kernel")
+            with jax.named_scope("paged_decode_kernel"):
+                o = paged_attention(q[:, None], nlayer, tables,
+                                    pos)[:, 0]
+        else:
+            o = _decode_attention(q, _paged_gather(nlayer, tables),
+                                  pos, cfg)
         x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"])
         x = x + _ffn(_rms_norm(x, p["ln2"])[:, None], p, cfg)[:, 0]
     x = _rms_norm(x, params["ln_f"])
@@ -1263,6 +1293,17 @@ def verify_chunk_paged(params, pool, tables, tokens, pos, cfg):
                                            positions, cfg)
         new_pool.append(nlayer)
         dh = q.shape[-1]
+        if _paged_pallas_requested():
+            # same megakernel, span=C: the ragged [B, k+1] spec-verify
+            # window is just the k>1 case of the decode grid
+            from ..kernels import paged_attention
+            from ..observability import attribution as _obs_attr
+            _obs_attr.note_scope("paged_verify_kernel")
+            with jax.named_scope("paged_verify_kernel"):
+                o = paged_attention(q, nlayer, tables, pos)
+            x = x + jnp.einsum("bchk,hkd->bcd", o, p["wo"])
+            x = x + _ffn(_rms_norm(x, p["ln2"]), p, cfg)
+            continue
         qg = q.reshape(b, c, _kvh(cfg), g, dh)
         att = _paged_gather(nlayer, tables)
         t_pos = jnp.arange(att["k"].shape[1])

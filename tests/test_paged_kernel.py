@@ -1,0 +1,249 @@
+"""Batched-lane paged decode/verify megakernel (kernels/paged_decode.py),
+interpret mode on CPU.
+
+Two layers of parity, mirroring the acceptance bar:
+
+  * kernel-level — paged_attention vs a dense reference built exactly
+    the way decode_step_paged / verify_chunk_paged build theirs
+    (gather through the tables, `<= pos + c` mask, shared
+    _int8_cache_attention), across span (decode k=0 / spec-verify
+    k in {1, 4}) x fp32/bf16 pools x int8-KV on/off x GQA group sizes,
+    with ragged lane lengths, permuted tables, and partially filled
+    last blocks;
+  * stream-level — greedy token streams through the REAL serving entry
+    points with MXNET_PAGED_DECODE_PALLAS toggled must be identical
+    token-for-token (the bit that makes the kernel a drop-in for
+    ContinuousBatcher). Pool trees agree to reduction-order ulps, not
+    bits: layer n>0's cache writes are downstream of layer n-1's
+    attention output, so ulp noise cascades — the reference
+    _int8_cache_attention itself carries the same class of noise
+    between its chunked and stepped callers.
+
+Plus the shared block_k choice cache (kernels/common.py): memoization,
+the pool-block-multiple constraint, env override + fallback-with-warn.
+"""
+
+import importlib
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+# the package re-exports the flash_attention FUNCTION, shadowing the
+# submodule name — import modules explicitly
+tf = importlib.import_module("mxnet_tpu.models.transformer")
+common = importlib.import_module("mxnet_tpu.kernels.common")
+from mxnet_tpu.kernels import paged_attention
+
+
+# ----------------------------------------------------- kernel parity ---
+
+def _make_pool(rng, nblocks, bs, kvh, d, dtype, int8):
+    k = rng.randn(nblocks, bs, kvh, d).astype(np.float32)
+    v = rng.randn(nblocks, bs, kvh, d).astype(np.float32)
+    if int8:
+        k8, ks = tf._kv_quant(jnp.asarray(k))
+        v8, vs = tf._kv_quant(jnp.asarray(v))
+        return {"k": k8, "v": v8, "ks": ks, "vs": vs}
+    return {"k": jnp.asarray(k, dtype), "v": jnp.asarray(v, dtype)}
+
+
+def _dense_ref(q, pool, tables, pos):
+    """The exact op sequence the transformer's paged entry points run:
+    _paged_gather through the tables, `t_pos <= pos + c` mask, then
+    _int8_cache_attention or the dense fp32 softmax contraction."""
+    b, span, h, d = q.shape
+    kvh = pool["k"].shape[2]
+    g = h // kvh
+    att = tf._paged_gather(pool, tables)
+    t_pos = jnp.arange(att["k"].shape[1])
+    positions = pos[:, None] + jnp.arange(span)[None, :]
+    mask = t_pos[None, None, :] <= positions[:, :, None]
+    qg = q.reshape(b, span, kvh, g, d)
+    if "ks" in pool:
+        o = tf._int8_cache_attention(qg, att, mask, q.dtype)
+    else:
+        ck, cv = att["k"], att["v"]
+        s = jnp.einsum("bckgd,btkd->bckgt", qg, ck,
+                       preferred_element_type=jnp.float32) / np.sqrt(d)
+        s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bckgt,btkd->bckgd", a.astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32
+                       ).astype(q.dtype)
+    return o.reshape(b, span, h, d)
+
+
+def _ragged_setup(rng, span, int8, g, dtype, b=3, kvh=2, d=16, bs=8,
+                  nb=4):
+    """Permuted per-lane tables with null-block tails, ragged positions
+    including a partially filled last block and a lane ending exactly
+    at capacity."""
+    nblocks = 1 + b * nb
+    h = kvh * g
+    pool = _make_pool(rng, nblocks, bs, kvh, d, dtype, int8)
+    t_max = nb * bs
+    pos = np.array([3, 13, t_max - span])[:b]     # partial + full lanes
+    tables = np.zeros((b, nb), np.int32)
+    for i in range(b):
+        perm = rng.permutation(nb)
+        need = -(-(pos[i] + span) // bs)          # ceil: live blocks only
+        for j in range(nb):
+            tables[i, j] = 1 + i * nb + perm[j] if j < need else 0
+    q = jnp.asarray(rng.randn(b, span, h, d), dtype)
+    return q, pool, jnp.asarray(tables), jnp.asarray(pos, jnp.int32)
+
+
+@pytest.mark.parametrize("span", [1, 2, 5])
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("g", [1, 4])
+def test_paged_kernel_matches_dense_reference(span, int8, g):
+    rng = np.random.RandomState(span * 16 + int8 * 4 + g)
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 3e-2)):
+        q, pool, tables, pos = _ragged_setup(rng, span, int8, g, dtype)
+        o_k = paged_attention(q, pool, tables, pos)
+        o_d = _dense_ref(q, pool, tables, pos)
+        assert o_k.dtype == q.dtype and o_k.shape == q.shape
+        diff = float(jnp.max(jnp.abs(o_k.astype(jnp.float32)
+                                     - o_d.astype(jnp.float32))))
+        assert diff <= tol, (dtype, diff)
+
+
+def test_paged_kernel_block_k_invariance():
+    """Any legal block_k tiles to the same numbers (the adaptive choice
+    is a bandwidth knob, not a numerics knob)."""
+    rng = np.random.RandomState(7)
+    q, pool, tables, pos = _ragged_setup(rng, 2, True, 2, jnp.float32)
+    bs, t_max = 8, 32
+    base = np.asarray(paged_attention(q, pool, tables, pos, block_k=bs))
+    for bk in (2 * bs, t_max):
+        o = np.asarray(paged_attention(q, pool, tables, pos,
+                                       block_k=bk))
+        np.testing.assert_allclose(o, base, rtol=1e-6, atol=1e-6)
+
+
+def test_paged_kernel_validates_layout():
+    rng = np.random.RandomState(3)
+    q, pool, tables, pos = _ragged_setup(rng, 1, False, 1, jnp.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_attention(q, pool, tables, pos, block_k=12)  # % bs != 0
+    with pytest.raises(ValueError, match="query heads"):
+        paged_attention(q[:, :, :1].repeat(3, axis=2), pool, tables,
+                        pos)                               # 3 % 2 != 0
+
+
+# ---------------------------------------------------- stream parity ---
+
+def _greedy_stream(monkeypatch, int8, spec_k, flag, steps=4):
+    """Drive the real serving entry points (decode_step_paged and, on
+    alternating steps, the [B, k+1] verify window) greedily."""
+    if flag:
+        monkeypatch.setenv("MXNET_PAGED_DECODE_PALLAS", "1")
+    else:
+        monkeypatch.delenv("MXNET_PAGED_DECODE_PALLAS", raising=False)
+    cfg = tf.TransformerConfig(vocab_size=97, d_model=32, n_heads=4,
+                               n_kv_heads=2, n_layers=2, max_len=64,
+                               kv_cache_int8=int8)
+    params = tf.init_params(cfg, seed=0)
+    b, bs = 3, 8
+    nb = cfg.max_len // bs
+    pool = tf.init_paged_cache(cfg, 1 + b * nb, bs)
+    tables = jnp.asarray(
+        np.stack([1 + i * nb + np.arange(nb) for i in range(b)])
+        .astype(np.int32))
+    pos = jnp.asarray([0, 3, 7], jnp.int32)
+    toks = jnp.asarray([5, 11, 23], jnp.int32)
+    stream = []
+    for step in range(steps):
+        if spec_k and step % 2 == 1:
+            win = jnp.stack([toks, (toks * 7 + 1) % 97,
+                             (toks * 3 + 2) % 97], axis=1)[:, :spec_k + 1]
+            logits, pool = tf.verify_chunk_paged(params, pool, tables,
+                                                 win, pos, cfg)
+            toks = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            pos = pos + win.shape[1]
+        else:
+            logits, pool = tf.decode_step_paged(params, pool, tables,
+                                                toks, pos, cfg)
+            toks = jnp.argmax(logits, -1).astype(jnp.int32)
+            pos = pos + 1
+        stream.append(np.asarray(toks))
+    return np.stack(stream), jax.tree.map(np.asarray, pool)
+
+
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_greedy_stream_parity_flag_toggle(monkeypatch, int8, spec_k):
+    s_off, p_off = _greedy_stream(monkeypatch, int8, spec_k, False)
+    s_on, p_on = _greedy_stream(monkeypatch, int8, spec_k, True)
+    np.testing.assert_array_equal(s_off, s_on)
+    # pools: layer 0 writes are upstream of any attention -> bit-equal;
+    # deeper layers agree to reduction-order ulps (see module docstring)
+    for name in sorted(p_off[0]):
+        np.testing.assert_array_equal(p_off[0][name], p_on[0][name])
+    for la, lb in zip(p_off[1:], p_on[1:]):
+        for name in sorted(la):
+            np.testing.assert_allclose(
+                la[name].astype(np.float64), lb[name].astype(np.float64),
+                rtol=2e-5, atol=2e-5)
+
+
+def test_serving_jit_key_includes_pallas_flag(monkeypatch):
+    """Toggling the flag between arms must build two programs — a
+    stale cache hit would silently bench one arm twice."""
+    cfg = tf.TransformerConfig(vocab_size=11, d_model=8, n_heads=1,
+                               n_layers=1, max_len=8)
+    built = []
+    monkeypatch.delenv("MXNET_PAGED_DECODE_PALLAS", raising=False)
+    tf._serving_jit("flagtest", cfg, lambda fz: built.append(1) or "a")
+    monkeypatch.setenv("MXNET_PAGED_DECODE_PALLAS", "1")
+    tf._serving_jit("flagtest", cfg, lambda fz: built.append(1) or "b")
+    assert len(built) == 2
+    # and each flag state reuses its own entry
+    tf._serving_jit("flagtest", cfg, lambda fz: built.append(1))
+    monkeypatch.delenv("MXNET_PAGED_DECODE_PALLAS", raising=False)
+    tf._serving_jit("flagtest", cfg, lambda fz: built.append(1))
+    assert len(built) == 2
+
+
+# ------------------------------------------------- block_k choice cache ---
+
+def test_choose_block_k_memoizes_and_respects_multiple():
+    key = ("t-memo", 1)
+    got = common.choose_block_k(1024, shape_key=key, multiple=16)
+    assert got == 512 and got % 16 == 0
+    assert common.choose_block_k(1024, shape_key=key, multiple=16) == 512
+    assert ((None, 1024, 16) + key) in common.block_choice_cache()
+    # no candidate is a multiple AND divides -> one full-length block
+    assert common.choose_block_k(48, shape_key=("t-memo", 2),
+                                 multiple=48) == 48
+
+
+def test_choose_block_k_env_override(monkeypatch):
+    monkeypatch.setenv("MXNET_PAGED_BLOCK_K", "64")
+    assert common.choose_block_k(1024, shape_key=("t-env", 1),
+                                 multiple=16,
+                                 env="MXNET_PAGED_BLOCK_K") == 64
+    # invalid override (not a multiple of the pool block) warns + falls back
+    monkeypatch.setenv("MXNET_PAGED_BLOCK_K", "24")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = common.choose_block_k(1024, shape_key=("t-env", 2),
+                                    multiple=16,
+                                    env="MXNET_PAGED_BLOCK_K")
+    assert got == 512
+    assert any("MXNET_PAGED_BLOCK_K" in str(x.message) for x in w)
+
+
+def test_flash_decode_routes_through_shared_cache():
+    fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
+    rng = np.random.RandomState(0)
+    b, t, kvh, g, d = 2, 64, 2, 1, 8
+    q = jnp.asarray(rng.randn(b, kvh * g, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, kvh, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, kvh, d), jnp.float32)
+    fa.flash_decode(q, k, v, lengths=t)
+    assert (None, t, 1, "flash_decode", b, kvh, g, d) \
+        in common.block_choice_cache()
