@@ -84,6 +84,20 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         exit 1
     fi
 
+    echo "==== [tier1] megakernel perf sentinel (paged Pallas scopes vs baseline) ===="
+    # the PR 16 paged decode/verify megakernel, forced on via
+    # MXNET_PAGED_DECODE_PALLAS=1 (interpret mode on CPU), must keep
+    # its paged_decode_kernel / paged_verify_kernel flop/byte rows
+    # within tolerance of the baseline's "kernels" section. Refresh:
+    #   python tools/obs_regression.py --baseline ci/obs_baseline.json \
+    #       --kernels --update
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 MXNET_PAGED_DECODE_PALLAS=1 \
+            python tools/obs_regression.py \
+            --baseline ci/obs_baseline.json --kernels; then
+        echo "[tier1] FAIL: megakernel perf sentinel"
+        exit 1
+    fi
+
     echo "==== [tier1] distributed observability smoke (2-process gloo merge) ===="
     # two gloo workers train against dist_tpu_sync (clock-anchor
     # handshake at kvstore creation), dump rank-local traces, and the

@@ -76,7 +76,7 @@ from ..observability import integrity as _integrity
 
 __all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state",
            "CheckpointCorrupt", "CheckpointIncompatible",
-           "wait_for_pending_save", "verify_lineage",
+           "wait_for_pending_save", "verify_lineage", "lineage_head",
            "list_checkpoints", "resume_from_latest", "resume_elastic",
            "save_shard_checkpoint", "load_shard_checkpoint",
            "list_shard_generations", "shard_layout",
@@ -299,6 +299,14 @@ def _note_lineage(path, name):
                        "step": int(m.get("step", -1))}
     except (OSError, ValueError):
         pass
+
+
+def lineage_head():
+    """The current lineage tail — the manifest this process last
+    committed or successfully loaded (name, digest, step), or None
+    before either. The flight recorder stamps this into every incident
+    bundle so a post-mortem knows exactly which weights were live."""
+    return _lineage[0]
 
 
 class _Saver(threading.Thread):
@@ -1366,6 +1374,7 @@ def _sigterm_handler(signum, frame):
     with _emergency_lock:
         prev = _emergency["prev_sigterm"]
         _emergency["fired"] = True
+    p = None
     try:
         p = save_emergency_checkpoint("sigterm")
         if p:
@@ -1373,6 +1382,9 @@ def _sigterm_handler(signum, frame):
                   "checkpoint committed to %s" % p, flush=True)
     except Exception:                # last-gasp: report, then go down
         traceback.print_exc()
+    from ..observability import flight as _flight
+    _flight.record_incident("sigterm", exit_code=143,
+                            emergency_checkpoint=p)
     if callable(prev):
         prev(signum, frame)
         return

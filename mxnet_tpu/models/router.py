@@ -74,7 +74,10 @@ from .serving import ContinuousBatcher
 from .. import _fastenv
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
+from ..observability import events as _events
+from ..observability import flight as _flight
 from ..observability import membudget as _membudget
+from ..observability import timeseries as _ts
 
 __all__ = ["ReplicaRouter"]
 
@@ -213,6 +216,16 @@ class ReplicaRouter(object):
             v = _fastenv.get("MXNET_ROUTER_ROLLOUT_WINDOW")
             rollout_window = int(v) if v else 8
         self.rollout_window = max(1, int(rollout_window))
+        # fleet trend aggregation (PR 17): each replica's health
+        # snapshot retained per step as a bounded fleet time-series,
+        # with the timeseries.py detectors run over it — anomalies
+        # count into obs.anomaly.* and warn once per (detector,
+        # replica) until the condition clears
+        self._fleet_hist = {}        # replica name -> deque of dicts
+        self._anomaly_warned = set()
+        # flight-recorder context: incident bundles carry the fleet
+        # view (weakly held — registration never pins the router)
+        _flight.register_context("router", self.health_snapshot)
 
     @classmethod
     def build(cls, params, cfg, n_replicas=2, shed_queue=None,
@@ -399,9 +412,8 @@ class ReplicaRouter(object):
             _obs.counter("serving.slo_violation.expired").add(1)
             if _obs.enabled():
                 _obs.counter("router.expired").add(1)
-                _obs.record_instant(
-                    "router.expired", cat="serving",
-                    args={"rid": job.rid, "priority": job.priority})
+                _events.event("expire", rid=job.rid,
+                              priority=job.priority)
         self._queue = keep
 
     def _admit_queued(self, finished):
@@ -456,6 +468,10 @@ class ReplicaRouter(object):
                         self._absorb_preempted(i, rep)
                     if _obs.enabled():
                         _obs.counter("router.routed").add(1)
+                        _events.event(
+                            "admit", rid=job.rid, replica=rep.name,
+                            priority=job.priority,
+                            continuation=job.emitted > 0)
                     admitted = True
                     break
             if not admitted:
@@ -475,10 +491,9 @@ class ReplicaRouter(object):
                 _obs.counter("serving.slo_violation.shed").add(1)
                 if _obs.enabled():
                     _obs.counter("router.shed").add(1)
-                    _obs.record_instant(
-                        "router.shed", cat="serving",
-                        args={"rid": job.rid, "priority": job.priority,
-                              "queued": len(self._queue)})
+                    _events.event("shed", rid=job.rid,
+                                  priority=job.priority,
+                                  queued=len(self._queue))
 
     def _retire_job(self, job, reason):
         """A queued job left the router for good (shed / expired):
@@ -608,11 +623,14 @@ class ReplicaRouter(object):
         if _obs.enabled():
             _obs.gauge("router.replica_state.%s"
                        % self.replicas[i].name).set(_STATE_CODE[state])
-            _obs.record_instant(
-                "router.breaker", cat="serving",
-                args={"replica": self.replicas[i].name,
-                      "from": old, "to": state,
-                      "trips": self._brk_trips[i]})
+            _events.event("breaker", replica=self.replicas[i].name,
+                          frm=old, to=state,
+                          trips=self._brk_trips[i])
+            if state == "open":
+                _flight.record_incident(
+                    "breaker.open", replica=self.replicas[i].name,
+                    trips=self._brk_trips[i],
+                    backoff=self._brk_open_left[i])
 
     def _breaker_tick(self, i):
         """One router step elapsed for an OPEN replica: count the
@@ -702,15 +720,21 @@ class ReplicaRouter(object):
             for i, r in enumerate(self.replicas):
                 _obs.gauge("router.replica_state.%s" % r.name).set(
                     _STATE_CODE[self._brk_state[i]])
+            # one health snapshot per alive replica feeds BOTH the
+            # fleet gauges and the trend history below
+            snaps = {i: r.health_snapshot()
+                     for i, r in enumerate(self.replicas)
+                     if self._alive[i]}
             # fleet-wide speculative health: the WORST alive replica's
             # acceptance ratio (the one an operator would retune
             # spec_k for) — absent when no replica speculates
             ratios = [
-                r.health_snapshot().get("serving.spec_draft_ratio")
-                for i, r in enumerate(self.replicas) if self._alive[i]]
+                s.get("serving.spec_draft_ratio")
+                for s in snaps.values()]
             ratios = [x for x in ratios if x is not None]
             if ratios:
                 _obs.gauge("router.spec_accept_ratio").set(min(ratios))
+            self._record_fleet_history(snaps)
             _obs.gauge("router.rollout_phase").set(
                 _ROLLOUT_CODE[self._rollout["phase"]]
                 if self._rollout else 0)
@@ -796,10 +820,8 @@ class ReplicaRouter(object):
         }
         self.rollout_events.append(("start", new_fp))
         if _obs.enabled():
-            _obs.record_instant(
-                "router.rollout_start", cat="serving",
-                args={"fingerprint": new_fp,
-                      "replicas": len(self.replicas)})
+            _events.event("swap", phase="start", fingerprint=new_fp,
+                          replicas=len(self.replicas))
         return new_fp
 
     @property
@@ -928,9 +950,8 @@ class ReplicaRouter(object):
         ro["canary"] = None
         self.rollout_events.append(("upgraded", rep.name))
         if _obs.enabled():
-            _obs.record_instant(
-                "router.rollout_upgraded", cat="serving",
-                args={"replica": rep.name, "fingerprint": ro["fp"]})
+            _events.event("swap", phase="upgraded", replica=rep.name,
+                          fingerprint=ro["fp"])
         self._rollout_advance()
 
     def _rollout_advance(self):
@@ -967,10 +988,12 @@ class ReplicaRouter(object):
         _obs.counter("router.rollbacks").add(1)
         self.rollout_events.append(("rolled_back", reason))
         if _obs.enabled():
-            _obs.record_instant(
-                "router.rollback", cat="serving",
-                args={"reason": reason,
-                      "restored": [fp for fp in ro["prior_fp"]]})
+            _events.event("rollback", reason=reason,
+                          restored=[fp for fp in ro["prior_fp"]])
+            _flight.record_incident("rollout.rollback", reason=reason,
+                                    target_fp=ro["fp"],
+                                    restored=[fp for fp in
+                                              ro["prior_fp"]])
         warnings.warn(
             "router: rollout of %s rolled back — %s"
             % (ro["fp"], reason), RuntimeWarning, stacklevel=2)
@@ -1083,6 +1106,107 @@ class ReplicaRouter(object):
                 % ", ".join("%s=%s" % kv for kv in sorted(fps.items())),
                 RuntimeWarning, stacklevel=2)
 
+    # ---- fleet trend aggregation (PR 17) ----
+
+    def _anomaly_cfg(self):
+        def _num(key, default, cast=float):
+            v = _fastenv.get(key)
+            return cast(v) if v else default
+        return {
+            "window": max(_num("MXNET_OBS_ANOMALY_WINDOW", 32, int), 4),
+            "min_points": max(
+                _num("MXNET_OBS_ANOMALY_MIN_POINTS", 8, int), 4),
+            "leak_blocks": _num("MXNET_OBS_ANOMALY_LEAK_BLOCKS", 1.0),
+            "slide_drop": _num("MXNET_OBS_ANOMALY_SLIDE_DROP", 0.2),
+            "collapse_drop": _num("MXNET_OBS_ANOMALY_COLLAPSE_DROP",
+                                  0.5),
+            "storm": _num("MXNET_OBS_ANOMALY_STORM", 3, int),
+        }
+
+    def _record_fleet_history(self, snaps):
+        """Retain this step's per-replica health snapshots as a
+        bounded fleet time-series and run the trend detectors
+        (timeseries.py) over the rings: KV-block leak at idle and SLO
+        attainment slide per replica; throughput collapse and retrace
+        storm fleet-wide. Only called under ``_obs.enabled()``."""
+        cfg = self._anomaly_cfg()
+        win = cfg["window"]
+        counters = _obs.counters()
+        rc_total = sum(c.value for name, c in counters.items()
+                       if name.startswith("recompile."))
+        gp = counters.get("serving.goodput_tok_s")
+        fleet = self._fleet_hist.setdefault(
+            "__fleet__", deque(maxlen=win))
+        fleet.append({"goodput": gp.value if gp is not None else None,
+                      "recompiles": rc_total})
+        for i, snap in snaps.items():
+            name = self.replicas[i].name
+            hist = self._fleet_hist.setdefault(
+                name, deque(maxlen=win))
+            hist.append({
+                "free": snap.get("serving.kv_free_blocks"),
+                "occ": snap.get("serving.lane_occupancy", 0),
+                "att": snap.get("serving.slo_attainment"),
+            })
+            self._detect_trends(name, list(hist), cfg)
+        self._detect_fleet_trends(list(fleet), cfg)
+
+    def _detect_trends(self, name, hist, cfg):
+        free = [(h["free"], h["occ"]) for h in hist
+                if h["free"] is not None]
+        if free and _ts.detect_leak(
+                [f for f, _o in free], [o for _f, o in free],
+                min_points=cfg["min_points"],
+                min_drop=cfg["leak_blocks"]):
+            self._note_anomaly(
+                "kv_leak", name,
+                "%g free blocks lost while idle"
+                % (free[0][0] - free[-1][0]))
+        att = [h["att"] for h in hist if h["att"] is not None]
+        if _ts.detect_slide(att, drop=cfg["slide_drop"],
+                            min_points=cfg["min_points"]):
+            self._note_anomaly(
+                "slo_slide", name,
+                "attainment slid %.2f -> %.2f" % (att[0], att[-1]))
+
+    def _detect_fleet_trends(self, fleet, cfg):
+        gp = [h["goodput"] for h in fleet if h["goodput"] is not None]
+        if _ts.detect_collapse(gp, drop=cfg["collapse_drop"],
+                               min_points=cfg["min_points"]):
+            self._note_anomaly(
+                "throughput_collapse", "fleet",
+                "goodput %.1f -> %.1f tok/s" % (gp[0], gp[-1]))
+        rc = [h["recompiles"] for h in fleet]
+        deltas = [b - a for a, b in zip(rc, rc[1:])]
+        if len(deltas) >= cfg["min_points"] and _ts.detect_storm(
+                deltas[-cfg["window"]:], threshold=cfg["storm"]):
+            self._note_anomaly(
+                "retrace_storm", "fleet",
+                "%d recompiles inside the window" % int(sum(deltas)))
+
+    def _note_anomaly(self, detector, where, detail):
+        """One detector firing: count ``obs.anomaly.<detector>``, log
+        a decision event, and warn ONCE per (detector, where) — the
+        counters keep climbing while the condition persists, the
+        warning doesn't repeat."""
+        _obs.counter("obs.anomaly." + detector).add(1)
+        _events.event("anomaly", detector=detector, where=where,
+                      detail=detail)
+        key = (detector, where)
+        if key not in self._anomaly_warned:
+            self._anomaly_warned.add(key)
+            warnings.warn(
+                "router: anomaly %s on %s — %s"
+                % (detector, where, detail),
+                _ts.AnomalyWarning, stacklevel=3)
+
+    def fleet_history(self, name=None):
+        """The retained trend rings (tests + tools): per-replica lists
+        of snapshot dicts, plus the ``__fleet__`` ring."""
+        if name is not None:
+            return list(self._fleet_hist.get(name, ()))
+        return {k: list(v) for k, v in self._fleet_hist.items()}
+
     def health_snapshot(self):
         """Router-level ``/healthz`` mirror: queue + fleet gauges, the
         shed/expired accounting (separate counters — satellite of the
@@ -1110,6 +1234,9 @@ class ReplicaRouter(object):
                 self._journal.depth_bytes
             snap["router.journal_lag_records"] = \
                 self._journal.lag_records
+        for name, c in _obs.counters().items():
+            if name.startswith("obs.anomaly."):
+                snap[name] = c.value
         return snap
 
     def run(self, requests):

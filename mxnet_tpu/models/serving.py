@@ -82,9 +82,14 @@ import jax.numpy as jnp
 from . import journal as _journal
 from . import transformer as tf
 from .. import _fastenv
+from ..observability import attribution as _attr
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
+from ..observability import events as _events
+from ..observability import flight as _flight
 from ..observability import http as _obs_http
+from ..observability import recompile as _obs_recompile
+from ..observability import timeseries as _timeseries
 from ..observability import integrity as _integrity
 from ..observability import membudget as _membudget
 from ..observability import slo as _slo
@@ -1221,6 +1226,15 @@ class ContinuousBatcher(object):
         # results to deliver at the next step() without a dispatch:
         # dedup re-deliveries and streams drained by swap_weights()
         self._pending_finished = {}
+        if _obs.enabled():
+            # flight recorder: incident bundles carry this replica's
+            # health snapshot (weak-ref'd — the recorder never pins a
+            # dead batcher); the time-series sampler daemon starts once
+            # per process, shared by every replica
+            _flight.register_context(
+                "serving.%s" % (self.name or "batcher"),
+                self.health_snapshot)
+            _timeseries.maybe_start()
 
     # ---- admission ----
 
@@ -1950,7 +1964,14 @@ class ContinuousBatcher(object):
             if self._journal is not None:
                 self._journal.append_park(req.rid, req.tokens,
                                           req.emitted)
+            avail0 = self._alloc.available
             self._free(i)
+            if _obs.enabled():
+                _events.event(
+                    "preempt", rid=req.rid, lane=i,
+                    victim_priority=req.priority,
+                    for_priority=priority, synced=req.emitted,
+                    blocks_freed=self._alloc.available - avail0)
             self.preempted.append((req, t_ns))
         return self._alloc.available >= demand
 
@@ -1995,7 +2016,14 @@ class ContinuousBatcher(object):
             if self._journal is not None:
                 self._journal.append_park(req.rid, req.tokens,
                                           req.emitted)
+            avail0 = self._alloc.available
             self._free(i)
+            if _obs.enabled():
+                _events.event(
+                    "preempt", rid=req.rid, lane=i,
+                    victim_priority=req.priority,
+                    reason="kv_shrink", synced=req.emitted,
+                    blocks_freed=self._alloc.available - avail0)
             self.preempted.append((req, t_ns))
         if parked and _obs.enabled():
             _obs.counter("serving.kv_shrinks").add(1)
@@ -2003,6 +2031,9 @@ class ContinuousBatcher(object):
                 "serving.kv_shrink", cat="serving",
                 args={"requested": n, "parked": parked,
                       "pool_parked": self._alloc.parked_blocks})
+            _events.event("pool", op="shrink", requested=n,
+                          parked=parked,
+                          pool_parked=self._alloc.parked_blocks)
         return parked
 
     def grow_pool(self, n):
@@ -2042,6 +2073,8 @@ class ContinuousBatcher(object):
                 "serving.kv_grow", cat="serving",
                 args={"requested": n, "returned": got,
                       "num_blocks": self.num_blocks})
+            _events.event("pool", op="grow", requested=n,
+                          returned=got, num_blocks=self.num_blocks)
         return got
 
     def _oom_shrink(self, exc):
@@ -2149,6 +2182,21 @@ class ContinuousBatcher(object):
             _obs.gauge("serving.brownout_rung").set(rung)
             _obs.record_instant("serving.brownout", cat="serving",
                                 args={"rung": rung})
+            _events.event("brownout", frm=prev, to=rung)
+
+    def _register_dispatch(self, kind, fn, args):
+        """Attribution over the serving jit boundary: register this
+        dispatch executable (once per signature) so its named scopes —
+        the paged_decode_kernel / paged_verify_kernel megakernel rows
+        under MXNET_PAGED_DECODE_PALLAS=1 — appear in ops summaries
+        and the obs_regression kernel baseline guard."""
+        import jax as _jax
+        leaves = [a for a in _jax.tree_util.tree_leaves(args)
+                  if hasattr(a, "shape")]
+        sig = _obs_recompile.signature_of(leaves)
+        origin = "serving.%s.%s" % (kind, self.name or "batcher")
+        if sig and _attr.needs_program(origin, sig):
+            _attr.register_program(origin, sig, fn, args)
 
     # ---- decode ----
 
@@ -2219,6 +2267,8 @@ class ContinuousBatcher(object):
                     if _membudget.enabled():
                         _membudget.preflight(self._chaos_site, fn,
                                              args)
+                    if _attr.ops_enabled():
+                        self._register_dispatch("decode", fn, args)
                     nxt, keys, state = fn(*args)
                     toks = np.asarray(nxt).astype(np.int32)[None]
                 else:
@@ -2228,6 +2278,8 @@ class ContinuousBatcher(object):
                     if _membudget.enabled():
                         _membudget.preflight(self._chaos_site, fn,
                                              args)
+                    if _attr.ops_enabled():
+                        self._register_dispatch("decode", fn, args)
                     toks, keys, state = fn(*args)
                     toks = np.asarray(toks).astype(np.int32)   # [k, B]
                 if self.paged:
@@ -2362,6 +2414,9 @@ class ContinuousBatcher(object):
                 if _membudget.enabled():
                     _membudget.preflight(self._chaos_site,
                                          self._pipe_fn, args)
+                if _attr.ops_enabled():
+                    self._register_dispatch("pipeline", self._pipe_fn,
+                                            args)
                 toks, pool, tables, tok, pos, keys = \
                     self._pipe_fn(*args)
                 self._pool, self._tables = pool, tables
@@ -2513,6 +2568,8 @@ class ContinuousBatcher(object):
             if _membudget.enabled():
                 _membudget.preflight(self._chaos_site, self._spec_fn,
                                      args)
+            if _attr.ops_enabled():
+                self._register_dispatch("spec", self._spec_fn, args)
             if self._spec_provider == "ngram":
                 if self.paged:
                     targets, emits, pool, hist, tok, pos = \
@@ -2601,11 +2658,16 @@ class ContinuousBatcher(object):
                 # floor shrinks the draft width (never below 1 — one
                 # draft still doubles the best-case tokens/dispatch),
                 # at-or-above grows it back toward spec_k
+                k0 = int(self._keff[i])
                 if self._accept_ewma[i] < self.spec_accept_floor:
-                    self._keff[i] = max(1, int(self._keff[i]) - 1)
+                    self._keff[i] = max(1, k0 - 1)
                 else:
-                    self._keff[i] = min(self.spec_k,
-                                        int(self._keff[i]) + 1)
+                    self._keff[i] = min(self.spec_k, k0 + 1)
+                if int(self._keff[i]) != k0 and _obs.enabled():
+                    _events.event(
+                        "spec_k", lane=i, frm=k0,
+                        to=int(self._keff[i]),
+                        accept=round(float(self._accept_ewma[i]), 4))
             if t_sync is not None:
                 self._note_progress(req, i, req.emitted - grew0,
                                     t_sync)
@@ -2955,6 +3017,8 @@ class ContinuousBatcher(object):
                 "serving.recover", cat="serving",
                 args={"resumed": len(resumed), "finished": len(done),
                       "skipped": len(skipped)})
+            _events.event("recover", resumed=len(resumed),
+                          finished=len(done), skipped=len(skipped))
         return resumed, done, skipped
 
     def swap_weights(self, params, manifest=None):
@@ -3049,6 +3113,9 @@ class ContinuousBatcher(object):
                 "serving.swap", cat="serving",
                 args={"fingerprint": new_fp, "previous": prev_fp,
                       "mode": mode, "live": len(pending)})
+            _events.event("swap", fingerprint=new_fp,
+                          previous=prev_fp, mode=mode,
+                          live=len(pending))
         return {"fingerprint": new_fp, "previous": prev_fp,
                 "mode": mode}
 

@@ -42,14 +42,18 @@ from . import chaos
 from . import core
 from . import dist
 from . import integrity
+from . import events
 from . import export
+from . import flight
 from . import histogram
 from . import hlo
 from . import http
+from . import sideband
 from . import slo
 from . import membudget
 from . import attribution
 from . import recompile
+from . import timeseries
 from . import watchdog
 from .attribution import (ops_enabled, format_ops_table,
                           compare_summaries)
@@ -66,11 +70,20 @@ from .dist import (merge_traces, detect_stragglers, skew_summary,
 from .export import (chrome_trace, dump_chrome_trace, aggregate,
                      aggregate_table, prometheus_text, write_prometheus)
 from .recompile import get_detector, note_call, record_retrace
+from .events import event
+from .flight import record_incident, note_exit
 from .watchdog import get_watchdog
 
-__all__ = ["chaos", "core", "dist", "export", "histogram", "hlo",
-           "http", "slo", "membudget", "attribution", "integrity",
-           "recompile",
+# chain the flight recorder's unhandled-exception hook when telemetry
+# is on (one guarded branch — PR 2 contract — when MXNET_OBS is unset)
+if core.enabled():
+    flight.install()
+
+__all__ = ["chaos", "core", "dist", "events", "export", "flight",
+           "histogram", "hlo",
+           "http", "sideband", "slo", "membudget", "attribution",
+           "integrity", "recompile", "timeseries",
+           "event", "record_incident", "note_exit",
            "watchdog", "ops_enabled", "format_ops_table",
            "compare_summaries", "ops_summary", "enabled",
            "set_enabled", "span", "counter", "gauge", "get_histogram",

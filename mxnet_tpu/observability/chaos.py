@@ -304,6 +304,7 @@ def fire_rules(site, **info):
     if not due:
         return ()
     if core.enabled():
+        from . import flight as _flight
         for r in due:
             core.counter("chaos.injected").add(1)
             core.counter("chaos." + r.fault).add(1)
@@ -311,6 +312,14 @@ def fire_rules(site, **info):
                 "chaos.inject", cat="chaos",
                 args=dict(info, site=site, fault=r.fault,
                           occurrence=r.seen - 1))
+            # the bundle must land BEFORE _execute: crash/sigterm
+            # faults leave no later opportunity (per-cause capped, so
+            # a retry loop of injected errors cannot flood the
+            # sideband)
+            _flight.record_incident(
+                "chaos." + r.fault, site=site,
+                occurrence=r.seen - 1,
+                info={k: str(v) for k, v in info.items()})
     for r in due:
         _execute(r, site)
     return tuple(due)

@@ -270,3 +270,7 @@ def reset():
         _counters.clear()
     from . import histogram as _h
     _h.reset()
+    from . import events as _ev
+    _ev.reset()
+    from . import timeseries as _ts
+    _ts.reset()

@@ -1,0 +1,131 @@
+"""Structured scheduler decision log — the narration layer.
+
+The serving stack *counts* every decision it makes (``router.shed``,
+``serving.preemptions``, ``serving.brownout_rung`` ...) but never
+*narrates* them: by the time an operator looks, the counter says "7
+preemptions" with no victims, no order, no context. ``event(kind,
+**fields)`` is the one-call fix — a bounded ring of structured records
+(``MXNET_OBS_EVENTS_RING`` entries, default 1024, oldest overwritten)
+capturing WHO and WHY at each decision point:
+
+    admit / shed / expire        admission control verdicts
+    preempt                      victim rid + blocks freed
+    brownout                     rung transitions (from -> to)
+    breaker                      replica breaker state changes
+    spec_k                       per-lane speculative-k adaptation
+    pool_shrink / pool_grow      elastic KV-pool resizes
+    swap / rollback              weight rollout lifecycle
+    elastic                      generation changes (world N -> N')
+    anomaly                      trend-detector firings (timeseries)
+
+Every event is mirrored into the core ring as a chrome instant
+(``event.<kind>``, cat ``decision``) so traces carry the narration on
+the same timeline as the spans, and ``format_recent()`` renders the
+"Recent events" section of ``profiler.dumps(aggregate=True)``. The
+flight recorder snapshots ``recent()`` into every incident bundle.
+
+PR 2 contract: with ``MXNET_OBS`` unset, ``event()`` is one guarded
+branch — no ring, no clock read, no dict building at call sites that
+pass only scalars.
+"""
+
+import threading
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["DEFAULT_RING", "event", "recent", "depth", "counts",
+           "dropped", "ring_capacity", "format_recent", "reset"]
+
+DEFAULT_RING = 1024
+
+_lock = threading.Lock()
+_ring = []
+_head = 0
+_total = 0
+_counts = {}
+
+
+def ring_capacity():
+    return max(int(_fastenv.get("MXNET_OBS_EVENTS_RING", DEFAULT_RING)),
+               1)
+
+
+def event(kind, **fields):
+    """Record one scheduler decision. No-op when telemetry is off;
+    mirrored as a chrome instant ``event.<kind>`` when on."""
+    global _head, _total
+    if not core.enabled():
+        return
+    t_us = core._now_us()
+    rec = (t_us, str(kind), fields)
+    with _lock:
+        if not _ring:
+            _ring.extend([None] * ring_capacity())
+        ring = _ring
+        ring[_head] = rec
+        _head = (_head + 1) % len(ring)
+        _total += 1
+        _counts[kind] = _counts.get(kind, 0) + 1
+    core.record_instant("event." + str(kind), cat="decision",
+                        args=fields)
+
+
+def recent(n=None):
+    """The last ``n`` events (all retained when None), oldest first:
+    list of ``(t_us, kind, fields)``."""
+    with _lock:
+        if not _ring:
+            return []
+        if _total <= len(_ring):
+            out = [r for r in _ring[:_head] if r is not None]
+        else:
+            out = [r for r in _ring[_head:] + _ring[:_head]
+                   if r is not None]
+    return out if n is None else out[-n:]
+
+
+def depth():
+    """Events currently held in the ring (the /healthz number)."""
+    with _lock:
+        return min(_total, len(_ring)) if _ring else 0
+
+
+def counts():
+    """Lifetime per-kind event counts (survive ring overwrite)."""
+    with _lock:
+        return dict(_counts)
+
+
+def dropped():
+    with _lock:
+        return max(_total - len(_ring), 0) if _ring else 0
+
+
+def format_recent(k=20):
+    """The "Recent events" aggregate-table section: the last ``k``
+    decisions, one line each, plus the per-kind lifetime tallies."""
+    evs = recent(k)
+    if not evs:
+        return []
+    lines = ["", "Recent events (last %d of %d, %d dropped):"
+             % (len(evs), _total, dropped())]
+    for t_us, kind, fields in evs:
+        kv = " ".join("%s=%s" % (key, fields[key])
+                      for key in sorted(fields))
+        lines.append("  %12.3f ms  %-12s %s"
+                     % (t_us / 1000.0, kind, kv))
+    tally = counts()
+    lines.append("  by kind: " + ", ".join(
+        "%s=%d" % (key, tally[key]) for key in sorted(tally)))
+    return lines
+
+
+def reset():
+    """Clear the ring and tallies (tests, new profile sessions)."""
+    global _ring, _head, _total
+    with _lock:
+        _ring = []
+        _head = 0
+        _total = 0
+        _counts.clear()

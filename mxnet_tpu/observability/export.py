@@ -134,6 +134,30 @@ def aggregate():
     return {"spans": spans, "counters": counters, "histograms": hists}
 
 
+def _format_timeseries():
+    """The "Time-series (last window)" aggregate-table section: one
+    line per sampled ring — points held, first/last values, and the
+    window-mean rate for counters (timeseries.py)."""
+    from . import timeseries as _ts
+    if not _ts.ticks():
+        return []
+    win = _ts.last_window()
+    lines = ["", "Time-series (last window: %d points max, %d ms "
+             "interval, %d ticks)" % (win["window"], win["interval_ms"],
+                                      win["ticks"])]
+    fmt = "  %-40s %6s %12s %12s %12s"
+    lines.append(fmt % ("Name", "Points", "First", "Last", "Rate/s"))
+    for name, ent in sorted(win["series"].items()):
+        vals = ent["values"]
+        if not vals:
+            continue
+        rs = ent.get("rate_per_s") or []
+        rate = ("%.3g" % (sum(rs) / len(rs))) if rs else "-"
+        lines.append(fmt % (name, len(vals), "%g" % vals[0],
+                            "%g" % vals[-1], rate))
+    return lines
+
+
 def aggregate_table():
     """The stats as a text table (reference AggregateStats::DumpTable):
     one section for span phases (ms), one for counters (raw values)."""
@@ -175,6 +199,9 @@ def aggregate_table():
                 "%.3f" % h["p50"], "%.3f" % h["p90"],
                 "%.3f" % h["p99"], "%.3f" % h["p999"],
                 "%.3f" % h["max"]))
+    from . import events as _events
+    lines.extend(_events.format_recent())
+    lines.extend(_format_timeseries())
     from . import dist
     lines.extend(dist.format_skew_table())
     from . import attribution
@@ -248,6 +275,16 @@ def prometheus_text():
                 lines.append(
                     'mxnet_obs_hist_quantile{name="%s",quantile="%s"} '
                     '%.6f' % (pname, q, h.percentile(q)))
+    anomalies = [(name, s) for name, s in agg["counters"].items()
+                 if name.startswith("obs.anomaly.")]
+    if anomalies:
+        lines.append("# HELP mxnet_obs_anomaly trend-detector firings "
+                     "(timeseries.py detectors over fleet history)")
+        lines.append("# TYPE mxnet_obs_anomaly counter")
+        for name, s in anomalies:
+            lines.append('mxnet_obs_anomaly_%s %g'
+                         % (_prom_name(name[len("obs.anomaly."):]),
+                            s["value"]))
     from . import dist
     lines.append("# HELP mxnet_obs_rank this process's rank (label the "
                  "scrape per worker in multi-host jobs)")

@@ -1,0 +1,416 @@
+"""Black-box flight recorder: one incident bundle per abnormal path.
+
+PRs 11-15 gave every failure class a tested *recovery*; this module
+gives them a *forensic artifact*. On any abnormal path — the exit
+taxonomy (43 watchdog / 44 shrink / 45 boundary / 46 quarantine /
+47 OOM / 143 SIGTERM), a watchdog post-mortem, a structural OOM, a
+breaker opening, a rollout rollback, a fired chaos fault, or an
+unhandled exception (``install()`` chains ``sys.excepthook``) —
+``record_incident(cause, ...)`` atomically dumps a rank-suffixed,
+CRC-framed incident bundle into the ``flight`` sideband
+(``MXNET_OBS_FLIGHT_DIR`` / ``MXNET_OBS_SIDEBAND_DIR``, defaulting to
+a per-uid temp directory so the recorder works before anyone
+configures it):
+
+    MXFLIGHT1 <crc32> <len>\\n{ json payload }
+
+The payload carries everything the post-incident questions need:
+cause + taxonomy class, the last time-series window
+(``timeseries.last_window()``), recent spans and decision events, the
+counter registry, every ``MXNET_*`` env knob, the registered
+``health_snapshot()`` providers (serving/router register themselves —
+journal positions ride in their snapshots), the membudget snapshot,
+and the checkpoint lineage head. ``tools/obs_incident.py`` merges
+bundles from many ranks/replicas on the PR 3 clock anchor.
+
+Guards: bundles are only written when telemetry is on (the PR 2
+off-path contract — with ``MXNET_OBS`` unset every hook is one guarded
+branch) and ``MXNET_OBS_FLIGHT`` is not ``0``; each distinct cause is
+capped at ``MXNET_OBS_FLIGHT_PER_CAUSE`` bundles per process (default
+4 — retry loops must not flood the sideband) and the directory is
+pruned to ``MXNET_OBS_FLIGHT_KEEP`` newest bundles (default 64).
+``record_incident`` never raises: the flight recorder must never turn
+an incident into a second incident.
+"""
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+import weakref
+import zlib
+
+from . import core
+from . import events as _events
+from . import sideband
+from . import timeseries as _ts
+from .. import _fastenv
+
+__all__ = ["MAGIC", "EXIT_TAXONOMY", "BundleError", "enabled",
+           "record_incident", "note_exit", "read_bundle",
+           "list_bundles", "last_incident", "incidents_written",
+           "register_context", "install", "reset"]
+
+MAGIC = b"MXFLIGHT1"
+SCHEMA = 1
+
+# supervisor-visible exit codes -> failure class (docs/ROBUSTNESS.md)
+EXIT_TAXONOMY = {
+    0: "done",
+    43: "watchdog_abort",
+    44: "elastic_shrink",
+    45: "elastic_boundary",
+    46: "quarantine",
+    47: "oom_structural",
+    130: "sigint",
+    143: "sigterm",
+}
+
+DEFAULT_PER_CAUSE = 4
+DEFAULT_KEEP = 64
+SPAN_TAIL = 128          # core-ring records per bundle
+EVENT_TAIL = 64          # decision events per bundle
+
+_lock = threading.Lock()
+_seq = 0
+_per_cause = {}
+_last_incident = None
+_providers = {}          # name -> weak or strong zero-arg callable
+_installed = False
+_prev_excepthook = None
+
+
+class BundleError(Exception):
+    """A bundle failed to parse; ``evidence`` names what broke
+    (``torn-header`` / ``bad-magic`` / ``torn-payload`` /
+    ``crc-mismatch`` / ``bad-json``)."""
+
+    def __init__(self, evidence, detail=""):
+        self.evidence = evidence
+        super(BundleError, self).__init__(
+            "%s%s" % (evidence, (": " + detail) if detail else ""))
+
+
+def enabled():
+    """Record bundles? Telemetry must be on AND the recorder not
+    explicitly killed — this is the one guarded branch on every
+    failure-path hook."""
+    if not core.enabled():
+        return False
+    v = _fastenv.get("MXNET_OBS_FLIGHT")
+    return v is None or v not in ("", "0", "false", "False")
+
+
+def _per_cause_cap():
+    return int(_fastenv.get("MXNET_OBS_FLIGHT_PER_CAUSE",
+                            DEFAULT_PER_CAUSE))
+
+
+def _keep():
+    return int(_fastenv.get("MXNET_OBS_FLIGHT_KEEP", DEFAULT_KEEP))
+
+
+def _slug(cause):
+    out = []
+    for ch in str(cause).lower():
+        out.append(ch if ch.isalnum() else "-")
+    s = "".join(out).strip("-")
+    while "--" in s:
+        s = s.replace("--", "-")
+    return s or "unknown"
+
+
+def classify(cause, exit_code=None):
+    """Map an incident to its taxonomy class: an explicit exit code
+    wins; otherwise the cause's leading token."""
+    if exit_code is not None and exit_code in EXIT_TAXONOMY:
+        return EXIT_TAXONOMY[exit_code]
+    head = str(cause).split(".", 1)[0]
+    return {"chaos": "chaos_fault", "exception": "unhandled_exception",
+            "watchdog": "watchdog_abort", "oom": "oom_structural",
+            "breaker": "breaker_open", "rollout": "rollout_rollback",
+            "elastic": "elastic_generation",
+            "sigterm": "sigterm"}.get(head, head)
+
+
+def register_context(name, fn):
+    """Register a zero-arg snapshot provider (e.g. a batcher's
+    ``health_snapshot``) folded into every bundle's ``health`` map.
+    Bound methods are held weakly so registration never pins a
+    serving stack in memory; a dead provider silently drops out."""
+    try:
+        ref = weakref.WeakMethod(fn)
+    except TypeError:
+        ref = lambda fn=fn: fn
+    with _lock:
+        _providers[str(name)] = ref
+
+
+def _provider_snapshots():
+    with _lock:
+        items = list(_providers.items())
+    out = {}
+    dead = []
+    for name, ref in items:
+        fn = ref()
+        if fn is None:
+            dead.append(name)
+            continue
+        try:
+            out[name] = fn()
+        except Exception as exc:       # noqa: BLE001 — best effort
+            out[name] = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    if dead:
+        with _lock:
+            for name in dead:
+                _providers.pop(name, None)
+    return out
+
+
+def _lineage_head():
+    try:
+        from ..models import checkpoint as _ckpt
+        return _ckpt.lineage_head()
+    except Exception:                  # noqa: BLE001 — best effort
+        return None
+
+
+def _rank():
+    # the barrier clock anchor's rank wins when present — it is pinned
+    # at calibration time, while jax.process_index() needs a live
+    # distributed runtime (absent in post-mortem/atexit contexts)
+    try:
+        from . import dist as _dist
+        anchor = _dist.clock_anchor()
+        if anchor and "rank" in anchor:
+            return int(anchor["rank"])
+        return _dist.process_index()
+    except Exception:                  # noqa: BLE001
+        return 0
+
+
+def _anchor():
+    try:
+        from . import dist as _dist
+        return _dist.clock_anchor()
+    except Exception:                  # noqa: BLE001
+        return None
+
+
+def _span_tail():
+    out = []
+    for rec in core.records()[-SPAN_TAIL:]:
+        ph, name, cat, ts, val, _tid, args = rec
+        if ph == "F":
+            val = list(val)
+        try:
+            json.dumps(args)
+        except (TypeError, ValueError):
+            args = {k: str(v) for k, v in args.items()}
+        out.append([ph, name, cat, ts, val, args])
+    return out
+
+
+def _payload(cause, exit_code, extra):
+    counters = {}
+    for name, c in core.counters().items():
+        counters[name] = {"value": c.value, "count": c.count,
+                          "total": c.total}
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith("MXNET_")}
+    health = _provider_snapshots()
+    try:
+        from . import membudget as _mb
+        health["membudget"] = _mb.healthz_snapshot()
+    except Exception:                  # noqa: BLE001
+        pass
+    doc = {
+        "schema": SCHEMA,
+        "cause": str(cause),
+        "taxonomy": classify(cause, exit_code),
+        "exit_code": exit_code,
+        "rank": _rank(),
+        "pid": os.getpid(),
+        "wall_time_s": time.time(),
+        "mono_us": core._now_us(),
+        "clock_anchor": _anchor(),
+        "env": env,
+        "counters": counters,
+        "events": [[t, k, f] for t, k, f in _events.recent(EVENT_TAIL)],
+        "spans": _span_tail(),
+        "timeseries": _ts.last_window(),
+        "health": health,
+        "lineage_head": _lineage_head(),
+        "dropped_records": core.dropped(),
+    }
+    if extra:
+        safe = {}
+        for k, v in extra.items():
+            try:
+                json.dumps(v)
+                safe[k] = v
+            except (TypeError, ValueError):
+                safe[k] = str(v)
+        doc["context"] = safe
+    return doc
+
+
+def frame(doc):
+    """CRC-frame a payload dict -> bytes (the on-disk bundle form)."""
+    body = json.dumps(doc, sort_keys=True,
+                      default=str).encode("utf-8")
+    head = b"%s %08x %d\n" % (MAGIC, zlib.crc32(body) & 0xFFFFFFFF,
+                              len(body))
+    return head + body
+
+
+def record_incident(cause, exit_code=None, dirpath=None, **extra):
+    """Dump one incident bundle. Returns the bundle path, or None when
+    the recorder is off, capped for this cause, or anything at all
+    goes wrong — never raises."""
+    global _seq, _last_incident
+    try:
+        if not enabled():
+            return None
+        slug = _slug(cause)
+        with _lock:
+            n = _per_cause.get(slug, 0)
+            if n >= _per_cause_cap():
+                return None
+            _per_cause[slug] = n + 1
+            _seq += 1
+            seq = _seq
+        d = dirpath or sideband.resolve("flight", create=True)
+        if not d:
+            return None
+        doc = _payload(cause, exit_code, extra)
+        name = ("incident.%s.rank%d.pid%d.%03d.json"
+                % (slug, doc["rank"], os.getpid(), seq))
+        path = os.path.join(d, name)
+        sideband.write_atomic(path, frame(doc))
+        sideband.prune(d, prefix="incident.", keep=_keep())
+        with _lock:
+            _last_incident = path
+        core.counter("obs.incidents").add(1)
+        return path
+    except Exception:                  # noqa: BLE001 — never raise
+        return None
+
+
+def note_exit(code, cause=None, **extra):
+    """The exit-taxonomy hook: record a bundle for a supervisor-visible
+    abnormal exit code (no-op for 0). Returns the bundle path."""
+    code = int(code)
+    if code == 0:
+        return None
+    if cause is None:
+        cause = "exit." + EXIT_TAXONOMY.get(code, "crash")
+    return record_incident(cause, exit_code=code, **extra)
+
+
+def read_bundle(path):
+    """Parse + verify one bundle. Raises BundleError with named
+    evidence on torn or corrupt files."""
+    with open(path, "rb") as f:
+        data = f.read()
+    nl = data.find(b"\n")
+    if nl < 0:
+        raise BundleError("torn-header", "no newline in %d bytes"
+                          % len(data))
+    parts = data[:nl].split()
+    if len(parts) != 3 or parts[0] != MAGIC:
+        raise BundleError("bad-magic", repr(data[:nl][:64]))
+    try:
+        want_crc = int(parts[1], 16)
+        want_len = int(parts[2])
+    except ValueError:
+        raise BundleError("bad-magic", repr(data[:nl][:64]))
+    body = data[nl + 1:]
+    if len(body) != want_len:
+        raise BundleError("torn-payload", "expected %d bytes, found %d"
+                          % (want_len, len(body)))
+    got_crc = zlib.crc32(body) & 0xFFFFFFFF
+    if got_crc != want_crc:
+        raise BundleError("crc-mismatch", "expected %08x, computed %08x"
+                          % (want_crc, got_crc))
+    try:
+        return json.loads(body.decode("utf-8"))
+    except ValueError as exc:
+        raise BundleError("bad-json", str(exc))
+
+
+def list_bundles(dirpath=None):
+    """Bundle paths under the flight sideband (or ``dirpath``), oldest
+    first by (mtime, name)."""
+    d = dirpath or sideband.resolve("flight")
+    if not d or not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        if name.startswith("incident.") and name.endswith(".json"):
+            p = os.path.join(d, name)
+            try:
+                out.append((os.path.getmtime(p), name, p))
+            except OSError:
+                continue
+    return [p for _m, _n, p in sorted(out)]
+
+
+def last_incident():
+    """Path of the newest bundle this process wrote (``/healthz``)."""
+    with _lock:
+        return _last_incident
+
+
+def incidents_written():
+    with _lock:
+        return sum(_per_cause.values())
+
+
+def _excepthook(etype, value, tb):
+    try:
+        frames = traceback.format_exception(etype, value, tb)
+        record_incident(
+            "exception.%s" % etype.__name__, error=str(value),
+            traceback=[ln.rstrip() for ln in frames][-20:])
+    except Exception:                  # noqa: BLE001 — never mask
+        pass
+    if _prev_excepthook is not None:
+        _prev_excepthook(etype, value, tb)
+
+
+def _atexit():
+    # debug knob: force a shutdown bundle even on clean exits (the
+    # excepthook already covers crashes; explicit hooks cover the
+    # exit taxonomy, whose os._exit paths skip atexit anyway)
+    v = _fastenv.get("MXNET_OBS_FLIGHT_ATEXIT")
+    if v and v not in ("0", "false", "False"):
+        record_incident("atexit.shutdown")
+
+
+def install():
+    """Chain the unhandled-exception hook (and the atexit debug hook)
+    once per process. Called from the observability package import
+    when telemetry is on; a no-op (one guarded branch) otherwise."""
+    global _installed, _prev_excepthook
+    if _installed or not enabled():
+        return False
+    _installed = True
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _excepthook
+    atexit.register(_atexit)
+    return True
+
+
+def reset():
+    """Forget per-process incident state (tests). Does not uninstall
+    the excepthook."""
+    global _seq, _per_cause, _last_incident
+    with _lock:
+        _seq = 0
+        _per_cause = {}
+        _last_incident = None
+        _providers.clear()

@@ -47,6 +47,9 @@ _failed = False
 def _healthz():
     """The /healthz JSON snapshot (also what tests assert on)."""
     from . import dist, export, slo
+    from . import events as _ev
+    from . import flight as _flight
+    from . import timeseries as _ts
     from . import histogram as _hist
     agg = export.aggregate()
     try:
@@ -71,6 +74,16 @@ def _healthz():
         "slo": {"targets": dict(slo.targets()),
                 "attainment": slo.attainment()},
         "mem": mem,
+        "events": {"depth": _ev.depth(), "dropped": _ev.dropped(),
+                   "kinds": _ev.counts()},
+        "flight": {"last_incident": _flight.last_incident(),
+                   "incidents": _flight.incidents_written()},
+        "anomalies": {name[len("obs.anomaly."):]: s["value"]
+                      for name, s in agg["counters"].items()
+                      if name.startswith("obs.anomaly.")},
+        "timeseries": {"ticks": _ts.ticks(),
+                       "series": len(_ts.names()),
+                       "sampler_running": _ts.running()},
     }
 
 
@@ -126,6 +139,10 @@ def start(port):
         _thread = threading.Thread(target=_server.serve_forever,
                                    name="mxnet-obs-http", daemon=True)
         _thread.start()
+        # a live-scraped process wants trends, not just last values:
+        # kick the bounded time-series sampler (no-op when obs is off)
+        from . import timeseries as _ts
+        _ts.maybe_start()
         return _server.server_address[1]
 
 

@@ -418,6 +418,10 @@ def quarantine(evidence, exit=None):
                                                         evidence),
           file=sys.stderr, flush=True)
     sys.stdout.flush()
+    from . import flight as _flight
+    _flight.record_incident(
+        "integrity.quarantine", exit_code=QUARANTINE_EXIT_CODE,
+        quarantine_rank=rank, generation=gen, evidence=evidence)
     if exit is not None:
         exit(QUARANTINE_EXIT_CODE)
         return

@@ -497,6 +497,9 @@ def checkpoint_and_exit(reason="oom"):
         core.record_instant("mem.oom_exit", cat="mem",
                             args={"reason": str(reason),
                                   "checkpoint": path})
+    from . import flight as _flight
+    _flight.record_incident("oom.structural", exit_code=OOM_EXIT_CODE,
+                            reason=str(reason), checkpoint=path)
     raise SystemExit(OOM_EXIT_CODE)
 
 

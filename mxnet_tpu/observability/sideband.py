@@ -1,0 +1,141 @@
+"""One resolver for every file sideband the runtime writes.
+
+Three subsystems grew their own "drop a small file next to the run"
+channel — the watchdog check-in/post-mortem directory
+(``MXNET_OBS_WATCHDOG_DIR``), the elastic supervisor's generation /
+shrink / quarantine records (``MXNET_ELASTIC_DIR``), and the flight
+recorder's incident bundles (``MXNET_OBS_FLIGHT_DIR``, PR 17) — each
+with its own env knob and its own ad-hoc cleanup. This module is the
+single place that turns a *kind* into a directory and keeps any of
+them from growing without bound:
+
+* ``resolve(kind)`` — the kind-specific env knob wins; otherwise the
+  shared root ``MXNET_OBS_SIDEBAND_DIR`` provides ``<root>/<kind>``;
+  otherwise the kind's default (``None`` for watchdog/elastic — those
+  sidebands are opt-in — and a per-user temp directory for ``flight``,
+  because a flight recorder that needs configuring before a crash is
+  not a flight recorder).
+* ``write_atomic(path, data)`` — tmp + ``os.replace`` in the target
+  directory, the same torn-write discipline as the prometheus textfile
+  and the watchdog check-ins.
+* ``prune(dirpath, ...)`` — bounded retention by count and/or age so
+  long-lived supervisors don't leak sideband files; deterministic
+  under an injected ``now`` for tests.
+
+Resolution never creates directories unless asked (``create=True``)
+and never raises on a missing root — sidebands are telemetry, and
+telemetry must never break the run.
+"""
+
+import os
+import tempfile
+
+from .. import _fastenv
+
+__all__ = ["KINDS", "resolve", "root", "write_atomic", "prune"]
+
+# kind -> (dedicated env knob, default when neither knob nor root set)
+KINDS = {
+    "watchdog": ("MXNET_OBS_WATCHDOG_DIR", None),
+    "elastic": ("MXNET_ELASTIC_DIR", None),
+    "flight": ("MXNET_OBS_FLIGHT_DIR", "__tmp__"),
+}
+
+ROOT_ENV = "MXNET_OBS_SIDEBAND_DIR"
+
+
+def root():
+    """The shared sideband root (``MXNET_OBS_SIDEBAND_DIR``) or None."""
+    return _fastenv.get(ROOT_ENV) or None
+
+
+def _flight_default():
+    # per-uid so a shared /tmp host doesn't cross-contaminate bundles
+    try:
+        uid = os.getuid()
+    except AttributeError:             # pragma: no cover - non-posix
+        uid = 0
+    return os.path.join(tempfile.gettempdir(),
+                        "mxnet_obs_incidents.%d" % uid)
+
+
+def resolve(kind, create=False):
+    """Directory for ``kind`` (one of ``KINDS``): the kind's own env
+    knob beats the shared root beats the kind default. Returns None
+    when the sideband is unconfigured and has no default."""
+    try:
+        env_key, default = KINDS[kind]
+    except KeyError:
+        raise ValueError("unknown sideband kind %r (have %s)"
+                         % (kind, sorted(KINDS)))
+    path = _fastenv.get(env_key) or None
+    if path is None:
+        shared = root()
+        if shared:
+            path = os.path.join(shared, kind)
+        elif default == "__tmp__":
+            path = _flight_default()
+        else:
+            path = default
+    if path and create:
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError:                # pragma: no cover - fs race/perm
+            return None
+    return path
+
+
+def write_atomic(path, data):
+    """Write ``data`` (bytes or str) to ``path`` via a same-directory
+    tmp file + ``os.replace`` — a reader never sees a torn file, only
+    the old content or the new. Returns ``path``."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def prune(dirpath, prefix="", keep=None, ttl_s=None, now=None):
+    """Bounded retention for a sideband directory: of the files whose
+    basename starts with ``prefix``, delete everything beyond the
+    ``keep`` newest (by mtime) and everything older than ``ttl_s``
+    seconds relative to ``now`` (default: the directory's newest
+    mtime, so a wholly-idle sideband is never aged out by wall time
+    alone). Missing directories and racing deletes are silently fine.
+    Returns the list of removed paths (tests assert on it)."""
+    if not dirpath or not os.path.isdir(dirpath):
+        return []
+    entries = []
+    for name in os.listdir(dirpath):
+        if prefix and not name.startswith(prefix):
+            continue
+        p = os.path.join(dirpath, name)
+        try:
+            if not os.path.isfile(p):
+                continue
+            entries.append((os.path.getmtime(p), p))
+        except OSError:
+            continue
+    entries.sort(reverse=True)         # newest first
+    removed = []
+    victims = []
+    if keep is not None and len(entries) > keep:
+        victims.extend(entries[keep:])
+        entries = entries[:keep]
+    if ttl_s is not None and entries:
+        ref = now if now is not None else entries[0][0]
+        victims.extend((m, p) for m, p in entries if ref - m > ttl_s)
+    for _mtime, p in victims:
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            continue
+    return removed

@@ -1,0 +1,277 @@
+"""Bounded time-series sampler + trend detectors over the PR 2 core.
+
+Every gauge in the stack is last-value-only and every counter is a
+running total — neither an operator nor the router can see a *trend*
+(a KV-block leak, an SLO attainment slide before a rollback, a retrace
+storm). This module closes that gap without unbounding memory: a
+daemon tick every ``MXNET_OBS_TS_INTERVAL_MS`` (default 1000; 0
+disables the thread, manual ``tick()`` still works) snapshots
+
+* every counter/gauge's current value, and
+* every histogram's per-window **delta** (observations and sum since
+  the previous tick — the activity in the interval, not the lifetime
+  total)
+
+into fixed-size rings of ``MXNET_OBS_TS_WINDOW`` points (default 240 —
+four minutes of history at the default interval). ``rates(name)``
+derives per-second rates from a counter's ring (the numpy reference is
+``np.diff(v) / np.diff(t) * 1e6``); ``last_window()`` is the
+flight-recorder / aggregate-table export shape.
+
+The PR 2 contract holds: with ``MXNET_OBS`` unset nothing here runs —
+``maybe_start()`` is one guarded branch, no thread is created, no ring
+is allocated.
+
+The trend detectors at the bottom are pure functions over numeric
+sequences with explicit thresholds — the router feeds them fleet
+history, tests feed them synthetic series, and the thresholds are
+policy (env-tunable at the call site), not code.
+"""
+
+import threading
+import time
+
+from . import core
+from . import histogram as _hist
+from .. import _fastenv
+
+__all__ = ["DEFAULT_INTERVAL_MS", "DEFAULT_WINDOW", "interval_ms",
+           "window", "tick", "ticks", "names", "series", "rates",
+           "last_window", "maybe_start", "stop", "running", "reset",
+           "slope", "detect_leak", "detect_slide", "detect_collapse",
+           "detect_storm", "AnomalyWarning"]
+
+DEFAULT_INTERVAL_MS = 1000
+DEFAULT_WINDOW = 240
+
+
+class AnomalyWarning(RuntimeWarning):
+    """A fleet trend detector fired (KV leak, SLO slide, throughput
+    collapse, retrace storm). Warned once per (detector, source) —
+    the ``obs.anomaly.*`` counters track persistence."""
+
+_lock = threading.Lock()
+_series = {}              # name -> list ring of (t_us, value)
+_kinds = {}               # name -> "counter" | "gauge" | "hist_count" | "hist_sum"
+_heads = {}               # name -> next write index
+_ticks = 0
+_last_hist = {}           # hist name -> (count, sum) at previous tick
+_thread = None
+_stop = threading.Event()
+
+
+def interval_ms():
+    return int(float(_fastenv.get("MXNET_OBS_TS_INTERVAL_MS",
+                                  DEFAULT_INTERVAL_MS)))
+
+
+def window():
+    return max(int(_fastenv.get("MXNET_OBS_TS_WINDOW", DEFAULT_WINDOW)),
+               2)
+
+
+def _push(name, kind, t_us, value, cap):
+    ring = _series.get(name)
+    if ring is None:
+        ring = _series[name] = [None] * cap
+        _kinds[name] = kind
+        _heads[name] = 0
+    h = _heads[name]
+    ring[h % len(ring)] = (t_us, float(value))
+    _heads[name] = h + 1
+
+
+def tick(now_us=None):
+    """One sampler tick: snapshot all counters/gauges + histogram
+    deltas into the rings. Returns the tick's timestamp (us on the
+    core trace timebase) or None when telemetry is off."""
+    global _ticks
+    if not core.enabled():
+        return None
+    t_us = core._now_us() if now_us is None else int(now_us)
+    counters = core.counters()
+    hstates = _hist.states()
+    cap = window()
+    with _lock:
+        for name, c in counters.items():
+            kind = "gauge" if isinstance(c, core.Gauge) else "counter"
+            _push(name, kind, t_us, c.value, cap)
+        for name, st in hstates.items():
+            cnt = int(st.get("count", 0))
+            tot = float(st.get("sum", 0.0))
+            p_cnt, p_tot = _last_hist.get(name, (0, 0.0))
+            _last_hist[name] = (cnt, tot)
+            _push(name + ".win_count", "hist_count", t_us,
+                  cnt - p_cnt, cap)
+            _push(name + ".win_sum", "hist_sum", t_us, tot - p_tot, cap)
+        _ticks += 1
+    return t_us
+
+
+def ticks():
+    """Sampler ticks taken since the last reset()."""
+    with _lock:
+        return _ticks
+
+
+def names():
+    with _lock:
+        return sorted(_series)
+
+
+def series(name):
+    """The ring for ``name``, oldest first: list of (t_us, value)."""
+    with _lock:
+        ring = _series.get(name)
+        if ring is None:
+            return []
+        h = _heads[name]
+        n = len(ring)
+        if h <= n:
+            return [p for p in ring[:h] if p is not None]
+        return [p for p in ring[h % n:] + ring[:h % n] if p is not None]
+
+
+def rates(name):
+    """Per-second rates derived from a counter ring: successive
+    ``(v1 - v0) / (t1 - t0 in s)``; one element shorter than the ring.
+    numpy reference: ``np.diff(v) / np.diff(t) * 1e6``."""
+    pts = series(name)
+    out = []
+    for (t0, v0), (t1, v1) in zip(pts, pts[1:]):
+        dt = t1 - t0
+        out.append((v1 - v0) / (dt / 1e6) if dt > 0 else 0.0)
+    return out
+
+
+def last_window():
+    """Export shape for the flight recorder and the aggregate table:
+    every ring's points plus derived rates for counters."""
+    out = {"interval_ms": interval_ms(), "window": window(),
+           "ticks": ticks(), "series": {}}
+    for name in names():
+        pts = series(name)
+        ent = {"kind": _kinds.get(name, "gauge"),
+               "t_us": [t for t, _v in pts],
+               "values": [v for _t, v in pts]}
+        if ent["kind"] == "counter":
+            ent["rate_per_s"] = rates(name)
+        out["series"][name] = ent
+    return out
+
+
+def _run():                            # pragma: no cover - thread body
+    while not _stop.wait(max(interval_ms(), 1) / 1000.0):
+        try:
+            tick()
+        except Exception:              # noqa: BLE001 — sampler never dies
+            pass
+
+
+def maybe_start():
+    """Start the daemon sampler thread if telemetry is on and the
+    interval is nonzero. Idempotent; one guarded branch when off."""
+    global _thread
+    if not core.enabled() or interval_ms() <= 0:
+        return False
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return True
+        _stop.clear()
+        _thread = threading.Thread(target=_run, daemon=True,
+                                   name="mxnet-obs-ts")
+        _thread.start()
+    return True
+
+
+def running():
+    with _lock:
+        return _thread is not None and _thread.is_alive()
+
+
+def stop(timeout=2.0):
+    """Stop the daemon thread (tests, profiler teardown)."""
+    global _thread
+    t = _thread
+    if t is None:
+        return
+    _stop.set()
+    t.join(timeout)
+    with _lock:
+        _thread = None
+
+
+def reset():
+    """Forget every ring and the histogram-delta baseline (tests)."""
+    global _ticks
+    with _lock:
+        _series.clear()
+        _kinds.clear()
+        _heads.clear()
+        _last_hist.clear()
+        _ticks = 0
+
+
+# ---------------------------------------------------------------------
+# trend detectors — pure functions, thresholds are the caller's policy
+# ---------------------------------------------------------------------
+
+def slope(values):
+    """Least-squares slope of ``values`` against their indices
+    (numpy reference: ``np.polyfit(range(n), values, 1)[0]``)."""
+    n = len(values)
+    if n < 2:
+        return 0.0
+    sx = (n - 1) * n / 2.0
+    sxx = (n - 1) * n * (2 * n - 1) / 6.0
+    sy = float(sum(values))
+    sxy = float(sum(i * v for i, v in enumerate(values)))
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        return 0.0
+    return (n * sxy - sx * sy) / denom
+
+
+def detect_leak(free_blocks, occupancy, min_points=8, min_drop=1.0):
+    """KV-block leak at idle: over a window where the replica held NO
+    work (every occupancy sample zero), its free-block gauge still
+    trended down by at least ``min_drop`` blocks. Free blocks falling
+    under load is normal; falling while idle means blocks left the
+    pool and never came back."""
+    if len(free_blocks) < min_points or len(occupancy) < min_points:
+        return False
+    if any(o > 0 for o in occupancy):
+        return False
+    return (slope(free_blocks) < 0
+            and free_blocks[0] - free_blocks[-1] >= min_drop)
+
+
+def _head_tail_means(values):
+    q = max(len(values) // 4, 1)
+    head = values[:q]
+    tail = values[-q:]
+    return sum(head) / len(head), sum(tail) / len(tail)
+
+
+def detect_slide(values, drop=0.2, min_points=8):
+    """SLO attainment slide: the window's tail-quarter mean fell at
+    least ``drop`` (fraction) below its head-quarter mean — the shape
+    that precedes a post-swap rollback."""
+    if len(values) < min_points:
+        return False
+    head, tail = _head_tail_means(values)
+    return head > 0 and tail <= head * (1.0 - drop)
+
+
+def detect_collapse(values, drop=0.5, min_points=8):
+    """Throughput collapse: same head/tail comparison as the slide
+    detector but for rate-like series, with a deeper default drop —
+    half the window's opening throughput gone by its close."""
+    return detect_slide(values, drop=drop, min_points=min_points)
+
+
+def detect_storm(deltas, threshold=3):
+    """Retrace storm: at least ``threshold`` recompiles landed inside
+    the window (``deltas`` are per-tick recompile-count increments —
+    steady state after warmup is zero)."""
+    return sum(deltas) >= threshold

@@ -90,8 +90,11 @@ def enabled():
 
 
 def sideband_dir():
-    """Shared directory for cross-rank check-in files (optional)."""
-    return _fastenv.get("MXNET_OBS_WATCHDOG_DIR")
+    """Shared directory for cross-rank check-in files (optional) —
+    ``MXNET_OBS_WATCHDOG_DIR``, or ``<MXNET_OBS_SIDEBAND_DIR>/watchdog``
+    under the unified sideband root (observability.sideband)."""
+    from . import sideband as _sb
+    return _sb.resolve("watchdog")
 
 
 def _rank():
@@ -219,6 +222,11 @@ class CollectiveWatchdog(object):
             "watchdog timeout on rank %d — post-mortem dumped"
             % (op["name"], self.timeout, self.rank),
             RuntimeWarning, stacklevel=2)
+        from . import flight as _flight
+        _flight.record_incident(
+            "watchdog.hang", collective=op["name"],
+            armed_s=round(self.clock() - op["t0"], 3),
+            action=self.escalation, postmortem=report)
         self._escalate(op)
 
     # ------------------------------------------------------ escalation --

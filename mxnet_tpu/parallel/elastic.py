@@ -87,10 +87,13 @@ DEFAULT_MISS = 3
 # ------------------------------------------------------------ env knobs --
 
 def elastic_dir():
-    """MXNET_ELASTIC_DIR: the rendezvous sideband directory. Falls back
-    to MXNET_OBS_WATCHDOG_DIR — one shared directory serves both the
-    watchdog check-in and the elastic membership protocol."""
-    return _fastenv.get("MXNET_ELASTIC_DIR") \
+    """MXNET_ELASTIC_DIR: the rendezvous sideband directory, resolved
+    through the unified ``observability.sideband`` helper (the shared
+    ``MXNET_OBS_SIDEBAND_DIR`` root serves it at ``<root>/elastic``).
+    Falls back to MXNET_OBS_WATCHDOG_DIR — one shared directory serves
+    both the watchdog check-in and the elastic membership protocol."""
+    from ..observability import sideband as _sb
+    return _sb.resolve("elastic") \
         or _fastenv.get("MXNET_OBS_WATCHDOG_DIR")
 
 
@@ -586,6 +589,7 @@ class ElasticCoordinator(object):
                              & quarantined_ranks(self.dir,
                                                  self.generation))
         from ..observability import core as _obs
+        from ..observability import events as _events
         if _obs.enabled():
             _obs.counter("elastic.shrink").add(1)
             _obs.record_instant(
@@ -594,6 +598,11 @@ class ElasticCoordinator(object):
                       "dead": sorted(int(r) for r in dead),
                       "quarantined": quarantined,
                       "survivors": survivors, "step": step})
+            _events.event("elastic", change="shrink",
+                          generation=self.generation,
+                          to_generation=self.generation + 1,
+                          dead=sorted(int(r) for r in dead),
+                          world=len(survivors), step=step)
         print("[elastic] rank %d g%d: peer(s) %s dead — capturing "
               "shard %d/%d at step %d and leaving for generation %d"
               % (self.rank, self.generation,
@@ -634,6 +643,12 @@ class ElasticCoordinator(object):
         except OSError:
             pass
         self.heartbeat.stop()
+        from ..observability import flight as _flight
+        _flight.record_incident(
+            "elastic.shrink", exit_code=SHRINK_EXIT_CODE,
+            generation=self.generation, dead=sorted(dead),
+            survivors=len(survivors), step=step,
+            quarantined=sorted(quarantined or []))
         if self._exit is not None:
             self._exit(SHRINK_EXIT_CODE)
         else:                            # pragma: no cover - fatal
@@ -649,6 +664,15 @@ class ElasticCoordinator(object):
         responsible for having saved a resumable checkpoint first."""
         self._shrunk.set()      # disarm: leaving deliberately
         self.heartbeat.stop()
+        from ..observability import core as _obs
+        from ..observability import events as _events
+        from ..observability import flight as _flight
+        if _obs.enabled():
+            _events.event("elastic", change="boundary",
+                          generation=self.generation)
+        _flight.record_incident(
+            "elastic.boundary", exit_code=BOUNDARY_EXIT_CODE,
+            generation=self.generation)
         if self._exit is not None:
             self._exit(BOUNDARY_EXIT_CODE)
         else:                            # pragma: no cover - fatal
@@ -765,6 +789,9 @@ def observe_recovery(generation=None, d=None):
                             args={"generation": generation,
                                   "kind": kind,
                                   "ms": round(ms, 3)})
+        from ..observability import events as _events
+        _events.event("elastic", change=kind or "recovered",
+                      generation=generation, ms=round(ms, 3))
     return ms
 
 

@@ -1,0 +1,321 @@
+"""Black-box flight recorder (PR 17): bounded time-series rings +
+rate derivation checked against numpy references, CRC-framed incident
+bundles with named corruption evidence, deterministic trend-detector
+thresholds, the chained excepthook (subprocess), the obs_incident
+multi-rank merge, and the MXNET_OBS-unset off-path contract."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.observability import core, events, flight, histogram
+from mxnet_tpu.observability import timeseries as ts
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _reset_all():
+    core.set_enabled(None)
+    core.reset()
+    ts.stop()
+    ts.reset()
+    events.reset()
+    flight.reset()
+
+
+@pytest.fixture
+def obs_on(monkeypatch, tmp_path):
+    """Enabled telemetry + an isolated flight sideband for one test."""
+    monkeypatch.setenv("MXNET_OBS", "1")
+    monkeypatch.setenv("MXNET_OBS_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("MXNET_OBS_TS_INTERVAL_MS", "0")  # manual ticks
+    _reset_all()
+    yield tmp_path
+    _reset_all()
+
+
+@pytest.fixture
+def obs_off(monkeypatch):
+    monkeypatch.delenv("MXNET_OBS", raising=False)
+    _reset_all()
+    yield
+    _reset_all()
+
+
+# --------------------------------------------- time-series rings --
+
+def test_rates_match_numpy_reference(obs_on):
+    c = core.counter("flighttest.requests")
+    t_us = [1_000_000, 2_000_000, 2_500_000, 4_000_000, 4_100_000]
+    vals = [3, 10, 10, 16, 17]
+    prev = 0
+    for t, v in zip(t_us, vals):
+        c.add(v - prev)
+        prev = v
+        ts.tick(now_us=t)
+    pts = ts.series("flighttest.requests")
+    assert [t for t, _v in pts] == t_us
+    assert [v for _t, v in pts] == [float(v) for v in vals]
+    want = np.diff(np.asarray(vals, float)) / np.diff(t_us) * 1e6
+    got = ts.rates("flighttest.requests")
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+    win = ts.last_window()
+    ent = win["series"]["flighttest.requests"]
+    assert ent["kind"] == "counter"
+    np.testing.assert_allclose(ent["rate_per_s"], want, rtol=1e-12)
+    assert win["ticks"] == len(t_us)
+
+
+def test_ring_is_bounded_and_keeps_newest(obs_on, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_TS_WINDOW", "4")
+    g = core.gauge("flighttest.gauge")
+    for i in range(10):
+        g.set(i)
+        ts.tick(now_us=(i + 1) * 1_000_000)
+    pts = ts.series("flighttest.gauge")
+    assert len(pts) == 4
+    assert [v for _t, v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_histogram_window_deltas(obs_on):
+    h = histogram.histogram("flighttest.lat_ms", unit="ms")
+    h.observe(2.0)
+    h.observe(4.0)
+    ts.tick(now_us=1_000_000)
+    h.observe(8.0)
+    ts.tick(now_us=2_000_000)
+    ts.tick(now_us=3_000_000)      # quiet interval -> zero delta
+    cnt = [v for _t, v in ts.series("flighttest.lat_ms.win_count")]
+    tot = [v for _t, v in ts.series("flighttest.lat_ms.win_sum")]
+    assert cnt == [2.0, 1.0, 0.0]
+    assert tot == [6.0, 8.0, 0.0]
+
+
+def test_slope_matches_polyfit(obs_on):
+    rng = np.random.RandomState(7)
+    vals = list(np.cumsum(rng.randn(32)))
+    want = np.polyfit(np.arange(len(vals)), vals, 1)[0]
+    assert ts.slope(vals) == pytest.approx(want, rel=1e-9)
+    assert ts.slope([5.0]) == 0.0
+
+
+# ------------------------------------------------ trend detectors --
+
+def test_detect_leak_thresholds(obs_on):
+    free = [100.0 - i for i in range(8)]      # 7 blocks gone at idle
+    idle = [0] * 8
+    assert ts.detect_leak(free, idle, min_points=8, min_drop=1.0)
+    # under load the same slide is normal
+    assert not ts.detect_leak(free, [0] * 7 + [1], min_points=8,
+                              min_drop=1.0)
+    # too-short window never fires
+    assert not ts.detect_leak(free[:7], idle[:7], min_points=8,
+                              min_drop=1.0)
+    # drop smaller than min_drop never fires
+    assert not ts.detect_leak([100.0] * 7 + [99.5], idle,
+                              min_points=8, min_drop=1.0)
+
+
+def test_detect_slide_and_collapse_thresholds(obs_on):
+    flat = [0.99] * 16
+    slide = [1.0] * 8 + [0.75] * 8            # tail 25% under head
+    assert not ts.detect_slide(flat, drop=0.2, min_points=8)
+    assert ts.detect_slide(slide, drop=0.2, min_points=8)
+    assert not ts.detect_slide(slide, drop=0.3, min_points=8)
+    assert not ts.detect_slide(slide[:4], drop=0.2, min_points=8)
+    tput = [1000.0] * 8 + [400.0] * 8         # 60% of opening gone
+    assert ts.detect_collapse(tput, drop=0.5, min_points=8)
+    assert not ts.detect_collapse(tput, drop=0.7, min_points=8)
+
+
+def test_detect_storm_threshold(obs_on):
+    assert ts.detect_storm([0, 1, 0, 2], threshold=3)
+    assert not ts.detect_storm([0, 1, 0, 1], threshold=3)
+
+
+# ------------------------------------------------ incident bundles --
+
+def test_bundle_roundtrip_carries_forensics(obs_on):
+    core.counter("flighttest.requests").add(5)
+    events.event("admit", rid="r1", lane=0)
+    ts.tick(now_us=1_000_000)
+    flight.register_context("unit", lambda: {"ok": True})
+    path = flight.record_incident("chaos.nan", site="step", step=3)
+    assert path and os.path.exists(path)
+    doc = flight.read_bundle(path)
+    assert doc["cause"] == "chaos.nan"
+    assert doc["taxonomy"] == "chaos_fault"
+    assert doc["counters"]["flighttest.requests"]["value"] == 5
+    assert [k for _t, k, _f in doc["events"]] == ["admit"]
+    assert "flighttest.requests" in doc["timeseries"]["series"]
+    assert doc["health"]["unit"] == {"ok": True}
+    assert doc["context"] == {"site": "step", "step": 3}
+    assert doc["env"].get("MXNET_OBS") == "1"
+    assert flight.last_incident() == path
+    assert flight.list_bundles() == [path]
+
+
+@pytest.mark.parametrize("mangle,evidence", [
+    (lambda b: b[:5], "torn-header"),
+    (lambda b: b"BOGUS" + b[5:], "bad-magic"),
+    (lambda b: b[:-7], "torn-payload"),
+    (lambda b: b[:-1] + (b"X" if b[-1:] != b"X" else b"Y"),
+     "crc-mismatch"),
+])
+def test_corrupt_bundle_names_evidence(obs_on, mangle, evidence):
+    path = flight.record_incident("chaos.crash")
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(mangle(data))
+    with pytest.raises(flight.BundleError) as err:
+        flight.read_bundle(path)
+    assert err.value.evidence == evidence
+
+
+def test_crc_valid_but_bad_json_named(obs_on, tmp_path):
+    import zlib
+    body = b"{this is not json"
+    head = b"%s %08x %d\n" % (flight.MAGIC,
+                              zlib.crc32(body) & 0xFFFFFFFF, len(body))
+    p = tmp_path / "flight" / "incident.byhand.rank0.pid1.001.json"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(head + body)
+    with pytest.raises(flight.BundleError) as err:
+        flight.read_bundle(str(p))
+    assert err.value.evidence == "bad-json"
+
+
+def test_per_cause_cap_and_exit_taxonomy(obs_on, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_FLIGHT_PER_CAUSE", "2")
+    for _ in range(5):
+        flight.record_incident("chaos.error")
+    assert len(flight.list_bundles()) == 2
+    assert flight.incidents_written() == 2
+    path = flight.note_exit(47)
+    doc = flight.read_bundle(path)
+    assert doc["cause"] == "exit.oom_structural"
+    assert doc["taxonomy"] == "oom_structural"
+    assert doc["exit_code"] == 47
+    assert flight.note_exit(0) is None
+
+
+# --------------------------------------------- excepthook (crash) --
+
+def test_excepthook_writes_bundle_in_subprocess(obs_on, tmp_path):
+    d = str(tmp_path / "crashflight")
+    env = dict(os.environ)
+    env.update({"MXNET_OBS": "1", "MXNET_OBS_FLIGHT_DIR": d,
+                "JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import mxnet_tpu\nraise ValueError('flight-test-boom')"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode != 0
+    assert "flight-test-boom" in r.stderr    # excepthook chains through
+    bundles = flight.list_bundles(d)
+    assert len(bundles) == 1
+    doc = flight.read_bundle(bundles[0])
+    assert doc["cause"] == "exception.ValueError"
+    assert doc["taxonomy"] == "unhandled_exception"
+    assert doc["context"]["error"] == "flight-test-boom"
+    assert any("flight-test-boom" in ln
+               for ln in doc["context"]["traceback"])
+
+
+# ------------------------------------------- obs_incident merge --
+
+def _fake_bundle(dirpath, rank, mono_us, wall_s, cause, anchor_mono):
+    doc = {"schema": 1, "cause": cause,
+           "taxonomy": flight.classify(cause), "exit_code": None,
+           "rank": rank, "pid": 1000 + rank, "wall_time_s": wall_s,
+           "mono_us": mono_us,
+           "clock_anchor": {"rank": rank, "nprocs": 2,
+                            "mono_us": anchor_mono,
+                            "wall_us": int(wall_s * 1e6),
+                            "barrier": "test"},
+           "env": {}, "counters": {},
+           "events": [[mono_us - 10, "admit", {"rid": "r%d" % rank}]],
+           "spans": [], "timeseries": {"series": {}}, "health": {},
+           "lineage_head": None, "dropped_records": 0}
+    name = "incident.%s.rank%d.pid%d.001.json" % (
+        cause.replace(".", "-"), rank, 1000 + rank)
+    path = os.path.join(dirpath, name)
+    with open(path, "wb") as f:
+        f.write(flight.frame(doc))
+    return path
+
+
+def test_obs_incident_merges_two_ranks(obs_on, tmp_path, capsys):
+    d0 = tmp_path / "fl0"
+    d1 = tmp_path / "fl1"
+    d0.mkdir()
+    d1.mkdir()
+    # rank 1's monotonic clock is 5s ahead at the anchor barrier; its
+    # incident lands 2s after rank 0's on the aligned timebase
+    _fake_bundle(str(d0), 0, mono_us=10_000_000, wall_s=100.0,
+                 cause="chaos.crash", anchor_mono=1_000_000)
+    _fake_bundle(str(d1), 1, mono_us=17_000_000, wall_s=100.0,
+                 cause="watchdog.hang", anchor_mono=6_000_000)
+    obs_incident = _load_tool("obs_incident")
+    out_json = str(tmp_path / "merged.json")
+    rc = obs_incident.main([str(d0), str(d1), "--events", "2",
+                            "--json", out_json])
+    assert rc == 0
+    out = capsys.readouterr().out
+    i_crash = out.index("chaos.crash")
+    i_hang = out.index("watchdog.hang")
+    assert i_crash < i_hang                   # merged, aligned order
+    assert "UNALIGNED" not in out
+    with open(out_json) as f:
+        merged = json.load(f)
+    assert len(merged["bundles"]) == 2
+    ts_by_cause = {b["cause"]: b["t_us"] for b in merged["bundles"]}
+    assert (ts_by_cause["watchdog.hang"]
+            - ts_by_cause["chaos.crash"]) == 2_000_000
+    assert merged["unreadable"] == []
+
+
+def test_obs_incident_flags_unreadable(obs_on, tmp_path, capsys):
+    d = tmp_path / "fl"
+    d.mkdir()
+    _fake_bundle(str(d), 0, mono_us=10_000_000, wall_s=100.0,
+                 cause="chaos.nan", anchor_mono=1_000_000)
+    torn = d / "incident.torn.rank0.pid7.002.json"
+    torn.write_bytes(b"MXFLIGHT1 00000000 99\n{")
+    obs_incident = _load_tool("obs_incident")
+    rc = obs_incident.main([str(d)])
+    assert rc == 0                            # 1 good bundle remains
+    out = capsys.readouterr().out
+    assert "torn-payload" in out
+
+
+# ------------------------------------------------------ off path --
+
+def test_off_path_is_silent(obs_off, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_OBS_FLIGHT_DIR", str(tmp_path / "fl"))
+    assert ts.tick() is None
+    assert not ts.maybe_start()
+    assert not ts.running()
+    assert ts.names() == [] and ts.ticks() == 0
+    events.event("admit", rid="r0")
+    assert events.recent() == [] and events.depth() == 0
+    assert events.counts() == {}
+    assert not flight.enabled()
+    assert flight.record_incident("chaos.nan") is None
+    assert flight.note_exit(47) is None
+    assert not os.path.exists(str(tmp_path / "fl"))
+    assert core.records() == []

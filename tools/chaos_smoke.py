@@ -68,6 +68,44 @@ def _tiny_cfg():
                                dtype=jnp.float32)
 
 
+def _flight_dir(label):
+    """Point the flight recorder's incident sideband at a fresh
+    per-leg directory (inherited by subprocess workers through the
+    environment) so the leg can assert on exactly its own bundles."""
+    d = tempfile.mkdtemp(prefix="chaos_flight_%s_" % label)
+    os.environ["MXNET_OBS_FLIGHT_DIR"] = d
+    return d
+
+
+def _assert_incident(d, cause_prefix, label):
+    """Every fault class must leave a PARSEABLE incident bundle whose
+    cause names the injected fault (ISSUE 17). Returns 1 (leg FAIL)
+    when no bundle under ``d`` matches ``cause_prefix``; a no-op when
+    telemetry is off (standalone runs without MXNET_OBS)."""
+    from mxnet_tpu.observability import core as obs_core
+    from mxnet_tpu.observability import flight
+    if not obs_core.enabled():
+        return 0
+    causes = []
+    for p in flight.list_bundles(d):
+        try:
+            doc = flight.read_bundle(p)
+        except flight.BundleError as e:
+            print("[chaos_smoke] FAIL(%s): unreadable incident "
+                  "bundle %s (%s)" % (label, p, e.evidence))
+            return 1
+        causes.append(doc.get("cause", ""))
+        if causes[-1].startswith(cause_prefix):
+            print("[chaos_smoke] %s incident bundle OK: cause=%s "
+                  "taxonomy=%s (%s)"
+                  % (label, doc["cause"], doc.get("taxonomy"),
+                     os.path.basename(p)))
+            return 0
+    print("[chaos_smoke] FAIL(%s): no incident bundle with cause "
+          "%s* under %s (saw: %s)" % (label, cause_prefix, d, causes))
+    return 1
+
+
 # ------------------------------------------------------------ scenarios --
 
 def nan_guard():
@@ -79,6 +117,7 @@ def nan_guard():
 
     os.environ["MXNET_STEP_GUARD"] = "1"
     chaos.reset()
+    fdir = _flight_dir("nan")
     net = nn.HybridSequential()
     with net.name_scope():
         net.add(nn.Dense(8, activation="relu"))
@@ -119,6 +158,8 @@ def nan_guard():
         print("[chaos_smoke] FAIL(nan): training did not resume")
         return 1
     chaos.reset()
+    if _assert_incident(fdir, "chaos.nan", "nan"):
+        return 1
     print("[chaos_smoke] nan OK: poisoned step skipped, weights "
           "bit-identical, training resumed")
     return 0
@@ -130,6 +171,7 @@ def ioerror():
     from mxnet_tpu.observability import chaos
 
     chaos.reset()
+    fdir = _flight_dir("ioerror")
     os.environ["MXNET_IO_BACKOFF_MS"] = "1"
     d = tempfile.mkdtemp(prefix="chaos_smoke_io_")
     path, idx = os.path.join(d, "img.rec"), os.path.join(d, "img.idx")
@@ -149,6 +191,8 @@ def ioerror():
               % (len(batches), chaos.stats["error"]))
         return 1
     chaos.reset()
+    if _assert_incident(fdir, "chaos.error", "ioerror"):
+        return 1
     print("[chaos_smoke] ioerror OK: 2 injected read failures retried, "
           "full epoch delivered")
     return 0
@@ -162,6 +206,7 @@ def serving():
     from mxnet_tpu.observability import chaos
 
     chaos.reset()
+    fdir = _flight_dir("serving")
     cfg = _tiny_cfg()
     params = T.init_params(cfg, seed=0)
     rng = np.random.RandomState(0)
@@ -183,6 +228,8 @@ def serving():
                   "after requeue" % j)
             return 1
     chaos.reset()
+    if _assert_incident(fdir, "chaos.error", "serving"):
+        return 1
     print("[chaos_smoke] serving OK: dispatch failure requeued, all "
           "streams bit-exact vs solo generate()")
     return 0
@@ -214,6 +261,7 @@ def hang():
     d = tempfile.mkdtemp(prefix="chaos_smoke_hang_")
     ckdir = os.path.join(d, "ck")
     sideband = os.path.join(d, "wd")
+    fdir = _flight_dir("hang")
     env = dict(os.environ)
     env.update({
         "MXNET_OBS": "1",
@@ -247,6 +295,8 @@ def hang():
             "watchdog:"):
         print("[chaos_smoke] FAIL(hang): emergency checkpoint "
               "step=%r meta=%r" % (step, meta))
+        return 1
+    if _assert_incident(fdir, "watchdog.hang", "hang"):
         return 1
     print("[chaos_smoke] hang OK: post-mortem names kvstore.push, "
           "emergency checkpoint loadable at step 7, abort rc=%d"
@@ -294,6 +344,7 @@ def train_worker(ckdir, steps):
 def sigterm():
     from mxnet_tpu.models.checkpoint import load_checkpoint
     d = tempfile.mkdtemp(prefix="chaos_smoke_sigterm_")
+    fdir = _flight_dir("sigterm")
     ckdir = os.path.join(d, "ck")
     env = dict(os.environ)
     env.update({"MXNET_CHAOS": "train.step:sigterm:at=1",
@@ -311,6 +362,8 @@ def sigterm():
         print("[chaos_smoke] FAIL(sigterm): step=%r meta=%r"
               % (step, meta))
         return 1
+    if _assert_incident(fdir, "sigterm", "sigterm"):
+        return 1
     print("[chaos_smoke] sigterm OK: preemption at step 2 committed "
           "an emergency checkpoint, exit 143")
     return 0
@@ -318,6 +371,7 @@ def sigterm():
 
 def crash():
     d = tempfile.mkdtemp(prefix="chaos_smoke_crash_")
+    fdir = _flight_dir("crash")
     env_base = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
                 "CHAOS_SMOKE_WORKER": "train"}
 
@@ -354,6 +408,8 @@ def crash():
         print("[chaos_smoke] FAIL(crash): resumed loss trajectory "
               "diverged:\n  want %s\n  got  %s" % (want, got))
         return 1
+    if _assert_incident(fdir, "chaos.crash", "crash"):
+        return 1
     print("[chaos_smoke] crash OK: crash at step 3, "
           "resume-from-latest; %d-step loss trajectory bit-exact"
           % len(want))
@@ -379,6 +435,7 @@ def overload():
     from mxnet_tpu.observability import core as obs
 
     chaos.reset()
+    fdir = _flight_dir("overload")
     cfg = _tiny_cfg()
     params = T.init_params(cfg, seed=0)
     rng = np.random.RandomState(12)
@@ -507,6 +564,9 @@ def overload():
             print("[chaos_smoke] FAIL(overload): %s health snapshot "
                   "lacks serving.brownout_rung" % rep.name)
             return 1
+    if _assert_incident(fdir, "chaos.error", "overload") \
+            or _assert_incident(fdir, "breaker.open", "overload"):
+        return 1
     print("[chaos_smoke] overload OK: %d-job storm over 2 replicas — "
           "%d preempted-and-resumed, %d shed + %d expired (all "
           "priority 0), brownout peaked at rung %d, r1 killed and "
@@ -531,6 +591,7 @@ def elastic():
     import shutil
 
     d = tempfile.mkdtemp(prefix="chaos_smoke_elastic_")
+    fdir = _flight_dir("elastic")
     sb, ck = os.path.join(d, "sb"), os.path.join(d, "ck")
     steps, rows = 6, 8
     env = dict(os.environ)
@@ -633,6 +694,8 @@ def elastic():
               "elastic.time_to_recovery_ms histogram (%s)"
               % json.dumps(list(merged.get("otherData", {})
                                 .get("histograms", {}))))
+        return 1
+    if _assert_incident(fdir, "elastic.shrink", "elastic"):
         return 1
     print("[chaos_smoke] elastic OK: kill -> shrink(44) -> bit-exact "
           "world-1 resume -> regrow(45) -> done; %d/%d samples "
@@ -741,6 +804,7 @@ def integrity_scenario():
     # ---- gradient-bucket flip -> replay audit -> quarantine(46) ----
     # -> relaunch resumes BIT-exact from the last verified checkpoint
     d = tempfile.mkdtemp(prefix="chaos_smoke_integrity_")
+    fdir = _flight_dir("integrity")
     sb = os.path.join(d, "sb")
     env_base = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
                 "CHAOS_SMOKE_WORKER": "integrity_train"}
@@ -922,6 +986,8 @@ def integrity_scenario():
                   "lacks path/record evidence: %s" % e)
             return 1
     r1.close()
+    if _assert_incident(fdir, "integrity.quarantine", "integrity"):
+        return 1
     print("[chaos_smoke] recordio OK: transient flip named "
           "(path, record 0) and recovered on retry; at-rest flip "
           "exhausted retries into the enriched IOError")
@@ -948,6 +1014,7 @@ def mem_pressure():
     """
     import tempfile
 
+    fdir = _flight_dir("oom")
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -1096,6 +1163,8 @@ def mem_pressure():
         os.environ.pop("MXNET_MEM_OOM_ACTION", None)
         membudget.reset()
         chaos.reset()
+    if _assert_incident(fdir, "chaos.oom", "oom"):
+        return 1
     print("[chaos_smoke] oom OK: trainer re-lowered at accum=2 with a "
           "deterministic global-batch trajectory, serving shrank and "
           "retried bit-exact, a failed pool grow degraded to reduced "
@@ -1157,6 +1226,7 @@ def durable():
     """
     import tempfile
 
+    fdir = _flight_dir("durable")
     from mxnet_tpu.models import transformer as T
     from mxnet_tpu.models import checkpoint as ck
     from mxnet_tpu.models.journal import RequestJournal
@@ -1321,6 +1391,9 @@ def durable():
               "still changed the weights")
         return 1
 
+    if _assert_incident(fdir, "rollout.rollback", "durable") \
+            or _assert_incident(fdir, "chaos.crash", "durable"):
+        return 1
     print("[chaos_smoke] durable OK: kill-9 at a journal commit point "
           "replayed bit-exact (paged x spec x pipeline greedy, paged "
           "x pipeline sampled), torn/CRC-corrupt records skipped "

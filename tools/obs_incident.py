@@ -1,0 +1,168 @@
+"""Merge flight-recorder incident bundles into a cross-fleet timeline.
+
+Every abnormal exit path (``observability/flight.py``) drops a
+CRC-framed ``incident.*.json`` bundle into the flight sideband — one
+per rank/replica/process. After a fleet-wide event ("the job died at
+2am") the bundles from N processes describe N local views of one
+global failure. This tool lines them up:
+
+* every bundle is CRC-verified on read (torn/corrupt files are
+  reported with their evidence and skipped, never silently dropped);
+* ranks align on the PR 3 barrier clock anchor (the same offsets
+  ``observability.dist.merge_traces`` uses), so "rank 1 hit the OOM
+  400 ms before rank 0's watchdog fired" is readable straight off the
+  timeline; bundles without an anchor fall back to wall-clock and are
+  flagged UNALIGNED;
+* each incident line carries its cause, taxonomy, exit code, and the
+  tail of that process's decision-event ring, so the scheduler story
+  leading INTO the failure (admissions, preemptions, brownout rungs,
+  breaker flips) interleaves with the failures themselves.
+
+Usage::
+
+    python tools/obs_incident.py DIR [DIR ...]   # text timeline
+    python tools/obs_incident.py DIR --events 5  # + last 5 events each
+    python tools/obs_incident.py DIR --json out.json
+
+Exit status: 0 when at least one parseable bundle rendered, 1
+otherwise (an empty sideband after a crash is itself a finding).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from mxnet_tpu.observability import flight  # noqa: E402
+from mxnet_tpu.observability import sideband  # noqa: E402
+
+
+def load_bundles(dirs):
+    """Read every bundle under ``dirs``. Returns (docs, bad) where
+    ``docs`` is [(path, doc)] CRC-verified and ``bad`` is
+    [(path, evidence)] for torn/corrupt files."""
+    docs, bad = [], []
+    for d in dirs:
+        for p in flight.list_bundles(d):
+            try:
+                docs.append((p, flight.read_bundle(p)))
+            except flight.BundleError as e:
+                bad.append((p, e.evidence))
+    return docs, bad
+
+
+def align(docs):
+    """Attach a fleet-common timestamp to each bundle.
+
+    Anchored bundles (``clock_anchor`` from the barrier handshake)
+    shift onto the lowest-ranked anchor's monotonic timebase; the rest
+    order by wall clock against the reference bundle's wall time and
+    are marked unaligned. Returns a list of dicts sorted by aligned
+    time (microseconds, relative to the earliest incident)."""
+    ref = None
+    for _p, doc in sorted(docs, key=lambda t: t[1].get("rank", 0)):
+        if doc.get("clock_anchor"):
+            ref = doc
+            break
+    if ref is None and docs:
+        ref = min(docs, key=lambda t: t[1].get("wall_time_s", 0))[1]
+    rows = []
+    for p, doc in docs:
+        anchor = doc.get("clock_anchor")
+        if anchor and ref.get("clock_anchor"):
+            off = int(anchor["mono_us"]) \
+                - int(ref["clock_anchor"]["mono_us"])
+            t_us = int(doc["mono_us"]) - off
+            aligned = True
+        else:
+            # wall-clock fallback: comparable across processes at
+            # NTP precision, good enough to order incidents
+            t_us = int(doc.get("wall_time_s", 0) * 1e6)
+            aligned = False
+        rows.append({"path": p, "t_us": t_us, "aligned": aligned,
+                     "doc": doc})
+    if not rows:
+        return rows
+    # events in each bundle ride the same per-process timebase as the
+    # incident's mono_us, so the incident's own shift applies to them
+    t0 = min(r["t_us"] for r in rows)
+    for r in rows:
+        r["t_us"] -= t0
+        shift = r["t_us"] - int(r["doc"]["mono_us"]) \
+            if r["aligned"] else None
+        r["event_shift_us"] = shift
+    rows.sort(key=lambda r: r["t_us"])
+    return rows
+
+
+def render(rows, bad, n_events=0):
+    """The text timeline, one line per incident (plus optional
+    decision-event tails), earliest first."""
+    out = []
+    nprocs = len({(r["doc"].get("rank"), r["doc"].get("pid"))
+                  for r in rows})
+    out.append("Incident timeline: %d bundle(s) from %d process(es)"
+               % (len(rows), nprocs))
+    for p, evidence in bad:
+        out.append("  UNREADABLE %s (%s)" % (p, evidence))
+    for r in rows:
+        doc = r["doc"]
+        flag = "" if r["aligned"] else "  [UNALIGNED wall-clock]"
+        code = doc.get("exit_code")
+        out.append(
+            "+%10.3fs  rank%-2s pid%-6s %-18s %s%s%s"
+            % (r["t_us"] / 1e6, doc.get("rank", "?"),
+               doc.get("pid", "?"), doc.get("taxonomy", "?"),
+               doc.get("cause", "?"),
+               "  exit=%d" % code if code is not None else "", flag))
+        if n_events:
+            for t_us, kind, fields in doc.get("events", [])[-n_events:]:
+                if r["event_shift_us"] is not None:
+                    t_rel = (int(t_us) + r["event_shift_us"]) / 1e6
+                    stamp = "+%10.3fs" % t_rel
+                else:
+                    stamp = " " * 11
+                out.append("  %s    event %-10s %s"
+                           % (stamp, kind, json.dumps(fields,
+                                                      sort_keys=True)))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dirs", nargs="*",
+                    help="incident directories (default: the resolved "
+                         "flight sideband)")
+    ap.add_argument("--events", type=int, default=0, metavar="N",
+                    help="show the last N decision events per bundle")
+    ap.add_argument("--json", metavar="FILE",
+                    help="also write the merged timeline as JSON")
+    args = ap.parse_args(argv)
+    dirs = args.dirs or [sideband.resolve("flight")]
+    docs, bad = load_bundles(dirs)
+    rows = align(docs)
+    if args.json:
+        merged = {"bundles": [{"path": r["path"], "t_us": r["t_us"],
+                               "aligned": r["aligned"],
+                               "cause": r["doc"]["cause"],
+                               "taxonomy": r["doc"].get("taxonomy"),
+                               "rank": r["doc"].get("rank"),
+                               "exit_code": r["doc"].get("exit_code")}
+                              for r in rows],
+                  "unreadable": [{"path": p, "evidence": e}
+                                 for p, e in bad]}
+        with open(args.json, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+    print(render(rows, bad, n_events=args.events))
+    if not rows:
+        print("[obs_incident] no parseable bundles under: %s"
+              % ", ".join(dirs), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

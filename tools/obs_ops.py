@@ -84,6 +84,49 @@ def run_workload(steps=2):
     return attribution.summary()
 
 
+def run_kernel_workload():
+    """Deterministic paged decode + spec-verify serving run with the
+    Pallas megakernel FORCED on (interpret mode on CPU — the same
+    kernel code the chip compiles), returning the attribution summary
+    for just this workload. The ``paged_decode_kernel`` /
+    ``paged_verify_kernel`` scope rows are the PR 16 numbers
+    ``tools/obs_regression.py --kernels`` guards against
+    ``ci/obs_baseline.json``."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu.models import transformer as tf
+    from mxnet_tpu.models.serving import ContinuousBatcher
+    from mxnet_tpu.observability import attribution
+
+    prev = os.environ.get("MXNET_PAGED_DECODE_PALLAS")
+    os.environ["MXNET_PAGED_DECODE_PALLAS"] = "1"
+    attribution.reset()     # only THIS workload's programs/scopes
+    try:
+        cfg = tf.TransformerConfig(vocab_size=97, d_model=16,
+                                   n_heads=2, n_layers=1, d_ff=32,
+                                   max_len=48, dtype=jnp.float32)
+        params = tf.init_params(cfg, seed=0)
+        rng = np.random.RandomState(0)
+        jobs = [(list(rng.randint(1, 97, 5)), 6) for _ in range(3)]
+        # spec run -> paged_verify_kernel; plain paged run ->
+        # paged_decode_kernel (the spec path replaces the decode
+        # pipeline, so both dispatches are needed for both scopes)
+        srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                block_size=8, spec_k=2)
+        results, order = srv.run(jobs)
+        assert len(results) == len(jobs)
+        srv = ContinuousBatcher(params, cfg, max_batch=2, paged=True,
+                                block_size=8)
+        results, order = srv.run(jobs)
+        assert len(results) == len(jobs)
+        return attribution.summary()
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_PAGED_DECODE_PALLAS", None)
+        else:
+            os.environ["MXNET_PAGED_DECODE_PALLAS"] = prev
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--summary", metavar="JSON", default=None,

@@ -17,6 +17,12 @@ BENCH row.
     # intentional change? refresh the committed numbers
     python tools/obs_regression.py --baseline ci/obs_baseline.json --update
 
+    # the PR 16 megakernel sentinel: run the paged decode + spec-verify
+    # serving workload with MXNET_PAGED_DECODE_PALLAS=1 and diff the
+    # paged_decode_kernel / paged_verify_kernel scope rows against the
+    # baseline file's "kernels" section
+    python tools/obs_regression.py --baseline ci/obs_baseline.json --kernels
+
 Tolerances: ``--tol metric=frac`` (repeatable) overrides, then the
 baseline file's ``tolerances`` map, then attribution.DEFAULT_TOLERANCES
 (flops/hbm_bytes 15%, out_bytes/peak_bytes 25%, count 50%). A metric
@@ -68,6 +74,11 @@ def main(argv=None):
     p.add_argument("--update", action="store_true",
                    help="write the current summary over --baseline "
                         "(keeps the file's tolerances block)")
+    p.add_argument("--kernels", action="store_true",
+                   help="guard the paged megakernel scopes instead: "
+                        "run the obs_ops kernel workload (Pallas "
+                        "forced on) and diff the baseline's 'kernels' "
+                        "section")
     args = p.parse_args(argv)
 
     cli_tol = {}
@@ -85,11 +96,25 @@ def main(argv=None):
             "obs_ops", os.path.join(ROOT, "tools", "obs_ops.py"))
         obs_ops = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(obs_ops)
-        current = obs_ops.run_workload()
+        if args.kernels:
+            os.environ.setdefault("MXNET_OBS_OPS", "1")
+            current = obs_ops.run_kernel_workload()
+        else:
+            current = obs_ops.run_workload()
         if not current["totals"].get("programs"):
             print("[obs_regression] FAIL: workload registered no "
                   "compiled program (MXNET_OBS off at trace time?)")
             return 2
+        if args.kernels:
+            missing = [k for k in ("paged_decode_kernel",
+                                   "paged_verify_kernel")
+                       if k not in current.get("scopes", {})]
+            if missing:
+                print("[obs_regression] FAIL: kernel workload is "
+                      "missing megakernel scope(s) %s — did the Pallas "
+                      "path (MXNET_PAGED_DECODE_PALLAS=1) not engage?"
+                      % ", ".join(missing))
+                return 2
 
     baseline_doc = {}
     if os.path.exists(args.baseline):
@@ -101,15 +126,36 @@ def main(argv=None):
               "with --update)" % args.baseline)
         return 2
 
+    if args.kernels:
+        kern_doc = baseline_doc.get("kernels", {})
+        baseline = kern_doc.get("summary")
+        if baseline is None and not args.update:
+            print("[obs_regression] FAIL: baseline %s has no 'kernels' "
+                  "section (generate with --kernels --update)"
+                  % args.baseline)
+            return 2
+
     if args.update:
-        doc = {"workload": "tools/obs_ops.py smoke (two-block "
-                           "conv+dense Gluon model, 2 train steps)",
-               "tolerances": baseline_doc.get("tolerances", {}),
-               "summary": current}
+        if args.kernels:
+            doc = dict(baseline_doc)
+            doc["kernels"] = {
+                "workload": "tools/obs_ops.py run_kernel_workload "
+                            "(paged decode + spec-verify serving, "
+                            "MXNET_PAGED_DECODE_PALLAS=1)",
+                "summary": current}
+        else:
+            doc = {"workload": "tools/obs_ops.py smoke (two-block "
+                               "conv+dense Gluon model, 2 train steps)",
+                   "tolerances": baseline_doc.get("tolerances", {}),
+                   "summary": current}
+            if "kernels" in baseline_doc:
+                doc["kernels"] = baseline_doc["kernels"]
         with open(args.baseline, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")
-        print("[obs_regression] baseline updated -> %s" % args.baseline)
+        print("[obs_regression] baseline updated -> %s%s"
+              % (args.baseline,
+                 " (kernels section)" if args.kernels else ""))
         return 0
 
     from mxnet_tpu.observability import attribution

@@ -218,6 +218,8 @@ def serving_smoke():
     # the shed-vs-expired split — on /healthz AND in the trace
     from mxnet_tpu.models.router import ReplicaRouter
     from mxnet_tpu.observability import core as obs_core
+    from mxnet_tpu.observability import events as obs_events
+    from mxnet_tpu.observability import timeseries as obs_ts
 
     pre0 = obs_core.counter("serving.preemptions").value
     rng2 = np.random.RandomState(3)
@@ -237,6 +239,7 @@ def serving_smoke():
     try:
         while (rr._queue or rr._live) and steps < 200:
             rr.step()
+            obs_ts.tick()      # deterministic mid-run sample points
             if steps == 1:
                 hz2 = json.loads(urllib.request.urlopen(
                     "http://127.0.0.1:%d/healthz" % port,
@@ -268,6 +271,38 @@ def serving_smoke():
         if k not in rr.health_snapshot():
             print("[obs_smoke] FAIL: router health_snapshot() lacks "
                   "%s" % k)
+            return 1
+
+    # ---- flight-recorder telemetry (ISSUE 17): the sampler must have
+    # a mid-run window with the serving counters in it, and every
+    # admission must have left a decision event in the ring
+    win = obs_ts.last_window()
+    if win["ticks"] < 1 \
+            or "serving.preemptions" not in win["series"] \
+            or "rate_per_s" not in win["series"]["serving.preemptions"]:
+        print("[obs_smoke] FAIL: no mid-run time-series window "
+              "(ticks=%d, series=%d)"
+              % (win["ticks"], len(win["series"])))
+        return 1
+    if not obs_ts.running():
+        print("[obs_smoke] FAIL: time-series sampler daemon not "
+              "running under a live batcher")
+        return 1
+    admitted_ev = {f.get("rid")
+                   for _t, kind, f in obs_events.recent(10000)
+                   if kind == "admit"}
+    # 6 submissions in the act; each one either got an admit event,
+    # was shed, or expired — the decision ring narrates all of them
+    expected = 6 - len(rr.shed_rids) - len(rr.expired_rids)
+    if len(admitted_ev) < expected:
+        print("[obs_smoke] FAIL: %d admissions but only %d admit "
+              "decision events" % (expected, len(admitted_ev)))
+        return 1
+    ev_counts = obs_events.counts()
+    for kind in ("admit", "preempt", "expire"):
+        if not ev_counts.get(kind):
+            print("[obs_smoke] FAIL: no '%s' decision event recorded "
+                  "(kinds: %s)" % (kind, sorted(ev_counts)))
             return 1
 
     fname = os.path.join(tempfile.mkdtemp(prefix="obs_smoke_srv_"),
