@@ -142,6 +142,11 @@ def bench_dist(name, shapes, n_workers, iters, shard_update):
                "small_tensor_mb": round(small_bytes / 1e6, 2),
                "workers": n_workers}
         print(json.dumps(row))
+        from benchmark.common import record_bench_profile
+        record_bench_profile(
+            "allreduce_%s_%s" % (name, tag), value=row["busbw_gb_s"],
+            unit="GB/s", dispatches=row["dispatches"],
+            sec_per_iter=row["sec_per_iter"], workers=n_workers)
         return row
 
     # --- per-key: one collective dispatch per parameter ---------------

@@ -62,6 +62,26 @@ def print_ops_table(compiled=None):
               "crossed an instrumented jit boundary)")
 
 
+def record_bench_profile(leg, value=None, unit=None, metric=None,
+                         **extra):
+    """Append one measured bench result to the performance archive
+    (observability/profile_store.py) with the run's config fingerprint,
+    so BENCH_TABLE.json rows carry provenance and
+    ``tools/perf_timeline.py`` can trend them across runs. One guarded
+    branch: with MXNET_OBS_PROFILE_DIR unset this is a single env read
+    and no I/O; never raises — archiving must not fail a bench."""
+    import os
+    if not os.environ.get("MXNET_OBS_PROFILE_DIR"):
+        return None
+    try:
+        from mxnet_tpu.observability import profile_store
+        return profile_store.append_bench(leg, value=value, unit=unit,
+                                          metric=metric,
+                                          extra=extra or None)
+    except Exception:
+        return None
+
+
 def obs_ops_requested(argv=None):
     """Shared --obs-ops detection for the stdin-run benches (their
     argv is free-form words, not argparse): present -> turn telemetry

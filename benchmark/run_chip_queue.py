@@ -384,6 +384,38 @@ def _save_table(path, table):
     _write_json(path, rows)
 
 
+def _archive_leg(name, res):
+    """Append an ok leg's ``{"metric": ...}`` stdout rows to the
+    performance archive (observability/profile_store.py) with the
+    run's config fingerprint, and stamp the fingerprint id into the
+    BENCH_TABLE row for provenance. One guarded branch — no I/O with
+    MXNET_OBS_PROFILE_DIR unset; never raises (archiving must not
+    fail the queue)."""
+    if not os.environ.get("MXNET_OBS_PROFILE_DIR"):
+        return
+    try:
+        sys.path.insert(0, ROOT)
+        from mxnet_tpu.observability import profile_store
+        fid, _cfg = profile_store.config_fingerprint()
+        res["fingerprint"] = fid
+        for ln in res["stdout"].splitlines():
+            if not ln.startswith('{"metric"'):
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            extra = {k: v for k, v in rec.items()
+                     if k not in ("metric", "value", "unit")
+                     and isinstance(v, (int, float, str, bool))}
+            extra["queue_leg"] = name
+            profile_store.append_bench(
+                name, value=rec.get("value"), unit=rec.get("unit"),
+                metric=rec.get("metric", name), extra=extra)
+    except Exception:
+        pass
+
+
 def _refresh_last_measured(res):
     """Point bench.py's wedged-tunnel fallback at a FRESH headline
     measurement (called at measurement time, never from a loaded
@@ -482,6 +514,8 @@ def run_pending(args, table, probe):
         if res["stderr"]:
             print(res["stderr"], file=sys.stderr, flush=True)
         table[name] = res
+        if res["ok"]:
+            _archive_leg(name, res)      # provenance + perf archive
         _save_table(args.out, table)     # checkpoint after every leg
         if res["ok"]:
             if name == "bench_headline":

@@ -209,6 +209,11 @@ def _latency_report(run_fn, leg, **extra):
     rec.update({"leg": "%s_latency" % leg, "goodput_tok_s": goodput,
                 "distributions": dists})
     print(json.dumps(rec), flush=True)
+    from benchmark.common import record_bench_profile
+    record_bench_profile(
+        "%s_latency" % leg, value=goodput, unit="tok/s",
+        metric="%s_goodput_tok_s" % leg,
+        p50_ms={name: s["p50"] for name, s in dists.items()})
     return rec
 
 

@@ -161,6 +161,12 @@ def main():
         "mfu_peak_flops": PEAK_FLOPS,
         "loss_finite": bool(np.isfinite(loss)),
     }))
+    from benchmark.common import record_bench_profile
+    record_bench_profile(
+        "train_lm", value=round(rate, 1), unit="tokens/s",
+        metric="lm_train_tokens_per_s_%s" % jax.default_backend(),
+        d_model=d_model, layers=layers, seq=seq, batch=batch,
+        remat=remat, flash=use_flash, mfu=round(mfu, 4))
     # the aggregate table below already appends the per-operator
     # attribution section when --obs-ops registered the step program
     from benchmark.common import print_obs_table

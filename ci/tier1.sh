@@ -98,6 +98,18 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         exit 1
     fi
 
+    echo "==== [tier1] performance-archive smoke (profile store + timeline + --history) ===="
+    # ISSUE 18: two synthetic runs through the CRC-framed profile
+    # store must merge into ONE timeline (perf_timeline renders both
+    # runs), and obs_regression --history must flag the second run's
+    # injected 2x per-scope slowdown by name against the rolling
+    # window. The committed-baseline sentinel above is unchanged —
+    # --history guards drift the snapshot diff cannot see.
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --store; then
+        echo "[tier1] FAIL: performance-archive smoke"
+        exit 1
+    fi
+
     echo "==== [tier1] distributed observability smoke (2-process gloo merge) ===="
     # two gloo workers train against dist_tpu_sync (clock-anchor
     # handshake at kvstore creation), dump rank-local traces, and the

@@ -52,6 +52,8 @@ from . import sideband
 from . import slo
 from . import membudget
 from . import attribution
+from . import profile_store
+from . import costmodel
 from . import recompile
 from . import timeseries
 from . import watchdog

@@ -206,6 +206,8 @@ def aggregate_table():
     lines.extend(dist.format_skew_table())
     from . import attribution
     lines.extend(attribution.format_ops_table())
+    from . import costmodel
+    lines.extend(costmodel.format_calibration_table())
     if core.dropped():
         lines.append("")
         lines.append("(%d oldest records dropped from the ring; "

@@ -535,6 +535,23 @@ def healthz_snapshot():
             "budget_mode": budget_mode() or "off"}
 
 
+def predicted_step_ms(scope=None, signature=None, dirpath=None):
+    """Cost-model hook (ISSUE 18): the calibrated roofline prediction
+    for an archived scope/signature, so admission decisions can weigh
+    TIME next to bytes (a preflight that passes on memory but predicts
+    a 10x step regression is still worth flagging). Returns None when
+    the performance archive is off or holds nothing for the workload —
+    callers keep their bytes-only verdicts. Never raises."""
+    try:
+        from . import costmodel, profile_store
+        if dirpath is None and not profile_store.enabled():
+            return None
+        return costmodel.predict(signature=signature, scope=scope,
+                                 dirpath=dirpath)
+    except Exception:
+        return None
+
+
 def reset():
     """Forget preflight verdicts + counters (tests, fresh sessions)."""
     with _lock:

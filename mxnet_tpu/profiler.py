@@ -132,6 +132,11 @@ def dump(finished=True, profile_process="worker"):
     # per-operator attribution: per-scope flops/bytes gauges ride the
     # ring into the chrome trace + Prometheus textfile
     _obs_attr.publish_counters()
+    # performance archive: persist this run's per-scope measurements
+    # (ISSUE 18) — one guarded branch, no I/O with the store unset
+    from .observability import profile_store as _obs_pstore
+    if _obs_pstore.enabled():
+        _obs_pstore.record_run()
     path = _obs_dist.rank_trace_path(str(_config["filename"]))
     _obs_export.dump_chrome_trace(path)
     _obs_export.write_prometheus()

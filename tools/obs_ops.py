@@ -137,6 +137,16 @@ def main(argv=None):
                         "artifact) after printing the table")
     p.add_argument("--topk", type=int, default=None,
                    help="table depth (default MXNET_OBS_OPS_TOPK=10)")
+    p.add_argument("--profile-dir", default=None,
+                   help="performance archive to calibrate against "
+                        "(default MXNET_OBS_PROFILE_DIR); adds "
+                        "predicted_ms/measured_ms/calib_err per scope "
+                        "to the table and the --json artifact")
+    p.add_argument("--max-calib-err", type=float, default=None,
+                   metavar="FRAC",
+                   help="exit 3 when any archived scope's calibration "
+                        "error exceeds FRAC (the autotuner pre-flight "
+                        "gate; also fails when the archive is empty)")
     args = p.parse_args(argv)
 
     if args.summary:
@@ -153,10 +163,51 @@ def main(argv=None):
               "set, and did the workload trace a jit?")
         return 1
     print("\n".join(lines).lstrip("\n"))
+
+    # cost-model calibration against the performance archive (ISSUE
+    # 18): predicted vs measured per scope, worst-calibrated named
+    calib_rows = []
+    pdir = args.profile_dir or os.environ.get("MXNET_OBS_PROFILE_DIR")
+    if pdir:
+        from mxnet_tpu.observability import costmodel
+        try:
+            calib_rows = costmodel.calibration_report(dirpath=pdir)
+        except Exception:
+            calib_rows = []
+        table = costmodel.format_calibration_table(dirpath=pdir)
+        if table:
+            print("\n".join(table))
+
     if args.json:
+        doc = {"summary": summ}
+        if calib_rows:
+            doc["calibration"] = {
+                r["scope"]: {"predicted_ms": r["predicted_ms"],
+                             "measured_ms": r["measured_ms"],
+                             "calib_err": r["calib_err"]}
+                for r in calib_rows}
         with open(args.json, "w") as f:
-            json.dump({"summary": summ}, f, indent=1, sort_keys=True)
+            json.dump(doc, f, indent=1, sort_keys=True)
         print("\n[obs_ops] summary -> %s" % args.json)
+
+    if args.max_calib_err is not None:
+        if not calib_rows:
+            print("[obs_ops] FAIL: --max-calib-err set but the "
+                  "performance archive holds no calibrated scopes "
+                  "(is MXNET_OBS_PROFILE_DIR populated?)")
+            return 3
+        bad = [r for r in calib_rows
+               if r["calib_err"] > args.max_calib_err]
+        if bad:
+            print("[obs_ops] FAIL: %d scope(s) past calibration "
+                  "error %.0f%%: %s"
+                  % (len(bad), 100 * args.max_calib_err,
+                     ", ".join("%s (%.0f%%)"
+                               % (r["scope"], 100 * r["calib_err"])
+                               for r in bad)))
+            return 3
+        print("[obs_ops] calibration within %.0f%% across %d scope(s)"
+              % (100 * args.max_calib_err, len(calib_rows)))
     return 0
 
 

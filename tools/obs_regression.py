@@ -23,6 +23,12 @@ BENCH row.
     # baseline file's "kernels" section
     python tools/obs_regression.py --baseline ci/obs_baseline.json --kernels
 
+    # rolling-window timing drift against the performance archive
+    # (observability/profile_store.py): the newest archived run's
+    # per-scope p50 vs the median of the prior MXNET_OBS_PROFILE_HISTORY
+    # runs, flagged past --tol p50_ms (default 50%) naming the scope
+    python tools/obs_regression.py --history --profile-dir /data/perf
+
 Tolerances: ``--tol metric=frac`` (repeatable) overrides, then the
 baseline file's ``tolerances`` map, then attribution.DEFAULT_TOLERANCES
 (flops/hbm_bytes 15%, out_bytes/peak_bytes 25%, count 50%). A metric
@@ -30,7 +36,11 @@ regresses when ``current > baseline * (1 + tol)``; scopes appearing or
 disappearing are reported as notes, not failures (renames happen — the
 aggregate totals still catch growth hiding behind one), and
 improvements past the same tolerance are listed so an intentional
-optimization reminds you to --update.
+optimization reminds you to --update. ``--kernels`` additionally runs
+both sides through the profile store's signature normalization first,
+so a harmless shape-signature rename (a re-jit with a widened batch
+axis turning ``paged_decode_kernel`` into ``paged_decode_kernel_1``)
+is merged back and reported as a note, not a failure.
 """
 
 import argparse
@@ -51,6 +61,90 @@ def _load_summary(path):
     return doc.get("summary", doc), doc
 
 
+HISTORY_TOL = 0.5    # timing is noisier than byte accounting
+
+
+def _normalize_scopes(summ):
+    """Run a summary's scope keys through the profile store's
+    signature normalization (trailing ``_<n>`` rename counters from a
+    re-jit stripped), merging rows that collapse onto one key. Returns
+    (normalized summary, notes) — a rename is a note, not a failure."""
+    from mxnet_tpu.observability import profile_store
+    scopes = summ.get("scopes", {}) or {}
+    out, notes = {}, []
+    for name in sorted(scopes):
+        row = scopes[name]
+        norm = profile_store.normalize_scope(name)
+        if norm != name:
+            notes.append("scope %r normalized to %r "
+                         "(shape-signature rename)" % (name, norm))
+        if norm in out:
+            for k, v in row.items():
+                if isinstance(v, (int, float)):
+                    out[norm][k] = out[norm].get(k, 0) + v
+        else:
+            out[norm] = dict(row)
+    new = dict(summ)
+    new["scopes"] = out
+    return new, notes
+
+
+def run_history(args, cli_tol):
+    """--history: the newest archived run's per-signature p50 (or
+    --history-metric) against the median of the prior rolling window.
+    Exit 0 in tolerance / nothing to compare yet, 1 on drift (scope
+    named), 2 on no archive."""
+    from mxnet_tpu.observability import profile_store
+    d = args.profile_dir or profile_store.store_dir()
+    if not d or not os.path.isdir(d):
+        print("[obs_regression] FAIL: --history needs an archive "
+              "(--profile-dir or MXNET_OBS_PROFILE_DIR)")
+        return 2
+    records, evidence = profile_store.load(d)
+    for ev in evidence:
+        print("[obs_regression] note: skipped %s frame at %s+%d"
+              % (ev["evidence"], os.path.basename(ev["file"]),
+                 ev["offset"]))
+    runs = profile_store.runs_in(records)
+    if len(runs) < 2:
+        print("[obs_regression] history: %d archived run(s) in %s — "
+              "need >= 2 to compare" % (len(runs), d))
+        return 0
+    window = args.window or profile_store.history()
+    latest = runs[-1]
+    window_runs = runs[:-1][-window:]
+    metric = args.history_metric
+    tol = cli_tol.get(metric, HISTORY_TOL)
+    regressions = []
+    for sig, g in sorted(profile_store.merge_by_signature(
+            records).items()):
+        series = {run: val for run, _ts, val
+                  in profile_store.run_series(g, metric=metric)}
+        cur = series.get(latest)
+        base = sorted(series[r] for r in window_runs if r in series)
+        if cur is None or not base:
+            continue
+        ref = base[len(base) // 2]
+        if ref <= 0:
+            continue
+        if cur > ref * (1.0 + tol) + 1e-9:
+            regressions.append((g["scope"], sig, ref, cur))
+    if regressions:
+        print("[obs_regression] FAIL: %d scope(s) drifted past %.0f%% "
+              "of the %d-run rolling median (%s):"
+              % (len(regressions), 100 * tol, len(window_runs),
+                 metric))
+        for scope, sig, ref, cur in regressions:
+            print("  %-28s %12.4g -> %12.4g  (%.2fx)  [%s]"
+                  % (scope, ref, cur, cur / ref, sig))
+        return 1
+    print("[obs_regression] OK: run %s within %.0f%% of the %d-run "
+          "window across %d archived signature(s)"
+          % (latest, 100 * tol, len(window_runs),
+             len(profile_store.merge_by_signature(records))))
+    return 0
+
+
 def _fmt(rows):
     out = []
     for r in rows:
@@ -63,7 +157,7 @@ def _fmt(rows):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--baseline", required=True,
+    p.add_argument("--baseline", default=None,
                    help="committed baseline JSON (ci/obs_baseline.json)")
     p.add_argument("--current", default=None,
                    help="summary JSON to check; default: run the "
@@ -79,6 +173,20 @@ def main(argv=None):
                         "run the obs_ops kernel workload (Pallas "
                         "forced on) and diff the baseline's 'kernels' "
                         "section")
+    p.add_argument("--history", action="store_true",
+                   help="check the newest archived run against the "
+                        "rolling window of prior runs in the "
+                        "performance archive instead of a committed "
+                        "baseline")
+    p.add_argument("--profile-dir", default=None,
+                   help="--history archive directory (default "
+                        "MXNET_OBS_PROFILE_DIR)")
+    p.add_argument("--history-metric", default="p50_ms",
+                   help="--history span stat to guard (default "
+                        "p50_ms)")
+    p.add_argument("--window", type=int, default=None,
+                   help="--history rolling-window size (default "
+                        "MXNET_OBS_PROFILE_HISTORY=8)")
     args = p.parse_args(argv)
 
     cli_tol = {}
@@ -87,6 +195,11 @@ def main(argv=None):
         if not frac:
             p.error("--tol wants METRIC=FRAC, got %r" % spec)
         cli_tol[metric] = float(frac)
+
+    if args.history:
+        return run_history(args, cli_tol)
+    if not args.baseline:
+        p.error("--baseline is required (except with --history)")
 
     if args.current:
         current, _ = _load_summary(args.current)
@@ -106,9 +219,12 @@ def main(argv=None):
                   "compiled program (MXNET_OBS off at trace time?)")
             return 2
         if args.kernels:
+            from mxnet_tpu.observability import profile_store
+            have = {profile_store.normalize_scope(k)
+                    for k in current.get("scopes", {})}
             missing = [k for k in ("paged_decode_kernel",
                                    "paged_verify_kernel")
-                       if k not in current.get("scopes", {})]
+                       if k not in have]
             if missing:
                 print("[obs_regression] FAIL: kernel workload is "
                       "missing megakernel scope(s) %s — did the Pallas "
@@ -157,6 +273,14 @@ def main(argv=None):
               % (args.baseline,
                  " (kernels section)" if args.kernels else ""))
         return 0
+
+    if args.kernels:
+        # the store's signature normalization: a re-jit's harmless
+        # scope rename must merge back onto the baseline row
+        baseline, base_notes = _normalize_scopes(baseline)
+        current, cur_notes = _normalize_scopes(current)
+        for note in base_notes + cur_notes:
+            print("[obs_regression] note: %s" % note)
 
     from mxnet_tpu.observability import attribution
     tol = dict(baseline_doc.get("tolerances", {}))

@@ -441,6 +441,107 @@ def orchestrate(nproc):
     return 0
 
 
+def store_smoke():
+    """--store: the performance-archive smoke (ISSUE 18). Two synthetic
+    runs of the same workload — deterministic injected span durations,
+    the second run 2x slower on one scope — must land in ONE merged
+    timeline (``tools/perf_timeline.py`` renders both runs), and
+    ``obs_regression --history`` must flag the slowed scope by name
+    while leaving the steady scope alone."""
+    import contextlib
+    import importlib.util
+    import io
+    import shutil
+    import time as _time
+
+    from mxnet_tpu.observability import core, profile_store
+
+    def load_tool(name):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "%s.py" % name))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    d = tempfile.mkdtemp(prefix="obs_store_smoke_")
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_OBS_PROFILE_DIR", "MXNET_OBS_PROFILE_RUN")}
+    os.environ["MXNET_OBS_PROFILE_DIR"] = d
+    try:
+        t0 = _time.perf_counter_ns()
+        # run1: decode 5ms, steady 8ms; run2: decode 10ms (the
+        # injected 2x slowdown), steady 8ms — synthetic spans through
+        # the REAL ring + record_run() write path
+        for run, decode_ms in (("run1", 5.0), ("run2", 10.0)):
+            os.environ["MXNET_OBS_PROFILE_RUN"] = run
+            core.set_enabled(True)
+            core.reset()
+            for _ in range(3):
+                core.record_span("smoke.decode", "phase", t0,
+                                 t0 + int(decode_ms * 1e6))
+                core.record_span("smoke.steady", "phase", t0,
+                                 t0 + int(8.0 * 1e6))
+            if not profile_store.record_run():
+                print("[obs_smoke] FAIL: record_run wrote nothing")
+                return 1
+        records, evidence = profile_store.load(d)
+        if evidence:
+            print("[obs_smoke] FAIL: fresh archive has corruption "
+                  "evidence: %s" % evidence)
+            return 1
+        groups = profile_store.merge_by_signature(records)
+        decode = next((g for g in groups.values()
+                       if g["scope"] == "smoke.decode"), None)
+        if decode is None or decode["runs"] != ["run1", "run2"]:
+            print("[obs_smoke] FAIL: two runs did not merge into one "
+                  "timeline: %s" % (decode and decode["runs"]))
+            return 1
+
+        perf_timeline = load_tool("perf_timeline")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = perf_timeline.main(["--dir", d, "--json",
+                                     os.path.join(d, "timeline.json")])
+        out = buf.getvalue()
+        if rc != 0 or "2 run(s)" not in out \
+                or "smoke.decode" not in out:
+            print(out)
+            print("[obs_smoke] FAIL: perf_timeline did not render "
+                  "both runs (rc=%d)" % rc)
+            return 1
+
+        obs_regression = load_tool("obs_regression")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = obs_regression.main(["--history", "--profile-dir", d])
+        out = buf.getvalue()
+        if rc != 1 or "smoke.decode" not in out:
+            print(out)
+            print("[obs_smoke] FAIL: --history missed the injected 2x "
+                  "slowdown (rc=%d)" % rc)
+            return 1
+        if "smoke.steady" in out:
+            print(out)
+            print("[obs_smoke] FAIL: --history flagged the steady "
+                  "scope")
+            return 1
+        print("[obs_smoke] store OK: %d records, 2 runs merged, "
+              "perf_timeline rendered, --history flagged smoke.decode "
+              "2x drift" % len(records))
+        return 0
+    finally:
+        core.set_enabled(None)
+        core.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        profile_store.reset()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--nproc", type=int, default=1,
@@ -456,9 +557,16 @@ def main():
                         "ContinuousBatcher step's dispatch/sync/patch "
                         "spans and depth/occupancy gauges must reach "
                         "the emitted trace")
+    p.add_argument("--store", action="store_true",
+                   help="run the performance-archive smoke instead: "
+                        "two synthetic runs must merge into one "
+                        "timeline and --history must flag an injected "
+                        "2x slowdown")
     args = p.parse_args()
     if os.environ.get("OBS_SMOKE_WORKER"):
         return worker()
+    if args.store:
+        return store_smoke()
     if args.serving:
         return serving_smoke()
     if args.ops:

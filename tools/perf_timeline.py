@@ -1,0 +1,179 @@
+"""Cross-run performance timelines from the profile archive.
+
+Renders per-scope (span p50) and per-headline (bench metric) trends
+across every run archived under ``MXNET_OBS_PROFILE_DIR`` — an ASCII
+sparkline per signature plus first->last delta — and can write the
+same series as a JSON artifact. This is the read side of
+observability/profile_store.py: two instrumented runs of the same
+workload appear as ONE merged timeline with two points, and the
+PERF.md round tables get a trajectory instead of a single row.
+
+    MXNET_OBS_PROFILE_DIR=/data/perf python tools/perf_timeline.py
+    python tools/perf_timeline.py --dir /data/perf --json timeline.json
+    python tools/perf_timeline.py --dir /data/perf --scope paged
+
+Exit codes: 0 rendered, 1 archive empty, 2 no archive directory.
+Torn/corrupt frames are reported as notes (file + offset) and
+skipped — the store's read discipline.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+# 9-level ASCII sparkline ramp (low -> high); missing points are " "
+RAMP = ".:-=+*#%@"
+
+
+def spark(values):
+    """ASCII sparkline; None points (run missing this scope) render
+    as spaces, a constant series sits mid-ramp."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return ""
+    lo, hi = min(present), max(present)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(RAMP[len(RAMP) // 2])
+        else:
+            idx = int((v - lo) / span * (len(RAMP) - 1))
+            out.append(RAMP[idx])
+    return "".join(out)
+
+
+def _delta(series):
+    first, last = series[0], series[-1]
+    if first and first > 0:
+        return 100.0 * (last - first) / first
+    return 0.0
+
+
+def _series_rows(groups, runs, metric):
+    """[(label, sig, {run: value})] for every signature group with at
+    least one measured point of ``metric``."""
+    from mxnet_tpu.observability import profile_store
+    rows = []
+    for sig in sorted(groups):
+        g = groups[sig]
+        pts = dict((run, val) for run, _ts, val
+                   in profile_store.run_series(g, metric=metric))
+        if pts:
+            rows.append((g["scope"], sig, pts))
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dir", default=None,
+                   help="archive directory (default "
+                        "MXNET_OBS_PROFILE_DIR)")
+    p.add_argument("--metric", default="p50_ms",
+                   help="span stat to trend for scopes (default "
+                        "p50_ms; also total_ms, p99_ms, count)")
+    p.add_argument("--scope", default=None,
+                   help="only signatures whose scope contains this "
+                        "substring")
+    p.add_argument("--runs", type=int, default=None,
+                   help="only the last N archived runs")
+    p.add_argument("--json", metavar="OUT", default=None,
+                   help="write the timeline series as a JSON artifact")
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.observability import profile_store
+    d = args.dir or profile_store.store_dir()
+    if not d or not os.path.isdir(d):
+        print("[perf_timeline] no archive directory (set "
+              "MXNET_OBS_PROFILE_DIR or pass --dir)")
+        return 2
+    records, evidence = profile_store.load(d)
+    for ev in evidence:
+        print("[perf_timeline] note: skipped %s frame at %s+%d (%s)"
+              % (ev["evidence"], os.path.basename(ev["file"]),
+                 ev["offset"], ev["detail"]))
+    if not records:
+        print("[perf_timeline] archive %s is empty" % d)
+        return 1
+
+    runs = profile_store.runs_in(records)
+    if args.runs:
+        runs = runs[-args.runs:]
+    print("performance archive %s — %d run(s): %s"
+          % (d, len(runs), ", ".join(runs)))
+
+    groups = profile_store.merge_by_signature(records)
+    if args.scope:
+        groups = {sig: g for sig, g in groups.items()
+                  if args.scope in g["scope"]}
+    scope_rows = _series_rows(groups, runs, args.metric)
+
+    doc = {"dir": d, "metric": args.metric, "runs": runs,
+           "scopes": [], "bench": []}
+    if scope_rows:
+        print()
+        print("Per-scope trend (%s)" % args.metric)
+        print("=" * 10)
+        fmt = "%-36s %5s  %-*s %10s %10s %8s"
+        width = max(len(runs), 5)
+        print(fmt % ("Scope", "Pts", width, "Trend", "First",
+                     "Last", "Delta"))
+        for label, sig, pts in scope_rows:
+            vals = [pts.get(r) for r in runs]
+            series = [v for v in vals if v is not None]
+            print(fmt % (label[:36], len(series), width, spark(vals),
+                         "%.3f" % series[0], "%.3f" % series[-1],
+                         "%+.0f%%" % _delta(series)))
+            doc["scopes"].append(
+                {"scope": label, "sig": sig,
+                 "points": [{"run": r, "value": pts.get(r)}
+                            for r in runs if r in pts]})
+
+    bench = {}
+    for r in records:
+        if r.get("kind") == "bench" and r.get("value") is not None:
+            key = (r.get("metric", r.get("leg", "?")),
+                   r.get("sig", ""))
+            bench.setdefault(key, {})[r.get("run")] = \
+                (float(r["value"]), r.get("unit"))
+    if bench:
+        print()
+        print("Per-headline trend (bench legs)")
+        print("=" * 10)
+        fmt = "%-36s %5s  %-*s %12s %12s %8s"
+        width = max(len(runs), 5)
+        print(fmt % ("Metric", "Pts", width, "Trend", "First",
+                     "Last", "Delta"))
+        for (metric, sig), pts in sorted(bench.items()):
+            vals = [pts[r][0] if r in pts else None for r in runs]
+            series = [v for v in vals if v is not None]
+            if not series:
+                continue
+            unit = next((pts[r][1] for r in runs if r in pts), "") or ""
+            print(fmt % (metric[:36], len(series), width, spark(vals),
+                         "%.4g %s" % (series[0], unit),
+                         "%.4g %s" % (series[-1], unit),
+                         "%+.0f%%" % _delta(series)))
+            doc["bench"].append(
+                {"metric": metric, "sig": sig,
+                 "points": [{"run": r, "value": pts[r][0],
+                             "unit": pts[r][1]}
+                            for r in runs if r in pts]})
+
+    if not scope_rows and not bench:
+        print("[perf_timeline] no measured series matched")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print("\n[perf_timeline] timeline -> %s" % args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
