@@ -390,13 +390,18 @@ def _archive_leg(name, res):
     run's config fingerprint, and stamp the fingerprint id into the
     BENCH_TABLE row for provenance. One guarded branch — no I/O with
     MXNET_OBS_PROFILE_DIR unset; never raises (archiving must not
-    fail the queue)."""
+    fail the queue). Fingerprinting runs with discover=False: this is
+    the ORCHESTRATOR, and a jax.devices() here would initialize the
+    backend in the parent and hold the single chip's claim, starving
+    every later leg subprocess (the queue's whole one-claimant
+    contract, lines above) — the device doc comes from the leg's own
+    archived records instead."""
     if not os.environ.get("MXNET_OBS_PROFILE_DIR"):
         return
     try:
         sys.path.insert(0, ROOT)
         from mxnet_tpu.observability import profile_store
-        fid, _cfg = profile_store.config_fingerprint()
+        fid, cfg = profile_store.config_fingerprint(discover=False)
         res["fingerprint"] = fid
         for ln in res["stdout"].splitlines():
             if not ln.startswith('{"metric"'):
@@ -411,7 +416,8 @@ def _archive_leg(name, res):
             extra["queue_leg"] = name
             profile_store.append_bench(
                 name, value=rec.get("value"), unit=rec.get("unit"),
-                metric=rec.get("metric", name), extra=extra)
+                metric=rec.get("metric", name), extra=extra,
+                fingerprint=fid, config=cfg)
     except Exception:
         pass
 

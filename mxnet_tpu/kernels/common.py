@@ -122,11 +122,14 @@ def choose_block_k(t_max, shape_key=(), candidates=(512, 256, 128),
                     "%s=%r is not a positive multiple of %d dividing "
                     "cache length %d; using the default block choice"
                     % (env, raw, multiple, t_max), stacklevel=2)
-    if choice is None and os.environ.get("MXNET_OBS_PROFILE_DIR"):
+    if choice is None and env == "MXNET_PAGED_BLOCK_K" \
+            and os.environ.get("MXNET_OBS_PROFILE_DIR"):
         # an ARCHIVED winner beats the static heuristic: the profile
         # store holds measured p50s per MXNET_PAGED_BLOCK_K config
         # fingerprint from past A/B runs (ISSUE 18 / ROADMAP item 5's
-        # predict-and-prune). One guarded branch — with the store
+        # predict-and-prune). Only for callers keyed on that knob —
+        # flash_decode doesn't honor it, so a paged winner must not
+        # leak into its grid. One guarded branch — with the store
         # unset this is a single env read; the memo above means the
         # archive is consulted once per distinct shape key.
         try:

@@ -34,14 +34,19 @@ when the archive is off or empty, and never raise.
 
 import math
 import os
+import threading
 
 from . import profile_store
 
-__all__ = ["fit", "predict", "predict_ms", "calibration_report",
-           "format_calibration_table", "archived_block_k"]
+__all__ = ["fit", "cached_fit", "predict", "predict_ms",
+           "calibration_report", "format_calibration_table",
+           "archived_block_k", "reset_cache"]
 
 MIN_LSQ_POINTS = 3       # below this, fit the single-scale model
 _EPS = 1e-9
+
+_cache_lock = threading.Lock()
+_fit_cache = [None]      # (stamp, records, model)
 
 
 def _peaks():
@@ -140,6 +145,49 @@ def fit(records=None, dirpath=None, exclude_scope=None):
     return {"families": {fam: _fit_points(fpts)
                          for fam, fpts in sorted(fams.items())},
             "global": _fit_points(pts), "n": len(pts)}
+
+
+def _archive_stamp(dirpath=None):
+    """Cheap change stamp of the archive dir: (path, mtime_ns, size)
+    per file. Appends grow the size, prune's os.replace bumps the
+    mtime — either invalidates the cache. None when the store is
+    off."""
+    d = dirpath or profile_store.store_dir()
+    if not d:
+        return None
+    stamp = [d]
+    for p in profile_store.list_files(d):
+        try:
+            st = os.stat(p)
+            stamp.append((p, st.st_mtime_ns, st.st_size))
+        except OSError:
+            stamp.append((p, -1, -1))
+    return tuple(stamp)
+
+
+def cached_fit(dirpath=None):
+    """(records, model) memoized on the archive's mtime/size stamp —
+    the hot-caller entry point (membudget's per-admission
+    ``predicted_step_ms``), which must not pay a full archive reload +
+    lstsq refit per call when nothing changed on disk."""
+    stamp = _archive_stamp(dirpath)
+    if stamp is None:
+        return [], fit(records=[])
+    with _cache_lock:
+        hit = _fit_cache[0]
+        if hit is not None and hit[0] == stamp:
+            return hit[1], hit[2]
+    records, _ev = profile_store.load(dirpath)
+    model = fit(records=records)
+    with _cache_lock:
+        _fit_cache[0] = (stamp, records, model)
+    return records, model
+
+
+def reset_cache():
+    """Drop the cached_fit memo (tests)."""
+    with _cache_lock:
+        _fit_cache[0] = None
 
 
 def predict(signature=None, scope=None, flops=None, hbm_bytes=None,
@@ -257,24 +305,31 @@ def format_calibration_table(records=None, dirpath=None):
 
 def archived_block_k(t_max, multiple=1,
                      families=("paged_decode_kernel",
-                               "paged_verify_kernel",
-                               "flash_decode"),
+                               "paged_verify_kernel"),
                      dirpath=None):
-    """The measured block_k winner for the decode-kernel scope
-    families: group archived kernel-scope records by the
-    MXNET_PAGED_BLOCK_K their config fingerprint carried, score each
-    candidate by median measured p50, and return the fastest one that
-    tiles (divides ``t_max``, multiple of ``multiple``). None when the
-    archive holds no measured candidates — the caller keeps its static
-    heuristic. The predict-and-prune entry point ROADMAP item 5
-    deferred."""
+    """The measured block_k winner for the paged decode-kernel scope
+    families, from COMPARABLE measurements only. Archived kernel-scope
+    records are grouped by (scope family, normalized program
+    signature) — the config fingerprint is deliberately excluded from
+    the group key, since it encodes the MXNET_PAGED_BLOCK_K knob being
+    compared — and a winner must come from ONE group holding >= 2
+    distinct candidates that tile this ``t_max`` (an actual measured
+    A/B on the same workload shape): a block_k measured only on small
+    paged workloads must not win a pooled median and get applied to a
+    much larger cache, and flash_decode (which does not honor the
+    paged knob) is out of the default families. Within the
+    best-evidenced group (most distinct candidates, then most
+    measurements) each candidate scores by its median measured p50;
+    the fastest wins. None when no group holds a comparable A/B — the
+    caller keeps its static heuristic. The predict-and-prune entry
+    point ROADMAP item 5 deferred."""
     records, _ev = profile_store.load(dirpath)
-    by_bk = {}
+    groups = {}
     for r in records:
         if r.get("kind") != "scope":
             continue
-        if profile_store.normalize_scope(
-                r.get("scope", "")) not in families:
+        fam = profile_store.normalize_scope(r.get("scope", ""))
+        if fam not in families:
             continue
         y = (r.get("stats") or {}).get("p50_ms")
         raw = (r.get("config") or {}).get("env", {}).get(
@@ -285,12 +340,22 @@ def archived_block_k(t_max, multiple=1,
             bk = int(raw)
         except ValueError:
             continue
-        if bk > 0:
-            by_bk.setdefault(bk, []).append(float(y))
-    best, best_ms = None, math.inf
-    for bk, ys in sorted(by_bk.items()):
-        if bk % multiple or t_max % bk or bk > t_max:
+        if bk <= 0 or bk % multiple or t_max % bk or bk > t_max:
             continue
+        key = (fam, profile_store.normalize_signature(
+            r.get("signature", "")))
+        groups.setdefault(key, {}).setdefault(bk, []).append(float(y))
+    best_rank, best_by_bk = None, None
+    for key, by_bk in sorted(groups.items()):
+        if len(by_bk) < 2:      # one candidate is not a comparison
+            continue
+        rank = (len(by_bk), sum(len(v) for v in by_bk.values()))
+        if best_rank is None or rank > best_rank:
+            best_rank, best_by_bk = rank, by_bk
+    if best_by_bk is None:
+        return None
+    best, best_ms = None, math.inf
+    for bk, ys in sorted(best_by_bk.items()):
         ys.sort()
         med = ys[len(ys) // 2]
         if med < best_ms:

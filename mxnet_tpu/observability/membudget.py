@@ -535,19 +535,26 @@ def healthz_snapshot():
             "budget_mode": budget_mode() or "off"}
 
 
-def predicted_step_ms(scope=None, signature=None, dirpath=None):
+def predicted_step_ms(scope=None, signature=None, dirpath=None,
+                      model=None):
     """Cost-model hook (ISSUE 18): the calibrated roofline prediction
     for an archived scope/signature, so admission decisions can weigh
     TIME next to bytes (a preflight that passes on memory but predicts
-    a 10x step regression is still worth flagging). Returns None when
-    the performance archive is off or holds nothing for the workload —
-    callers keep their bytes-only verdicts. Never raises."""
+    a 10x step regression is still worth flagging). Per-admission
+    callers are cheap: the archive load + fit go through
+    ``costmodel.cached_fit`` (mtime/size-stamped memo, refit only when
+    the archive changed on disk), and a caller holding its own prefit
+    ``model`` can pass it in. Returns None when the performance
+    archive is off or holds nothing for the workload — callers keep
+    their bytes-only verdicts. Never raises."""
     try:
         from . import costmodel, profile_store
         if dirpath is None and not profile_store.enabled():
             return None
+        records, cached_model = costmodel.cached_fit(dirpath)
         return costmodel.predict(signature=signature, scope=scope,
-                                 dirpath=dirpath)
+                                 records=records,
+                                 model=model or cached_model)
     except Exception:
         return None
 

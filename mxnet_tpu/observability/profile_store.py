@@ -15,7 +15,9 @@ On-disk form (house MXFLIGHT-style framing, many frames per file):
     MXPROF1 <crc32> <len>\\n{ json record }\\n
 
 Files are ``profiles.<host>.mxp``, opened O_APPEND so concurrent
-writers interleave whole frames; the reader re-synchronizes on the
+writers interleave whole frames (writers additionally serialize on a
+sidecar ``.lock`` flock so the retention rewrite cannot discard a
+concurrent append); the reader re-synchronizes on the
 magic and skips torn/corrupt frames with named evidence
 (``torn-header`` / ``bad-magic`` / ``torn-payload`` / ``crc-mismatch``
 / ``bad-json``) carrying the file + byte offset — a crash mid-write
@@ -44,6 +46,7 @@ entry point is ONE guarded branch (`enabled()` is a ~0.1us _fastenv
 read) and no store I/O happens at all.
 """
 
+import contextlib
 import hashlib
 import json
 import os
@@ -53,11 +56,16 @@ import threading
 import time
 import zlib
 
+try:
+    import fcntl
+except ImportError:        # non-POSIX: intra-process _lock only
+    fcntl = None
+
 from .. import _fastenv
 
 __all__ = ["MAGIC", "SCHEMA", "StoreError", "FINGERPRINT_ENVS",
            "enabled", "store_dir", "keep", "history", "run_id",
-           "config_fingerprint", "normalize_scope",
+           "config_fingerprint", "archived_device_doc", "normalize_scope",
            "normalize_signature", "signature_key", "frame",
            "read_file", "load", "append", "append_bench",
            "record_run", "prune", "merge_by_signature", "runs_in",
@@ -209,26 +217,58 @@ def normalize_scope(name):
     return norm or base
 
 
-def config_fingerprint(extra=None):
+_UNKNOWN_DEVICE_DOC = {"device_kind": "?", "backend": "?",
+                       "n_devices": 0, "n_processes": 0}
+_DEVICE_DOC_KEYS = tuple(_UNKNOWN_DEVICE_DOC)
+
+
+def archived_device_doc(dirpath=None):
+    """The device half of the fingerprint from the NEWEST archived
+    record that carries one — written by a process that actually held
+    the device — or None. Never touches a backend."""
+    records, _ev = load(dirpath)
+    for r in reversed(records):                 # load() sorts by ts
+        cfg = r.get("config") or {}
+        if cfg.get("device_kind") and cfg.get("device_kind") != "?":
+            return {k: cfg.get(k) for k in _DEVICE_DOC_KEYS}
+    return None
+
+
+def config_fingerprint(extra=None, discover=True):
     """(fingerprint-id, doc): device kind + mesh/process shape + the
     FINGERPRINT_ENVS knobs, hashed to a short id. The doc rides in
     every record so a timeline can explain why two signatures differ.
     Device discovery is cached per process and best-effort (the store
-    must work before/without a backend)."""
-    if _device_doc[0] is None:
-        doc = {}
-        try:
-            import jax
-            dev = jax.devices()[0]
-            doc = {"device_kind": getattr(dev, "device_kind", "?"),
-                   "backend": jax.default_backend(),
-                   "n_devices": jax.device_count(),
-                   "n_processes": jax.process_count()}
-        except Exception:
-            doc = {"device_kind": "?", "backend": "?",
-                   "n_devices": 0, "n_processes": 0}
-        _device_doc[0] = doc
-    cfg = dict(_device_doc[0])
+    must work before/without a backend).
+
+    ``discover=False`` NEVER initializes a backend: the device doc
+    comes from the newest archived record (written by the process that
+    measured it), else the unknown-device placeholder. This is for
+    orchestrators like ``benchmark/run_chip_queue.py`` whose contract
+    is that one leg subprocess at a time exclusively claims the chip —
+    a ``jax.devices()`` in the parent would hold the claim and starve
+    every later leg. The placeholder is not cached, so the doc
+    upgrades to the real one once a leg has archived it."""
+    doc = _device_doc[0]
+    if doc is None:
+        if discover:
+            try:
+                import jax
+                dev = jax.devices()[0]
+                doc = {"device_kind": getattr(dev, "device_kind", "?"),
+                       "backend": jax.default_backend(),
+                       "n_devices": jax.device_count(),
+                       "n_processes": jax.process_count()}
+            except Exception:
+                doc = dict(_UNKNOWN_DEVICE_DOC)
+            _device_doc[0] = doc
+        else:
+            doc = archived_device_doc()
+            if doc is not None:
+                _device_doc[0] = doc
+            else:
+                doc = dict(_UNKNOWN_DEVICE_DOC)
+    cfg = dict(doc)
     cfg["env"] = {k: os.environ[k] for k in FINGERPRINT_ENVS
                   if os.environ.get(k)}
     if extra:
@@ -332,6 +372,39 @@ def load(dirpath=None):
 
 # --------------------------------------------------------- writers ---
 
+@contextlib.contextmanager
+def _file_lock(path):
+    """Cross-process writer lock: flock on a sidecar ``<file>.lock``
+    (never the data file itself — prune's os.replace swaps the data
+    inode, which would orphan a lock taken on it). O_APPEND alone makes
+    concurrent appends safe, but prune's read-modify-replace is not:
+    a frame appended between its read and its replace would be
+    silently discarded, so every writer — append AND prune — holds
+    this lock. Best-effort: without fcntl (non-POSIX) or on lock
+    errors, fall back to the intra-process ``_lock`` the callers
+    already hold."""
+    if fcntl is None:
+        yield
+        return
+    try:
+        fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError:
+        yield
+        return
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except OSError:
+            pass
+        yield
+    finally:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        os.close(fd)
+
+
 def append(doc, dirpath=None):
     """Append one framed record to this host's archive file. Returns
     the path, or None when the store is off (the guarded branch) or
@@ -350,7 +423,7 @@ def append(doc, dirpath=None):
     path = host_file(dirpath)
     data = frame(doc)
     try:
-        with _lock:
+        with _lock, _file_lock(path):
             with open(path, "ab") as f:     # O_APPEND: whole frames
                 f.write(data)
                 f.flush()
@@ -412,14 +485,20 @@ def record_run(run=None, dirpath=None, ts=None):
 
 
 def append_bench(leg, value=None, unit=None, metric=None, extra=None,
-                 dirpath=None, run=None):
+                 dirpath=None, run=None, fingerprint=None, config=None):
     """Archive one bench headline row (benchmark/common.py's hook).
-    Returns the path written, or None when the store is off. Never
-    raises — a bench must not fail because archiving did."""
+    ``fingerprint``/``config`` let a caller that already computed the
+    fingerprint (run_chip_queue's orchestrator, which must not trigger
+    device discovery) pass it through instead of recomputing. Returns
+    the path written, or None when the store is off. Never raises — a
+    bench must not fail because archiving did."""
     try:
         if dirpath is None and not enabled():
             return None
-        fid, cfg = config_fingerprint()
+        if fingerprint is None:
+            fid, cfg = config_fingerprint()
+        else:
+            fid, cfg = fingerprint, (config or {})
         metric = metric or leg
         rec = {"schema": SCHEMA, "kind": "bench", "run": run or run_id(),
                "ts": time.time(), "host": _host(), "leg": leg,
@@ -441,7 +520,12 @@ def prune(dirpath=None, keep_n=None):
     """Enforce the per-signature retention cap on this host's file:
     keep the newest ``keep_n`` (default MXNET_OBS_PROFILE_KEEP) records
     per signature, atomically rewriting only when something must go.
-    Returns the number of records dropped."""
+    The read AND the rewrite happen under ``_lock`` + the cross-process
+    ``_file_lock`` — a frame appended concurrently (other thread or
+    other process on this host) lands either before the read (and is
+    kept) or after the replace (O_APPEND onto the new file), never in
+    the window where the rewrite would discard it. Returns the number
+    of records dropped."""
     d = dirpath or store_dir()
     if not d:
         return 0
@@ -449,21 +533,21 @@ def prune(dirpath=None, keep_n=None):
     if not os.path.exists(path):
         return 0
     keep_n = keep_n or keep()
-    records, _ev = read_file(path)
-    by_sig = {}
-    for i, r in enumerate(records):
-        by_sig.setdefault(r.get("sig", ""), []).append(i)
-    drop = set()
-    for idxs in by_sig.values():
-        if len(idxs) > keep_n:
-            idxs.sort(key=lambda i: (records[i].get("ts", 0), i))
-            drop.update(idxs[:-keep_n])
-    if not drop:
-        return 0
-    kept = [r for i, r in enumerate(records) if i not in drop]
     tmp = path + ".tmp.%d" % os.getpid()
     try:
-        with _lock:
+        with _lock, _file_lock(path):
+            records, _ev = read_file(path)
+            by_sig = {}
+            for i, r in enumerate(records):
+                by_sig.setdefault(r.get("sig", ""), []).append(i)
+            drop = set()
+            for idxs in by_sig.values():
+                if len(idxs) > keep_n:
+                    idxs.sort(key=lambda i: (records[i].get("ts", 0), i))
+                    drop.update(idxs[:-keep_n])
+            if not drop:
+                return 0
+            kept = [r for i, r in enumerate(records) if i not in drop]
             with open(tmp, "wb") as f:
                 for r in kept:
                     f.write(frame(r))

@@ -157,6 +157,29 @@ def test_fingerprint_tracks_env_knobs(store, monkeypatch):
     assert cfg2["env"]["MXNET_PAGED_BLOCK_K"] == "256"
 
 
+def test_fingerprint_no_discovery_reads_archived_device_doc(store):
+    # the orchestrator mode (run_chip_queue): discover=False must not
+    # initialize a backend — the device doc comes from the archive
+    fid, cfg = profile_store.config_fingerprint(discover=False)
+    assert cfg["device_kind"] == "?"        # empty archive: placeholder
+    rec = _scope_rec("decode", "run0", 5.0, 10.0)
+    rec["config"] = {"device_kind": "axon-v1", "backend": "axon",
+                     "n_devices": 1, "n_processes": 1, "env": {}}
+    profile_store.append(rec)
+    # the placeholder was NOT cached: the next call upgrades to the
+    # leg-archived doc and fingerprints diverge accordingly
+    fid2, cfg2 = profile_store.config_fingerprint(discover=False)
+    assert cfg2["device_kind"] == "axon-v1"
+    assert fid2 != fid
+    # append_bench with an explicit fingerprint recomputes nothing
+    path = profile_store.append_bench("leg", value=1.0, unit="x",
+                                      fingerprint=fid2, config=cfg2)
+    assert path is not None
+    loaded, _ = profile_store.load(store)
+    bench = [r for r in loaded if r.get("kind") == "bench"]
+    assert bench and bench[0]["fingerprint"] == fid2
+
+
 def test_record_run_spans(store, monkeypatch):
     monkeypatch.setenv("MXNET_OBS", "1")
     core.set_enabled(True)
@@ -248,8 +271,32 @@ def test_costmodel_off_without_store(monkeypatch):
 
 def test_membudget_predicted_step_ms(store):
     _roofline_archive(store)
+    costmodel.reset_cache()
     pred = membudget.predicted_step_ms(scope="conv")
     assert pred is not None and pred > 0
+
+
+def test_cached_fit_memoizes_until_archive_changes(store, monkeypatch):
+    _roofline_archive(store)
+    costmodel.reset_cache()
+    records, model = costmodel.cached_fit()
+    assert model["n"] > 0
+    # unchanged archive: the memo hits — no reload, no refit
+    calls = []
+    real_load = profile_store.load
+    monkeypatch.setattr(profile_store, "load",
+                        lambda *a, **k: calls.append(1) or real_load(
+                            *a, **k))
+    records2, model2 = costmodel.cached_fit()
+    assert not calls
+    assert model2 is model and records2 is records
+    # an append changes the stamp -> reload + refit
+    profile_store.append(_scope_rec("conv", "runN", 99.0, 99.0,
+                                    flops=1e12, hbm=1e9))
+    _r3, model3 = costmodel.cached_fit()
+    assert calls
+    assert model3 is not model
+    costmodel.reset_cache()
 
 
 def test_archived_block_k_beats_heuristic(store):
@@ -267,10 +314,34 @@ def test_archived_block_k_beats_heuristic(store):
     from mxnet_tpu.kernels import common as kcommon
     kcommon._BLOCK_CHOICE.clear()
     try:
+        # the archive consult is scoped to the paged knob's callers...
         assert kcommon.choose_block_k(1024, shape_key=("test_arch",),
-                                      multiple=16) == 128
+                                      multiple=16,
+                                      env="MXNET_PAGED_BLOCK_K") == 128
+        # ...a caller not keyed on it (flash_decode) keeps its static
+        # heuristic — paged winners must not leak into its grid
+        assert kcommon.choose_block_k(1024, shape_key=("test_arch2",),
+                                      multiple=16) == 512
     finally:
         kcommon._BLOCK_CHOICE.clear()
+
+
+def test_archived_block_k_needs_comparable_measurements(store):
+    # a single measured candidate is not a comparison: keep the
+    # heuristic rather than crowning an un-raced block_k
+    profile_store.append(_scope_rec("paged_decode_kernel", "r0", 3.0,
+                                    10.0, sig="paged_decode_kernel||a",
+                                    block_k=128))
+    assert costmodel.archived_block_k(1024, multiple=16) is None
+    # flash_decode records don't honor MXNET_PAGED_BLOCK_K: excluded
+    profile_store.append(_scope_rec("flash_decode", "r0", 1.0, 11.0,
+                                    sig="flash_decode||a", block_k=256))
+    assert costmodel.archived_block_k(1024, multiple=16) is None
+    # a second candidate on the SAME workload signature makes the A/B
+    profile_store.append(_scope_rec("paged_decode_kernel", "r1", 7.0,
+                                    12.0, sig="paged_decode_kernel||b",
+                                    block_k=256))
+    assert costmodel.archived_block_k(1024, multiple=16) == 128
 
 
 def test_choose_block_k_heuristic_unchanged_without_store(monkeypatch):
