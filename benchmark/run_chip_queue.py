@@ -422,6 +422,84 @@ def _archive_leg(name, res):
         pass
 
 
+_LEDGER = {"started": None, "measuring_s": 0.0, "failed_s": 0.0,
+           "probe_s": 0.0, "sleeping_s": 0.0,
+           "legs_ok": 0, "legs_failed": 0}
+
+
+def _note_leg(res):
+    """Charge one run_leg result to the queue's own goodput ledger:
+    ok legs are the chip window's 'measuring' time, failures (incl. a
+    retried first attempt) its badput."""
+    if res.get("ok"):
+        _LEDGER["measuring_s"] += res.get("seconds", 0.0)
+        _LEDGER["legs_ok"] += 1
+    else:
+        _LEDGER["failed_s"] += res.get("seconds", 0.0)
+        _LEDGER["legs_failed"] += 1
+
+
+def _timed_probe(probe, **kw):
+    t0 = time.time()
+    try:
+        return probe(**kw)
+    finally:
+        _LEDGER["probe_s"] += time.time() - t0
+
+
+def _ledger_summary():
+    """The chip-window efficiency row: 100%% of the orchestrator's
+    wall time split into measuring / failed / probe / sleeping /
+    other, same invariant as the run-level goodput ledger."""
+    wall = max(time.time() - (_LEDGER["started"] or time.time()), 0.0)
+    tracked = (_LEDGER["measuring_s"] + _LEDGER["failed_s"]
+               + _LEDGER["probe_s"] + _LEDGER["sleeping_s"])
+    return {"leg": "_ledger", "ts": time.time(),
+            "wall_s": round(wall, 3),
+            "measuring_s": round(_LEDGER["measuring_s"], 3),
+            "failed_s": round(_LEDGER["failed_s"], 3),
+            "probe_s": round(_LEDGER["probe_s"], 3),
+            "sleeping_s": round(_LEDGER["sleeping_s"], 3),
+            "other_s": round(max(wall - tracked, 0.0), 3),
+            "goodput_fraction": (round(_LEDGER["measuring_s"] / wall,
+                                       4) if wall else 0.0),
+            "legs_ok": _LEDGER["legs_ok"],
+            "legs_failed": _LEDGER["legs_failed"]}
+
+
+def _finalize_ledger(args, table):
+    """Checkpoint the queue's goodput ledger as a ``_ledger``
+    pseudo-row in the BENCH_TABLE (run_pending only iterates QUEUE
+    names, so it never reads as a leg) and append it to the
+    performance archive so chip-window efficiency — time measuring vs
+    time wedged/retrying — is trended across rounds like any bench.
+    One guarded branch without MXNET_OBS_PROFILE_DIR; never raises."""
+    if _LEDGER["started"] is None:
+        return
+    row = _ledger_summary()
+    table["_ledger"] = row
+    try:
+        _save_table(args.out, table)
+    except OSError:
+        pass
+    if not os.environ.get("MXNET_OBS_PROFILE_DIR"):
+        return
+    try:
+        from mxnet_tpu.observability import profile_store
+        fid, cfg = profile_store.config_fingerprint(discover=False)
+        for key in ("wall_s", "measuring_s", "failed_s", "probe_s",
+                    "sleeping_s", "other_s", "goodput_fraction"):
+            profile_store.append_bench(
+                "_chip_queue", value=row[key],
+                unit="fraction" if key == "goodput_fraction" else "s",
+                metric="chip_queue.%s" % key,
+                extra={"legs_ok": row["legs_ok"],
+                       "legs_failed": row["legs_failed"]},
+                fingerprint=fid, config=cfg)
+    except Exception:
+        pass
+
+
 def _refresh_last_measured(res):
     """Point bench.py's wedged-tunnel fallback at a FRESH headline
     measurement (called at measurement time, never from a loaded
@@ -456,14 +534,19 @@ def _wait_claim_release(probe, tries=4, gap=20.0):
     """The tunnel releases a just-exited process's chip claim lazily;
     a probe (or a leg's first device touch) in that window blocks and
     reads as dead. Probe with patience before calling it a wedge."""
-    for i in range(tries):
-        if probe(use_cache=False):
-            return True
-        if i + 1 < tries:
-            _status("probe blocked (claim-release lag or wedge), "
-                    "retry %d/%d" % (i + 1, tries))
-            time.sleep(gap)
-    return False
+    t0 = time.time()
+    try:
+        for i in range(tries):
+            if probe(use_cache=False):
+                return True
+            if i + 1 < tries:
+                _status("probe blocked (claim-release lag or wedge), "
+                        "retry %d/%d" % (i + 1, tries))
+                time.sleep(gap)
+        return False
+    finally:
+        # claim-release waiting is probe overhead in the queue ledger
+        _LEDGER["probe_s"] += time.time() - t0
 
 
 def _looks_wedged(res):
@@ -498,6 +581,7 @@ def run_pending(args, table, probe):
                 % (name, timeout))
         res = run_leg(name, spec, timeout)
         res["attempts"] = (prior or {}).get("attempts", 0) + 1
+        _note_leg(res)
         if (not res["ok"] and not _looks_wedged(res)
                 and res["attempts"] < args.max_attempts):
             # one immediate in-pass retry for non-wedge failures
@@ -516,6 +600,7 @@ def run_pending(args, table, probe):
             retry["attempts"] = res["attempts"] + 1
             retry["first_failure"] = res["first_failure"]
             res = retry
+            _note_leg(res)
         print(res["stdout"], flush=True)
         if res["stderr"]:
             print(res["stderr"], file=sys.stderr, flush=True)
@@ -529,7 +614,7 @@ def run_pending(args, table, probe):
         else:
             if _looks_wedged(res):
                 _status("probe after wedge-looking failure: %s" % name)
-                if not probe(use_cache=False):
+                if not _timed_probe(probe, use_cache=False):
                     # a wedge-killed run is not the leg's fault: it must
                     # not consume an attempt, or a long leg that gets
                     # wedge-killed every short alive window exhausts
@@ -589,13 +674,23 @@ def main():
 
     table = _load_table(args.out, max_age_h=args.max_age_hours)
     deadline = time.time() + args.watch_hours * 3600.0
+    _LEDGER["started"] = time.time()
+    try:
+        return _watch_loop(args, table, probe, deadline)
+    finally:
+        # whatever path got us out, the window's efficiency ledger is
+        # checkpointed (and archived) so wedged time is itself trended
+        _finalize_ledger(args, table)
+
+
+def _watch_loop(args, table, probe, deadline):
     attempted_any = False
     verdict = None        # this probe cycle's state (sleep message)
     last_run_verdict = None   # last run_pending outcome (exit code)
 
     while True:
         _status("probing tunnel")
-        if probe(use_cache=False):
+        if _timed_probe(probe, use_cache=False):
             attempted_any = True
             verdict = last_run_verdict = run_pending(args, table, probe)
             if verdict == "done":
@@ -627,6 +722,7 @@ def main():
             _status("SLEEPING %ds (tunnel wedged); host free for "
                     "other work" % int(args.watch_interval))
         time.sleep(args.watch_interval)
+        _LEDGER["sleeping_s"] += args.watch_interval
 
     if not attempted_any:
         _status("EXITED — no tunnel-alive window in %.1f h"

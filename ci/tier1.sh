@@ -110,6 +110,28 @@ if [ "${TIER1_OBS:-0}" = "1" ]; then
         exit 1
     fi
 
+    echo "==== [tier1] goodput-ledger smoke (wall accounting + badput taxonomy) ===="
+    # ISSUE 19: a deterministic single-rank run with one injected
+    # stall per badput class (chaos io.read delay, detector-narrated
+    # recompile, checkpoint save) must come back with >=95% of the
+    # wall attributed, every injected category within 20% of its
+    # injected duration, the mxnet_obs_goodput_* Prometheus series
+    # exported, and tools/obs_goodput.py --check green on the dumped
+    # trace (docs/OBSERVABILITY.md "Goodput & critical path")
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --goodput; then
+        echo "[tier1] FAIL: goodput-ledger smoke"
+        exit 1
+    fi
+
+    echo "==== [tier1] critical-path smoke (2-process merged-trace attribution) ===="
+    # the merged 2-rank trace's per-step lattice walk must name which
+    # rank+phase bounds the step (the cross-rank critical path);
+    # serial like everything else on the 1-core host
+    if ! env JAX_PLATFORMS=cpu MXNET_OBS=1 python tools/obs_smoke.py --goodput --nproc 2; then
+        echo "[tier1] FAIL: critical-path smoke"
+        exit 1
+    fi
+
     echo "==== [tier1] distributed observability smoke (2-process gloo merge) ===="
     # two gloo workers train against dist_tpu_sync (clock-anchor
     # handshake at kvstore creation), dump rank-local traces, and the

@@ -26,6 +26,7 @@ from ..base import MXNetError
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
+from ..observability import goodput as _obs_goodput
 from ..observability import integrity as _integrity
 from ..observability import membudget as _membudget
 from ..observability import recompile as _obs_recompile
@@ -208,6 +209,12 @@ class Trainer(object):
             # the cross-rank straggler exchange
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)
+            # goodput ledger: this step committed (skip paths returned
+            # above) — count it and, once per elastic generation, write
+            # the first-commit sideband record that closes the
+            # recovery interval (goodput.elastic_downtime)
+            _obs_goodput.note_step_commit(
+                getattr(self, "_elastic_steps", None))
             # step-cadence mem.device.* gauge refresh (no-op unless
             # MXNET_MEM_GAUGE_EVERY is set) — headroom-driven brownout
             # and routing act on live data, not dump-time snapshots

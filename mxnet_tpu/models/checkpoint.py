@@ -72,6 +72,7 @@ import zlib
 import numpy as np
 
 from ..observability import chaos as _chaos
+from ..observability import core as _obs
 from ..observability import integrity as _integrity
 
 __all__ = ["save_checkpoint", "load_checkpoint", "restore_train_state",
@@ -181,7 +182,14 @@ def _snapshot(flat):
     near-full device over the edge; a RESOURCE_EXHAUSTED mid-gather
     (chaos site ``checkpoint.snapshot``, or the real thing) retries
     once post-GC without overlap. All of it one guarded branch when no
-    ``MXNET_MEM_*`` knob (and no chaos spec) is set."""
+    ``MXNET_MEM_*`` knob (and no chaos spec) is set. The
+    ``checkpoint.snapshot`` span feeds the goodput ledger's checkpoint
+    badput category."""
+    with _obs.span("checkpoint.snapshot", cat="checkpoint"):
+        return _snapshot_impl(flat)
+
+
+def _snapshot_impl(flat):
     from ..observability import membudget as _membudget
     armed = _membudget.armed()
     if not armed and not _chaos.enabled():
@@ -362,7 +370,21 @@ def save_checkpoint(path, cfg, params, momentum=None, step=0,
               save/load is the in-flight barrier. Multi-controller runs
               save synchronously (the completion barrier is a
               collective and must stay on the calling thread).
+
+    The ``checkpoint.save`` span covers the calling thread's blocking
+    cost (async saves: barrier + snapshot + thread handoff — the time
+    the train loop actually lost, which is what the goodput ledger
+    charges to its checkpoint category).
     """
+    with _obs.span("checkpoint.save", cat="checkpoint", step=step,
+                   async_save=bool(async_save)):
+        return _save_checkpoint_blocking(path, cfg, params, momentum,
+                                         step, metadata, keep,
+                                         async_save)
+
+
+def _save_checkpoint_blocking(path, cfg, params, momentum, step,
+                              metadata, keep, async_save):
     wait_for_pending_save()          # in-flight barrier (and re-raise)
     flat = {}
     _flatten(params, _PARAMS, flat)
@@ -974,6 +996,17 @@ def save_shard_checkpoint(path, cfg, params, momentum=None, step=0,
     monitor thread while the main thread is wedged. Keeps the newest
     ``keep_generations`` complete shard generations (default: the
     ``MXNET_ELASTIC_KEEP_GENERATIONS`` knob, 2)."""
+    with _obs.span("checkpoint.save", cat="checkpoint", step=int(step),
+                   shard=int(rank), world=int(world),
+                   generation=int(generation)):
+        return _save_shard_checkpoint_impl(
+            path, cfg, params, momentum, step, rank, world, generation,
+            cursor, rng, base_world, metadata, keep_generations)
+
+
+def _save_shard_checkpoint_impl(path, cfg, params, momentum, step, rank,
+                                world, generation, cursor, rng,
+                                base_world, metadata, keep_generations):
     if keep_generations is None:
         from .. import _fastenv
         try:

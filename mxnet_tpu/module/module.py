@@ -24,6 +24,7 @@ from ..initializer import Uniform, InitDesc
 from ..observability import chaos as _chaos
 from ..observability import core as _obs
 from ..observability import dist as _obs_dist
+from ..observability import goodput as _obs_goodput
 from ..observability import integrity as _integrity
 from ..observability import recompile as _obs_recompile
 from ..model import save_checkpoint, load_checkpoint
@@ -420,11 +421,16 @@ class Module(BaseModule):
                 # weight update happens inside the store's push, so the
                 # guard must gate BEFORE any gradient leaves the exec
                 _chaos.count_skipped_step("module")
+                skipped = True
             else:
                 self._update_impl()
+                skipped = False
         if _obs.enabled():
             _obs_recompile.step_boundary()
             _obs_dist.step_boundary(self._kvstore)
+            if not skipped:
+                # goodput ledger: a committed (non-guard-skipped) step
+                _obs_goodput.note_step_commit()
         if _integrity.enabled():
             # same reverse-registration order as the fused grad path,
             # so vote evidence names the matching bucket/lane

@@ -54,6 +54,7 @@ from . import membudget
 from . import attribution
 from . import profile_store
 from . import costmodel
+from . import goodput
 from . import recompile
 from . import timeseries
 from . import watchdog
@@ -74,6 +75,8 @@ from .export import (chrome_trace, dump_chrome_trace, aggregate,
 from .recompile import get_detector, note_call, record_retrace
 from .events import event
 from .flight import record_incident, note_exit
+from .goodput import (compute_ledger, critical_path, elastic_downtime,
+                      note_step_commit)
 from .watchdog import get_watchdog
 
 # chain the flight recorder's unhandled-exception hook when telemetry
@@ -82,6 +85,8 @@ if core.enabled():
     flight.install()
 
 __all__ = ["chaos", "core", "dist", "events", "export", "flight",
+           "goodput", "compute_ledger", "critical_path",
+           "elastic_downtime", "note_step_commit",
            "histogram", "hlo",
            "http", "sideband", "slo", "membudget", "attribution",
            "integrity", "recompile", "timeseries",

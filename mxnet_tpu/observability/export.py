@@ -208,6 +208,8 @@ def aggregate_table():
     lines.extend(attribution.format_ops_table())
     from . import costmodel
     lines.extend(costmodel.format_calibration_table())
+    from . import goodput
+    lines.extend(goodput.format_table_section())
     if core.dropped():
         lines.append("")
         lines.append("(%d oldest records dropped from the ring; "
@@ -219,10 +221,44 @@ def aggregate_table():
 # ------------------------------------------------- prometheus --------
 
 def _prom_name(name):
+    """One name sanitized to the Prometheus charset [a-zA-Z0-9_]
+    (leading digits get a ``_`` prefix). Lossy on its own — named
+    scopes like ``block[0]/attn`` and ``block(0).attn`` collapse to
+    the same series — so exposition paths use :func:`_prom_name_map`
+    for a collision-free mapping over the whole name set."""
     out = []
     for ch in name:
         out.append(ch if ch.isalnum() or ch == "_" else "_")
-    return "".join(out)
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s or "_"
+
+
+def _prom_name_map(names):
+    """{original -> sanitized-and-unique} over ``names``. Collisions
+    (distinct originals sanitizing to the same series name) get a
+    deterministic ``_2``/``_3``... suffix in sorted-original order —
+    the sorted-first original keeps the bare name, so the mapping is
+    stable for a given name set regardless of iteration order."""
+    by_sanitized = {}
+    for name in sorted(set(names)):
+        by_sanitized.setdefault(_prom_name(name), []).append(name)
+    out = {}
+    used = set(by_sanitized)
+    for base in sorted(by_sanitized):
+        members = by_sanitized[base]
+        out[members[0]] = base
+        n = 2
+        for name in members[1:]:
+            cand = "%s_%d" % (base, n)
+            while cand in used:
+                n += 1
+                cand = "%s_%d" % (base, n)
+            used.add(cand)
+            out[name] = cand
+            n += 1
+    return out
 
 
 def prometheus_text():
@@ -243,26 +279,28 @@ def prometheus_text():
         for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
             lines.append('mxnet_obs_span_ms{%s,quantile="%s"} %.6f'
                          % (lab, q, s[key]))
+    cmap = _prom_name_map(agg["counters"])
     lines.append("# HELP mxnet_obs_counter_total accumulated counter "
                  "deltas")
     lines.append("# TYPE mxnet_obs_counter_total counter")
     for name, s in agg["counters"].items():
         lines.append('mxnet_obs_counter_total{name="%s"} %g'
-                     % (_prom_name(name), s["total"]))
+                     % (cmap[name], s["total"]))
     lines.append("# HELP mxnet_obs_value last recorded value per "
                  "counter/gauge")
     lines.append("# TYPE mxnet_obs_value gauge")
     for name, s in agg["counters"].items():
         lines.append('mxnet_obs_value{name="%s"} %g'
-                     % (_prom_name(name), s["value"]))
+                     % (cmap[name], s["value"]))
     from . import histogram as _hist
     hists = _hist.histograms()
     if hists:
         lines.append("# HELP mxnet_obs_hist log-bucketed latency "
                      "histograms (serving.* request distributions)")
         lines.append("# TYPE mxnet_obs_hist histogram")
+        hmap = _prom_name_map(hists)
         for name, h in sorted(hists.items()):
-            pname = _prom_name(name)
+            pname = hmap[name]
             for le, cum in h.cumulative_buckets():
                 lines.append(
                     'mxnet_obs_hist_bucket{name="%s",le="%s"} %d'
@@ -283,10 +321,14 @@ def prometheus_text():
         lines.append("# HELP mxnet_obs_anomaly trend-detector firings "
                      "(timeseries.py detectors over fleet history)")
         lines.append("# TYPE mxnet_obs_anomaly counter")
+        amap = _prom_name_map(n[len("obs.anomaly."):]
+                              for n, _s in anomalies)
         for name, s in anomalies:
             lines.append('mxnet_obs_anomaly_%s %g'
-                         % (_prom_name(name[len("obs.anomaly."):]),
+                         % (amap[name[len("obs.anomaly."):]],
                             s["value"]))
+    from . import goodput
+    lines.extend(goodput.prometheus_lines())
     from . import dist
     lines.append("# HELP mxnet_obs_rank this process's rank (label the "
                  "scrape per worker in multi-host jobs)")

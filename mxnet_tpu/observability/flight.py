@@ -227,6 +227,11 @@ def _payload(cause, exit_code, extra):
         health["membudget"] = _mb.healthz_snapshot()
     except Exception:                  # noqa: BLE001
         pass
+    try:
+        from . import goodput as _goodput
+        health["goodput"] = _goodput.healthz_snapshot()
+    except Exception:                  # noqa: BLE001
+        pass
     doc = {
         "schema": SCHEMA,
         "cause": str(cause),

@@ -1,0 +1,829 @@
+"""Goodput ledger — whole-run wall-clock accounting + badput taxonomy
++ cross-rank critical-path attribution.
+
+Every other observability layer answers "how long did X take"; this one
+answers the question the north star actually asks: **of every
+wall-clock second the run consumed, how many produced committed train
+steps or emitted-and-kept serving tokens, and where did the rest go?**
+The TF system paper and the cross-replica sharding work (PAPERS.md)
+both treat whole-fleet utilization accounting — not per-op timing — as
+the operative metric at scale; five robustness PRs (elastic shrink,
+preemption+resume, OOM re-lowering, journal replay, brownout) added
+recovery paths whose *cost in lost time* lands here.
+
+The ledger classifies 100% of the observed wall window into:
+
+* **goodput** — time under committed work spans: the train-step lattice
+  (``trainer.step``/``forward``/``backward``/``allreduce``/``update``,
+  minus guard-skipped and OOM-failed steps) and the serving compute
+  spans (``serving.dispatch``/``sync``/``prefill``/``patch``).
+* a **badput taxonomy** (``CATEGORIES``): ``data_stall`` (io.next /
+  io.prefetch_wait), ``recompile`` (PR 2 detector instants, whose
+  ``duration_s`` reconstructs the compile interval), ``checkpoint``
+  (save/snapshot spans), ``guard_skipped`` (step spans containing a
+  ``chaos.step_skipped`` marker), ``oom_relower`` (step spans
+  containing a ``mem.oom`` marker), ``elastic_recovery``
+  (``elastic.recovered`` instants in-run; the cross-generation
+  stitching below for whole-timeline downtime), ``preempt_stall``
+  (serving.preempt -> serving.resumed, FIFO-paired),
+  ``requeue_redone`` (the re-prefill a requeued request pays),
+  ``spec_rejected`` (dispatch time times the rejected-draft fraction),
+  and ``brownout`` (non-goodput gaps while the brownout rung is up).
+* an **untracked** remainder the ledger is *required* to keep small
+  (``MXNET_OBS_GOODPUT_WARN``, default 5%).
+
+Categories overlap in time (a recompile fires inside a step span); the
+sweep resolves every elementary segment to the highest-priority
+covering category (``_PRIORITY``), so the invariant
+
+    goodput + sum(badput) + untracked == wall
+
+holds exactly by construction. ``brownout`` ranks BELOW goodput:
+throttled-but-working time is goodput, only the throttle's idle gaps
+are badput.
+
+**Elastic downtime across generations**: a process that died at
+generation g cannot time its own absence. ``elastic_downtime`` stitches
+the ``MXNET_ELASTIC_DIR`` sideband into one timeline: the
+``shrink.g<g>.json`` wall stamp (failure detected) to the
+``goodput.firstcommit.g<g>.rank<r>.json`` record the first committed
+step of g writes (``note_step_commit``), so the recovery interval spans
+the generation boundary by construction.
+
+**Critical path** (``critical_path``): over a PR 3 merged trace, the
+i-th ``trainer.step`` span of every rank lane is one step on the common
+timebase; the step's wall time runs from the earliest rank's phase
+start to the latest rank's step end, the rank that ends last is the
+*critical rank*, and its forward/backward/allreduce/update durations —
+plus the skew it started late by — bound the step. Aggregated: "step
+time is X% bound by rank r backward, Y% by allreduce, Z% by straggler
+skew".
+
+Surfaces: ``goodput.fraction`` / ``badput.<category>_ms`` gauges
+(all three PR 2 exporters), an aggregate-table section, fresh
+``mxnet_obs_goodput_*`` Prometheus series, the ``/healthz`` ``goodput``
+key, PR 17 incident bundles, and per-run ``goodput.*`` scope records in
+the PR 18 profile store so ``perf_timeline`` / ``obs_regression
+--history`` trend goodput across runs like any scope timing.
+
+Off path (``MXNET_OBS`` unset) everything here is one guarded branch
+with zero new I/O; ``MXNET_OBS_GOODPUT=0`` disables the ledger alone.
+"""
+
+import json
+import os
+import re
+import time
+
+from . import core
+from .. import _fastenv
+
+__all__ = ["CATEGORIES", "enabled", "warn_fraction",
+           "events_from_ring", "events_from_trace", "compute_ledger",
+           "critical_path", "format_table", "format_table_section",
+           "prometheus_lines", "healthz_snapshot", "publish",
+           "archive_run", "on_dump", "note_step_commit",
+           "first_commit_path", "read_first_commit",
+           "elastic_downtime", "reset"]
+
+# badput taxonomy, in report order
+CATEGORIES = ("data_stall", "recompile", "checkpoint", "guard_skipped",
+              "oom_relower", "elastic_recovery", "preempt_stall",
+              "requeue_redone", "spec_rejected", "brownout")
+
+# sweep priority, highest first: a segment covered by several
+# categories is charged to the first one here. brownout sits BELOW
+# goodput on purpose (throttled-but-working time is goodput; only the
+# throttle's idle gaps are badput).
+_PRIORITY = ("elastic_recovery", "recompile", "checkpoint",
+             "guard_skipped", "oom_relower", "data_stall",
+             "preempt_stall", "requeue_redone", "goodput", "brownout")
+
+# spans whose time is committed work (step spans filtered by the
+# skip/oom markers before entering this union)
+_GOODPUT_SPANS = frozenset((
+    "trainer.step", "forward", "backward", "allreduce", "update",
+    "serving.dispatch", "serving.sync", "serving.prefill",
+    "serving.patch"))
+_SERVING_DISPATCH = frozenset(("serving.dispatch", "serving.sync"))
+_STEP_SPANS = frozenset(("trainer.step", "update"))
+_STALL_SPANS = frozenset(("io.next", "io.prefetch_wait"))
+
+
+def enabled():
+    """THE off-path guard: telemetry on AND MXNET_OBS_GOODPUT not
+    explicitly disabled (default on — the ledger reads the ring that
+    already exists, costing nothing extra per step)."""
+    if not core.enabled():
+        return False
+    v = _fastenv.get("MXNET_OBS_GOODPUT")
+    return v not in ("0", "false", "False")
+
+
+def warn_fraction():
+    """MXNET_OBS_GOODPUT_WARN: the untracked fraction above which the
+    table flags the ledger itself as incomplete (default 0.05)."""
+    try:
+        return float(_fastenv.get("MXNET_OBS_GOODPUT_WARN", 0.05))
+    except (TypeError, ValueError):
+        return 0.05
+
+
+# ------------------------------------------------ event normalization --
+
+def events_from_ring():
+    """The telemetry ring as normalized events:
+    ``(ph, name, ts_us, dur_us, args, pid)``. ``ph`` is "X"/"i"/"C"
+    ("F" flows carry no time mass and are dropped)."""
+    out = []
+    for rec in core.records():
+        ph, name, _cat, ts, val, _tid, args = rec
+        if ph == "X":
+            out.append(("X", name, ts, val, args, 0))
+        elif ph == "i":
+            out.append(("i", name, ts, 0, args, 0))
+        elif ph == "C":
+            out.append(("C", name, ts, 0,
+                        {"value": val, "delta": args.get("delta")}, 0))
+    return out
+
+
+def events_from_trace(trace):
+    """A chrome trace JSON object (rank-local or merged) as normalized
+    events. Counter events keep their sampled value under
+    ``args["value"]`` regardless of the chrome arg key."""
+    out = []
+    for ev in trace.get("traceEvents", []):
+        ph = ev.get("ph")
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        pid = ev.get("pid", 0)
+        ts = ev.get("ts", 0)
+        if ph == "X":
+            out.append(("X", name, ts, ev.get("dur", 0), args, pid))
+        elif ph in ("i", "I"):
+            out.append(("i", name, ts, 0, args, pid))
+        elif ph == "C" and args:
+            out.append(("C", name, ts, 0,
+                        {"value": next(iter(args.values()))}, pid))
+    out.sort(key=lambda e: e[2])
+    return out
+
+
+# -------------------------------------------------- interval algebra --
+
+def _merge(iv):
+    """Merge a list of (t0, t1) intervals into a disjoint sorted
+    union."""
+    iv = sorted((a, b) for a, b in iv if b > a)
+    out = []
+    for a, b in iv:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def _length(merged):
+    return sum(b - a for a, b in merged)
+
+
+def _subtract(merged, covered):
+    """``merged`` minus ``covered`` (both disjoint sorted) as a new
+    disjoint sorted list — two-pointer, O(n+m)."""
+    out = []
+    j = 0
+    for a, b in merged:
+        cur = a
+        while j < len(covered) and covered[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(covered) and covered[k][0] < b:
+            ca, cb = covered[k]
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cb >= b:
+                break
+            k += 1
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def _clip(iv, t0, t1):
+    return [(max(a, t0), min(b, t1)) for a, b in iv
+            if min(b, t1) > max(a, t0)]
+
+
+# ------------------------------------------------------- the ledger --
+
+def _collect_intervals(events):
+    """Per-category raw interval lists (µs) + the scalar observations
+    the post-passes need. The marker-containment pass classifies step
+    spans: a ``chaos.step_skipped`` instant inside a step span turns it
+    into guard_skipped; a ``mem.oom`` instant turns it into
+    oom_relower; everything else is committed work."""
+    iv = {name: [] for name in _PRIORITY}
+    step_spans = []         # (t0, t1)
+    skip_ts, oom_ts = [], []
+    preempts, resumes, requeues = [], [], []
+    prefills = []           # (t0, t1) serving.prefill extents
+    finish_tokens = 0
+    spec_ratio = None
+    brownout_edges = []     # (ts, rung)
+    for ph, name, ts, dur, args, _pid in events:
+        if ph == "X":
+            t1 = ts + dur
+            if name in _STEP_SPANS:
+                step_spans.append((ts, t1, name))
+            elif name in _GOODPUT_SPANS:
+                iv["goodput"].append((ts, t1))
+                if name == "serving.prefill":
+                    prefills.append((ts, t1))
+            if name in _SERVING_DISPATCH:
+                iv.setdefault("_dispatch", []).append((ts, t1))
+            if name in _STALL_SPANS:
+                iv["data_stall"].append((ts, t1))
+            elif name.startswith("checkpoint."):
+                iv["checkpoint"].append((ts, t1))
+        elif ph == "i":
+            if name == "chaos.step_skipped":
+                skip_ts.append(ts)
+            elif name == "mem.oom":
+                oom_ts.append(ts)
+            elif name in ("recompile.trace",
+                          "recompile.backend_compile"):
+                dur_us = int(float(args.get("duration_s") or 0) * 1e6)
+                if dur_us > 0:
+                    iv["recompile"].append((ts - dur_us, ts))
+            elif name == "elastic.recovered":
+                ms = float(args.get("ms") or 0)
+                if ms > 0:
+                    iv["elastic_recovery"].append(
+                        (ts - int(ms * 1e3), ts))
+            elif name == "serving.preempt":
+                preempts.append(ts)
+            elif name == "serving.resumed":
+                resumes.append(ts)
+            elif name == "serving.requeued":
+                requeues.append(ts)
+            elif name == "serving.brownout":
+                try:
+                    brownout_edges.append((ts, int(args.get("rung",
+                                                           0))))
+                except (TypeError, ValueError):
+                    pass
+            elif name in ("serving.finish", "serving.evict"):
+                try:
+                    finish_tokens += int(args.get("emitted") or 0)
+                except (TypeError, ValueError):
+                    pass
+        elif ph == "C" and name == "serving.spec_draft_ratio":
+            try:
+                spec_ratio = float(args.get("value"))
+            except (TypeError, ValueError):
+                pass
+
+    # marker containment: route each step span by the markers inside
+    # it. Gluon records trainer.step AND a nested update span — both
+    # route time, but only one kind counts steps (trainer.step when
+    # present; bare update spans only for Module-style workloads).
+    skip_ts.sort()
+    oom_ts.sort()
+    committed = skipped = oomed = 0
+    count_name = ("trainer.step"
+                  if any(n == "trainer.step" for _a, _b, n in step_spans)
+                  else "update")
+    for t0, t1, name in step_spans:
+        if _any_in(skip_ts, t0, t1):
+            iv["guard_skipped"].append((t0, t1))
+            skipped += name == count_name
+        elif _any_in(oom_ts, t0, t1):
+            iv["oom_relower"].append((t0, t1))
+            oomed += name == count_name
+        else:
+            iv["goodput"].append((t0, t1))
+            committed += name == count_name
+
+    # FIFO pairing: the k-th preempt resolves at the first resume after
+    # it (the batcher re-admits parked work oldest-first); an unpaired
+    # preempt stalls to the end of the window (clipped later).
+    resumes.sort()
+    ri = 0
+    for pts in sorted(preempts):
+        while ri < len(resumes) and resumes[ri] <= pts:
+            ri += 1
+        end = resumes[ri] if ri < len(resumes) else None
+        if ri < len(resumes):
+            ri += 1
+        iv["preempt_stall"].append((pts, end if end is not None
+                                    else float("inf")))
+    # a requeued request pays its re-prefill again: charge the first
+    # prefill span starting at/after each requeue instant
+    prefills.sort()
+    pi = 0
+    for rts in sorted(requeues):
+        while pi < len(prefills) and prefills[pi][0] < rts:
+            pi += 1
+        if pi < len(prefills):
+            iv["requeue_redone"].append(prefills[pi])
+            pi += 1
+    # brownout: intervals where the rung is above 0
+    open_ts = None
+    for ts, rung in sorted(brownout_edges):
+        if rung > 0 and open_ts is None:
+            open_ts = ts
+        elif rung == 0 and open_ts is not None:
+            iv["brownout"].append((open_ts, ts))
+            open_ts = None
+    if open_ts is not None:
+        iv["brownout"].append((open_ts, float("inf")))
+
+    return iv, {"committed": committed, "skipped": skipped,
+                "oom": oomed, "tokens": finish_tokens,
+                "spec_ratio": spec_ratio}
+
+
+def _any_in(sorted_ts, t0, t1):
+    import bisect
+    i = bisect.bisect_left(sorted_ts, t0)
+    return i < len(sorted_ts) and sorted_ts[i] <= t1
+
+
+def compute_ledger(events=None, wall_us=None):
+    """Classify the observed wall window. ``events`` defaults to the
+    live ring; ``wall_us`` overrides the window length (default: first
+    record to last record end). Returns the ledger dict; the invariant
+    ``goodput_ms + sum(badput_ms) + untracked_ms == wall_ms`` holds to
+    float precision."""
+    if events is None:
+        events = events_from_ring()
+    iv, obs = _collect_intervals(events)
+    spans = [(ts, ts + dur) for ph, _n, ts, dur, _a, _p in events
+             if ph == "X"] + \
+        [(ts, ts) for ph, _n, ts, _d, _a, _p in events if ph != "X"]
+    # recompile/recovery intervals reconstructed backwards from their
+    # end instant may begin before the first record — they extend the
+    # observed window (that compile time was real wall time)
+    for cat in ("recompile", "elastic_recovery"):
+        spans.extend((a, b) for a, b in iv[cat])
+    if not spans:
+        return _empty_ledger()
+    t0 = min(a for a, _b in spans)
+    t1 = max(b for _a, b in spans if b != float("inf"))
+    if wall_us is not None:
+        t1 = t0 + int(wall_us)
+    if t1 <= t0:
+        return _empty_ledger()
+
+    covered = []
+    assigned = {}
+    for cat in _PRIORITY:
+        merged = _merge(_clip(iv[cat], t0, t1))
+        assigned[cat] = _length(_subtract(merged, covered)) / 1e3
+        covered = _merge(covered + merged)
+
+    wall_ms = (t1 - t0) / 1e3
+    goodput_ms = assigned.pop("goodput")
+    badput = {cat: assigned.get(cat, 0.0) for cat in CATEGORIES}
+
+    # spec_rejected post-pass: a scalar transfer out of goodput — the
+    # dispatch share of goodput times the rejected-draft fraction
+    # (1 - the serving.spec_draft_ratio gauge's last sample)
+    ratio = obs["spec_ratio"]
+    if ratio is not None and ratio < 1.0 and goodput_ms > 0:
+        disp = _length(_merge(_clip(iv.get("_dispatch", []),
+                                    t0, t1))) / 1e3
+        spec_ms = min(goodput_ms, disp * max(0.0, 1.0 - ratio))
+        badput["spec_rejected"] = spec_ms
+        goodput_ms -= spec_ms
+
+    badput_total = sum(badput.values())
+    untracked = max(wall_ms - goodput_ms - badput_total, 0.0)
+    return {
+        "wall_ms": wall_ms,
+        "goodput_ms": goodput_ms,
+        "goodput_fraction": goodput_ms / wall_ms if wall_ms else 0.0,
+        "badput_ms": badput,
+        "badput_total_ms": badput_total,
+        "untracked_ms": untracked,
+        "untracked_fraction": untracked / wall_ms if wall_ms else 0.0,
+        "steps": {"committed": obs["committed"],
+                  "skipped": obs["skipped"], "oom": obs["oom"]},
+        "tokens_emitted": obs["tokens"],
+        "window_us": [int(t0), int(t1)],
+    }
+
+
+def _empty_ledger():
+    return {"wall_ms": 0.0, "goodput_ms": 0.0, "goodput_fraction": 0.0,
+            "badput_ms": {cat: 0.0 for cat in CATEGORIES},
+            "badput_total_ms": 0.0, "untracked_ms": 0.0,
+            "untracked_fraction": 0.0,
+            "steps": {"committed": 0, "skipped": 0, "oom": 0},
+            "tokens_emitted": 0, "window_us": [0, 0]}
+
+
+# -------------------------------------------------- critical path ----
+
+_PHASES = ("forward", "backward", "allreduce", "update")
+
+
+def critical_path(events):
+    """Walk the per-rank step lattice of a merged (or rank-local)
+    trace. For step i: the window runs from the earliest rank's phase
+    start to the latest rank's ``trainer.step`` end; the rank ending
+    last is the critical rank; its phase durations + the skew it
+    started late by bound the step. Returns None when no
+    ``trainer.step`` spans exist (serving-only trace)."""
+    by_rank = {}
+    for ph, name, ts, dur, _args, pid in events:
+        if ph != "X":
+            continue
+        if name == "trainer.step" or name in _PHASES:
+            by_rank.setdefault(pid, {}).setdefault(name, []).append(
+                (ts, ts + dur))
+    ranks = sorted(r for r, sp in by_rank.items()
+                   if sp.get("trainer.step"))
+    if not ranks:
+        return None
+    for sp in by_rank.values():
+        for lst in sp.values():
+            lst.sort()
+    nsteps = max(len(by_rank[r]["trainer.step"]) for r in ranks)
+
+    bound = {}              # (rank, phase) -> us
+    skew_us = other_us = total_us = 0
+    counted = 0
+    for i in range(nsteps):
+        parts = []
+        for r in ranks:
+            steps = by_rank[r]["trainer.step"]
+            if i >= len(steps):
+                continue
+            s0, s1 = steps[i]
+            w0 = s0
+            for phs in _PHASES:
+                lst = by_rank[r].get(phs, [])
+                if i < len(lst):
+                    w0 = min(w0, lst[i][0])
+            parts.append((r, w0, s1))
+        if not parts:
+            continue
+        counted += 1
+        step_start = min(w0 for _r, w0, _s1 in parts)
+        crit_rank, crit_w0, crit_end = max(parts, key=lambda p: p[2])
+        step_wall = crit_end - step_start
+        total_us += step_wall
+        skew = max(crit_w0 - step_start, 0)
+        skew_us += skew
+        phase_sum = 0
+        for phs in _PHASES:
+            lst = by_rank[crit_rank].get(phs, [])
+            if i < len(lst):
+                d = lst[i][1] - lst[i][0]
+                # phases nest (allreduce/update inside trainer.step);
+                # forward/backward precede it — all charge the critical
+                # rank, clamped so a step never over-attributes
+                d = min(d, step_wall - skew - phase_sum)
+                if d > 0:
+                    bound[(crit_rank, phs)] = \
+                        bound.get((crit_rank, phs), 0) + d
+                    phase_sum += d
+        other_us += max(step_wall - skew - phase_sum, 0)
+
+    if not total_us:
+        return None
+    rows = [{"rank": r, "phase": p, "ms": us / 1e3,
+             "fraction": us / total_us}
+            for (r, p), us in bound.items()]
+    rows.sort(key=lambda x: -x["ms"])
+    return {"steps": counted, "ranks": ranks, "bound": rows,
+            "skew_ms": skew_us / 1e3,
+            "skew_fraction": skew_us / total_us,
+            "other_ms": other_us / 1e3,
+            "other_fraction": other_us / total_us,
+            "total_ms": total_us / 1e3}
+
+
+# ------------------------------------------------------- rendering ---
+
+def format_table(ledger, cpath=None):
+    """The ledger (+ optional critical path) as aggregate-table-style
+    lines."""
+    lines = ["", "Goodput ledger (wall %.1f ms; goodput + badput + "
+             "untracked = wall)" % ledger["wall_ms"]]
+    fmt = "  %-18s %12.1f ms %7.1f%%"
+    wall = ledger["wall_ms"] or 1.0
+    steps = ledger["steps"]
+    extra = "   (%d steps committed" % steps["committed"]
+    if ledger["tokens_emitted"]:
+        extra += ", %d tokens emitted" % ledger["tokens_emitted"]
+    extra += ")"
+    lines.append(fmt % ("goodput", ledger["goodput_ms"],
+                        100.0 * ledger["goodput_ms"] / wall) + extra)
+    for cat in CATEGORIES:
+        ms = ledger["badput_ms"][cat]
+        if ms <= 0:
+            continue
+        note = ""
+        if cat == "guard_skipped" and steps["skipped"]:
+            note = "   (%d steps skipped)" % steps["skipped"]
+        elif cat == "oom_relower" and steps["oom"]:
+            note = "   (%d OOM-failed steps)" % steps["oom"]
+        lines.append(fmt % (cat, ms, 100.0 * ms / wall) + note)
+    warn = ""
+    if ledger["untracked_fraction"] > warn_fraction():
+        warn = ("   <-- above the %.0f%% budget; the ledger is "
+                "missing a category" % (100.0 * warn_fraction()))
+    lines.append(fmt % ("untracked", ledger["untracked_ms"],
+                        100.0 * ledger["untracked_fraction"]) + warn)
+    if cpath:
+        lines.append("")
+        lines.append("Critical path (%d rank%s, %d steps; what bounds "
+                     "the step)" % (len(cpath["ranks"]),
+                                    "s" if len(cpath["ranks"]) != 1
+                                    else "", cpath["steps"]))
+        for row in cpath["bound"][:8]:
+            lines.append("  rank %-3d %-12s %12.1f ms %7.1f%%"
+                         % (row["rank"], row["phase"], row["ms"],
+                            100.0 * row["fraction"]))
+        if cpath["skew_ms"] > 0:
+            lines.append("  %-21s %12.1f ms %7.1f%%"
+                         % ("straggler skew", cpath["skew_ms"],
+                            100.0 * cpath["skew_fraction"]))
+        if cpath["other_ms"] > 0:
+            lines.append("  %-21s %12.1f ms %7.1f%%"
+                         % ("other (host)", cpath["other_ms"],
+                            100.0 * cpath["other_fraction"]))
+    return lines
+
+
+def format_table_section():
+    """The aggregate-table hook (export.aggregate_table): the live
+    ring's ledger + critical path, or [] when off/empty."""
+    if not enabled():
+        return []
+    try:
+        events = events_from_ring()
+        ledger = compute_ledger(events)
+        if not ledger["wall_ms"]:
+            return []
+        return format_table(ledger, critical_path(events))
+    except Exception:   # noqa: BLE001 — a broken table must not break dumps
+        return []
+
+
+def prometheus_lines():
+    """Fresh mxnet_obs_goodput_* series for the Prometheus exporter
+    (rendered per scrape like everything else — no ring mutation)."""
+    if not enabled():
+        return []
+    try:
+        ledger = compute_ledger()
+    except Exception:   # noqa: BLE001
+        return []
+    if not ledger["wall_ms"]:
+        return []
+    lines = [
+        "# HELP mxnet_obs_goodput_fraction fraction of wall-clock "
+        "spent on committed steps / kept tokens",
+        "# TYPE mxnet_obs_goodput_fraction gauge",
+        "mxnet_obs_goodput_fraction %.6f" % ledger["goodput_fraction"],
+        "# HELP mxnet_obs_badput_ms wall-clock lost per badput "
+        "category (goodput ledger taxonomy)",
+        "# TYPE mxnet_obs_badput_ms gauge"]
+    for cat in CATEGORIES:
+        lines.append('mxnet_obs_badput_ms{category="%s"} %.3f'
+                     % (cat, ledger["badput_ms"][cat]))
+    lines.append('mxnet_obs_badput_ms{category="untracked"} %.3f'
+                 % ledger["untracked_ms"])
+    lines.append("# HELP mxnet_obs_goodput_wall_ms observed ledger "
+                 "window")
+    lines.append("# TYPE mxnet_obs_goodput_wall_ms gauge")
+    lines.append("mxnet_obs_goodput_wall_ms %.3f" % ledger["wall_ms"])
+    return lines
+
+
+def healthz_snapshot():
+    """The /healthz ``goodput`` section (also rides PR 17 incident
+    bundles): the compact ledger for dashboards and the router."""
+    if not enabled():
+        return {}
+    try:
+        ledger = compute_ledger()
+    except Exception:   # noqa: BLE001 — health must never 500
+        return {}
+    return {"wall_ms": round(ledger["wall_ms"], 3),
+            "goodput_fraction": round(ledger["goodput_fraction"], 4),
+            "goodput_ms": round(ledger["goodput_ms"], 3),
+            "badput_ms": {k: round(v, 3)
+                          for k, v in ledger["badput_ms"].items()
+                          if v > 0},
+            "untracked_fraction": round(ledger["untracked_fraction"],
+                                        4),
+            "steps": ledger["steps"],
+            "tokens_emitted": ledger["tokens_emitted"]}
+
+
+# ---------------------------------------------------- publish/archive --
+
+def publish(ledger=None):
+    """Land the ledger as gauges so all three PR 2 exporters carry it:
+    ``goodput.fraction``, ``goodput.wall_ms``, ``badput.<cat>_ms``,
+    ``goodput.untracked_ms``."""
+    if not enabled():
+        return None
+    if ledger is None:
+        ledger = compute_ledger()
+    if not ledger["wall_ms"]:
+        return ledger
+    core.gauge("goodput.fraction").set(ledger["goodput_fraction"])
+    core.gauge("goodput.wall_ms").set(ledger["wall_ms"])
+    core.gauge("goodput.untracked_ms").set(ledger["untracked_ms"])
+    for cat, ms in ledger["badput_ms"].items():
+        if ms > 0:
+            core.gauge("badput.%s_ms" % cat).set(ms)
+    return ledger
+
+
+def archive_run(ledger=None, run=None, dirpath=None):
+    """Persist the ledger into the PR 18 profile store as scope-shaped
+    records (``goodput.fraction``, ``goodput.goodput``,
+    ``goodput.<category>``, ``goodput.untracked``, stats in ms except
+    the fraction) so perf_timeline / obs_regression --history trend
+    goodput across runs exactly like scope timings. One guarded branch
+    when the store is off; never raises."""
+    from . import profile_store as _ps
+    try:
+        if dirpath is None and not _ps.enabled():
+            return 0
+        if ledger is None:
+            ledger = compute_ledger()
+        if not ledger["wall_ms"]:
+            return 0
+        fid, cfg = _ps.config_fingerprint()
+        run = run or _ps.run_id()
+        ts = time.time()
+        rows = [("goodput.fraction", ledger["goodput_fraction"]),
+                ("goodput.goodput", ledger["goodput_ms"]),
+                ("goodput.wall", ledger["wall_ms"]),
+                ("goodput.untracked", ledger["untracked_ms"])]
+        rows += [("goodput.%s" % cat, ms)
+                 for cat, ms in ledger["badput_ms"].items() if ms > 0]
+        wrote = 0
+        for scope, val in rows:
+            rec = {"schema": _ps.SCHEMA, "kind": "scope", "run": run,
+                   "ts": ts, "host": _ps._host(), "scope": scope,
+                   "sig": _ps.signature_key(scope, "", fid),
+                   "signature": "", "fingerprint": fid, "config": cfg,
+                   "stats": {"count": 1, "total_ms": float(val),
+                             "p50_ms": float(val),
+                             "p99_ms": float(val)},
+                   "flops": 0, "hbm_bytes": 0}
+            if _ps.append(rec, dirpath=dirpath) is not None:
+                wrote += 1
+        if wrote:
+            _ps.prune(dirpath=dirpath)
+        return wrote
+    except Exception:   # noqa: BLE001 — archiving must not break dumps
+        return 0
+
+
+def on_dump():
+    """profiler.dump()'s goodput hook: publish the gauges (they ride
+    the trace + textfile being written) and archive the run. One
+    guarded branch when the ledger is off."""
+    if not enabled():
+        return None
+    try:
+        ledger = publish()
+    except Exception:   # noqa: BLE001
+        return None
+    if ledger and ledger["wall_ms"]:
+        archive_run(ledger)
+    return ledger
+
+
+# ------------------------------------ cross-generation stitching -----
+
+_commit_state = {"generation": None}
+
+
+def reset():
+    """Forget the per-generation first-commit latch (tests)."""
+    _commit_state["generation"] = None
+
+
+def note_step_commit(step=None):
+    """Per-committed-step hook (Trainer.step / Module.update, inside
+    their existing ``if obs.enabled():`` block). Counts the commit
+    and, once per elastic generation, writes the
+    ``goodput.firstcommit.g<g>.rank<r>.json`` sideband record that
+    closes that generation's recovery interval — the other half of
+    ``elastic_downtime``'s stitching. One guarded branch when the
+    ledger (or elastic) is off; never raises."""
+    if not enabled():
+        return
+    core.counter("goodput.steps_committed").add(1)
+    try:
+        from ..parallel import elastic as _elastic
+        if not _elastic.enabled():
+            return
+        g = _elastic.generation_env()
+        if _commit_state["generation"] == g:
+            return
+        _commit_state["generation"] = g
+        d = _elastic.elastic_dir()
+        path = first_commit_path(d, g, _elastic.rank_env())
+        if not os.path.exists(path):
+            _elastic._atomic_write_json(
+                path, {"generation": int(g),
+                       "rank": int(_elastic.rank_env()),
+                       "step": None if step is None else int(step),
+                       "wall": time.time()})
+    except Exception:   # noqa: BLE001 — sideband writes never take a step down
+        pass
+
+
+def first_commit_path(d, generation, rank):
+    return os.path.join(d, "goodput.firstcommit.g%d.rank%d.json"
+                        % (int(generation), int(rank)))
+
+
+def read_first_commit(d, generation):
+    """The earliest first-commit record of ``generation`` across
+    ranks, or None."""
+    best = None
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return None
+    prefix = "goodput.firstcommit.g%d.rank" % int(generation)
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if best is None or rec.get("wall", 0) < best.get("wall", 0):
+            best = rec
+    return best
+
+
+def elastic_downtime(d):
+    """Stitch the elastic sideband into per-generation recovery
+    intervals: for every ``shrink.g<g>.json``, downtime runs from the
+    shrink's wall stamp (failure detected, generation g-1 still dying)
+    to generation g's first committed step (``note_step_commit``
+    record; fallbacks: the g ``gen.json`` commit, then g's earliest
+    heartbeat) — an interval that SPANS the generation boundary by
+    construction. Returns a wall-ordered list of
+    ``{"generation", "from_wall", "to_wall", "ms", "dead",
+    "survivors", "closed_by"}``."""
+    out = []
+    if not d:
+        return out
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    shrink_re = re.compile(r"^shrink\.g(\d+)\.json$")
+    from ..parallel import elastic as _elastic
+    for name in names:
+        m = shrink_re.match(name)
+        if not m:
+            continue
+        g = int(m.group(1))
+        rec = _elastic.read_shrink_record(d, g)
+        if not rec:
+            continue
+        start = float(rec.get("wall", 0.0))
+        end, closed_by = None, None
+        fc = read_first_commit(d, g)
+        if fc and fc.get("wall"):
+            end, closed_by = float(fc["wall"]), "first_commit"
+        if end is None:
+            gen = _elastic.read_generation(d)
+            if gen and gen.get("generation") == g and gen.get("wall"):
+                end, closed_by = float(gen["wall"]), "generation"
+        if end is None:
+            beats = _elastic.read_heartbeats(d, g)
+            walls = [b.get("wall") for b in beats.values()
+                     if b.get("wall")]
+            if walls:
+                end, closed_by = float(min(walls)), "heartbeat"
+        out.append({"generation": g, "from_wall": start,
+                    "to_wall": end,
+                    "ms": max((end - start) * 1e3, 0.0)
+                    if end is not None and start else None,
+                    "dead": rec.get("dead", []),
+                    "survivors": rec.get("survivors", []),
+                    "closed_by": closed_by})
+    out.sort(key=lambda r: r["from_wall"])
+    return out

@@ -57,6 +57,11 @@ def _healthz():
         mem = membudget.healthz_snapshot()
     except Exception:  # noqa: BLE001 — health must never 500
         mem = {}
+    try:
+        from . import goodput as _goodput
+        gp = _goodput.healthz_snapshot()
+    except Exception:  # noqa: BLE001
+        gp = {}
     return {
         "status": "ok",
         "rank": dist.process_index(),
@@ -74,6 +79,7 @@ def _healthz():
         "slo": {"targets": dict(slo.targets()),
                 "attainment": slo.attainment()},
         "mem": mem,
+        "goodput": gp,
         "events": {"depth": _ev.depth(), "dropped": _ev.dropped(),
                    "kinds": _ev.counts()},
         "flight": {"last_incident": _flight.last_incident(),

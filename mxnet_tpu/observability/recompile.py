@@ -120,7 +120,10 @@ class RecompileDetector(object):
         core.record_instant(
             "recompile." + kind, cat="recompile",
             args={"origin": origin, "signature": signature,
-                  "steady": rec["steady"]})
+                  "steady": rec["steady"],
+                  # the goodput ledger reconstructs the compile
+                  # interval [ts - duration, ts] from this instant
+                  "duration_s": duration})
         core.counter("recompile." + kind).add(1)
         if kind == "backend_compile":
             # a fresh executable exists — per-operator attribution must

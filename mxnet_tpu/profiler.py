@@ -137,6 +137,12 @@ def dump(finished=True, profile_process="worker"):
     from .observability import profile_store as _obs_pstore
     if _obs_pstore.enabled():
         _obs_pstore.record_run()
+    # goodput ledger (ISSUE 19): publish goodput.fraction /
+    # badput.<cat>_ms gauges (they ride the trace + textfile written
+    # below) and archive the run's ledger into the profile store
+    from .observability import goodput as _obs_goodput
+    if _obs_goodput.enabled():
+        _obs_goodput.on_dump()
     path = _obs_dist.rank_trace_path(str(_config["filename"]))
     _obs_export.dump_chrome_trace(path)
     _obs_export.write_prometheus()

@@ -11,7 +11,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
 from mxnet_tpu.gluon import nn
-from mxnet_tpu.observability import core, export, recompile
+from mxnet_tpu.observability import attribution, core, export, recompile
 
 
 @pytest.fixture
@@ -26,6 +26,7 @@ def obs_on(monkeypatch):
     core.set_enabled(None)
     core.reset()
     recompile.get_detector().reset()
+    attribution.reset()
 
 
 # ------------------------------------------------------------- core --

@@ -1,0 +1,136 @@
+"""Goodput ledger + critical-path report over dumped traces.
+
+Feed it one or more chrome traces from ``profiler.dump()`` (rank-local
+or the merged output of ``tools/obs_merge.py``) and it answers: of
+every wall-clock second the run consumed, how many produced committed
+train steps / kept tokens, and where did the rest go? Each trace gets
+the full badput-taxonomy table (goodput + badput + untracked = wall by
+construction) and — when trainer.step spans exist — the cross-rank
+critical-path table naming which rank+phase bounds the step.
+
+With ``--elastic-dir`` (or ``MXNET_ELASTIC_DIR`` in the environment)
+it also stitches the elastic sideband across generations: each
+``shrink.g<g>.json`` -> first-committed-step record pair is one
+recovery interval that SPANS the generation boundary — downtime no
+single process could have timed, because the process that died isn't
+there to measure its own absence.
+
+    python tools/obs_goodput.py trace.json
+    python tools/obs_goodput.py merged.json --elastic-dir /tmp/elastic
+    python tools/obs_goodput.py trace.json --check        # CI gate
+    python tools/obs_goodput.py trace.json --json ledger.json
+
+``--check`` exits 1 when the untracked remainder exceeds
+``--max-untracked`` (default: MXNET_OBS_GOODPUT_WARN, 5%) — the ledger
+is *required* to explain the run's time, not just sample it.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def report(path, no_cpath=False):
+    """(ledger, critical_path) for one trace file, printing the
+    tables."""
+    from mxnet_tpu.observability import goodput
+    with open(path) as f:
+        trace = json.load(f)
+    events = goodput.events_from_trace(trace)
+    ledger = goodput.compute_ledger(events)
+    cpath = None if no_cpath else goodput.critical_path(events)
+    print("== %s ==" % path)
+    for line in goodput.format_table(ledger, cpath):
+        print(line)
+    print()
+    return ledger, cpath
+
+
+def report_elastic(d):
+    """Print (and return) the stitched cross-generation recovery
+    intervals."""
+    from mxnet_tpu.observability import goodput
+    rows = goodput.elastic_downtime(d)
+    if not rows:
+        print("(no shrink records under %s — no elastic downtime)" % d)
+        return rows
+    print("Elastic downtime (stitched across generations from %s)" % d)
+    print("  %-4s %-14s %-24s %12s  %s"
+          % ("gen", "dead ranks", "closed by", "downtime", "interval"))
+    for r in rows:
+        ms = "%.1f ms" % r["ms"] if r["ms"] is not None else "open"
+        iv = ("wall %.3f -> %.3f" % (r["from_wall"], r["to_wall"])
+              if r["to_wall"] else "wall %.3f -> ?" % r["from_wall"])
+        print("  %-4d %-14s %-24s %12s  %s"
+              % (r["generation"], ",".join(map(str, r["dead"])) or "-",
+                 r["closed_by"] or "-", ms, iv))
+    print()
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("traces", nargs="*",
+                   help="chrome traces from profiler.dump() or "
+                        "tools/obs_merge.py")
+    p.add_argument("--elastic-dir", default=None,
+                   help="MXNET_ELASTIC_DIR sideband to stitch "
+                        "cross-generation recovery intervals from "
+                        "(default: $MXNET_ELASTIC_DIR)")
+    p.add_argument("--json", default=None,
+                   help="write ledgers + critical paths + elastic "
+                        "intervals as JSON")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any trace's untracked fraction "
+                        "exceeds --max-untracked")
+    p.add_argument("--max-untracked", type=float, default=None,
+                   help="untracked budget for --check (fraction; "
+                        "default MXNET_OBS_GOODPUT_WARN, 0.05)")
+    p.add_argument("--no-critical-path", action="store_true",
+                   help="skip the per-step lattice walk (serving-only "
+                        "traces)")
+    args = p.parse_args(argv)
+
+    elastic_dir = args.elastic_dir or os.environ.get(
+        "MXNET_ELASTIC_DIR")
+    if not args.traces and not elastic_dir:
+        p.error("need at least one trace (or --elastic-dir)")
+
+    from mxnet_tpu.observability import goodput
+    budget = (args.max_untracked if args.max_untracked is not None
+              else goodput.warn_fraction())
+
+    out = {"traces": {}, "elastic": []}
+    failed = []
+    for path in args.traces:
+        ledger, cpath = report(path, args.no_critical_path)
+        out["traces"][path] = {"ledger": ledger,
+                               "critical_path": cpath}
+        if args.check and ledger["wall_ms"] \
+                and ledger["untracked_fraction"] > budget:
+            failed.append((path, ledger["untracked_fraction"]))
+    if elastic_dir:
+        out["elastic"] = report_elastic(elastic_dir)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print("wrote %s" % args.json)
+
+    if failed:
+        for path, frac in failed:
+            print("CHECK FAILED: %s untracked %.1f%% > budget %.1f%%"
+                  % (path, 100 * frac, 100 * budget))
+        return 1
+    if args.check:
+        print("check ok: untracked within %.1f%% on %d trace(s)"
+              % (100 * budget, len(args.traces)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,13 @@ directly:
 
 * a per-request TIMELINE — admit / first-token / sync / finish
   landmarks per rid, with an ASCII lane so a slow stream is visible at
-  a glance (which request, stalled where, requeued how often);
+  a glance (which request, stalled where, requeued how often).
+  Preemptions (``serving.preempt`` -> ``serving.resumed``), requeues
+  and the pool-level instants (``serving.kv_shrink`` /
+  ``serving.kv_grow`` / ``serving.brownout``) render too — a
+  preemption stall shows as ``P~~~`` instead of an unexplained gap,
+  and the global ``pool`` lane explains WHY (a shrink or brownout
+  landed right there);
 * the PERCENTILE TABLE — TTFT / ITL / e2e / queue-wait p50/p90/p99/
   p99.9 recomputed from the trace's bucket states (works on merged
   multi-rank traces: buckets are already combined fleet-wide).
@@ -43,7 +49,9 @@ def collect_requests(trace):
             "rid": int(rid), "admit_ts": None, "first_ts": None,
             "finish_ts": None, "syncs": [], "tokens": 0,
             "queue_ms": None, "prefill_ms": None, "requeues": 0,
-            "evicted": False, "lane": None, "rank": None})
+            "evicted": False, "lane": None, "rank": None,
+            "preempts": [], "requeue_ts": [], "resumed": False,
+            "resume_pos": None})
 
     for ev in trace.get("traceEvents", []):
         name = ev.get("name", "")
@@ -75,27 +83,72 @@ def collect_requests(trace):
             r["finish_ts"] = ts
             r["tokens"] = int(args.get("emitted", r["tokens"]))
             r["evicted"] = name == "serving.evict"
+        elif name == "serving.preempt":
+            # parked mid-decode (PR 11); the resume lands under a NEW
+            # rid, so this rid's lane ends in a visible ~stall~
+            r["preempts"].append(ts)
+        elif name == "serving.resumed":
+            # the rid here is the resume continuation's new identity
+            r["resumed"] = True
+            r["resume_pos"] = args.get("resume_pos")
+            if r["admit_ts"] is None:
+                r["admit_ts"] = ts
+        elif name == "serving.requeued":
+            r["requeue_ts"].append(ts)
     return reqs
 
 
-def render_timeline(reqs):
-    """ASCII lanes, one per request: Q(ueue) P(refill/admit) then a
-    dot per sync landmark, F(inish)/E(vict)/R(equeue markers)."""
+def collect_pool_events(trace):
+    """Pool-level instants that hit EVERY in-flight request — KV block
+    pool shrink/grow (PR 14 elastic handoff) and brownout rung moves —
+    as a wall-ordered [(ts, kind, args)] for the global timeline
+    lane."""
+    out = []
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") not in ("i", "I"):
+            continue
+        name = ev.get("name", "")
+        if name in ("serving.kv_shrink", "serving.kv_grow",
+                    "serving.brownout"):
+            out.append((ev.get("ts", 0), name[len("serving."):],
+                        ev.get("args") or {}))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def render_timeline(reqs, pool_events=None):
+    """ASCII lanes, one per request: ``-`` queue wait, A admit,
+    ``.`` chunk sync, P preempt + ``~`` stall fill, R requeue,
+    F(inish)/E(vict). A leading ``pool`` lane carries the global
+    instants: ``v`` kv_shrink, ``^`` kv_grow, ``!`` brownout rung up,
+    ``o`` rung restored."""
     spans = [r for r in reqs.values() if r["admit_ts"] is not None]
     if not spans:
         return ["(no serving.* request events in this trace)"]
     t0 = min(r["admit_ts"] - (r["queue_ms"] or 0) * 1000 for r in spans)
     t1 = max(max([r["finish_ts"] or r["admit_ts"]]
-                 + r["syncs"]) for r in spans)
+                 + r["syncs"] + r["preempts"] + r["requeue_ts"])
+             for r in spans)
     scale = (t1 - t0) or 1
 
     def col(ts):
-        return min(int((ts - t0) / scale * (TIMELINE_WIDTH - 1)),
-                   TIMELINE_WIDTH - 1)
+        return max(0, min(int((ts - t0) / scale * (TIMELINE_WIDTH - 1)),
+                          TIMELINE_WIDTH - 1))
 
-    lines = ["per-request timeline (%.1f ms window, '.'=chunk sync)"
-             % (scale / 1000.0),
-             "%-6s %-6s %-8s %s" % ("rid", "rank", "status", "lane")]
+    lines = ["per-request timeline (%.1f ms window; '.'=chunk sync, "
+             "P~=preempt stall, R=requeue; pool lane: v=kv_shrink "
+             "^=kv_grow !=brownout o=restored)" % (scale / 1000.0),
+             "%-6s %-6s %-10s %s" % ("rid", "rank", "status", "lane")]
+    if pool_events:
+        lane = [" "] * TIMELINE_WIDTH
+        for ts, kind, args in pool_events:
+            if kind == "brownout":
+                ch = "!" if int(args.get("rung", 0) or 0) > 0 else "o"
+            else:
+                ch = "v" if kind == "kv_shrink" else "^"
+            lane[col(ts)] = ch
+        lines.append("%-6s %-6s %-10s |%s|"
+                     % ("pool", "-", "-", "".join(lane)))
     for r in sorted(spans, key=lambda x: x["admit_ts"]):
         lane = [" "] * TIMELINE_WIDTH
         if r["queue_ms"]:
@@ -106,14 +159,32 @@ def render_timeline(reqs):
         for ts in r["syncs"]:
             c = col(ts)
             lane[c] = "." if lane[c] == " " else lane[c]
+        landmarks = sorted(r["syncs"] +
+                           ([r["finish_ts"]] if r["finish_ts"]
+                            is not None else []))
+        for pts in r["preempts"]:
+            # the resume continues under a new rid, so the stall runs
+            # to this rid's next landmark — or the window edge
+            pc = col(pts)
+            nxt = next((lts for lts in landmarks if lts > pts), None)
+            end = col(nxt) if nxt is not None else TIMELINE_WIDTH
+            for c in range(pc + 1, end):
+                if lane[c] == " ":
+                    lane[c] = "~"
+            lane[pc] = "P"
+        for ts in r["requeue_ts"]:
+            lane[col(ts)] = "R"
         if r["finish_ts"] is not None:
             lane[col(r["finish_ts"])] = "E" if r["evicted"] else "F"
         status = ("evicted" if r["evicted"]
                   else "done" if r["finish_ts"] is not None
-                  else "live")
-        if r["requeues"]:
-            status += "+rq%d" % r["requeues"]
-        lines.append("%-6d %-6s %-8s |%s|"
+                  else "parked" if r["preempts"] else "live")
+        if r["resumed"]:
+            status += "+res"
+        rq = max(r["requeues"], len(r["requeue_ts"]))
+        if rq:
+            status += "+rq%d" % rq
+        lines.append("%-6d %-6s %-10s |%s|"
                      % (r["rid"],
                         r["rank"] if r["rank"] is not None else "-",
                         status, "".join(lane)))
@@ -145,7 +216,8 @@ def main(argv=None):
     with open(args.trace) as f:
         trace = json.load(f)
     reqs = collect_requests(trace)
-    for line in render_timeline(reqs):
+    pool = collect_pool_events(trace)
+    for line in render_timeline(reqs, pool):
         print(line)
 
     rows = percentile_rows(trace)
@@ -168,6 +240,9 @@ def main(argv=None):
         with open(args.json, "w") as f:
             json.dump({"requests": sorted(reqs.values(),
                                           key=lambda r: r["rid"]),
+                       "pool_events": [{"ts": ts, "kind": kind,
+                                        "args": a}
+                                       for ts, kind, a in pool],
                        "histograms": dict(rows)}, f, indent=1)
         print("\nwrote %s" % args.json)
     return 0

@@ -361,6 +361,124 @@ def serving_smoke():
     return 0
 
 
+def goodput_smoke():
+    """--goodput: the whole-run wall-clock ledger (ISSUE 19). A
+    deterministic single-rank run with one injected stall per badput
+    class — a chaos ``delay`` at io.read inside a real DataIter
+    io.next, a detector-narrated recompile, committed step work and a
+    checkpoint span — must come back from ``compute_ledger`` with
+    >=95% of the wall attributed and every injected category within
+    20% of its injected duration, and ``tools/obs_goodput.py --check``
+    must pass on the dumped chrome trace."""
+    import time as _time
+
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.observability import chaos, core, export, goodput
+    from mxnet_tpu.observability import recompile
+
+    core.set_enabled(True)
+    core.reset()
+    chaos.reset()
+    goodput.reset()
+    try:
+        # a compile the detector narrates: its [ts - duration, ts]
+        # interval extends the window backwards, before the first span
+        recompile.get_detector()._push("trace", "goodput_smoke",
+                                       "sig(smoke)", 0.04)
+
+        class OneBatch(mio.DataIter):
+            def __init__(self):
+                super().__init__(batch_size=1)
+                self._left = 1
+
+            def iter_next(self):
+                self._left -= 1
+                return self._left >= 0
+
+            def getdata(self):
+                chaos.fire("io.read", path="goodput_smoke")
+                return []
+
+            def getlabel(self):
+                return []
+
+            def getpad(self):
+                return 0
+
+        # the sleep can overshoot badly on a loaded 1-core host, so
+        # the tolerance is against the MEASURED stall (what the ledger
+        # must reproduce), floored by the injected 50 ms
+        chaos.inject("io.read", "delay", ms=50)
+        t0 = _time.perf_counter()
+        OneBatch().next()
+        stall_ms = (_time.perf_counter() - t0) * 1e3
+        chaos.reset()
+
+        # committed work + a checkpoint, deterministic durations
+        t = _time.perf_counter_ns()
+        core.record_span("trainer.step", "step", t, t + 100 * 10**6)
+        core.record_span("checkpoint.save", "checkpoint",
+                         t + 100 * 10**6, t + 130 * 10**6)
+
+        led = goodput.compute_ledger()
+        for line in goodput.format_table(led):
+            print(line)
+        coverage = 1.0 - led["untracked_fraction"]
+        if coverage < 0.95:
+            print("[obs_smoke] FAIL: ledger attributes only %.1f%% of "
+                  "the wall" % (100.0 * coverage))
+            return 1
+        if stall_ms < 50.0:
+            print("[obs_smoke] FAIL: injected 50 ms delay measured "
+                  "only %.1f ms" % stall_ms)
+            return 1
+        expect = (("recompile", 40.0), ("data_stall", stall_ms),
+                  ("checkpoint", 30.0))
+        for cat, want in expect:
+            got = led["badput_ms"][cat]
+            if abs(got - want) > 0.20 * want:
+                print("[obs_smoke] FAIL: %s %.1f ms not within 20%% "
+                      "of the injected %.1f ms" % (cat, got, want))
+                return 1
+        if abs(led["goodput_ms"] - 100.0) > 20.0 \
+                or led["steps"]["committed"] != 1:
+            print("[obs_smoke] FAIL: goodput %.1f ms / %d committed "
+                  "steps (expected 100 ms / 1)"
+                  % (led["goodput_ms"], led["steps"]["committed"]))
+            return 1
+        text = export.prometheus_text()
+        if "mxnet_obs_goodput_fraction" not in text \
+                or 'mxnet_obs_badput_ms{category="data_stall"}' \
+                not in text:
+            print("[obs_smoke] FAIL: prometheus export lacks the "
+                  "goodput series")
+            return 1
+
+        # the CLI gate on the dumped trace (what CI runs on artifacts)
+        import importlib.util
+        fname = os.path.join(tempfile.mkdtemp(prefix="obs_goodput_"),
+                             "trace.json")
+        export.dump_chrome_trace(fname)
+        spec = importlib.util.spec_from_file_location(
+            "obs_goodput", os.path.join(ROOT, "tools",
+                                        "obs_goodput.py"))
+        obs_goodput = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(obs_goodput)
+        rc = obs_goodput.main([fname, "--check"])
+        if rc != 0:
+            print("[obs_smoke] FAIL: obs_goodput --check rc=%d on the "
+                  "dumped trace" % rc)
+            return 1
+        print("[obs_smoke] goodput OK: %.1f%% of %.1f ms wall "
+              "attributed, all injected categories within 20%% -> %s"
+              % (100.0 * coverage, led["wall_ms"], fname))
+        return 0
+    finally:
+        chaos.reset()
+        core.reset()
+        core.set_enabled(None)
+
+
 def worker():
     """One rank of the --nproc job (re-entered via tools/launch.py)."""
     from mxnet_tpu import parallel
@@ -374,8 +492,11 @@ def worker():
     return 0
 
 
-def orchestrate(nproc):
-    """Launch the gloo workers, then merge + validate the rank lanes."""
+def orchestrate(nproc, goodput_check=False):
+    """Launch the gloo workers, then merge + validate the rank lanes.
+    With ``goodput_check`` the merged trace must also yield a
+    cross-rank critical-path table naming a real rank+phase (ISSUE
+    19)."""
     outdir = tempfile.mkdtemp(prefix="obs_smoke_mp_")
     env = dict(os.environ)
     env.update({"OBS_SMOKE_WORKER": "1", "OBS_SMOKE_DIR": outdir,
@@ -438,6 +559,28 @@ def orchestrate(nproc):
              "+".join(str(c) for c in rank_counts),
              merged_hist.get("count", 0),
              os.path.join(outdir, "merged.json")))
+    if goodput_check:
+        from mxnet_tpu.observability import goodput as _goodput
+        events = _goodput.events_from_trace(merged)
+        cp = _goodput.critical_path(events)
+        if not cp or not cp.get("bound"):
+            print("[obs_smoke] FAIL: merged %d-rank trace yields no "
+                  "critical-path attribution" % nproc)
+            return 1
+        top = cp["bound"][0]
+        if top["rank"] not in range(nproc) \
+                or top["phase"] not in ("forward", "backward",
+                                        "allreduce", "update"):
+            print("[obs_smoke] FAIL: critical path names rank=%r "
+                  "phase=%r" % (top["rank"], top["phase"]))
+            return 1
+        for line in _goodput.format_table(
+                _goodput.compute_ledger(events), cp):
+            print(line)
+        print("[obs_smoke] critical path OK: step bound by rank %d "
+              "%s (%.1f%%) across %d steps"
+              % (top["rank"], top["phase"], 100.0 * top["fraction"],
+                 cp["steps"]))
     return 0
 
 
@@ -562,9 +705,20 @@ def main():
                         "two synthetic runs must merge into one "
                         "timeline and --history must flag an injected "
                         "2x slowdown")
+    p.add_argument("--goodput", action="store_true",
+                   help="run the goodput-ledger smoke instead: a "
+                        "deterministic injected-stall run must have "
+                        ">=95%% of its wall attributed with every "
+                        "category within 20%%; with --nproc 2 the "
+                        "merged trace's critical path must name a "
+                        "rank+phase")
     args = p.parse_args()
     if os.environ.get("OBS_SMOKE_WORKER"):
         return worker()
+    if args.goodput:
+        if args.nproc > 1:
+            return orchestrate(args.nproc, goodput_check=True)
+        return goodput_smoke()
     if args.store:
         return store_smoke()
     if args.serving:
